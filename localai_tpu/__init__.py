@@ -1,0 +1,23 @@
+"""localai_tpu — a TPU-native, OpenAI-compatible model serving framework.
+
+Brand-new design with the capabilities of the reference LocalAI
+(see /root/reference, structural analysis in SURVEY.md): an OpenAI-compatible
+HTTP surface, one narrow model-worker RPC protocol, and declarative per-model
+YAML configs — but the compute layer is a single JAX/XLA engine with Pallas
+kernels and pjit/ICI sharding instead of a zoo of per-format native engines.
+
+Layer map (mirrors SURVEY.md §1, re-architected TPU-first):
+
+  L7 CLI                localai_tpu.cli
+  L6 HTTP API           localai_tpu.api        (aiohttp, OpenAI/LocalAI/Jina surface)
+  L5 Services           localai_tpu.gallery, localai_tpu.utils.metrics
+  L4 Modality adapters  localai_tpu.worker.manager (request -> worker RPC)
+  L3 Model lifecycle    localai_tpu.worker     (spawn/health/watchdog)
+  L2 Compute            localai_tpu.engine, localai_tpu.models, localai_tpu.ops
+  L1 Distributed        localai_tpu.parallel   (Mesh/pjit/ICI collectives)
+  L0 Supporting libs    localai_tpu.{config,templates,functions,utils}
+"""
+
+from localai_tpu.version import __version__
+
+__all__ = ["__version__"]

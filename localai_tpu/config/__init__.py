@@ -1,0 +1,25 @@
+from localai_tpu.config.app_config import AppConfig
+from localai_tpu.config.loader import ConfigLoader, load_config_file, load_multi_config_file
+from localai_tpu.config.model_config import (
+    EngineConfig,
+    FunctionsConfig,
+    ModelConfig,
+    PredictionParams,
+    ShardingConfig,
+    TemplateConfig,
+    Usecase,
+)
+
+__all__ = [
+    "AppConfig",
+    "ConfigLoader",
+    "EngineConfig",
+    "FunctionsConfig",
+    "ModelConfig",
+    "PredictionParams",
+    "ShardingConfig",
+    "TemplateConfig",
+    "Usecase",
+    "load_config_file",
+    "load_multi_config_file",
+]

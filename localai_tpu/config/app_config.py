@@ -1,0 +1,100 @@
+"""Application-wide configuration.
+
+Parity: ApplicationConfig + functional options
+(/root/reference/core/config/application_config.go) and the CLI flag surface
+(/root/reference/core/cli/run.go:19-73). Flags are dataclass fields here;
+every field is env-overridable via LOCALAI_<UPPER_NAME> (see from_env).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Optional
+
+
+@dataclass
+class AppConfig:
+    # paths
+    model_path: str = "models"
+    backend_assets_path: str = "backend-assets"
+    upload_path: str = "uploaded_files"
+    config_path: str = "configuration"
+    audio_path: str = "generated_audio"
+    image_path: str = "generated_images"
+
+    # server
+    address: str = "0.0.0.0"
+    port: int = 8080
+    cors: bool = True
+    cors_allow_origins: str = "*"
+    api_keys: list[str] = field(default_factory=list)
+    opaque_errors: bool = False
+    disable_webui: bool = False
+    csrf: bool = False
+
+    # model management
+    galleries: list[dict] = field(default_factory=list)
+    autoload_galleries: bool = True
+    preload_models: list[str] = field(default_factory=list)
+    load_to_memory: list[str] = field(default_factory=list)
+    context_size: int = 4096
+    parallel_requests: bool = True
+    single_active_backend: bool = False
+    external_backends: dict[str, str] = field(default_factory=dict)
+
+    # watchdog (parity: run.go:66-69 defaults 5m busy / 15m idle)
+    watchdog_idle: bool = False
+    watchdog_busy: bool = False
+    watchdog_idle_timeout: float = 15 * 60.0
+    watchdog_busy_timeout: float = 5 * 60.0
+
+    # distributed / federation
+    p2p: bool = False
+    federated: bool = False
+    peer_token: str = ""
+
+    # observability
+    debug: bool = False
+    log_level: str = "info"
+    metrics: bool = True
+
+    # TPU-specific
+    mesh_shape: Optional[dict[str, int]] = None   # None = auto from devices
+    platform: Optional[str] = None                # force jax platform (tests: cpu)
+
+    def ensure_dirs(self) -> None:
+        """mkdir -p all configured paths (parity: core/startup/startup.go:20-60)."""
+        for p in (
+            self.model_path,
+            self.upload_path,
+            self.config_path,
+            self.audio_path,
+            self.image_path,
+        ):
+            Path(p).mkdir(parents=True, exist_ok=True)
+
+    @classmethod
+    def from_env(cls, **overrides) -> "AppConfig":
+        """Build from environment (parity: kong env tags, run.go:22-72)."""
+        cfg = cls()
+        for name, f in cls.__dataclass_fields__.items():
+            env = os.environ.get(f"LOCALAI_{name.upper()}")
+            if env is None:
+                continue
+            typ = f.type
+            if typ == "int":
+                setattr(cfg, name, int(env))
+            elif typ == "float":
+                setattr(cfg, name, float(env))
+            elif typ == "bool":
+                setattr(cfg, name, env.lower() in ("1", "true", "yes", "on"))
+            elif typ == "list[str]":
+                setattr(cfg, name, [s for s in env.split(",") if s])
+            elif typ == "str":
+                setattr(cfg, name, env)
+        for k, v in overrides.items():
+            if v is not None:
+                setattr(cfg, k, v)
+        return cfg

@@ -1,0 +1,157 @@
+"""Model-config registry: load one YAML, a multi-doc YAML, or a whole dir.
+
+Parity: BackendConfigLoader
+(/root/reference/core/config/backend_config_loader.go): LoadBackendConfig /
+LoadBackendConfigsFromPath / LoadMultipleBackendConfigsSingleFile, plus the
+thread-safe registry semantics the HTTP layer relies on.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from pathlib import Path
+from typing import Callable, Iterable, Optional
+
+import yaml
+
+from localai_tpu.config.model_config import ModelConfig, Usecase
+
+log = logging.getLogger(__name__)
+
+# files in a models dir that are not servable loose models (parity:
+# knownModelsNameSuffixToSkip, /root/reference/pkg/model/loader.go:54-67 —
+# weight files like .gguf/.safetensors are NOT skipped there)
+_SKIP_SUFFIXES = (".tmpl", ".keep", ".json", ".partial", ".md", ".MD",
+                  ".txt", ".jinja", ".tar.gz", ".DS_Store")
+_SKIP_FILES = ("MODEL_CARD", "README", "README.md")
+
+
+def load_config_file(path: str | Path) -> ModelConfig:
+    """Load a single-document model YAML (parity: readBackendConfigFromFile)."""
+    with open(path) as f:
+        data = yaml.safe_load(f) or {}
+    cfg = ModelConfig(**data)
+    return cfg
+
+
+def load_multi_config_file(path: str | Path) -> list[ModelConfig]:
+    """Load a file holding a LIST of configs (parity:
+    LoadMultipleBackendConfigsSingleFile)."""
+    with open(path) as f:
+        data = yaml.safe_load(f) or []
+    if isinstance(data, dict):
+        data = [data]
+    return [ModelConfig(**d) for d in data]
+
+
+class ConfigLoader:
+    """Thread-safe name→ModelConfig registry."""
+
+    def __init__(self, model_path: str | Path = "models"):
+        self.model_path = Path(model_path)
+        self._configs: dict[str, ModelConfig] = {}
+        self._lock = threading.RLock()
+
+    # -- loading ---------------------------------------------------------
+
+    def load_from_path(self, path: Optional[str | Path] = None,
+                       context_size: int = 4096) -> None:
+        """Scan a dir for *.yaml/*.yml configs (parity:
+        LoadBackendConfigsFromPath, backend_config_loader.go)."""
+        root = Path(path or self.model_path)
+        if not root.is_dir():
+            return
+        for entry in sorted(root.iterdir()):
+            if not entry.is_file():
+                continue
+            if entry.suffix not in (".yaml", ".yml"):
+                continue
+            try:
+                cfg = load_config_file(entry)
+            except Exception as e:  # noqa: BLE001 — skip malformed, keep loading
+                log.warning("skipping malformed config %s: %s", entry, e)
+                continue
+            if not cfg.name:
+                cfg.name = entry.stem
+            cfg.set_defaults(context_size=context_size)
+            if cfg.validate_config():
+                self.register(cfg)
+            else:
+                log.warning("invalid config %s, skipping", entry)
+
+    def load_single(self, path: str | Path, context_size: int = 4096) -> ModelConfig:
+        cfg = load_config_file(path)
+        if not cfg.name:
+            cfg.name = Path(path).stem
+        cfg.set_defaults(context_size=context_size)
+        self.register(cfg)
+        return cfg
+
+    # -- registry --------------------------------------------------------
+
+    def register(self, cfg: ModelConfig) -> None:
+        with self._lock:
+            self._configs[cfg.name] = cfg
+
+    def remove(self, name: str) -> None:
+        with self._lock:
+            self._configs.pop(name, None)
+
+    def get(self, name: str) -> Optional[ModelConfig]:
+        with self._lock:
+            return self._configs.get(name)
+
+    def exists(self, name: str) -> bool:
+        with self._lock:
+            return name in self._configs
+
+    def names(self) -> list[str]:
+        with self._lock:
+            return sorted(self._configs)
+
+    def all(self) -> list[ModelConfig]:
+        with self._lock:
+            return [self._configs[k] for k in sorted(self._configs)]
+
+    def by_usecase(self, uc: Usecase) -> list[ModelConfig]:
+        """Filter (parity: GetBackendConfigsByFilter + usecase flags)."""
+        return [c for c in self.all() if c.has_usecase(uc)]
+
+    # -- loose model files ----------------------------------------------
+
+    def loose_files(self) -> list[str]:
+        """Model files in the models dir without a YAML config; served with
+        default settings (parity: services/list_models.go:17-49 loose-file
+        policy + ModelLoader.ListFilesInModelPath skip list
+        /root/reference/pkg/model/loader.go:54-67)."""
+        if not self.model_path.is_dir():
+            return []
+        out = []
+        for entry in sorted(self.model_path.iterdir()):
+            if not entry.is_file() or entry.name.startswith("."):
+                continue
+            if entry.suffix in (".yaml", ".yml") or entry.name.endswith(_SKIP_SUFFIXES):
+                continue
+            if entry.name in _SKIP_FILES:
+                continue
+            if not self.exists(entry.name):
+                out.append(entry.name)
+        return out
+
+    def preload(self, downloader: Optional[Callable[[str, Path], None]] = None) -> None:
+        """Download model files referenced by configs (parity:
+        BackendConfigLoader.Preload, backend_config_loader.go)."""
+        from localai_tpu.utils.downloader import download_uri
+
+        dl = downloader or download_uri
+        for cfg in self.all():
+            for spec in cfg.download_files:
+                uri, filename = spec.get("uri"), spec.get("filename")
+                if not uri or not filename:
+                    continue
+                dest = self.model_path / filename
+                if dest.exists():
+                    continue
+                dest.parent.mkdir(parents=True, exist_ok=True)
+                dl(uri, dest)

@@ -1,0 +1,314 @@
+"""Per-model declarative YAML config.
+
+Parity target: the reference's ``BackendConfig``
+(/root/reference/core/config/backend_config.go:28-246) — prediction defaults,
+backend choice, prompt-template refs, grammar/function-calling config,
+modality-specific sections, and feature flags — re-expressed for a TPU engine:
+CUDA/GGUF-specific knobs (gpu_layers, mmap, ...) are replaced by sharding and
+dtype/quantization knobs that map onto jax.sharding meshes and XLA.
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Any, Optional
+
+from pydantic import BaseModel, ConfigDict, Field, model_validator
+
+
+class Usecase(str, enum.Enum):
+    """Capability flags a model can serve.
+
+    Parity: BackendConfigUsecases bitmask
+    (/root/reference/core/config/backend_config.go:"known_usecases").
+    """
+
+    CHAT = "chat"
+    COMPLETION = "completion"
+    EDIT = "edit"
+    EMBEDDINGS = "embeddings"
+    IMAGE = "image"
+    TRANSCRIPT = "transcript"
+    TTS = "tts"
+    SOUND_GENERATION = "sound_generation"
+    RERANK = "rerank"
+    TOKENIZE = "tokenize"
+    VISION = "vision"
+
+
+class PredictionParams(BaseModel):
+    """Sampling / prediction defaults merged with each request.
+
+    Parity: PredictionOptions (/root/reference/core/schema/prediction.go) and
+    the ``parameters:`` YAML section. All sampling runs on-device (see
+    localai_tpu.engine.sampling); fields that only make sense for llama.cpp's
+    CPU samplers (mirostat, tfz) are accepted and mapped or ignored with a
+    warning rather than rejected, so reference YAML files keep loading.
+    """
+
+    model_config = ConfigDict(extra="allow", populate_by_name=True)
+
+    temperature: Optional[float] = None
+    top_p: Optional[float] = None
+    top_k: Optional[int] = None
+    min_p: Optional[float] = None
+    max_tokens: Optional[int] = None
+    frequency_penalty: Optional[float] = None
+    presence_penalty: Optional[float] = None
+    repeat_penalty: Optional[float] = None
+    repeat_last_n: Optional[int] = None
+    seed: Optional[int] = None
+    echo: bool = False
+    n: int = 1
+    # Accepted-for-compat (llama.cpp-only samplers; engine maps or ignores):
+    mirostat: Optional[int] = None
+    mirostat_eta: Optional[float] = None
+    mirostat_tau: Optional[float] = None
+    typical_p: Optional[float] = None
+    tfz: Optional[float] = None
+    keep: Optional[int] = None
+
+    def merged_with(self, overrides: dict[str, Any]) -> "PredictionParams":
+        """Request-over-config merge (parity: updateRequestConfig,
+        /root/reference/core/http/endpoints/openai/request.go:51+)."""
+        data = self.model_dump(exclude_none=True)
+        data.update({k: v for k, v in overrides.items() if v is not None})
+        return PredictionParams(**data)
+
+
+class TemplateConfig(BaseModel):
+    """Prompt template references.
+
+    Parity: TemplateConfig (/root/reference/core/config/backend_config.go:
+    TemplateConfig struct). Templates here are Jinja2 (the HF ecosystem's
+    native format) instead of Go text/template; ``use_tokenizer_template``
+    selects the tokenizer's built-in chat template.
+    """
+
+    model_config = ConfigDict(extra="allow")
+
+    chat: Optional[str] = None
+    chat_message: Optional[str] = None
+    completion: Optional[str] = None
+    edit: Optional[str] = None
+    functions: Optional[str] = None
+    multimodal: Optional[str] = None
+    use_tokenizer_template: bool = False
+    join_chat_messages_by_character: Optional[str] = None
+
+
+class FunctionsConfig(BaseModel):
+    """Function-calling / tool-use behavior.
+
+    Parity: FunctionsConfig (/root/reference/pkg/functions/parse.go:15-50).
+    On TPU, constrained decoding is token-level logit masking from a compiled
+    FSM (localai_tpu.functions) rather than BNF text handed to a CPU sampler.
+    """
+
+    model_config = ConfigDict(extra="allow")
+
+    disable_no_action: bool = False
+    no_action_function_name: str = "answer"
+    no_action_description_name: str = ""
+    function_name_key: str = "name"
+    function_arguments_key: str = "arguments"
+    response_regex: list[str] = Field(default_factory=list)
+    json_regex_match: list[str] = Field(default_factory=list)
+    replace_function_results: list[dict[str, str]] = Field(default_factory=list)
+    replace_llm_results: list[dict[str, str]] = Field(default_factory=list)
+    capture_llm_results: list[str] = Field(default_factory=list)
+    grammar: dict[str, Any] = Field(default_factory=dict)
+
+
+class ShardingConfig(BaseModel):
+    """How to lay the model over a jax.sharding.Mesh.
+
+    This REPLACES the reference's gpu_layers/tensor_split/main_gpu/rpc_servers
+    knobs (/root/reference/core/config/backend_config.go:116-117,151 and
+    backend/cpp/llama/grpc-server.cpp:2233-2262): parallelism is compiled via
+    pjit over ICI, not proxied over TCP. Axis sizes of 1 collapse; the product
+    must divide the available device count (or equal it when data=0 → auto).
+    """
+
+    model_config = ConfigDict(extra="forbid")
+
+    tensor_parallel_size: int = 1     # 'model' mesh axis (MXU-friendly TP)
+    data_parallel_size: int = 0       # 0 = auto: fill remaining devices
+    sequence_parallel_size: int = 1   # 'seq' axis: long-context ring attention
+    expert_parallel_size: int = 1     # 'expert' axis for MoE layers
+    pipeline_parallel_size: int = 1   # 'pipe' axis (layer stages)
+
+
+class EngineConfig(BaseModel):
+    """TPU serving-engine knobs.
+
+    Replaces llama.cpp slot/cache flags (LLAMACPP_PARALLEL, n_ctx per slot —
+    /root/reference/backend/cpp/llama/grpc-server.cpp:176,2223-2231) with
+    static-shape equivalents: fixed slot count, paged KV in HBM, bucketed
+    prefill lengths to bound XLA recompiles.
+    """
+
+    model_config = ConfigDict(extra="allow")
+
+    max_slots: int = 8                # concurrent decode slots (continuous batching)
+    page_size: int = 128              # KV page length (tokens); MXU/lane aligned
+    prefill_buckets: list[int] = Field(
+        default_factory=lambda: [128, 512, 2048, 8192]
+    )
+    dtype: str = "bfloat16"           # compute/weight dtype
+    kv_dtype: str = "bfloat16"        # KV-cache dtype (int8 supported)
+    quantization: Optional[str] = None  # e.g. "int8" weight-only
+    donate_kv: bool = True            # buffer donation for in-place KV updates
+    decode_steps_per_dispatch: int = 1  # tokens per host round-trip (lax.scan)
+
+
+class DiffusionConfig(BaseModel):
+    """Image-generation section (parity: Diffusers struct,
+    /root/reference/core/config/backend_config.go Diffusers section)."""
+
+    model_config = ConfigDict(extra="allow")
+
+    scheduler_type: Optional[str] = None
+    cfg_scale: Optional[float] = None
+    clip_skip: Optional[int] = None
+    pipeline_type: Optional[str] = None
+    enable_parameters: Optional[str] = None
+    steps: Optional[int] = None
+
+
+class TTSConfig(BaseModel):
+    """TTS section (parity: TTSConfig,
+    /root/reference/core/config/backend_config.go:19-26)."""
+
+    model_config = ConfigDict(extra="allow")
+
+    voice: Optional[str] = None
+    audio_path: Optional[str] = None
+
+
+class ModelConfig(BaseModel):
+    """One model's declarative config (a YAML document in the models dir).
+
+    Parity: BackendConfig (/root/reference/core/config/backend_config.go:28+).
+    """
+
+    model_config = ConfigDict(extra="allow", populate_by_name=True)
+
+    name: str = ""
+    backend: str = ""                       # worker type; "" = auto-select
+    description: str = ""
+    usage: str = ""
+    model: str = ""                         # weights ref: hf repo / local path
+    model_path: Optional[str] = None        # resolved absolute path (runtime)
+    tokenizer: Optional[str] = None         # override tokenizer ref
+    context_size: Optional[int] = None
+    embeddings: bool = False
+    seed: Optional[int] = None
+    mmproj: Optional[str] = None            # vision projector weights ref
+    download_files: list[dict[str, Any]] = Field(default_factory=list)
+
+    parameters: PredictionParams = Field(default_factory=PredictionParams)
+    template: TemplateConfig = Field(default_factory=TemplateConfig)
+    function: FunctionsConfig = Field(default_factory=FunctionsConfig)
+    sharding: ShardingConfig = Field(default_factory=ShardingConfig)
+    engine: EngineConfig = Field(default_factory=EngineConfig)
+    diffusers: DiffusionConfig = Field(default_factory=DiffusionConfig)
+    tts: TTSConfig = Field(default_factory=TTSConfig)
+
+    stopwords: list[str] = Field(default_factory=list)
+    cutstrings: list[str] = Field(default_factory=list)
+    extract_regex: list[str] = Field(default_factory=list)
+    trimspace: list[str] = Field(default_factory=list)
+    trimsuffix: list[str] = Field(default_factory=list)
+
+    system_prompt: str = ""
+    roles: dict[str, str] = Field(default_factory=dict)
+
+    feature_flags: dict[str, bool] = Field(default_factory=dict)
+    known_usecases: Optional[list[Usecase]] = None
+
+    # Compat fields accepted from reference YAMLs and mapped:
+    f16: Optional[bool] = None              # → engine.dtype bfloat16 (TPU norm)
+    threads: Optional[int] = None           # ignored: XLA owns threading
+    gpu_layers: Optional[int] = None        # ignored: no host/device layer split
+    tensor_parallel_size: Optional[int] = None  # → sharding.tensor_parallel_size
+    low_vram: Optional[bool] = None         # ignored
+    mmap: Optional[bool] = None             # ignored
+    prompt_cache_path: Optional[str] = None
+    prompt_cache_all: bool = False
+    prompt_cache_ro: bool = False
+    grammar: str = ""                       # raw grammar text (GBNF-compatible)
+    rope_scaling: Optional[str] = None      # linear|yarn → models.llama rope
+    rope_freq_base: Optional[float] = None
+    rope_freq_scale: Optional[float] = None
+
+    @model_validator(mode="after")
+    def _apply_compat(self) -> "ModelConfig":
+        if self.tensor_parallel_size and self.sharding.tensor_parallel_size == 1:
+            self.sharding.tensor_parallel_size = self.tensor_parallel_size
+        if self.f16 is False:
+            self.engine.dtype = "float32"
+        return self
+
+    def set_defaults(self, *, context_size: int = 4096, debug: bool = False) -> None:
+        """Fill unset fields (parity: BackendConfig.SetDefaults,
+        /root/reference/core/config/backend_config.go)."""
+        p = self.parameters
+        if p.temperature is None and p.mirostat in (None, 0):
+            p.temperature = 0.9
+        if p.top_p is None:
+            p.top_p = 0.95
+        if p.top_k is None:
+            p.top_k = 40
+        if p.max_tokens is None:
+            p.max_tokens = 2048
+        if self.context_size is None:
+            self.context_size = context_size
+        if not self.name and self.model:
+            self.name = self.model
+
+    def validate_config(self) -> bool:
+        """Minimal sanity validation (parity: BackendConfig.Validate)."""
+        if not self.name:
+            return False
+        for field in (self.model, self.backend, self.mmproj or ""):
+            if field.startswith("/") or ".." in field.split("/"):
+                # path traversal guard (parity: pkg/utils/path.go VerifyPath)
+                if ".." in field:
+                    return False
+        return True
+
+    def has_usecase(self, uc: Usecase) -> bool:
+        """Usecase gating (parity: HasUsecases/GuessUsecases,
+        /root/reference/core/config/backend_config.go known_usecases)."""
+        if self.known_usecases is not None:
+            return uc in self.known_usecases
+        return uc in self.guess_usecases()
+
+    def guess_usecases(self) -> set[Usecase]:
+        guessed: set[Usecase] = set()
+        name = (self.backend or "").lower()
+        if self.embeddings or "embed" in name:
+            guessed.add(Usecase.EMBEDDINGS)
+        if name in ("", "jax", "jax-llm", "transformers"):
+            guessed |= {
+                Usecase.CHAT,
+                Usecase.COMPLETION,
+                Usecase.EDIT,
+                Usecase.TOKENIZE,
+            }
+            if self.mmproj:
+                guessed.add(Usecase.VISION)
+            if self.embeddings:
+                guessed.add(Usecase.EMBEDDINGS)
+        if "diffus" in name or "image" in name:
+            guessed.add(Usecase.IMAGE)
+        if "whisper" in name:
+            guessed.add(Usecase.TRANSCRIPT)
+        if "tts" in name:
+            guessed.add(Usecase.TTS)
+        if "musicgen" in name or "sound" in name:
+            guessed.add(Usecase.SOUND_GENERATION)
+        if "rerank" in name:
+            guessed.add(Usecase.RERANK)
+        return guessed

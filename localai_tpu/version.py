@@ -1,0 +1,6 @@
+__version__ = "0.1.0"
+
+
+def printable_version() -> str:
+    """Human-readable version banner (parity: internal/version.go PrintableVersion)."""
+    return f"localai-tpu {__version__}"

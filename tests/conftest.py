@@ -1,0 +1,23 @@
+"""Test harness: force an 8-device virtual CPU mesh so all sharding paths are
+exercised without TPU hardware (the driver separately dry-runs multi-chip via
+__graft_entry__.dryrun_multichip). Mirrors the reference's strategy of gating
+heavy backends out of unit tests (SURVEY.md §4)."""
+
+import os
+
+# Must be set before jax is imported anywhere.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture()
+def tmp_models_dir(tmp_path):
+    d = tmp_path / "models"
+    d.mkdir()
+    return d

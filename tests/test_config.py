@@ -1,0 +1,116 @@
+"""Config-system unit tests (parity model:
+/root/reference/core/config/backend_config_test.go — pure-logic YAML tests)."""
+
+import textwrap
+
+from localai_tpu.config import ConfigLoader, ModelConfig, Usecase, load_config_file
+
+
+def write(p, text):
+    p.write_text(textwrap.dedent(text))
+    return p
+
+
+def test_load_single_config(tmp_models_dir):
+    f = write(
+        tmp_models_dir / "gpt4.yaml",
+        """
+        name: gpt-4
+        backend: jax-llm
+        model: meta-llama/Llama-3-8B-Instruct
+        context_size: 8192
+        parameters:
+          temperature: 0.2
+          top_k: 50
+        stopwords: ["<|eot_id|>"]
+        """,
+    )
+    cfg = load_config_file(f)
+    assert cfg.name == "gpt-4"
+    assert cfg.parameters.temperature == 0.2
+    assert cfg.parameters.top_k == 50
+    assert cfg.context_size == 8192
+    assert cfg.stopwords == ["<|eot_id|>"]
+
+
+def test_defaults_applied(tmp_models_dir):
+    cfg = ModelConfig(name="m", model="x")
+    cfg.set_defaults(context_size=2048)
+    assert cfg.parameters.temperature == 0.9
+    assert cfg.parameters.top_p == 0.95
+    assert cfg.parameters.max_tokens == 2048
+    assert cfg.context_size == 2048
+
+
+def test_dir_scan_names_and_skip(tmp_models_dir):
+    write(tmp_models_dir / "a.yaml", "model: modelA\n")
+    write(tmp_models_dir / "b.yaml", "name: bee\nmodel: modelB\n")
+    write(tmp_models_dir / "notes.md", "not a config\n")
+    (tmp_models_dir / "loose.gguf").write_bytes(b"\x00")
+    (tmp_models_dir / "plainmodel").write_bytes(b"\x00")
+    cl = ConfigLoader(tmp_models_dir)
+    cl.load_from_path()
+    assert cl.names() == ["a", "bee"]
+    assert cl.loose_files() == ["loose.gguf", "plainmodel"]
+
+
+def test_reference_yaml_compat(tmp_models_dir):
+    """A reference-style YAML (aio/cpu/text-to-text.yaml shape) must parse;
+    CUDA-era knobs are accepted and mapped."""
+    f = write(
+        tmp_models_dir / "ref.yaml",
+        """
+        name: gpt-4
+        mmap: true
+        f16: true
+        gpu_layers: 90
+        parameters:
+          model: Hermes-2-Pro-Llama-3-8B.Q4_K_M.gguf
+          temperature: 0.7
+        template:
+          chat: chat-template
+          use_tokenizer_template: false
+        function:
+          disable_no_action: true
+        stopwords:
+        - <|im_end|>
+        """,
+    )
+    cfg = load_config_file(f)
+    assert cfg.name == "gpt-4"
+    assert cfg.parameters.temperature == 0.7
+    assert cfg.template.chat == "chat-template"
+    assert cfg.function.disable_no_action is True
+    assert cfg.stopwords == ["<|im_end|>"]
+
+
+def test_usecase_guessing():
+    llm = ModelConfig(name="x", backend="jax-llm")
+    assert llm.has_usecase(Usecase.CHAT)
+    assert not llm.has_usecase(Usecase.IMAGE)
+    emb = ModelConfig(name="e", backend="jax-llm", embeddings=True)
+    assert emb.has_usecase(Usecase.EMBEDDINGS)
+    whisper = ModelConfig(name="w", backend="whisper")
+    assert whisper.has_usecase(Usecase.TRANSCRIPT)
+    explicit = ModelConfig(name="k", known_usecases=[Usecase.CHAT])
+    assert explicit.has_usecase(Usecase.CHAT)
+    assert not explicit.has_usecase(Usecase.COMPLETION)
+
+
+def test_request_merge():
+    cfg = ModelConfig(name="m")
+    cfg.set_defaults()
+    merged = cfg.parameters.merged_with({"temperature": 0.1, "max_tokens": 5})
+    assert merged.temperature == 0.1
+    assert merged.max_tokens == 5
+    assert merged.top_p == 0.95  # config default survives
+
+
+def test_tp_compat_mapping():
+    cfg = ModelConfig(name="m", tensor_parallel_size=4)
+    assert cfg.sharding.tensor_parallel_size == 4
+
+
+def test_path_traversal_rejected():
+    cfg = ModelConfig(name="evil", model="../../etc/passwd")
+    assert not cfg.validate_config()
