@@ -83,7 +83,7 @@ class AppConfig:
             env = os.environ.get(f"LOCALAI_{name.upper()}")
             if env is None:
                 continue
-            typ = f.type
+            typ = str(f.type)
             if typ == "int":
                 setattr(cfg, name, int(env))
             elif typ == "float":
@@ -92,7 +92,7 @@ class AppConfig:
                 setattr(cfg, name, env.lower() in ("1", "true", "yes", "on"))
             elif typ == "list[str]":
                 setattr(cfg, name, [s for s in env.split(",") if s])
-            elif typ == "str":
+            elif typ in ("str", "Optional[str]"):
                 setattr(cfg, name, env)
         for k, v in overrides.items():
             if v is not None:

@@ -127,22 +127,28 @@ class ConfigLoader:
         /root/reference/pkg/model/loader.go:54-67)."""
         if not self.model_path.is_dir():
             return []
+        # skip files already claimed by a config — keyed on the config's model
+        # filename, not its name (parity: services/list_models.go:28)
+        with self._lock:
+            claimed = {Path(c.model).name for c in self._configs.values() if c.model}
+            claimed |= set(self._configs)
         out = []
         for entry in sorted(self.model_path.iterdir()):
             if not entry.is_file() or entry.name.startswith("."):
                 continue
             if entry.suffix in (".yaml", ".yml") or entry.name.endswith(_SKIP_SUFFIXES):
                 continue
-            if entry.name in _SKIP_FILES:
+            if entry.name in _SKIP_FILES or entry.name in claimed:
                 continue
-            if not self.exists(entry.name):
-                out.append(entry.name)
+            out.append(entry.name)
         return out
 
-    def preload(self, downloader: Optional[Callable[[str, Path], None]] = None) -> None:
-        """Download model files referenced by configs (parity:
-        BackendConfigLoader.Preload, backend_config_loader.go)."""
+    def preload(self, downloader: Optional[Callable[..., None]] = None) -> None:
+        """Download model files referenced by configs, sha-verified, with a
+        traversal guard on the YAML-supplied filename (parity:
+        BackendConfigLoader.Preload, backend_config_loader.go:261-267)."""
         from localai_tpu.utils.downloader import download_uri
+        from localai_tpu.utils.paths import verify_path
 
         dl = downloader or download_uri
         for cfg in self.all():
@@ -150,8 +156,7 @@ class ConfigLoader:
                 uri, filename = spec.get("uri"), spec.get("filename")
                 if not uri or not filename:
                     continue
-                dest = self.model_path / filename
-                if dest.exists():
-                    continue
+                dest = verify_path(filename, self.model_path)
                 dest.parent.mkdir(parents=True, exist_ok=True)
-                dl(uri, dest)
+                # download_uri skips existing files only when the sha matches
+                dl(uri, dest, sha256=spec.get("sha256"))

@@ -268,15 +268,15 @@ class ModelConfig(BaseModel):
             self.name = self.model
 
     def validate_config(self) -> bool:
-        """Minimal sanity validation (parity: BackendConfig.Validate)."""
+        """Minimal sanity validation (parity: BackendConfig.Validate).
+        Rejects '..' traversal segments in file refs; absolute paths are
+        allowed (they are resolved against verify_path at use sites)."""
         if not self.name:
             return False
-        for field in (self.model, self.backend, self.mmproj or ""):
-            if field.startswith("/") or ".." in field.split("/"):
-                # path traversal guard (parity: pkg/utils/path.go VerifyPath)
-                if ".." in field:
-                    return False
-        return True
+        return not any(
+            ".." in f.split("/")
+            for f in (self.model, self.backend, self.mmproj or "")
+        )
 
     def has_usecase(self, uc: Usecase) -> bool:
         """Usecase gating (parity: HasUsecases/GuessUsecases,

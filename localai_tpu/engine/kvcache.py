@@ -1,0 +1,114 @@
+"""Slot-resident KV cache in HBM.
+
+Replaces llama.cpp's per-slot KV management (kv_cache_clear / cache_tokens /
+n_ctx-per-slot partitioning, /root/reference/backend/cpp/llama/
+grpc-server.cpp:176,906,1546-1990) with a TPU-native layout: one statically
+shaped tensor pair per model, stacked over layers so the layer loop can
+``lax.scan`` it, sliced per slot by masking — never by ragged mutation.
+
+Layout: k,v each [num_layers, num_slots, max_ctx, num_kv_heads, head_dim].
+All updates are functional; jit donation makes them in-place in HBM.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from localai_tpu.models.llama import LlamaConfig
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class KVCache:
+    k: jax.Array
+    v: jax.Array
+
+    @property
+    def num_slots(self) -> int:
+        return self.k.shape[1]
+
+    @property
+    def max_ctx(self) -> int:
+        return self.k.shape[2]
+
+
+def init_cache(
+    cfg: LlamaConfig,
+    num_slots: int,
+    max_ctx: int,
+    dtype: str = "bfloat16",
+    sharding: Optional[jax.sharding.Sharding] = None,
+) -> KVCache:
+    shape = (cfg.num_layers, num_slots, max_ctx, cfg.num_kv_heads, cfg.hd)
+    dt = jnp.dtype(dtype)
+    if sharding is not None:
+        zeros = jax.jit(
+            lambda: jnp.zeros(shape, dt), out_shardings=sharding
+        )()
+        return KVCache(k=zeros, v=jax.jit(
+            lambda: jnp.zeros(shape, dt), out_shardings=sharding
+        )())
+    return KVCache(k=jnp.zeros(shape, dt), v=jnp.zeros(shape, dt))
+
+
+def decode_write(positions: jax.Array):
+    """KV write policy for batched single-token decode.
+
+    positions: [S] — write location per slot. Returns a ``kv_write`` closure
+    for models.llama.forward: writes k/v_new [S, 1, H, hd] at
+    cache[s, positions[s]] and exposes the full per-layer cache as keys.
+    """
+
+    def write(layer_kv, k_new, v_new):
+        k_layer, v_layer = layer_kv  # [S, C, H, hd]
+        s = jnp.arange(k_layer.shape[0])
+        kdt = k_layer.dtype
+        new_k = k_layer.at[s, positions].set(k_new[:, 0].astype(kdt))
+        new_v = v_layer.at[s, positions].set(v_new[:, 0].astype(kdt))
+        return (new_k, new_v), new_k.astype(k_new.dtype), new_v.astype(v_new.dtype)
+
+    return write
+
+
+def prefill_write(slot: jax.Array, offset: jax.Array):
+    """KV write policy for single-sequence prefill into one slot.
+
+    Writes the whole chunk [1, T, H, hd] at cache[slot, offset:offset+T] and
+    attends over the chunk itself (fresh context ⇒ T² attention, not T·C).
+    """
+
+    def write(layer_kv, k_new, v_new):
+        k_layer, v_layer = layer_kv  # [S, C, H, hd]
+        kdt = k_layer.dtype
+        zero = jnp.zeros((), jnp.int32)
+        idx = (slot, offset, zero, zero)
+        new_k = lax.dynamic_update_slice(k_layer, k_new.astype(kdt), idx)
+        new_v = lax.dynamic_update_slice(v_layer, v_new.astype(kdt), idx)
+        return (new_k, new_v), k_new, v_new
+
+    return write
+
+
+def decode_mask(cfg: LlamaConfig, positions: jax.Array, max_ctx: int) -> jax.Array:
+    """[S, 1, C] attention mask for decode: attend to all written positions
+    (≤ current), optionally sliding-window limited (Mistral-style)."""
+    idx = jnp.arange(max_ctx)[None, None, :]
+    pos = positions[:, None, None]
+    m = idx <= pos
+    if cfg.sliding_window:
+        m &= idx > pos - cfg.sliding_window
+    return m
+
+
+def prefill_mask(cfg: LlamaConfig, seq_len: int, length: jax.Array) -> jax.Array:
+    """[1, T, T] causal mask limited to the real (unpadded) length."""
+    t = jnp.arange(seq_len)
+    m = (t[None, :, None] >= t[None, None, :]) & (t[None, None, :] < length)
+    if cfg.sliding_window:
+        m &= t[None, None, :] > t[None, :, None] - cfg.sliding_window
+    return m

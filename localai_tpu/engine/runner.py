@@ -1,0 +1,228 @@
+"""ModelRunner: the jitted prefill/decode executor for one loaded LLM.
+
+This is the TPU-era replacement for llama.cpp's slot engine hot loop
+(update_slots + llama_decode + per-slot sampling,
+/root/reference/backend/cpp/llama/grpc-server.cpp:1546-1990), redesigned for
+XLA's compile-once/static-shape model:
+
+  * ONE compiled decode step serves all slots every iteration (continuous
+    batching = slot masking, not ragged batch rebuilds).
+  * Prefill lengths are bucketed (powers of a small set) so at most
+    len(buckets) prefill programs are ever compiled — no recompilation
+    storms from arbitrary prompt lengths.
+  * KV cache and decode state are donated on every dispatch → in-place HBM
+    updates, zero copies.
+  * Sampling runs on device in the same program as the forward pass; the
+    only per-step host traffic is the [S] sampled-token vector.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from functools import partial
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from localai_tpu.engine import kvcache as kvc
+from localai_tpu.engine import sampling as smp
+from localai_tpu.engine.kvcache import KVCache
+from localai_tpu.models import llama as mdl
+from localai_tpu.models.llama import LlamaConfig
+
+log = logging.getLogger(__name__)
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class DecodeState:
+    """All per-slot mutable serving state, device-resident."""
+
+    tokens: jax.Array      # [S] i32 — next token to feed per slot
+    positions: jax.Array   # [S] i32 — next KV write position per slot
+    active: jax.Array      # [S] bool
+    keys: jax.Array        # [S] PRNG keys
+    counts: jax.Array      # [S, V] i32 — token occurrence counts (penalties)
+    params: smp.SamplingParams
+
+    @staticmethod
+    def init(num_slots: int, vocab_size: int, seed: int = 0) -> "DecodeState":
+        return DecodeState(
+            tokens=jnp.zeros(num_slots, jnp.int32),
+            positions=jnp.zeros(num_slots, jnp.int32),
+            active=jnp.zeros(num_slots, jnp.bool_),
+            keys=jax.random.split(jax.random.key(seed), num_slots),
+            counts=jnp.zeros((num_slots, vocab_size), jnp.int32),
+            params=smp.SamplingParams.init(num_slots),
+        )
+
+
+class ModelRunner:
+    """Owns params + KV cache + decode state for one model; exposes
+    admit/step/release to the scheduler."""
+
+    def __init__(
+        self,
+        cfg: LlamaConfig,
+        params: Any,
+        *,
+        num_slots: int = 8,
+        max_ctx: Optional[int] = None,
+        prefill_buckets: Optional[list[int]] = None,
+        kv_dtype: str = "bfloat16",
+        rope_freq_base: Optional[float] = None,
+        rope_freq_scale: Optional[float] = None,
+        seed: int = 0,
+    ):
+        self.cfg = cfg
+        self.params = params
+        self.num_slots = num_slots
+        self.max_ctx = max_ctx or cfg.max_position_embeddings
+        buckets = sorted(prefill_buckets or [128, 512, 2048, 8192])
+        self.buckets = [b for b in buckets if b <= self.max_ctx] or [self.max_ctx]
+        self.rope = mdl.rope_table(
+            cfg, self.max_ctx, freq_base=rope_freq_base, freq_scale=rope_freq_scale
+        )
+        self.kv = kvc.init_cache(cfg, num_slots, self.max_ctx, kv_dtype)
+        self.state = DecodeState.init(num_slots, cfg.vocab_size, seed)
+        self._free_slots = list(range(num_slots))
+
+        self._decode = jax.jit(self._decode_fn, donate_argnums=(1, 2))
+        self._prefill = jax.jit(
+            self._prefill_fn, static_argnames=("bucket",), donate_argnums=(1, 2)
+        )
+
+    # -- jitted programs -------------------------------------------------
+
+    def _decode_fn(self, params, kv: KVCache, state: DecodeState):
+        cfg = self.cfg
+        pos = state.positions
+        mask = kvc.decode_mask(cfg, pos, self.max_ctx)
+        write = kvc.decode_write(pos)
+        hidden, (new_k, new_v) = mdl.forward(
+            cfg, params, state.tokens[:, None], pos[:, None],
+            write, (kv.k, kv.v), mask, self.rope,
+        )
+        logits = mdl.logits_from_hidden(cfg, params, hidden[:, 0])
+        tokens, keys = smp.sample(logits, state.params, state.counts, state.keys)
+        tokens = jnp.where(state.active, tokens, state.tokens)
+        counts = smp.update_counts(state.counts, tokens, state.active)
+        positions = jnp.where(
+            state.active, jnp.minimum(pos + 1, self.max_ctx - 1), pos
+        )
+        new_state = dataclasses.replace(
+            state, tokens=tokens, positions=positions, keys=keys, counts=counts
+        )
+        return KVCache(new_k, new_v), new_state, tokens
+
+    def _prefill_fn(self, params, kv: KVCache, state: DecodeState,
+                    tokens, length, slot, *, bucket: int):
+        cfg = self.cfg
+        positions = jnp.arange(bucket, dtype=jnp.int32)[None, :]
+        mask = kvc.prefill_mask(cfg, bucket, length)
+        write = kvc.prefill_write(slot, jnp.zeros((), jnp.int32))
+        hidden, (new_k, new_v) = mdl.forward(
+            cfg, params, tokens, positions, write, (kv.k, kv.v), mask, self.rope,
+        )
+        last_h = jax.lax.dynamic_index_in_dim(hidden[0], length - 1, keepdims=True)
+        logits = mdl.logits_from_hidden(cfg, params, last_h)  # [1, V]
+        counts = smp.count_prompt_tokens(state.counts, slot, tokens[0], length)
+        slot_params = jax.tree.map(lambda a: a[slot][None], state.params)
+        tok, new_key = smp.sample(
+            logits, slot_params, counts[slot][None], state.keys[slot][None]
+        )
+        new_state = dataclasses.replace(
+            state,
+            tokens=state.tokens.at[slot].set(tok[0]),
+            positions=state.positions.at[slot].set(length),
+            active=state.active.at[slot].set(True),
+            keys=state.keys.at[slot].set(new_key[0]),
+            counts=counts,
+        )
+        return KVCache(new_k, new_v), new_state, tok[0]
+
+    # -- host API --------------------------------------------------------
+
+    def bucket_for(self, n: int) -> int:
+        for b in self.buckets:
+            if n <= b:
+                return b
+        raise ValueError(
+            f"prompt length {n} exceeds max prefill bucket {self.buckets[-1]}"
+        )
+
+    def acquire_slot(self) -> Optional[int]:
+        return self._free_slots.pop(0) if self._free_slots else None
+
+    def admit(
+        self,
+        slot: int,
+        prompt: list[int],
+        *,
+        temperature: Optional[float] = None,
+        top_k: Optional[int] = None,
+        top_p: Optional[float] = None,
+        min_p: Optional[float] = None,
+        repeat_penalty: Optional[float] = None,
+        presence_penalty: Optional[float] = None,
+        frequency_penalty: Optional[float] = None,
+        seed: Optional[int] = None,
+    ) -> int:
+        """Prefill a prompt into a slot; returns the first sampled token."""
+        if not prompt:
+            prompt = [0]
+        n = len(prompt)
+        if n > self.max_ctx - 1:
+            # context-exhaustion policy parity (grpc-server.cpp:1573-1592):
+            # reject rather than silently shift context.
+            raise ValueError(f"prompt ({n} tokens) exceeds context {self.max_ctx}")
+        bucket = self.bucket_for(n)
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, :n] = prompt
+        self.state = dataclasses.replace(
+            self.state,
+            params=self.state.params.with_slot(
+                slot,
+                temperature=temperature,
+                top_k=top_k,
+                top_p=top_p,
+                min_p=min_p,
+                repeat_penalty=repeat_penalty,
+                presence_penalty=presence_penalty,
+                frequency_penalty=frequency_penalty,
+            ),
+        )
+        if seed is not None:
+            self.state = dataclasses.replace(
+                self.state,
+                keys=self.state.keys.at[slot].set(jax.random.key(seed)),
+            )
+        self.kv, self.state, tok = self._prefill(
+            self.params, self.kv, self.state,
+            jnp.asarray(padded), jnp.int32(n), jnp.int32(slot), bucket=bucket,
+        )
+        return int(tok)
+
+    def step(self) -> np.ndarray:
+        """One decode iteration over all slots; returns sampled tokens [S]."""
+        self.kv, self.state, tokens = self._decode(
+            self.params, self.kv, self.state
+        )
+        return np.asarray(tokens)
+
+    def release(self, slot: int) -> None:
+        self.state = dataclasses.replace(
+            self.state, active=self.state.active.at[slot].set(False)
+        )
+        if slot not in self._free_slots:
+            self._free_slots.append(slot)
+
+    @property
+    def any_active(self) -> bool:
+        return bool(np.asarray(self.state.active).any())
+
+    def slot_position(self, slot: int) -> int:
+        return int(self.state.positions[slot])

@@ -1,0 +1,298 @@
+"""Llama-family decoder (Llama 2/3, Mistral, Qwen2, Hermes, ...) — pure
+functional JAX, designed for TPU serving.
+
+This is the engine that replaces llama.cpp's C++ decode loop
+(/root/reference/backend/cpp/llama/grpc-server.cpp:1546-1990) as the main LLM
+compute path. Architectural choices are TPU-first, not a translation:
+
+  * params are a pytree of stacked per-layer weights; the layer loop is a
+    single ``lax.scan`` → one compiled layer body, O(1) XLA graph size.
+  * all shapes are static: fixed slot count, fixed context; continuous
+    batching is masking over slot tensors (see engine.scheduler), not
+    ragged mutation.
+  * bfloat16 weights/activations (MXU-native), float32 for RMSNorm,
+    softmax and RoPE tables.
+  * GQA is computed grouped ([S, n_kv, q_per_kv, ...]) so the KV repeat is
+    a broadcast inside einsum, never materialized.
+  * rope scaling supports linear / llama3 / yarn — parity with the
+    reference's rope plumbing (/root/reference/core/config/
+    backend_config.go:157-163, grpc-server.cpp:2279-2299).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+PyTree = Any
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 11008
+    num_layers: int = 32
+    num_heads: int = 32
+    num_kv_heads: int = 32
+    head_dim: Optional[int] = None
+    rope_theta: float = 10000.0
+    rms_norm_eps: float = 1e-5
+    max_position_embeddings: int = 4096
+    tie_word_embeddings: bool = False
+    attention_bias: bool = False          # Qwen2-style qkv bias
+    rope_scaling: Optional[dict] = None   # HF rope_scaling dict
+    sliding_window: Optional[int] = None  # Mistral-style (mask-only)
+    dtype: str = "bfloat16"
+
+    @property
+    def hd(self) -> int:
+        return self.head_dim or self.hidden_size // self.num_heads
+
+    @property
+    def q_per_kv(self) -> int:
+        return self.num_heads // self.num_kv_heads
+
+    @classmethod
+    def from_hf(cls, hf: dict) -> "LlamaConfig":
+        """Build from an HF config.json dict (llama/mistral/qwen2 families)."""
+        return cls(
+            vocab_size=hf.get("vocab_size", 32000),
+            hidden_size=hf.get("hidden_size", 4096),
+            intermediate_size=hf.get("intermediate_size", 11008),
+            num_layers=hf.get("num_hidden_layers", 32),
+            num_heads=hf.get("num_attention_heads", 32),
+            num_kv_heads=hf.get("num_key_value_heads",
+                                hf.get("num_attention_heads", 32)),
+            head_dim=hf.get("head_dim"),
+            rope_theta=hf.get("rope_theta", 10000.0),
+            rms_norm_eps=hf.get("rms_norm_eps", 1e-5),
+            max_position_embeddings=hf.get("max_position_embeddings", 4096),
+            tie_word_embeddings=hf.get("tie_word_embeddings", False),
+            attention_bias=hf.get("attention_bias", False)
+            or hf.get("model_type") == "qwen2",
+            rope_scaling=hf.get("rope_scaling"),
+            sliding_window=hf.get("sliding_window"),
+        )
+
+
+# ---------------------------------------------------------------------------
+# RoPE
+# ---------------------------------------------------------------------------
+
+def rope_table(cfg: LlamaConfig, max_len: int,
+               freq_base: Optional[float] = None,
+               freq_scale: Optional[float] = None) -> tuple[jax.Array, jax.Array]:
+    """Precompute (cos, sin) [max_len, hd/2] in float32.
+
+    Supports HF rope_scaling types 'linear', 'llama3', 'yarn' and the
+    reference's raw rope_freq_base/rope_freq_scale overrides
+    (/root/reference/core/config/backend_config.go:162-163).
+    """
+    hd = cfg.hd
+    base = freq_base or cfg.rope_theta
+    inv_freq = 1.0 / (base ** (jnp.arange(0, hd, 2, dtype=jnp.float32) / hd))
+    sc = cfg.rope_scaling or {}
+    rtype = sc.get("rope_type", sc.get("type", "default"))
+    attn_factor = 1.0
+
+    if rtype == "linear":
+        inv_freq = inv_freq / float(sc.get("factor", 1.0))
+    elif rtype == "llama3":
+        factor = float(sc.get("factor", 8.0))
+        lo = float(sc.get("low_freq_factor", 1.0))
+        hi = float(sc.get("high_freq_factor", 4.0))
+        old_ctx = float(sc.get("original_max_position_embeddings", 8192))
+        wavelen = 2 * math.pi / inv_freq
+        # three bands: scale long wavelengths, keep short, smooth in between
+        smooth = (old_ctx / wavelen - lo) / (hi - lo)
+        smooth = jnp.clip(smooth, 0.0, 1.0)
+        scaled = inv_freq / factor
+        inv_freq = (1 - smooth) * scaled + smooth * inv_freq
+    elif rtype == "yarn":
+        # YaRN (arXiv:2309.00071) NTK-by-parts interpolation, as plumbed by
+        # the reference's yarn_* options (backend.proto:225-229).
+        factor = float(sc.get("factor", 1.0))
+        old_ctx = float(sc.get("original_max_position_embeddings", 4096))
+        beta_fast = float(sc.get("beta_fast", 32.0))
+        beta_slow = float(sc.get("beta_slow", 1.0))
+        attn_factor = float(sc.get("attention_factor") or
+                            (0.1 * math.log(factor) + 1.0 if factor > 1 else 1.0))
+
+        def corr_dim(n_rot: float) -> float:
+            return (hd * math.log(old_ctx / (n_rot * 2 * math.pi))) / (
+                2 * math.log(base)
+            )
+
+        low = max(math.floor(corr_dim(beta_fast)), 0)
+        high = min(math.ceil(corr_dim(beta_slow)), hd // 2 - 1)
+        ramp = jnp.clip(
+            (jnp.arange(hd // 2, dtype=jnp.float32) - low) / max(high - low, 1),
+            0.0, 1.0,
+        )
+        inv_freq = inv_freq / factor * ramp + inv_freq * (1 - ramp)
+
+    if freq_scale:
+        inv_freq = inv_freq * freq_scale
+    t = jnp.arange(max_len, dtype=jnp.float32)
+    freqs = jnp.outer(t, inv_freq)  # [max_len, hd/2]
+    return jnp.cos(freqs) * attn_factor, jnp.sin(freqs) * attn_factor
+
+
+def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
+    """x: [..., heads, hd]; cos/sin broadcastable [..., 1, hd/2].
+
+    Uses the HF 'rotate_half' convention (pairs are (i, i+hd/2)) to match
+    safetensors weights without permutation.
+    """
+    x1, x2 = jnp.split(x, 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return out.astype(x.dtype)
+
+
+def rms_norm(x: jax.Array, w: jax.Array, eps: float) -> jax.Array:
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(xf * xf, axis=-1, keepdims=True)
+    return (xf * lax.rsqrt(var + eps)).astype(x.dtype) * w.astype(x.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Parameters
+# ---------------------------------------------------------------------------
+
+def param_shapes(cfg: LlamaConfig) -> dict:
+    """Shapes of the stacked-parameter pytree."""
+    D, F, L = cfg.hidden_size, cfg.intermediate_size, cfg.num_layers
+    Hq, Hkv, hd = cfg.num_heads, cfg.num_kv_heads, cfg.hd
+    shapes = {
+        "embed": (cfg.vocab_size, D),
+        "final_norm": (D,),
+        "layers": {
+            "attn_norm": (L, D),
+            "wq": (L, D, Hq * hd),
+            "wk": (L, D, Hkv * hd),
+            "wv": (L, D, Hkv * hd),
+            "wo": (L, Hq * hd, D),
+            "mlp_norm": (L, D),
+            "w_gate": (L, D, F),
+            "w_up": (L, D, F),
+            "w_down": (L, F, D),
+        },
+    }
+    if cfg.attention_bias:
+        shapes["layers"]["bq"] = (L, Hq * hd)
+        shapes["layers"]["bk"] = (L, Hkv * hd)
+        shapes["layers"]["bv"] = (L, Hkv * hd)
+    if not cfg.tie_word_embeddings:
+        shapes["lm_head"] = (D, cfg.vocab_size)
+    return shapes
+
+
+def init_params(rng: jax.Array, cfg: LlamaConfig) -> PyTree:
+    """Random init (testing / benchmarking with synthetic weights)."""
+    shapes = param_shapes(cfg)
+    flat, treedef = jax.tree.flatten(shapes, is_leaf=lambda x: isinstance(x, tuple))
+    keys = jax.random.split(rng, len(flat))
+    dtype = jnp.dtype(cfg.dtype)
+
+    def mk(k, shape):
+        if len(shape) == 1:  # norm gains
+            return jnp.ones(shape, dtype)
+        return (jax.random.normal(k, shape, jnp.float32) * 0.02).astype(dtype)
+
+    return jax.tree.unflatten(treedef, [mk(k, s) for k, s in zip(keys, flat)])
+
+
+# ---------------------------------------------------------------------------
+# Forward
+# ---------------------------------------------------------------------------
+
+def _layer(cfg: LlamaConfig, x, lp, cos, sin, attend):
+    """One decoder layer. ``attend(q, k_new, v_new) -> (attn_out, new_kv)``
+    is injected so prefill/decode/KV-cache policies stay out of the math."""
+    Hq, Hkv, hd = cfg.num_heads, cfg.num_kv_heads, cfg.hd
+
+    h = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
+    q = h @ lp["wq"]
+    k = h @ lp["wk"]
+    v = h @ lp["wv"]
+    if "bq" in lp:
+        q = q + lp["bq"].astype(q.dtype)
+        k = k + lp["bk"].astype(k.dtype)
+        v = v + lp["bv"].astype(v.dtype)
+    q = q.reshape(*q.shape[:-1], Hq, hd)
+    k = k.reshape(*k.shape[:-1], Hkv, hd)
+    v = v.reshape(*v.shape[:-1], Hkv, hd)
+    q = apply_rope(q, cos, sin)
+    k = apply_rope(k, cos, sin)
+
+    attn, new_kv = attend(q, k, v)
+    attn = attn.reshape(*attn.shape[:-2], Hq * hd)
+    x = x + attn @ lp["wo"]
+
+    h = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
+    x = x + (jax.nn.silu(h @ lp["w_gate"]) * (h @ lp["w_up"])) @ lp["w_down"]
+    return x, new_kv
+
+
+def _grouped_attn(cfg: LlamaConfig, q, keys, values, mask):
+    """Grouped-query attention.
+
+    q: [S, T, Hq, hd], keys/values: [S, Lk, Hkv, hd],
+    mask: [S, T, Lk] bool (True = attend). Returns [S, T, Hq, hd].
+    """
+    S, T = q.shape[0], q.shape[1]
+    Hkv, g, hd = cfg.num_kv_heads, cfg.q_per_kv, cfg.hd
+    qg = q.reshape(S, T, Hkv, g, hd)
+    scores = jnp.einsum("stkgh,slkh->skgtl", qg, keys) / math.sqrt(hd)
+    scores = scores.astype(jnp.float32)
+    scores = jnp.where(mask[:, None, None, :, :], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(values.dtype)
+    out = jnp.einsum("skgtl,slkh->stkgh", probs, values)
+    return out.reshape(S, T, cfg.num_heads, hd)
+
+
+def forward(
+    cfg: LlamaConfig,
+    params: PyTree,
+    tokens: jax.Array,      # [B, T] int32
+    positions: jax.Array,   # [B, T] int32 (absolute positions for RoPE)
+    kv_write: Any,          # KV write policy: fn(layer_kv, k, v) -> (new_layer_kv, keys, values)
+    kv_stack: Any,          # stacked KV pytree scanned alongside layers (or None)
+    mask: jax.Array,        # [B, T, Lk] bool attention mask
+    rope: tuple[jax.Array, jax.Array],
+) -> tuple[jax.Array, Any]:
+    """Shared transformer trunk: returns (hidden [B, T, D], updated kv_stack).
+
+    The layer loop is ``lax.scan`` over stacked weights + stacked KV so XLA
+    compiles one layer body regardless of depth.
+    """
+    cos_t, sin_t = rope
+    cos = cos_t[positions][:, :, None, :]  # [B, T, 1, hd/2]
+    sin = sin_t[positions][:, :, None, :]
+    x = params["embed"][tokens].astype(jnp.dtype(cfg.dtype))
+
+    def body(carry, layer_in):
+        lp, layer_kv = layer_in
+
+        def attend(q, k_new, v_new):
+            new_kv, keys, values = kv_write(layer_kv, k_new, v_new)
+            return _grouped_attn(cfg, q, keys, values, mask), new_kv
+
+        y, new_kv = _layer(cfg, carry, lp, cos, sin, attend)
+        return y, new_kv
+
+    x, new_kv_stack = lax.scan(body, x, (params["layers"], kv_stack))
+    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    return x, new_kv_stack
+
+
+def logits_from_hidden(cfg: LlamaConfig, params: PyTree, x: jax.Array) -> jax.Array:
+    if cfg.tie_word_embeddings:
+        return x @ params["embed"].T.astype(x.dtype)
+    return x @ params["lm_head"]

@@ -1,0 +1,131 @@
+"""Weight ingestion: HF safetensors → stacked JAX param pytrees.
+
+The TPU replacement for GGUF ingestion (the reference's weight path is
+llama.cpp's GGUF mmap, /root/reference/pkg/model + gguf autoconfig
+core/config/guesser.go:13-246): we ingest the HF safetensors layout
+directly, transpose to right-multiply convention, and stack per-layer
+tensors along a leading axis so the model can lax.scan over layers.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+from pathlib import Path
+from typing import Any, Iterator, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from localai_tpu.models.llama import LlamaConfig, param_shapes
+
+log = logging.getLogger(__name__)
+
+
+def load_hf_config(model_dir: str | Path) -> LlamaConfig:
+    with open(Path(model_dir) / "config.json") as f:
+        return LlamaConfig.from_hf(json.load(f))
+
+
+def _open_safetensors(model_dir: Path) -> dict[str, Any]:
+    """Return name → lazy tensor accessor across all shards."""
+    from safetensors import safe_open
+
+    tensors: dict[str, Any] = {}
+    files = sorted(model_dir.glob("*.safetensors"))
+    if not files:
+        raise FileNotFoundError(f"no .safetensors files in {model_dir}")
+    for fp in files:
+        handle = safe_open(str(fp), framework="numpy")
+        for name in handle.keys():
+            tensors[name] = (handle, name)
+    return tensors
+
+
+def _get(tensors: dict, name: str) -> np.ndarray:
+    handle, key = tensors[name]
+    arr = handle.get_tensor(key)
+    # bfloat16 arrives as uint16 view from some writers; reinterpret via ml_dtypes
+    if arr.dtype == np.uint16:
+        import ml_dtypes
+
+        arr = arr.view(ml_dtypes.bfloat16)
+    return arr
+
+
+def load_llama_params(
+    model_dir: str | Path,
+    cfg: Optional[LlamaConfig] = None,
+    dtype: str = "bfloat16",
+    shard_fn=None,
+) -> tuple[LlamaConfig, Any]:
+    """Load an HF llama/mistral/qwen2 checkpoint into the stacked pytree.
+
+    ``shard_fn(path_tuple, np_array) -> jax.Array`` lets the caller place
+    each param with a NamedSharding (device_put per shard); default is
+    single-device jnp.asarray.
+    """
+    model_dir = Path(model_dir)
+    if cfg is None:
+        cfg = load_hf_config(model_dir)
+    tensors = _open_safetensors(model_dir)
+    dt = jnp.dtype(dtype)
+    put = shard_fn or (lambda path, a: jnp.asarray(a, dt))
+
+    def stack(fmt: str, transpose: bool) -> np.ndarray:
+        mats = []
+        for i in range(cfg.num_layers):
+            a = _get(tensors, fmt.format(i=i)).astype(np.float32)
+            mats.append(a.T if transpose else a)
+        return np.stack(mats)
+
+    L = "model.layers.{i}."
+    layers = {
+        "attn_norm": stack(L + "input_layernorm.weight", False),
+        "wq": stack(L + "self_attn.q_proj.weight", True),
+        "wk": stack(L + "self_attn.k_proj.weight", True),
+        "wv": stack(L + "self_attn.v_proj.weight", True),
+        "wo": stack(L + "self_attn.o_proj.weight", True),
+        "mlp_norm": stack(L + "post_attention_layernorm.weight", False),
+        "w_gate": stack(L + "mlp.gate_proj.weight", True),
+        "w_up": stack(L + "mlp.up_proj.weight", True),
+        "w_down": stack(L + "mlp.down_proj.weight", True),
+    }
+    if cfg.attention_bias:
+        layers["bq"] = stack(L + "self_attn.q_proj.bias", False)
+        layers["bk"] = stack(L + "self_attn.k_proj.bias", False)
+        layers["bv"] = stack(L + "self_attn.v_proj.bias", False)
+
+    params: dict[str, Any] = {
+        "embed": _get(tensors, "model.embed_tokens.weight").astype(np.float32),
+        "final_norm": _get(tensors, "model.norm.weight").astype(np.float32),
+        "layers": layers,
+    }
+    if not cfg.tie_word_embeddings:
+        if "lm_head.weight" in tensors:
+            params["lm_head"] = _get(tensors, "lm_head.weight").astype(np.float32).T
+        else:
+            cfg = LlamaConfig(**{**cfg.__dict__, "tie_word_embeddings": True})
+
+    placed = jax.tree.map_with_path(lambda p, a: put(p, a), params)
+    _check_shapes(cfg, placed)
+    return cfg, placed
+
+
+def _check_shapes(cfg: LlamaConfig, params: Any) -> None:
+    expected = param_shapes(cfg)
+
+    def chk(path, exp):
+        node = params
+        for k in path:
+            node = node[k]
+        if tuple(node.shape) != tuple(exp):
+            raise ValueError(f"param {path}: shape {node.shape} != expected {exp}")
+
+    for name, v in expected.items():
+        if isinstance(v, dict):
+            for k, s in v.items():
+                chk((name, k), s)
+        else:
+            chk((name,), v)

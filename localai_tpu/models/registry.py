@@ -1,0 +1,88 @@
+"""Model resolution: a model ref → (config, params, tokenizer).
+
+The TPU analogue of backend selection + GGUF autoconfig
+(/root/reference/pkg/model/initializers.go:65-267 and
+core/config/guesser.go): instead of scanning binary variants per CPU flag,
+we resolve a weights ref to one JAX model family and load it.
+
+Refs:
+  * a local dir with config.json + *.safetensors  → HF checkpoint
+  * "debug:tiny" / "debug:small" / "debug:1b" ... → random-weight presets
+    (byte tokenizer; used by tests and synthetic benchmarks)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from pathlib import Path
+from typing import Any, Optional
+
+import jax
+
+from localai_tpu.models.llama import LlamaConfig, init_params
+from localai_tpu.utils.tokenizer import ByteTokenizer, Tokenizer, load_tokenizer
+
+# Synthetic presets: shapes only, random weights. "llama3-8b" matches
+# Llama-3-8B dims for honest perf measurement without weight downloads.
+DEBUG_PRESETS: dict[str, LlamaConfig] = {
+    "tiny": LlamaConfig(
+        vocab_size=258, hidden_size=64, intermediate_size=128, num_layers=2,
+        num_heads=4, num_kv_heads=2, max_position_embeddings=512,
+        rope_theta=10000.0,
+    ),
+    "small": LlamaConfig(
+        vocab_size=258, hidden_size=256, intermediate_size=512, num_layers=4,
+        num_heads=8, num_kv_heads=4, max_position_embeddings=2048,
+    ),
+    "1b": LlamaConfig(
+        vocab_size=128256, hidden_size=2048, intermediate_size=8192,
+        num_layers=16, num_heads=32, num_kv_heads=8,
+        max_position_embeddings=8192, rope_theta=500000.0,
+        tie_word_embeddings=True,
+    ),
+    "llama3-8b": LlamaConfig(
+        vocab_size=128256, hidden_size=4096, intermediate_size=14336,
+        num_layers=32, num_heads=32, num_kv_heads=8,
+        max_position_embeddings=8192, rope_theta=500000.0,
+    ),
+}
+
+
+@dataclasses.dataclass
+class LoadedModel:
+    cfg: LlamaConfig
+    params: Any
+    tokenizer: Tokenizer
+    ref: str
+
+
+def resolve_model(
+    ref: str,
+    model_path: str | Path = "models",
+    dtype: str = "bfloat16",
+    shard_fn=None,
+    seed: int = 0,
+) -> LoadedModel:
+    if ref.startswith("debug:"):
+        name = ref.split(":", 1)[1]
+        if name not in DEBUG_PRESETS:
+            raise ValueError(
+                f"unknown debug preset {name!r}; have {sorted(DEBUG_PRESETS)}"
+            )
+        cfg = dataclasses.replace(DEBUG_PRESETS[name], dtype=dtype)
+        params = init_params(jax.random.key(seed), cfg)
+        if shard_fn is not None:
+            params = jax.tree.map_with_path(shard_fn, params)
+        return LoadedModel(cfg, params, ByteTokenizer(), ref)
+
+    for cand in (Path(ref), Path(model_path) / ref):
+        if (cand / "config.json").exists():
+            from localai_tpu.models.loader import load_llama_params
+
+            cfg, params = load_llama_params(cand, dtype=dtype, shard_fn=shard_fn)
+            cfg = dataclasses.replace(cfg, dtype=dtype)
+            return LoadedModel(cfg, params, load_tokenizer(cand), ref)
+    raise FileNotFoundError(
+        f"model ref {ref!r} not found (looked for config.json under {ref} and "
+        f"{Path(model_path) / ref})"
+    )

@@ -1,0 +1,109 @@
+"""Device-mesh construction and axis conventions.
+
+This layer REPLACES the reference's entire distributed plumbing — llama.cpp
+RPC weight-sharding over libp2p tunnels (/root/reference/backend/cpp/llama/
+grpc-server.cpp:2233-2236, core/p2p/p2p.go:137-173) and vLLM
+tensor_parallel_size passthrough (backend/python/vllm/backend.py:102-103) —
+with compiled SPMD: a jax.sharding.Mesh over ICI, shardings annotated on
+params/activations, XLA inserting the collectives.
+
+Axis conventions (sizes of 1 are legal and collapse at trace time):
+
+  data    — request/batch data parallelism (DP)
+  seq     — sequence/context parallelism for long-context (SP, ring attention)
+  pipe    — pipeline stages (PP)
+  expert  — MoE expert parallelism (EP)
+  model   — tensor parallelism (TP; Megatron-style head/ffn split)
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+AXES = ("data", "seq", "pipe", "expert", "model")
+
+
+@dataclass(frozen=True)
+class MeshPlan:
+    """Validated logical mesh shape. Product must equal the device count."""
+
+    data: int = 1
+    seq: int = 1
+    pipe: int = 1
+    expert: int = 1
+    model: int = 1
+
+    @property
+    def shape(self) -> tuple[int, ...]:
+        return (self.data, self.seq, self.pipe, self.expert, self.model)
+
+    def size(self) -> int:
+        return math.prod(self.shape)
+
+
+def plan_from_sharding_config(
+    tensor_parallel_size: int = 1,
+    data_parallel_size: int = 0,
+    sequence_parallel_size: int = 1,
+    expert_parallel_size: int = 1,
+    pipeline_parallel_size: int = 1,
+    n_devices: Optional[int] = None,
+) -> MeshPlan:
+    """Turn ShardingConfig knobs into a concrete MeshPlan.
+
+    data_parallel_size=0 means "fill whatever devices remain" (the TPU
+    analogue of the reference auto-detecting GPU count,
+    /root/reference/pkg/model/initializers.go:185-267).
+    """
+    nd = n_devices if n_devices is not None else len(jax.devices())
+    fixed = (
+        tensor_parallel_size
+        * sequence_parallel_size
+        * expert_parallel_size
+        * pipeline_parallel_size
+    )
+    if nd % fixed != 0:
+        raise ValueError(
+            f"device count {nd} not divisible by tp*sp*ep*pp={fixed}"
+        )
+    dp = data_parallel_size or nd // fixed
+    plan = MeshPlan(
+        data=dp,
+        seq=sequence_parallel_size,
+        pipe=pipeline_parallel_size,
+        expert=expert_parallel_size,
+        model=tensor_parallel_size,
+    )
+    if plan.size() != nd:
+        raise ValueError(
+            f"mesh {plan.shape} (={plan.size()}) != device count {nd}"
+        )
+    return plan
+
+
+def build_mesh(
+    plan: Optional[MeshPlan] = None,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build the Mesh. Device order follows jax.devices(), which for TPU
+    slices is ICI-contiguous — 'model' is the fastest-varying axis so TP
+    collectives ride the shortest ICI rings."""
+    devs = list(devices if devices is not None else jax.devices())
+    if plan is None:
+        plan = MeshPlan(model=len(devs))
+    arr = np.array(devs).reshape(plan.shape)
+    return Mesh(arr, AXES)
+
+
+def single_device_mesh() -> Mesh:
+    return build_mesh(MeshPlan(), devices=jax.devices()[:1])
+
+
+def named(mesh: Mesh, *spec) -> NamedSharding:
+    return NamedSharding(mesh, P(*spec))

@@ -48,6 +48,8 @@ def resolve_url(uri: str) -> str:
         if "@" in ref:
             ref, branch = ref.rsplit("@", 1)
         parts = ref.split("/")
+        if len(parts) < 3:
+            raise ValueError(f"github uri needs owner/repo/file: {uri}")
         owner, repo, filepath = parts[0], parts[1], "/".join(parts[2:])
         return (
             f"https://raw.githubusercontent.com/{owner}/{repo}/{branch}/{filepath}"

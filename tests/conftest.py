@@ -5,13 +5,16 @@ heavy backends out of unit tests (SURVEY.md §4)."""
 
 import os
 
-# Must be set before jax is imported anywhere.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+# The environment presets JAX_PLATFORMS=axon (the real TPU tunnel) and its
+# sitecustomize imports jax at interpreter start, so env vars are captured
+# before this file runs. Override via jax.config, which is honored until the
+# backend is actually initialized (first device use).
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
 
 import pytest  # noqa: E402
 
