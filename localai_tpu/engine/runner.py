@@ -46,6 +46,8 @@ class DecodeState:
     active: jax.Array      # [S] bool
     keys: jax.Array        # [S] PRNG keys
     counts: jax.Array      # [S, V] i32 — token occurrence counts (penalties)
+    bias: jax.Array        # [S, V] f32 — additive logit bias (logit_bias API
+                           #              + grammar/FSM masks as -1e30)
     params: smp.SamplingParams
 
     @staticmethod
@@ -56,6 +58,7 @@ class DecodeState:
             active=jnp.zeros(num_slots, jnp.bool_),
             keys=jax.random.split(jax.random.key(seed), num_slots),
             counts=jnp.zeros((num_slots, vocab_size), jnp.int32),
+            bias=jnp.zeros((num_slots, vocab_size), jnp.float32),
             params=smp.SamplingParams.init(num_slots),
         )
 
@@ -82,7 +85,8 @@ class ModelRunner:
         self.num_slots = num_slots
         self.max_ctx = max_ctx or cfg.max_position_embeddings
         buckets = sorted(prefill_buckets or [128, 512, 2048, 8192])
-        self.buckets = [b for b in buckets if b <= self.max_ctx] or [self.max_ctx]
+        self.buckets = [b for b in buckets if b < self.max_ctx]
+        self.buckets.append(self.max_ctx)  # any admissible prompt has a bucket
         self.rope = mdl.rope_table(
             cfg, self.max_ctx, freq_base=rope_freq_base, freq_scale=rope_freq_scale
         )
@@ -107,7 +111,9 @@ class ModelRunner:
             write, (kv.k, kv.v), mask, self.rope,
         )
         logits = mdl.logits_from_hidden(cfg, params, hidden[:, 0])
-        tokens, keys = smp.sample(logits, state.params, state.counts, state.keys)
+        tokens, keys = smp.sample(
+            logits, state.params, state.counts, state.keys, state.bias
+        )
         tokens = jnp.where(state.active, tokens, state.tokens)
         counts = smp.update_counts(state.counts, tokens, state.active)
         positions = jnp.where(
@@ -132,7 +138,8 @@ class ModelRunner:
         counts = smp.count_prompt_tokens(state.counts, slot, tokens[0], length)
         slot_params = jax.tree.map(lambda a: a[slot][None], state.params)
         tok, new_key = smp.sample(
-            logits, slot_params, counts[slot][None], state.keys[slot][None]
+            logits, slot_params, counts[slot][None], state.keys[slot][None],
+            state.bias[slot][None],
         )
         new_state = dataclasses.replace(
             state,
@@ -170,6 +177,8 @@ class ModelRunner:
         presence_penalty: Optional[float] = None,
         frequency_penalty: Optional[float] = None,
         seed: Optional[int] = None,
+        logit_bias: Optional[dict[int, float]] = None,
+        bias_row: Optional[np.ndarray] = None,
     ) -> int:
         """Prefill a prompt into a slot; returns the first sampled token."""
         if not prompt:
@@ -200,6 +209,15 @@ class ModelRunner:
                 self.state,
                 keys=self.state.keys.at[slot].set(jax.random.key(seed)),
             )
+        if bias_row is not None:
+            row = np.asarray(bias_row, np.float32).copy()
+        else:
+            row = np.zeros(self.cfg.vocab_size, np.float32)
+        if logit_bias:
+            for tid, b in logit_bias.items():
+                if 0 <= int(tid) < self.cfg.vocab_size:
+                    row[int(tid)] += b
+        self.set_bias(slot, row)
         self.kv, self.state, tok = self._prefill(
             self.params, self.kv, self.state,
             jnp.asarray(padded), jnp.int32(n), jnp.int32(slot), bucket=bucket,
@@ -212,6 +230,17 @@ class ModelRunner:
             self.params, self.kv, self.state
         )
         return np.asarray(tokens)
+
+    def set_bias(self, slot: int, bias_row: Optional[np.ndarray]) -> None:
+        """Replace one slot's [V] additive logit-bias row (grammar masks write
+        -1e30 at disallowed ids; None clears)."""
+        if bias_row is None:
+            row = jnp.zeros(self.cfg.vocab_size, jnp.float32)
+        else:
+            row = jnp.asarray(bias_row, jnp.float32)
+        self.state = dataclasses.replace(
+            self.state, bias=self.state.bias.at[slot].set(row)
+        )
 
     def release(self, slot: int) -> None:
         self.state = dataclasses.replace(

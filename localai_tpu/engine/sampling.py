@@ -57,17 +57,29 @@ class SamplingParams:
             frequency_penalty=full(0.0),
         )
 
+    DEFAULTS = {
+        "temperature": 1.0,
+        "top_k": 40,
+        "top_p": 1.0,
+        "min_p": 0.0,
+        "repeat_penalty": 1.0,
+        "presence_penalty": 0.0,
+        "frequency_penalty": 0.0,
+    }
+
     def with_slot(self, slot: int, **kw) -> "SamplingParams":
-        """Functional single-slot update (host-side, at admit time)."""
+        """Functional single-slot update (host-side, at admit time).
+
+        Unspecified (None) fields reset to engine defaults so a reused slot
+        never inherits the previous request's sampling options.
+        """
         out = {}
         for f in dataclasses.fields(self):
             arr = getattr(self, f.name)
-            if f.name in kw and kw[f.name] is not None:
-                val = kw[f.name]
-                arr = arr.at[slot].set(
-                    jnp.asarray(val, arr.dtype)
-                )
-            out[f.name] = arr
+            val = kw.get(f.name)
+            if val is None:
+                val = self.DEFAULTS[f.name]
+            out[f.name] = arr.at[slot].set(jnp.asarray(val, arr.dtype))
         return SamplingParams(**out)
 
 
@@ -92,10 +104,14 @@ def sample(
     params: SamplingParams,
     counts: jax.Array,        # [S, V] i32
     keys: jax.Array,          # [S] jax PRNG keys
+    bias: jax.Array | None = None,  # [S, V] f32 additive logit bias
+                                    # (OpenAI logit_bias + grammar masks as -inf)
 ) -> tuple[jax.Array, jax.Array]:
     """Returns (tokens [S] i32, new_keys [S])."""
     S, V = logits.shape
     logits = logits.astype(jnp.float32)
+    if bias is not None:
+        logits = logits + bias
     logits = apply_penalties(logits, counts, params)
 
     k = min(MAX_TOPK, V)

@@ -1,0 +1,381 @@
+"""Continuous-batching scheduler: the serving loop over ModelRunner.
+
+TPU-era redesign of llama.cpp's slot engine (`update_slots`, task queue and
+deferred-task handling — /root/reference/backend/cpp/llama/
+grpc-server.cpp:1546-1990, utils.hpp:192-357):
+
+  * requests queue on the host; a single engine thread admits them into free
+    slots (prefill) and then advances ALL active slots with one compiled
+    decode step per iteration — continuous batching is slot masking inside a
+    static-shape program, not ragged batch rebuilds.
+  * per-request streams: each request owns a thread-safe queue of text
+    deltas; SSE writers drain it without touching the engine thread.
+  * stop handling: EOS ids, stop strings (with split-across-tokens holdback),
+    max_tokens, context exhaustion (slot released at n_ctx — parity with the
+    reference's no-context-shift policy, grpc-server.cpp:1573-1592).
+  * grammar constraints: an optional per-request TokenConstraint advances an
+    FSM on the host and writes a -1e30 mask row into the device bias before
+    the next step (see localai_tpu.functions for the FSM compiler).
+  * metrics: per-slot prompt/generated token counts and tokens/sec — the
+    GetMetrics surface (grpc-server.cpp:2434-2457).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import logging
+import queue
+import threading
+import time
+from typing import Any, Optional, Protocol, Sequence
+
+import numpy as np
+
+from localai_tpu.engine.runner import ModelRunner
+from localai_tpu.engine.stream import IncrementalDetokenizer, StopChecker
+
+log = logging.getLogger(__name__)
+
+
+class TokenConstraint(Protocol):
+    """Grammar/JSON-schema constraint driven by the scheduler.
+
+    ``allowed_mask`` returns a [V] f32 additive bias row (0 allowed, -1e30
+    disallowed) or None for "anything"; ``advance`` consumes the sampled
+    token; ``done`` means the constrained region is complete.
+    """
+
+    def allowed_mask(self) -> Optional[np.ndarray]: ...
+    def advance(self, token_id: int) -> None: ...
+    @property
+    def done(self) -> bool: ...
+
+
+@dataclasses.dataclass
+class GenRequest:
+    """One generation request (the scheduler-facing request schema)."""
+
+    prompt: list[int]
+    max_new_tokens: int = 256
+    temperature: Optional[float] = None
+    top_k: Optional[int] = None
+    top_p: Optional[float] = None
+    min_p: Optional[float] = None
+    repeat_penalty: Optional[float] = None
+    presence_penalty: Optional[float] = None
+    frequency_penalty: Optional[float] = None
+    seed: Optional[int] = None
+    logit_bias: Optional[dict[int, float]] = None
+    stop: Sequence[str] = ()
+    ignore_eos: bool = False
+    constraint: Optional[TokenConstraint] = None
+    correlation_id: str = ""
+
+
+class StreamItem:
+    """Sentinel-free stream element: text delta or end-of-stream marker."""
+
+    __slots__ = ("delta", "token_id", "finish_reason")
+
+    def __init__(self, delta: str, token_id: Optional[int],
+                 finish_reason: Optional[str]):
+        self.delta = delta
+        self.token_id = token_id
+        self.finish_reason = finish_reason
+
+
+class GenHandle:
+    """Per-request handle: iterate deltas (streaming) or join for the full
+    result. Filled by the engine thread."""
+
+    def __init__(self, req: GenRequest, rid: int):
+        self.request = req
+        self.id = rid
+        self._q: "queue.Queue[StreamItem]" = queue.Queue()
+        self.text = ""
+        self.token_ids: list[int] = []
+        self.finish_reason: Optional[str] = None
+        self.prompt_tokens = len(req.prompt)
+        self._done = threading.Event()
+        self.cancelled = False
+        # perf (parity: per-slot timings grpc-server.cpp:1650,1661)
+        self.t_submit = time.monotonic()
+        self.t_first_token: Optional[float] = None
+        self.t_done: Optional[float] = None
+
+    # engine-thread side -------------------------------------------------
+    def _emit(self, delta: str, token_id: Optional[int]) -> None:
+        if self.t_first_token is None:
+            self.t_first_token = time.monotonic()
+        if token_id is not None:
+            self.token_ids.append(token_id)
+        if delta:
+            self.text += delta
+        if delta or token_id is not None:
+            self._q.put(StreamItem(delta, token_id, None))
+
+    def _finish(self, reason: str) -> None:
+        self.finish_reason = reason
+        self.t_done = time.monotonic()
+        self._q.put(StreamItem("", None, reason))
+        self._done.set()
+
+    # consumer side ------------------------------------------------------
+    def cancel(self) -> None:
+        """Request cancellation; the engine releases the slot on next step."""
+        self.cancelled = True
+
+    def __iter__(self):
+        while True:
+            item = self._q.get()
+            yield item
+            if item.finish_reason is not None:
+                return
+
+    def result(self, timeout: Optional[float] = None) -> "GenHandle":
+        if not self._done.wait(timeout):
+            raise TimeoutError(f"request {self.id} not finished")
+        return self
+
+    @property
+    def completion_tokens(self) -> int:
+        return len(self.token_ids)
+
+    @property
+    def tokens_per_second(self) -> float:
+        if self.t_first_token is None:
+            return 0.0
+        end = self.t_done or time.monotonic()
+        dt = end - self.t_first_token
+        return (len(self.token_ids) - 1) / dt if dt > 0 else 0.0
+
+
+@dataclasses.dataclass
+class _SlotCtx:
+    """Host-side state for one occupied slot."""
+
+    handle: GenHandle
+    detok: IncrementalDetokenizer
+    stopper: StopChecker
+    generated: int = 0
+    base_bias: Optional[np.ndarray] = None  # [V] row from logit_bias
+    mask_set: bool = False                  # constraint mask currently on device
+
+
+class Scheduler:
+    """Owns one ModelRunner + tokenizer; runs the engine thread."""
+
+    def __init__(self, runner: ModelRunner, tokenizer: Any,
+                 *, default_max_tokens: int = 2048):
+        self.runner = runner
+        self.tokenizer = tokenizer
+        self.default_max_tokens = default_max_tokens
+        self._pending: "queue.Queue[GenHandle]" = queue.Queue()
+        self._slots: dict[int, _SlotCtx] = {}
+        self._ids = itertools.count()
+        self._wake = threading.Event()
+        self._stopping = False
+        self._lock = threading.Lock()
+        # lifetime metrics (GetMetrics parity)
+        self.total_prompt_tokens = 0
+        self.total_generated_tokens = 0
+        self._thread = threading.Thread(
+            target=self._run, name="engine", daemon=True
+        )
+        self._thread.start()
+
+    # -- public API ------------------------------------------------------
+
+    def submit(self, req: GenRequest) -> GenHandle:
+        handle = GenHandle(req, next(self._ids))
+        self._pending.put(handle)
+        self._wake.set()
+        return handle
+
+    def generate(self, req: GenRequest, timeout: float = 600.0) -> GenHandle:
+        return self.submit(req).result(timeout)
+
+    @property
+    def busy(self) -> bool:
+        return bool(self._slots) or not self._pending.empty()
+
+    def metrics(self) -> dict:
+        """Live engine metrics (parity: GetMetrics RPC,
+        grpc-server.cpp:2434-2457)."""
+        with self._lock:
+            active = [
+                {
+                    "slot": s,
+                    "prompt_tokens_processed": c.handle.prompt_tokens,
+                    "tokens_generated": c.handle.completion_tokens,
+                    "tokens_per_second": c.handle.tokens_per_second,
+                    "correlation_id": c.handle.request.correlation_id,
+                }
+                for s, c in self._slots.items()
+            ]
+        return {
+            "active_slots": active,
+            "num_slots": self.runner.num_slots,
+            "queue_depth": self._pending.qsize(),
+            "total_prompt_tokens": self.total_prompt_tokens,
+            "total_generated_tokens": self.total_generated_tokens,
+        }
+
+    def shutdown(self, timeout: float = 10.0) -> None:
+        self._stopping = True
+        self._wake.set()
+        self._thread.join(timeout)
+
+    # -- engine thread ---------------------------------------------------
+
+    def _run(self) -> None:
+        while not self._stopping:
+            admitted = self._admit_pending()
+            if not self._slots:
+                if not admitted:
+                    self._wake.wait(timeout=0.05)
+                    self._wake.clear()
+                continue
+            try:
+                tokens = self.runner.step()
+            except Exception:  # noqa: BLE001 — engine must not die silently
+                log.exception("decode step failed; failing active requests")
+                with self._lock:
+                    for slot, ctx in list(self._slots.items()):
+                        ctx.handle._finish("error")
+                        self.runner.release(slot)
+                    self._slots.clear()
+                continue
+            self._process_step(tokens)
+
+    def _admit_pending(self) -> bool:
+        admitted = False
+        while True:
+            slot = self.runner.acquire_slot()
+            if slot is None:
+                return admitted
+            try:
+                handle = self._pending.get_nowait()
+            except queue.Empty:
+                self.runner.release(slot)
+                return admitted
+            if handle.cancelled:
+                handle._finish("cancelled")
+                self.runner.release(slot)
+                continue
+            try:
+                self._start(slot, handle)
+                admitted = True
+            except Exception as e:  # noqa: BLE001 — bad request ≠ dead engine
+                log.warning("admit failed: %s", e)
+                handle._finish("error")
+                self.runner.release(slot)
+
+    def _start(self, slot: int, handle: GenHandle) -> None:
+        req = handle.request
+        base = None
+        if req.logit_bias:
+            base = np.zeros(self.runner.cfg.vocab_size, np.float32)
+            for tid, b in req.logit_bias.items():
+                if 0 <= int(tid) < base.shape[0]:
+                    base[int(tid)] = b
+        mask = (
+            req.constraint.allowed_mask() if req.constraint is not None else None
+        )
+        first = self.runner.admit(
+            slot,
+            req.prompt,
+            temperature=req.temperature,
+            top_k=req.top_k,
+            top_p=req.top_p,
+            min_p=req.min_p,
+            repeat_penalty=req.repeat_penalty,
+            presence_penalty=req.presence_penalty,
+            frequency_penalty=req.frequency_penalty,
+            seed=req.seed,
+            bias_row=self._compose_bias(base, mask),
+        )
+        ctx = _SlotCtx(
+            handle=handle,
+            detok=IncrementalDetokenizer(self.tokenizer.decode),
+            stopper=StopChecker(req.stop),
+            base_bias=base,
+            mask_set=mask is not None,
+        )
+        with self._lock:
+            self._slots[slot] = ctx
+            self.total_prompt_tokens += handle.prompt_tokens
+        self._consume(slot, ctx, int(first))
+
+    @staticmethod
+    def _compose_bias(
+        base: Optional[np.ndarray], mask: Optional[np.ndarray]
+    ) -> Optional[np.ndarray]:
+        if base is None:
+            return mask
+        if mask is None:
+            return base
+        return base + mask
+
+    def _process_step(self, tokens: np.ndarray) -> None:
+        # _slots is authoritative: the runner only deactivates slots when this
+        # thread releases them, so no device round-trip for liveness.
+        for slot, ctx in list(self._slots.items()):
+            self._consume(slot, ctx, int(tokens[slot]))
+
+    def _consume(self, slot: int, ctx: _SlotCtx, token_id: int) -> None:
+        """Handle one sampled token for one slot: stream, stop, constrain."""
+        handle = ctx.handle
+        req = handle.request
+        if handle.cancelled:
+            self._release(slot, ctx, "cancelled")
+            return
+
+        is_eos = (not req.ignore_eos) and token_id in getattr(
+            self.tokenizer, "eos_ids", set()
+        )
+        if is_eos:
+            handle._emit(ctx.stopper.flush(), None)
+            self._release(slot, ctx, "stop")
+            return
+
+        ctx.generated += 1
+        delta = ctx.detok.push(token_id)
+        safe = ctx.stopper.push(delta)
+        handle._emit(safe, token_id)
+
+        if ctx.stopper.stopped is not None:
+            self._release(slot, ctx, "stop")
+            return
+
+        if req.constraint is not None:
+            req.constraint.advance(token_id)
+            if req.constraint.done:
+                handle._emit(ctx.stopper.flush(), None)
+                self._release(slot, ctx, "stop")
+                return
+            mask = req.constraint.allowed_mask()
+            if mask is not None or ctx.mask_set:
+                # always refresh when a mask was ever set, so an FSM entering
+                # a free-text region (mask=None) clears the stale device mask
+                self.runner.set_bias(slot, self._compose_bias(ctx.base_bias, mask))
+                ctx.mask_set = mask is not None
+
+        limit = req.max_new_tokens or self.default_max_tokens
+        if ctx.generated >= limit:
+            handle._emit(ctx.stopper.flush(), None)
+            self._release(slot, ctx, "length")
+            return
+        if handle.prompt_tokens + ctx.generated >= self.runner.max_ctx - 1:
+            # context exhausted: finish (no silent context shifting — parity
+            # with grpc-server.cpp:1573-1592)
+            handle._emit(ctx.stopper.flush(), None)
+            self._release(slot, ctx, "length")
+
+    def _release(self, slot: int, ctx: _SlotCtx, reason: str) -> None:
+        with self._lock:
+            self._slots.pop(slot, None)
+            self.total_generated_tokens += ctx.handle.completion_tokens
+        self.runner.release(slot)
+        ctx.handle._finish(reason)

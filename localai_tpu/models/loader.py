@@ -74,9 +74,11 @@ def load_llama_params(
     put = shard_fn or (lambda path, a: jnp.asarray(a, dt))
 
     def stack(fmt: str, transpose: bool) -> np.ndarray:
+        # keep source dtype on host (bf16 checkpoints stay 2 bytes/elem);
+        # the device put casts to the target dtype
         mats = []
         for i in range(cfg.num_layers):
-            a = _get(tensors, fmt.format(i=i)).astype(np.float32)
+            a = _get(tensors, fmt.format(i=i))
             mats.append(a.T if transpose else a)
         return np.stack(mats)
 
@@ -98,13 +100,13 @@ def load_llama_params(
         layers["bv"] = stack(L + "self_attn.v_proj.bias", False)
 
     params: dict[str, Any] = {
-        "embed": _get(tensors, "model.embed_tokens.weight").astype(np.float32),
-        "final_norm": _get(tensors, "model.norm.weight").astype(np.float32),
+        "embed": _get(tensors, "model.embed_tokens.weight"),
+        "final_norm": _get(tensors, "model.norm.weight"),
         "layers": layers,
     }
     if not cfg.tie_word_embeddings:
         if "lm_head.weight" in tensors:
-            params["lm_head"] = _get(tensors, "lm_head.weight").astype(np.float32).T
+            params["lm_head"] = _get(tensors, "lm_head.weight").T
         else:
             cfg = LlamaConfig(**{**cfg.__dict__, "tie_word_embeddings": True})
 

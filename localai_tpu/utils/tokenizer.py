@@ -65,38 +65,41 @@ class HFTokenizer:
         self.eos_ids = self._find_eos(model_dir)
         self.bos_id = self._find_bos(model_dir)
 
-    def _read_cfgs(self, model_dir: Path) -> dict:
+    def _each_cfg(self, model_dir: Path):
         import json
 
-        merged: dict = {}
         for name in ("generation_config.json", "config.json",
                      "tokenizer_config.json"):
             p = model_dir / name
             if p.exists():
                 try:
-                    merged.update(json.loads(p.read_text()))
+                    yield json.loads(p.read_text())
                 except Exception:  # noqa: BLE001
                     pass
-        return merged
 
     def _find_eos(self, model_dir: Path) -> set[int]:
-        cfg = self._read_cfgs(model_dir)
-        eos = cfg.get("eos_token_id")
+        # union across all config files: Llama-3-Instruct lists multiple EOS
+        # ids in generation_config.json and a single one in config.json —
+        # generation must stop on any of them
         out: set[int] = set()
-        if isinstance(eos, int):
-            out.add(eos)
-        elif isinstance(eos, list):
-            out.update(int(e) for e in eos)
-        elif isinstance(eos, str):
-            ids = self._encode(eos)
-            if len(ids) == 1:
-                out.add(ids[0])
+        for cfg in self._each_cfg(model_dir):
+            eos = cfg.get("eos_token_id")
+            if isinstance(eos, int):
+                out.add(eos)
+            elif isinstance(eos, list):
+                out.update(int(e) for e in eos)
+            elif isinstance(eos, str):
+                ids = self._encode(eos)
+                if len(ids) == 1:
+                    out.add(ids[0])
         return out
 
     def _find_bos(self, model_dir: Path):
-        cfg = self._read_cfgs(model_dir)
-        b = cfg.get("bos_token_id")
-        return b if isinstance(b, int) else None
+        for cfg in self._each_cfg(model_dir):
+            b = cfg.get("bos_token_id")
+            if isinstance(b, int):
+                return b
+        return None
 
     def encode(self, text: str, add_bos: bool = False) -> list[int]:
         ids = self._encode(text)
