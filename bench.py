@@ -1,0 +1,85 @@
+"""Benchmark: steady-state decode throughput of the TPU llama engine.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Workload: Llama-3.2-1B-class shapes (synthetic bf16 weights — the reference
+publishes no absolute numbers and this environment has zero egress, see
+BASELINE.md), 8 concurrent slots, 128-token prefill each, then timed batched
+decode. This is the hot loop the north star measures (/v1/chat/completions
+output tok/s); the API layers add microseconds, the engine dominates.
+
+vs_baseline: ratio against 800 tok/s aggregate — a documented proxy for
+llama.cpp-CUDA-class serving of a 1B model at batch 8 (~100 tok/s/stream).
+The reference itself publishes no numbers (BASELINE.md), so this constant is
+the stand-in target until a measured reference run exists; it is held fixed
+across rounds so the trend is comparable.
+"""
+
+import json
+import os
+import time
+
+BASELINE_TOK_S = 800.0
+
+
+def main() -> None:
+    from localai_tpu.engine.runner import ModelRunner
+    from localai_tpu.models.registry import resolve_model
+
+    import jax
+
+    # env knobs for smoke runs (the driver uses the defaults)
+    preset = os.environ.get("BENCH_MODEL", "debug:1b")
+    steps = int(os.environ.get("BENCH_STEPS", "50"))
+
+    model = resolve_model(preset, dtype="bfloat16")
+    num_slots = 8
+    runner = ModelRunner(
+        model.cfg, model.params, num_slots=num_slots, max_ctx=1024,
+        prefill_buckets=[128],
+    )
+
+    prompt = list(range(1, 101))  # 100-token synthetic prompt
+    for _ in range(num_slots):
+        slot = runner.acquire_slot()
+        runner.admit(slot, prompt, temperature=0.0)
+
+    # warmup (compile + first dispatches)
+    for _ in range(5):
+        runner.step()
+    jax.block_until_ready(runner.state.tokens)
+
+    # pipelined loop — the scheduler's production pattern: depth-4 in-flight
+    # dispatches with async D2H copies, so neither the device nor the host
+    # round-trip sits on the critical path
+    from collections import deque
+
+    import numpy as np
+
+    depth = 4
+    t0 = time.perf_counter()
+    q: deque = deque()
+    for _ in range(steps):
+        toks = runner.step_async()
+        try:
+            toks.copy_to_host_async()
+        except AttributeError:
+            pass
+        q.append(toks)
+        if len(q) >= depth:
+            np.asarray(q.popleft())
+    while q:
+        np.asarray(q.popleft())
+    dt = time.perf_counter() - t0
+
+    tok_s = steps * num_slots / dt
+    print(json.dumps({
+        "metric": "decode_throughput_llama1b_bs8",
+        "value": round(tok_s, 2),
+        "unit": "tok/s",
+        "vs_baseline": round(tok_s / BASELINE_TOK_S, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

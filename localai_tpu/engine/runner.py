@@ -79,22 +79,65 @@ class ModelRunner:
         rope_freq_base: Optional[float] = None,
         rope_freq_scale: Optional[float] = None,
         seed: int = 0,
+        mesh: Optional[jax.sharding.Mesh] = None,
     ):
         self.cfg = cfg
         self.params = params
         self.num_slots = num_slots
         self.max_ctx = max_ctx or cfg.max_position_embeddings
+        self.mesh = mesh
         buckets = sorted(prefill_buckets or [128, 512, 2048, 8192])
         self.buckets = [b for b in buckets if b < self.max_ctx]
         self.buckets.append(self.max_ctx)  # any admissible prompt has a bucket
         self.rope = mdl.rope_table(
             cfg, self.max_ctx, freq_base=rope_freq_base, freq_scale=rope_freq_scale
         )
-        self.kv = kvc.init_cache(cfg, num_slots, self.max_ctx, kv_dtype)
+        kv_sharding = None
+        if mesh is not None:
+            from jax.sharding import NamedSharding
+
+            from localai_tpu.parallel import sharding as shd
+
+            shd.slots_per_data_shard(num_slots, mesh)  # divisibility check
+            kv_sharding = NamedSharding(mesh, shd.kv_spec(cfg, mesh))
+        self.kv = kvc.init_cache(
+            cfg, num_slots, self.max_ctx, kv_dtype, sharding=kv_sharding
+        )
         self.state = DecodeState.init(num_slots, cfg.vocab_size, seed)
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from localai_tpu.parallel import sharding as shd
+
+            specs = shd.state_specs(mesh)
+
+            def place(name: str, leaf):
+                spec = shd._sanitize(specs[name], leaf.shape, mesh)
+                return jax.device_put(leaf, NamedSharding(mesh, spec))
+
+            self.state = DecodeState(
+                tokens=place("tokens", self.state.tokens),
+                positions=place("positions", self.state.positions),
+                active=place("active", self.state.active),
+                keys=place("keys", self.state.keys),
+                counts=place("counts", self.state.counts),
+                bias=place("bias", self.state.bias),
+                params=jax.tree.map(
+                    lambda a: jax.device_put(
+                        a, NamedSharding(mesh, P("data"))
+                    ),
+                    self.state.params,
+                ),
+            )
+            self.rope = jax.device_put(
+                self.rope, NamedSharding(mesh, P())
+            )
         self._free_slots = list(range(num_slots))
 
         self._decode = jax.jit(self._decode_fn, donate_argnums=(1, 2))
+        self._decode_n = jax.jit(
+            self._decode_n_fn, static_argnames=("n",), donate_argnums=(1, 2)
+        )
         self._prefill = jax.jit(
             self._prefill_fn, static_argnames=("bucket",), donate_argnums=(1, 2)
         )
@@ -123,6 +166,21 @@ class ModelRunner:
             state, tokens=tokens, positions=positions, keys=keys, counts=counts
         )
         return KVCache(new_k, new_v), new_state, tokens
+
+    def _decode_n_fn(self, params, kv: KVCache, state: DecodeState, *, n: int):
+        """n decode steps in ONE dispatch via lax.scan — amortizes host→device
+        dispatch latency (the tunnel RTT dominates single-step decode; see
+        bench.py). Returns tokens [n, S]."""
+
+        def body(carry, _):
+            kv, state = carry
+            kv, state, tokens = self._decode_fn(params, kv, state)
+            return (kv, state), tokens
+
+        (kv, state), tokens = jax.lax.scan(
+            body, (kv, state), None, length=n
+        )
+        return kv, state, tokens
 
     def _prefill_fn(self, params, kv: KVCache, state: DecodeState,
                     tokens, length, slot, *, bucket: int):
@@ -228,6 +286,21 @@ class ModelRunner:
         """One decode iteration over all slots; returns sampled tokens [S]."""
         self.kv, self.state, tokens = self._decode(
             self.params, self.kv, self.state
+        )
+        return np.asarray(tokens)
+
+    def step_async(self) -> jax.Array:
+        """Like step() but returns the device array without synchronizing —
+        callers overlap the host read with the next dispatch."""
+        self.kv, self.state, tokens = self._decode(
+            self.params, self.kv, self.state
+        )
+        return tokens
+
+    def step_n(self, n: int) -> np.ndarray:
+        """n decode iterations in one dispatch; returns tokens [n, S]."""
+        self.kv, self.state, tokens = self._decode_n(
+            self.params, self.kv, self.state, n=n
         )
         return np.asarray(tokens)
 

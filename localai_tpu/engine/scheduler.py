@@ -161,22 +161,27 @@ class _SlotCtx:
     generated: int = 0
     base_bias: Optional[np.ndarray] = None  # [V] row from logit_bias
     mask_set: bool = False                  # constraint mask currently on device
+    admit_seq: int = 0                      # dispatch counter at admit time:
+                                            # tokens from dispatches issued
+                                            # before admission are not ours
 
 
 class Scheduler:
     """Owns one ModelRunner + tokenizer; runs the engine thread."""
 
     def __init__(self, runner: ModelRunner, tokenizer: Any,
-                 *, default_max_tokens: int = 2048):
+                 *, default_max_tokens: int = 2048, pipeline_depth: int = 4):
         self.runner = runner
         self.tokenizer = tokenizer
         self.default_max_tokens = default_max_tokens
+        self.pipeline_depth = max(1, pipeline_depth)
         self._pending: "queue.Queue[GenHandle]" = queue.Queue()
         self._slots: dict[int, _SlotCtx] = {}
         self._ids = itertools.count()
         self._wake = threading.Event()
         self._stopping = False
         self._lock = threading.Lock()
+        self._dispatch_seq = 0
         # lifetime metrics (GetMetrics parity)
         self.total_prompt_tokens = 0
         self.total_generated_tokens = 0
@@ -230,24 +235,62 @@ class Scheduler:
     # -- engine thread ---------------------------------------------------
 
     def _run(self) -> None:
+        # Pipelined decode: keep up to pipeline_depth dispatches in flight,
+        # start each result's D2H copy immediately (copy_to_host_async), and
+        # process the oldest batch each iteration. The device never waits for
+        # the host round-trip (6-8x throughput on a remote-tunneled chip; see
+        # bench.py). Token delivery lags by depth×step-time (~30ms) — invisible
+        # in streaming. Constrained slots need the sampled token before the
+        # next dispatch (the FSM mask feeds step k+1), so any active
+        # constraint forces synchronous single-stepping.
+        from collections import deque
+
+        inflight: deque[tuple[Any, int]] = deque()
+
+        def drain_one() -> None:
+            toks, seq = inflight.popleft()
+            self._process_step(np.asarray(toks), seq)
+
         while not self._stopping:
             admitted = self._admit_pending()
             if not self._slots:
+                if inflight:
+                    drain_one()
+                    continue
                 if not admitted:
                     self._wake.wait(timeout=0.05)
                     self._wake.clear()
                 continue
+            constrained = any(
+                c.handle.request.constraint is not None
+                for c in self._slots.values()
+            )
             try:
-                tokens = self.runner.step()
+                if constrained:
+                    while inflight:
+                        drain_one()
+                    if not self._slots:
+                        continue
+                    self._dispatch_seq += 1
+                    self._process_step(self.runner.step(), self._dispatch_seq)
+                else:
+                    self._dispatch_seq += 1
+                    tokens = self.runner.step_async()
+                    try:
+                        tokens.copy_to_host_async()
+                    except AttributeError:
+                        pass
+                    inflight.append((tokens, self._dispatch_seq))
+                    if len(inflight) >= self.pipeline_depth:
+                        drain_one()
             except Exception:  # noqa: BLE001 — engine must not die silently
                 log.exception("decode step failed; failing active requests")
+                inflight.clear()
                 with self._lock:
                     for slot, ctx in list(self._slots.items()):
                         ctx.handle._finish("error")
                         self.runner.release(slot)
                     self._slots.clear()
-                continue
-            self._process_step(tokens)
 
     def _admit_pending(self) -> bool:
         admitted = False
@@ -302,6 +345,7 @@ class Scheduler:
             stopper=StopChecker(req.stop),
             base_bias=base,
             mask_set=mask is not None,
+            admit_seq=self._dispatch_seq,
         )
         with self._lock:
             self._slots[slot] = ctx
@@ -318,10 +362,14 @@ class Scheduler:
             return base
         return base + mask
 
-    def _process_step(self, tokens: np.ndarray) -> None:
+    def _process_step(self, tokens: np.ndarray, seq: int) -> None:
         # _slots is authoritative: the runner only deactivates slots when this
-        # thread releases them, so no device round-trip for liveness.
+        # thread releases them, so no device round-trip for liveness. The seq
+        # guard drops tokens from dispatches issued before a slot's admission
+        # (pipelined mode re-admits slots while a read is still in flight).
         for slot, ctx in list(self._slots.items()):
+            if seq <= ctx.admit_seq:
+                continue
             self._consume(slot, ctx, int(tokens[slot]))
 
     def _consume(self, slot: int, ctx: _SlotCtx, token_id: int) -> None:
