@@ -1,0 +1,27 @@
+"""Prompt templating: Go-template-compatible Jinja2 evaluation.
+
+Replaces /root/reference/pkg/templates + pkg/model/template.go (Go
+text/template + sprig) with Jinja2 plus a Go-template transpiler so the
+reference's gallery templates keep working unmodified.
+"""
+
+from localai_tpu.templates.cache import TemplateCache, TemplateType
+from localai_tpu.templates.chat import (
+    apply_tokenizer_template,
+    build_chat_prompt,
+    build_completion_prompt,
+    build_edit_prompt,
+    multimodal_placeholders,
+)
+from localai_tpu.templates.gotmpl import go_template_to_jinja
+
+__all__ = [
+    "TemplateCache",
+    "TemplateType",
+    "apply_tokenizer_template",
+    "build_chat_prompt",
+    "build_completion_prompt",
+    "build_edit_prompt",
+    "go_template_to_jinja",
+    "multimodal_placeholders",
+]

@@ -1,0 +1,94 @@
+"""Template cache keyed by (type, name).
+
+Parity: /root/reference/pkg/templates/cache.go — a template name resolves to
+``<name>.tmpl`` (or ``.jinja``/``.j2``) in the templates dir; if no such file
+exists the name string ITSELF is the template (gallery configs embed template
+bodies inline, cache.go:85-94). Go-template sources are transpiled to Jinja2
+on load (see gotmpl.py); path traversal outside the templates dir is rejected
+(cache.go:81-83).
+"""
+
+from __future__ import annotations
+
+import enum
+import threading
+from pathlib import Path
+from typing import Any, Optional
+
+import jinja2
+
+from localai_tpu.templates.gotmpl import (
+    go_template_to_jinja,
+    looks_like_go_template,
+    make_environment,
+)
+from localai_tpu.utils.paths import verify_path
+
+
+class TemplateType(enum.Enum):
+    """Parity: the TemplateType enum (/root/reference/pkg/model/template.go:
+    34-40) + multimodal (pkg/templates/multimodal.go)."""
+
+    CHAT = "chat"
+    CHAT_MESSAGE = "chat_message"
+    COMPLETION = "completion"
+    EDIT = "edit"
+    FUNCTIONS = "functions"
+    MULTIMODAL = "multimodal"
+
+
+class TemplateCache:
+    def __init__(self, templates_path: str | Path):
+        self.templates_path = Path(templates_path)
+        self._env = make_environment()
+        self._cache: dict[tuple[TemplateType, str], jinja2.Template] = {}
+        self._lock = threading.Lock()
+
+    @staticmethod
+    def _could_be_filename(name: str) -> bool:
+        return "\n" not in name and "{{" not in name and len(name) < 200
+
+    def _load(self, name: str) -> jinja2.Template:
+        src: Optional[str] = None
+        if self._could_be_filename(name):
+            for suffix in (".tmpl", ".jinja", ".j2"):
+                fname = name + suffix
+                cand = self.templates_path / fname
+                try:
+                    found = cand.exists()
+                except OSError:
+                    found = False
+                if found:
+                    verify_path(fname, self.templates_path)
+                    src = cand.read_text()
+                    break
+        if src is None:
+            src = name  # inline template body (cache.go:92-93)
+        if looks_like_go_template(src):
+            src = go_template_to_jinja(src)
+        return self._env.from_string(src)
+
+    def evaluate(
+        self, ttype: TemplateType, name: str, data: dict[str, Any]
+    ) -> str:
+        if not name:
+            return ""
+        key = (ttype, name)
+        with self._lock:
+            tmpl = self._cache.get(key)
+            if tmpl is None:
+                tmpl = self._load(name)
+                self._cache[key] = tmpl
+        # _data/_it support bare {{.}} refs from transpiled Go templates
+        return tmpl.render(**data, _data=data)
+
+    def exists_file(self, name: str) -> bool:
+        if not self._could_be_filename(name):
+            return False
+        try:
+            return any(
+                (self.templates_path / (name + s)).exists()
+                for s in (".tmpl", ".jinja", ".j2")
+            )
+        except OSError:
+            return False

@@ -1,0 +1,214 @@
+"""Template subsystem tests. The llama3/chatML Go templates and expected
+outputs mirror the reference's own template tests
+(/root/reference/pkg/model/template_test.go) — byte-for-byte parity."""
+
+import pytest
+
+from localai_tpu.config.model_config import ModelConfig
+from localai_tpu.templates import (
+    TemplateCache,
+    TemplateType,
+    build_chat_prompt,
+    build_completion_prompt,
+    build_edit_prompt,
+    go_template_to_jinja,
+    multimodal_placeholders,
+)
+
+LLAMA3 = """<|start_header_id|>{{if eq .RoleName "assistant"}}assistant{{else if eq .RoleName "system"}}system{{else if eq .RoleName "tool"}}tool{{else if eq .RoleName "user"}}user{{end}}<|end_header_id|>
+
+{{ if .FunctionCall -}}
+Function call:
+{{ else if eq .RoleName "tool" -}}
+Function response:
+{{ end -}}
+{{ if .Content -}}
+{{.Content -}}
+{{ else if .FunctionCall -}}
+{{ toJson .FunctionCall -}}
+{{ end -}}
+<|eot_id|>"""
+
+CHATML = """<|im_start|>{{if eq .RoleName "assistant"}}assistant{{else if eq .RoleName "system"}}system{{else if eq .RoleName "tool"}}tool{{else if eq .RoleName "user"}}user{{end}}
+{{- if .FunctionCall }}
+<tool_call>
+{{- else if eq .RoleName "tool" }}
+<tool_response>
+{{- end }}
+{{- if .Content}}
+{{.Content }}
+{{- end }}
+{{- if .FunctionCall}}
+{{toJson .FunctionCall}}
+{{- end }}
+{{- if .FunctionCall }}
+</tool_call>
+{{- else if eq .RoleName "tool" }}
+</tool_response>
+{{- end }}<|im_end|>"""
+
+
+@pytest.fixture()
+def cache(tmp_path):
+    return TemplateCache(tmp_path)
+
+
+def _eval_msg(cache, tmpl, **data):
+    base = {
+        "SystemPrompt": "", "Role": "", "RoleName": "", "FunctionName": "",
+        "Content": "", "MessageIndex": 0, "Function": False,
+        "FunctionCall": None, "LastMessage": False,
+    }
+    base.update(data)
+    return cache.evaluate(TemplateType.CHAT_MESSAGE, tmpl, base)
+
+
+# -- parity cases from /root/reference/pkg/model/template_test.go ----------
+
+def test_llama3_user(cache):
+    out = _eval_msg(cache, LLAMA3, RoleName="user", Role="user",
+                    Content="A long time ago in a galaxy far, far away...")
+    assert out == ("<|start_header_id|>user<|end_header_id|>\n\n"
+                   "A long time ago in a galaxy far, far away...<|eot_id|>")
+
+
+def test_llama3_function_call(cache):
+    out = _eval_msg(cache, LLAMA3, RoleName="assistant", Role="assistant",
+                    FunctionCall={"function": "test"})
+    assert out == ("<|start_header_id|>assistant<|end_header_id|>\n\n"
+                   "Function call:\n{\"function\":\"test\"}<|eot_id|>")
+
+
+def test_llama3_function_response(cache):
+    out = _eval_msg(cache, LLAMA3, RoleName="tool", Role="tool",
+                    Content="Response from tool")
+    assert out == ("<|start_header_id|>tool<|end_header_id|>\n\n"
+                   "Function response:\nResponse from tool<|eot_id|>")
+
+
+def test_chatml_user(cache):
+    out = _eval_msg(cache, CHATML, RoleName="user", Role="user",
+                    Content="A long time ago in a galaxy far, far away...")
+    assert out == ("<|im_start|>user\n"
+                   "A long time ago in a galaxy far, far away...<|im_end|>")
+
+
+def test_chatml_function_call(cache):
+    out = _eval_msg(cache, CHATML, RoleName="assistant", Role="assistant",
+                    FunctionCall={"function": "test"})
+    assert out == ("<|im_start|>assistant\n<tool_call>\n"
+                   "{\"function\":\"test\"}\n</tool_call><|im_end|>")
+
+
+def test_chatml_function_response(cache):
+    out = _eval_msg(cache, CHATML, RoleName="tool", Role="tool",
+                    Content="Response from tool")
+    assert out == ("<|im_start|>tool\n<tool_response>\n"
+                   "Response from tool\n</tool_response><|im_end|>")
+
+
+# -- file templates, inline templates, traversal guard ---------------------
+
+def test_file_template_loads(cache, tmp_path):
+    (tmp_path / "completion.tmpl").write_text("### Prompt:\n{{.Input}}\n### Response:")
+    out = cache.evaluate(TemplateType.COMPLETION, "completion",
+                         {"Input": "hello"})
+    assert out == "### Prompt:\nhello\n### Response:"
+
+
+def test_inline_template_used_when_no_file(cache):
+    out = cache.evaluate(TemplateType.COMPLETION, "PRE {{.Input}} POST",
+                         {"Input": "x"})
+    assert out == "PRE x POST"
+
+
+def test_jinja_template_passthrough(cache, tmp_path):
+    (tmp_path / "j.jinja").write_text("A {{ Input }} B")
+    assert cache.evaluate(TemplateType.COMPLETION, "j", {"Input": "y"}) == "A y B"
+
+
+def test_traversal_rejected(tmp_path):
+    nested = tmp_path / "tpl"
+    nested.mkdir()
+    outside = tmp_path / "evil.tmpl"
+    outside.write_text("{{.Input}}")
+    cache = TemplateCache(nested)
+    # a name resolving to a file OUTSIDE the templates dir is refused
+    # (parity: cache.go:81-83 VerifyPath error)
+    with pytest.raises(ValueError, match="escapes"):
+        cache.evaluate(TemplateType.COMPLETION, "../evil", {"Input": "x"})
+
+
+# -- chat prompt construction (chat.go loop parity) ------------------------
+
+def test_build_chat_prompt_with_message_template():
+    cfg = ModelConfig(name="m")
+    cfg.template.chat_message = CHATML
+    cfg.template.chat = "{{.Input}}\n<|im_start|>assistant\n"
+    cache = TemplateCache("/nonexistent")
+    out = build_chat_prompt(cache, cfg, [
+        {"role": "system", "content": "You are helpful."},
+        {"role": "user", "content": "Hi!"},
+    ])
+    assert out == ("<|im_start|>system\nYou are helpful.<|im_end|>\n"
+                   "<|im_start|>user\nHi!<|im_end|>\n"
+                   "<|im_start|>assistant\n")
+
+
+def test_build_chat_prompt_role_fallback():
+    cfg = ModelConfig(name="m", roles={"user": "USER: ", "assistant": "ASSISTANT: "})
+    cache = TemplateCache("/nonexistent")
+    out = build_chat_prompt(cache, cfg, [
+        {"role": "user", "content": "question"},
+        {"role": "assistant", "content": "answer"},
+    ])
+    assert out == "USER: question\nASSISTANT: answer"
+
+
+def test_build_chat_prompt_tool_calls_marshalled():
+    cfg = ModelConfig(name="m")
+    cache = TemplateCache("/nonexistent")
+    out = build_chat_prompt(cache, cfg, [
+        {"role": "assistant",
+         "tool_calls": [{"id": "1", "function": {"name": "f", "arguments": "{}"}}]},
+    ])
+    assert out == '[{"id":"1","function":{"name":"f","arguments":"{}"}}]'
+
+
+def test_multipart_content_flattened():
+    cfg = ModelConfig(name="m")
+    cache = TemplateCache("/nonexistent")
+    out = build_chat_prompt(cache, cfg, [
+        {"role": "user", "content": [
+            {"type": "text", "text": "look at "},
+            {"type": "image_url", "image_url": {"url": "http://x/i.png"}},
+            {"type": "text", "text": "this"},
+        ]},
+    ])
+    assert out == "look at this"
+
+
+def test_completion_and_edit_prompts():
+    cfg = ModelConfig(name="m")
+    cfg.template.completion = "C:{{.Input}}"
+    cfg.template.edit = "E:{{.Instruction}}|{{.Input}}"
+    cache = TemplateCache("/nonexistent")
+    assert build_completion_prompt(cache, cfg, "in") == "C:in"
+    assert build_edit_prompt(cache, cfg, "text", "fix it") == "E:fix it|text"
+
+
+def test_multimodal_placeholders():
+    out = multimodal_placeholders("", "describe", n_images=2)
+    assert out == "[img-0][img-1]describe"
+    out = multimodal_placeholders(
+        "{{ range .Images }}<image>{{end}}{{.Text}}", "hi", n_images=1
+    )
+    assert out == "<image>hi"
+
+
+def test_gotmpl_range_and_nested():
+    j = go_template_to_jinja("{{ range .Items }}[{{.Name}}]{{ end }}")
+    assert "for _it in Items" in j
+    from localai_tpu.templates.gotmpl import make_environment
+    env = make_environment()
+    assert env.from_string(j).render(Items=[{"Name": "a"}, {"Name": "b"}]) == "[a][b]"
