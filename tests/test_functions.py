@@ -1,0 +1,440 @@
+"""Function-calling pipeline tests: regex FSM, JSON-schema compiler, token
+constraints, tools→grammar, and output parsing.
+
+Modeled on the reference's pkg/functions test coverage
+(/root/reference/pkg/functions/parse_test.go,
+grammars/json_schema_test.go) — same behaviors, asserted against the FSM
+pipeline instead of BNF text.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from localai_tpu.config.model_config import FunctionsConfig
+from localai_tpu.functions import (
+    FSMConstraint,
+    build_tool_constraint,
+    build_tool_regex,
+    compile_dfa,
+    constraint_for_regex,
+    constraint_for_schema,
+    inject_no_action,
+    normalize_tools,
+    parse_function_call,
+    parse_json_objects,
+    parse_text_content,
+    cleanup_llm_result,
+    schema_to_regex,
+    select_function,
+)
+from localai_tpu.utils.tokenizer import ByteTokenizer
+
+
+# ---------------------------------------------------------------------------
+# fsm
+
+
+@pytest.mark.parametrize("pattern,text,expect", [
+    (r"abc", "abc", True),
+    (r"abc", "abx", False),
+    (r"a(b|c)*d", "abcbcd", True),
+    (r"a(b|c)*d", "ad", True),
+    (r"[0-9]{2,4}", "123", True),
+    (r"[0-9]{2,4}", "1", False),
+    (r"[0-9]{2,4}", "12345", False),
+    (r"[^abc]+", "xyz", True),
+    (r"[^abc]+", "xaz", False),
+    (r"\{\}", "{}", True),
+    (r".*", "anything at all", True),
+])
+def test_dfa_matches(pattern, text, expect):
+    assert compile_dfa(pattern).matches(text) is expect
+
+
+def test_dfa_dead_state_pruning():
+    d = compile_dfa(r"ab")
+    s = d.step_bytes(d.start, b"ax")
+    assert s == d.DEAD
+    s = d.step_bytes(d.start, b"ab")
+    assert d.accept[s]
+    assert d.forced_end(s)
+
+
+# ---------------------------------------------------------------------------
+# jsonschema
+
+
+def _matches(schema, text, **kw):
+    return compile_dfa(schema_to_regex(schema, **kw)).matches(text)
+
+
+def test_schema_object_round_trip():
+    schema = {
+        "type": "object",
+        "properties": {
+            "name": {"type": "string"},
+            "n": {"type": "integer"},
+            "ok": {"type": "boolean"},
+        },
+    }
+    assert _matches(schema, '{"name":"x","n":3,"ok":true}')
+    assert _matches(schema, '{ "name" : "x" , "n" : -1 , "ok" : false }')
+    assert not _matches(schema, '{"n":3,"name":"x","ok":true}')  # order fixed
+    assert not _matches(schema, '{"name":"x"}')  # all-required default
+
+
+def test_schema_optional_properties():
+    schema = {
+        "type": "object",
+        "properties": {"a": {"type": "integer"}, "b": {"type": "string"}},
+        "required": ["a"],
+    }
+    assert _matches(schema, '{"a":1}')
+    assert _matches(schema, '{"a":1,"b":"x"}')
+    assert not _matches(schema, '{"b":"x"}')
+
+
+def test_schema_enum_const_refs():
+    schema = {
+        "type": "object",
+        "properties": {
+            "unit": {"enum": ["celsius", "fahrenheit"]},
+            "p": {"$ref": "#/$defs/point"},
+        },
+        "$defs": {"point": {"type": "number"}},
+    }
+    assert _matches(schema, '{"unit":"celsius","p":1.5}')
+    assert not _matches(schema, '{"unit":"kelvin","p":1.5}')
+
+
+def test_schema_arrays_and_nested():
+    schema = {
+        "type": "array",
+        "items": {
+            "type": "object",
+            "properties": {"x": {"type": "integer"}},
+        },
+        "minItems": 1,
+    }
+    assert _matches(schema, '[{"x":1},{"x":2}]')
+    assert not _matches(schema, "[]")
+
+
+def test_schema_free_form_depth():
+    assert _matches({}, '{"a":{"b":[1,"x",null]}}')
+    assert _matches({}, "[1,2,3]")
+    assert _matches({}, "true")
+
+
+def test_schema_recursive_ref_rejected():
+    schema = {"$ref": "#/$defs/n",
+              "$defs": {"n": {"type": "object",
+                              "properties": {"next": {"$ref": "#/$defs/n"}}}}}
+    with pytest.raises(ValueError):
+        schema_to_regex(schema)
+
+
+# ---------------------------------------------------------------------------
+# constraint: masked greedy decode stays inside the grammar
+
+
+def _constrained_greedy(constraint: FSMConstraint, tok: ByteTokenizer,
+                        prefer: str, limit: int = 200) -> str:
+    """Greedy walk: at each step pick the preferred next byte if allowed,
+    else the lowest allowed token — must always yield a grammar match."""
+    out = []
+    want = prefer.encode()
+    i = 0
+    while len(out) < limit and not constraint.done:
+        mask = constraint.allowed_mask()
+        if mask is None:
+            break
+        allowed = np.nonzero(mask == 0.0)[0]
+        assert allowed.size, "grammar wedged with nothing allowed"
+        if i < len(want) and mask[want[i]] == 0.0:
+            t = int(want[i])
+            i += 1
+        else:
+            non_eos = [a for a in allowed if a not in tok.eos_ids]
+            if not non_eos:
+                break
+            t = int(non_eos[0])
+        if t in tok.eos_ids:
+            break
+        out.append(t)
+        constraint.advance(t)
+    return tok.decode(out)
+
+
+def test_constraint_forces_valid_json():
+    tok = ByteTokenizer()
+    schema = {
+        "type": "object",
+        "properties": {"name": {"const": "get_weather"},
+                       "arguments": {
+                           "type": "object",
+                           "properties": {"city": {"type": "string"}},
+                       }},
+    }
+    c = constraint_for_schema(schema, tok)
+    text = _constrained_greedy(
+        c, tok, '{"name":"get_weather","arguments":{"city":"Kyiv"}}'
+    )
+    obj = json.loads(text)
+    assert obj["name"] == "get_weather"
+    assert obj["arguments"]["city"] == "Kyiv"
+
+
+def test_constraint_rejects_offgrammar_bytes():
+    tok = ByteTokenizer()
+    c = constraint_for_regex(r"(yes|no)", tok)
+    mask = c.allowed_mask()
+    assert mask[ord("y")] == 0.0
+    assert mask[ord("n")] == 0.0
+    assert mask[ord("x")] < -1e29
+    c.advance(ord("y"))
+    mask = c.allowed_mask()
+    assert mask[ord("e")] == 0.0
+    assert mask[ord("o")] < -1e29
+    c.advance(ord("e"))
+    c.advance(ord("s"))
+    assert c.done  # forced end: no continuation
+
+
+def test_constraint_eos_only_at_accept():
+    tok = ByteTokenizer()
+    c = constraint_for_regex(r"ab?", tok)
+    assert c.allowed_mask()[tok.EOS] < -1e29  # not accepting yet
+    c.advance(ord("a"))
+    mask = c.allowed_mask()
+    assert mask[tok.EOS] == 0.0  # "a" is a full match
+    assert mask[ord("b")] == 0.0  # but may continue
+    c.advance(tok.EOS)
+    assert c.done
+
+
+def test_constraint_mask_cache_reused():
+    tok = ByteTokenizer()
+    c = constraint_for_regex(r"[ab]*", tok)
+    c.advance(ord("a"))
+    m1 = c.allowed_mask()
+    c.advance(ord("b"))
+    m2 = c.allowed_mask()
+    assert m1 is m2  # self-loop state → identical cached row
+
+
+# ---------------------------------------------------------------------------
+# tools → grammar
+
+
+WEATHER = {
+    "name": "get_weather",
+    "parameters": {
+        "type": "object",
+        "properties": {"city": {"type": "string"}},
+        "required": ["city"],
+    },
+}
+
+
+def test_normalize_and_inject():
+    tools = [{"type": "function", "function": WEATHER}]
+    fns = normalize_tools(tools)
+    assert fns[0]["name"] == "get_weather"
+    cfg = FunctionsConfig()
+    with_na = inject_no_action(fns, cfg)
+    assert with_na[-1]["name"] == "answer"
+    cfg2 = FunctionsConfig(disable_no_action=True)
+    assert inject_no_action(fns, cfg2) == fns
+    assert select_function(with_na, "get_weather") == [WEATHER]
+
+
+def test_tool_regex_single_call():
+    built = build_tool_regex([WEATHER], FunctionsConfig())
+    d = compile_dfa(built.pattern)
+    assert d.matches('{"name":"get_weather","arguments":{"city":"Oslo"}}')
+    assert not d.matches('{"name":"nope","arguments":{"city":"Oslo"}}')
+
+
+def test_tool_regex_parallel_and_mixed():
+    cfg = FunctionsConfig(grammar={"parallel_calls": True, "mixed_mode": True})
+    built = build_tool_regex([WEATHER], cfg)
+    d = compile_dfa(built.pattern)
+    one = '{"name":"get_weather","arguments":{"city":"Oslo"}}'
+    assert d.matches(one)
+    assert d.matches(f"[{one},\n{one}]")
+    assert d.matches("plain text answer")  # mixed mode
+
+
+def test_tool_regex_prefix_and_name_key():
+    cfg = FunctionsConfig(
+        function_name_key="function",
+        grammar={"prefix": "TOOL: "},
+    )
+    built = build_tool_regex([WEATHER], cfg)
+    d = compile_dfa(built.pattern)
+    assert d.matches('TOOL: {"function":"get_weather","arguments":{"city":"x"}}')
+    assert not d.matches('{"function":"get_weather","arguments":{"city":"x"}}')
+
+
+def test_tool_regex_llama31():
+    cfg = FunctionsConfig(grammar={"schema_type": "llama3.1"})
+    built = build_tool_regex([WEATHER], cfg)
+    d = compile_dfa(built.pattern)
+    assert d.matches('<function=get_weather>{"city":"Rome"}</function>')
+    assert not d.matches('{"name":"get_weather","arguments":{"city":"Rome"}}')
+
+
+def test_tool_constraint_end_to_end():
+    tok = ByteTokenizer()
+    cfg = FunctionsConfig(disable_no_action=True)
+    constraint, built = build_tool_constraint([WEATHER], cfg, tok)
+    text = _constrained_greedy(
+        constraint, tok,
+        '{"name":"get_weather","arguments":{"city":"Paris"}}',
+    )
+    calls = parse_function_call(text, cfg)
+    assert calls and calls[0].name == "get_weather"
+    assert json.loads(calls[0].arguments) == {"city": "Paris"}
+
+
+def test_tool_constraint_disabled_grammar():
+    tok = ByteTokenizer()
+    cfg = FunctionsConfig(grammar={"disable": True})
+    constraint, built = build_tool_constraint([WEATHER], cfg, tok)
+    assert constraint is None
+    assert built.pattern
+
+
+# ---------------------------------------------------------------------------
+# parse (reference parse_test.go behaviors)
+
+
+def test_parse_single_call():
+    cfg = FunctionsConfig()
+    res = parse_function_call(
+        '{"name":"add","arguments":{"x":1,"y":2}}', cfg
+    )
+    assert len(res) == 1
+    assert res[0].name == "add"
+    assert json.loads(res[0].arguments) == {"x": 1, "y": 2}
+
+
+def test_parse_multiple_and_garbage():
+    cfg = FunctionsConfig()
+    res = parse_function_call(
+        'noise {"name":"a","arguments":{}} mid {"name":"b","arguments":{"k":1}}',
+        cfg,
+    )
+    assert [r.name for r in res] == ["a", "b"]
+
+
+def test_parse_top_level_array():
+    cfg = FunctionsConfig()
+    res = parse_function_call(
+        '[{"name":"a","arguments":{}},{"name":"b","arguments":{}}]', cfg
+    )
+    assert [r.name for r in res] == ["a", "b"]
+
+
+def test_parse_custom_keys():
+    cfg = FunctionsConfig(function_name_key="function",
+                          function_arguments_key="args")
+    res = parse_function_call('{"function":"f","args":{"q":"z"}}', cfg)
+    assert res[0].name == "f"
+    assert json.loads(res[0].arguments) == {"q": "z"}
+
+
+def test_parse_json_regex_match():
+    cfg = FunctionsConfig(
+        json_regex_match=[r"```json\n?(.*?)```"],
+    )
+    res = parse_function_call(
+        'prose ```json\n{"name":"f","arguments":{}}``` more', cfg
+    )
+    assert res[0].name == "f"
+
+
+def test_parse_response_regex():
+    cfg = FunctionsConfig(
+        response_regex=[r"call=(?P<name>\w+) args=(?P<arguments>\{.*\})"],
+    )
+    res = parse_function_call('call=go args={"a":1}', cfg)
+    assert res[0].name == "go"
+    assert json.loads(res[0].arguments) == {"a": 1}
+
+
+def test_parse_llama31_tags():
+    cfg = FunctionsConfig()
+    res = parse_function_call(
+        '<function=get_weather>{"city":"Rome"}</function>', cfg
+    )
+    assert res[0].name == "get_weather"
+    assert json.loads(res[0].arguments) == {"city": "Rome"}
+
+
+def test_parse_replacements_and_capture():
+    cfg = FunctionsConfig(
+        replace_function_results=[{"key": r"'", "value": '"'}],
+        replace_llm_results=[{"key": r"<think>.*?</think>", "value": ""}],
+        capture_llm_results=[r"<answer>(.*?)</answer>"],
+    )
+    # single quotes replaced by the regex before JSON decode
+    res = parse_function_call("{'name':'f','arguments':{}}", cfg)
+    assert res and res[0].name == "f"
+    assert cleanup_llm_result("<think>hmm</think>ok", cfg) == "ok"
+    assert parse_text_content("<answer>42</answer>", cfg) == "42"
+    assert parse_text_content("nothing here", cfg) == ""
+
+
+def test_parse_json_objects_tolerant():
+    objs = parse_json_objects('{"a":1} x {"b":2} [{"c":3}]')
+    assert objs == [{"a": 1}, {"b": 2}, {"c": 3}]
+    assert parse_json_objects("no json") == []
+    assert parse_json_objects('{"broken": ') == []
+
+
+def test_review_fixes_regression():
+    """Fixes from review: pattern grouping, $defs merge, allOf siblings,
+    response_regex None args, empty replacement keys, DFA cache."""
+    # string pattern with top-level alternation must stay contained
+    schema = {"type": "object",
+              "properties": {"s": {"type": "string", "pattern": "yes|no"}}}
+    d = compile_dfa(schema_to_regex(schema))
+    assert d.matches('{"s":"yes"}')
+    assert not d.matches('{"s":"yes')
+    # $defs from EVERY tool are available
+    t1 = {"name": "t1", "parameters": {
+        "type": "object", "properties": {"a": {"$ref": "#/$defs/d1"}},
+        "$defs": {"d1": {"type": "integer"}}}}
+    t2 = {"name": "t2", "parameters": {
+        "type": "object", "properties": {"b": {"$ref": "#/$defs/d2"}},
+        "$defs": {"d2": {"type": "boolean"}}}}
+    built = build_tool_regex([t1, t2], FunctionsConfig(disable_no_action=True))
+    d = compile_dfa(built.pattern)
+    assert d.matches('{"name":"t2","arguments":{"b":true}}')
+    # allOf merges with sibling keys instead of being overwritten
+    schema = {"allOf": [{"type": "object",
+                         "properties": {"a": {"type": "integer"}}}],
+              "properties": {"b": {"type": "string"}}}
+    d = compile_dfa(schema_to_regex(schema))
+    assert d.matches('{"a":1,"b":"x"}')
+    assert not d.matches('{"b":"x"}')
+    # optional named group yields "" not None
+    cfg = FunctionsConfig(
+        response_regex=[r"call=(?P<name>\w+)( args=(?P<arguments>\{.*\}))?"])
+    res = parse_function_call("call=go", cfg)
+    assert res[0].arguments == ""
+    # malformed replacement entries are skipped
+    cfg = FunctionsConfig(replace_llm_results=[{"value": "X"}])
+    assert cleanup_llm_result("ab", cfg) == "ab"
+    # DFA cache: same pattern → same object and shared mask rows
+    from localai_tpu.functions.constraint import cached_dfa
+    assert cached_dfa(r"[ab]+") is cached_dfa(r"[ab]+")
+    tok = ByteTokenizer()
+    c1 = constraint_for_regex(r"xy?z", tok)
+    m1 = c1.allowed_mask()
+    c2 = constraint_for_regex(r"xy?z", tok)
+    assert c2.allowed_mask() is m1

@@ -186,3 +186,32 @@ def test_constraint_mask_cleared_when_none(sched):
     assert h.token_ids[:2] == [66, 66]
     # after the mask clears, greedy decode must be able to leave token 66
     assert any(t != 66 for t in h.token_ids[2:])
+
+
+def test_constrained_generation_valid_json(sched):
+    """End-to-end grammar constraint through the live engine: the tiny
+    random-weight model MUST emit schema-valid JSON when masked."""
+    import json
+
+    from localai_tpu.functions import constraint_for_schema
+
+    schema = {
+        "type": "object",
+        "properties": {
+            "name": {"const": "answer"},
+            "arguments": {
+                "type": "object",
+                "properties": {"message": {"type": "string",
+                                           "maxLength": 12}},
+            },
+        },
+    }
+    c = constraint_for_schema(schema, ByteTokenizer())
+    h = sched.generate(
+        _req("call a tool", max_new_tokens=120, temperature=0.8, seed=7,
+             constraint=c),
+        timeout=120,
+    )
+    obj = json.loads(h.text)
+    assert obj["name"] == "answer"
+    assert "message" in obj["arguments"]
