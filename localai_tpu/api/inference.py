@@ -1,0 +1,226 @@
+"""Request → engine orchestration shared by the OpenAI endpoints.
+
+Parity targets:
+  * mergeRequestWithConfig — request overrides per-model YAML defaults
+    (/root/reference/core/http/endpoints/openai/request.go:298,51)
+  * ComputeChoices — n-choice fan-out (inference.go:11)
+  * ModelInference + Finetune post-processing — echo / cutstrings /
+    extract_regex / trimspace / trimsuffix (core/backend/llm.go:34-216)
+  * tool-grammar wiring (chat.go:268-271) via localai_tpu.functions.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import re
+from typing import Any, Optional
+
+from localai_tpu.api.schema import OpenAIRequest
+from localai_tpu.config.model_config import ModelConfig
+from localai_tpu.engine.scheduler import GenHandle, GenRequest
+from localai_tpu.models.manager import ServingModel
+
+log = logging.getLogger(__name__)
+
+
+def merge_request(mcfg: ModelConfig, req: OpenAIRequest) -> ModelConfig:
+    """Effective config: per-model YAML defaults overridden by request
+    fields that were explicitly provided."""
+    cfg = mcfg.model_copy(deep=True)
+    p = cfg.parameters
+    for field in ("temperature", "top_p", "top_k", "min_p", "max_tokens",
+                  "seed", "presence_penalty", "frequency_penalty",
+                  "repeat_penalty"):
+        val = getattr(req, field)
+        if val is not None:
+            setattr(p, field, val)
+    return cfg
+
+
+def build_gen_request(
+    sm: ServingModel,
+    cfg: ModelConfig,
+    req: OpenAIRequest,
+    prompt: str,
+    *,
+    constraint: Any = None,
+    seed_offset: int = 0,
+) -> GenRequest:
+    p = cfg.parameters
+    tokens = sm.tokenizer.encode(prompt, add_bos=True)
+    logit_bias = None
+    if req.logit_bias:
+        logit_bias = {}
+        for k, v in req.logit_bias.items():
+            try:
+                logit_bias[int(k)] = float(v)
+            except (TypeError, ValueError):
+                continue
+    seed = p.seed
+    if seed is not None and seed_offset:
+        seed = seed + seed_offset
+    return GenRequest(
+        prompt=tokens,
+        max_new_tokens=p.max_tokens or 2048,
+        temperature=p.temperature,
+        top_k=p.top_k,
+        top_p=p.top_p,
+        min_p=p.min_p,
+        repeat_penalty=p.repeat_penalty,
+        presence_penalty=p.presence_penalty,
+        frequency_penalty=p.frequency_penalty,
+        seed=seed,
+        logit_bias=logit_bias,
+        stop=tuple(cfg.stopwords) + tuple(req.stop_list()),
+        ignore_eos=req.ignore_eos,
+        constraint=constraint,
+        correlation_id=req.user or "",
+    )
+
+
+def finetune_result(cfg: ModelConfig, prompt: str, text: str,
+                    *, echo: bool = False) -> str:
+    """Post-inference text shaping (parity: Finetune, llm.go:168-216)."""
+    if echo:
+        text = prompt + text
+    for c in cfg.cutstrings:
+        text = re.sub(c, "", text)
+    for ex in cfg.extract_regex:
+        m = re.search(ex, text)
+        if m:
+            text = m.group(1) if m.groups() else m.group(0)
+            break
+    for t in cfg.trimspace:
+        text = text.strip()
+        break
+    for suf in cfg.trimsuffix:
+        text = text.removesuffix(suf)
+    return text
+
+
+@dataclasses.dataclass
+class ToolContext:
+    """What the chat endpoint needs to post-process a tools response."""
+
+    functions: list[dict]
+    config_fn: Any  # FunctionsConfig
+    no_action_name: str
+    constraint: Any = None
+
+
+def prepare_tools(
+    sm: ServingModel, cfg: ModelConfig, req: OpenAIRequest
+) -> Optional[ToolContext]:
+    """Normalize tools, apply tool_choice, build the FSM constraint.
+    Returns None when the request carries no usable tools or disables them
+    (parity: chat.go tool gating + grammar build, chat.go:222-280)."""
+    if req.tools_disabled():
+        return None
+    functions = req.tool_definitions()
+    if not functions:
+        return None
+    from localai_tpu import functions as fx
+
+    fn_cfg = cfg.function
+    funcs = fx.inject_no_action(functions, fn_cfg)
+    choice = req.tool_choice_name()
+    if choice:
+        funcs = fx.select_function(funcs, choice)
+    constraint = None
+    try:
+        constraint, _built = fx.build_tool_constraint(
+            funcs, fn_cfg, sm.tokenizer
+        )
+    except Exception as e:  # noqa: BLE001 — bad schema ≠ failed request
+        log.warning("tool grammar build failed (%s); decoding unconstrained", e)
+    return ToolContext(
+        functions=funcs,
+        config_fn=fn_cfg,
+        no_action_name=fn_cfg.no_action_function_name or "answer",
+        constraint=constraint,
+    )
+
+
+def response_format_constraint(
+    sm: ServingModel, req: OpenAIRequest
+) -> Optional[Any]:
+    """response_format json_object/json_schema → decoding constraint
+    (parity: chat.go JSON-mode via JSONBNF; json_schema is the modern
+    OpenAI structured-output shape)."""
+    rf = req.response_format
+    if rf is None:
+        return None
+    if isinstance(rf, str):
+        kind = rf
+        payload: dict[str, Any] = {}
+    else:
+        kind = str(rf.get("type", ""))
+        payload = rf
+    from localai_tpu import functions as fx
+
+    if kind == "json_object":
+        return fx.constraint_for_regex(fx.JSON_OBJECT_REGEX, sm.tokenizer)
+    if kind == "json_schema":
+        schema = (payload.get("json_schema") or {}).get("schema")
+        if schema:
+            return fx.constraint_for_schema(schema, sm.tokenizer)
+    return None
+
+
+def parse_tool_calls(text: str, tctx: ToolContext) -> tuple[str, list[dict]]:
+    """LLM output → (content, OpenAI tool_calls). The no-action function's
+    message becomes plain content (parity: chat.go:107-154 + parse.go)."""
+    from localai_tpu import functions as fx
+    from localai_tpu.api.schema import new_id
+
+    cleaned = fx.cleanup_llm_result(text, tctx.config_fn)
+    calls = fx.parse_function_call(cleaned, tctx.config_fn)
+    content = ""
+    tool_calls: list[dict] = []
+    for call in calls:
+        if call.name == tctx.no_action_name:
+            import json as _json
+
+            try:
+                args = _json.loads(call.arguments or "{}")
+                content = str(args.get("message", "")) or cleaned
+            except Exception:  # noqa: BLE001
+                content = cleaned
+            continue
+        tool_calls.append({
+            "id": new_id("call"),
+            "index": len(tool_calls),
+            "type": "function",
+            "function": {"name": call.name, "arguments": call.arguments},
+        })
+    if not calls:
+        content = fx.parse_text_content(cleaned, tctx.config_fn) or cleaned
+    elif not content and not tool_calls:
+        content = cleaned
+    return content, tool_calls
+
+
+def run_choices(
+    sm: ServingModel,
+    cfg: ModelConfig,
+    req: OpenAIRequest,
+    prompt: str,
+    *,
+    constraint_factory=None,
+    timeout: float = 600.0,
+) -> list[GenHandle]:
+    """Submit n parallel generations and wait (parity: ComputeChoices loop,
+    inference.go:11 — but concurrent via the continuous-batching engine
+    rather than sequential)."""
+    n = max(1, req.n or 1)
+    handles = []
+    for i in range(n):
+        constraint = constraint_factory() if constraint_factory else None
+        gr = build_gen_request(
+            sm, cfg, req, prompt, constraint=constraint, seed_offset=i
+        )
+        handles.append(sm.scheduler.submit(gr))
+    for h in handles:
+        h.result(timeout)
+    return handles

@@ -1,0 +1,134 @@
+"""LocalAI-specific endpoints: tokenize, metrics, system info, backend
+monitor/shutdown, readiness.
+
+Parity: /root/reference/core/http/routes/localai.go:20-67 and
+core/http/endpoints/localai/ (tokenize, system, backend_monitor,
+welcome/health).
+"""
+
+from __future__ import annotations
+
+import logging
+
+from aiohttp import web
+
+from localai_tpu.api.metrics import REGISTRY
+from localai_tpu.version import __version__
+
+log = logging.getLogger(__name__)
+
+
+def _state(request: web.Request):
+    from localai_tpu.api.server import STATE_KEY
+
+    return request.app[STATE_KEY]
+
+
+async def healthz(_request: web.Request) -> web.Response:
+    return web.json_response({"status": "ok"})
+
+
+async def readyz(request: web.Request) -> web.Response:
+    """Ready = config loader up; per-model engines load lazily."""
+    state = _state(request)
+    return web.json_response({
+        "status": "ok",
+        "models_configured": len(state.loader.names()),
+        "models_loaded": state.manager.loaded_names(),
+    })
+
+
+async def version(_request: web.Request) -> web.Response:
+    return web.json_response({"version": __version__})
+
+
+async def tokenize(request: web.Request) -> web.Response:
+    """POST {model, content} → {tokens} (parity: TokenizeEndpoint,
+    core/http/endpoints/localai/tokenize.go + TokenizeString RPC)."""
+    from localai_tpu.api.openai import _serving
+    from localai_tpu.api.schema import OpenAIRequest
+
+    try:
+        body = await request.json()
+    except Exception:
+        raise web.HTTPBadRequest(text="invalid JSON body")
+    state = _state(request)
+    model = body.get("model") or (state.loader.names() or [""])[0]
+    if not model:
+        raise web.HTTPNotFound(text="no models configured")
+    content = body.get("content") or body.get("prompt") or ""
+    sm, _cfg = _serving(request, OpenAIRequest(model=model))
+    ids = sm.tokenizer.encode(str(content), add_bos=False)
+    return web.json_response({"tokens": ids})
+
+
+async def metrics(_request: web.Request) -> web.Response:
+    return web.Response(
+        text=REGISTRY.render(),
+        content_type="text/plain",
+        charset="utf-8",
+    )
+
+
+async def system(request: web.Request) -> web.Response:
+    """GET /system (parity: SystemInformations, routes/localai.go:64 —
+    CPU/GPU info becomes the JAX device inventory)."""
+    import jax
+
+    state = _state(request)
+    devices = [
+        {
+            "id": d.id,
+            "platform": d.platform,
+            "kind": getattr(d, "device_kind", ""),
+            "process_index": d.process_index,
+        }
+        for d in jax.devices()
+    ]
+    return web.json_response({
+        "version": __version__,
+        "devices": devices,
+        "backends": ["jax"],
+        "loaded_models": state.manager.loaded_names(),
+        "configured_models": state.loader.names(),
+    })
+
+
+async def backend_monitor(request: web.Request) -> web.Response:
+    """POST {model} → engine status (parity: BackendMonitorEndpoint,
+    core/http/endpoints/localai/backend_monitor.go)."""
+    body = await request.json()
+    name = body.get("model", "")
+    if not name:
+        raise web.HTTPBadRequest(text="missing 'model'")
+    return web.json_response(_state(request).manager.monitor(name))
+
+
+async def backend_shutdown(request: web.Request) -> web.Response:
+    body = await request.json()
+    name = body.get("model", "")
+    if not name:
+        raise web.HTTPBadRequest(text="missing 'model'")
+    ok = _state(request).manager.shutdown_model(name)
+    return web.json_response({"shutdown": ok, "model": name})
+
+
+async def engine_metrics(request: web.Request) -> web.Response:
+    """Per-model live slot metrics (parity: the GetMetrics RPC surface,
+    grpc-server.cpp:2434-2457, exposed over /backend/monitor)."""
+    return web.json_response(_state(request).manager.metrics())
+
+
+def routes() -> list[web.RouteDef]:
+    return [
+        web.get("/healthz", healthz),
+        web.get("/readyz", readyz),
+        web.get("/version", version),
+        web.get("/metrics", metrics),
+        web.get("/system", system),
+        web.post("/v1/tokenize", tokenize),
+        web.post("/tokenize", tokenize),
+        web.post("/backend/monitor", backend_monitor),
+        web.post("/backend/shutdown", backend_shutdown),
+        web.get("/backend/metrics", engine_metrics),
+    ]

@@ -1,0 +1,393 @@
+"""OpenAI-compatible endpoints: chat, completions, edits, embeddings,
+models, with SSE streaming and tool-call handling.
+
+Parity: /root/reference/core/http/endpoints/openai/
+(chat.go:27-608, completion.go, edit.go, embeddings.go, list.go,
+request.go readRequest/mergeRequestWithConfig).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Optional
+
+from aiohttp import web
+
+from localai_tpu.api import inference as inf
+from localai_tpu.api import schema as sc
+from localai_tpu.api.streams import (
+    SSE_DONE,
+    SSE_HEADERS,
+    aiter_handle,
+    sse_event,
+)
+from localai_tpu.config.model_config import Usecase
+from localai_tpu.templates.chat import (
+    build_chat_prompt,
+    build_completion_prompt,
+    build_edit_prompt,
+)
+
+log = logging.getLogger(__name__)
+
+
+def _state(request: web.Request):
+    from localai_tpu.api.server import STATE_KEY
+
+    return request.app[STATE_KEY]
+
+
+async def _read_request(request: web.Request) -> sc.OpenAIRequest:
+    """Body → OpenAIRequest with model-name fallback chain: body.model →
+    path param → first available config (parity: request.go:25 +
+    ctx/fiber.go:18-47)."""
+    try:
+        body = await request.json()
+    except Exception:
+        raise web.HTTPBadRequest(text="invalid JSON body")
+    req = sc.OpenAIRequest.model_validate(body)
+    if not req.model:
+        req.model = request.match_info.get("model", "")
+    if not req.model:
+        names = _state(request).loader.names()
+        if not names:
+            raise web.HTTPNotFound(
+                text="no models configured; install one first"
+            )
+        req.model = names[0]
+    return req
+
+
+def _serving(request: web.Request, req: sc.OpenAIRequest,
+             usecase: Optional[Usecase] = None):
+    state = _state(request)
+    mcfg = state.loader.get(req.model)
+    if mcfg is None:
+        raise web.HTTPNotFound(
+            text=f"model {req.model!r} not found; available: "
+                 f"{state.loader.names()}"
+        )
+    if usecase is not None and not mcfg.has_usecase(usecase):
+        raise web.HTTPBadRequest(
+            text=f"model {req.model!r} does not support {usecase.value}"
+        )
+    try:
+        return state.manager.get(req.model), mcfg
+    except FileNotFoundError as e:
+        raise web.HTTPInternalServerError(text=f"model load failed: {e}")
+
+
+async def _in_executor(request: web.Request, fn, *args):
+    import asyncio
+
+    return await asyncio.get_running_loop().run_in_executor(
+        _state(request).executor, fn, *args
+    )
+
+
+# ---------------------------------------------------------------------------
+# /v1/chat/completions
+
+
+async def chat(request: web.Request) -> web.StreamResponse:
+    req = await _read_request(request)
+    sm, base_cfg = _serving(request, req, Usecase.CHAT)
+    cfg = inf.merge_request(base_cfg, req)
+
+    tctx = await _in_executor(request, inf.prepare_tools, sm, cfg, req)
+    rf_constraint = None
+    if tctx is None:
+        rf_constraint = await _in_executor(
+            request, inf.response_format_constraint, sm, req
+        )
+
+    messages = [m.model_dump(exclude_none=True) for m in req.messages]
+    if cfg.template.use_tokenizer_template:
+        from localai_tpu.templates.chat import apply_tokenizer_template
+
+        prompt = apply_tokenizer_template(sm.tokenizer, messages)
+    else:
+        prompt = build_chat_prompt(
+            sm.templates, cfg, messages,
+            functions=tctx.functions if tctx else None,
+            use_function_template=tctx is not None,
+            grammar_active=tctx is not None and tctx.constraint is not None,
+        )
+    rid = sc.new_id("chatcmpl")
+
+    constraint = tctx.constraint if tctx else rf_constraint
+    gr = inf.build_gen_request(sm, cfg, req, prompt, constraint=constraint)
+
+    if req.stream:
+        return await _chat_stream(request, req, sm, cfg, gr, rid, tctx)
+
+    n = max(1, req.n or 1)
+    handles = []
+    for i in range(n):
+        if i > 0:
+            c = None
+            if tctx is not None:
+                c = (await _in_executor(
+                    request, inf.prepare_tools, sm, cfg, req)).constraint
+            elif rf_constraint is not None:
+                c = await _in_executor(
+                    request, inf.response_format_constraint, sm, req)
+            gr_i = inf.build_gen_request(
+                sm, cfg, req, prompt, constraint=c, seed_offset=i
+            )
+        else:
+            gr_i = gr
+        handles.append(sm.scheduler.submit(gr_i))
+    choices = []
+    total_completion = 0
+    prompt_tokens = 0
+    for i, h in enumerate(handles):
+        await _in_executor(request, h.result, 600.0)
+        text = inf.finetune_result(cfg, prompt, h.text)
+        prompt_tokens = h.prompt_tokens
+        total_completion += h.completion_tokens
+        message: dict[str, Any] = {"role": "assistant"}
+        finish = h.finish_reason or "stop"
+        if tctx is not None:
+            content, tool_calls = inf.parse_tool_calls(text, tctx)
+            message["content"] = content or None
+            if tool_calls:
+                message["tool_calls"] = tool_calls
+                finish = "tool_calls"
+        else:
+            message["content"] = text
+        choices.append({
+            "index": i,
+            "message": message,
+            "finish_reason": finish,
+        })
+    return web.json_response(sc.chat_response(
+        rid, req.model, choices, sc.usage(prompt_tokens, total_completion)
+    ))
+
+
+async def _chat_stream(request, req, sm, cfg, gr, rid, tctx
+                       ) -> web.StreamResponse:
+    """SSE streaming. Plain chat streams deltas as they decode; with tools
+    the text must be parsed whole, so deltas buffer and the final frames
+    carry tool_calls (parity: chat.go:107-154,463-508)."""
+    resp = web.StreamResponse(headers=SSE_HEADERS)
+    await resp.prepare(request)
+    await resp.write(sse_event(sc.chat_chunk(
+        rid, req.model, {"role": "assistant", "content": ""}
+    )))
+    handle = sm.scheduler.submit(gr)
+    buffered: list[str] = []
+    finish = "stop"
+    async for item in aiter_handle(handle):
+        if item.finish_reason is not None:
+            finish = item.finish_reason
+            break
+        if not item.delta:
+            continue
+        if tctx is not None:
+            buffered.append(item.delta)
+        else:
+            await resp.write(sse_event(sc.chat_chunk(
+                rid, req.model, {"content": item.delta}
+            )))
+    if tctx is not None:
+        text = inf.finetune_result(cfg, "", "".join(buffered))
+        content, tool_calls = inf.parse_tool_calls(text, tctx)
+        if tool_calls:
+            finish = "tool_calls"
+            for tc in tool_calls:
+                await resp.write(sse_event(sc.chat_chunk(
+                    rid, req.model, {"tool_calls": [tc]}
+                )))
+        elif content:
+            await resp.write(sse_event(sc.chat_chunk(
+                rid, req.model, {"content": content}
+            )))
+    await resp.write(sse_event(sc.chat_chunk(
+        rid, req.model, {}, finish_reason=finish,
+        usage_dict=sc.usage(handle.prompt_tokens, handle.completion_tokens),
+    )))
+    await resp.write(SSE_DONE)
+    await resp.write_eof()
+    return resp
+
+
+# ---------------------------------------------------------------------------
+# /v1/completions  /v1/edits
+
+
+async def completions(request: web.Request) -> web.StreamResponse:
+    req = await _read_request(request)
+    sm, base_cfg = _serving(request, req, Usecase.COMPLETION)
+    cfg = inf.merge_request(base_cfg, req)
+    rid = sc.new_id("cmpl")
+
+    prompts: list[str]
+    if isinstance(req.prompt, list):
+        prompts = [str(p) for p in req.prompt] or [""]
+    else:
+        prompts = [str(req.prompt or "")]
+    templated = [
+        build_completion_prompt(sm.templates, cfg, p) for p in prompts
+    ]
+
+    if req.stream:
+        resp = web.StreamResponse(headers=SSE_HEADERS)
+        await resp.prepare(request)
+        handle = sm.scheduler.submit(
+            inf.build_gen_request(sm, cfg, req, templated[0])
+        )
+        finish = "stop"
+        async for item in aiter_handle(handle):
+            if item.finish_reason is not None:
+                finish = item.finish_reason
+                break
+            if item.delta:
+                await resp.write(sse_event(sc.completion_response(
+                    rid, req.model,
+                    [{"index": 0, "text": item.delta,
+                      "finish_reason": None}],
+                    sc.usage(handle.prompt_tokens, handle.completion_tokens),
+                )))
+        await resp.write(sse_event(sc.completion_response(
+            rid, req.model, [{"index": 0, "text": "",
+                              "finish_reason": finish}],
+            sc.usage(handle.prompt_tokens, handle.completion_tokens),
+        )))
+        await resp.write(SSE_DONE)
+        await resp.write_eof()
+        return resp
+
+    choices = []
+    prompt_total = 0
+    completion_total = 0
+    idx = 0
+    for raw, prompt in zip(prompts, templated):
+        n = max(1, req.n or 1)
+        handles = [
+            sm.scheduler.submit(inf.build_gen_request(
+                sm, cfg, req, prompt, seed_offset=i))
+            for i in range(n)
+        ]
+        for h in handles:
+            await _in_executor(request, h.result, 600.0)
+            text = inf.finetune_result(cfg, raw, h.text, echo=req.echo)
+            prompt_total += h.prompt_tokens
+            completion_total += h.completion_tokens
+            choices.append({
+                "index": idx,
+                "text": text,
+                "finish_reason": h.finish_reason or "stop",
+            })
+            idx += 1
+    return web.json_response(sc.completion_response(
+        rid, req.model, choices, sc.usage(prompt_total, completion_total)
+    ))
+
+
+async def edits(request: web.Request) -> web.Response:
+    req = await _read_request(request)
+    sm, base_cfg = _serving(request, req, Usecase.EDIT)
+    cfg = inf.merge_request(base_cfg, req)
+    rid = sc.new_id("edit")
+    inputs: list[str]
+    if isinstance(req.prompt, list):
+        inputs = [str(p) for p in req.prompt] or [""]
+    else:
+        inputs = [str(req.prompt or "")]
+    choices = []
+    ptotal = ctotal = 0
+    for i, text_in in enumerate(inputs):
+        prompt = build_edit_prompt(sm.templates, cfg, text_in,
+                                   req.instruction)
+        h = sm.scheduler.submit(inf.build_gen_request(sm, cfg, req, prompt))
+        await _in_executor(request, h.result, 600.0)
+        ptotal += h.prompt_tokens
+        ctotal += h.completion_tokens
+        choices.append({
+            "index": i,
+            "text": inf.finetune_result(cfg, prompt, h.text),
+            "finish_reason": h.finish_reason or "stop",
+        })
+    return web.json_response(sc.completion_response(
+        rid, req.model, choices, sc.usage(ptotal, ctotal),
+        object_name="edit",
+    ))
+
+
+# ---------------------------------------------------------------------------
+# /v1/embeddings
+
+
+async def embeddings(request: web.Request) -> web.Response:
+    req = await _read_request(request)
+    sm, base_cfg = _serving(request, req, Usecase.EMBEDDINGS)
+
+    inputs: list[Any]
+    if req.input is None:
+        inputs = [""]
+    elif isinstance(req.input, str):
+        inputs = [req.input]
+    else:
+        inputs = list(req.input) or [""]
+        if inputs and all(isinstance(x, int) for x in inputs):
+            inputs = [inputs]  # one tokenized input
+
+    def embed_all() -> tuple[list[list[float]], int]:
+        vecs = []
+        ptokens = 0
+        for item in inputs:
+            if isinstance(item, list):
+                toks = [int(t) for t in item]
+            else:
+                toks = sm.tokenizer.encode(str(item), add_bos=True)
+            ptokens += len(toks)
+            vecs.append([float(x) for x in sm.runner.embed(toks)])
+        return vecs, ptokens
+
+    vectors, prompt_tokens = await _in_executor(request, embed_all)
+    return web.json_response(
+        sc.embeddings_response(req.model, vectors, prompt_tokens)
+    )
+
+
+# ---------------------------------------------------------------------------
+# /v1/models
+
+
+async def list_models(request: web.Request) -> web.Response:
+    state = _state(request)
+    names = state.loader.names()
+    # ?filter=<regex> and loose-file policy parity
+    # (core/services/list_models.go:17-49)
+    flt = request.query.get("filter")
+    if flt:
+        import re
+
+        try:
+            rx = re.compile(flt)
+            names = [n for n in names if rx.search(n)]
+        except re.error:
+            pass
+    return web.json_response(sc.models_response(names))
+
+
+def routes() -> list[web.RouteDef]:
+    """Route table (parity: core/http/routes/openai.go:18-84 incl. the
+    legacy unversioned aliases)."""
+    out = []
+    for path, handler in [
+        ("/v1/chat/completions", chat),
+        ("/chat/completions", chat),
+        ("/v1/completions", completions),
+        ("/completions", completions),
+        ("/v1/edits", edits),
+        ("/edits", edits),
+        ("/v1/embeddings", embeddings),
+        ("/embeddings", embeddings),
+    ]:
+        out.append(web.post(path, handler))
+    out.append(web.get("/v1/models", list_models))
+    out.append(web.get("/models", list_models))
+    return out

@@ -1,0 +1,260 @@
+"""OpenAI-compatible wire schema (requests as pydantic, responses as
+helper-built dicts).
+
+Parity: /root/reference/core/schema/openai.go (OpenAIRequest:157,
+OpenAIResponse:38, Message:69 — string-or-multipart content, tool calls),
+prediction.go, and the LocalAI request types (core/schema/localai.go).
+"""
+
+from __future__ import annotations
+
+import time
+import uuid
+from typing import Any, Optional, Union
+
+from pydantic import BaseModel, ConfigDict, Field
+
+
+class FunctionDef(BaseModel):
+    model_config = ConfigDict(extra="allow")
+
+    name: str = ""
+    description: str = ""
+    parameters: Optional[dict[str, Any]] = None
+
+
+class ToolDef(BaseModel):
+    model_config = ConfigDict(extra="allow")
+
+    type: str = "function"
+    function: Optional[FunctionDef] = None
+
+
+class FunctionCall(BaseModel):
+    model_config = ConfigDict(extra="allow")
+
+    name: str = ""
+    arguments: str = ""
+
+
+class ToolCall(BaseModel):
+    model_config = ConfigDict(extra="allow")
+
+    id: str = ""
+    index: Optional[int] = None
+    type: str = "function"
+    function: FunctionCall = Field(default_factory=FunctionCall)
+
+
+class Message(BaseModel):
+    """Chat message; content may be a string or multipart list
+    (text / image_url / audio / video parts — schema/openai.go:69)."""
+
+    model_config = ConfigDict(extra="allow")
+
+    role: str = "user"
+    name: Optional[str] = None
+    content: Optional[Union[str, list[dict[str, Any]]]] = None
+    tool_calls: Optional[list[ToolCall]] = None
+    function_call: Optional[Union[FunctionCall, dict]] = None
+
+    def text_content(self) -> str:
+        if self.content is None:
+            return ""
+        if isinstance(self.content, str):
+            return self.content
+        parts = []
+        for part in self.content:
+            if part.get("type") == "text" or "text" in part:
+                parts.append(str(part.get("text", "")))
+        return "".join(parts)
+
+    def media_parts(self, kind: str) -> list[str]:
+        """URLs/base64 payloads of image_url/audio_url/video_url parts."""
+        if not isinstance(self.content, list):
+            return []
+        out = []
+        key = f"{kind}_url"
+        for part in self.content:
+            if part.get("type") == key or key in part:
+                val = part.get(key)
+                if isinstance(val, dict):
+                    val = val.get("url")
+                if val:
+                    out.append(str(val))
+        return out
+
+
+class OpenAIRequest(BaseModel):
+    """The one merged request shape every OpenAI endpoint reads
+    (parity: schema/openai.go:157 — a single struct serves chat,
+    completions, edits, embeddings, images, audio)."""
+
+    model_config = ConfigDict(extra="allow", populate_by_name=True)
+
+    model: str = ""
+    # chat / completion / edit
+    messages: list[Message] = Field(default_factory=list)
+    prompt: Optional[Union[str, list[str]]] = None
+    instruction: str = ""
+    suffix: str = ""
+    # embeddings
+    input: Optional[Union[str, list[Any]]] = None
+    # sampling
+    temperature: Optional[float] = None
+    top_p: Optional[float] = None
+    top_k: Optional[int] = None
+    min_p: Optional[float] = None
+    max_tokens: Optional[int] = None
+    n: Optional[int] = None
+    seed: Optional[int] = None
+    stop: Optional[Union[str, list[str]]] = None
+    logit_bias: Optional[dict[str, float]] = None
+    presence_penalty: Optional[float] = None
+    frequency_penalty: Optional[float] = None
+    repeat_penalty: Optional[float] = None
+    ignore_eos: bool = False
+    echo: bool = False
+    stream: bool = False
+    # tools
+    tools: Optional[list[ToolDef]] = None
+    tool_choice: Optional[Union[str, dict[str, Any]]] = None
+    functions: Optional[list[FunctionDef]] = None
+    function_call: Optional[Union[str, dict[str, Any]]] = None
+    grammar: Optional[str] = None
+    response_format: Optional[Union[str, dict[str, Any]]] = None
+    # misc
+    user: str = ""
+    language: Optional[str] = None
+    backend: Optional[str] = None
+
+    def stop_list(self) -> list[str]:
+        if self.stop is None:
+            return []
+        if isinstance(self.stop, str):
+            return [self.stop]
+        return [s for s in self.stop if isinstance(s, str)]
+
+    def tool_definitions(self) -> list[dict]:
+        """tools ∪ legacy functions, as plain function dicts."""
+        out: list[dict] = []
+        for t in self.tools or []:
+            if t.function is not None:
+                out.append(t.function.model_dump(exclude_none=True))
+        for f in self.functions or []:
+            out.append(f.model_dump(exclude_none=True))
+        return out
+
+    def tool_choice_name(self) -> Optional[str]:
+        """Requested function name, or None; "none" disables tools."""
+        for choice in (self.tool_choice, self.function_call):
+            if choice is None:
+                continue
+            if isinstance(choice, str):
+                if choice in ("none", "auto", "required"):
+                    return None
+                return choice
+            if isinstance(choice, dict):
+                fn = choice.get("function", choice)
+                name = fn.get("name")
+                if name:
+                    return str(name)
+        return None
+
+    def tools_disabled(self) -> bool:
+        return self.tool_choice == "none" or self.function_call == "none"
+
+
+# ---------------------------------------------------------------------------
+# Response builders (OpenAIResponse parity, schema/openai.go:38)
+
+
+def _now() -> int:
+    return int(time.time())
+
+
+def new_id(prefix: str) -> str:
+    return f"{prefix}-{uuid.uuid4().hex[:24]}"
+
+
+def usage(prompt_tokens: int, completion_tokens: int) -> dict:
+    return {
+        "prompt_tokens": prompt_tokens,
+        "completion_tokens": completion_tokens,
+        "total_tokens": prompt_tokens + completion_tokens,
+    }
+
+
+def chat_response(rid: str, model: str, choices: list[dict],
+                  usage_dict: dict) -> dict:
+    return {
+        "id": rid,
+        "object": "chat.completion",
+        "created": _now(),
+        "model": model,
+        "choices": choices,
+        "usage": usage_dict,
+    }
+
+
+def chat_chunk(rid: str, model: str, delta: dict, *, index: int = 0,
+               finish_reason: Optional[str] = None,
+               usage_dict: Optional[dict] = None) -> dict:
+    out = {
+        "id": rid,
+        "object": "chat.completion.chunk",
+        "created": _now(),
+        "model": model,
+        "choices": [{
+            "index": index,
+            "delta": delta,
+            "finish_reason": finish_reason,
+        }],
+    }
+    if usage_dict is not None:
+        out["usage"] = usage_dict
+    return out
+
+
+def completion_response(rid: str, model: str, choices: list[dict],
+                        usage_dict: dict, *, object_name: str =
+                        "text_completion") -> dict:
+    return {
+        "id": rid,
+        "object": object_name,
+        "created": _now(),
+        "model": model,
+        "choices": choices,
+        "usage": usage_dict,
+    }
+
+
+def embeddings_response(model: str, vectors: list[list[float]],
+                        prompt_tokens: int) -> dict:
+    return {
+        "object": "list",
+        "model": model,
+        "data": [
+            {"object": "embedding", "index": i, "embedding": v}
+            for i, v in enumerate(vectors)
+        ],
+        "usage": usage(prompt_tokens, 0),
+    }
+
+
+def models_response(names: list[str]) -> dict:
+    return {
+        "object": "list",
+        "data": [
+            {"id": n, "object": "model", "owned_by": "localai-tpu"}
+            for n in names
+        ],
+    }
+
+
+def error_body(message: str, *, kind: str = "invalid_request_error",
+               code: Optional[int] = None) -> dict:
+    err: dict[str, Any] = {"message": message, "type": kind}
+    if code is not None:
+        err["code"] = code
+    return {"error": err}

@@ -1,0 +1,172 @@
+"""HTTP application wiring: middlewares (auth, metrics, errors, CORS) +
+route registration + lifecycle.
+
+Parity: /root/reference/core/http/app.go:52-186 — fiber app with error
+handling (optional opaque errors), request logging, recover, metrics
+middleware, key-auth with exemptions, CORS, route registration — rebuilt
+on aiohttp (FastAPI/uvicorn are not in this image; aiohttp is, and SSE
+streaming maps directly onto StreamResponse).
+"""
+
+from __future__ import annotations
+
+import logging
+import secrets
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Optional
+
+from aiohttp import web
+
+from localai_tpu.api import localai as localai_routes
+from localai_tpu.api import openai as openai_routes
+from localai_tpu.api.metrics import REGISTRY
+from localai_tpu.api.schema import error_body
+from localai_tpu.config.app_config import AppConfig
+from localai_tpu.config.loader import ConfigLoader
+from localai_tpu.models.manager import ModelManager
+
+log = logging.getLogger(__name__)
+
+STATE_KEY = web.AppKey("state", object)
+
+# paths reachable without an API key (parity: auth exemption filter,
+# core/http/middleware/auth.go:17+)
+AUTH_EXEMPT = {"/", "/healthz", "/readyz", "/version"}
+
+
+class AppState:
+    """Shared handler state (the reference passes (cl, ml, appConfig)
+    closures into every endpoint — app.go:159-165)."""
+
+    def __init__(self, app_config: Optional[AppConfig] = None,
+                 loader: Optional[ConfigLoader] = None,
+                 manager: Optional[ModelManager] = None):
+        self.config = app_config or AppConfig()
+        self.loader = loader or ConfigLoader(self.config.model_path)
+        self.manager = manager or ModelManager(self.config, self.loader)
+        # blocking engine waits run here, off the event loop
+        self.executor = ThreadPoolExecutor(
+            max_workers=32, thread_name_prefix="api-wait"
+        )
+
+    def shutdown(self) -> None:
+        self.manager.shutdown_all()
+        self.executor.shutdown(wait=False, cancel_futures=True)
+
+
+@web.middleware
+async def error_middleware(request: web.Request, handler):
+    state = request.app[STATE_KEY]
+    try:
+        return await handler(request)
+    except web.HTTPException as e:
+        if e.status >= 400:
+            msg = e.text or e.reason or "error"
+            return web.json_response(
+                error_body(msg, code=e.status), status=e.status
+            )
+        raise
+    except Exception as e:  # noqa: BLE001 — recover middleware parity
+        log.exception("unhandled error on %s %s", request.method,
+                      request.path)
+        msg = ("internal error" if state.config.opaque_errors
+               else f"{type(e).__name__}: {e}")
+        return web.json_response(
+            error_body(msg, kind="internal_error", code=500), status=500
+        )
+
+
+@web.middleware
+async def metrics_middleware(request: web.Request, handler):
+    t0 = time.perf_counter()
+    try:
+        return await handler(request)
+    finally:
+        REGISTRY.api_call.observe(
+            time.perf_counter() - t0,
+            method=request.method, path=request.path,
+        )
+
+
+@web.middleware
+async def auth_middleware(request: web.Request, handler):
+    state = request.app[STATE_KEY]
+    keys = state.config.api_keys
+    if not keys or request.path in AUTH_EXEMPT:
+        return await handler(request)
+    header = request.headers.get("Authorization", "")
+    token = header.removeprefix("Bearer ").strip()
+    if token and any(secrets.compare_digest(token, k) for k in keys):
+        return await handler(request)
+    return web.json_response(
+        error_body("invalid or missing API key",
+                   kind="authentication_error", code=401),
+        status=401,
+    )
+
+
+@web.middleware
+async def cors_middleware(request: web.Request, handler):
+    state = request.app[STATE_KEY]
+    if not state.config.cors:
+        return await handler(request)
+    if request.method == "OPTIONS":
+        resp: web.StreamResponse = web.Response(status=204)
+    else:
+        resp = await handler(request)
+    resp.headers["Access-Control-Allow-Origin"] = (
+        state.config.cors_allow_origins or "*"
+    )
+    resp.headers["Access-Control-Allow-Methods"] = "GET, POST, DELETE, OPTIONS"
+    resp.headers["Access-Control-Allow-Headers"] = "Authorization, Content-Type"
+    return resp
+
+
+async def welcome(request: web.Request) -> web.Response:
+    state = request.app[STATE_KEY]
+    return web.json_response({
+        "message": "LocalAI-TPU",
+        "models": state.loader.names(),
+        "endpoints": sorted({
+            r.resource.canonical
+            for r in request.app.router.routes()
+            if r.resource is not None
+        }),
+    })
+
+
+def create_app(state: Optional[AppState] = None) -> web.Application:
+    state = state or AppState()
+    app = web.Application(middlewares=[
+        cors_middleware, error_middleware, auth_middleware,
+        metrics_middleware,
+    ], client_max_size=64 * 1024 * 1024)
+    app[STATE_KEY] = state
+    app.add_routes([web.get("/", welcome)])
+    app.add_routes(openai_routes.routes())
+    app.add_routes(localai_routes.routes())
+
+    async def on_cleanup(_app):
+        state.shutdown()
+
+    app.on_cleanup.append(on_cleanup)
+    return app
+
+
+def serve(app_config: Optional[AppConfig] = None) -> None:
+    """Blocking server entry (parity: appHTTP.Listen, run.go:199)."""
+    cfg = app_config or AppConfig()
+    cfg.ensure_dirs()
+    loader = ConfigLoader(cfg.model_path)
+    loader.load_from_path(context_size=cfg.context_size)
+    state = AppState(cfg, loader)
+    for name in cfg.preload_models + cfg.load_to_memory:
+        try:
+            state.manager.get(name)
+        except Exception as e:  # noqa: BLE001
+            log.warning("preload of %s failed: %s", name, e)
+    log.info("serving on %s:%d (%d models configured)",
+             cfg.address, cfg.port, len(loader.names()))
+    web.run_app(create_app(state), host=cfg.address, port=cfg.port,
+                print=None, access_log=None)

@@ -1,0 +1,52 @@
+"""Sync engine streams → async HTTP responses.
+
+The scheduler fills GenHandle queues from its engine thread
+(localai_tpu.engine.scheduler); aiohttp handlers consume them through an
+asyncio bridge so one slow SSE client never blocks the event loop or the
+engine (parity concern: the reference's per-request goroutine + channel
+fan-out, chat.go:455-508).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import threading
+from typing import Any, AsyncIterator
+
+from localai_tpu.engine.scheduler import GenHandle, StreamItem
+
+
+async def aiter_handle(handle: GenHandle) -> AsyncIterator[StreamItem]:
+    """Async view of a GenHandle's delta stream."""
+    loop = asyncio.get_running_loop()
+    q: asyncio.Queue = asyncio.Queue()
+
+    def pump() -> None:
+        for item in handle:
+            loop.call_soon_threadsafe(q.put_nowait, item)
+
+    t = threading.Thread(target=pump, daemon=True,
+                         name=f"sse-pump-{handle.id}")
+    t.start()
+    while True:
+        item = await q.get()
+        yield item
+        if item.finish_reason is not None:
+            return
+
+
+def sse_event(payload: Any) -> bytes:
+    """One `data: {json}` SSE frame (chat.go:463-508 wire shape)."""
+    return b"data: " + json.dumps(
+        payload, separators=(",", ":"), ensure_ascii=False
+    ).encode("utf-8") + b"\n\n"
+
+
+SSE_DONE = b"data: [DONE]\n\n"
+SSE_HEADERS = {
+    "Content-Type": "text/event-stream",
+    "Cache-Control": "no-cache",
+    "Connection": "keep-alive",
+    "X-Accel-Buffering": "no",
+}

@@ -1,0 +1,171 @@
+"""CLI entry: ``python -m localai_tpu.cli.main <command>``.
+
+Parity: the reference's kong command tree (/root/reference/core/cli/
+cli.go:8-20 — run, models, tts, transcript, worker, util, federated,
+explorer) with env-aliased flags (run.go:19-73). argparse instead of kong;
+every flag also reads LOCALAI_<NAME> from the environment.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+from typing import Optional, Sequence
+
+
+def _env_default(name: str, fallback):
+    for key in (f"LOCALAI_{name.upper()}", name.upper()):
+        if key in os.environ:
+            return os.environ[key]
+    return fallback
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="localai-tpu",
+        description="TPU-native LocalAI: OpenAI-compatible serving on JAX/XLA",
+    )
+    p.add_argument("--log-level", default=_env_default("log_level", "info"),
+                   choices=["error", "warn", "info", "debug", "trace"])
+    sub = p.add_subparsers(dest="command")
+
+    run = sub.add_parser("run", help="start the API server (default)")
+    run.add_argument("models", nargs="*", help="model refs to preload")
+    run.add_argument("--address", default=_env_default("address", "0.0.0.0"))
+    run.add_argument("--port", type=int,
+                     default=int(_env_default("port", 8080)))
+    run.add_argument("--models-path",
+                     default=_env_default("models_path", "models"))
+    run.add_argument("--context-size", type=int,
+                     default=int(_env_default("context_size", 4096)))
+    run.add_argument("--api-keys", default=_env_default("api_keys", ""),
+                     help="comma-separated bearer keys")
+    run.add_argument("--cors", action="store_true", default=True)
+    run.add_argument("--no-cors", dest="cors", action="store_false")
+    run.add_argument("--opaque-errors", action="store_true",
+                     default=bool(_env_default("opaque_errors", "")))
+    run.add_argument("--single-active-backend", action="store_true")
+    run.add_argument("--preload-models", default="",
+                     help="comma-separated model names to load eagerly")
+    run.add_argument("--enable-watchdog-idle", action="store_true")
+    run.add_argument("--enable-watchdog-busy", action="store_true")
+    run.add_argument("--watchdog-idle-timeout", type=float, default=15 * 60)
+    run.add_argument("--watchdog-busy-timeout", type=float, default=5 * 60)
+    run.add_argument("--mesh", default=_env_default("mesh", ""),
+                     help="mesh shape, e.g. data=2,model=4 (default: auto)")
+    run.add_argument("--platform", default=_env_default("platform", None),
+                     help="force JAX platform (cpu for tests)")
+
+    models = sub.add_parser("models", help="model management")
+    models_sub = models.add_subparsers(dest="models_command")
+    mlist = models_sub.add_parser("list", help="list configured models")
+    mlist.add_argument("--models-path", default="models")
+
+    tok = sub.add_parser("tokenize", help="tokenize text with a model")
+    tok.add_argument("text")
+    tok.add_argument("--model", required=True)
+    tok.add_argument("--models-path", default="models")
+
+    worker = sub.add_parser("worker", help="start a gRPC model worker")
+    worker.add_argument("--addr", default="127.0.0.1:50051")
+
+    sub.add_parser("version", help="print version")
+    return p
+
+
+def _parse_mesh(spec: str) -> Optional[dict]:
+    if not spec:
+        return None
+    out = {}
+    for part in spec.split(","):
+        k, _, v = part.partition("=")
+        out[k.strip()] = int(v)
+    return out
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    argv = list(argv if argv is not None else sys.argv[1:])
+    parser = build_parser()
+    args = parser.parse_args(argv)
+    level = {"error": logging.ERROR, "warn": logging.WARNING,
+             "info": logging.INFO, "debug": logging.DEBUG,
+             "trace": logging.DEBUG}[args.log_level]
+    logging.basicConfig(
+        level=level,
+        format="%(asctime)s %(levelname).1s %(name)s: %(message)s",
+    )
+
+    cmd = args.command or "run"
+    if cmd == "version":
+        from localai_tpu.version import __version__
+
+        print(__version__)
+        return 0
+
+    if cmd == "run":
+        if args.platform:
+            os.environ.setdefault("JAX_PLATFORMS", args.platform)
+        from localai_tpu.api.server import serve
+        from localai_tpu.config.app_config import AppConfig
+
+        cfg = AppConfig(
+            model_path=args.models_path,
+            address=args.address,
+            port=args.port,
+            context_size=args.context_size,
+            cors=args.cors,
+            api_keys=[k for k in args.api_keys.split(",") if k],
+            opaque_errors=args.opaque_errors,
+            single_active_backend=args.single_active_backend,
+            preload_models=[m for m in args.preload_models.split(",") if m]
+            + list(args.models),
+            watchdog_idle=args.enable_watchdog_idle,
+            watchdog_busy=args.enable_watchdog_busy,
+            watchdog_idle_timeout=args.watchdog_idle_timeout,
+            watchdog_busy_timeout=args.watchdog_busy_timeout,
+            mesh_shape=_parse_mesh(args.mesh),
+            platform=args.platform,
+        )
+        serve(cfg)
+        return 0
+
+    if cmd == "models":
+        if args.models_command == "list":
+            from localai_tpu.config.loader import ConfigLoader
+
+            loader = ConfigLoader(args.models_path)
+            loader.load_from_path()
+            for name in loader.names():
+                print(name)
+            return 0
+        parser.error("unknown models subcommand")
+
+    if cmd == "tokenize":
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        from localai_tpu.config.loader import ConfigLoader
+        from localai_tpu.models.manager import ModelManager
+
+        loader = ConfigLoader(args.models_path)
+        loader.load_from_path()
+        from localai_tpu.config.app_config import AppConfig
+
+        manager = ModelManager(AppConfig(model_path=args.models_path), loader)
+        sm = manager.get(args.model)
+        print(sm.tokenizer.encode(args.text))
+        manager.shutdown_all()
+        return 0
+
+    if cmd == "worker":
+        from localai_tpu.worker.server import serve_worker
+
+        serve_worker(args.addr)
+        return 0
+
+    parser.error(f"unknown command {cmd!r}")
+    return 2
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -134,6 +134,7 @@ class ModelRunner:
             )
         self._free_slots = list(range(num_slots))
 
+        self.kv_dtype = kv_dtype
         self._decode = jax.jit(self._decode_fn, donate_argnums=(1, 2))
         self._decode_n = jax.jit(
             self._decode_n_fn, static_argnames=("n",), donate_argnums=(1, 2)
@@ -141,6 +142,7 @@ class ModelRunner:
         self._prefill = jax.jit(
             self._prefill_fn, static_argnames=("bucket",), donate_argnums=(1, 2)
         )
+        self._embed = jax.jit(self._embed_fn, static_argnames=("bucket",))
 
     # -- jitted programs -------------------------------------------------
 
@@ -208,6 +210,27 @@ class ModelRunner:
             counts=counts,
         )
         return KVCache(new_k, new_v), new_state, tok[0]
+
+    def _embed_fn(self, params, tokens, length, *, bucket: int):
+        """Mean-pooled final hidden state over the real tokens — the LLM
+        embeddings path (parity: llama.cpp embeddings mode behind the
+        Embedding RPC, backend.proto:16; reference core/backend/
+        embeddings.go:13). Uses a throwaway single-sequence KV so it never
+        touches serving slots."""
+        cfg = self.cfg
+        kv_shape = (cfg.num_layers, 1, bucket, cfg.num_kv_heads, cfg.hd)
+        kv = (jnp.zeros(kv_shape, jnp.dtype(self.kv_dtype)),
+              jnp.zeros(kv_shape, jnp.dtype(self.kv_dtype)))
+        positions = jnp.arange(bucket, dtype=jnp.int32)[None, :]
+        mask = kvc.prefill_mask(cfg, bucket, length)
+        write = kvc.prefill_write(jnp.int32(0), jnp.zeros((), jnp.int32))
+        hidden, _ = mdl.forward(
+            cfg, params, tokens, positions, write, kv, mask, self.rope,
+        )
+        valid = (jnp.arange(bucket) < length)[None, :, None]
+        summed = jnp.sum(hidden * valid, axis=1)
+        pooled = summed / jnp.maximum(length, 1).astype(hidden.dtype)
+        return pooled[0]
 
     # -- host API --------------------------------------------------------
 
@@ -303,6 +326,21 @@ class ModelRunner:
             self.params, self.kv, self.state, n=n
         )
         return np.asarray(tokens)
+
+    def embed(self, prompt: list[int]) -> np.ndarray:
+        """[D] float32 embedding of a token sequence (bucketed like prefill)."""
+        if not prompt:
+            prompt = [0]
+        n = len(prompt)
+        if n > self.max_ctx:
+            raise ValueError(f"input ({n} tokens) exceeds context {self.max_ctx}")
+        bucket = self.bucket_for(n)
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, :n] = prompt
+        out = self._embed(
+            self.params, jnp.asarray(padded), jnp.int32(n), bucket=bucket
+        )
+        return np.asarray(out, dtype=np.float32)
 
     def set_bias(self, slot: int, bias_row: Optional[np.ndarray]) -> None:
         """Replace one slot's [V] additive logit-bias row (grammar masks write

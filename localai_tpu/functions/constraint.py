@@ -163,11 +163,19 @@ class FSMConstraint:
         # per-state caches: mask row and per-token final state (for advance).
         # Shared across all requests using the same (dfa, vocab trie) — the
         # expensive trie walks happen once per state per grammar, not per
-        # request.
-        shared = dfa.__dict__.setdefault("_vocab_caches", {})
-        self._masks, self._finals = shared.setdefault(
-            id(self.trie), ({}, {})
+        # request. WeakKeyDictionary: when a model's tokenizer (and thus its
+        # trie) is unloaded, its [V]-sized rows are collectible, and a new
+        # trie can never collide with a dead one's cache.
+        import weakref
+
+        shared = dfa.__dict__.setdefault(
+            "_vocab_caches", weakref.WeakKeyDictionary()
         )
+        cached = shared.get(self.trie)
+        if cached is None:
+            cached = ({}, {})
+            shared[self.trie] = cached
+        self._masks, self._finals = cached
 
     # -- TokenConstraint protocol ----------------------------------------
 

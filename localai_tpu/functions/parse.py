@@ -77,7 +77,7 @@ def parse_json_objects(s: str) -> list[Any]:
     return out
 
 
-_TAG_CALL = re.compile(r"<function=(\w+)>(.*?)</function>", re.DOTALL)
+_TAG_CALL = re.compile(r"<function=([\w.-]+)>(.*?)</function>", re.DOTALL)
 
 
 def parse_function_call(

@@ -150,6 +150,8 @@ def build_tool_regex(
                 "properties": params.get("properties") or {},
                 **({"required": params["required"]}
                    if params.get("required") is not None else {}),
+                **({"$defs": params["$defs"]}
+                   if isinstance(params.get("$defs"), dict) else {}),
             }
             args_rx = schema_to_regex(args_schema, prop_order=prop_order)
             fname = escape_literal(fn.get("name", ""))

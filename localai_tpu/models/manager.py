@@ -1,0 +1,272 @@
+"""ModelManager: name → live serving engine, loaded on demand.
+
+TPU-era redesign of the reference's model-lifecycle layer
+(/root/reference/pkg/model/loader.go:22-206, initializers.go:271-540,
+watchdog.go:19-156): where the reference spawns one gRPC worker *process*
+per model and health-checks/respawns it, the in-process manager owns one
+ModelRunner+Scheduler per model inside the server process. Process-level
+isolation (crash containment) is provided by the separate gRPC worker tier
+(localai_tpu.worker) — this manager is the in-process fast path, and both
+expose the same surface.
+
+Watchdog parity: busy-too-long requests are cancelled, idle-too-long
+models are evicted to free HBM (defaults 5m/15m — core/cli/run.go:66-69).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import threading
+import time
+from typing import Any, Optional
+
+from localai_tpu.config.app_config import AppConfig
+from localai_tpu.config.loader import ConfigLoader
+from localai_tpu.config.model_config import ModelConfig
+from localai_tpu.engine.runner import ModelRunner
+from localai_tpu.engine.scheduler import Scheduler
+from localai_tpu.templates.cache import TemplateCache
+
+log = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class ServingModel:
+    """One loaded model: engine + tokenizer + its declarative config."""
+
+    name: str
+    config: ModelConfig
+    runner: ModelRunner
+    scheduler: Scheduler
+    tokenizer: Any
+    templates: TemplateCache
+    loaded_at: float = dataclasses.field(default_factory=time.monotonic)
+    last_used: float = dataclasses.field(default_factory=time.monotonic)
+
+    def touch(self) -> None:
+        self.last_used = time.monotonic()
+
+    @property
+    def busy(self) -> bool:
+        return self.scheduler.busy
+
+
+class ModelManager:
+    """Thread-safe registry of loaded models (parity: ModelLoader map +
+    mutex, loader.go:22-40)."""
+
+    def __init__(
+        self,
+        app_config: Optional[AppConfig] = None,
+        loader: Optional[ConfigLoader] = None,
+    ):
+        self.app = app_config or AppConfig()
+        self.loader = loader or ConfigLoader(self.app.model_path)
+        self._models: dict[str, ServingModel] = {}
+        self._lock = threading.RLock()
+        self._watchdog: Optional[_Watchdog] = None
+        if self.app.watchdog_idle or self.app.watchdog_busy:
+            self._watchdog = _Watchdog(self)
+            self._watchdog.start()
+
+    # -- lookup / load ----------------------------------------------------
+
+    def loaded_names(self) -> list[str]:
+        with self._lock:
+            return sorted(self._models)
+
+    def is_loaded(self, name: str) -> bool:
+        with self._lock:
+            return name in self._models
+
+    def get(self, name: str) -> ServingModel:
+        """Idempotent load-or-get (parity: ModelLoader.LoadModel +
+        CheckIsLoaded health path, loader.go:96-206). The engine thread is
+        the health signal: a dead thread → reload."""
+        with self._lock:
+            sm = self._models.get(name)
+            if sm is not None:
+                if sm.scheduler._thread.is_alive():
+                    sm.touch()
+                    return sm
+                log.warning("model %s engine thread died; reloading", name)
+                self._evict_locked(name)
+            mcfg = self.loader.get(name)
+            if mcfg is None:
+                raise KeyError(f"no configuration for model {name!r}")
+            if self.app.single_active_backend:
+                for other in list(self._models):
+                    if not self._models[other].busy:
+                        self._evict_locked(other)
+            sm = self._load(mcfg)
+            self._models[name] = sm
+            return sm
+
+    def _load(self, mcfg: ModelConfig) -> ServingModel:
+        from localai_tpu.models.registry import resolve_model
+
+        eng = mcfg.engine
+        shard = mcfg.sharding
+        mesh = None
+        t0 = time.monotonic()
+        want_tp = max(1, shard.tensor_parallel_size)
+        want_dp = shard.data_parallel_size  # 0 = auto
+        if want_tp > 1 or want_dp not in (0, 1) or self.app.mesh_shape:
+            from localai_tpu.parallel.mesh import MeshPlan, build_mesh
+
+            if self.app.mesh_shape:
+                mesh = build_mesh(MeshPlan(**self.app.mesh_shape))
+            else:
+                import jax
+
+                nd = len(jax.devices())
+                dp = want_dp or max(1, nd // want_tp)
+                mesh = build_mesh(MeshPlan(data=dp, model=want_tp))
+
+        model = resolve_model(
+            mcfg.model or mcfg.name,
+            model_path=self.app.model_path,
+            dtype=eng.dtype,
+        )
+        params = model.params
+        if mesh is not None:
+            from localai_tpu.parallel import sharding as shd
+
+            params = shd.shard_params(params, model.cfg, mesh)
+        ctx = mcfg.context_size or self.app.context_size
+        ctx = min(ctx, model.cfg.max_position_embeddings)
+        runner = ModelRunner(
+            model.cfg,
+            params,
+            num_slots=eng.max_slots,
+            max_ctx=ctx,
+            prefill_buckets=eng.prefill_buckets,
+            kv_dtype=eng.kv_dtype,
+            rope_freq_base=mcfg.rope_freq_base,
+            rope_freq_scale=mcfg.rope_freq_scale,
+            seed=mcfg.seed or 0,
+            mesh=mesh,
+        )
+        scheduler = Scheduler(
+            runner,
+            model.tokenizer,
+            default_max_tokens=mcfg.parameters.max_tokens or 2048,
+        )
+        log.info(
+            "loaded model %s (%s) in %.1fs: slots=%d ctx=%d mesh=%s",
+            mcfg.name, mcfg.model, time.monotonic() - t0,
+            eng.max_slots, ctx, mesh.shape if mesh else None,
+        )
+        return ServingModel(
+            name=mcfg.name,
+            config=mcfg,
+            runner=runner,
+            scheduler=scheduler,
+            tokenizer=model.tokenizer,
+            templates=TemplateCache(self.app.model_path),
+        )
+
+    # -- shutdown ---------------------------------------------------------
+
+    def _evict_locked(self, name: str) -> None:
+        sm = self._models.pop(name, None)
+        if sm is not None:
+            sm.scheduler.shutdown()
+
+    def shutdown_model(self, name: str, *, force: bool = False,
+                       wait: float = 30.0) -> bool:
+        """Graceful single-model shutdown: wait for in-flight work unless
+        forced (parity: ShutdownModel wait loop, loader.go:143-168)."""
+        deadline = time.monotonic() + wait
+        while not force:
+            with self._lock:
+                sm = self._models.get(name)
+                if sm is None:
+                    return False
+                if not sm.busy:
+                    break
+            if time.monotonic() > deadline:
+                log.warning("%s still busy after %.0fs; forcing", name, wait)
+                break
+            time.sleep(0.1)
+        with self._lock:
+            if name not in self._models:
+                return False
+            self._evict_locked(name)
+            return True
+
+    def shutdown_all(self) -> None:
+        if self._watchdog is not None:
+            self._watchdog.stop()
+        with self._lock:
+            for name in list(self._models):
+                self._evict_locked(name)
+
+    # -- observability -----------------------------------------------------
+
+    def metrics(self) -> dict:
+        with self._lock:
+            return {
+                name: sm.scheduler.metrics()
+                for name, sm in self._models.items()
+            }
+
+    def monitor(self, name: str) -> dict:
+        """Per-model status (parity: /backend/monitor via gopsutil,
+        core/services/backend_monitor.go — process stats become engine
+        stats in-process)."""
+        with self._lock:
+            sm = self._models.get(name)
+            if sm is None:
+                return {"loaded": False, "name": name}
+            return {
+                "loaded": True,
+                "name": name,
+                "busy": sm.busy,
+                "age_seconds": time.monotonic() - sm.loaded_at,
+                "idle_seconds": time.monotonic() - sm.last_used,
+                **sm.scheduler.metrics(),
+            }
+
+
+class _Watchdog(threading.Thread):
+    """Busy/idle sweeper (parity: WatchDog.Run/checkBusy/checkIdle,
+    /root/reference/pkg/model/watchdog.go:82-156)."""
+
+    INTERVAL = 5.0
+
+    def __init__(self, manager: ModelManager):
+        super().__init__(name="watchdog", daemon=True)
+        self.manager = manager
+        self._stop = threading.Event()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def run(self) -> None:
+        app = self.manager.app
+        while not self._stop.wait(self.INTERVAL):
+            now = time.monotonic()
+            with self.manager._lock:
+                items = list(self.manager._models.items())
+            for name, sm in items:
+                if (app.watchdog_idle and not sm.busy
+                        and now - sm.last_used > app.watchdog_idle_timeout):
+                    log.info("watchdog: evicting idle model %s", name)
+                    self.manager.shutdown_model(name, force=True)
+                elif app.watchdog_busy and sm.busy:
+                    self._cancel_stuck(sm, now)
+
+    def _cancel_stuck(self, sm: ServingModel, now: float) -> None:
+        timeout = self.manager.app.watchdog_busy_timeout
+        with sm.scheduler._lock:
+            stuck = [
+                ctx.handle
+                for ctx in sm.scheduler._slots.values()
+                if now - ctx.handle.t_submit > timeout
+            ]
+        for handle in stuck:
+            log.warning("watchdog: cancelling stuck request %d (>%ds)",
+                        handle.id, int(timeout))
+            handle.cancel()

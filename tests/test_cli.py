@@ -1,0 +1,106 @@
+"""CLI tests: version/models/tokenize inline, plus a real subprocess boot
+of `run` with an HTTP round-trip (the reference's e2e black-box pattern,
+SURVEY.md §4)."""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+from localai_tpu.cli.main import main
+
+TINY_YAML = """\
+name: tiny
+model: "debug:tiny"
+context_size: 96
+parameters:
+  max_tokens: 8
+engine:
+  max_slots: 2
+  prefill_buckets: [16, 32]
+  dtype: float32
+  kv_dtype: float32
+"""
+
+
+@pytest.fixture()
+def models_dir(tmp_path):
+    d = tmp_path / "models"
+    d.mkdir()
+    (d / "tiny.yaml").write_text(TINY_YAML)
+    return d
+
+
+def test_version(capsys):
+    assert main(["version"]) == 0
+    from localai_tpu.version import __version__
+
+    assert capsys.readouterr().out.strip() == __version__
+
+
+def test_models_list(models_dir, capsys):
+    assert main(["models", "list", "--models-path", str(models_dir)]) == 0
+    assert capsys.readouterr().out.split() == ["tiny"]
+
+
+def test_tokenize(models_dir, capsys):
+    assert main([
+        "tokenize", "hi", "--model", "tiny",
+        "--models-path", str(models_dir),
+    ]) == 0
+    assert json.loads(capsys.readouterr().out) == [104, 105]
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_run_server_subprocess(models_dir, tmp_path):
+    port = _free_port()
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=".")
+    logf = open(tmp_path / "server.log", "w")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "localai_tpu.cli.main", "run",
+         "--address", "127.0.0.1", "--port", str(port),
+         "--models-path", str(models_dir), "--platform", "cpu"],
+        stdout=logf, stderr=logf, env=env, cwd="/root/repo",
+    )
+    try:
+        base = f"http://127.0.0.1:{port}"
+        deadline = time.monotonic() + 60
+        while True:
+            try:
+                with urllib.request.urlopen(f"{base}/readyz", timeout=2) as r:
+                    assert json.load(r)["status"] == "ok"
+                    break
+            except Exception:
+                if time.monotonic() > deadline:
+                    logf.flush()
+                    raise AssertionError(
+                        "server did not come up:\n"
+                        + (tmp_path / "server.log").read_text()[-3000:]
+                    )
+                time.sleep(0.5)
+        req = urllib.request.Request(
+            f"{base}/v1/chat/completions",
+            data=json.dumps({
+                "model": "tiny",
+                "messages": [{"role": "user", "content": "hello"}],
+                "max_tokens": 4,
+            }).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=120) as r:
+            body = json.load(r)
+        assert body["choices"][0]["message"]["role"] == "assistant"
+    finally:
+        proc.terminate()
+        proc.wait(10)
+        logf.close()

@@ -438,3 +438,22 @@ def test_review_fixes_regression():
     m1 = c1.allowed_mask()
     c2 = constraint_for_regex(r"xy?z", tok)
     assert c2.allowed_mask() is m1
+
+
+def test_llama31_defs_and_hyphen_names():
+    """Review fixes: llama3.1 keeps per-tool $defs; hyphenated tool names
+    survive both grammar and parse."""
+    tool = {"name": "get-weather", "parameters": {
+        "type": "object",
+        "properties": {"c": {"$ref": "#/$defs/city"}},
+        "required": ["c"],
+        "$defs": {"city": {"type": "string"}}}}
+    cfg = FunctionsConfig(disable_no_action=True,
+                          grammar={"schema_type": "llama3.1"})
+    built = build_tool_regex([tool], cfg)
+    d = compile_dfa(built.pattern)
+    text = '<function=get-weather>{"c":"Nice"}</function>'
+    assert d.matches(text)
+    res = parse_function_call(text, cfg)
+    assert res and res[0].name == "get-weather"
+    assert json.loads(res[0].arguments) == {"c": "Nice"}
