@@ -80,9 +80,18 @@ class ModelRunner:
         rope_freq_scale: Optional[float] = None,
         seed: int = 0,
         mesh: Optional[jax.sharding.Mesh] = None,
+        attn_impl: str = "auto",
     ):
+        from localai_tpu import ops
+
         self.cfg = cfg
         self.params = params
+        # Pallas flash kernels are single-device programs; under a mesh the
+        # XLA path stays (a shard_map'd kernel variant is future work).
+        if mesh is not None:
+            self.attn_impl, self._attn_interpret = "xla", False
+        else:
+            self.attn_impl, self._attn_interpret = ops.resolve_attn_impl(attn_impl)
         self.num_slots = num_slots
         self.max_ctx = max_ctx or cfg.max_position_embeddings
         self.mesh = mesh
@@ -149,11 +158,23 @@ class ModelRunner:
     def _decode_fn(self, params, kv: KVCache, state: DecodeState):
         cfg = self.cfg
         pos = state.positions
+        attn = None
+        if self.attn_impl == "pallas":
+            from localai_tpu import ops
+
+            def attn(q, keys, values, _mask):  # q [S,1,Hq,hd], keys [S,C,Hkv,hd]
+                out = ops.decode_attention(
+                    q[:, 0], keys, values, pos,
+                    sliding_window=cfg.sliding_window,
+                    interpret=self._attn_interpret,
+                )
+                return out[:, None]
+
         mask = kvc.decode_mask(cfg, pos, self.max_ctx)
         write = kvc.decode_write(pos)
         hidden, (new_k, new_v) = mdl.forward(
             cfg, params, state.tokens[:, None], pos[:, None],
-            write, (kv.k, kv.v), mask, self.rope,
+            write, (kv.k, kv.v), mask, self.rope, attn=attn,
         )
         logits = mdl.logits_from_hidden(cfg, params, hidden[:, 0])
         tokens, keys = smp.sample(
@@ -188,10 +209,12 @@ class ModelRunner:
                     tokens, length, slot, *, bucket: int):
         cfg = self.cfg
         positions = jnp.arange(bucket, dtype=jnp.int32)[None, :]
+        attn = self._prefill_attn(length)
         mask = kvc.prefill_mask(cfg, bucket, length)
         write = kvc.prefill_write(slot, jnp.zeros((), jnp.int32))
         hidden, (new_k, new_v) = mdl.forward(
             cfg, params, tokens, positions, write, (kv.k, kv.v), mask, self.rope,
+            attn=attn,
         )
         last_h = jax.lax.dynamic_index_in_dim(hidden[0], length - 1, keepdims=True)
         logits = mdl.logits_from_hidden(cfg, params, last_h)  # [1, V]
@@ -226,11 +249,30 @@ class ModelRunner:
         write = kvc.prefill_write(jnp.int32(0), jnp.zeros((), jnp.int32))
         hidden, _ = mdl.forward(
             cfg, params, tokens, positions, write, kv, mask, self.rope,
+            attn=self._prefill_attn(length),
         )
         valid = (jnp.arange(bucket) < length)[None, :, None]
         summed = jnp.sum(hidden * valid, axis=1)
         pooled = summed / jnp.maximum(length, 1).astype(hidden.dtype)
         return pooled[0]
+
+    def _prefill_attn(self, length):
+        """Pallas flash attention for the prefill/embed paths (None = XLA)."""
+        if self.attn_impl != "pallas":
+            return None
+        from localai_tpu import ops
+
+        cfg = self.cfg
+
+        def attn(q, keys, values, _mask):  # q/keys [1, T, H, hd]
+            out = ops.prefill_attention(
+                q[0], keys[0], values[0], length,
+                sliding_window=cfg.sliding_window,
+                interpret=self._attn_interpret,
+            )
+            return out[None]
+
+        return attn
 
     # -- host API --------------------------------------------------------
 

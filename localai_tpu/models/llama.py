@@ -266,6 +266,8 @@ def forward(
     kv_stack: Any,          # stacked KV pytree scanned alongside layers (or None)
     mask: jax.Array,        # [B, T, Lk] bool attention mask
     rope: tuple[jax.Array, jax.Array],
+    attn: Any = None,       # optional override: fn(q, keys, values, mask) -> out
+                            # (Pallas flash kernels inject here; None = XLA)
 ) -> tuple[jax.Array, Any]:
     """Shared transformer trunk: returns (hidden [B, T, D], updated kv_stack).
 
@@ -276,13 +278,15 @@ def forward(
     cos = cos_t[positions][:, :, None, :]  # [B, T, 1, hd/2]
     sin = sin_t[positions][:, :, None, :]
     x = params["embed"][tokens].astype(jnp.dtype(cfg.dtype))
+    if attn is None:
+        attn = lambda q, keys, values, m: _grouped_attn(cfg, q, keys, values, m)  # noqa: E731
 
     def body(carry, layer_in):
         lp, layer_kv = layer_in
 
         def attend(q, k_new, v_new):
             new_kv, keys, values = kv_write(layer_kv, k_new, v_new)
-            return _grouped_attn(cfg, q, keys, values, mask), new_kv
+            return attn(q, keys, values, mask), new_kv
 
         y, new_kv = _layer(cfg, carry, lp, cos, sin, attend)
         return y, new_kv

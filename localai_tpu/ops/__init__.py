@@ -1,0 +1,39 @@
+"""TPU kernels (Pallas) and their selection policy.
+
+``resolve_attn_impl`` decides the attention implementation for the engine:
+  * "pallas"  — flash kernels (ops.attention), the default on real TPU
+  * "xla"     — pure-XLA grouped attention (models.llama._grouped_attn),
+                the default off-TPU and the numerical reference
+  * "pallas_interpret" — flash kernels in interpreter mode (CPU tests)
+
+Override with env ``LOCALAI_ATTN_IMPL`` or per-runner ``attn_impl=``.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+
+from localai_tpu.ops.attention import decode_attention, prefill_attention
+
+__all__ = [
+    "decode_attention",
+    "prefill_attention",
+    "resolve_attn_impl",
+]
+
+
+def resolve_attn_impl(requested: str = "auto") -> tuple[str, bool]:
+    """Returns (impl, interpret) with impl in {"xla", "pallas"}."""
+    impl = requested
+    if impl in ("auto", ""):
+        # env only overrides the default, never an explicit per-runner choice
+        impl = os.environ.get("LOCALAI_ATTN_IMPL", "") or "auto"
+    if impl in ("auto", ""):
+        impl = "pallas" if jax.default_backend() == "tpu" else "xla"
+    if impl == "pallas_interpret":
+        return "pallas", True
+    if impl not in ("pallas", "xla"):
+        raise ValueError(f"unknown attention impl {impl!r}")
+    return impl, impl == "pallas" and jax.default_backend() != "tpu"

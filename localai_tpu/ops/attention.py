@@ -1,0 +1,264 @@
+"""Pallas TPU flash-attention kernels for the serving engine.
+
+The XLA path (models.llama._grouped_attn) materializes the full score tensor
+[S, Hkv, g, T, L] in float32 — at long context that is the HBM-bandwidth
+bottleneck of decode. These kernels keep K/V in HBM and stream them through
+VMEM in ``block_k`` chunks with double-buffered async DMA and an online
+softmax (flash attention), so per (slot, kv-head) the VMEM working set is
+O(block_k · hd) regardless of context length, and only blocks inside the
+[sliding-window, causal/length] frontier are ever fetched.
+
+Replaces (TPU-era) the reference's per-slot CPU attention inside llama.cpp's
+``llama_decode`` hot loop (/root/reference/backend/cpp/llama/
+grpc-server.cpp:1546-1990). Two shapes of the same kernel:
+
+  * ``decode_attention`` — q is one token per slot, KV is the slot cache
+    [S, C, Hkv, hd]; grid (S, Hkv); the GQA group (g = Hq/Hkv queries) forms
+    the row dimension of the MXU matmul. Masking comes from per-slot write
+    positions, not a materialized mask.
+  * ``prefill_attention`` — single-sequence causal attention [T, ...];
+    grid (Hkv, T/block_q); rows are (q-position × group) pairs; KV blocks
+    beyond the causal frontier or the real prompt length are not fetched.
+
+Both run under ``interpret=True`` on CPU for tests (tests/test_ops.py) and
+compile to Mosaic on real TPU. Sliding-window (Mistral) masking is supported
+statically.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30
+
+
+def _pick_block(total: int, target: int) -> int:
+    """Largest divisor of ``total`` that is ≤ target (keeps grids exact)."""
+    b = min(total, target)
+    while total % b:
+        b -= 1
+    return b
+
+
+def _flash_loop(q, kv_slice, kbuf, vbuf, ksem, vsem, lo, nb, block_k,
+                mask_for_block):
+    """Online-softmax loop over KV blocks [lo, nb) with double-buffered DMA.
+
+    q: [rows, hd] f32 (pre-scaled). ``kv_slice(hbm_ref, i)`` yields the
+    [block_k, hd] HBM slice for block i; ``mask_for_block(i)`` the
+    [rows or 1, block_k] keep-mask. Returns the attention output [rows, hd].
+    """
+    k_hbm, v_hbm = kv_slice
+    rows, hd = q.shape
+
+    def start(i, slot):
+        pltpu.make_async_copy(k_hbm(i), kbuf.at[slot], ksem.at[slot]).start()
+        pltpu.make_async_copy(v_hbm(i), vbuf.at[slot], vsem.at[slot]).start()
+
+    def wait(i, slot):
+        pltpu.make_async_copy(k_hbm(i), kbuf.at[slot], ksem.at[slot]).wait()
+        pltpu.make_async_copy(v_hbm(i), vbuf.at[slot], vsem.at[slot]).wait()
+
+    start(lo, 0)
+
+    def body(i, carry):
+        m, l, acc = carry
+        slot = lax.rem(i - lo, 2)
+
+        @pl.when(i + 1 < nb)
+        def _prefetch():
+            start(i + 1, lax.rem(i + 1 - lo, 2))
+
+        wait(i, slot)
+        k = kbuf[slot].astype(jnp.float32)
+        v = vbuf[slot].astype(jnp.float32)
+        s = q @ k.T  # [rows, block_k] — MXU
+        s = jnp.where(mask_for_block(i), s, _NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = acc * alpha + p @ v
+        return m_new, l_new, acc_new
+
+    m0 = jnp.full((rows, 1), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((rows, 1), jnp.float32)
+    acc0 = jnp.zeros((rows, hd), jnp.float32)
+    m, l, acc = lax.fori_loop(lo, nb, body, (m0, l0, acc0))
+    return acc / jnp.maximum(l, 1e-30)
+
+
+# ---------------------------------------------------------------------------
+# decode: one token per slot over the slot KV cache
+# ---------------------------------------------------------------------------
+
+
+def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref,
+                   kbuf, vbuf, ksem, vsem, *, block_k: int,
+                   sm_scale: float, sliding_window: Optional[int]):
+    pos = pos_ref[0]
+    q = q_ref[0, 0].astype(jnp.float32) * sm_scale  # [g, hd]
+    ctx = k_ref.shape[1]
+
+    nb = jnp.minimum(pos // block_k + 1, ctx // block_k)
+    lo = jnp.int32(0)
+    if sliding_window is not None:
+        lo = jnp.maximum((pos - sliding_window + 1) // block_k, 0)
+
+    def slice_of(ref):
+        return lambda i: ref.at[0, pl.ds(i * block_k, block_k), 0, :]
+
+    def mask_for_block(i):
+        idx = i * block_k + lax.broadcasted_iota(jnp.int32, (1, block_k), 1)
+        keep = idx <= pos
+        if sliding_window is not None:
+            keep &= idx > pos - sliding_window
+        return keep
+
+    out = _flash_loop(q, (slice_of(k_ref), slice_of(v_ref)),
+                      kbuf, vbuf, ksem, vsem, lo, nb, block_k, mask_for_block)
+    o_ref[0, 0] = out.astype(o_ref.dtype)
+
+
+def decode_attention(
+    q: jax.Array,            # [S, Hq, hd]
+    k_cache: jax.Array,      # [S, C, Hkv, hd]
+    v_cache: jax.Array,      # [S, C, Hkv, hd]
+    positions: jax.Array,    # [S] i32 — current token's KV write position
+    *,
+    sliding_window: Optional[int] = None,
+    block_k: int = 512,
+    interpret: bool = False,
+) -> jax.Array:
+    """Flash GQA decode attention over the slot cache. Returns [S, Hq, hd]."""
+    S, Hq, hd = q.shape
+    C, Hkv = k_cache.shape[1], k_cache.shape[2]
+    g = Hq // Hkv
+    bk = _pick_block(C, block_k)
+    qg = q.reshape(S, Hkv, g, hd)
+
+    kernel = functools.partial(
+        _decode_kernel, block_k=bk, sm_scale=hd ** -0.5,
+        sliding_window=sliding_window,
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid=(S, Hkv),
+        in_specs=[
+            pl.BlockSpec((1,), lambda s, h: (s,), memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, 1, g, hd), lambda s, h: (s, h, 0, 0)),
+            # K/V stay in HBM; the kernel streams block_k slices via DMA
+            pl.BlockSpec((1, C, 1, hd), lambda s, h: (s, 0, h, 0),
+                         memory_space=pl.ANY),
+            pl.BlockSpec((1, C, 1, hd), lambda s, h: (s, 0, h, 0),
+                         memory_space=pl.ANY),
+        ],
+        out_specs=pl.BlockSpec((1, 1, g, hd), lambda s, h: (s, h, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((S, Hkv, g, hd), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((2, bk, hd), k_cache.dtype),
+            pltpu.VMEM((2, bk, hd), v_cache.dtype),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+        interpret=interpret,
+    )(positions.astype(jnp.int32), qg, k_cache, v_cache)
+    return out.reshape(S, Hq, hd)
+
+
+# ---------------------------------------------------------------------------
+# prefill: single-sequence causal attention
+# ---------------------------------------------------------------------------
+
+
+def _prefill_kernel(len_ref, q_ref, k_ref, v_ref, o_ref,
+                    kbuf, vbuf, ksem, vsem, *, block_q: int, block_k: int,
+                    groups: int, sm_scale: float,
+                    sliding_window: Optional[int]):
+    length = len_ref[0]
+    qi = pl.program_id(1)
+    hd = q_ref.shape[3]
+    T = k_ref.shape[0]
+    rows = block_q * groups
+    q = q_ref[:, 0].astype(jnp.float32).reshape(rows, hd) * sm_scale
+    # row r ↦ absolute q position
+    qpos = qi * block_q + lax.broadcasted_iota(jnp.int32, (rows, 1), 0) // groups
+
+    # trip range: causal frontier ∧ real length, minus sub-window blocks
+    nb_causal = ((qi + 1) * block_q + block_k - 1) // block_k
+    nb_len = (length + block_k - 1) // block_k
+    nb = jnp.minimum(jnp.minimum(nb_causal, nb_len), T // block_k)
+    lo = jnp.int32(0)
+    if sliding_window is not None:
+        lo = jnp.maximum((qi * block_q - sliding_window + 1) // block_k, 0)
+    # rows entirely past `length` are garbage either way; keep the loop
+    # non-empty so the DMA pipeline stays well-formed
+    nb = jnp.maximum(nb, lo + 1)
+
+    def slice_of(ref):
+        return lambda i: ref.at[pl.ds(i * block_k, block_k), 0, :]
+
+    def mask_for_block(i):
+        kj = i * block_k + lax.broadcasted_iota(jnp.int32, (1, block_k), 1)
+        keep = (kj <= qpos) & (kj < length)
+        if sliding_window is not None:
+            keep &= kj > qpos - sliding_window
+        return keep
+
+    out = _flash_loop(q, (slice_of(k_ref), slice_of(v_ref)),
+                      kbuf, vbuf, ksem, vsem, lo, nb, block_k, mask_for_block)
+    o_ref[:] = out.reshape(block_q, 1, groups, hd).astype(o_ref.dtype)
+
+
+def prefill_attention(
+    q: jax.Array,         # [T, Hq, hd]
+    k: jax.Array,         # [T, Hkv, hd]
+    v: jax.Array,         # [T, Hkv, hd]
+    length: jax.Array,    # scalar i32 — real (unpadded) sequence length
+    *,
+    sliding_window: Optional[int] = None,
+    block_q: int = 256,
+    block_k: int = 512,
+    interpret: bool = False,
+) -> jax.Array:
+    """Flash causal GQA prefill attention. Returns [T, Hq, hd]."""
+    T, Hq, hd = q.shape
+    Hkv = k.shape[1]
+    g = Hq // Hkv
+    bq = _pick_block(T, block_q)
+    bk = _pick_block(T, block_k)
+    qg = q.reshape(T, Hkv, g, hd)
+
+    kernel = functools.partial(
+        _prefill_kernel, block_q=bq, block_k=bk, groups=g,
+        sm_scale=hd ** -0.5, sliding_window=sliding_window,
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid=(Hkv, T // bq),
+        in_specs=[
+            pl.BlockSpec((1,), lambda h, i: (0,), memory_space=pltpu.SMEM),
+            pl.BlockSpec((bq, 1, g, hd), lambda h, i: (i, h, 0, 0)),
+            pl.BlockSpec((T, 1, hd), lambda h, i: (0, h, 0),
+                         memory_space=pl.ANY),
+            pl.BlockSpec((T, 1, hd), lambda h, i: (0, h, 0),
+                         memory_space=pl.ANY),
+        ],
+        out_specs=pl.BlockSpec((bq, 1, g, hd), lambda h, i: (i, h, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((T, Hkv, g, hd), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((2, bk, hd), k.dtype),
+            pltpu.VMEM((2, bk, hd), v.dtype),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+        interpret=interpret,
+    )(jnp.reshape(length, (1,)).astype(jnp.int32), qg, k, v)
+    return out.reshape(T, Hq, hd)
