@@ -57,12 +57,20 @@ async def tokenize(request: web.Request) -> web.Response:
     if not model:
         raise web.HTTPNotFound(text="no models configured")
     content = body.get("content") or body.get("prompt") or ""
-    sm, _cfg = _serving(request, OpenAIRequest(model=model))
+    sm, _cfg = await _serving(request, OpenAIRequest(model=model))
     ids = sm.tokenizer.encode(str(content), add_bos=False)
     return web.json_response({"tokens": ids})
 
 
-async def metrics(_request: web.Request) -> web.Response:
+async def metrics(request: web.Request) -> web.Response:
+    # refresh token/slot series from live engine state at scrape time
+    # (counters are monotone: scheduler totals only grow)
+    for name, m in _state(request).manager.metrics().items():
+        REGISTRY.tokens_prompt.set_total(m["total_prompt_tokens"], model=name)
+        REGISTRY.tokens_generated.set_total(
+            m["total_generated_tokens"], model=name
+        )
+        REGISTRY.active_slots.set(len(m["active_slots"]), model=name)
     return web.Response(
         text=REGISTRY.render(),
         content_type="text/plain",

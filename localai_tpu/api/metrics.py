@@ -76,6 +76,12 @@ class Counter:
         with self._lock:
             self._series[key] = self._series.get(key, 0.0) + value
 
+    def set_total(self, value: float, **labels: str) -> None:
+        """Sync the series to an externally tracked monotone total."""
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            self._series[key] = max(self._series.get(key, 0.0), value)
+
     def render(self) -> str:
         lines = [f"# HELP {self.name} {self.help}",
                  f"# TYPE {self.name} counter"]

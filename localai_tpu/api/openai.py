@@ -45,7 +45,10 @@ async def _read_request(request: web.Request) -> sc.OpenAIRequest:
         body = await request.json()
     except Exception:
         raise web.HTTPBadRequest(text="invalid JSON body")
-    req = sc.OpenAIRequest.model_validate(body)
+    try:
+        req = sc.OpenAIRequest.model_validate(body)
+    except Exception as e:  # pydantic ValidationError → client error, not 500
+        raise web.HTTPBadRequest(text=f"invalid request: {e}") from None
     if not req.model:
         req.model = request.match_info.get("model", "")
     if not req.model:
@@ -58,8 +61,8 @@ async def _read_request(request: web.Request) -> sc.OpenAIRequest:
     return req
 
 
-def _serving(request: web.Request, req: sc.OpenAIRequest,
-             usecase: Optional[Usecase] = None):
+async def _serving(request: web.Request, req: sc.OpenAIRequest,
+                   usecase: Optional[Usecase] = None):
     state = _state(request)
     mcfg = state.loader.get(req.model)
     if mcfg is None:
@@ -72,7 +75,8 @@ def _serving(request: web.Request, req: sc.OpenAIRequest,
             text=f"model {req.model!r} does not support {usecase.value}"
         )
     try:
-        return state.manager.get(req.model), mcfg
+        # lazy weight load + jit can take minutes — keep it off the loop
+        return await _in_executor(request, state.manager.get, req.model), mcfg
     except FileNotFoundError as e:
         raise web.HTTPInternalServerError(text=f"model load failed: {e}")
 
@@ -85,13 +89,25 @@ async def _in_executor(request: web.Request, fn, *args):
     )
 
 
+async def _await_handles(request: web.Request, handles, timeout: float = 600.0):
+    """Wait for generations, cancelling them all if the client goes away
+    (otherwise orphaned work would hold decode slots to max_tokens)."""
+    try:
+        for h in handles:
+            await _in_executor(request, h.result, timeout)
+    except BaseException:
+        for h in handles:
+            h.cancel()
+        raise
+
+
 # ---------------------------------------------------------------------------
 # /v1/chat/completions
 
 
 async def chat(request: web.Request) -> web.StreamResponse:
     req = await _read_request(request)
-    sm, base_cfg = _serving(request, req, Usecase.CHAT)
+    sm, base_cfg = await _serving(request, req, Usecase.CHAT)
     cfg = inf.merge_request(base_cfg, req)
 
     tctx = await _in_executor(request, inf.prepare_tools, sm, cfg, req)
@@ -138,11 +154,11 @@ async def chat(request: web.Request) -> web.StreamResponse:
         else:
             gr_i = gr
         handles.append(sm.scheduler.submit(gr_i))
+    await _await_handles(request, handles)
     choices = []
     total_completion = 0
     prompt_tokens = 0
     for i, h in enumerate(handles):
-        await _in_executor(request, h.result, 600.0)
         text = inf.finetune_result(cfg, prompt, h.text)
         prompt_tokens = h.prompt_tokens
         total_completion += h.completion_tokens
@@ -179,18 +195,23 @@ async def _chat_stream(request, req, sm, cfg, gr, rid, tctx
     handle = sm.scheduler.submit(gr)
     buffered: list[str] = []
     finish = "stop"
-    async for item in aiter_handle(handle):
-        if item.finish_reason is not None:
-            finish = item.finish_reason
-            break
-        if not item.delta:
-            continue
-        if tctx is not None:
-            buffered.append(item.delta)
-        else:
-            await resp.write(sse_event(sc.chat_chunk(
-                rid, req.model, {"content": item.delta}
-            )))
+    try:
+        async for item in aiter_handle(handle):
+            if item.finish_reason is not None:
+                finish = item.finish_reason
+                break
+            if not item.delta:
+                continue
+            if tctx is not None:
+                buffered.append(item.delta)
+            else:
+                await resp.write(sse_event(sc.chat_chunk(
+                    rid, req.model, {"content": item.delta}
+                )))
+    except BaseException:
+        # client went away mid-stream — free the decode slot immediately
+        handle.cancel()
+        raise
     if tctx is not None:
         text = inf.finetune_result(cfg, "", "".join(buffered))
         content, tool_calls = inf.parse_tool_calls(text, tctx)
@@ -219,7 +240,7 @@ async def _chat_stream(request, req, sm, cfg, gr, rid, tctx
 
 async def completions(request: web.Request) -> web.StreamResponse:
     req = await _read_request(request)
-    sm, base_cfg = _serving(request, req, Usecase.COMPLETION)
+    sm, base_cfg = await _serving(request, req, Usecase.COMPLETION)
     cfg = inf.merge_request(base_cfg, req)
     rid = sc.new_id("cmpl")
 
@@ -239,17 +260,22 @@ async def completions(request: web.Request) -> web.StreamResponse:
             inf.build_gen_request(sm, cfg, req, templated[0])
         )
         finish = "stop"
-        async for item in aiter_handle(handle):
-            if item.finish_reason is not None:
-                finish = item.finish_reason
-                break
-            if item.delta:
-                await resp.write(sse_event(sc.completion_response(
-                    rid, req.model,
-                    [{"index": 0, "text": item.delta,
-                      "finish_reason": None}],
-                    sc.usage(handle.prompt_tokens, handle.completion_tokens),
-                )))
+        try:
+            async for item in aiter_handle(handle):
+                if item.finish_reason is not None:
+                    finish = item.finish_reason
+                    break
+                if item.delta:
+                    await resp.write(sse_event(sc.completion_response(
+                        rid, req.model,
+                        [{"index": 0, "text": item.delta,
+                          "finish_reason": None}],
+                        sc.usage(handle.prompt_tokens,
+                                 handle.completion_tokens),
+                    )))
+        except BaseException:
+            handle.cancel()
+            raise
         await resp.write(sse_event(sc.completion_response(
             rid, req.model, [{"index": 0, "text": "",
                               "finish_reason": finish}],
@@ -270,8 +296,8 @@ async def completions(request: web.Request) -> web.StreamResponse:
                 sm, cfg, req, prompt, seed_offset=i))
             for i in range(n)
         ]
+        await _await_handles(request, handles)
         for h in handles:
-            await _in_executor(request, h.result, 600.0)
             text = inf.finetune_result(cfg, raw, h.text, echo=req.echo)
             prompt_total += h.prompt_tokens
             completion_total += h.completion_tokens
@@ -288,7 +314,7 @@ async def completions(request: web.Request) -> web.StreamResponse:
 
 async def edits(request: web.Request) -> web.Response:
     req = await _read_request(request)
-    sm, base_cfg = _serving(request, req, Usecase.EDIT)
+    sm, base_cfg = await _serving(request, req, Usecase.EDIT)
     cfg = inf.merge_request(base_cfg, req)
     rid = sc.new_id("edit")
     inputs: list[str]
@@ -302,7 +328,7 @@ async def edits(request: web.Request) -> web.Response:
         prompt = build_edit_prompt(sm.templates, cfg, text_in,
                                    req.instruction)
         h = sm.scheduler.submit(inf.build_gen_request(sm, cfg, req, prompt))
-        await _in_executor(request, h.result, 600.0)
+        await _await_handles(request, [h])
         ptotal += h.prompt_tokens
         ctotal += h.completion_tokens
         choices.append({
@@ -322,7 +348,7 @@ async def edits(request: web.Request) -> web.Response:
 
 async def embeddings(request: web.Request) -> web.Response:
     req = await _read_request(request)
-    sm, base_cfg = _serving(request, req, Usecase.EMBEDDINGS)
+    sm, base_cfg = await _serving(request, req, Usecase.EMBEDDINGS)
 
     inputs: list[Any]
     if req.input is None:

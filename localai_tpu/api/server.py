@@ -83,9 +83,13 @@ async def metrics_middleware(request: web.Request, handler):
     try:
         return await handler(request)
     finally:
+        # label by matched route pattern, not the raw URL — raw paths are
+        # attacker-controlled and would grow the registry without bound
+        resource = getattr(request.match_info.route, "resource", None)
+        canonical = getattr(resource, "canonical", None) or "(unmatched)"
         REGISTRY.api_call.observe(
             time.perf_counter() - t0,
-            method=request.method, path=request.path,
+            method=request.method, path=canonical,
         )
 
 

@@ -145,16 +145,16 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     if cmd == "tokenize":
         os.environ.setdefault("JAX_PLATFORMS", "cpu")
         from localai_tpu.config.loader import ConfigLoader
-        from localai_tpu.models.manager import ModelManager
+        from localai_tpu.models.registry import resolve_tokenizer
 
         loader = ConfigLoader(args.models_path)
         loader.load_from_path()
-        from localai_tpu.config.app_config import AppConfig
-
-        manager = ModelManager(AppConfig(model_path=args.models_path), loader)
-        sm = manager.get(args.model)
-        print(sm.tokenizer.encode(args.text))
-        manager.shutdown_all()
+        mcfg = loader.get(args.model)
+        if mcfg is None:
+            parser.error(f"model {args.model!r} not found")
+        # tokenizer-only: never pull weights/KV into RAM just to encode
+        tok = resolve_tokenizer(mcfg.model, args.models_path)
+        print(tok.encode(args.text))
         return 0
 
     if cmd == "worker":

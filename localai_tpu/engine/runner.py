@@ -252,8 +252,10 @@ class ModelRunner:
             attn=self._prefill_attn(length),
         )
         valid = (jnp.arange(bucket) < length)[None, :, None]
-        summed = jnp.sum(hidden * valid, axis=1)
-        pooled = summed / jnp.maximum(length, 1).astype(hidden.dtype)
+        # pool in f32: a bf16 sum over thousands of positions loses the
+        # precision the embeddings exist to provide
+        summed = jnp.sum((hidden * valid).astype(jnp.float32), axis=1)
+        pooled = summed / jnp.maximum(length, 1).astype(jnp.float32)
         return pooled[0]
 
     def _prefill_attn(self, length):

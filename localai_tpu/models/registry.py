@@ -86,3 +86,14 @@ def resolve_model(
         f"model ref {ref!r} not found (looked for config.json under {ref} and "
         f"{Path(model_path) / ref})"
     )
+
+
+def resolve_tokenizer(ref: str, model_path: str | Path = "models"):
+    """Tokenizer-only resolution — never touches weights (the tokenize CLI
+    and API must not pull GBs of params into RAM to encode a string)."""
+    if ref.startswith("debug:"):
+        return ByteTokenizer()
+    for cand in (Path(ref), Path(model_path) / ref):
+        if cand.is_dir():
+            return load_tokenizer(cand)
+    raise FileNotFoundError(f"model ref {ref!r} not found under {model_path}")

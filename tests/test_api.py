@@ -317,6 +317,27 @@ def test_bad_json_400(client):
     assert r.status_code == 400
 
 
+def test_schema_mismatch_400(client):
+    """Valid JSON, wrong shape → 400 invalid_request_error, never a 500."""
+    r = client.post("/v1/chat/completions", json={"messages": "hi"})
+    assert r.status_code == 400
+
+
+def test_metrics_token_series(client):
+    client.post("/v1/chat/completions", json={
+        "model": "tiny",
+        "messages": [{"role": "user", "content": "count me"}],
+        "max_tokens": 4,
+    })
+    r = client.get("/metrics")
+    assert r.status_code == 200
+    body = r.text
+    assert 'localai_tokens_generated_total{model="tiny"}' in body
+    assert 'localai_prompt_tokens_total{model="tiny"}' in body
+    # histogram series must be labeled by route pattern, not raw path
+    assert 'path="/v1/chat/completions"' in body
+
+
 def test_auth_enforced(tmp_path):
     models = tmp_path / "models"
     models.mkdir()
