@@ -1,0 +1,156 @@
+"""Ring attention: sequence/context parallelism for long-context prefill.
+
+The reference has NO sequence parallelism — long context is a single-device
+concern handled by RoPE scaling and self-extend inside llama.cpp
+(SURVEY.md §5.7, /root/reference/backend/cpp/llama/grpc-server.cpp:1884-1886).
+On TPU, context length scales across the 'seq' mesh axis instead: the
+sequence is chunked over devices, each device computes blockwise attention
+between its query chunk and a rotating KV chunk, and the KV chunks travel
+the ICI ring via ``lax.ppermute`` (Ring Attention, arXiv:2310.01889-style;
+the blockwise online-softmax merge is the same math as the Pallas flash
+kernels in ops.attention).
+
+Communication pattern per layer: n_seq - 1 ppermute hops of one KV chunk
+(2 · Tc · Hkv · hd elements) fully overlapped with the chunk attention
+matmuls by XLA's latency-hiding scheduler; no all-to-all, no gather of the
+full sequence on any device.
+
+``sp_prefill_forward`` runs the whole llama trunk under shard_map with
+activations sharded on 'seq', reusing models.llama._layer so the math stays
+in one place. Params must be replicated across the 'seq' axis (TP×SP
+composition is tracked as future work); the returned per-layer K/V is
+'seq'-sharded and ready for slot-cache insertion.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+shard_map = jax.shard_map
+
+from localai_tpu.models import llama as mdl
+from localai_tpu.models.llama import LlamaConfig
+
+_NEG_INF = -1e30
+
+
+def ring_attention(
+    q: jax.Array,          # [Tc, Hq, hd] — this device's query chunk
+    k: jax.Array,          # [Tc, Hkv, hd] — this device's KV chunk
+    v: jax.Array,          # [Tc, Hkv, hd]
+    length: jax.Array,     # scalar i32 — real (unpadded) global length
+    *,
+    n_chunks: int,         # static: size of the 'seq' axis
+    axis_name: str = "seq",
+    sliding_window: int | None = None,
+) -> jax.Array:
+    """Causal GQA ring attention inside shard_map. Returns [Tc, Hq, hd].
+
+    The q-chunk's global offset is derived from ``lax.axis_index`` — chunk
+    layout and mask can never disagree.
+    """
+    Tc, Hq, hd = q.shape
+    Hkv = k.shape[1]
+    g = Hq // Hkv
+    i = lax.axis_index(axis_name)
+
+    qg = q.reshape(Tc, Hkv, g, hd).astype(jnp.float32) * hd ** -0.5
+    qpos = i * Tc + jnp.arange(Tc, dtype=jnp.int32)
+    perm = [(p, (p + 1) % n_chunks) for p in range(n_chunks)]
+
+    def update(s, k_c, v_c, m, l, acc):
+        j = lax.rem(i - s + n_chunks, n_chunks)  # owner of the chunk in hand
+        kpos = j * Tc + jnp.arange(Tc, dtype=jnp.int32)
+        scores = jnp.einsum(
+            "tkgh,lkh->kgtl", qg, k_c.astype(jnp.float32)
+        )
+        keep = (kpos[None, :] <= qpos[:, None]) & (kpos < length)[None, :]
+        if sliding_window is not None:
+            keep &= kpos[None, :] > qpos[:, None] - sliding_window
+        scores = jnp.where(keep[None, None], scores, _NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(scores, axis=-1, keepdims=True))
+        p = jnp.exp(scores - m_new)
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = acc * alpha + jnp.einsum(
+            "kgtl,lkh->kgth", p, v_c.astype(jnp.float32)
+        )
+        return m_new, l_new, acc_new
+
+    m0 = jnp.full((Hkv, g, Tc, 1), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((Hkv, g, Tc, 1), jnp.float32)
+    acc0 = jnp.zeros((Hkv, g, Tc, hd), jnp.float32)
+    # local chunk first, then exactly n_chunks-1 ring hops: each body
+    # iteration rotates the KV chunk one device along ICI, then folds it in
+    carry = (k, v) + update(0, k, v, m0, l0, acc0)
+
+    def body(s, carry):
+        k_c, v_c, m, l, acc = carry
+        k_c = lax.ppermute(k_c, axis_name, perm)
+        v_c = lax.ppermute(v_c, axis_name, perm)
+        return (k_c, v_c) + update(s, k_c, v_c, m, l, acc)
+
+    _, _, _, l, acc = lax.fori_loop(1, n_chunks, body, carry)
+    out = acc / jnp.maximum(l, 1e-30)            # [Hkv, g, Tc, hd]
+    out = out.transpose(2, 0, 1, 3).reshape(Tc, Hq, hd)
+    return out.astype(q.dtype)
+
+
+def sp_prefill_forward(
+    cfg: LlamaConfig,
+    params: Any,
+    tokens: jax.Array,     # [T] i32, T divisible by mesh 'seq' size
+    length: jax.Array,     # scalar i32
+    mesh: Mesh,
+    rope: tuple[jax.Array, jax.Array],
+) -> tuple[jax.Array, tuple[jax.Array, jax.Array]]:
+    """Sequence-parallel prefill of one long sequence.
+
+    Returns (hidden [1, T, D], (k, v) each [L, T, Hkv, hd]) with T sharded
+    on the 'seq' axis — the K/V stack is handed to the slot cache writer.
+    """
+    n = mesh.shape["seq"]
+    T = tokens.shape[0]
+    if T % n:
+        raise ValueError(f"sequence length {T} not divisible by seq={n}")
+    Tc = T // n
+    dtype = jnp.dtype(cfg.dtype)
+
+    def local_fn(params, tokens_c, length, cos_t, sin_t):
+        i = lax.axis_index("seq")
+        positions = i * Tc + jnp.arange(Tc, dtype=jnp.int32)
+        cos = cos_t[positions][None, :, None, :]
+        sin = sin_t[positions][None, :, None, :]
+        x = params["embed"][tokens_c[None]].astype(dtype)
+
+        def body(carry, lp):
+            def attend(q, k_new, v_new):
+                out = ring_attention(
+                    q[0], k_new[0], v_new[0], length,
+                    n_chunks=n, sliding_window=cfg.sliding_window,
+                )
+                return out[None], (k_new[0], v_new[0])
+
+            return mdl._layer(cfg, carry, lp, cos, sin, attend)
+
+        x, kvs = lax.scan(body, x, params["layers"])
+        x = mdl.rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+        return x, kvs
+
+    pspec = jax.tree.map(lambda _: P(), params)
+    fn = shard_map(
+        local_fn,
+        mesh=mesh,
+        in_specs=(pspec, P("seq"), P(), P(), P()),
+        out_specs=(
+            P(None, "seq", None),
+            (P(None, "seq", None, None), P(None, "seq", None, None)),
+        ),
+        check_vma=False,
+    )
+    return fn(params, tokens, length, rope[0], rope[1])
