@@ -6,7 +6,10 @@ grpc-server.cpp:176,906,1546-1990) with a TPU-native layout: one statically
 shaped tensor pair per model, stacked over layers so the layer loop can
 ``lax.scan`` it, sliced per slot by masking — never by ragged mutation.
 
-Layout: k,v each [num_layers, num_slots, max_ctx, num_kv_heads, head_dim].
+Layout: k,v each [num_layers, num_slots, num_kv_heads, max_ctx, head_dim].
+Heads lead the context dim so the last two axes are (context, head_dim) —
+the (sublane, lane) tiling Mosaic requires for the flash kernels' per-head
+HBM→VMEM DMA slices (ops.attention), and a contiguous stream per head.
 All updates are functional; jit donation makes them in-place in HBM.
 """
 
@@ -34,7 +37,7 @@ class KVCache:
 
     @property
     def max_ctx(self) -> int:
-        return self.k.shape[2]
+        return self.k.shape[3]
 
 
 def init_cache(
@@ -44,7 +47,7 @@ def init_cache(
     dtype: str = "bfloat16",
     sharding: Optional[jax.sharding.Sharding] = None,
 ) -> KVCache:
-    shape = (cfg.num_layers, num_slots, max_ctx, cfg.num_kv_heads, cfg.hd)
+    shape = (cfg.num_layers, num_slots, cfg.num_kv_heads, max_ctx, cfg.hd)
     dt = jnp.dtype(dtype)
     if sharding is not None:
         zeros = jax.jit(
@@ -61,15 +64,17 @@ def decode_write(positions: jax.Array):
 
     positions: [S] — write location per slot. Returns a ``kv_write`` closure
     for models.llama.forward: writes k/v_new [S, 1, H, hd] at
-    cache[s, positions[s]] and exposes the full per-layer cache as keys.
-    """
+    cache[s, :, positions[s]] and exposes the full per-layer cache as keys
+    ([S, H, C, hd])."""
 
     def write(layer_kv, k_new, v_new):
-        k_layer, v_layer = layer_kv  # [S, C, H, hd]
+        k_layer, v_layer = layer_kv  # [S, H, C, hd]
         s = jnp.arange(k_layer.shape[0])
         kdt = k_layer.dtype
-        new_k = k_layer.at[s, positions].set(k_new[:, 0].astype(kdt))
-        new_v = v_layer.at[s, positions].set(v_new[:, 0].astype(kdt))
+        # advanced indices (s, positions) separated by the head slice →
+        # result dims [S, H, hd], matching k_new[:, 0]
+        new_k = k_layer.at[s, :, positions].set(k_new[:, 0].astype(kdt))
+        new_v = v_layer.at[s, :, positions].set(v_new[:, 0].astype(kdt))
         return (new_k, new_v), new_k.astype(k_new.dtype), new_v.astype(v_new.dtype)
 
     return write
@@ -78,18 +83,20 @@ def decode_write(positions: jax.Array):
 def prefill_write(slot: jax.Array, offset: jax.Array):
     """KV write policy for single-sequence prefill into one slot.
 
-    Writes the whole chunk [1, T, H, hd] at cache[slot, offset:offset+T] and
-    attends over the chunk itself (fresh context ⇒ T² attention, not T·C).
-    """
+    Writes the whole chunk [1, T, H, hd] at cache[slot, :, offset:offset+T]
+    and attends over the chunk itself (fresh context ⇒ T² attention, not
+    T·C). Keys are exposed head-major: [1, H, T, hd]."""
 
     def write(layer_kv, k_new, v_new):
-        k_layer, v_layer = layer_kv  # [S, C, H, hd]
+        k_layer, v_layer = layer_kv  # [S, H, C, hd]
         kdt = k_layer.dtype
+        k_hm = k_new.transpose(0, 2, 1, 3)  # [1, H, T, hd]
+        v_hm = v_new.transpose(0, 2, 1, 3)
         zero = jnp.zeros((), jnp.int32)
-        idx = (slot, offset, zero, zero)
-        new_k = lax.dynamic_update_slice(k_layer, k_new.astype(kdt), idx)
-        new_v = lax.dynamic_update_slice(v_layer, v_new.astype(kdt), idx)
-        return (new_k, new_v), k_new, v_new
+        idx = (slot, zero, offset, zero)
+        new_k = lax.dynamic_update_slice(k_layer, k_hm.astype(kdt), idx)
+        new_v = lax.dynamic_update_slice(v_layer, v_hm.astype(kdt), idx)
+        return (new_k, new_v), k_hm, v_hm
 
     return write
 

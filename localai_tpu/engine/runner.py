@@ -92,6 +92,15 @@ class ModelRunner:
             self.attn_impl, self._attn_interpret = "xla", False
         else:
             self.attn_impl, self._attn_interpret = ops.resolve_attn_impl(attn_impl)
+        if (self.attn_impl == "pallas" and not self._attn_interpret
+                and (cfg.hd % 128 or (max_ctx or cfg.max_position_embeddings) % 128)):
+            # Mosaic lane tiling is 128-wide; unaligned head_dim/ctx (tiny
+            # debug models, hd-64 families) take the XLA path on real TPU
+            log.info(
+                "attention: head_dim=%d ctx=%s not 128-aligned; using XLA",
+                cfg.hd, max_ctx,
+            )
+            self.attn_impl = "xla"
         self.num_slots = num_slots
         self.max_ctx = max_ctx or cfg.max_position_embeddings
         self.mesh = mesh
@@ -162,7 +171,7 @@ class ModelRunner:
         if self.attn_impl == "pallas":
             from localai_tpu import ops
 
-            def attn(q, keys, values, _mask):  # q [S,1,Hq,hd], keys [S,C,Hkv,hd]
+            def attn(q, keys, values, _mask):  # q [S,1,Hq,hd], keys [S,Hkv,C,hd]
                 out = ops.decode_attention(
                     q[:, 0], keys, values, pos,
                     sliding_window=cfg.sliding_window,
@@ -241,7 +250,7 @@ class ModelRunner:
         embeddings.go:13). Uses a throwaway single-sequence KV so it never
         touches serving slots."""
         cfg = self.cfg
-        kv_shape = (cfg.num_layers, 1, bucket, cfg.num_kv_heads, cfg.hd)
+        kv_shape = (cfg.num_layers, 1, cfg.num_kv_heads, bucket, cfg.hd)
         kv = (jnp.zeros(kv_shape, jnp.dtype(self.kv_dtype)),
               jnp.zeros(kv_shape, jnp.dtype(self.kv_dtype)))
         positions = jnp.arange(bucket, dtype=jnp.int32)[None, :]
@@ -266,7 +275,7 @@ class ModelRunner:
 
         cfg = self.cfg
 
-        def attn(q, keys, values, _mask):  # q/keys [1, T, H, hd]
+        def attn(q, keys, values, _mask):  # q [1,T,Hq,hd], keys [1,Hkv,T,hd]
             out = ops.prefill_attention(
                 q[0], keys[0], values[0], length,
                 sliding_window=cfg.sliding_window,

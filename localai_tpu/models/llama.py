@@ -243,17 +243,17 @@ def _layer(cfg: LlamaConfig, x, lp, cos, sin, attend):
 def _grouped_attn(cfg: LlamaConfig, q, keys, values, mask):
     """Grouped-query attention.
 
-    q: [S, T, Hq, hd], keys/values: [S, Lk, Hkv, hd],
+    q: [S, T, Hq, hd], keys/values head-major: [S, Hkv, Lk, hd],
     mask: [S, T, Lk] bool (True = attend). Returns [S, T, Hq, hd].
     """
     S, T = q.shape[0], q.shape[1]
     Hkv, g, hd = cfg.num_kv_heads, cfg.q_per_kv, cfg.hd
     qg = q.reshape(S, T, Hkv, g, hd)
-    scores = jnp.einsum("stkgh,slkh->skgtl", qg, keys) / math.sqrt(hd)
+    scores = jnp.einsum("stkgh,sklh->skgtl", qg, keys) / math.sqrt(hd)
     scores = scores.astype(jnp.float32)
     scores = jnp.where(mask[:, None, None, :, :], scores, -1e30)
     probs = jax.nn.softmax(scores, axis=-1).astype(values.dtype)
-    out = jnp.einsum("skgtl,slkh->stkgh", probs, values)
+    out = jnp.einsum("skgtl,sklh->stkgh", probs, values)
     return out.reshape(S, T, cfg.num_heads, hd)
 
 

@@ -52,6 +52,75 @@ class ServingModel:
         return self.scheduler.busy
 
 
+def build_serving_model(mcfg: ModelConfig, app: AppConfig) -> ServingModel:
+    """Config → live engine: resolve weights, build mesh/shardings, runner,
+    scheduler, tokenizer, templates. Shared by the in-process manager and
+    the gRPC worker tier (localai_tpu.worker.server), so both load paths
+    behave identically."""
+    from localai_tpu.models.registry import resolve_model
+
+    eng = mcfg.engine
+    shard = mcfg.sharding
+    mesh = None
+    t0 = time.monotonic()
+    want_tp = max(1, shard.tensor_parallel_size)
+    want_dp = shard.data_parallel_size  # 0 = auto
+    if want_tp > 1 or want_dp not in (0, 1) or app.mesh_shape:
+        from localai_tpu.parallel.mesh import MeshPlan, build_mesh
+
+        if app.mesh_shape:
+            mesh = build_mesh(MeshPlan(**app.mesh_shape))
+        else:
+            import jax
+
+            nd = len(jax.devices())
+            dp = want_dp or max(1, nd // want_tp)
+            mesh = build_mesh(MeshPlan(data=dp, model=want_tp))
+
+    model = resolve_model(
+        mcfg.model or mcfg.name,
+        model_path=app.model_path,
+        dtype=eng.dtype,
+    )
+    params = model.params
+    if mesh is not None:
+        from localai_tpu.parallel import sharding as shd
+
+        params = shd.shard_params(params, model.cfg, mesh)
+    ctx = mcfg.context_size or app.context_size
+    ctx = min(ctx, model.cfg.max_position_embeddings)
+    runner = ModelRunner(
+        model.cfg,
+        params,
+        num_slots=eng.max_slots,
+        max_ctx=ctx,
+        prefill_buckets=eng.prefill_buckets,
+        kv_dtype=eng.kv_dtype,
+        rope_freq_base=mcfg.rope_freq_base,
+        rope_freq_scale=mcfg.rope_freq_scale,
+        seed=mcfg.seed or 0,
+        mesh=mesh,
+    )
+    scheduler = Scheduler(
+        runner,
+        model.tokenizer,
+        default_max_tokens=mcfg.parameters.max_tokens or 2048,
+    )
+    log.info(
+        "loaded model %s (%s) in %.1fs: slots=%d ctx=%d mesh=%s",
+        mcfg.name, mcfg.model, time.monotonic() - t0,
+        eng.max_slots, ctx, mesh.shape if mesh else None,
+    )
+    return ServingModel(
+        name=mcfg.name,
+        config=mcfg,
+        runner=runner,
+        scheduler=scheduler,
+        tokenizer=model.tokenizer,
+        templates=TemplateCache(app.model_path),
+    )
+
+
 class ModelManager:
     """Thread-safe registry of loaded models (parity: ModelLoader map +
     mutex, loader.go:22-40)."""
@@ -104,68 +173,7 @@ class ModelManager:
             return sm
 
     def _load(self, mcfg: ModelConfig) -> ServingModel:
-        from localai_tpu.models.registry import resolve_model
-
-        eng = mcfg.engine
-        shard = mcfg.sharding
-        mesh = None
-        t0 = time.monotonic()
-        want_tp = max(1, shard.tensor_parallel_size)
-        want_dp = shard.data_parallel_size  # 0 = auto
-        if want_tp > 1 or want_dp not in (0, 1) or self.app.mesh_shape:
-            from localai_tpu.parallel.mesh import MeshPlan, build_mesh
-
-            if self.app.mesh_shape:
-                mesh = build_mesh(MeshPlan(**self.app.mesh_shape))
-            else:
-                import jax
-
-                nd = len(jax.devices())
-                dp = want_dp or max(1, nd // want_tp)
-                mesh = build_mesh(MeshPlan(data=dp, model=want_tp))
-
-        model = resolve_model(
-            mcfg.model or mcfg.name,
-            model_path=self.app.model_path,
-            dtype=eng.dtype,
-        )
-        params = model.params
-        if mesh is not None:
-            from localai_tpu.parallel import sharding as shd
-
-            params = shd.shard_params(params, model.cfg, mesh)
-        ctx = mcfg.context_size or self.app.context_size
-        ctx = min(ctx, model.cfg.max_position_embeddings)
-        runner = ModelRunner(
-            model.cfg,
-            params,
-            num_slots=eng.max_slots,
-            max_ctx=ctx,
-            prefill_buckets=eng.prefill_buckets,
-            kv_dtype=eng.kv_dtype,
-            rope_freq_base=mcfg.rope_freq_base,
-            rope_freq_scale=mcfg.rope_freq_scale,
-            seed=mcfg.seed or 0,
-            mesh=mesh,
-        )
-        scheduler = Scheduler(
-            runner,
-            model.tokenizer,
-            default_max_tokens=mcfg.parameters.max_tokens or 2048,
-        )
-        log.info(
-            "loaded model %s (%s) in %.1fs: slots=%d ctx=%d mesh=%s",
-            mcfg.name, mcfg.model, time.monotonic() - t0,
-            eng.max_slots, ctx, mesh.shape if mesh else None,
-        )
-        return ServingModel(
-            name=mcfg.name,
-            config=mcfg,
-            runner=runner,
-            scheduler=scheduler,
-            tokenizer=model.tokenizer,
-            templates=TemplateCache(self.app.model_path),
-        )
+        return build_serving_model(mcfg, self.app)
 
     # -- shutdown ---------------------------------------------------------
 

@@ -13,9 +13,10 @@ Replaces (TPU-era) the reference's per-slot CPU attention inside llama.cpp's
 grpc-server.cpp:1546-1990). Two shapes of the same kernel:
 
   * ``decode_attention`` — q is one token per slot, KV is the slot cache
-    [S, C, Hkv, hd]; grid (S, Hkv); the GQA group (g = Hq/Hkv queries) forms
-    the row dimension of the MXU matmul. Masking comes from per-slot write
-    positions, not a materialized mask.
+    head-major [S, Hkv, C, hd] (so per-head DMA slices are (context, hd) —
+    the (sublane, lane) tiling Mosaic requires); grid (S, Hkv); the GQA
+    group (g = Hq/Hkv queries) forms the row dimension of the MXU matmul.
+    Masking comes from per-slot write positions, not a materialized mask.
   * ``prefill_attention`` — single-sequence causal attention [T, ...];
     grid (Hkv, T/block_q); rows are (q-position × group) pairs; KV blocks
     beyond the causal frontier or the real prompt length are not fetched.
@@ -103,9 +104,13 @@ def _flash_loop(q, kv_slice, kbuf, vbuf, ksem, vsem, lo, nb, block_k,
 def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref,
                    kbuf, vbuf, ksem, vsem, *, block_k: int,
                    sm_scale: float, sliding_window: Optional[int]):
-    pos = pos_ref[0]
+    # k_ref/v_ref are the FULL [S, Hkv, C, hd] cache in HBM (Mosaic only
+    # allows whole-array ANY refs); slot/head are picked in the DMA slice
+    s_idx = pl.program_id(0)
+    h_idx = pl.program_id(1)
+    pos = pos_ref[s_idx]
     q = q_ref[0, 0].astype(jnp.float32) * sm_scale  # [g, hd]
-    ctx = k_ref.shape[1]
+    ctx = k_ref.shape[2]
 
     nb = jnp.minimum(pos // block_k + 1, ctx // block_k)
     lo = jnp.int32(0)
@@ -113,7 +118,7 @@ def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref,
         lo = jnp.maximum((pos - sliding_window + 1) // block_k, 0)
 
     def slice_of(ref):
-        return lambda i: ref.at[0, pl.ds(i * block_k, block_k), 0, :]
+        return lambda i: ref.at[s_idx, h_idx, pl.ds(i * block_k, block_k), :]
 
     def mask_for_block(i):
         idx = i * block_k + lax.broadcasted_iota(jnp.int32, (1, block_k), 1)
@@ -129,8 +134,8 @@ def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref,
 
 def decode_attention(
     q: jax.Array,            # [S, Hq, hd]
-    k_cache: jax.Array,      # [S, C, Hkv, hd]
-    v_cache: jax.Array,      # [S, C, Hkv, hd]
+    k_cache: jax.Array,      # [S, Hkv, C, hd] head-major slot cache
+    v_cache: jax.Array,      # [S, Hkv, C, hd]
     positions: jax.Array,    # [S] i32 — current token's KV write position
     *,
     sliding_window: Optional[int] = None,
@@ -139,7 +144,7 @@ def decode_attention(
 ) -> jax.Array:
     """Flash GQA decode attention over the slot cache. Returns [S, Hq, hd]."""
     S, Hq, hd = q.shape
-    C, Hkv = k_cache.shape[1], k_cache.shape[2]
+    Hkv, C = k_cache.shape[1], k_cache.shape[2]
     g = Hq // Hkv
     bk = _pick_block(C, block_k)
     qg = q.reshape(S, Hkv, g, hd)
@@ -152,13 +157,13 @@ def decode_attention(
         kernel,
         grid=(S, Hkv),
         in_specs=[
-            pl.BlockSpec((1,), lambda s, h: (s,), memory_space=pltpu.SMEM),
+            # SMEM blocks must cover the whole array; index by slot inside
+            pl.BlockSpec((S,), lambda s, h: (0,), memory_space=pltpu.SMEM),
             pl.BlockSpec((1, 1, g, hd), lambda s, h: (s, h, 0, 0)),
-            # K/V stay in HBM; the kernel streams block_k slices via DMA
-            pl.BlockSpec((1, C, 1, hd), lambda s, h: (s, 0, h, 0),
-                         memory_space=pl.ANY),
-            pl.BlockSpec((1, C, 1, hd), lambda s, h: (s, 0, h, 0),
-                         memory_space=pl.ANY),
+            # K/V stay whole in HBM (ANY refs must be unblocked); the
+            # kernel DMAs block_k slices per (slot, head) itself
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
         ],
         out_specs=pl.BlockSpec((1, 1, g, hd), lambda s, h: (s, h, 0, 0)),
         out_shape=jax.ShapeDtypeStruct((S, Hkv, g, hd), q.dtype),
@@ -182,10 +187,11 @@ def _prefill_kernel(len_ref, q_ref, k_ref, v_ref, o_ref,
                     kbuf, vbuf, ksem, vsem, *, block_q: int, block_k: int,
                     groups: int, sm_scale: float,
                     sliding_window: Optional[int]):
+    h_idx = pl.program_id(0)
     length = len_ref[0]
     qi = pl.program_id(1)
     hd = q_ref.shape[3]
-    T = k_ref.shape[0]
+    T = k_ref.shape[1]
     rows = block_q * groups
     q = q_ref[:, 0].astype(jnp.float32).reshape(rows, hd) * sm_scale
     # row r ↦ absolute q position
@@ -203,7 +209,7 @@ def _prefill_kernel(len_ref, q_ref, k_ref, v_ref, o_ref,
     nb = jnp.maximum(nb, lo + 1)
 
     def slice_of(ref):
-        return lambda i: ref.at[pl.ds(i * block_k, block_k), 0, :]
+        return lambda i: ref.at[h_idx, pl.ds(i * block_k, block_k), :]
 
     def mask_for_block(i):
         kj = i * block_k + lax.broadcasted_iota(jnp.int32, (1, block_k), 1)
@@ -219,8 +225,8 @@ def _prefill_kernel(len_ref, q_ref, k_ref, v_ref, o_ref,
 
 def prefill_attention(
     q: jax.Array,         # [T, Hq, hd]
-    k: jax.Array,         # [T, Hkv, hd]
-    v: jax.Array,         # [T, Hkv, hd]
+    k: jax.Array,         # [Hkv, T, hd] head-major chunk
+    v: jax.Array,         # [Hkv, T, hd]
     length: jax.Array,    # scalar i32 — real (unpadded) sequence length
     *,
     sliding_window: Optional[int] = None,
@@ -230,7 +236,7 @@ def prefill_attention(
 ) -> jax.Array:
     """Flash causal GQA prefill attention. Returns [T, Hq, hd]."""
     T, Hq, hd = q.shape
-    Hkv = k.shape[1]
+    Hkv = k.shape[0]
     g = Hq // Hkv
     bq = _pick_block(T, block_q)
     bk = _pick_block(T, block_k)
@@ -246,10 +252,9 @@ def prefill_attention(
         in_specs=[
             pl.BlockSpec((1,), lambda h, i: (0,), memory_space=pltpu.SMEM),
             pl.BlockSpec((bq, 1, g, hd), lambda h, i: (i, h, 0, 0)),
-            pl.BlockSpec((T, 1, hd), lambda h, i: (0, h, 0),
-                         memory_space=pl.ANY),
-            pl.BlockSpec((T, 1, hd), lambda h, i: (0, h, 0),
-                         memory_space=pl.ANY),
+            # K/V whole in HBM; the kernel DMAs per-head block_k slices
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
         ],
         out_specs=pl.BlockSpec((bq, 1, g, hd), lambda h, i: (i, h, 0, 0)),
         out_shape=jax.ShapeDtypeStruct((T, Hkv, g, hd), q.dtype),

@@ -112,7 +112,9 @@ def sp_prefill_forward(
     """Sequence-parallel prefill of one long sequence.
 
     Returns (hidden [1, T, D], (k, v) each [L, T, Hkv, hd]) with T sharded
-    on the 'seq' axis — the K/V stack is handed to the slot cache writer.
+    on the 'seq' axis. NOTE: the slot cache (engine.kvcache) is head-major
+    [L, S, Hkv, C, hd] — transpose the returned stacks to [L, Hkv, T, hd]
+    before inserting into a slot.
     """
     n = mesh.shape["seq"]
     T = tokens.shape[0]

@@ -17,7 +17,7 @@ Layout (Megatron-style TP on the 'model' axis, slots on 'data'):
   embed     [V, D]         → P('model', None)         vocab-sharded
   lm_head   [D, V]         → P(None, 'model')         vocab-sharded logits
   norms                    → replicated
-  KV cache  [L, S, C, Hkv, hd] → P(None, 'data', None, 'model', None)
+  KV cache  [L, S, Hkv, C, hd] → P(None, 'data', 'model', None, None)
   counts/bias [S, V]       → P('data', 'model')
 
 With this layout one decode step needs exactly two psums per layer (after
@@ -102,7 +102,7 @@ def param_specs(
 
 
 def kv_spec(cfg: LlamaConfig, mesh: Mesh) -> P:
-    """KV cache [L, S, C, Hkv, hd]: slots on 'data', kv heads on 'model'.
+    """KV cache [L, S, Hkv, C, hd]: slots on 'data', kv heads on 'model'.
 
     When tp does not divide the kv-head count (deep-GQA models on wide
     meshes), the kv heads are replicated instead — attention q-heads stay
@@ -115,7 +115,7 @@ def kv_spec(cfg: LlamaConfig, mesh: Mesh) -> P:
             "kv heads (%d) not divisible by tensor_parallel (%d); "
             "replicating KV cache", cfg.num_kv_heads, tp,
         )
-    return P(None, "data", None, heads, None)
+    return P(None, "data", heads, None, None)
 
 
 def state_specs(mesh: Mesh) -> dict:

@@ -1,0 +1,11 @@
+"""Process-isolated model workers speaking one narrow gRPC contract.
+
+The reference's L2/L3 (SURVEY.md §1): one worker process per loaded model,
+spawned/health-checked/respawned by the API server, all speaking
+backend.proto. Here the contract is worker/backend.proto, the engine inside
+each worker is the JAX ModelRunner+Scheduler, and external workers in any
+language can register by address.
+"""
+
+from localai_tpu.worker.client import WorkerClient
+from localai_tpu.worker.process import Watchdog, WorkerPool, WorkerProcess

@@ -1,0 +1,167 @@
+"""WorkerClient: typed wrapper over the Backend stub.
+
+Parity: the reference's Go client layer (/root/reference/pkg/grpc/
+client.go:15-120) — per-call busy marking for the watchdog, optional
+serialization when parallel requests are disabled, UTF-8-safe streaming
+(byte chunks reassembled into runes happens worker-side here; deltas are
+whole UTF-8 strings by construction, core/backend/llm.go:122-138 is no
+longer needed).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from typing import Any, Callable, Iterator, Optional
+
+import grpc
+
+from localai_tpu.worker import backend_pb2 as pb
+from localai_tpu.worker.rpc import BackendStub
+
+
+class WorkerClient:
+    def __init__(self, address: str, *, parallel: bool = True,
+                 watchdog: Optional[Any] = None):
+        self.address = address
+        self._channel = grpc.insecure_channel(
+            address,
+            options=[("grpc.max_receive_message_length", 256 * 1024 * 1024),
+                     ("grpc.max_send_message_length", 256 * 1024 * 1024)],
+        )
+        self._stub = BackendStub(self._channel)
+        # parallel=False serializes all calls (parity: --parallel-requests
+        # gate, client.go:102-118)
+        self._op_lock = threading.Lock() if not parallel else None
+        self._watchdog = watchdog
+        self.busy = False
+
+    # -- busy/watchdog bookkeeping ---------------------------------------
+
+    def _enter(self):
+        if self._op_lock is not None:
+            self._op_lock.acquire()
+        self.busy = True
+        if self._watchdog is not None:
+            self._watchdog.mark(self.address)
+
+    def _exit(self):
+        self.busy = False
+        if self._watchdog is not None:
+            self._watchdog.unmark(self.address)
+        if self._op_lock is not None:
+            self._op_lock.release()
+
+    def _call(self, fn: Callable, request, timeout: Optional[float] = None):
+        self._enter()
+        try:
+            return fn(request, timeout=timeout)
+        finally:
+            self._exit()
+
+    # -- RPC surface ------------------------------------------------------
+
+    def health(self, timeout: float = 5.0) -> bool:
+        try:
+            reply = self._stub.Health(pb.HealthMessage(), timeout=timeout)
+            return reply.message == b"OK"
+        except grpc.RpcError:
+            return False
+
+    def load_model(self, *, model: str = "", config_yaml: str = "",
+                   model_path: str = "", context_size: int = 0,
+                   seed: int = 0, timeout: float = 600.0) -> pb.Result:
+        return self._call(self._stub.LoadModel, pb.ModelOptions(
+            model=model, config_yaml=config_yaml, model_path=model_path,
+            context_size=context_size, seed=seed,
+        ), timeout)
+
+    def predict(self, opts: pb.PredictOptions,
+                timeout: float = 600.0) -> pb.Reply:
+        return self._call(self._stub.Predict, opts, timeout)
+
+    def predict_stream(self, opts: pb.PredictOptions,
+                       timeout: float = 600.0) -> Iterator[pb.Reply]:
+        self._enter()
+        try:
+            yield from self._stub.PredictStream(opts, timeout=timeout)
+        finally:
+            self._exit()
+
+    def embedding(self, text: str = "", tokens: Optional[list[int]] = None,
+                  timeout: float = 600.0) -> list[float]:
+        res = self._call(self._stub.Embedding, pb.EmbeddingRequest(
+            text=text, tokens=tokens or []), timeout)
+        return list(res.embeddings)
+
+    def tokenize(self, text: str, add_bos: bool = False,
+                 timeout: float = 60.0) -> list[int]:
+        res = self._call(self._stub.TokenizeString, pb.TokenizationRequest(
+            text=text, add_bos=add_bos), timeout)
+        return list(res.tokens)
+
+    def status(self, timeout: float = 5.0) -> pb.StatusResponse:
+        return self._stub.Status(pb.HealthMessage(), timeout=timeout)
+
+    def metrics(self, timeout: float = 10.0) -> dict:
+        res = self._stub.GetMetrics(pb.MetricsRequest(), timeout=timeout)
+        return json.loads(res.json or "{}")
+
+    def tts(self, text: str, *, voice: str = "", language: str = "",
+            dst: str = "", timeout: float = 600.0) -> pb.AudioResult:
+        return self._call(self._stub.TTS, pb.TTSRequest(
+            text=text, voice=voice, language=language, dst=dst), timeout)
+
+    def sound_generation(self, text: str, *, duration: Optional[float] = None,
+                         dst: str = "",
+                         timeout: float = 600.0) -> pb.AudioResult:
+        req = pb.SoundGenerationRequest(text=text, dst=dst)
+        if duration is not None:
+            req.duration = duration
+        return self._call(self._stub.SoundGeneration, req, timeout)
+
+    def transcribe(self, *, path: str = "", audio: bytes = b"",
+                   language: str = "", translate: bool = False,
+                   timeout: float = 600.0) -> pb.TranscriptResult:
+        return self._call(self._stub.AudioTranscription, pb.TranscriptRequest(
+            path=path, audio=audio, language=language, translate=translate,
+        ), timeout)
+
+    def generate_image(self, prompt: str, *, negative: str = "",
+                       width: int = 512, height: int = 512, step: int = 0,
+                       seed: int = 0, dst: str = "",
+                       timeout: float = 600.0) -> pb.ImageResult:
+        return self._call(self._stub.GenerateImage, pb.GenerateImageRequest(
+            positive_prompt=prompt, negative_prompt=negative,
+            width=width, height=height, step=step, seed=seed, dst=dst,
+        ), timeout)
+
+    def rerank(self, query: str, documents: list[str], top_n: int = 0,
+               timeout: float = 600.0) -> pb.RerankResult:
+        return self._call(self._stub.Rerank, pb.RerankRequest(
+            query=query, documents=documents, top_n=top_n), timeout)
+
+    def stores_set(self, keys: list[list[float]],
+                   values: list[bytes], timeout: float = 60.0) -> pb.Result:
+        return self._call(self._stub.StoresSet, pb.StoresSetOptions(
+            keys=[pb.StoresKey(floats=k) for k in keys],
+            values=[pb.StoresValue(bytes=v) for v in values],
+        ), timeout)
+
+    def stores_get(self, keys: list[list[float]],
+                   timeout: float = 60.0) -> pb.StoresGetResult:
+        return self._call(self._stub.StoresGet, pb.StoresGetOptions(
+            keys=[pb.StoresKey(floats=k) for k in keys]), timeout)
+
+    def stores_find(self, key: list[float], top_k: int,
+                    timeout: float = 60.0) -> pb.StoresFindResult:
+        return self._call(self._stub.StoresFind, pb.StoresFindOptions(
+            key=pb.StoresKey(floats=key), top_k=top_k), timeout)
+
+    def stores_delete(self, keys: list[list[float]],
+                      timeout: float = 60.0) -> pb.Result:
+        return self._call(self._stub.StoresDelete, pb.StoresDeleteOptions(
+            keys=[pb.StoresKey(floats=k) for k in keys]), timeout)
+
+    def close(self) -> None:
+        self._channel.close()

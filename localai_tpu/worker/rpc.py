@@ -1,0 +1,78 @@
+"""Hand-rolled gRPC service/stub glue for the Backend contract.
+
+The image ships grpcio + protoc but not grpc_tools, so the usual
+``backend_pb2_grpc.py`` cannot be generated; this module is its exact
+functional equivalent (parity concept: the reference's generated Go stubs
+mirrored by the hand-written Backend interface, /root/reference/pkg/grpc/
+backend.go:37-60). One method table drives both the server-side generic
+handler and the client stub, so the two can never drift.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import grpc
+
+from localai_tpu.worker import backend_pb2 as pb
+
+SERVICE = "localai_tpu.Backend"
+
+# name → (is_server_streaming, request type, response type)
+METHODS: dict[str, tuple[bool, Any, Any]] = {
+    "Health": (False, pb.HealthMessage, pb.Reply),
+    "LoadModel": (False, pb.ModelOptions, pb.Result),
+    "Predict": (False, pb.PredictOptions, pb.Reply),
+    "PredictStream": (True, pb.PredictOptions, pb.Reply),
+    "Embedding": (False, pb.EmbeddingRequest, pb.EmbeddingResult),
+    "TokenizeString": (False, pb.TokenizationRequest, pb.TokenizationResponse),
+    "Status": (False, pb.HealthMessage, pb.StatusResponse),
+    "GetMetrics": (False, pb.MetricsRequest, pb.MetricsResponse),
+    "TTS": (False, pb.TTSRequest, pb.AudioResult),
+    "SoundGeneration": (False, pb.SoundGenerationRequest, pb.AudioResult),
+    "AudioTranscription": (False, pb.TranscriptRequest, pb.TranscriptResult),
+    "GenerateImage": (False, pb.GenerateImageRequest, pb.ImageResult),
+    "Rerank": (False, pb.RerankRequest, pb.RerankResult),
+    "StoresSet": (False, pb.StoresSetOptions, pb.Result),
+    "StoresDelete": (False, pb.StoresDeleteOptions, pb.Result),
+    "StoresGet": (False, pb.StoresGetOptions, pb.StoresGetResult),
+    "StoresFind": (False, pb.StoresFindOptions, pb.StoresFindResult),
+}
+
+
+def add_servicer(server: grpc.Server, servicer: Any) -> None:
+    """Register every METHODS entry the servicer implements; missing ones
+    answer UNIMPLEMENTED (parity: base.Base unimplemented defaults,
+    /root/reference/pkg/grpc/base/base.go:16-49)."""
+    handlers: dict[str, grpc.RpcMethodHandler] = {}
+    for name, (streaming, req_t, resp_t) in METHODS.items():
+        fn = getattr(servicer, name, None)
+        if fn is None:
+            def fn(request, context, _n=name):  # noqa: ANN001
+                context.abort(grpc.StatusCode.UNIMPLEMENTED,
+                              f"{_n} not implemented by this worker")
+        make = (grpc.unary_stream_rpc_method_handler if streaming
+                else grpc.unary_unary_rpc_method_handler)
+        handlers[name] = make(
+            fn,
+            request_deserializer=req_t.FromString,
+            response_serializer=resp_t.SerializeToString,
+        )
+    server.add_generic_rpc_handlers(
+        (grpc.method_handlers_generic_handler(SERVICE, handlers),)
+    )
+
+
+class BackendStub:
+    """Client stub: one callable per method, typed by METHODS."""
+
+    def __init__(self, channel: grpc.Channel):
+        for name, (streaming, req_t, resp_t) in METHODS.items():
+            factory: Callable = (
+                channel.unary_stream if streaming else channel.unary_unary
+            )
+            setattr(self, name, factory(
+                f"/{SERVICE}/{name}",
+                request_serializer=req_t.SerializeToString,
+                response_deserializer=resp_t.FromString,
+            ))

@@ -1,0 +1,287 @@
+"""The model-worker gRPC server: one process, one loaded model.
+
+This is the process-isolation tier of the framework — the TPU-era
+counterpart of the reference's backend workers (llama.cpp gRPC server,
+/root/reference/backend/cpp/llama/grpc-server.cpp:2304-2458, and the Go
+harness /root/reference/pkg/grpc/server.go:23-60+): the API server spawns
+one of these per model (worker.process), so an engine crash never takes
+down the API, and external/third-party workers can implement the same
+contract (rpc.METHODS) in any language.
+
+Inside the process the engine is the same ModelRunner + continuous-batching
+Scheduler the in-process manager uses (models.manager.build_serving_model);
+the worker adds only the wire surface.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+import signal
+import threading
+from concurrent import futures
+from typing import Any, Iterator, Optional
+
+import grpc
+
+from localai_tpu.worker import backend_pb2 as pb
+from localai_tpu.worker import rpc
+
+log = logging.getLogger(__name__)
+
+
+class BackendServicer:
+    """LLM worker: Predict/PredictStream/Embedding + lifecycle RPCs.
+
+    Modality RPCs (TTS, transcription, image gen, rerank, stores) are
+    intentionally absent here — rpc.add_servicer answers UNIMPLEMENTED for
+    them, and dedicated workers (audio/image/store servicers) implement
+    them instead, exactly like the reference's per-modality backends.
+    """
+
+    def __init__(self) -> None:
+        self._sm: Optional[Any] = None  # ServingModel
+        self._load_error = ""
+        self._lock = threading.Lock()
+
+    # -- lifecycle -------------------------------------------------------
+
+    def Health(self, request: pb.HealthMessage, context) -> pb.Reply:
+        return pb.Reply(message=b"OK")
+
+    def LoadModel(self, request: pb.ModelOptions, context) -> pb.Result:
+        from localai_tpu.config.app_config import AppConfig
+        from localai_tpu.config.model_config import ModelConfig
+        from localai_tpu.models.manager import build_serving_model
+
+        with self._lock:
+            if self._sm is not None:
+                return pb.Result(success=True, message="already loaded")
+            try:
+                if request.config_yaml:
+                    import yaml
+
+                    doc = yaml.safe_load(request.config_yaml) or {}
+                else:
+                    doc = {"name": request.model or "model",
+                           "model": request.model}
+                if request.model:
+                    doc.setdefault("model", request.model)
+                if request.context_size:
+                    doc["context_size"] = request.context_size
+                if request.seed:
+                    doc["seed"] = request.seed
+                mcfg = ModelConfig.model_validate(doc)
+                app = AppConfig(model_path=request.model_path or "models")
+                self._sm = build_serving_model(mcfg, app)
+                return pb.Result(success=True, message="ok")
+            except Exception as e:  # noqa: BLE001 — report, don't crash
+                self._load_error = f"{type(e).__name__}: {e}"
+                log.exception("LoadModel failed")
+                return pb.Result(success=False, message=self._load_error)
+
+    def Status(self, request: pb.HealthMessage, context) -> pb.StatusResponse:
+        if self._sm is None:
+            state = (pb.StatusResponse.ERROR if self._load_error
+                     else pb.StatusResponse.UNINITIALIZED)
+            return pb.StatusResponse(state=state)
+        busy = self._sm.scheduler.busy
+        state = pb.StatusResponse.BUSY if busy else pb.StatusResponse.READY
+        mem = {}
+        try:
+            import resource
+
+            mem["maxrss_kb"] = resource.getrusage(
+                resource.RUSAGE_SELF).ru_maxrss
+        except Exception:  # noqa: BLE001
+            pass
+        return pb.StatusResponse(state=state, memory=mem)
+
+    def GetMetrics(self, request: pb.MetricsRequest,
+                   context) -> pb.MetricsResponse:
+        if self._sm is None:
+            return pb.MetricsResponse(json="{}")
+        return pb.MetricsResponse(json=json.dumps(self._sm.scheduler.metrics()))
+
+    # -- inference -------------------------------------------------------
+
+    def _require_model(self, context):
+        if self._sm is None:
+            context.abort(
+                grpc.StatusCode.FAILED_PRECONDITION,
+                self._load_error or "no model loaded (call LoadModel first)",
+            )
+        return self._sm
+
+    def _gen_request(self, req: pb.PredictOptions, sm):
+        from localai_tpu.engine.scheduler import GenRequest
+
+        if req.tokens:
+            prompt = list(req.tokens)
+        else:
+            prompt = sm.tokenizer.encode(req.prompt, add_bos=req.add_bos)
+        constraint = None
+        if req.constraint_schema:
+            from localai_tpu.functions.constraint import constraint_for_schema
+
+            constraint = constraint_for_schema(
+                json.loads(req.constraint_schema), sm.tokenizer
+            )
+        elif req.constraint_regex:
+            from localai_tpu.functions.constraint import constraint_for_regex
+
+            constraint = constraint_for_regex(
+                req.constraint_regex, sm.tokenizer
+            )
+
+        def opt(name):
+            return getattr(req, name) if req.HasField(name) else None
+
+        return GenRequest(
+            prompt=prompt,
+            max_new_tokens=req.max_tokens or 2048,
+            temperature=opt("temperature"),
+            top_k=opt("top_k"),
+            top_p=opt("top_p"),
+            min_p=opt("min_p"),
+            repeat_penalty=opt("repeat_penalty"),
+            presence_penalty=opt("presence_penalty"),
+            frequency_penalty=opt("frequency_penalty"),
+            seed=opt("seed"),
+            logit_bias=dict(req.logit_bias) or None,
+            stop=tuple(req.stop),
+            ignore_eos=req.ignore_eos,
+            constraint=constraint,
+            correlation_id=req.correlation_id,
+        )
+
+    def Predict(self, request: pb.PredictOptions, context) -> pb.Reply:
+        sm = self._require_model(context)
+        handle = sm.scheduler.submit(self._gen_request(request, sm))
+        try:
+            handle.result(timeout=600.0)
+        finally:
+            if handle.finish_reason is None:
+                # timeout or abandoned RPC — free the decode slot
+                handle.cancel()
+        return pb.Reply(
+            message=handle.text.encode("utf-8"),
+            tokens=handle.completion_tokens,
+            prompt_tokens=handle.prompt_tokens,
+            finish_reason=handle.finish_reason or "stop",
+        )
+
+    def PredictStream(self, request: pb.PredictOptions,
+                      context) -> Iterator[pb.Reply]:
+        sm = self._require_model(context)
+        handle = sm.scheduler.submit(self._gen_request(request, sm))
+        try:
+            for item in handle:
+                if item.finish_reason is not None:
+                    yield pb.Reply(
+                        message=b"",
+                        tokens=handle.completion_tokens,
+                        prompt_tokens=handle.prompt_tokens,
+                        finish_reason=item.finish_reason,
+                    )
+                    break
+                if item.delta:
+                    yield pb.Reply(message=item.delta.encode("utf-8"))
+        finally:
+            if not context.is_active():
+                handle.cancel()
+
+    def Embedding(self, request: pb.EmbeddingRequest,
+                  context) -> pb.EmbeddingResult:
+        sm = self._require_model(context)
+        if request.tokens:
+            toks = list(request.tokens)
+        else:
+            toks = sm.tokenizer.encode(request.text, add_bos=True)
+        vec = sm.runner.embed(toks)
+        return pb.EmbeddingResult(embeddings=[float(x) for x in vec])
+
+    def TokenizeString(self, request: pb.TokenizationRequest,
+                       context) -> pb.TokenizationResponse:
+        sm = self._require_model(context)
+        ids = sm.tokenizer.encode(request.text, add_bos=request.add_bos)
+        return pb.TokenizationResponse(length=len(ids), tokens=ids)
+
+    def shutdown(self) -> None:
+        with self._lock:
+            if self._sm is not None:
+                self._sm.scheduler.shutdown()
+                self._sm = None
+
+
+def serve_worker(addr: str = "127.0.0.1:0",
+                 servicer: Optional[Any] = None,
+                 block: bool = True) -> tuple[grpc.Server, int]:
+    """Start the worker gRPC server. Returns (server, bound_port)."""
+    servicer = servicer or BackendServicer()
+    server = grpc.server(
+        futures.ThreadPoolExecutor(max_workers=32),
+        options=[("grpc.max_receive_message_length", 256 * 1024 * 1024),
+                 ("grpc.max_send_message_length", 256 * 1024 * 1024)],
+    )
+    rpc.add_servicer(server, servicer)
+    port = server.add_insecure_port(addr)
+    if port == 0:
+        raise RuntimeError(f"could not bind worker to {addr}")
+    server.start()
+    log.info("worker listening on port %d", port)
+    if block:
+        stop = threading.Event()
+
+        def _sig(*_a):
+            stop.set()
+
+        signal.signal(signal.SIGTERM, _sig)
+        signal.signal(signal.SIGINT, _sig)
+        stop.wait()
+        if hasattr(servicer, "shutdown"):
+            servicer.shutdown()
+        server.stop(grace=5.0)
+    return server, port
+
+
+def main(argv: Optional[list[str]] = None) -> int:
+    parser = argparse.ArgumentParser(description="localai-tpu model worker")
+    parser.add_argument("--addr", default="127.0.0.1:0",
+                        help="host:port to bind (port 0 = ephemeral)")
+    parser.add_argument("--servicer", default="llm",
+                        help="which servicer to run (llm)")
+    args = parser.parse_args(argv)
+    logging.basicConfig(
+        level=os.environ.get("LOCALAI_LOG_LEVEL", "INFO").upper(),
+        format="%(asctime)s %(levelname).1s %(name)s: %(message)s",
+    )
+    # honor JAX_PLATFORMS even when a sitecustomize imported jax before the
+    # env var could take effect (jax.config wins until backend init)
+    plat = os.environ.get("JAX_PLATFORMS")
+    if plat:
+        import jax
+
+        try:
+            jax.config.update("jax_platforms", plat)
+        except Exception:  # noqa: BLE001 — backend already initialized
+            pass
+    servicer = BackendServicer()
+    _server, port = serve_worker(args.addr, servicer=servicer, block=False)
+    # the parent process-manager greps this line for the bound port
+    print(f"WORKER_READY port={port}", flush=True)
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    signal.signal(signal.SIGINT, lambda *_: stop.set())
+    stop.wait()
+    # stop the engine thread before tearing down grpc so no handler is
+    # mid-flight when the C core unwinds
+    servicer.shutdown()
+    _server.stop(grace=2.0).wait(5.0)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

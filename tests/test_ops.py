@@ -28,8 +28,8 @@ def test_decode_attention_matches_xla(window):
     S, C = 4, 64
     rng = np.random.default_rng(0)
     q = jnp.asarray(rng.normal(size=(S, cfg.num_heads, cfg.hd)), jnp.float32)
-    k = jnp.asarray(rng.normal(size=(S, C, cfg.num_kv_heads, cfg.hd)), jnp.float32)
-    v = jnp.asarray(rng.normal(size=(S, C, cfg.num_kv_heads, cfg.hd)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(S, cfg.num_kv_heads, C, cfg.hd)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(S, cfg.num_kv_heads, C, cfg.hd)), jnp.float32)
     pos = jnp.asarray([0, 5, 31, 63], jnp.int32)
 
     ref = mdl._grouped_attn(cfg, q[:, None], k, v,
@@ -47,8 +47,8 @@ def test_prefill_attention_matches_xla(window, length):
     T = 48
     rng = np.random.default_rng(1)
     q = jnp.asarray(rng.normal(size=(T, cfg.num_heads, cfg.hd)), jnp.float32)
-    k = jnp.asarray(rng.normal(size=(T, cfg.num_kv_heads, cfg.hd)), jnp.float32)
-    v = jnp.asarray(rng.normal(size=(T, cfg.num_kv_heads, cfg.hd)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(cfg.num_kv_heads, T, cfg.hd)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(cfg.num_kv_heads, T, cfg.hd)), jnp.float32)
 
     ref = mdl._grouped_attn(cfg, q[None], k[None], v[None],
                             kvc.prefill_mask(cfg, T, jnp.int32(length)))[0]

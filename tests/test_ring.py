@@ -31,8 +31,9 @@ def _reference_forward(model_cfg, params, tokens, length):
     mask = kvc.prefill_mask(model_cfg, T, length)
 
     def write(layer_kv, k, v):
-        # pass the fresh chunk through and stack it as the per-layer output
-        return (k[0], v[0]), k, v
+        # pass the fresh chunk through (head-major for _grouped_attn) and
+        # stack the token-major chunk as the per-layer output
+        return (k[0], v[0]), k.transpose(0, 2, 1, 3), v.transpose(0, 2, 1, 3)
 
     hidden, kvs = mdl.forward(
         model_cfg, params, tokens[None],
@@ -83,7 +84,8 @@ def test_ring_attention_matches_full(seq_mesh, window):
     v = jnp.asarray(rng.normal(size=(T, 2, 8)), jnp.float32)
     length = jnp.int32(29)
 
-    ref = mdl._grouped_attn(cfg, q[None], k[None], v[None],
+    ref = mdl._grouped_attn(cfg, q[None], k.transpose(1, 0, 2)[None],
+                            v.transpose(1, 0, 2)[None],
                             kvc.prefill_mask(cfg, T, length))[0]
 
     def local(q_c, k_c, v_c):
