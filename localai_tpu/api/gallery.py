@@ -1,0 +1,171 @@
+"""Gallery HTTP endpoints: apply/delete models, job status, browse.
+
+Parity: /root/reference/core/http/endpoints/localai/gallery.go +
+routes/localai.go:25-44 — POST /models/apply, POST /models/delete/:name,
+GET /models/available, GET /models/jobs/:uuid, GET /models/jobs,
+GET+POST+DELETE /models/galleries.
+"""
+
+from __future__ import annotations
+
+import logging
+
+from aiohttp import web
+
+from localai_tpu.gallery import (
+    EMBEDDED_MODELS,
+    Gallery,
+    GalleryModel,
+    GalleryOp,
+    available_models,
+    resolve_ref,
+)
+
+log = logging.getLogger(__name__)
+
+
+def _state(request: web.Request):
+    from localai_tpu.api.server import STATE_KEY
+
+    return request.app[STATE_KEY]
+
+
+async def apply_model(request: web.Request) -> web.Response:
+    """POST /models/apply — async install; returns a job uuid + status URL
+    (parity: ApplyModelGalleryEndpoint, gallery.go)."""
+    state = _state(request)
+    try:
+        body = await request.json()
+    except Exception:
+        raise web.HTTPBadRequest(text="invalid JSON body")
+
+    ref = body.get("id") or body.get("model") or ""
+    op = GalleryOp(
+        id="", kind="apply",
+        install_name=body.get("name") or "",
+        overrides=body.get("overrides") or {},
+    )
+    inline = None
+    if body.get("url") or body.get("config_url"):
+        inline = GalleryModel(
+            name=op.install_name or ref or "model",
+            url=body.get("url") or body.get("config_url"),
+        )
+    elif body.get("files") or body.get("config_file"):
+        inline = GalleryModel.model_validate({
+            "name": op.install_name or ref or "model",
+            "files": body.get("files") or [],
+            "config_file": body.get("config_file"),
+        })
+    elif ref:
+        # shared resolution chain (embedded → URL → gallery); gallery refs
+        # resolve lazily in the job worker so a slow index never blocks here
+        inline = resolve_ref([], ref, name=op.install_name)
+        if inline is not None and not inline.url:
+            op.install_name = op.install_name or ref
+    else:
+        raise web.HTTPBadRequest(
+            text="need one of: id (gallery@name), url, files"
+        )
+    op.model = inline
+    op.gallery_ref = ref
+    job_id = state.gallery_service.submit(op)
+    return web.json_response({
+        "uuid": job_id,
+        "status": f"/models/jobs/{job_id}",
+    })
+
+
+async def delete_model_endpoint(request: web.Request) -> web.Response:
+    state = _state(request)
+    name = request.match_info["name"]
+    op = GalleryOp(id="", kind="delete", install_name=name)
+    job_id = state.gallery_service.submit(op)
+    # drop any loaded instance so HBM frees immediately
+    try:
+        state.manager.shutdown_model(name, force=True)
+    except Exception:  # noqa: BLE001
+        log.debug("no loaded instance of %s to shut down", name)
+    return web.json_response({
+        "uuid": job_id,
+        "status": f"/models/jobs/{job_id}",
+    })
+
+
+async def job_status(request: web.Request) -> web.Response:
+    state = _state(request)
+    st = state.gallery_service.status(request.match_info["uuid"])
+    if st is None:
+        raise web.HTTPNotFound(text="no such job")
+    return web.json_response(st.as_dict())
+
+
+async def all_jobs(request: web.Request) -> web.Response:
+    return web.json_response(_state(request).gallery_service.all_status())
+
+
+async def list_available(request: web.Request) -> web.Response:
+    """GET /models/available — gallery models + embedded library entries
+    (parity: ListModelFromGalleryEndpoint)."""
+    import asyncio
+
+    state = _state(request)
+    out = []
+    # gallery indexes are fetched over the network — keep it off the loop
+    models = await asyncio.get_running_loop().run_in_executor(
+        state.executor, available_models, state.galleries,
+        state.config.model_path,
+    )
+    for m in models:
+        out.append(m.model_dump(exclude={"config_file"}))
+    for _name, m in sorted(EMBEDDED_MODELS.items()):
+        d = m.model_dump(exclude={"config_file"})
+        d["gallery"] = "embedded"
+        out.append(d)
+    return web.json_response(out)
+
+
+async def list_galleries(request: web.Request) -> web.Response:
+    return web.json_response([
+        {"name": g.name, "url": g.url} for g in _state(request).galleries
+    ])
+
+
+async def add_gallery(request: web.Request) -> web.Response:
+    state = _state(request)
+    try:
+        body = await request.json()
+    except Exception:
+        raise web.HTTPBadRequest(text="invalid JSON body")
+    name, url = body.get("name"), body.get("url")
+    if not name or not url:
+        raise web.HTTPBadRequest(text="need name and url")
+    if any(g.name == name for g in state.galleries):
+        raise web.HTTPConflict(text=f"gallery {name!r} already exists")
+    state.add_gallery(Gallery(name=name, url=url))
+    return web.json_response({"name": name, "url": url})
+
+
+async def remove_gallery(request: web.Request) -> web.Response:
+    state = _state(request)
+    try:
+        body = await request.json()
+    except Exception:
+        raise web.HTTPBadRequest(text="invalid JSON body")
+    name = body.get("name")
+    if not state.remove_gallery(name):
+        raise web.HTTPNotFound(text=f"no gallery {name!r}")
+    return web.json_response({"removed": name})
+
+
+def routes() -> list[web.RouteDef]:
+    return [
+        web.post("/models/apply", apply_model),
+        web.post("/models/delete/{name}", delete_model_endpoint),
+        web.get("/models/available", list_available),
+        web.get("/models/jobs/{uuid}", job_status),
+        web.get("/models/jobs", all_jobs),
+        web.get("/models/galleries", list_galleries),
+        web.post("/models/galleries", add_gallery),
+        web.delete("/models/galleries", remove_gallery),
+    ]

@@ -42,16 +42,53 @@ class AppState:
     def __init__(self, app_config: Optional[AppConfig] = None,
                  loader: Optional[ConfigLoader] = None,
                  manager: Optional[ModelManager] = None):
+        from localai_tpu.gallery import Gallery
+
         self.config = app_config or AppConfig()
         self.loader = loader or ConfigLoader(self.config.model_path)
         self.manager = manager or ModelManager(self.config, self.loader)
+        self.galleries: list[Gallery] = [
+            Gallery(name=g.get("name", ""), url=g.get("url", ""))
+            for g in self.config.galleries
+        ]
+        self._gallery_service = None
         # blocking engine waits run here, off the event loop
         self.executor = ThreadPoolExecutor(
             max_workers=32, thread_name_prefix="api-wait"
         )
 
+    @property
+    def gallery_service(self):
+        """Lazily started job runner (parity: gallery service start,
+        core/http/app.go:141-150)."""
+        if self._gallery_service is None:
+            from localai_tpu.gallery import GalleryService
+
+            self._gallery_service = GalleryService(
+                self.config.model_path, self.galleries,
+                on_installed=lambda p: self.loader.load_single(
+                    p, context_size=self.config.context_size
+                ),
+                on_deleted=self.loader.remove,
+            )
+        return self._gallery_service
+
+    def add_gallery(self, gallery) -> None:
+        self.galleries.append(gallery)
+        if self._gallery_service is not None:
+            self._gallery_service.galleries = list(self.galleries)
+
+    def remove_gallery(self, name: str) -> bool:
+        before = len(self.galleries)
+        self.galleries = [g for g in self.galleries if g.name != name]
+        if self._gallery_service is not None:
+            self._gallery_service.galleries = list(self.galleries)
+        return len(self.galleries) < before
+
     def shutdown(self) -> None:
         self.manager.shutdown_all()
+        if self._gallery_service is not None:
+            self._gallery_service.shutdown()
         self.executor.shutdown(wait=False, cancel_futures=True)
 
 
@@ -147,9 +184,12 @@ def create_app(state: Optional[AppState] = None) -> web.Application:
         metrics_middleware,
     ], client_max_size=64 * 1024 * 1024)
     app[STATE_KEY] = state
+    from localai_tpu.api import gallery as gallery_routes
+
     app.add_routes([web.get("/", welcome)])
     app.add_routes(openai_routes.routes())
     app.add_routes(localai_routes.routes())
+    app.add_routes(gallery_routes.routes())
 
     async def on_cleanup(_app):
         state.shutdown()
@@ -165,11 +205,30 @@ def serve(app_config: Optional[AppConfig] = None) -> None:
     loader = ConfigLoader(cfg.model_path)
     loader.load_from_path(context_size=cfg.context_size)
     state = AppState(cfg, loader)
-    for name in cfg.preload_models + cfg.load_to_memory:
+    # preload = make the model configured (embedded short names, gallery
+    # refs — parity: pkgStartup.InstallModels, pkg/startup/model_preload.go)
+    for name in cfg.preload_models:
+        if loader.exists(name):
+            continue
+        try:
+            from localai_tpu.gallery import install_model, resolve_ref
+
+            m = resolve_ref(state.galleries, name)
+            if m is None:
+                log.warning("preload: unknown model ref %r", name)
+                continue
+            path = install_model(m, cfg.model_path,
+                                 install_name="" if m.url else name)
+            loader.load_single(path, context_size=cfg.context_size)
+        except Exception as e:  # noqa: BLE001
+            log.warning("preload of %s failed: %s", name, e)
+    # load_to_memory = eager engine load (parity: LoadToMemory,
+    # startup.go:148-176)
+    for name in cfg.load_to_memory or cfg.preload_models:
         try:
             state.manager.get(name)
         except Exception as e:  # noqa: BLE001
-            log.warning("preload of %s failed: %s", name, e)
+            log.warning("eager load of %s failed: %s", name, e)
     log.info("serving on %s:%d (%d models configured)",
              cfg.address, cfg.port, len(loader.names()))
     web.run_app(create_app(state), host=cfg.address, port=cfg.port,

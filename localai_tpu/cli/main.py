@@ -62,6 +62,17 @@ def build_parser() -> argparse.ArgumentParser:
     models_sub = models.add_subparsers(dest="models_command")
     mlist = models_sub.add_parser("list", help="list configured models")
     mlist.add_argument("--models-path", default="models")
+    minstall = models_sub.add_parser(
+        "install", help="install from gallery/embedded library/URL")
+    minstall.add_argument("ref", help="name, gallery@name, or URL")
+    minstall.add_argument("--models-path", default="models")
+    minstall.add_argument("--name", default="", help="install under this name")
+    minstall.add_argument("--galleries", default="",
+                          help="JSON list of {name,url} galleries")
+    mavail = models_sub.add_parser(
+        "available", help="list models available to install")
+    mavail.add_argument("--models-path", default="models")
+    mavail.add_argument("--galleries", default="")
 
     tok = sub.add_parser("tokenize", help="tokenize text with a model")
     tok.add_argument("text")
@@ -139,6 +150,45 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             loader.load_from_path()
             for name in loader.names():
                 print(name)
+            return 0
+        if args.models_command in ("install", "available"):
+            import json as jsonlib
+
+            from localai_tpu.gallery import (
+                EMBEDDED_MODELS,
+                Gallery,
+                available_models,
+                install_model,
+                resolve_ref,
+            )
+
+            galleries = [
+                Gallery(name=g["name"], url=g["url"])
+                for g in (jsonlib.loads(args.galleries)
+                          if args.galleries else [])
+            ]
+            if args.models_command == "available":
+                for m in available_models(galleries, args.models_path):
+                    mark = "*" if m.installed else " "
+                    print(f"{mark} {m.id}\t{m.description}")
+                for name, m in sorted(EMBEDDED_MODELS.items()):
+                    print(f"  embedded@{name}\t{m.description}")
+                return 0
+            ref = args.ref
+            model = resolve_ref(galleries, ref, name=args.name)
+            if model is None:
+                parser.error(f"model {ref!r} not found in embedded library "
+                             "or configured galleries")
+
+            def progress(fn, done, total):
+                pct = f"{100.0 * done / total:5.1f}%" if total else "?"
+                print(f"\r{fn}: {pct}", end="", flush=True)
+
+            path = install_model(
+                model, args.models_path,
+                install_name=args.name or model.name, progress=progress,
+            )
+            print(f"\ninstalled → {path}")
             return 0
         parser.error("unknown models subcommand")
 

@@ -1,0 +1,23 @@
+"""Model gallery: marketplace indexes, installs, async jobs.
+
+Parity: /root/reference/core/gallery/ (+ core/services/gallery.go job
+runner, embedded/ short-name library). Install = download files with
+sha256 + progress + resume, then write the declarative model config YAML
+into the models dir.
+"""
+
+from localai_tpu.gallery.embedded import EMBEDDED_MODELS, resolve_embedded
+from localai_tpu.gallery.index import (
+    Gallery,
+    available_models,
+    find_model,
+    load_gallery_index,
+    resolve_ref,
+)
+from localai_tpu.gallery.models import (
+    GalleryFile,
+    GalleryModel,
+    delete_model,
+    install_model,
+)
+from localai_tpu.gallery.service import GalleryOp, GalleryService, JobStatus

@@ -1,0 +1,113 @@
+"""Gallery indexes: load, merge, search, and resolve ``gallery@name`` refs.
+
+Parity: /root/reference/core/gallery/gallery.go:19-48 (AvailableGalleryModels
++ findModel resolution across configured galleries) and the `name@gallery`
+addressing used by the CLI/API.
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Optional
+
+import yaml
+
+from localai_tpu.gallery.models import GalleryModel, safe_name
+from localai_tpu.utils import downloader
+
+log = logging.getLogger(__name__)
+
+
+@dataclass(frozen=True)
+class Gallery:
+    """A named index of models (parity: config.Gallery {name, url})."""
+
+    name: str
+    url: str
+
+
+def load_gallery_index(gallery: Gallery) -> list[GalleryModel]:
+    """Fetch + parse one gallery index YAML (list of model entries)."""
+    import tempfile
+
+    if gallery.url.startswith("file://"):
+        text = Path(gallery.url[len("file://"):]).read_text()
+    else:
+        with tempfile.TemporaryDirectory() as td:
+            tmp = Path(td) / "index.yaml"
+            downloader.download_uri(gallery.url, tmp)
+            text = tmp.read_text()
+    docs = yaml.safe_load(text) or []
+    if not isinstance(docs, list):
+        raise ValueError(f"gallery index {gallery.url} is not a list")
+    out = []
+    for doc in docs:
+        try:
+            m = GalleryModel.model_validate(doc)
+            m.gallery = gallery.name
+            out.append(m)
+        except Exception as e:  # noqa: BLE001 — skip malformed entries
+            log.warning("gallery %s: skipping bad entry: %s", gallery.name, e)
+    return out
+
+
+def available_models(
+    galleries: list[Gallery], models_path: str | Path = "models"
+) -> list[GalleryModel]:
+    """All models across galleries, flagged installed when their config
+    YAML exists in the models dir."""
+    models_path = Path(models_path)
+    out: list[GalleryModel] = []
+    for g in galleries:
+        try:
+            models = load_gallery_index(g)
+        except Exception as e:  # noqa: BLE001 — one dead gallery ≠ no list
+            log.warning("gallery %s unavailable: %s", g.name, e)
+            continue
+        for m in models:
+            m.installed = (models_path / f"{safe_name(m.name)}.yaml").exists()
+        out.extend(models)
+    return out
+
+
+def resolve_ref(
+    galleries: list[Gallery], ref: str, *, name: str = ""
+) -> Optional[GalleryModel]:
+    """THE model-ref resolution chain, shared by CLI, API and preload:
+    embedded short name → definition URL → gallery lookup (parity:
+    pkg/startup/model_preload.go:21+ resolution order)."""
+    from localai_tpu.gallery.embedded import resolve_embedded
+
+    m = resolve_embedded(ref)
+    if m is not None:
+        return m
+    if downloader.looks_like_url(ref):
+        return GalleryModel(name=name or "model", url=ref)
+    return find_model(galleries, ref)
+
+
+def find_model(
+    galleries: list[Gallery], ref: str
+) -> Optional[GalleryModel]:
+    """Resolve ``name``, ``gallery@name`` or ``name@gallery`` (the reference
+    accepts both orders — gallery.go findModel)."""
+    name, wanted_gallery = ref, ""
+    if "@" in ref:
+        a, b = ref.split("@", 1)
+        gallery_names = {g.name for g in galleries}
+        if a in gallery_names:
+            wanted_gallery, name = a, b
+        else:
+            name, wanted_gallery = a, b
+    for g in galleries:
+        if wanted_gallery and g.name != wanted_gallery:
+            continue
+        try:
+            for m in load_gallery_index(g):
+                if m.name == name:
+                    return m
+        except Exception as e:  # noqa: BLE001
+            log.warning("gallery %s unavailable: %s", g.name, e)
+    return None

@@ -1,0 +1,190 @@
+"""Gallery model schema + install/delete operations.
+
+Parity: /root/reference/core/gallery/ — ``GalleryModel`` (request.go),
+``InstallModel``/``DeleteModel`` (models.go), overrides merged into the
+written config (mergo semantics → deep dict merge here), per-file sha256
+verification with progress callbacks, and ``known_usecases`` filtering.
+"""
+
+from __future__ import annotations
+
+import logging
+import re
+from pathlib import Path
+from typing import Any, Callable, Optional
+
+import yaml
+from pydantic import BaseModel, ConfigDict, Field
+
+from localai_tpu.utils import downloader
+
+log = logging.getLogger(__name__)
+
+ProgressFn = Callable[[str, int, int], None]  # (filename, done, total)
+
+
+class GalleryFile(BaseModel):
+    model_config = ConfigDict(extra="allow", populate_by_name=True)
+
+    filename: str
+    uri: str
+    sha256: str = ""
+
+
+class GalleryModel(BaseModel):
+    """One entry in a gallery index (parity: GalleryModel, request.go +
+    config.go ModelConfig with files/overrides)."""
+
+    model_config = ConfigDict(extra="allow", protected_namespaces=())
+
+    name: str
+    description: str = ""
+    license: str = ""
+    urls: list[str] = Field(default_factory=list)
+    tags: list[str] = Field(default_factory=list)
+    icon: str = ""
+    # install payload
+    url: str = ""                       # URL of a model-definition YAML
+    config_file: Optional[dict] = None  # inline model config
+    files: list[GalleryFile] = Field(default_factory=list)
+    overrides: dict[str, Any] = Field(default_factory=dict)
+    gallery: str = ""                   # which gallery it came from
+    installed: bool = False
+
+    @property
+    def id(self) -> str:
+        return f"{self.gallery}@{self.name}" if self.gallery else self.name
+
+
+def deep_merge(base: dict, overrides: dict) -> dict:
+    """mergo.Merge-with-override parity: nested dicts merge, scalars and
+    lists from ``overrides`` win."""
+    out = dict(base)
+    for k, v in overrides.items():
+        if k in out and isinstance(out[k], dict) and isinstance(v, dict):
+            out[k] = deep_merge(out[k], v)
+        else:
+            out[k] = v
+    return out
+
+
+_NAME_RX = re.compile(r"[^a-zA-Z0-9._-]")
+
+
+def safe_name(name: str) -> str:
+    return _NAME_RX.sub("_", name)
+
+
+def _verify_inside(base: Path, target: Path) -> Path:
+    """Path-traversal guard (parity: utils.VerifyPath, pkg/utils/path.go)."""
+    base_r = base.resolve()
+    target_r = target.resolve()
+    if not str(target_r).startswith(str(base_r) + "/") and target_r != base_r:
+        raise ValueError(f"path {target} escapes models dir {base}")
+    return target
+
+
+def install_model(
+    model: GalleryModel,
+    models_path: str | Path,
+    *,
+    install_name: str = "",
+    overrides: Optional[dict] = None,
+    progress: Optional[ProgressFn] = None,
+) -> Path:
+    """Download the model's files (sha-verified, resumable) and write its
+    config YAML into the models dir. Returns the config path.
+
+    Parity: InstallModel (core/gallery/models.go) + the config-file
+    resolution chain: inline config_file → remote url → bare files.
+    """
+    models_path = Path(models_path)
+    models_path.mkdir(parents=True, exist_ok=True)
+    name = install_name or model.name
+
+    config: dict = {}
+    if model.url:
+        # model definition lives at a URL (yaml with files/overrides/config)
+        import tempfile
+
+        with tempfile.TemporaryDirectory() as td:
+            tmp = Path(td) / "def.yaml"
+            downloader.download_uri(model.url, tmp)
+            doc = yaml.safe_load(tmp.read_text()) or {}
+        remote = GalleryModel.model_validate({"name": name, **doc})
+        if remote.config_file:
+            config = dict(remote.config_file)
+        files = remote.files or model.files
+        config = deep_merge(config, remote.overrides or {})
+    else:
+        files = model.files
+        if model.config_file:
+            config = dict(model.config_file)
+
+    config = deep_merge(config, model.overrides or {})
+    config = deep_merge(config, overrides or {})
+    config["name"] = name
+
+    total_all = 0
+    for f in files:
+        dest = _verify_inside(models_path, models_path / f.filename)
+        log.info("gallery: downloading %s ← %s", f.filename, f.uri)
+
+        def file_progress(done: int, total: int, _fn=f.filename):
+            if progress:
+                progress(_fn, done, total)
+
+        downloader.download_uri(
+            f.uri, dest, sha256=f.sha256 or None, progress=file_progress
+        )
+        total_all += dest.stat().st_size
+
+    if files:
+        # manifest of downloaded files so delete can remove them (the
+        # reference keeps this in a gallery metadata file)
+        config["downloaded_files"] = [f.filename for f in files]
+    config_path = models_path / f"{safe_name(name)}.yaml"
+    config_path.write_text(yaml.safe_dump(config, sort_keys=False))
+    log.info("gallery: installed %s (%d files, %d bytes) → %s",
+             name, len(files), total_all, config_path)
+    return config_path
+
+
+def delete_model(name: str, models_path: str | Path) -> bool:
+    """Remove a model's config and its referenced weight files (parity:
+    DeleteModelFromSystem, core/gallery/gallery.go)."""
+    models_path = Path(models_path)
+    config_path = models_path / f"{safe_name(name)}.yaml"
+    found = config_path.exists()
+    files: list[str] = []
+    if found:
+        try:
+            doc = yaml.safe_load(config_path.read_text()) or {}
+            files.extend(doc.get("downloaded_files") or [])
+            ref = doc.get("model") or ""
+            if ref and not ref.startswith("debug:"):
+                files.append(ref)
+        except Exception:  # noqa: BLE001
+            pass
+        config_path.unlink()
+    dirs: set[Path] = set()
+    for ref in files:
+        target = models_path / ref
+        try:
+            _verify_inside(models_path, target)
+        except ValueError:
+            continue
+        if target.is_dir():
+            import shutil
+
+            shutil.rmtree(target, ignore_errors=True)
+        elif target.exists():
+            target.unlink()
+            if target.parent != models_path:
+                dirs.add(target.parent)
+    for d in dirs:  # prune now-empty per-model dirs
+        try:
+            d.rmdir()
+        except OSError:
+            pass
+    return found

@@ -74,14 +74,23 @@ class _ServerThread:
         self._thread.join(10)
 
 
+def make_state(models_dir, *, write_tiny: bool = False) -> AppState:
+    """AppState over a models dir (shared with test_gallery)."""
+    from pathlib import Path
+
+    models_dir = Path(models_dir)
+    if write_tiny:
+        (models_dir / "tiny.yaml").write_text(TINY_YAML)
+    cfg = AppConfig(model_path=str(models_dir))
+    loader = ConfigLoader(models_dir)
+    loader.load_from_path(context_size=cfg.context_size)
+    return AppState(cfg, loader)
+
+
 @pytest.fixture(scope="module")
 def server(tmp_path_factory):
     models = tmp_path_factory.mktemp("models")
-    (models / "tiny.yaml").write_text(TINY_YAML)
-    cfg = AppConfig(model_path=str(models))
-    loader = ConfigLoader(models)
-    loader.load_from_path(context_size=cfg.context_size)
-    state = AppState(cfg, loader)
+    state = make_state(models, write_tiny=True)
     srv = _ServerThread(state)
     yield srv
     srv.stop()

@@ -1,0 +1,224 @@
+"""Gallery subsystem: index resolution, installs, async jobs, HTTP API.
+
+Mirrors the reference's approach of driving gallery code with file://
+fixture galleries (/root/reference/tests/fixtures/gallery_simple.yaml and
+core/gallery/models_test.go) — no network needed.
+"""
+
+import json
+import time
+
+import pytest
+import yaml
+
+from localai_tpu.gallery import (
+    Gallery,
+    GalleryModel,
+    GalleryOp,
+    GalleryService,
+    available_models,
+    delete_model,
+    find_model,
+    install_model,
+    resolve_embedded,
+)
+from localai_tpu.gallery.models import deep_merge
+
+
+@pytest.fixture()
+def fixture_gallery(tmp_path):
+    """A file:// gallery with one model whose weight file is also file://."""
+    blob = tmp_path / "weights.bin"
+    blob.write_bytes(b"\x00" * 64)
+    import hashlib
+
+    sha = hashlib.sha256(blob.read_bytes()).hexdigest()
+    index = [{
+        "name": "fixture-model",
+        "description": "test model",
+        "license": "mit",
+        "files": [{
+            "filename": "fixture-model/weights.bin",
+            "uri": f"file://{blob}",
+            "sha256": sha,
+        }],
+        "config_file": {
+            "model": "debug:tiny",
+            "context_size": 64,
+            "parameters": {"temperature": 0.2},
+        },
+        "overrides": {"parameters": {"top_k": 7}},
+    }]
+    path = tmp_path / "index.yaml"
+    path.write_text(yaml.safe_dump(index))
+    return Gallery(name="test", url=f"file://{path}")
+
+
+def test_find_and_available(fixture_gallery, tmp_models_dir):
+    models = available_models([fixture_gallery], tmp_models_dir)
+    assert [m.name for m in models] == ["fixture-model"]
+    assert not models[0].installed
+
+    assert find_model([fixture_gallery], "fixture-model") is not None
+    assert find_model([fixture_gallery], "test@fixture-model") is not None
+    assert find_model([fixture_gallery], "fixture-model@test") is not None
+    assert find_model([fixture_gallery], "nope") is None
+
+
+def test_install_and_delete(fixture_gallery, tmp_models_dir):
+    model = find_model([fixture_gallery], "fixture-model")
+    cfg_path = install_model(model, tmp_models_dir)
+    assert cfg_path.exists()
+    doc = yaml.safe_load(cfg_path.read_text())
+    # config_file ⊕ overrides merge (mergo parity)
+    assert doc["name"] == "fixture-model"
+    assert doc["parameters"]["temperature"] == 0.2
+    assert doc["parameters"]["top_k"] == 7
+    assert (tmp_models_dir / "fixture-model/weights.bin").exists()
+
+    # installed flag now set
+    models = available_models([fixture_gallery], tmp_models_dir)
+    assert models[0].installed
+
+    assert delete_model("fixture-model", tmp_models_dir)
+    assert not cfg_path.exists()
+    # downloaded files (recorded in the install manifest) are removed too
+    assert not (tmp_models_dir / "fixture-model/weights.bin").exists()
+    assert not (tmp_models_dir / "fixture-model").exists()
+    assert not delete_model("fixture-model", tmp_models_dir)
+
+
+def test_sha_mismatch_rejected(tmp_path, tmp_models_dir):
+    blob = tmp_path / "w.bin"
+    blob.write_bytes(b"data")
+    model = GalleryModel(
+        name="bad",
+        files=[{"filename": "bad/w.bin", "uri": f"file://{blob}",
+                "sha256": "0" * 64}],
+    )
+    with pytest.raises(ValueError, match="sha256 mismatch"):
+        install_model(model, tmp_models_dir)
+
+
+def test_path_traversal_rejected(tmp_path, tmp_models_dir):
+    blob = tmp_path / "w.bin"
+    blob.write_bytes(b"data")
+    model = GalleryModel(
+        name="evil",
+        files=[{"filename": "../../etc/evil.bin", "uri": f"file://{blob}"}],
+    )
+    with pytest.raises(ValueError, match="escapes"):
+        install_model(model, tmp_models_dir)
+
+
+def test_deep_merge():
+    assert deep_merge(
+        {"a": {"x": 1, "y": 2}, "b": 1},
+        {"a": {"y": 3}, "c": 4},
+    ) == {"a": {"x": 1, "y": 3}, "b": 1, "c": 4}
+
+
+def test_embedded_library(tmp_models_dir):
+    m = resolve_embedded("debug-tiny")
+    assert m is not None
+    path = install_model(m, tmp_models_dir)
+    doc = yaml.safe_load(path.read_text())
+    assert doc["model"] == "debug:tiny"
+    assert resolve_embedded("no-such-model") is None
+
+
+def _wait_job(svc, job_id, timeout=30.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        st = svc.status(job_id)
+        if st is not None and st.processed:
+            return st
+        time.sleep(0.05)
+    raise TimeoutError("job never finished")
+
+
+def test_gallery_service_jobs(fixture_gallery, tmp_models_dir):
+    installed = []
+    svc = GalleryService(str(tmp_models_dir), [fixture_gallery],
+                         on_installed=installed.append)
+    try:
+        job = svc.submit(GalleryOp(id="", kind="apply",
+                                   gallery_ref="fixture-model"))
+        st = _wait_job(svc, job)
+        assert st.error == ""
+        assert st.progress == 100.0
+        assert installed and installed[0].name == "fixture-model.yaml"
+
+        job2 = svc.submit(GalleryOp(id="", kind="delete",
+                                    install_name="fixture-model"))
+        st2 = _wait_job(svc, job2)
+        assert st2.error == ""
+        assert st2.deletion
+
+        job3 = svc.submit(GalleryOp(id="", kind="apply",
+                                    gallery_ref="missing-model"))
+        st3 = _wait_job(svc, job3)
+        assert "missing-model" in st3.error
+    finally:
+        svc.shutdown()
+
+
+def test_gallery_http_api(fixture_gallery, tmp_models_dir):
+    """Drive the gallery endpoints through the real HTTP app."""
+    from tests.test_api import _ServerThread, make_state
+    import httpx
+
+    state = make_state(tmp_models_dir)
+    state.add_gallery(fixture_gallery)
+    srv = _ServerThread(state)
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+        with httpx.Client(base_url=base, timeout=60.0) as client:
+            r = client.get("/models/galleries")
+            assert {g["name"] for g in r.json()} == {"test"}
+
+            r = client.get("/models/available")
+            names = {m["name"] for m in r.json()}
+            assert "fixture-model" in names
+            assert "debug-tiny" in names  # embedded library
+
+            r = client.post("/models/apply", json={"id": "fixture-model"})
+            assert r.status_code == 200, r.text
+            uuid = r.json()["uuid"]
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                st = client.get(f"/models/jobs/{uuid}").json()
+                if st["processed"]:
+                    break
+                time.sleep(0.05)
+            assert st["processed"] and not st["error"], st
+
+            # the installed model is immediately configured for serving
+            r = client.get("/v1/models")
+            assert "fixture-model" in {
+                m["id"] for m in r.json()["data"]}
+
+            r = client.post("/models/delete/fixture-model")
+            uuid = r.json()["uuid"]
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                st = client.get(f"/models/jobs/{uuid}").json()
+                if st["processed"]:
+                    break
+                time.sleep(0.05)
+            assert st["processed"] and not st["error"], st
+
+            r = client.get("/models/jobs")
+            assert len(r.json()) == 2
+
+            r = client.get("/models/jobs/nope")
+            assert r.status_code == 404
+
+            r = client.post("/models/galleries",
+                            json={"name": "g2", "url": "file:///dev/null"})
+            assert r.status_code == 200
+            r = client.request("DELETE", "/models/galleries",
+                               json={"name": "g2"})
+            assert r.status_code == 200
+    finally:
+        srv.stop()
