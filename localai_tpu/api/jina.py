@@ -1,0 +1,86 @@
+"""Jina-compatible rerank endpoint.
+
+Parity: /root/reference/core/http/endpoints/jina/rerank.go +
+core/backend/rerank.go — POST /v1/rerank {model, query, documents, top_n}
+→ scored documents. The reference fans out to a cross-encoder Python
+backend; here scoring runs on the serving model's embedding path (cosine
+of mean-pooled hidden states), batched through the same engine.
+"""
+
+from __future__ import annotations
+
+import logging
+
+import numpy as np
+from aiohttp import web
+
+from localai_tpu.api import schema as sc
+from localai_tpu.config.model_config import Usecase
+
+log = logging.getLogger(__name__)
+
+
+def _state(request: web.Request):
+    from localai_tpu.api.server import STATE_KEY
+
+    return request.app[STATE_KEY]
+
+
+async def rerank(request: web.Request) -> web.Response:
+    from localai_tpu.api.openai import _default_model, _in_executor, _serving
+
+    try:
+        body = await request.json()
+    except Exception:
+        raise web.HTTPBadRequest(text="invalid JSON body")
+    query = body.get("query") or ""
+    documents = [str(d) for d in body.get("documents") or []]
+    if not query or not documents:
+        raise web.HTTPBadRequest(text="need query and documents")
+    try:
+        top_n = int(body.get("top_n") or len(documents))
+    except (TypeError, ValueError):
+        raise web.HTTPBadRequest(text="top_n must be an integer")
+    if top_n < 1:
+        raise web.HTTPBadRequest(text="top_n must be >= 1")
+
+    req = sc.OpenAIRequest(model=body.get("model") or "")
+    req.model = _default_model(request, req.model)
+    sm, _cfg = await _serving(request, req, Usecase.RERANK)
+
+    def score_all():
+        q_toks = sm.tokenizer.encode(query, add_bos=True)
+        q_vec = np.asarray(sm.runner.embed(q_toks))
+        q_vec = q_vec / max(float(np.linalg.norm(q_vec)), 1e-12)
+        scores = []
+        total_tokens = len(q_toks)
+        for doc in documents:
+            d_toks = sm.tokenizer.encode(doc, add_bos=True)
+            total_tokens += len(d_toks)
+            d_vec = np.asarray(sm.runner.embed(d_toks))
+            d_vec = d_vec / max(float(np.linalg.norm(d_vec)), 1e-12)
+            scores.append(float(q_vec @ d_vec))
+        return scores, total_tokens
+
+    scores, total_tokens = await _in_executor(request, score_all)
+    order = sorted(range(len(documents)), key=lambda i: -scores[i])[:top_n]
+    return web.json_response({
+        "model": req.model,
+        "usage": {"total_tokens": total_tokens,
+                  "prompt_tokens": total_tokens},
+        "results": [
+            {
+                "index": i,
+                "document": {"text": documents[i]},
+                "relevance_score": scores[i],
+            }
+            for i in order
+        ],
+    })
+
+
+def routes() -> list[web.RouteDef]:
+    return [
+        web.post("/v1/rerank", rerank),
+        web.post("/rerank", rerank),
+    ]

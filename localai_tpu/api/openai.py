@@ -51,14 +51,22 @@ async def _read_request(request: web.Request) -> sc.OpenAIRequest:
         raise web.HTTPBadRequest(text=f"invalid request: {e}") from None
     if not req.model:
         req.model = request.match_info.get("model", "")
-    if not req.model:
-        names = _state(request).loader.names()
-        if not names:
-            raise web.HTTPNotFound(
-                text="no models configured; install one first"
-            )
-        req.model = names[0]
+    req.model = _default_model(request, req.model)
     return req
+
+
+def _default_model(request: web.Request, model: str) -> str:
+    """Model-name fallback: explicit name, else first configured model
+    (parity: ModelFromContext, ctx/fiber.go:18-47). Shared with non-OpenAI
+    endpoints (rerank, tts, ...)."""
+    if model:
+        return model
+    names = _state(request).loader.names()
+    if not names:
+        raise web.HTTPNotFound(
+            text="no models configured; install one first"
+        )
+    return names[0]
 
 
 async def _serving(request: web.Request, req: sc.OpenAIRequest,

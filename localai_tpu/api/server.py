@@ -52,6 +52,9 @@ class AppState:
             for g in self.config.galleries
         ]
         self._gallery_service = None
+        from localai_tpu.stores import StoreRegistry
+
+        self.stores = StoreRegistry()
         # blocking engine waits run here, off the event loop
         self.executor = ThreadPoolExecutor(
             max_workers=32, thread_name_prefix="api-wait"
@@ -185,11 +188,15 @@ def create_app(state: Optional[AppState] = None) -> web.Application:
     ], client_max_size=64 * 1024 * 1024)
     app[STATE_KEY] = state
     from localai_tpu.api import gallery as gallery_routes
+    from localai_tpu.api import jina as jina_routes
+    from localai_tpu.api import stores as stores_routes
 
     app.add_routes([web.get("/", welcome)])
     app.add_routes(openai_routes.routes())
     app.add_routes(localai_routes.routes())
     app.add_routes(gallery_routes.routes())
+    app.add_routes(stores_routes.routes())
+    app.add_routes(jina_routes.routes())
 
     async def on_cleanup(_app):
         state.shutdown()

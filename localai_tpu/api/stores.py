@@ -1,0 +1,107 @@
+"""Vector-store HTTP endpoints.
+
+Parity: /root/reference/core/http/endpoints/localai/stores.go +
+routes/localai.go (POST /stores/set, /stores/get, /stores/find,
+/stores/delete) backed by the jitted VectorStore instead of a spawned
+local-store process.
+"""
+
+from __future__ import annotations
+
+import base64
+import logging
+
+from aiohttp import web
+
+log = logging.getLogger(__name__)
+
+
+def _state(request: web.Request):
+    from localai_tpu.api.server import STATE_KEY
+
+    return request.app[STATE_KEY]
+
+
+async def _body(request: web.Request) -> dict:
+    try:
+        return await request.json()
+    except Exception:
+        raise web.HTTPBadRequest(text="invalid JSON body")
+
+
+def _store(request: web.Request, body: dict):
+    return _state(request).stores.get(body.get("store") or "default")
+
+
+def _decode_values(raw: list) -> list[bytes]:
+    return [v.encode("utf-8") if isinstance(v, str)
+            else base64.b64decode(v.get("b64", "")) for v in raw]
+
+
+async def _run(request: web.Request, fn, *args):
+    """Store ops touch the device (jit, matmul, O(N·D) rebuilds) — run
+    them on the executor, mapping input errors to 400."""
+    import asyncio
+
+    try:
+        return await asyncio.get_running_loop().run_in_executor(
+            _state(request).executor, fn, *args
+        )
+    except ValueError as e:
+        raise web.HTTPBadRequest(text=str(e))
+
+
+async def stores_set(request: web.Request) -> web.Response:
+    body = await _body(request)
+    keys = body.get("keys") or []
+    values = _decode_values(body.get("values") or [])
+    await _run(request, _store(request, body).set, keys, values)
+    return web.json_response({})
+
+
+async def stores_get(request: web.Request) -> web.Response:
+    body = await _body(request)
+    st = _store(request, body)
+    keys, values = await _run(request, st.get, body.get("keys") or [])
+    found_keys, found_vals = [], []
+    for k, v in zip(keys, values):
+        if v is not None:
+            found_keys.append(k)
+            found_vals.append(v.decode("utf-8", "replace"))
+    return web.json_response({"keys": found_keys, "values": found_vals})
+
+
+async def stores_delete(request: web.Request) -> web.Response:
+    body = await _body(request)
+    await _run(request, _store(request, body).delete,
+               body.get("keys") or [])
+    return web.json_response({})
+
+
+async def stores_find(request: web.Request) -> web.Response:
+    body = await _body(request)
+    key = body.get("key")
+    if not key:
+        raise web.HTTPBadRequest(text="need key")
+    try:
+        top_k = int(body.get("topk") or body.get("top_k") or 10)
+    except (TypeError, ValueError):
+        raise web.HTTPBadRequest(text="topk must be an integer")
+    if top_k < 1:
+        raise web.HTTPBadRequest(text="topk must be >= 1")
+    keys, values, sims = await _run(
+        request, _store(request, body).find, key, top_k)
+    return web.json_response({
+        "keys": keys,
+        "values": [v.decode("utf-8", "replace") for v in values],
+        "similarities": sims,
+    })
+
+
+def routes() -> list[web.RouteDef]:
+    return [
+        web.post("/stores/set", stores_set),
+        web.post("/stores/get", stores_get),
+        web.post("/stores/delete", stores_delete),
+        web.post("/stores/find", stores_find),
+    ]

@@ -311,4 +311,7 @@ class ModelConfig(BaseModel):
             guessed.add(Usecase.SOUND_GENERATION)
         if "rerank" in name:
             guessed.add(Usecase.RERANK)
+        if self.embeddings:
+            # embedding-capable models can score query/document pairs
+            guessed.add(Usecase.RERANK)
         return guessed

@@ -1,0 +1,8 @@
+"""Vector stores: jitted cosine top-K over device-resident key matrices.
+
+Parity: the reference's local-store backend + Stores RPCs
+(/root/reference/backend/go/stores/store.go, backend/backend.proto
+StoresSet/Get/Find/Delete) and the /stores/* HTTP API.
+"""
+
+from localai_tpu.stores.store import StoreRegistry, VectorStore

@@ -1,0 +1,199 @@
+"""In-memory vector store with jitted top-K similarity search.
+
+TPU-era redesign of the reference's ``local-store`` backend
+(/root/reference/backend/go/stores/store.go:101-507): where the Go store
+keeps columnar float32 keys with insertion sort and a hand-rolled cosine
+loop (store.go:323-375,426-473 normalized fast path), here the keys live as
+one device matrix and Find is a single jitted matmul + ``lax.top_k`` — the
+shape vector search wants on an MXU.
+
+Semantics parity:
+  * Set upserts by exact key bytes; Get/Delete address by exact key.
+  * Find returns (keys, values, cosine similarities) of the top-K.
+  * The normalized fast path is implicit: stored keys and queries are
+    L2-normalized once at insert/query time, so dot == cosine.
+
+The device matrix is padded to the next power of two so repeated inserts
+reuse a handful of compiled programs instead of recompiling per size.
+"""
+
+from __future__ import annotations
+
+import threading
+from functools import partial
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@partial(jax.jit, static_argnames=("k",))
+def _topk_cosine(matrix: jax.Array, norms: jax.Array, query: jax.Array,
+                 valid: jax.Array, k: int):
+    """matrix [N, D] (unnormalized), norms [N], query [D] → (scores, idx)."""
+    qn = query / jnp.maximum(jnp.linalg.norm(query), 1e-12)
+    sims = matrix @ qn / jnp.maximum(norms, 1e-12)
+    sims = jnp.where(valid, sims, -jnp.inf)
+    return jax.lax.top_k(sims, k)
+
+
+class VectorStore:
+    """Thread-safe store: host dict for exact addressing, device matrix
+    for similarity search."""
+
+    def __init__(self, dim: Optional[int] = None):
+        self.dim = dim
+        self._lock = threading.Lock()
+        self._index: dict[bytes, int] = {}   # key bytes → row
+        self._keys: list[np.ndarray] = []    # row → key vector
+        self._values: list[bytes] = []       # row → payload
+        self._free: list[int] = []
+        self._matrix: Optional[jax.Array] = None   # [cap, D]
+        self._norms: Optional[jax.Array] = None    # [cap]
+        self._valid: Optional[jax.Array] = None    # [cap] bool
+        self._cap = 0
+        self._dirty = True
+
+    # -- internal ----------------------------------------------------------
+
+    @staticmethod
+    def _key_bytes(vec: np.ndarray) -> bytes:
+        return np.ascontiguousarray(vec, dtype=np.float32).tobytes()
+
+    def _check_dim(self, vec: np.ndarray) -> np.ndarray:
+        v = np.asarray(vec, np.float32).reshape(-1)
+        if self.dim is None:
+            self.dim = v.shape[0]
+        elif v.shape[0] != self.dim:
+            raise ValueError(
+                f"key dim {v.shape[0]} != store dim {self.dim}"
+            )
+        return v
+
+    def _sync_device(self) -> None:
+        """Rebuild the device matrix if rows changed (power-of-two cap)."""
+        if not self._dirty:
+            return
+        n = len(self._keys)
+        cap = 1
+        while cap < max(n, 1):
+            cap *= 2
+        host = np.zeros((cap, self.dim or 1), np.float32)
+        valid = np.zeros(cap, bool)
+        for i, kv in enumerate(self._keys):
+            if kv is not None:
+                host[i] = kv
+                valid[i] = True
+        self._matrix = jnp.asarray(host)
+        self._norms = jnp.linalg.norm(self._matrix, axis=1)
+        self._valid = jnp.asarray(valid)
+        self._cap = cap
+        self._dirty = False
+
+    # -- API ---------------------------------------------------------------
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._index)
+
+    def set(self, keys: Sequence[Sequence[float]],
+            values: Sequence[bytes]) -> None:
+        if len(keys) != len(values):
+            raise ValueError("keys and values must be the same length")
+        with self._lock:
+            for vec, val in zip(keys, values):
+                v = self._check_dim(np.asarray(vec))
+                kb = self._key_bytes(v)
+                row = self._index.get(kb)
+                if row is None:
+                    if self._free:
+                        row = self._free.pop()
+                        self._keys[row] = v
+                        self._values[row] = val
+                    else:
+                        row = len(self._keys)
+                        self._keys.append(v)
+                        self._values.append(val)
+                    self._index[kb] = row
+                else:
+                    self._values[row] = val
+                self._dirty = True
+
+    def _row_of(self, vec: np.ndarray) -> Optional[int]:
+        """Exact-key lookup that never latches/asserts dimensions — reads
+        against an empty or differently-sized store just miss."""
+        v = np.asarray(vec, np.float32).reshape(-1)
+        if self.dim is None or v.shape[0] != self.dim:
+            return None
+        return self._index.get(self._key_bytes(v))
+
+    def get(self, keys: Sequence[Sequence[float]]
+            ) -> tuple[list[list[float]], list[Optional[bytes]]]:
+        out_k, out_v = [], []
+        with self._lock:
+            for vec in keys:
+                v = np.asarray(vec, np.float32).reshape(-1)
+                row = self._row_of(v)
+                out_k.append([float(x) for x in v])
+                out_v.append(self._values[row] if row is not None else None)
+        return out_k, out_v
+
+    def delete(self, keys: Sequence[Sequence[float]]) -> int:
+        removed = 0
+        with self._lock:
+            for vec in keys:
+                v = np.asarray(vec, np.float32).reshape(-1)
+                row = self._row_of(v)
+                if row is not None:
+                    self._index.pop(self._key_bytes(v), None)
+                if row is None:
+                    continue
+                self._keys[row] = None  # type: ignore[call-overload]
+                self._values[row] = b""
+                self._free.append(row)
+                removed += 1
+                self._dirty = True
+        return removed
+
+    def find(self, key: Sequence[float], top_k: int
+             ) -> tuple[list[list[float]], list[bytes], list[float]]:
+        with self._lock:
+            if not self._index:
+                return [], [], []
+            q = self._check_dim(np.asarray(key))
+            self._sync_device()
+            k = min(top_k, len(self._index))
+            scores, idx = _topk_cosine(
+                self._matrix, self._norms, jnp.asarray(q), self._valid,
+                min(max(k, 1), self._cap),
+            )
+            scores = np.asarray(scores)
+            idx = np.asarray(idx)
+            keys_out, vals_out, sims_out = [], [], []
+            for s, i in zip(scores, idx):
+                if not np.isfinite(s) or len(keys_out) >= k:
+                    continue
+                keys_out.append([float(x) for x in self._keys[int(i)]])
+                vals_out.append(self._values[int(i)])
+                sims_out.append(float(s))
+            return keys_out, vals_out, sims_out
+
+
+class StoreRegistry:
+    """Named stores (the API server can host several)."""
+
+    def __init__(self) -> None:
+        self._stores: dict[str, VectorStore] = {}
+        self._lock = threading.Lock()
+
+    def get(self, name: str = "default") -> VectorStore:
+        with self._lock:
+            st = self._stores.get(name)
+            if st is None:
+                st = self._stores[name] = VectorStore()
+            return st
+
+    def drop(self, name: str) -> bool:
+        with self._lock:
+            return self._stores.pop(name, None) is not None

@@ -216,6 +216,83 @@ class BackendServicer:
                 self._sm = None
 
 
+class StoreServicer:
+    """Standalone vector-store worker (parity: the local-store Go backend
+    process, /root/reference/backend/go/stores/store.go, speaking the
+    Stores RPCs of the shared contract)."""
+
+    def __init__(self) -> None:
+        from localai_tpu.stores import VectorStore
+
+        self._store = VectorStore()
+
+    def Health(self, request: pb.HealthMessage, context) -> pb.Reply:
+        return pb.Reply(message=b"OK")
+
+    def LoadModel(self, request: pb.ModelOptions, context) -> pb.Result:
+        return pb.Result(success=True, message="store ready")
+
+    def Status(self, request: pb.HealthMessage, context) -> pb.StatusResponse:
+        return pb.StatusResponse(state=pb.StatusResponse.READY)
+
+    def StoresSet(self, request: pb.StoresSetOptions, context) -> pb.Result:
+        try:
+            self._store.set(
+                [list(k.floats) for k in request.keys],
+                [v.bytes for v in request.values],
+            )
+        except ValueError as e:
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+        return pb.Result(success=True)
+
+    def StoresDelete(self, request: pb.StoresDeleteOptions,
+                     context) -> pb.Result:
+        try:
+            self._store.delete([list(k.floats) for k in request.keys])
+        except ValueError as e:
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+        return pb.Result(success=True)
+
+    def StoresGet(self, request: pb.StoresGetOptions,
+                  context) -> pb.StoresGetResult:
+        try:
+            keys, values = self._store.get(
+                [list(k.floats) for k in request.keys]
+            )
+        except ValueError as e:
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+        out = pb.StoresGetResult()
+        for k, v in zip(keys, values):
+            if v is None:
+                continue
+            out.keys.append(pb.StoresKey(floats=k))
+            out.values.append(pb.StoresValue(bytes=v))
+        return out
+
+    def StoresFind(self, request: pb.StoresFindOptions,
+                   context) -> pb.StoresFindResult:
+        try:
+            keys, values, sims = self._store.find(
+                list(request.key.floats), request.top_k or 10
+            )
+        except ValueError as e:
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+        out = pb.StoresFindResult(similarities=sims)
+        for k, v in zip(keys, values):
+            out.keys.append(pb.StoresKey(floats=k))
+            out.values.append(pb.StoresValue(bytes=v))
+        return out
+
+    def shutdown(self) -> None:
+        pass
+
+
+SERVICERS = {
+    "llm": BackendServicer,
+    "store": StoreServicer,
+}
+
+
 def serve_worker(addr: str = "127.0.0.1:0",
                  servicer: Optional[Any] = None,
                  block: bool = True) -> tuple[grpc.Server, int]:
@@ -252,7 +329,7 @@ def main(argv: Optional[list[str]] = None) -> int:
     parser.add_argument("--addr", default="127.0.0.1:0",
                         help="host:port to bind (port 0 = ephemeral)")
     parser.add_argument("--servicer", default="llm",
-                        help="which servicer to run (llm)")
+                        help=f"which servicer to run ({'/'.join(SERVICERS)})")
     args = parser.parse_args(argv)
     logging.basicConfig(
         level=os.environ.get("LOCALAI_LOG_LEVEL", "INFO").upper(),
@@ -268,7 +345,11 @@ def main(argv: Optional[list[str]] = None) -> int:
             jax.config.update("jax_platforms", plat)
         except Exception:  # noqa: BLE001 — backend already initialized
             pass
-    servicer = BackendServicer()
+    try:
+        servicer = SERVICERS[args.servicer]()
+    except KeyError:
+        parser.error(f"unknown servicer {args.servicer!r}; "
+                     f"have {sorted(SERVICERS)}")
     _server, port = serve_worker(args.addr, servicer=servicer, block=False)
     # the parent process-manager greps this line for the bound port
     print(f"WORKER_READY port={port}", flush=True)

@@ -1,0 +1,147 @@
+"""Vector store + rerank: library semantics, gRPC worker, HTTP API.
+
+Parity model: the reference's stores integration test spawns the real
+local-store backend and drives Set/Get/Find via the client
+(/root/reference/tests/integration/stores_test.go); here the same flow
+runs against the StoreServicer over real gRPC plus the HTTP endpoints.
+"""
+
+import numpy as np
+import pytest
+
+from localai_tpu.stores import StoreRegistry, VectorStore
+
+
+@pytest.fixture()
+def store():
+    return VectorStore()
+
+
+def test_set_get_delete(store):
+    store.set([[1, 0, 0], [0, 1, 0]], [b"a", b"b"])
+    assert len(store) == 2
+
+    keys, values = store.get([[1, 0, 0], [0, 0, 1]])
+    assert values[0] == b"a"
+    assert values[1] is None
+
+    # upsert by exact key
+    store.set([[1, 0, 0]], [b"a2"])
+    assert len(store) == 2
+    _, values = store.get([[1, 0, 0]])
+    assert values[0] == b"a2"
+
+    assert store.delete([[1, 0, 0]]) == 1
+    assert store.delete([[1, 0, 0]]) == 0
+    assert len(store) == 1
+
+
+def test_find_cosine_order(store):
+    store.set(
+        [[1, 0, 0], [0.9, 0.1, 0], [0, 1, 0], [-1, 0, 0]],
+        [b"east", b"mostly-east", b"north", b"west"],
+    )
+    keys, values, sims = store.find([1, 0, 0], 3)
+    assert values == [b"east", b"mostly-east", b"north"]
+    assert sims[0] == pytest.approx(1.0, abs=1e-5)
+    assert sims == sorted(sims, reverse=True)
+    # deleted rows never come back
+    store.delete([[1, 0, 0]])
+    _, values, _ = store.find([1, 0, 0], 3)
+    assert b"east" not in values
+
+
+def test_find_topk_larger_than_store(store):
+    store.set([[1, 0]], [b"only"])
+    keys, values, sims = store.find([1, 0], 10)
+    assert values == [b"only"]
+
+
+def test_dim_mismatch(store):
+    store.set([[1, 0, 0]], [b"x"])
+    with pytest.raises(ValueError, match="dim"):
+        store.set([[1, 0]], [b"y"])
+
+
+def test_growth_reuses_padding(store):
+    rng = np.random.default_rng(0)
+    for i in range(20):
+        store.set([rng.normal(size=4)], [f"v{i}".encode()])
+    _, values, sims = store.find(rng.normal(size=4), 5)
+    assert len(values) == 5
+    assert sims == sorted(sims, reverse=True)
+
+
+def test_registry():
+    reg = StoreRegistry()
+    a = reg.get("a")
+    assert reg.get("a") is a
+    assert reg.get("b") is not a
+    assert reg.drop("a")
+    assert not reg.drop("a")
+
+
+def test_store_worker_grpc():
+    """The standalone store servicer over real gRPC."""
+    from localai_tpu.worker import WorkerClient
+    from localai_tpu.worker.server import StoreServicer, serve_worker
+
+    server, port = serve_worker("127.0.0.1:0", servicer=StoreServicer(),
+                                block=False)
+    try:
+        c = WorkerClient(f"127.0.0.1:{port}")
+        assert c.health()
+        c.stores_set([[1, 0], [0, 1]], [b"x", b"y"])
+        got = c.stores_get([[1, 0]])
+        assert got.values[0].bytes == b"x"
+        found = c.stores_find([1, 0.1], 2)
+        assert found.values[0].bytes == b"x"
+        assert list(found.similarities) == sorted(found.similarities,
+                                                  reverse=True)
+        c.stores_delete([[1, 0]])
+        assert len(c.stores_get([[1, 0]]).values) == 0
+        c.close()
+    finally:
+        server.stop(grace=None)
+
+
+def test_stores_and_rerank_http(tmp_path):
+    from tests.test_api import _ServerThread, make_state
+    import httpx
+
+    state = make_state(tmp_path, write_tiny=True)
+    srv = _ServerThread(state)
+    try:
+        with httpx.Client(base_url=srv.base, timeout=120.0) as client:
+            r = client.post("/stores/set", json={
+                "keys": [[1, 0], [0, 1]], "values": ["alpha", "beta"]})
+            assert r.status_code == 200, r.text
+            r = client.post("/stores/find", json={"key": [1, 0.2],
+                                                  "topk": 1})
+            assert r.json()["values"] == ["alpha"]
+            r = client.post("/stores/get", json={"keys": [[0, 1]]})
+            assert r.json()["values"] == ["beta"]
+            r = client.post("/stores/delete", json={"keys": [[0, 1]]})
+            assert r.status_code == 200
+            r = client.post("/stores/get", json={"keys": [[0, 1]]})
+            assert r.json()["values"] == []
+
+            # rerank rides the tiny model's embedding path
+            r = client.post("/v1/rerank", json={
+                "model": "tiny",
+                "query": "hello world",
+                "documents": ["hello world", "completely different",
+                              "hello there"],
+                "top_n": 2,
+            })
+            assert r.status_code == 200, r.text
+            body = r.json()
+            assert len(body["results"]) == 2
+            scores = [x["relevance_score"] for x in body["results"]]
+            assert scores == sorted(scores, reverse=True)
+            assert body["usage"]["total_tokens"] > 0
+
+            r = client.post("/v1/rerank", json={"model": "tiny"})
+            assert r.status_code == 400
+    finally:
+        srv.stop()
