@@ -1,0 +1,217 @@
+"""Audio endpoints: transcription (OpenAI), TTS (OpenAI/LocalAI), and
+Elevenlabs-compatible routes.
+
+Parity:
+  * POST /v1/audio/transcriptions — multipart upload → whisper engine
+    (/root/reference/core/http/endpoints/openai/transcription.go)
+  * POST /v1/audio/speech + POST /tts — TTS
+    (endpoints/localai/tts.go, routes/openai.go)
+  * POST /v1/text-to-speech/{voice_id}, /v1/sound-generation —
+    Elevenlabs surface (endpoints/elevenlabs/*.go, routes/elevenlabs.go)
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+
+from aiohttp import web
+
+from localai_tpu.config.model_config import Usecase
+
+log = logging.getLogger(__name__)
+
+
+def _state(request: web.Request):
+    from localai_tpu.api.server import STATE_KEY
+
+    return request.app[STATE_KEY]
+
+
+_whisper_lock = threading.Lock()
+
+
+def _whisper_for(state, name: str):
+    """name → loaded WhisperModel, cached on AppState (the analogue of
+    ModelManager.get for the transcription modality)."""
+    from localai_tpu.models import whisper as wh
+
+    with _whisper_lock:
+        cache = getattr(state, "_whisper_cache", None)
+        if cache is None:
+            cache = state._whisper_cache = {}
+        model = cache.get(name)
+        if model is not None:
+            return model
+        mcfg = state.loader.get(name)
+        ref = (mcfg.model if mcfg else name) or name
+        if ref.startswith("debug:"):
+            model = wh.debug_model()
+        else:
+            from pathlib import Path
+
+            for cand in (Path(ref), Path(state.config.model_path) / ref):
+                if (cand / "config.json").exists():
+                    model = wh.load_hf_whisper(cand)
+                    break
+            else:
+                raise web.HTTPNotFound(
+                    text=f"whisper model {ref!r} not found"
+                )
+        cache[name] = model
+        return model
+
+
+def _transcript_model(request: web.Request, name: str) -> str:
+    state = _state(request)
+    if name:
+        return name
+    for cfg in state.loader.all():
+        if cfg.has_usecase(Usecase.TRANSCRIPT):
+            return cfg.name
+    raise web.HTTPNotFound(
+        text="no transcription model configured (backend: whisper)"
+    )
+
+
+async def transcribe(request: web.Request) -> web.Response:
+    """POST /v1/audio/transcriptions (multipart: file, model, language,
+    translate, response_format)."""
+    from localai_tpu.api.openai import _in_executor
+    from localai_tpu.audio import read_wav
+
+    if not (request.content_type or "").startswith("multipart/"):
+        raise web.HTTPBadRequest(text="expected multipart/form-data")
+    reader = await request.multipart()
+    audio_bytes = b""
+    fields: dict[str, str] = {}
+    async for part in reader:
+        if part.name == "file":
+            audio_bytes = await part.read(decode=False)
+        else:
+            fields[part.name or ""] = (await part.text())
+    if not audio_bytes:
+        raise web.HTTPBadRequest(text="missing file field")
+
+    name = _transcript_model(request, fields.get("model", ""))
+    state = _state(request)
+
+    def run():
+        model = _whisper_for(state, name)
+        audio = read_wav(audio_bytes)
+        return model.transcribe(
+            audio,
+            language=fields.get("language") or None,
+            translate=fields.get("translate", "") in ("1", "true"),
+        )
+
+    try:
+        result = await _in_executor(request, run)
+    except ValueError as e:
+        raise web.HTTPBadRequest(text=str(e))
+
+    fmt = fields.get("response_format", "json")
+    if fmt == "text":
+        return web.Response(text=result["text"] + "\n")
+    if fmt == "verbose_json":
+        return web.json_response({
+            "task": "transcribe",
+            "duration": result["segments"][-1]["end"]
+            if result["segments"] else 0.0,
+            "text": result["text"],
+            "segments": result["segments"],
+        })
+    return web.json_response({"text": result["text"],
+                              "segments": result["segments"]})
+
+
+def _tts_params(state, model_name: str) -> tuple[str, float]:
+    """Resolve default voice/speed from the named TTS config, if any."""
+    voice, speed = "alloy", 1.0
+    mcfg = state.loader.get(model_name) if model_name else None
+    if mcfg is not None:
+        tts_cfg = getattr(mcfg, "tts", None)
+        if tts_cfg is not None and getattr(tts_cfg, "voice", ""):
+            voice = tts_cfg.voice
+    return voice, speed
+
+
+async def _speak(request: web.Request, text: str, voice: str,
+                 speed: float) -> web.Response:
+    from localai_tpu.api.openai import _in_executor
+    from localai_tpu.audio import write_wav
+    from localai_tpu.audio import tts as ttsmod
+
+    if not text:
+        raise web.HTTPBadRequest(text="empty input text")
+
+    def run():
+        return write_wav(ttsmod.synthesize(text, voice=voice, speed=speed))
+
+    data = await _in_executor(request, run)
+    return web.Response(body=data, content_type="audio/wav")
+
+
+async def speech(request: web.Request) -> web.Response:
+    """POST /v1/audio/speech (OpenAI) and POST /tts (LocalAI)."""
+    try:
+        body = await request.json()
+    except Exception:
+        raise web.HTTPBadRequest(text="invalid JSON body")
+    state = _state(request)
+    text = body.get("input") or body.get("text") or ""
+    voice, speed = _tts_params(state, body.get("model") or "")
+    voice = body.get("voice") or voice
+    try:
+        speed = float(body.get("speed") or speed)
+    except (TypeError, ValueError):
+        raise web.HTTPBadRequest(text="speed must be a number")
+    return await _speak(request, text, voice, speed)
+
+
+async def elevenlabs_tts(request: web.Request) -> web.Response:
+    """POST /v1/text-to-speech/{voice_id} (Elevenlabs parity)."""
+    try:
+        body = await request.json()
+    except Exception:
+        raise web.HTTPBadRequest(text="invalid JSON body")
+    voice = request.match_info.get("voice_id", "alloy")
+    return await _speak(request, body.get("text") or "", voice, 1.0)
+
+
+async def sound_generation(request: web.Request) -> web.Response:
+    """POST /v1/sound-generation (Elevenlabs parity; the reference fans
+    out to transformers-musicgen)."""
+    from localai_tpu.api.openai import _in_executor
+    from localai_tpu.audio import write_wav
+    from localai_tpu.audio import tts as ttsmod
+
+    try:
+        body = await request.json()
+    except Exception:
+        raise web.HTTPBadRequest(text="invalid JSON body")
+    text = body.get("text") or body.get("input") or ""
+    if not text:
+        raise web.HTTPBadRequest(text="empty input text")
+    try:
+        duration = float(body.get("duration_seconds") or 3.0)
+        temperature = float(body.get("temperature") or 1.0)
+    except (TypeError, ValueError):
+        raise web.HTTPBadRequest(text="duration/temperature must be numbers")
+
+    def run():
+        return write_wav(ttsmod.generate_sound(text, duration, temperature))
+
+    data = await _in_executor(request, run)
+    return web.Response(body=data, content_type="audio/wav")
+
+
+def routes() -> list[web.RouteDef]:
+    return [
+        web.post("/v1/audio/transcriptions", transcribe),
+        web.post("/v1/audio/speech", speech),
+        web.post("/tts", speech),
+        web.post("/v1/text-to-speech/{voice_id}", elevenlabs_tts),
+        web.post("/v1/sound-generation", sound_generation),
+        web.post("/sound-generation", sound_generation),
+    ]

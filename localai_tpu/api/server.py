@@ -187,6 +187,7 @@ def create_app(state: Optional[AppState] = None) -> web.Application:
         metrics_middleware,
     ], client_max_size=64 * 1024 * 1024)
     app[STATE_KEY] = state
+    from localai_tpu.api import audio as audio_routes
     from localai_tpu.api import gallery as gallery_routes
     from localai_tpu.api import jina as jina_routes
     from localai_tpu.api import stores as stores_routes
@@ -197,6 +198,7 @@ def create_app(state: Optional[AppState] = None) -> web.Application:
     app.add_routes(gallery_routes.routes())
     app.add_routes(stores_routes.routes())
     app.add_routes(jina_routes.routes())
+    app.add_routes(audio_routes.routes())
 
     async def on_cleanup(_app):
         state.shutdown()

@@ -34,8 +34,20 @@ def _store(request: web.Request, body: dict):
 
 
 def _decode_values(raw: list) -> list[bytes]:
-    return [v.encode("utf-8") if isinstance(v, str)
-            else base64.b64decode(v.get("b64", "")) for v in raw]
+    out = []
+    for v in raw:
+        if isinstance(v, str):
+            out.append(v.encode("utf-8"))
+        elif isinstance(v, dict) and "b64" in v:
+            try:
+                out.append(base64.b64decode(v["b64"]))
+            except Exception:
+                raise web.HTTPBadRequest(text="invalid base64 value")
+        else:
+            raise web.HTTPBadRequest(
+                text="values must be strings or {\"b64\": ...} objects"
+            )
+    return out
 
 
 async def _run(request: web.Request, fn, *args):

@@ -1,0 +1,9 @@
+"""Audio: wav I/O, log-mel frontend, transcription engine glue, TTS.
+
+Parity: the reference's audio tier — whisper.cpp transcription
+(/root/reference/backend/go/transcribe/whisper/), piper TTS
+(backend/go/tts/), musicgen sound generation (backend/python/
+transformers-musicgen) — rebuilt as JAX models + jitted DSP.
+"""
+
+from localai_tpu.audio.wav import read_wav, write_wav

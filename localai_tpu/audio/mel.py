@@ -1,0 +1,97 @@
+"""Whisper-compatible log-mel spectrogram frontend, in JAX.
+
+Replaces whisper.cpp's C mel extraction (consumed via the cgo backend,
+/root/reference/backend/go/transcribe/whisper/whisper.go:21-105) with a
+jitted STFT + slaney-scale mel filterbank: frame, window, rFFT, magnitude²,
+mel project, log10, clamp — all fused by XLA, so the frontend runs on
+device alongside the encoder instead of on the host.
+
+Constants match OpenAI whisper (n_fft=400, hop=160, 80 mels @ 16 kHz) so
+real checkpoint weights see the distribution they were trained on.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+SAMPLE_RATE = 16000
+N_FFT = 400
+HOP = 160
+N_MELS = 80
+CHUNK_SECONDS = 30
+CHUNK_SAMPLES = SAMPLE_RATE * CHUNK_SECONDS
+CHUNK_FRAMES = CHUNK_SAMPLES // HOP  # 3000
+
+
+def _hz_to_mel(f: np.ndarray) -> np.ndarray:
+    """Slaney scale (librosa default, what whisper uses)."""
+    f = np.asarray(f, np.float64)
+    mel = f / (200.0 / 3)
+    log_step = np.log(6.4) / 27.0
+    brk = 1000.0
+    brk_mel = brk / (200.0 / 3)
+    safe = np.maximum(f, 1e-10)
+    return np.where(f >= brk, brk_mel + np.log(safe / brk) / log_step, mel)
+
+
+def _mel_to_hz(m: np.ndarray) -> np.ndarray:
+    m = np.asarray(m, np.float64)
+    log_step = np.log(6.4) / 27.0
+    brk_mel = 15.0
+    f = m * (200.0 / 3)
+    return np.where(m >= brk_mel, 1000.0 * np.exp(log_step * (m - brk_mel)), f)
+
+
+def mel_filterbank(n_mels: int = N_MELS, n_fft: int = N_FFT,
+                   rate: int = SAMPLE_RATE) -> np.ndarray:
+    """[n_mels, n_fft//2 + 1] slaney-normalized triangular filters."""
+    n_freqs = n_fft // 2 + 1
+    freqs = np.linspace(0, rate / 2, n_freqs)
+    mel_pts = np.linspace(_hz_to_mel(np.array(0.0)),
+                          _hz_to_mel(np.array(rate / 2.0)), n_mels + 2)
+    hz_pts = _mel_to_hz(mel_pts)
+    fb = np.zeros((n_mels, n_freqs))
+    for i in range(n_mels):
+        lo, ctr, hi = hz_pts[i], hz_pts[i + 1], hz_pts[i + 2]
+        up = (freqs - lo) / max(ctr - lo, 1e-10)
+        down = (hi - freqs) / max(hi - ctr, 1e-10)
+        fb[i] = np.maximum(0.0, np.minimum(up, down))
+        fb[i] *= 2.0 / (hi - lo)  # slaney area normalization
+    return fb.astype(np.float32)
+
+
+@partial(jax.jit, static_argnames=("n_mels",))
+def log_mel(audio: jax.Array, filters: jax.Array,
+            n_mels: int = N_MELS) -> jax.Array:
+    """audio [CHUNK_SAMPLES] f32 → log-mel [n_mels, CHUNK_FRAMES]."""
+    # periodic Hann (torch.hann_window), NOT the symmetric jnp.hanning —
+    # whisper checkpoints were trained with the periodic variant
+    window = (0.5 * (1.0 - jnp.cos(
+        2.0 * jnp.pi * jnp.arange(N_FFT) / N_FFT))).astype(jnp.float32)
+    pad = N_FFT // 2
+    x = jnp.pad(audio, (pad, pad), mode="reflect")
+    n_frames = CHUNK_FRAMES
+    idx = jnp.arange(n_frames)[:, None] * HOP + jnp.arange(N_FFT)[None, :]
+    frames = x[idx] * window[None, :]
+    spec = jnp.fft.rfft(frames, axis=-1)
+    power = jnp.abs(spec) ** 2                    # [frames, n_freqs]
+    mel = power @ filters.T                       # [frames, n_mels]
+    logspec = jnp.log10(jnp.maximum(mel, 1e-10))
+    logspec = jnp.maximum(logspec, jnp.max(logspec) - 8.0)
+    logspec = (logspec + 4.0) / 4.0
+    return logspec.T                              # [n_mels, frames]
+
+
+def chunk_audio(audio: np.ndarray) -> list[np.ndarray]:
+    """Split/pad into 30-s chunks (whisper's fixed receptive field)."""
+    chunks = []
+    for off in range(0, max(len(audio), 1), CHUNK_SAMPLES):
+        c = audio[off:off + CHUNK_SAMPLES]
+        if len(c) < CHUNK_SAMPLES:
+            c = np.pad(c, (0, CHUNK_SAMPLES - len(c)))
+        chunks.append(c.astype(np.float32))
+    return chunks

@@ -116,9 +116,9 @@ class VectorStore:
                         self._keys.append(v)
                         self._values.append(val)
                     self._index[kb] = row
+                    self._dirty = True  # value-only upserts don't touch keys
                 else:
                     self._values[row] = val
-                self._dirty = True
 
     def _row_of(self, vec: np.ndarray) -> Optional[int]:
         """Exact-key lookup that never latches/asserts dimensions — reads
@@ -163,10 +163,17 @@ class VectorStore:
                 return [], [], []
             q = self._check_dim(np.asarray(key))
             self._sync_device()
-            k = min(top_k, len(self._index))
+            k = min(max(top_k, 1), len(self._index))
+            # round the device-side k to a power of two capped at cap, so
+            # distinct client top_k values share compiled programs; the
+            # host filter below trims to the exact k
+            k_dev = 1
+            while k_dev < k:
+                k_dev *= 2
+            k_dev = min(k_dev, self._cap)
             scores, idx = _topk_cosine(
                 self._matrix, self._norms, jnp.asarray(q), self._valid,
-                min(max(k, 1), self._cap),
+                k_dev,
             )
             scores = np.asarray(scores)
             idx = np.asarray(idx)

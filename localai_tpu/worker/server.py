@@ -271,6 +271,9 @@ class StoreServicer:
 
     def StoresFind(self, request: pb.StoresFindOptions,
                    context) -> pb.StoresFindResult:
+        if request.top_k < 0:
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT,
+                          "top_k must be >= 1")
         try:
             keys, values, sims = self._store.find(
                 list(request.key.floats), request.top_k or 10
@@ -287,9 +290,113 @@ class StoreServicer:
         pass
 
 
+class AudioServicer:
+    """Audio worker: AudioTranscription + TTS + SoundGeneration RPCs
+    (parity: the whisper.cpp, piper and musicgen worker processes,
+    /root/reference/backend/go/transcribe/whisper/whisper.go:21-105,
+    backend/go/tts/piper.go:20-49, backend/python/transformers-musicgen)."""
+
+    def __init__(self) -> None:
+        self._whisper = None
+        self._lock = threading.Lock()
+
+    def Health(self, request: pb.HealthMessage, context) -> pb.Reply:
+        return pb.Reply(message=b"OK")
+
+    def Status(self, request: pb.HealthMessage, context) -> pb.StatusResponse:
+        return pb.StatusResponse(state=pb.StatusResponse.READY)
+
+    def LoadModel(self, request: pb.ModelOptions, context) -> pb.Result:
+        from pathlib import Path
+
+        from localai_tpu.models import whisper as wh
+
+        with self._lock:
+            try:
+                ref = request.model or "debug:whisper"
+                if ref.startswith("debug:"):
+                    self._whisper = wh.debug_model(seed=request.seed)
+                else:
+                    base = Path(request.model_path or "models")
+                    cand = Path(ref) if Path(ref).is_dir() else base / ref
+                    self._whisper = wh.load_hf_whisper(cand)
+                return pb.Result(success=True, message="ok")
+            except Exception as e:  # noqa: BLE001
+                log.exception("audio LoadModel failed")
+                return pb.Result(success=False,
+                                 message=f"{type(e).__name__}: {e}")
+
+    def AudioTranscription(self, request: pb.TranscriptRequest,
+                           context) -> pb.TranscriptResult:
+        from localai_tpu.audio import read_wav
+
+        if self._whisper is None:
+            context.abort(grpc.StatusCode.FAILED_PRECONDITION,
+                          "no model loaded (call LoadModel first)")
+        data = request.audio
+        if not data and request.path:
+            try:
+                data = open(request.path, "rb").read()
+            except OSError as e:
+                context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+        try:
+            audio = read_wav(data)
+        except ValueError as e:
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+        res = self._whisper.transcribe(
+            audio, language=request.language or None,
+            translate=request.translate,
+        )
+        out = pb.TranscriptResult(text=res["text"])
+        for seg in res["segments"]:
+            out.segments.append(pb.TranscriptSegment(
+                id=seg["id"],
+                start=int(seg["start"] * 1e9),
+                end=int(seg["end"] * 1e9),
+                text=seg["text"],
+                tokens=seg["tokens"],
+            ))
+        return out
+
+    def TTS(self, request: pb.TTSRequest, context) -> pb.AudioResult:
+        from localai_tpu.audio import write_wav
+        from localai_tpu.audio import tts as ttsmod
+
+        if not request.text:
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT, "empty text")
+        wav = write_wav(ttsmod.synthesize(
+            request.text, voice=request.voice or "alloy"))
+        if request.dst:
+            with open(request.dst, "wb") as f:
+                f.write(wav)
+            return pb.AudioResult(success=True, message=request.dst)
+        return pb.AudioResult(success=True, audio=wav)
+
+    def SoundGeneration(self, request: pb.SoundGenerationRequest,
+                        context) -> pb.AudioResult:
+        from localai_tpu.audio import write_wav
+        from localai_tpu.audio import tts as ttsmod
+
+        if not request.text:
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT, "empty text")
+        dur = request.duration if request.HasField("duration") else 3.0
+        temp = (request.temperature
+                if request.HasField("temperature") else 1.0)
+        wav = write_wav(ttsmod.generate_sound(request.text, dur, temp))
+        if request.dst:
+            with open(request.dst, "wb") as f:
+                f.write(wav)
+            return pb.AudioResult(success=True, message=request.dst)
+        return pb.AudioResult(success=True, audio=wav)
+
+    def shutdown(self) -> None:
+        pass
+
+
 SERVICERS = {
     "llm": BackendServicer,
     "store": StoreServicer,
+    "audio": AudioServicer,
 }
 
 
