@@ -30,7 +30,8 @@ def main() -> None:
 
     # env knobs for smoke runs (the driver uses the defaults)
     preset = os.environ.get("BENCH_MODEL", "debug:1b")
-    steps = int(os.environ.get("BENCH_STEPS", "50"))
+    steps = int(os.environ.get("BENCH_STEPS", "48"))
+    multi = int(os.environ.get("BENCH_MULTI_STEP", "16"))
 
     model = resolve_model(preset, dtype="bfloat16")
     num_slots = 8
@@ -45,22 +46,25 @@ def main() -> None:
         runner.admit(slot, prompt, temperature=0.0)
 
     # warmup (compile + first dispatches)
-    for _ in range(5):
-        runner.step()
+    runner.step_n(multi)
+    runner.step_n(multi)
     jax.block_until_ready(runner.state.tokens)
 
-    # pipelined loop — the scheduler's production pattern: depth-4 in-flight
-    # dispatches with async D2H copies, so neither the device nor the host
+    # pipelined multi-step loop — the scheduler's production pattern: each
+    # dispatch decodes `multi` tokens per slot inside one compiled lax.scan
+    # program (amortizing dispatch/tunnel RTT), depth-2 dispatches stay in
+    # flight with async D2H copies, so neither the device nor the host
     # round-trip sits on the critical path
     from collections import deque
 
     import numpy as np
 
-    depth = 4
+    depth = 2
+    dispatches = max(1, steps // multi)
     t0 = time.perf_counter()
     q: deque = deque()
-    for _ in range(steps):
-        toks = runner.step_async()
+    for _ in range(dispatches):
+        toks = runner.step_n_async(multi)
         try:
             toks.copy_to_host_async()
         except AttributeError:
@@ -72,7 +76,7 @@ def main() -> None:
         np.asarray(q.popleft())
     dt = time.perf_counter() - t0
 
-    tok_s = steps * num_slots / dt
+    tok_s = dispatches * multi * num_slots / dt
     print(json.dumps({
         "metric": "decode_throughput_llama1b_bs8",
         "value": round(tok_s, 2),

@@ -159,7 +159,10 @@ class EngineConfig(BaseModel):
     kv_dtype: str = "bfloat16"        # KV-cache dtype (int8 supported)
     quantization: Optional[str] = None  # e.g. "int8" weight-only
     donate_kv: bool = True            # buffer donation for in-place KV updates
-    decode_steps_per_dispatch: int = 1  # tokens per host round-trip (lax.scan)
+    decode_steps_per_dispatch: int = 16  # tokens per dispatch (lax.scan) —
+                                      # amortizes host→device RTT; lower it
+                                      # for tighter streaming cadence
+    pipeline_depth: int = 2           # in-flight decode dispatches
 
 
 class DiffusionConfig(BaseModel):

@@ -157,6 +157,10 @@ class ModelRunner:
         self._decode_n = jax.jit(
             self._decode_n_fn, static_argnames=("n",), donate_argnums=(1, 2)
         )
+        self._decode_frozen_n = jax.jit(
+            self._decode_frozen_n_fn, static_argnames=("n",),
+            donate_argnums=(1, 2),
+        )
         self._prefill = jax.jit(
             self._prefill_fn, static_argnames=("bucket",), donate_argnums=(1, 2)
         )
@@ -189,6 +193,9 @@ class ModelRunner:
         tokens, keys = smp.sample(
             logits, state.params, state.counts, state.keys, state.bias
         )
+        # inactive/frozen slots keep their key: a seeded request's stream must
+        # not depend on batch composition (key advances == tokens sampled)
+        keys = jnp.where(state.active, keys, state.keys)
         tokens = jnp.where(state.active, tokens, state.tokens)
         counts = smp.update_counts(state.counts, tokens, state.active)
         positions = jnp.where(
@@ -211,6 +218,32 @@ class ModelRunner:
 
         (kv, state), tokens = jax.lax.scan(
             body, (kv, state), None, length=n
+        )
+        return kv, state, tokens
+
+    def _decode_frozen_n_fn(self, params, kv: KVCache, state: DecodeState,
+                            freeze, *, n: int):
+        """n decode steps in one dispatch where slots in ``freeze`` advance
+        only on the FIRST step — the per-slot constraint gating path: a
+        grammar-constrained slot needs its logit mask refreshed by the host
+        between tokens (so it gets one token per dispatch), while the
+        unconstrained slots ride the same dispatch for n tokens. Replaces the
+        whole-batch synchronous fallback (one constrained request no longer
+        de-pipelines the batch). Returns tokens [n, S]; rows 1..n-1 are only
+        meaningful for non-frozen slots."""
+        full_active = state.active
+
+        def body(carry, i):
+            kv, st = carry
+            eff = jnp.where(i == 0, full_active, full_active & ~freeze)
+            kv, st, tokens = self._decode_fn(
+                params, kv, dataclasses.replace(st, active=eff)
+            )
+            st = dataclasses.replace(st, active=full_active)
+            return (kv, st), tokens
+
+        (kv, state), tokens = jax.lax.scan(
+            body, (kv, state), jnp.arange(n), length=n
         )
         return kv, state, tokens
 
@@ -377,6 +410,23 @@ class ModelRunner:
         """n decode iterations in one dispatch; returns tokens [n, S]."""
         self.kv, self.state, tokens = self._decode_n(
             self.params, self.kv, self.state, n=n
+        )
+        return np.asarray(tokens)
+
+    def step_n_async(self, n: int) -> jax.Array:
+        """Like step_n() but returns the [n, S] device array without
+        synchronizing — callers overlap the host read with later dispatches."""
+        self.kv, self.state, tokens = self._decode_n(
+            self.params, self.kv, self.state, n=n
+        )
+        return tokens
+
+    def step_frozen_n(self, freeze: np.ndarray, n: int) -> np.ndarray:
+        """n decode iterations where ``freeze``-masked slots advance only on
+        the first; returns tokens [n, S] (rows 1+ stale for frozen slots)."""
+        self.kv, self.state, tokens = self._decode_frozen_n(
+            self.params, self.kv, self.state,
+            jnp.asarray(freeze, jnp.bool_), n=n,
         )
         return np.asarray(tokens)
 

@@ -170,11 +170,17 @@ class Scheduler:
     """Owns one ModelRunner + tokenizer; runs the engine thread."""
 
     def __init__(self, runner: ModelRunner, tokenizer: Any,
-                 *, default_max_tokens: int = 2048, pipeline_depth: int = 4):
+                 *, default_max_tokens: int = 2048, pipeline_depth: int = 2,
+                 multi_step: int = 16):
         self.runner = runner
         self.tokenizer = tokenizer
         self.default_max_tokens = default_max_tokens
         self.pipeline_depth = max(1, pipeline_depth)
+        # tokens decoded per dispatch (lax.scan inside one program): amortizes
+        # the host→device dispatch RTT that dominates single-step decode on a
+        # tunneled chip. Delivery lag ≈ multi_step×pipeline_depth×step-time —
+        # keep the product small enough for <100ms streaming latency.
+        self.multi_step = max(1, multi_step)
         self._pending: "queue.Queue[GenHandle]" = queue.Queue()
         self._slots: dict[int, _SlotCtx] = {}
         self._ids = itertools.count()
@@ -235,21 +241,28 @@ class Scheduler:
     # -- engine thread ---------------------------------------------------
 
     def _run(self) -> None:
-        # Pipelined decode: keep up to pipeline_depth dispatches in flight,
-        # start each result's D2H copy immediately (copy_to_host_async), and
-        # process the oldest batch each iteration. The device never waits for
-        # the host round-trip (6-8x throughput on a remote-tunneled chip; see
-        # bench.py). Token delivery lags by depth×step-time (~30ms) — invisible
-        # in streaming. Constrained slots need the sampled token before the
-        # next dispatch (the FSM mask feeds step k+1), so any active
-        # constraint forces synchronous single-stepping.
+        # Pipelined multi-step decode: each dispatch advances all slots
+        # multi_step tokens inside ONE compiled program (lax.scan), up to
+        # pipeline_depth dispatches stay in flight, and each result's D2H
+        # copy starts immediately (copy_to_host_async). The device never
+        # waits for the host round-trip and the dispatch overhead is
+        # amortized over multi_step tokens (see bench.py). Grammar
+        # constraints need the sampled token on the host before the next
+        # dispatch (the FSM mask feeds the next step), so constrained slots
+        # run synchronously one token per dispatch — but via the frozen-slot
+        # program the UNconstrained slots still ride the same dispatch for
+        # multi_step tokens (one tool-call request no longer de-pipelines
+        # the whole batch).
         from collections import deque
 
         inflight: deque[tuple[Any, int]] = deque()
 
         def drain_one() -> None:
             toks, seq = inflight.popleft()
-            self._process_step(np.asarray(toks), seq)
+            rows = np.asarray(toks)
+            if rows.ndim == 1:
+                rows = rows[None]
+            self._process_rows(rows, seq)
 
         while not self._stopping:
             admitted = self._admit_pending()
@@ -261,21 +274,39 @@ class Scheduler:
                     self._wake.wait(timeout=0.05)
                     self._wake.clear()
                 continue
-            constrained = any(
-                c.handle.request.constraint is not None
-                for c in self._slots.values()
-            )
             try:
-                if constrained:
+                def constrained_slots() -> set[int]:
+                    return {
+                        s for s, c in self._slots.items()
+                        if c.handle.request.constraint is not None
+                    }
+
+                if constrained_slots():
+                    # sync mode: drain the pipeline so set_bias updates from
+                    # processed tokens apply to the very next dispatch
                     while inflight:
                         drain_one()
-                    if not self._slots:
+                    constrained = constrained_slots()
+                    if not self._slots or not constrained:
                         continue
                     self._dispatch_seq += 1
-                    self._process_step(self.runner.step(), self._dispatch_seq)
+                    if len(constrained) == len(self._slots) or self.multi_step == 1:
+                        self._process_rows(
+                            self.runner.step()[None], self._dispatch_seq
+                        )
+                    else:
+                        freeze = np.zeros(self.runner.num_slots, bool)
+                        freeze[list(constrained)] = True
+                        rows = self.runner.step_frozen_n(freeze, self.multi_step)
+                        self._process_rows(
+                            rows, self._dispatch_seq, frozen=constrained
+                        )
                 else:
                     self._dispatch_seq += 1
-                    tokens = self.runner.step_async()
+                    if self.multi_step > 1:
+                        tokens = self.runner.step_n_async(self.multi_step)
+                    else:
+                        tokens = self.runner.step_async()
                     try:
                         tokens.copy_to_host_async()
                     except AttributeError:
@@ -362,15 +393,26 @@ class Scheduler:
             return base
         return base + mask
 
-    def _process_step(self, tokens: np.ndarray, seq: int) -> None:
+    def _process_rows(
+        self, rows: np.ndarray, seq: int,
+        frozen: Optional[set[int]] = None,
+    ) -> None:
         # _slots is authoritative: the runner only deactivates slots when this
         # thread releases them, so no device round-trip for liveness. The seq
         # guard drops tokens from dispatches issued before a slot's admission
-        # (pipelined mode re-admits slots while a read is still in flight).
-        for slot, ctx in list(self._slots.items()):
-            if seq <= ctx.admit_seq:
-                continue
-            self._consume(slot, ctx, int(tokens[slot]))
+        # (pipelined mode re-admits slots while a read is still in flight);
+        # it works at dispatch granularity because admissions only happen
+        # between dispatches. Rows are consumed in temporal order, so a slot
+        # that finishes at row i (removed from _slots) ignores rows i+1..;
+        # ``frozen`` slots only advanced on the first step of the dispatch,
+        # so only row 0 is theirs.
+        for i in range(rows.shape[0]):
+            for slot, ctx in list(self._slots.items()):
+                if seq <= ctx.admit_seq:
+                    continue
+                if i > 0 and frozen is not None and slot in frozen:
+                    continue
+                self._consume(slot, ctx, int(rows[i, slot]))
 
     def _consume(self, slot: int, ctx: _SlotCtx, token_id: int) -> None:
         """Handle one sampled token for one slot: stream, stop, constrain."""

@@ -105,6 +105,8 @@ def build_serving_model(mcfg: ModelConfig, app: AppConfig) -> ServingModel:
         runner,
         model.tokenizer,
         default_max_tokens=mcfg.parameters.max_tokens or 2048,
+        multi_step=eng.decode_steps_per_dispatch,
+        pipeline_depth=eng.pipeline_depth,
     )
     log.info(
         "loaded model %s (%s) in %.1fs: slots=%d ctx=%d mesh=%s",
