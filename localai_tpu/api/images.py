@@ -1,0 +1,209 @@
+"""Image generation endpoint: POST /v1/images/generations + the
+/generated-images/ static file route.
+
+Parity: ImageEndpoint (/root/reference/core/http/endpoints/openai/
+image.go:67-242) — "positive|negative" prompt splitting, n copies per
+prompt, size "WxH", step/seed/cfg from the model's diffusers config with
+request overrides, img2img init from a base64 or URL `file`, and
+b64_json vs url response formats (url files land in image_path and are
+served at /generated-images/<name>). The compute path is the TPU-native
+latent-diffusion pipeline (localai_tpu.image) instead of the reference's
+diffusers/NCNN workers.
+"""
+
+from __future__ import annotations
+
+import base64
+import binascii
+import io
+import logging
+import threading
+import time
+import uuid
+from pathlib import Path
+
+import numpy as np
+from aiohttp import web
+
+from localai_tpu.api import openai as oai
+from localai_tpu.api import schema as sc
+from localai_tpu.config.model_config import Usecase
+
+log = logging.getLogger(__name__)
+
+_pipeline_lock = threading.Lock()
+
+
+def _state(request: web.Request):
+    from localai_tpu.api.server import STATE_KEY
+
+    return request.app[STATE_KEY]
+
+
+def _pipeline_for(state, name: str):
+    """name → loaded DiffusionPipeline, cached on AppState (the image
+    modality's analogue of ModelManager.get)."""
+    from localai_tpu.image import resolve_image_model
+
+    with _pipeline_lock:
+        cache = getattr(state, "_image_cache", None)
+        if cache is None:
+            cache = state._image_cache = {}
+        pipe = cache.get(name)
+        if pipe is not None:
+            return pipe
+        mcfg = state.loader.get(name)
+        ref = (mcfg.model if mcfg else name) or name
+        kwargs = {}
+        if mcfg is not None:
+            d = mcfg.diffusers
+            if d.scheduler_type:
+                kwargs["default_scheduler"] = d.scheduler_type
+            if d.steps:
+                kwargs["default_steps"] = d.steps
+            if d.cfg_scale is not None:
+                kwargs["default_cfg_scale"] = d.cfg_scale
+            if d.clip_skip:
+                kwargs["clip_skip"] = d.clip_skip
+        try:
+            pipe = resolve_image_model(
+                ref, model_path=state.config.model_path, **kwargs
+            )
+        except FileNotFoundError as e:
+            raise web.HTTPNotFound(text=str(e))
+        cache[name] = pipe
+        return pipe
+
+
+def _parse_size(size: str) -> tuple[int, int]:
+    if not size:
+        return 512, 512
+    parts = size.lower().split("x")
+    try:
+        w, h = int(parts[0]), int(parts[1])
+    except (ValueError, IndexError):
+        raise web.HTTPBadRequest(text="invalid value for 'size'")
+    if w <= 0 or h <= 0 or w > 2048 or h > 2048:
+        raise web.HTTPBadRequest(text="invalid value for 'size' (max 2048)")
+    return w, h
+
+
+async def _init_image(request: web.Request, file_ref: str):
+    """`file` → decoded RGB array. base64 data always works; http(s) URLs
+    are fetched over the network (parity: downloadFile, image.go:27-45)."""
+    from PIL import Image
+
+    if file_ref.startswith(("http://", "https://")):
+        # one-shot session per fetch: img2img URL inits are rare enough that
+        # connection reuse isn't worth a pooled session on AppState
+        import aiohttp
+
+        try:
+            async with aiohttp.ClientSession() as sess:
+                async with sess.get(file_ref) as resp:
+                    resp.raise_for_status()
+                    data = await resp.read()
+        except Exception as e:  # noqa: BLE001
+            raise web.HTTPBadRequest(text=f"failed downloading file: {e}")
+    else:
+        try:
+            data = base64.b64decode(file_ref, validate=True)
+        except (binascii.Error, ValueError):
+            raise web.HTTPBadRequest(text="file is neither a URL nor base64")
+    try:
+        img = Image.open(io.BytesIO(data)).convert("RGB")
+    except Exception as e:  # noqa: BLE001
+        raise web.HTTPBadRequest(text=f"cannot decode init image: {e}")
+    return np.asarray(img, np.uint8)
+
+
+def _encode_png(arr: np.ndarray) -> bytes:
+    from PIL import Image
+
+    buf = io.BytesIO()
+    Image.fromarray(arr).save(buf, format="PNG")
+    return buf.getvalue()
+
+
+async def generations(request: web.Request) -> web.Response:
+    state = _state(request)
+    req = await oai._read_request(request)
+    mcfg = state.loader.get(req.model)
+    if mcfg is None:
+        raise web.HTTPNotFound(
+            text=f"model {req.model!r} not found; available: "
+                 f"{state.loader.names()}"
+        )
+    if not mcfg.has_usecase(Usecase.IMAGE):
+        raise web.HTTPBadRequest(
+            text=f"model {req.model!r} does not support image generation"
+        )
+    width, height = _parse_size(req.size)
+    prompts = req.prompt if isinstance(req.prompt, list) else [req.prompt or ""]
+    n = req.n or mcfg.parameters.n or 1
+    b64 = (req.response_format or "") == "b64_json" or (
+        isinstance(req.response_format, dict)
+        and req.response_format.get("type") == "b64_json"
+    )
+    init = await _init_image(request, req.file) if req.file else None
+    steps = req.step or mcfg.diffusers.steps or 0
+    seed = req.seed if req.seed is not None else mcfg.parameters.seed
+
+    pipe = await oai._in_executor(request, _pipeline_for, state, req.model)
+
+    items = []
+    for prompt in prompts:
+        pos, _, neg = (prompt or "").partition("|")
+        for j in range(n):
+            # distinct images per copy: offset the seed like a new draw
+            s = None if seed is None else int(seed) + j
+            result = await oai._in_executor(
+                request,
+                lambda: pipe.generate(
+                    pos, negative_prompt=neg, width=width, height=height,
+                    steps=steps or None, seed=s, init_image=init,
+                ),
+            )
+            img = result.image
+            if img.shape[:2] != (height, width):
+                # the pipeline buckets latent sizes to 64-multiples; return
+                # exactly what the client asked for
+                from PIL import Image
+
+                img = np.asarray(
+                    Image.fromarray(img).resize((width, height)), np.uint8
+                )
+            png = _encode_png(img)
+            if b64:
+                items.append({"b64_json": base64.b64encode(png).decode()})
+            else:
+                name = f"{uuid.uuid4().hex}.png"
+                out = Path(state.config.image_path)
+                out.mkdir(parents=True, exist_ok=True)
+                (out / name).write_bytes(png)
+                base = f"{request.scheme}://{request.host}"
+                items.append({"url": f"{base}/generated-images/{name}"})
+
+    return web.json_response({
+        "id": uuid.uuid4().hex,
+        "created": int(time.time()),
+        "data": items,
+    })
+
+
+async def serve_generated(request: web.Request) -> web.Response:
+    """GET /generated-images/{name} — path-guarded static file serving."""
+    state = _state(request)
+    name = request.match_info["name"]
+    root = Path(state.config.image_path).resolve()
+    target = (root / name).resolve()
+    if root not in target.parents or not target.is_file():
+        raise web.HTTPNotFound(text="image not found")
+    return web.FileResponse(target)
+
+
+def routes() -> list[web.RouteDef]:
+    return [
+        web.post("/v1/images/generations", generations),
+        web.get("/generated-images/{name}", serve_generated),
+    ]

@@ -123,6 +123,14 @@ class OpenAIRequest(BaseModel):
     function_call: Optional[Union[str, dict[str, Any]]] = None
     grammar: Optional[str] = None
     response_format: Optional[Union[str, dict[str, Any]]] = None
+    # images (parity: schema/openai.go Size/File/Step fields consumed by
+    # ImageEndpoint, core/http/endpoints/openai/image.go:139-202)
+    size: str = ""
+    file: str = ""                     # img2img init: base64 or URL
+    mode: int = 0                      # accepted for reference compat only:
+                                       # txt2img vs img2img is keyed off
+                                       # `file` here, not this selector
+    step: int = 0
     # misc
     user: str = ""
     language: Optional[str] = None

@@ -189,6 +189,7 @@ def create_app(state: Optional[AppState] = None) -> web.Application:
     app[STATE_KEY] = state
     from localai_tpu.api import audio as audio_routes
     from localai_tpu.api import gallery as gallery_routes
+    from localai_tpu.api import images as image_routes
     from localai_tpu.api import jina as jina_routes
     from localai_tpu.api import stores as stores_routes
 
@@ -199,6 +200,7 @@ def create_app(state: Optional[AppState] = None) -> web.Application:
     app.add_routes(stores_routes.routes())
     app.add_routes(jina_routes.routes())
     app.add_routes(audio_routes.routes())
+    app.add_routes(image_routes.routes())
 
     async def on_cleanup(_app):
         state.shutdown()

@@ -1,0 +1,14 @@
+"""Image generation: JAX latent diffusion for TPU.
+
+The TPU-native replacement for the reference's image backends — the
+diffusers Python worker (/root/reference/backend/python/diffusers/
+backend.py:74-474) and the NCNN stable-diffusion Go backend
+(/root/reference/backend/go/image/stablediffusion/stablediffusion.go) —
+rebuilt as pure-functional JAX: an SD-class UNet with cross-attention,
+an AutoencoderKL VAE, a CLIP text encoder, and sigma-space samplers, all
+jitted with static shapes (one compiled step program per latent size).
+"""
+
+from localai_tpu.image.pipeline import DiffusionPipeline, resolve_image_model
+
+__all__ = ["DiffusionPipeline", "resolve_image_model"]

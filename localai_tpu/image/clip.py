@@ -1,0 +1,132 @@
+"""CLIP-class text encoder — the conditioning tower for latent diffusion.
+
+Capability parity: the text_encoder of the reference's diffusers pipelines
+(/root/reference/backend/python/diffusers/backend.py:171-176 CLIPModel
+handling). Pre-LN transformer with causal masking, learned position
+embeddings, quick-GELU activation (CLIP ViT-L/14 family), final LN.
+Supports clip_skip (use hidden states N layers before the end — parity:
+Diffusers CLIPSkip config, backend.proto diffusers options).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from localai_tpu.image.unet import layer_norm
+
+PyTree = Any
+
+
+@dataclasses.dataclass(frozen=True)
+class CLIPTextConfig:
+    vocab_size: int = 49408
+    hidden_size: int = 768
+    intermediate_size: int = 3072
+    num_layers: int = 12
+    num_heads: int = 12
+    max_length: int = 77
+    eos_token_id: int = 49407
+    activation: str = "quick_gelu"
+    dtype: str = "bfloat16"
+
+    @classmethod
+    def from_hf(cls, hf: dict) -> "CLIPTextConfig":
+        return cls(
+            vocab_size=hf.get("vocab_size", 49408),
+            hidden_size=hf.get("hidden_size", 768),
+            intermediate_size=hf.get("intermediate_size", 3072),
+            num_layers=hf.get("num_hidden_layers", 12),
+            num_heads=hf.get("num_attention_heads", 12),
+            max_length=hf.get("max_position_embeddings", 77),
+            eos_token_id=hf.get("eos_token_id", 49407),
+            activation=hf.get("hidden_act", "quick_gelu"),
+        )
+
+
+def _act(cfg: CLIPTextConfig, x):
+    if cfg.activation == "quick_gelu":
+        return x * jax.nn.sigmoid(1.702 * x)
+    return jax.nn.gelu(x)
+
+
+def _mha(x, p, num_heads: int, mask):
+    B, T, C = x.shape
+    hd = C // num_heads
+
+    def proj(w, b):
+        return (x @ p[w].astype(x.dtype) + p[b].astype(x.dtype)).reshape(
+            B, T, num_heads, hd
+        )
+
+    q, k, v = proj("wq", "bq"), proj("wk", "bk"), proj("wv", "bv")
+    scores = jnp.einsum("bnhd,bmhd->bhnm", q, k) / math.sqrt(hd)
+    scores = scores.astype(jnp.float32) + mask
+    probs = jax.nn.softmax(scores, -1).astype(x.dtype)
+    out = jnp.einsum("bhnm,bmhd->bnhd", probs, v).reshape(B, T, C)
+    return out @ p["wo"].astype(x.dtype) + p["bo"].astype(x.dtype)
+
+
+def forward(cfg: CLIPTextConfig, params: PyTree, tokens,
+            clip_skip: int = 0) -> jax.Array:
+    """tokens [B, T] i32 → hidden states [B, T, C] (the context fed to the
+    UNet cross-attention). clip_skip=N>0 returns the states N layers early
+    (diffusers convention: skip=1 is the default final-layer output)."""
+    dtype = jnp.dtype(cfg.dtype)
+    B, T = tokens.shape
+    x = params["token_emb"][tokens].astype(dtype)
+    x = x + params["pos_emb"][:T].astype(dtype)
+    causal = jnp.triu(jnp.full((T, T), -1e9, jnp.float32), 1)[None, None]
+    stop = max(0, clip_skip - 1)
+    layers = params["layers"]
+    for li, lp in enumerate(layers):
+        if li >= len(layers) - stop:
+            break
+        x = x + _mha(layer_norm(x, lp["ln1"]), lp["attn"], cfg.num_heads, causal)
+        h = layer_norm(x, lp["ln2"])
+        h = _act(cfg, h @ lp["mlp"]["w1"].astype(h.dtype) + lp["mlp"]["b1"].astype(h.dtype))
+        x = x + (h @ lp["mlp"]["w2"].astype(h.dtype) + lp["mlp"]["b2"].astype(h.dtype))
+    return layer_norm(x, params["ln_f"])
+
+
+def param_shapes(cfg: CLIPTextConfig) -> PyTree:
+    C, I = cfg.hidden_size, cfg.intermediate_size
+    layer = {
+        "ln1": {"g": (C,), "b": (C,)},
+        "attn": {"wq": (C, C), "bq": (C,), "wk": (C, C), "bk": (C,),
+                 "wv": (C, C), "bv": (C,), "wo": (C, C), "bo": (C,)},
+        "ln2": {"g": (C,), "b": (C,)},
+        "mlp": {"w1": (C, I), "b1": (I,), "w2": (I, C), "b2": (C,)},
+    }
+    return {
+        "token_emb": (cfg.vocab_size, C),
+        "pos_emb": (cfg.max_length, C),
+        "layers": [dict(layer) for _ in range(cfg.num_layers)],
+        "ln_f": {"g": (C,), "b": (C,)},
+    }
+
+
+def init_params(rng: jax.Array, cfg: CLIPTextConfig) -> PyTree:
+    shapes = param_shapes(cfg)
+    flat, treedef = jax.tree.flatten(shapes, is_leaf=lambda x: isinstance(x, tuple))
+    keys = jax.random.split(rng, len(flat))
+    dtype = jnp.dtype(cfg.dtype)
+
+    def mk(k, shape):
+        if len(shape) == 1:
+            return jnp.ones(shape, jnp.float32)
+        return (jax.random.normal(k, shape, jnp.float32) * 0.02).astype(dtype)
+
+    params = jax.tree.unflatten(treedef, [mk(k, s) for k, s in zip(keys, flat)])
+
+    def fix(path, leaf):
+        name = path[-1].key if hasattr(path[-1], "key") else ""
+        if name in ("bq", "bk", "bv", "bo", "b1", "b2", "b"):
+            return jnp.zeros_like(leaf)
+        return leaf
+
+    return jax.tree_util.tree_map_with_path(fix, params)

@@ -1,0 +1,354 @@
+"""Diffusers-layout checkpoint ingestion → JAX pytrees.
+
+The image-model analogue of localai_tpu.models.loader: reads a local
+diffusers directory (model_index.json + unet/ vae/ text_encoder/ tokenizer/
+with safetensors weights — the layout `StableDiffusionPipeline.from_pretrained`
+consumes in the reference, /root/reference/backend/python/diffusers/
+backend.py:208-219) and maps the torch state dicts onto the functional
+param trees of localai_tpu.image.{unet,vae,clip}. Torch conv kernels are
+OIHW → transposed to HWIO (TPU-native); linear weights [out,in] → [in,out].
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+from pathlib import Path
+from typing import Any
+
+import numpy as np
+
+log = logging.getLogger(__name__)
+
+
+def _open_dir(d: Path) -> dict[str, Any]:
+    from safetensors import safe_open
+
+    tensors: dict[str, Any] = {}
+    files = sorted(d.glob("*.safetensors"))
+    if not files:
+        raise FileNotFoundError(f"no .safetensors in {d}")
+    for fp in files:
+        h = safe_open(str(fp), framework="numpy")
+        for name in h.keys():
+            tensors[name] = (h, name)
+    return tensors
+
+
+def _np(tensors, key: str) -> np.ndarray:
+    h, k = tensors[key]
+    arr = h.get_tensor(k)
+    if arr.dtype == np.uint16:  # bf16 written as raw views by some writers
+        import ml_dtypes
+
+        arr = arr.view(ml_dtypes.bfloat16)
+    return np.asarray(arr, np.float32)
+
+
+def _conv(tensors, prefix: str) -> dict:
+    w = _np(tensors, f"{prefix}.weight")
+    return {"w": w.transpose(2, 3, 1, 0), "b": _np(tensors, f"{prefix}.bias")}
+
+
+def _lin(tensors, prefix: str, *, bias: bool = True) -> tuple:
+    w = _np(tensors, f"{prefix}.weight")
+    if w.ndim == 4:  # 1x1 conv posing as a linear (older VAE attn blocks)
+        w = w[:, :, 0, 0]
+    out = w.T
+    return (out, _np(tensors, f"{prefix}.bias")) if bias else (out,)
+
+
+def _norm(tensors, prefix: str) -> dict:
+    return {"g": _np(tensors, f"{prefix}.weight"),
+            "b": _np(tensors, f"{prefix}.bias")}
+
+
+def _proj_1x1(tensors, prefix: str) -> dict:
+    """proj_in/proj_out: 1×1 conv in SD1.x, plain linear in SD2.x — load
+    either into the 1×1-conv param shape."""
+    w = _np(tensors, f"{prefix}.weight")
+    if w.ndim == 2:  # linear [out,in] → [1,1,in,out]
+        w = w.T[None, None]
+    else:
+        w = w.transpose(2, 3, 1, 0)
+    return {"w": w, "b": _np(tensors, f"{prefix}.bias")}
+
+
+# ---------------------------------------------------------------------------
+# UNet
+# ---------------------------------------------------------------------------
+
+def _res_params(t, prefix: str, *, temb: bool = True) -> dict:
+    p = {
+        "norm1": _norm(t, f"{prefix}.norm1"),
+        "conv1": _conv(t, f"{prefix}.conv1"),
+        "norm2": _norm(t, f"{prefix}.norm2"),
+        "conv2": _conv(t, f"{prefix}.conv2"),
+    }
+    if temb:
+        w, b = _lin(t, f"{prefix}.time_emb_proj")
+        p["temb"] = {"w": w, "b": b}
+    if f"{prefix}.conv_shortcut.weight" in t:
+        p["skip"] = _conv(t, f"{prefix}.conv_shortcut")
+    return p
+
+
+def _xattn_params(t, prefix: str) -> dict:
+    (wq,) = _lin(t, f"{prefix}.to_q", bias=False)
+    (wk,) = _lin(t, f"{prefix}.to_k", bias=False)
+    (wv,) = _lin(t, f"{prefix}.to_v", bias=False)
+    wo, bo = _lin(t, f"{prefix}.to_out.0")
+    return {"wq": wq, "wk": wk, "wv": wv, "wo": wo, "bo": bo}
+
+
+def _st_params(t, prefix: str) -> dict:
+    blocks = []
+    i = 0
+    while f"{prefix}.transformer_blocks.{i}.norm1.weight" in t:
+        bp = f"{prefix}.transformer_blocks.{i}"
+        w1, b1 = _lin(t, f"{bp}.ff.net.0.proj")
+        w2, b2 = _lin(t, f"{bp}.ff.net.2")
+        blocks.append({
+            "ln1": _norm(t, f"{bp}.norm1"),
+            "attn1": _xattn_params(t, f"{bp}.attn1"),
+            "ln2": _norm(t, f"{bp}.norm2"),
+            "attn2": _xattn_params(t, f"{bp}.attn2"),
+            "ln3": _norm(t, f"{bp}.norm3"),
+            "ff": {"w1": w1, "b1": b1, "w2": w2, "b2": b2},
+        })
+        i += 1
+    return {
+        "norm": _norm(t, f"{prefix}.norm"),
+        "proj_in": _proj_1x1(t, f"{prefix}.proj_in"),
+        "blocks": blocks,
+        "proj_out": _proj_1x1(t, f"{prefix}.proj_out"),
+    }
+
+
+def load_unet(d: Path):
+    from localai_tpu.image.unet import UNetConfig
+
+    with open(d / "config.json") as f:
+        cfg = UNetConfig.from_hf(json.load(f))
+    t = _open_dir(d)
+    w1, b1 = _lin(t, "time_embedding.linear_1")
+    w2, b2 = _lin(t, "time_embedding.linear_2")
+    params: dict[str, Any] = {
+        "conv_in": _conv(t, "conv_in"),
+        "time_emb": {"w1": w1, "b1": b1, "w2": w2, "b2": b2},
+        "norm_out": _norm(t, "conv_norm_out"),
+        "conv_out": _conv(t, "conv_out"),
+    }
+    down = []
+    for lvl in range(len(cfg.channel_mult)):
+        base = f"down_blocks.{lvl}"
+        has_attn = f"{base}.attentions.0.norm.weight" in t
+        lp: dict[str, Any] = {
+            "res": [_res_params(t, f"{base}.resnets.{j}")
+                    for j in range(cfg.num_res_blocks)],
+            "attn": [_st_params(t, f"{base}.attentions.{j}")
+                     for j in range(cfg.num_res_blocks)] if has_attn else None,
+        }
+        if f"{base}.downsamplers.0.conv.weight" in t:
+            lp["down"] = _conv(t, f"{base}.downsamplers.0.conv")
+        down.append(lp)
+    params["down"] = down
+    params["mid"] = {
+        "res1": _res_params(t, "mid_block.resnets.0"),
+        "attn": _st_params(t, "mid_block.attentions.0"),
+        "res2": _res_params(t, "mid_block.resnets.1"),
+    }
+    up = []
+    for i in range(len(cfg.channel_mult)):
+        base = f"up_blocks.{i}"
+        has_attn = f"{base}.attentions.0.norm.weight" in t
+        lp = {
+            "res": [_res_params(t, f"{base}.resnets.{j}")
+                    for j in range(cfg.num_res_blocks + 1)],
+            "attn": [_st_params(t, f"{base}.attentions.{j}")
+                     for j in range(cfg.num_res_blocks + 1)] if has_attn else None,
+        }
+        if f"{base}.upsamplers.0.conv.weight" in t:
+            lp["up"] = _conv(t, f"{base}.upsamplers.0.conv")
+        up.append(lp)
+    params["up"] = up
+    return cfg, params
+
+
+# ---------------------------------------------------------------------------
+# VAE
+# ---------------------------------------------------------------------------
+
+def _vae_res(t, prefix: str) -> dict:
+    return _res_params(t, prefix, temb=False)
+
+
+def _vae_attn(t, prefix: str) -> dict:
+    # newer diffusers: group_norm + to_q/to_k/to_v/to_out.0 linears;
+    # older: norm + q/k/v/proj_out 1x1 convs
+    if f"{prefix}.group_norm.weight" in t:
+        names = ("group_norm", "to_q", "to_k", "to_v", "to_out.0")
+    else:
+        names = ("norm", "q", "k", "v", "proj_out")
+    norm, q, k, v, o = names
+    wq, bq = _lin(t, f"{prefix}.{q}")
+    wk, bk = _lin(t, f"{prefix}.{k}")
+    wv, bv = _lin(t, f"{prefix}.{v}")
+    wo, bo = _lin(t, f"{prefix}.{o}")
+    return {"norm": _norm(t, f"{prefix}.{norm}"),
+            "wq": wq, "bq": bq, "wk": wk, "bk": bk,
+            "wv": wv, "bv": bv, "wo": wo, "bo": bo}
+
+
+def _vae_mid(t, prefix: str) -> dict:
+    return {
+        "res1": _vae_res(t, f"{prefix}.resnets.0"),
+        "attn": _vae_attn(t, f"{prefix}.attentions.0"),
+        "res2": _vae_res(t, f"{prefix}.resnets.1"),
+    }
+
+
+def load_vae(d: Path):
+    from localai_tpu.image.vae import VAEConfig
+
+    with open(d / "config.json") as f:
+        cfg = VAEConfig.from_hf(json.load(f))
+    t = _open_dir(d)
+    levels = len(cfg.channel_mult)
+    enc_down = []
+    for lvl in range(levels):
+        base = f"encoder.down_blocks.{lvl}"
+        lp: dict[str, Any] = {
+            "res": [_vae_res(t, f"{base}.resnets.{j}")
+                    for j in range(cfg.num_res_blocks)],
+        }
+        if f"{base}.downsamplers.0.conv.weight" in t:
+            lp["down"] = _conv(t, f"{base}.downsamplers.0.conv")
+        enc_down.append(lp)
+    dec_up = []
+    for i in range(levels):
+        base = f"decoder.up_blocks.{i}"
+        lp = {
+            "res": [_vae_res(t, f"{base}.resnets.{j}")
+                    for j in range(cfg.num_res_blocks + 1)],
+        }
+        if f"{base}.upsamplers.0.conv.weight" in t:
+            lp["up"] = _conv(t, f"{base}.upsamplers.0.conv")
+        dec_up.append(lp)
+    params = {
+        "encoder": {
+            "conv_in": _conv(t, "encoder.conv_in"),
+            "down": enc_down,
+            "mid": _vae_mid(t, "encoder.mid_block"),
+            "norm_out": _norm(t, "encoder.conv_norm_out"),
+            "conv_out": _conv(t, "encoder.conv_out"),
+        },
+        "quant_conv": _conv(t, "quant_conv"),
+        "post_quant_conv": _conv(t, "post_quant_conv"),
+        "decoder": {
+            "conv_in": _conv(t, "decoder.conv_in"),
+            "mid": _vae_mid(t, "decoder.mid_block"),
+            "up": dec_up,
+            "norm_out": _norm(t, "decoder.conv_norm_out"),
+            "conv_out": _conv(t, "decoder.conv_out"),
+        },
+    }
+    return cfg, params
+
+
+# ---------------------------------------------------------------------------
+# CLIP text encoder
+# ---------------------------------------------------------------------------
+
+def load_text_encoder(d: Path):
+    from localai_tpu.image.clip import CLIPTextConfig
+
+    with open(d / "config.json") as f:
+        cfg = CLIPTextConfig.from_hf(json.load(f))
+    t = _open_dir(d)
+    pre = "text_model."
+    layers = []
+    for i in range(cfg.num_layers):
+        base = f"{pre}encoder.layers.{i}"
+        attn = {}
+        for ours, theirs in (("q", "q_proj"), ("k", "k_proj"),
+                             ("v", "v_proj"), ("o", "out_proj")):
+            w, b = _lin(t, f"{base}.self_attn.{theirs}")
+            attn[f"w{ours}"] = w
+            attn[f"b{ours}"] = b
+        w1, b1 = _lin(t, f"{base}.mlp.fc1")
+        w2, b2 = _lin(t, f"{base}.mlp.fc2")
+        layers.append({
+            "ln1": _norm(t, f"{base}.layer_norm1"),
+            "attn": attn,
+            "ln2": _norm(t, f"{base}.layer_norm2"),
+            "mlp": {"w1": w1, "b1": b1, "w2": w2, "b2": b2},
+        })
+    params = {
+        "token_emb": _np(t, f"{pre}embeddings.token_embedding.weight"),
+        "pos_emb": _np(t, f"{pre}embeddings.position_embedding.weight"),
+        "layers": layers,
+        "ln_f": _norm(t, f"{pre}final_layer_norm"),
+    }
+    return cfg, params
+
+
+def load_diffusers_pipeline(d: Path, **defaults):
+    """Directory with unet/ vae/ text_encoder/ tokenizer/ → DiffusionPipeline."""
+    from localai_tpu.image.pipeline import DiffusionPipeline
+
+    d = Path(d)
+    unet_cfg, unet_params = load_unet(d / "unet")
+    vae_cfg, vae_params = load_vae(d / "vae")
+    text_cfg, text_params = load_text_encoder(d / "text_encoder")
+    tokenizer = _load_clip_tokenizer(d / "tokenizer", text_cfg)
+    log.info("loaded diffusers pipeline from %s (unet %dch, ctx %d)",
+             d, unet_cfg.model_channels, unet_cfg.context_dim)
+    return DiffusionPipeline(
+        unet_cfg, _to_device(unet_params, unet_cfg.dtype),
+        vae_cfg, _to_device(vae_params, vae_cfg.dtype),
+        text_cfg, _to_device(text_params, text_cfg.dtype),
+        tokenizer, ref=str(d), **defaults,
+    )
+
+
+def _to_device(params, dtype: str):
+    import jax.numpy as jnp
+
+    dt = jnp.dtype(dtype)
+
+    def conv(a):
+        return jnp.asarray(a, dt if a.ndim > 1 else jnp.float32)
+
+    import jax
+
+    return jax.tree.map(conv, params)
+
+
+def _load_clip_tokenizer(d: Path, text_cfg):
+    """CLIP BPE tokenizer from a diffusers tokenizer/ dir, wrapped in the
+    repo's Tokenizer protocol; byte fallback keeps debug flows alive."""
+    try:
+        from transformers import CLIPTokenizer, CLIPTokenizerFast
+
+        try:
+            tok = CLIPTokenizerFast.from_pretrained(str(d))
+        except Exception:  # noqa: BLE001
+            tok = CLIPTokenizer.from_pretrained(str(d))
+
+        class _Wrap:
+            vocab_size = tok.vocab_size
+            eos_ids = {tok.eos_token_id}
+
+            def encode(self, text: str, add_bos: bool = False):
+                return tok(text).input_ids
+
+            def decode(self, ids):
+                return tok.decode(ids)
+
+        return _Wrap()
+    except Exception as e:  # noqa: BLE001
+        log.warning("CLIP tokenizer load failed (%s); using byte tokenizer", e)
+        from localai_tpu.utils.tokenizer import ByteTokenizer
+
+        return ByteTokenizer()

@@ -1,0 +1,245 @@
+"""Latent-diffusion pipeline: text→image and image→image on TPU.
+
+Capability parity: the reference's diffusers worker pipelines
+(/root/reference/backend/python/diffusers/backend.py:184-474 — txt2img,
+img2img, schedulers, cfg_scale, clip_skip, negative prompts, seeds) and
+the NCNN fallback (/root/reference/backend/go/image/stablediffusion).
+
+TPU design: ONE jitted step program per latent size — the UNet runs
+cond+uncond in a single batch-2 call (classifier-free guidance without two
+dispatches), the Python loop over steps stays on host (step count is
+dynamic per request; the per-step dispatch is negligible next to the UNet).
+Latent sizes are bucketed by rounding requested W/H up to multiples of 64,
+bounding XLA recompiles the way prefill buckets do for the LLM engine.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+from pathlib import Path
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from localai_tpu.image import clip as clip_mod
+from localai_tpu.image import schedulers as sch
+from localai_tpu.image import unet as unet_mod
+from localai_tpu.image import vae as vae_mod
+
+log = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class GenerationResult:
+    image: np.ndarray          # [H, W, 3] uint8
+    seed: int
+
+
+class DiffusionPipeline:
+    """One loaded diffusion model (UNet + VAE + text encoder + tokenizer)."""
+
+    def __init__(self, unet_cfg, unet_params, vae_cfg, vae_params,
+                 text_cfg, text_params, tokenizer, *,
+                 default_scheduler: str = "euler",
+                 default_steps: int = 15, default_cfg_scale: float = 7.0,
+                 clip_skip: int = 0, ref: str = ""):
+        self.unet_cfg = unet_cfg
+        self.unet_params = unet_params
+        self.vae_cfg = vae_cfg
+        self.vae_params = vae_params
+        self.text_cfg = text_cfg
+        self.text_params = text_params
+        self.tokenizer = tokenizer
+        self.default_scheduler = default_scheduler
+        self.default_steps = default_steps
+        self.default_cfg_scale = default_cfg_scale
+        self.clip_skip = clip_skip
+        self.ref = ref
+        self._encode_text = jax.jit(self._encode_text_fn)
+        self._unet_step = jax.jit(self._unet_step_fn)
+        self._decode = jax.jit(self._decode_fn)
+        self._encode_img = jax.jit(self._encode_img_fn)
+
+    # -- jitted programs -------------------------------------------------
+
+    def _encode_text_fn(self, tokens):
+        return clip_mod.forward(
+            self.text_cfg, self.text_params, tokens, clip_skip=self.clip_skip
+        )
+
+    def _unet_step_fn(self, x, sigma, t, context, cfg_scale):
+        """Batched CFG: one UNet dispatch over [uncond; cond]."""
+        xin = sch.scale_model_input(x, sigma)
+        both = jnp.concatenate([xin, xin], axis=0)
+        ts = jnp.full((both.shape[0],), t, jnp.float32)
+        eps = unet_mod.forward(self.unet_cfg, self.unet_params, both, ts, context)
+        eps_u, eps_c = jnp.split(eps, 2, axis=0)
+        eps = eps_u + cfg_scale * (eps_c - eps_u)
+        return sch.denoised_from_eps(x, eps, sigma)
+
+    def _decode_fn(self, latents):
+        img = vae_mod.decode(
+            self.vae_cfg, self.vae_params,
+            latents / self.vae_cfg.scaling_factor,
+        )
+        return jnp.clip((img + 1.0) * 127.5, 0, 255).astype(jnp.uint8)
+
+    def _encode_img_fn(self, img):
+        return vae_mod.encode(self.vae_cfg, self.vae_params, img)
+
+    # -- host API --------------------------------------------------------
+
+    def _tokenize(self, text: str) -> np.ndarray:
+        T = self.text_cfg.max_length
+        eos = self.text_cfg.eos_token_id
+        ids = list(self.tokenizer.encode(text))[: T - 1]
+        row = np.full((1, T), eos, np.int32)
+        row[0, : len(ids)] = ids
+        return row
+
+    def _context(self, prompt: str, negative: str) -> jax.Array:
+        toks = np.concatenate(
+            [self._tokenize(negative or ""), self._tokenize(prompt)], axis=0
+        )
+        return self._encode_text(jnp.asarray(toks))
+
+    @staticmethod
+    def _bucket(v: int, lo: int = 64, quantum: int = 64, hi: int = 2048) -> int:
+        v = max(lo, min(v, hi))
+        return ((v + quantum - 1) // quantum) * quantum
+
+    def generate(
+        self,
+        prompt: str,
+        *,
+        negative_prompt: str = "",
+        width: int = 512,
+        height: int = 512,
+        steps: Optional[int] = None,
+        cfg_scale: Optional[float] = None,
+        seed: Optional[int] = None,
+        scheduler: Optional[str] = None,
+        init_image: Optional[np.ndarray] = None,   # [H,W,3] uint8 (img2img)
+        strength: float = 0.75,
+    ) -> GenerationResult:
+        rule, karras = sch.resolve(scheduler or self.default_scheduler)
+        steps = int(steps or self.default_steps)
+        guidance = float(
+            self.default_cfg_scale if cfg_scale is None else cfg_scale
+        )
+        if seed is None or seed < 0:
+            seed = int(np.random.randint(0, 2 ** 31 - 1))
+        rng = jax.random.key(seed)
+        ds = self.vae_cfg.downscale
+        width, height = self._bucket(width), self._bucket(height)
+        lw, lh = width // ds, height // ds
+        L = self.vae_cfg.latent_channels
+
+        context = self._context(prompt, negative_prompt)
+        sigmas, timesteps = sch.build_sigmas(steps, karras=karras)
+
+        rng, nkey = jax.random.split(rng)
+        noise = jax.random.normal(nkey, (1, lh, lw, L), jnp.float32)
+        start = 0
+        if init_image is not None:
+            # img2img: start the trajectory at sigma[start] around the
+            # encoded init latents (strength 1.0 = full re-noise)
+            start = min(steps - 1, int(steps * (1.0 - strength)))
+            img = jnp.asarray(init_image, jnp.float32) / 127.5 - 1.0
+            img = jax.image.resize(img[None], (1, height, width, 3), "linear")
+            x = self._encode_img(img) + noise * sigmas[start]
+        else:
+            x = noise * sigmas[0]
+
+        prev_denoised = None
+        prev_sigma = None
+        for i in range(start, steps):
+            sigma, sigma_next = float(sigmas[i]), float(sigmas[i + 1])
+            denoised = self._unet_step(
+                x, jnp.float32(sigma), jnp.float32(timesteps[i]), context,
+                jnp.float32(guidance),
+            )
+            noise_i = None
+            if rule in sch.ANCESTRAL_RULES:
+                rng, k = jax.random.split(rng)
+                noise_i = jax.random.normal(k, x.shape, jnp.float32)
+            x = sch.step(
+                rule, x, denoised, jnp.float32(sigma), jnp.float32(sigma_next),
+                prev_denoised=prev_denoised,
+                prev_sigma=None if prev_sigma is None else jnp.float32(prev_sigma),
+                noise=noise_i,
+            )
+            prev_denoised, prev_sigma = denoised, sigma
+
+        img = np.asarray(self._decode(x))[0]
+        return GenerationResult(image=img, seed=seed)
+
+
+# ---------------------------------------------------------------------------
+# resolution: ref → pipeline
+# ---------------------------------------------------------------------------
+
+_DEBUG_PRESETS = {
+    # tiny: 64x64 output, runs in seconds on CPU — the test/debug preset
+    # (the analogue of the LLM debug:* presets; zero-egress environment)
+    "sd-tiny": dict(
+        unet=unet_mod.UNetConfig(
+            model_channels=32, channel_mult=(1, 2), num_res_blocks=1,
+            attn_levels=(0, 1), num_heads=4, context_dim=64,
+        ),
+        vae=vae_mod.VAEConfig(
+            base_channels=32, channel_mult=(1, 2), num_res_blocks=1,
+        ),
+        text=clip_mod.CLIPTextConfig(
+            vocab_size=258, hidden_size=64, intermediate_size=128,
+            num_layers=2, num_heads=4, max_length=16, eos_token_id=257,
+        ),
+    ),
+}
+
+
+def _debug_pipeline(name: str, seed: int = 0, **defaults) -> DiffusionPipeline:
+    from localai_tpu.utils.tokenizer import ByteTokenizer
+
+    preset = _DEBUG_PRESETS[name]
+    k1, k2, k3 = jax.random.split(jax.random.key(seed), 3)
+    return DiffusionPipeline(
+        preset["unet"], unet_mod.init_params(k1, preset["unet"]),
+        preset["vae"], vae_mod.init_params(k2, preset["vae"]),
+        preset["text"], clip_mod.init_params(k3, preset["text"]),
+        ByteTokenizer(), ref=f"debug:{name}", **defaults,
+    )
+
+
+def resolve_image_model(
+    ref: str,
+    model_path: str | Path = "models",
+    **defaults,
+) -> DiffusionPipeline:
+    """ref → loaded DiffusionPipeline.
+
+    * ``debug:sd-tiny`` — random-weight preset (tests/benchmarks)
+    * a diffusers-layout dir (model_index.json + unet/ vae/ text_encoder/
+      tokenizer/) — SD-class safetensors checkpoint
+    """
+    if ref.startswith("debug:"):
+        name = ref.split(":", 1)[1]
+        if name not in _DEBUG_PRESETS:
+            raise ValueError(
+                f"unknown debug image preset {name!r}; have "
+                f"{sorted(_DEBUG_PRESETS)}"
+            )
+        return _debug_pipeline(name, **defaults)
+    for cand in (Path(ref), Path(model_path) / ref):
+        if (cand / "model_index.json").exists() or (cand / "unet").is_dir():
+            from localai_tpu.image.loader import load_diffusers_pipeline
+
+            return load_diffusers_pipeline(cand, **defaults)
+    raise FileNotFoundError(
+        f"image model ref {ref!r} not found (looked for a diffusers layout "
+        f"under {ref} and {Path(model_path) / ref})"
+    )

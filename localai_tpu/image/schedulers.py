@@ -1,0 +1,167 @@
+"""Diffusion samplers in sigma space — the TPU reworking of the reference's
+scheduler zoo (/root/reference/backend/python/diffusers/backend.py:74-143,
+DiffusionScheduler enum + get_scheduler).
+
+Supported names (aliases map onto four step rules + the Karras sigma
+option, the way A1111/k-diffusion names map onto diffusers classes):
+
+  ddim, euler, euler_a, dpmpp_2m, and k_* variants (Karras sigma schedule:
+  k_euler, k_dpmpp_2m, ...); lms/heun/pndm/unipc/dpm_2* accept and map to
+  the nearest supported rule so reference YAMLs keep working.
+
+Design: schedules are tiny host-side numpy; the per-step update is pure
+jnp executed inside the pipeline's jitted step program. All rules share the
+epsilon-prediction convention x = x0 + sigma * eps with model input scaled
+by 1/sqrt(1+sigma^2) (k-diffusion parameterization).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# alias → (rule, karras)
+_ALIASES = {
+    "ddim": ("ddim", False),
+    "pndm": ("ddim", False),
+    "unipc": ("dpmpp_2m", False),
+    "euler": ("euler", False),
+    "euler_a": ("euler_a", False),
+    "heun": ("euler", False),
+    "lms": ("euler", False),
+    "k_lms": ("euler", True),
+    "dpm_2": ("euler", False),
+    "k_dpm_2": ("euler", True),
+    "dpm_2_a": ("euler_a", False),
+    "k_dpm_2_a": ("euler_a", True),
+    "dpmpp_2m": ("dpmpp_2m", False),
+    "k_dpmpp_2m": ("dpmpp_2m", True),
+    "dpmpp_sde": ("euler_a", False),
+    "k_dpmpp_sde": ("euler_a", True),
+    "dpmpp_2m_sde": ("dpmpp_2m", False),
+    "k_dpmpp_2m_sde": ("dpmpp_2m", True),
+    "k_euler": ("euler", True),
+    "k_euler_a": ("euler_a", True),
+}
+
+ANCESTRAL_RULES = ("euler_a",)
+
+
+def resolve(name: Optional[str]) -> tuple[str, bool]:
+    """Scheduler name → (step rule, use_karras_sigmas)."""
+    if not name:
+        return "euler", False
+    key = name.strip().lower()
+    if key in _ALIASES:
+        return _ALIASES[key]
+    if key.startswith("k_") and key[2:] in _ALIASES:
+        return _ALIASES[key[2:]][0], True
+    raise ValueError(f"unknown scheduler {name!r}; have {sorted(_ALIASES)}")
+
+
+@dataclasses.dataclass(frozen=True)
+class NoiseSchedule:
+    """The training noise schedule (SD default: scaled_linear betas)."""
+
+    num_train_timesteps: int = 1000
+    beta_start: float = 0.00085
+    beta_end: float = 0.012
+
+    def alphas_cumprod(self) -> np.ndarray:
+        betas = np.linspace(
+            self.beta_start ** 0.5, self.beta_end ** 0.5,
+            self.num_train_timesteps, dtype=np.float64,
+        ) ** 2
+        return np.cumprod(1.0 - betas)
+
+    def all_sigmas(self) -> np.ndarray:
+        ac = self.alphas_cumprod()
+        return np.sqrt((1 - ac) / ac)
+
+
+def build_sigmas(
+    steps: int,
+    schedule: NoiseSchedule = NoiseSchedule(),
+    karras: bool = False,
+    rho: float = 7.0,
+) -> tuple[np.ndarray, np.ndarray]:
+    """Returns (sigmas [steps+1] desc ending at 0, timesteps [steps] f32) —
+    the timestep for each sigma interpolated into the training schedule
+    (what the UNet's time embedding expects)."""
+    all_sig = schedule.all_sigmas()
+    if karras:
+        smin, smax = all_sig[0], all_sig[-1]
+        ramp = np.linspace(0, 1, steps)
+        sigmas = (smax ** (1 / rho)
+                  + ramp * (smin ** (1 / rho) - smax ** (1 / rho))) ** rho
+    else:
+        idx = np.linspace(len(all_sig) - 1, 0, steps)
+        sigmas = np.interp(idx, np.arange(len(all_sig)), all_sig)
+    # sigma → (fractional) training timestep, via log-sigma interpolation
+    log_all = np.log(all_sig)
+    timesteps = np.interp(np.log(sigmas), log_all, np.arange(len(all_sig)))
+    sigmas = np.append(sigmas, 0.0).astype(np.float32)
+    return sigmas, timesteps.astype(np.float32)
+
+
+def scale_model_input(x: jax.Array, sigma) -> jax.Array:
+    return x / jnp.sqrt(sigma ** 2 + 1.0)
+
+
+def denoised_from_eps(x: jax.Array, eps: jax.Array, sigma) -> jax.Array:
+    return x - sigma * eps
+
+
+def step(
+    rule: str,
+    x: jax.Array,            # current sample (x0 + sigma*eps convention)
+    denoised: jax.Array,     # model's x0 estimate at sigma
+    sigma,                   # current sigma (scalar)
+    sigma_next,              # next sigma (scalar; 0 at the last step)
+    prev_denoised: Optional[jax.Array] = None,   # for multistep rules
+    prev_sigma=None,
+    noise: Optional[jax.Array] = None,           # for ancestral rules
+) -> jax.Array:
+    """One sampler update x(sigma) → x(sigma_next). Shapes are static; this
+    runs inside the pipeline's jitted step program."""
+    if rule == "euler":
+        d = (x - denoised) / sigma
+        return x + d * (sigma_next - sigma)
+    if rule == "ddim":
+        # deterministic DDIM expressed in sigma space:
+        # x' = x0 + (sigma_next/sigma) * (x - x0)
+        return denoised + (x - denoised) * (sigma_next / sigma)
+    if rule == "euler_a":
+        # ancestral split of the step into a down-step + fresh noise
+        var_next = sigma_next ** 2
+        up2 = var_next * (sigma ** 2 - var_next) / jnp.maximum(sigma ** 2, 1e-12)
+        sigma_up = jnp.sqrt(jnp.maximum(up2, 0.0))
+        sigma_down = jnp.sqrt(jnp.maximum(var_next - up2, 0.0))
+        d = (x - denoised) / sigma
+        x = x + d * (sigma_down - sigma)
+        if noise is not None:
+            x = x + noise * sigma_up
+        return x
+    if rule == "dpmpp_2m":
+        # DPM-Solver++ (2M) deterministic multistep (k-diffusion form);
+        # sigma_next=0 degenerates to ratio→0, -(exp(-h)-1)→1, d=denoised,
+        # i.e. x' = denoised, matching the reference sampler's last step.
+        def lam(s):
+            return -jnp.log(jnp.maximum(s, 1e-10))
+
+        l_cur, l_next = lam(sigma), lam(sigma_next)
+        h = l_next - l_cur
+        if prev_denoised is None:
+            d = denoised
+        else:
+            h_last = l_cur - lam(prev_sigma)
+            r = h_last / h
+            d = (1 + 1 / (2 * r)) * denoised - (1 / (2 * r)) * prev_denoised
+            d = jnp.where(sigma_next > 0, d, denoised)
+        ratio = sigma_next / jnp.maximum(sigma, 1e-10)
+        return ratio * x - (jnp.exp(-h) - 1.0) * d
+    raise ValueError(f"unknown step rule {rule!r}")

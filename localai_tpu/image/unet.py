@@ -1,0 +1,346 @@
+"""SD-class conditional UNet — pure functional JAX, NHWC (TPU-native layout).
+
+Capability parity target: the denoising network behind the reference's
+diffusers pipelines (/root/reference/backend/python/diffusers/backend.py:
+184-260, StableDiffusionPipeline class). Architecture follows the SD-1.x
+UNet2DConditionModel family (configurable dims so tiny debug presets and
+real checkpoints share one code path): ResBlocks with timestep embedding,
+spatial transformers with self+cross attention over the text context, skip
+connections, stride-2 conv down / nearest-up. Convs run in NHWC with HWIO
+kernels (XLA's native TPU conv layout); norms and softmax in float32.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+PyTree = Any
+
+
+@dataclasses.dataclass(frozen=True)
+class UNetConfig:
+    in_channels: int = 4
+    out_channels: int = 4
+    model_channels: int = 320
+    channel_mult: tuple[int, ...] = (1, 2, 4, 4)
+    num_res_blocks: int = 2
+    attn_levels: tuple[int, ...] = (0, 1, 2)   # levels with spatial transformers
+    transformer_depth: int = 1
+    num_heads: int = 8
+    context_dim: int = 768                     # CLIP hidden size
+    dtype: str = "bfloat16"
+
+    @classmethod
+    def from_hf(cls, hf: dict) -> "UNetConfig":
+        """Build from a diffusers unet/config.json dict."""
+        block_out = hf.get("block_out_channels", [320, 640, 1280, 1280])
+        mc = block_out[0]
+        down_types = hf.get("down_block_types", [])
+        attn_levels = tuple(
+            i for i, t in enumerate(down_types) if "CrossAttn" in t
+        ) or tuple(range(len(block_out) - 1))
+        heads = hf.get("attention_head_dim", 8)
+        if isinstance(heads, (list, tuple)):
+            heads = heads[0]
+        return cls(
+            in_channels=hf.get("in_channels", 4),
+            out_channels=hf.get("out_channels", 4),
+            model_channels=mc,
+            channel_mult=tuple(c // mc for c in block_out),
+            num_res_blocks=hf.get("layers_per_block", 2),
+            attn_levels=attn_levels,
+            transformer_depth=hf.get("transformer_layers_per_block", 1)
+            if isinstance(hf.get("transformer_layers_per_block", 1), int) else 1,
+            num_heads=hf.get("num_attention_heads") or heads,
+            context_dim=hf.get("cross_attention_dim", 768),
+        )
+
+
+# ---------------------------------------------------------------------------
+# primitives
+# ---------------------------------------------------------------------------
+
+def conv2d(x, p, *, stride: int = 1, padding="SAME") -> jax.Array:
+    out = lax.conv_general_dilated(
+        x, p["w"].astype(x.dtype), (stride, stride), padding,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+    return out + p["b"].astype(x.dtype)
+
+
+def group_norm(x, p, *, groups: int = 32, eps: float = 1e-5) -> jax.Array:
+    """GroupNorm over channel groups, computed in f32 (TPU numerics)."""
+    B, H, W, C = x.shape
+    g = min(groups, C)
+    xf = x.astype(jnp.float32).reshape(B, H, W, g, C // g)
+    mean = xf.mean(axis=(1, 2, 4), keepdims=True)
+    var = xf.var(axis=(1, 2, 4), keepdims=True)
+    xf = (xf - mean) * lax.rsqrt(var + eps)
+    xf = xf.reshape(B, H, W, C)
+    return (xf * p["g"] + p["b"]).astype(x.dtype)
+
+
+def layer_norm(x, p, *, eps: float = 1e-5) -> jax.Array:
+    xf = x.astype(jnp.float32)
+    mean = xf.mean(-1, keepdims=True)
+    var = xf.var(-1, keepdims=True)
+    out = (xf - mean) * lax.rsqrt(var + eps) * p["g"] + p["b"]
+    return out.astype(x.dtype)
+
+
+def attention(q, k, v, num_heads: int) -> jax.Array:
+    """Multi-head dot-product attention over [B, N, C] / [B, M, C]."""
+    B, N, C = q.shape
+    M = k.shape[1]
+    hd = C // num_heads
+    q = q.reshape(B, N, num_heads, hd)
+    k = k.reshape(B, M, num_heads, hd)
+    v = v.reshape(B, M, num_heads, hd)
+    scores = jnp.einsum("bnhd,bmhd->bhnm", q, k) / math.sqrt(hd)
+    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
+    out = jnp.einsum("bhnm,bmhd->bnhd", probs, v)
+    return out.reshape(B, N, C)
+
+
+def timestep_embedding(t, dim: int, max_period: float = 10000.0) -> jax.Array:
+    """Sinusoidal timestep features [B, dim] (f32)."""
+    half = dim // 2
+    freqs = jnp.exp(
+        -math.log(max_period) * jnp.arange(half, dtype=jnp.float32) / half
+    )
+    args = t.astype(jnp.float32)[:, None] * freqs[None]
+    return jnp.concatenate([jnp.cos(args), jnp.sin(args)], axis=-1)
+
+
+# ---------------------------------------------------------------------------
+# blocks
+# ---------------------------------------------------------------------------
+
+def res_block(x, temb, p) -> jax.Array:
+    h = jax.nn.silu(group_norm(x, p["norm1"]))
+    h = conv2d(h, p["conv1"])
+    t = jax.nn.silu(temb) @ p["temb"]["w"].astype(temb.dtype) + p["temb"]["b"].astype(temb.dtype)
+    h = h + t.astype(h.dtype)[:, None, None, :]
+    h = jax.nn.silu(group_norm(h, p["norm2"]))
+    h = conv2d(h, p["conv2"])
+    if "skip" in p:
+        x = conv2d(x, p["skip"])
+    return x + h
+
+
+def _geglu(x, p) -> jax.Array:
+    h = x @ p["w1"].astype(x.dtype) + p["b1"].astype(x.dtype)
+    a, b = jnp.split(h, 2, axis=-1)
+    h = a * jax.nn.gelu(b)
+    return h @ p["w2"].astype(x.dtype) + p["b2"].astype(x.dtype)
+
+
+def _attn_proj(x, ctx, p, num_heads: int) -> jax.Array:
+    q = x @ p["wq"].astype(x.dtype)
+    k = ctx @ p["wk"].astype(ctx.dtype)
+    v = ctx @ p["wv"].astype(ctx.dtype)
+    out = attention(q, k, v, num_heads)
+    return out @ p["wo"].astype(x.dtype) + p["bo"].astype(x.dtype)
+
+
+def spatial_transformer(x, context, p, cfg: UNetConfig) -> jax.Array:
+    """GN → 1×1 in → transformer blocks (self, cross, GEGLU FF) → 1×1 out,
+    residual around the whole stack."""
+    B, H, W, C = x.shape
+    h = group_norm(x, p["norm"])
+    h = conv2d(h, p["proj_in"])
+    h = h.reshape(B, H * W, C)
+    for bp in p["blocks"]:
+        h = h + _attn_proj(layer_norm(h, bp["ln1"]), layer_norm(h, bp["ln1"]),
+                           bp["attn1"], cfg.num_heads)
+        h = h + _attn_proj(layer_norm(h, bp["ln2"]), context,
+                           bp["attn2"], cfg.num_heads)
+        h = h + _geglu(layer_norm(h, bp["ln3"]), bp["ff"])
+    h = h.reshape(B, H, W, C)
+    h = conv2d(h, p["proj_out"])
+    return x + h
+
+
+def downsample(x, p) -> jax.Array:
+    # stride-2 conv with the (0,1) asymmetric padding SD uses
+    return conv2d(x, p, stride=2, padding=((0, 1), (0, 1)))
+
+
+def upsample(x, p) -> jax.Array:
+    B, H, W, C = x.shape
+    x = jax.image.resize(x, (B, H * 2, W * 2, C), method="nearest")
+    return conv2d(x, p)
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+def forward(cfg: UNetConfig, params: PyTree, latents, timesteps, context):
+    """Denoise step: latents [B,h,w,Cin], timesteps [B], context [B,T,ctx]
+    → predicted noise [B,h,w,Cout]."""
+    dtype = jnp.dtype(cfg.dtype)
+    x = latents.astype(dtype)
+    context = context.astype(dtype)
+
+    temb = timestep_embedding(timesteps, cfg.model_channels)
+    te = params["time_emb"]
+    temb = temb @ te["w1"] + te["b1"]
+    temb = jax.nn.silu(temb) @ te["w2"] + te["b2"]
+
+    h = conv2d(x, params["conv_in"])
+    skips = [h]
+    for lvl, lp in enumerate(params["down"]):
+        for i, rp in enumerate(lp["res"]):
+            h = res_block(h, temb, rp)
+            if lp.get("attn"):
+                h = spatial_transformer(h, context, lp["attn"][i], cfg)
+            skips.append(h)
+        if lp.get("down"):
+            h = downsample(h, lp["down"])
+            skips.append(h)
+
+    mid = params["mid"]
+    h = res_block(h, temb, mid["res1"])
+    h = spatial_transformer(h, context, mid["attn"], cfg)
+    h = res_block(h, temb, mid["res2"])
+
+    for lvl, lp in enumerate(params["up"]):
+        for i, rp in enumerate(lp["res"]):
+            h = jnp.concatenate([h, skips.pop()], axis=-1)
+            h = res_block(h, temb, rp)
+            if lp.get("attn"):
+                h = spatial_transformer(h, context, lp["attn"][i], cfg)
+        if lp.get("up"):
+            h = upsample(h, lp["up"])
+
+    h = jax.nn.silu(group_norm(h, params["norm_out"]))
+    return conv2d(h, params["conv_out"]).astype(jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# shapes / init
+# ---------------------------------------------------------------------------
+
+def _conv_shape(cin, cout, k=3):
+    return {"w": (k, k, cin, cout), "b": (cout,)}
+
+
+def _res_shapes(cin, cout, tdim):
+    p = {
+        "norm1": {"g": (cin,), "b": (cin,)},
+        "conv1": _conv_shape(cin, cout),
+        "temb": {"w": (tdim, cout), "b": (cout,)},
+        "norm2": {"g": (cout,), "b": (cout,)},
+        "conv2": _conv_shape(cout, cout),
+    }
+    if cin != cout:
+        p["skip"] = _conv_shape(cin, cout, k=1)
+    return p
+
+
+def _st_shapes(ch, cfg: UNetConfig):
+    def attn(kv_dim):
+        return {"wq": (ch, ch), "wk": (kv_dim, ch), "wv": (kv_dim, ch),
+                "wo": (ch, ch), "bo": (ch,)}
+
+    inner = ch * 4
+    block = {
+        "ln1": {"g": (ch,), "b": (ch,)}, "attn1": attn(ch),
+        "ln2": {"g": (ch,), "b": (ch,)}, "attn2": attn(cfg.context_dim),
+        "ln3": {"g": (ch,), "b": (ch,)},
+        "ff": {"w1": (ch, inner * 2), "b1": (inner * 2,),
+               "w2": (inner, ch), "b2": (ch,)},
+    }
+    return {
+        "norm": {"g": (ch,), "b": (ch,)},
+        "proj_in": _conv_shape(ch, ch, k=1),
+        "blocks": [dict(block) for _ in range(cfg.transformer_depth)],
+        "proj_out": _conv_shape(ch, ch, k=1),
+    }
+
+
+def param_shapes(cfg: UNetConfig) -> PyTree:
+    mc = cfg.model_channels
+    tdim = mc * 4
+    shapes: dict[str, Any] = {
+        "conv_in": _conv_shape(cfg.in_channels, mc),
+        "time_emb": {"w1": (mc, tdim), "b1": (tdim,),
+                     "w2": (tdim, tdim), "b2": (tdim,)},
+    }
+    down = []
+    ch = mc
+    level_out_ch = []   # channels of each skip, in push order
+    skip_chs = [mc]
+    for lvl, mult in enumerate(cfg.channel_mult):
+        out_ch = mc * mult
+        lp: dict[str, Any] = {"res": [], "attn": [] if lvl in cfg.attn_levels else None}
+        for _ in range(cfg.num_res_blocks):
+            lp["res"].append(_res_shapes(ch, out_ch, tdim))
+            if lp["attn"] is not None:
+                lp["attn"].append(_st_shapes(out_ch, cfg))
+            ch = out_ch
+            skip_chs.append(ch)
+        if lvl != len(cfg.channel_mult) - 1:
+            lp["down"] = _conv_shape(ch, ch)
+            skip_chs.append(ch)
+        down.append(lp)
+        level_out_ch.append(out_ch)
+    shapes["down"] = down
+    shapes["mid"] = {
+        "res1": _res_shapes(ch, ch, tdim),
+        "attn": _st_shapes(ch, cfg),
+        "res2": _res_shapes(ch, ch, tdim),
+    }
+    up = []
+    for lvl in reversed(range(len(cfg.channel_mult))):
+        out_ch = mc * cfg.channel_mult[lvl]
+        lp = {"res": [], "attn": [] if lvl in cfg.attn_levels else None}
+        for _ in range(cfg.num_res_blocks + 1):
+            skip = skip_chs.pop()
+            lp["res"].append(_res_shapes(ch + skip, out_ch, tdim))
+            if lp["attn"] is not None:
+                lp["attn"].append(_st_shapes(out_ch, cfg))
+            ch = out_ch
+        if lvl != 0:
+            lp["up"] = _conv_shape(ch, ch)
+        up.append(lp)
+    shapes["up"] = up
+    shapes["norm_out"] = {"g": (ch,), "b": (ch,)}
+    shapes["conv_out"] = _conv_shape(ch, cfg.out_channels)
+    return shapes
+
+
+def init_params(rng: jax.Array, cfg: UNetConfig) -> PyTree:
+    """Random init (debug presets / tests; real weights come from the
+    diffusers-layout loader, localai_tpu.image.loader)."""
+    shapes = param_shapes(cfg)
+    flat, treedef = jax.tree.flatten(shapes, is_leaf=lambda x: isinstance(x, tuple))
+    keys = jax.random.split(rng, len(flat))
+    dtype = jnp.dtype(cfg.dtype)
+
+    def mk(k, shape):
+        if len(shape) == 1:
+            return jnp.ones(shape, jnp.float32) if shape else jnp.zeros(shape)
+        fan_in = math.prod(shape[:-1])
+        scale = 1.0 / math.sqrt(max(fan_in, 1))
+        return (jax.random.normal(k, shape, jnp.float32) * scale).astype(dtype)
+
+    params = jax.tree.unflatten(treedef, [mk(k, s) for k, s in zip(keys, flat)])
+    return _zero_biases(params)
+
+
+def _zero_biases(params: PyTree) -> PyTree:
+    def fix(path, leaf):
+        name = path[-1].key if hasattr(path[-1], "key") else ""
+        if name in ("b", "b1", "b2", "bo"):
+            return jnp.zeros_like(leaf)
+        return leaf
+
+    return jax.tree_util.tree_map_with_path(fix, params)

@@ -1,0 +1,218 @@
+"""AutoencoderKL VAE (SD-class) — functional JAX, NHWC.
+
+Latent codec for the diffusion pipeline: encoder for img2img init latents,
+decoder for final images. Capability parity: the VAE inside the reference's
+diffusers pipelines (/root/reference/backend/python/diffusers/backend.py
+txt2img/img2img paths). ResBlocks without time embedding, one single-head
+spatial attention at the bottleneck, nearest-up/stride-2-down resampling.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from localai_tpu.image.unet import conv2d, group_norm
+
+PyTree = Any
+
+
+@dataclasses.dataclass(frozen=True)
+class VAEConfig:
+    in_channels: int = 3
+    latent_channels: int = 4
+    base_channels: int = 128
+    channel_mult: tuple[int, ...] = (1, 2, 4, 4)
+    num_res_blocks: int = 2
+    scaling_factor: float = 0.18215
+    dtype: str = "bfloat16"
+
+    @property
+    def downscale(self) -> int:
+        return 2 ** (len(self.channel_mult) - 1)
+
+    @classmethod
+    def from_hf(cls, hf: dict) -> "VAEConfig":
+        block_out = hf.get("block_out_channels", [128, 256, 512, 512])
+        base = block_out[0]
+        return cls(
+            in_channels=hf.get("in_channels", 3),
+            latent_channels=hf.get("latent_channels", 4),
+            base_channels=base,
+            channel_mult=tuple(c // base for c in block_out),
+            num_res_blocks=hf.get("layers_per_block", 2),
+            scaling_factor=hf.get("scaling_factor", 0.18215),
+        )
+
+
+def _res(x, p):
+    h = jax.nn.silu(group_norm(x, p["norm1"]))
+    h = conv2d(h, p["conv1"])
+    h = jax.nn.silu(group_norm(h, p["norm2"]))
+    h = conv2d(h, p["conv2"])
+    if "skip" in p:
+        x = conv2d(x, p["skip"])
+    return x + h
+
+
+def _attn(x, p):
+    """Single-head spatial self-attention at the bottleneck (f32 softmax)."""
+    B, H, W, C = x.shape
+    h = group_norm(x, p["norm"]).reshape(B, H * W, C)
+    q = h @ p["wq"].astype(h.dtype) + p["bq"].astype(h.dtype)
+    k = h @ p["wk"].astype(h.dtype) + p["bk"].astype(h.dtype)
+    v = h @ p["wv"].astype(h.dtype) + p["bv"].astype(h.dtype)
+    scores = jnp.einsum("bnc,bmc->bnm", q, k) / math.sqrt(C)
+    probs = jax.nn.softmax(scores.astype(jnp.float32), -1).astype(h.dtype)
+    out = jnp.einsum("bnm,bmc->bnc", probs, v)
+    out = out @ p["wo"].astype(h.dtype) + p["bo"].astype(h.dtype)
+    return x + out.reshape(B, H, W, C)
+
+
+def decode(cfg: VAEConfig, params: PyTree, latents) -> jax.Array:
+    """Latents [B,h,w,L] (already divided by scaling_factor) → images
+    [B, h*downscale, w*downscale, 3] in [-1, 1] (f32)."""
+    p = params["decoder"]
+    x = latents.astype(jnp.dtype(cfg.dtype))
+    x = conv2d(x, params["post_quant_conv"])
+    x = conv2d(x, p["conv_in"])
+    x = _res(x, p["mid"]["res1"])
+    x = _attn(x, p["mid"]["attn"])
+    x = _res(x, p["mid"]["res2"])
+    for lp in p["up"]:
+        for rp in lp["res"]:
+            x = _res(x, rp)
+        if "up" in lp:
+            B, H, W, C = x.shape
+            x = jax.image.resize(x, (B, H * 2, W * 2, C), method="nearest")
+            x = conv2d(x, lp["up"])
+    x = jax.nn.silu(group_norm(x, p["norm_out"]))
+    return conv2d(x, p["conv_out"]).astype(jnp.float32)
+
+
+def encode(cfg: VAEConfig, params: PyTree, images, rng=None) -> jax.Array:
+    """Images [B,H,W,3] in [-1,1] → latents [B,H/ds,W/ds,L] scaled by
+    scaling_factor (mode of the posterior unless rng is given)."""
+    p = params["encoder"]
+    x = images.astype(jnp.dtype(cfg.dtype))
+    x = conv2d(x, p["conv_in"])
+    for lp in p["down"]:
+        for rp in lp["res"]:
+            x = _res(x, rp)
+        if "down" in lp:
+            x = conv2d(x, lp["down"], stride=2, padding=((0, 1), (0, 1)))
+    x = _res(x, p["mid"]["res1"])
+    x = _attn(x, p["mid"]["attn"])
+    x = _res(x, p["mid"]["res2"])
+    x = jax.nn.silu(group_norm(x, p["norm_out"]))
+    x = conv2d(x, p["conv_out"])              # [B,h,w,2L]: mean ‖ logvar
+    x = conv2d(x, params["quant_conv"])
+    mean, logvar = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    if rng is not None:
+        std = jnp.exp(0.5 * jnp.clip(logvar, -30.0, 20.0))
+        mean = mean + std * jax.random.normal(rng, mean.shape)
+    return mean * cfg.scaling_factor
+
+
+# ---------------------------------------------------------------------------
+# shapes / init
+# ---------------------------------------------------------------------------
+
+def _conv_shape(cin, cout, k=3):
+    return {"w": (k, k, cin, cout), "b": (cout,)}
+
+
+def _res_shapes(cin, cout):
+    p = {
+        "norm1": {"g": (cin,), "b": (cin,)},
+        "conv1": _conv_shape(cin, cout),
+        "norm2": {"g": (cout,), "b": (cout,)},
+        "conv2": _conv_shape(cout, cout),
+    }
+    if cin != cout:
+        p["skip"] = _conv_shape(cin, cout, k=1)
+    return p
+
+
+def _attn_shapes(ch):
+    return {
+        "norm": {"g": (ch,), "b": (ch,)},
+        "wq": (ch, ch), "bq": (ch,), "wk": (ch, ch), "bk": (ch,),
+        "wv": (ch, ch), "bv": (ch,), "wo": (ch, ch), "bo": (ch,),
+    }
+
+
+def param_shapes(cfg: VAEConfig) -> PyTree:
+    bc = cfg.base_channels
+    chs = [bc * m for m in cfg.channel_mult]
+    top = chs[-1]
+    enc_down = []
+    ch = bc
+    for lvl, out_ch in enumerate(chs):
+        lp: dict[str, Any] = {"res": []}
+        for _ in range(cfg.num_res_blocks):
+            lp["res"].append(_res_shapes(ch, out_ch))
+            ch = out_ch
+        if lvl != len(chs) - 1:
+            lp["down"] = _conv_shape(ch, ch)
+        enc_down.append(lp)
+    dec_up = []
+    ch = top
+    for lvl in reversed(range(len(chs))):
+        out_ch = chs[lvl]
+        lp = {"res": []}
+        for _ in range(cfg.num_res_blocks + 1):
+            lp["res"].append(_res_shapes(ch, out_ch))
+            ch = out_ch
+        if lvl != 0:
+            lp["up"] = _conv_shape(ch, ch)
+        dec_up.append(lp)
+    L = cfg.latent_channels
+    return {
+        "encoder": {
+            "conv_in": _conv_shape(cfg.in_channels, bc),
+            "down": enc_down,
+            "mid": {"res1": _res_shapes(top, top), "attn": _attn_shapes(top),
+                    "res2": _res_shapes(top, top)},
+            "norm_out": {"g": (top,), "b": (top,)},
+            "conv_out": _conv_shape(top, 2 * L),
+        },
+        "quant_conv": _conv_shape(2 * L, 2 * L, k=1),
+        "post_quant_conv": _conv_shape(L, L, k=1),
+        "decoder": {
+            "conv_in": _conv_shape(L, top),
+            "mid": {"res1": _res_shapes(top, top), "attn": _attn_shapes(top),
+                    "res2": _res_shapes(top, top)},
+            "up": dec_up,
+            "norm_out": {"g": (ch,), "b": (ch,)},
+            "conv_out": _conv_shape(ch, cfg.in_channels),
+        },
+    }
+
+
+def init_params(rng: jax.Array, cfg: VAEConfig) -> PyTree:
+    shapes = param_shapes(cfg)
+    flat, treedef = jax.tree.flatten(shapes, is_leaf=lambda x: isinstance(x, tuple))
+    keys = jax.random.split(rng, len(flat))
+    dtype = jnp.dtype(cfg.dtype)
+
+    def mk(k, shape):
+        if len(shape) == 1:
+            return jnp.ones(shape, jnp.float32)
+        fan_in = math.prod(shape[:-1])
+        return (jax.random.normal(k, shape, jnp.float32)
+                / math.sqrt(max(fan_in, 1))).astype(dtype)
+
+    params = jax.tree.unflatten(treedef, [mk(k, s) for k, s in zip(keys, flat)])
+
+    def fix(path, leaf):
+        name = path[-1].key if hasattr(path[-1], "key") else ""
+        if name.startswith("b") and name != "blocks":
+            return jnp.zeros_like(leaf)
+        return leaf
+
+    return jax.tree_util.tree_map_with_path(fix, params)

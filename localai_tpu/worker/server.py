@@ -393,10 +393,79 @@ class AudioServicer:
         pass
 
 
+class ImageServicer:
+    """Image-generation worker behind the GenerateImage RPC (parity: the
+    diffusers Python worker process, /root/reference/backend/python/
+    diffusers/backend.py:263-474, and the NCNN stablediffusion backend,
+    backend/go/image/stablediffusion/stablediffusion.go)."""
+
+    def __init__(self) -> None:
+        self._pipe = None
+        self._lock = threading.Lock()
+
+    def Health(self, request: pb.HealthMessage, context) -> pb.Reply:
+        return pb.Reply(message=b"OK")
+
+    def Status(self, request: pb.HealthMessage, context) -> pb.StatusResponse:
+        return pb.StatusResponse(state=pb.StatusResponse.READY)
+
+    def LoadModel(self, request: pb.ModelOptions, context) -> pb.Result:
+        from localai_tpu.image import resolve_image_model
+
+        with self._lock:
+            try:
+                self._pipe = resolve_image_model(
+                    request.model or "debug:sd-tiny",
+                    model_path=request.model_path or "models",
+                )
+                return pb.Result(success=True, message="ok")
+            except Exception as e:  # noqa: BLE001
+                log.exception("image LoadModel failed")
+                return pb.Result(success=False,
+                                 message=f"{type(e).__name__}: {e}")
+
+    def GenerateImage(self, request: pb.GenerateImageRequest,
+                      context) -> pb.ImageResult:
+        import io
+
+        from PIL import Image
+
+        if self._pipe is None:
+            context.abort(grpc.StatusCode.FAILED_PRECONDITION,
+                          "no model loaded (call LoadModel first)")
+        try:
+            result = self._pipe.generate(
+                request.positive_prompt,
+                negative_prompt=request.negative_prompt,
+                width=request.width or 512,
+                height=request.height or 512,
+                steps=request.step or None,
+                seed=request.seed if request.seed else None,
+                cfg_scale=(request.cfg_scale
+                           if request.HasField("cfg_scale") else None),
+            )
+        except Exception as e:  # noqa: BLE001
+            log.exception("GenerateImage failed")
+            return pb.ImageResult(success=False,
+                                  message=f"{type(e).__name__}: {e}")
+        buf = io.BytesIO()
+        Image.fromarray(result.image).save(buf, format="PNG")
+        png = buf.getvalue()
+        if request.dst:
+            with open(request.dst, "wb") as f:
+                f.write(png)
+            return pb.ImageResult(success=True, message=request.dst)
+        return pb.ImageResult(success=True, image=png)
+
+    def shutdown(self) -> None:
+        pass
+
+
 SERVICERS = {
     "llm": BackendServicer,
     "store": StoreServicer,
     "audio": AudioServicer,
+    "image": ImageServicer,
 }
 
 

@@ -1,0 +1,380 @@
+"""Image generation tests: pipeline, schedulers, diffusers-layout loader,
+worker servicer, HTTP endpoint (debug preset — no downloads, SURVEY.md §4
+fixture strategy)."""
+
+import base64
+import json
+
+import numpy as np
+import pytest
+
+from localai_tpu.image import resolve_image_model
+from localai_tpu.image import schedulers as sch
+
+
+@pytest.fixture(scope="module")
+def pipe():
+    return resolve_image_model("debug:sd-tiny")
+
+
+def test_txt2img_shape_and_determinism(pipe):
+    a = pipe.generate("a red square", width=64, height=64, steps=3, seed=7)
+    b = pipe.generate("a red square", width=64, height=64, steps=3, seed=7)
+    assert a.image.shape == (64, 64, 3)
+    assert a.image.dtype == np.uint8
+    assert (a.image == b.image).all()
+    c = pipe.generate("a red square", width=64, height=64, steps=3, seed=8)
+    assert (a.image != c.image).any()
+
+
+def test_size_bucketing(pipe):
+    r = pipe.generate("x", width=70, height=100, steps=2, seed=1)
+    # 70→128, 100→128 (64-quantum buckets bound XLA recompiles)
+    assert r.image.shape == (128, 128, 3)
+
+
+@pytest.mark.parametrize("name", ["ddim", "euler", "euler_a", "dpmpp_2m",
+                                  "k_euler", "k_dpmpp_2m"])
+def test_schedulers_run(pipe, name):
+    r = pipe.generate("s", width=64, height=64, steps=3, seed=3,
+                      scheduler=name)
+    assert r.image.shape == (64, 64, 3)
+
+
+def test_scheduler_aliases_resolve():
+    # every reference scheduler name maps onto a supported rule
+    for name in ("ddim", "pndm", "heun", "unipc", "euler", "euler_a", "lms",
+                 "k_lms", "dpm_2", "k_dpm_2", "dpm_2_a", "k_dpm_2_a",
+                 "dpmpp_2m", "k_dpmpp_2m", "dpmpp_sde", "k_dpmpp_sde",
+                 "dpmpp_2m_sde", "k_dpmpp_2m_sde"):
+        rule, _karras = sch.resolve(name)
+        assert rule in ("ddim", "euler", "euler_a", "dpmpp_2m")
+    assert sch.resolve(None) == ("euler", False)
+    with pytest.raises(ValueError):
+        sch.resolve("nonsense")
+
+
+def test_sigma_schedules():
+    sigmas, ts = sch.build_sigmas(10)
+    assert sigmas.shape == (11,) and ts.shape == (10,)
+    assert sigmas[-1] == 0.0
+    assert (np.diff(sigmas) < 0).all()
+    ks, kts = sch.build_sigmas(10, karras=True)
+    assert ks[-1] == 0.0 and (np.diff(ks) < 0).all()
+    assert not np.allclose(ks[:-1], sigmas[:-1])
+
+
+def test_img2img(pipe):
+    base = pipe.generate("base", width=64, height=64, steps=3, seed=5)
+    out = pipe.generate("restyle", width=64, height=64, steps=4, seed=6,
+                        init_image=base.image, strength=0.5)
+    assert out.image.shape == (64, 64, 3)
+
+
+def test_negative_prompt_changes_output(pipe):
+    a = pipe.generate("castle", width=64, height=64, steps=3, seed=9)
+    b = pipe.generate("castle", negative_prompt="blurry", width=64,
+                      height=64, steps=3, seed=9)
+    assert (a.image != b.image).any()
+
+
+# ---------------------------------------------------------------------------
+# diffusers-layout loader
+# ---------------------------------------------------------------------------
+
+def _write_diffusers_fixture(root):
+    """Emit a tiny random checkpoint in the diffusers directory layout
+    (torch OIHW convs / [out,in] linears under diffusers key names) so the
+    loader's mapping is exercised end to end."""
+    from safetensors.numpy import save_file
+
+    rng = np.random.default_rng(0)
+
+    def t(*shape):
+        return rng.standard_normal(shape).astype(np.float32) * 0.05
+
+    def conv(cin, cout, k=3):
+        return t(cout, cin, k, k)
+
+    # -- unet: block_out [32,64], 1 res block, attn on level 0 only
+    u = {}
+    u["conv_in.weight"], u["conv_in.bias"] = conv(4, 32), t(32)
+    u["time_embedding.linear_1.weight"] = t(128, 32)
+    u["time_embedding.linear_1.bias"] = t(128)
+    u["time_embedding.linear_2.weight"] = t(128, 128)
+    u["time_embedding.linear_2.bias"] = t(128)
+
+    def res(prefix, cin, cout):
+        u[f"{prefix}.norm1.weight"], u[f"{prefix}.norm1.bias"] = t(cin), t(cin)
+        u[f"{prefix}.conv1.weight"], u[f"{prefix}.conv1.bias"] = conv(cin, cout), t(cout)
+        u[f"{prefix}.time_emb_proj.weight"] = t(cout, 128)
+        u[f"{prefix}.time_emb_proj.bias"] = t(cout)
+        u[f"{prefix}.norm2.weight"], u[f"{prefix}.norm2.bias"] = t(cout), t(cout)
+        u[f"{prefix}.conv2.weight"], u[f"{prefix}.conv2.bias"] = conv(cout, cout), t(cout)
+        if cin != cout:
+            u[f"{prefix}.conv_shortcut.weight"] = conv(cin, cout, 1)
+            u[f"{prefix}.conv_shortcut.bias"] = t(cout)
+
+    def st(prefix, ch, ctx=64):
+        u[f"{prefix}.norm.weight"], u[f"{prefix}.norm.bias"] = t(ch), t(ch)
+        u[f"{prefix}.proj_in.weight"] = conv(ch, ch, 1)
+        u[f"{prefix}.proj_in.bias"] = t(ch)
+        u[f"{prefix}.proj_out.weight"] = conv(ch, ch, 1)
+        u[f"{prefix}.proj_out.bias"] = t(ch)
+        b = f"{prefix}.transformer_blocks.0"
+        for ln in ("norm1", "norm2", "norm3"):
+            u[f"{b}.{ln}.weight"], u[f"{b}.{ln}.bias"] = t(ch), t(ch)
+        for attn, kv in (("attn1", ch), ("attn2", ctx)):
+            u[f"{b}.{attn}.to_q.weight"] = t(ch, ch)
+            u[f"{b}.{attn}.to_k.weight"] = t(ch, kv)
+            u[f"{b}.{attn}.to_v.weight"] = t(ch, kv)
+            u[f"{b}.{attn}.to_out.0.weight"] = t(ch, ch)
+            u[f"{b}.{attn}.to_out.0.bias"] = t(ch)
+        inner = ch * 4
+        u[f"{b}.ff.net.0.proj.weight"] = t(inner * 2, ch)
+        u[f"{b}.ff.net.0.proj.bias"] = t(inner * 2)
+        u[f"{b}.ff.net.2.weight"] = t(ch, inner)
+        u[f"{b}.ff.net.2.bias"] = t(ch)
+
+    res("down_blocks.0.resnets.0", 32, 32)
+    st("down_blocks.0.attentions.0", 32)
+    u["down_blocks.0.downsamplers.0.conv.weight"] = conv(32, 32)
+    u["down_blocks.0.downsamplers.0.conv.bias"] = t(32)
+    res("down_blocks.1.resnets.0", 32, 64)
+    res("mid_block.resnets.0", 64, 64)
+    st("mid_block.attentions.0", 64)
+    res("mid_block.resnets.1", 64, 64)
+    # up level 1 (deepest first): skips are [64, 32]
+    res("up_blocks.0.resnets.0", 64 + 64, 64)
+    res("up_blocks.0.resnets.1", 64 + 32, 64)
+    u["up_blocks.0.upsamplers.0.conv.weight"] = conv(64, 64)
+    u["up_blocks.0.upsamplers.0.conv.bias"] = t(64)
+    res("up_blocks.1.resnets.0", 64 + 32, 32)
+    st("up_blocks.1.attentions.0", 32)
+    res("up_blocks.1.resnets.1", 32 + 32, 32)
+    st("up_blocks.1.attentions.1", 32)
+    u["conv_norm_out.weight"], u["conv_norm_out.bias"] = t(32), t(32)
+    u["conv_out.weight"], u["conv_out.bias"] = conv(32, 4), t(4)
+
+    (root / "unet").mkdir(parents=True)
+    save_file(u, str(root / "unet" / "model.safetensors"))
+    (root / "unet" / "config.json").write_text(json.dumps({
+        "block_out_channels": [32, 64], "layers_per_block": 1,
+        "down_block_types": ["CrossAttnDownBlock2D", "DownBlock2D"],
+        "cross_attention_dim": 64, "attention_head_dim": 4,
+        "in_channels": 4, "out_channels": 4,
+    }))
+
+    # -- vae: block_out [32, 64], 1 res block
+    v = {}
+
+    def vres(prefix, cin, cout):
+        v[f"{prefix}.norm1.weight"], v[f"{prefix}.norm1.bias"] = t(cin), t(cin)
+        v[f"{prefix}.conv1.weight"], v[f"{prefix}.conv1.bias"] = conv(cin, cout), t(cout)
+        v[f"{prefix}.norm2.weight"], v[f"{prefix}.norm2.bias"] = t(cout), t(cout)
+        v[f"{prefix}.conv2.weight"], v[f"{prefix}.conv2.bias"] = conv(cout, cout), t(cout)
+        if cin != cout:
+            v[f"{prefix}.conv_shortcut.weight"] = conv(cin, cout, 1)
+            v[f"{prefix}.conv_shortcut.bias"] = t(cout)
+
+    def vattn(prefix, ch):
+        v[f"{prefix}.group_norm.weight"], v[f"{prefix}.group_norm.bias"] = t(ch), t(ch)
+        for n in ("to_q", "to_k", "to_v", "to_out.0"):
+            v[f"{prefix}.{n}.weight"] = t(ch, ch)
+            v[f"{prefix}.{n}.bias"] = t(ch)
+
+    v["encoder.conv_in.weight"], v["encoder.conv_in.bias"] = conv(3, 32), t(32)
+    vres("encoder.down_blocks.0.resnets.0", 32, 32)
+    v["encoder.down_blocks.0.downsamplers.0.conv.weight"] = conv(32, 32)
+    v["encoder.down_blocks.0.downsamplers.0.conv.bias"] = t(32)
+    vres("encoder.down_blocks.1.resnets.0", 32, 64)
+    vres("encoder.mid_block.resnets.0", 64, 64)
+    vattn("encoder.mid_block.attentions.0", 64)
+    vres("encoder.mid_block.resnets.1", 64, 64)
+    v["encoder.conv_norm_out.weight"], v["encoder.conv_norm_out.bias"] = t(64), t(64)
+    v["encoder.conv_out.weight"], v["encoder.conv_out.bias"] = conv(64, 8), t(8)
+    v["quant_conv.weight"], v["quant_conv.bias"] = conv(8, 8, 1), t(8)
+    v["post_quant_conv.weight"], v["post_quant_conv.bias"] = conv(4, 4, 1), t(4)
+    v["decoder.conv_in.weight"], v["decoder.conv_in.bias"] = conv(4, 64), t(64)
+    vres("decoder.mid_block.resnets.0", 64, 64)
+    vattn("decoder.mid_block.attentions.0", 64)
+    vres("decoder.mid_block.resnets.1", 64, 64)
+    for j in range(2):
+        vres(f"decoder.up_blocks.0.resnets.{j}", 64, 64)
+    v["decoder.up_blocks.0.upsamplers.0.conv.weight"] = conv(64, 64)
+    v["decoder.up_blocks.0.upsamplers.0.conv.bias"] = t(64)
+    vres("decoder.up_blocks.1.resnets.0", 64, 32)
+    vres("decoder.up_blocks.1.resnets.1", 32, 32)
+    v["decoder.conv_norm_out.weight"], v["decoder.conv_norm_out.bias"] = t(32), t(32)
+    v["decoder.conv_out.weight"], v["decoder.conv_out.bias"] = conv(32, 3), t(3)
+
+    (root / "vae").mkdir()
+    save_file(v, str(root / "vae" / "model.safetensors"))
+    (root / "vae" / "config.json").write_text(json.dumps({
+        "block_out_channels": [32, 64], "layers_per_block": 1,
+        "latent_channels": 4, "in_channels": 3,
+    }))
+
+    # -- text encoder: 2 layers, width = unet cross_attention_dim
+    c = {}
+    C, I = 64, 128
+    c["text_model.embeddings.token_embedding.weight"] = t(100, C)
+    c["text_model.embeddings.position_embedding.weight"] = t(16, C)
+    for i in range(2):
+        b = f"text_model.encoder.layers.{i}"
+        for ln in ("layer_norm1", "layer_norm2"):
+            c[f"{b}.{ln}.weight"], c[f"{b}.{ln}.bias"] = t(C), t(C)
+        for p in ("q_proj", "k_proj", "v_proj", "out_proj"):
+            c[f"{b}.self_attn.{p}.weight"] = t(C, C)
+            c[f"{b}.self_attn.{p}.bias"] = t(C)
+        c[f"{b}.mlp.fc1.weight"], c[f"{b}.mlp.fc1.bias"] = t(I, C), t(I)
+        c[f"{b}.mlp.fc2.weight"], c[f"{b}.mlp.fc2.bias"] = t(C, I), t(C)
+    c["text_model.final_layer_norm.weight"] = t(C)
+    c["text_model.final_layer_norm.bias"] = t(C)
+
+    (root / "text_encoder").mkdir()
+    save_file(c, str(root / "text_encoder" / "model.safetensors"))
+    (root / "text_encoder" / "config.json").write_text(json.dumps({
+        "vocab_size": 100, "hidden_size": C, "intermediate_size": I,
+        "num_hidden_layers": 2, "num_attention_heads": 4,
+        "max_position_embeddings": 16, "eos_token_id": 99,
+    }))
+    (root / "model_index.json").write_text(json.dumps(
+        {"_class_name": "StableDiffusionPipeline"}
+    ))
+
+
+def test_diffusers_layout_loader(tmp_path):
+    from localai_tpu.image.loader import load_diffusers_pipeline
+
+    _write_diffusers_fixture(tmp_path / "ckpt")
+    pipe = load_diffusers_pipeline(tmp_path / "ckpt")
+    assert pipe.unet_cfg.model_channels == 32
+    assert pipe.unet_cfg.attn_levels == (0,)
+    r = pipe.generate("fixture", width=64, height=64, steps=2, seed=11)
+    assert r.image.shape == (64, 64, 3)
+
+
+# ---------------------------------------------------------------------------
+# worker servicer
+# ---------------------------------------------------------------------------
+
+def test_image_worker_servicer():
+    from localai_tpu.worker import backend_pb2 as pb
+    from localai_tpu.worker.server import ImageServicer
+
+    s = ImageServicer()
+    res = s.LoadModel(pb.ModelOptions(model="debug:sd-tiny"), None)
+    assert res.success, res.message
+    out = s.GenerateImage(pb.GenerateImageRequest(
+        positive_prompt="worker image", width=64, height=64, step=2, seed=4,
+    ), None)
+    assert out.success
+    assert out.image[:8] == b"\x89PNG\r\n\x1a\n"
+
+
+# ---------------------------------------------------------------------------
+# HTTP endpoint
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def image_server(tmp_path_factory):
+    import httpx
+
+    from localai_tpu.api.server import AppState
+    from localai_tpu.config.app_config import AppConfig
+    from localai_tpu.config.loader import ConfigLoader
+    from tests.test_api import _ServerThread
+
+    models = tmp_path_factory.mktemp("img_models")
+    imgs = tmp_path_factory.mktemp("generated")
+    (models / "sd.yaml").write_text(
+        "name: sd\nbackend: diffusers\nmodel: 'debug:sd-tiny'\n"
+        "diffusers:\n  steps: 2\n"
+    )
+    (models / "tiny.yaml").write_text(
+        "name: tiny\nmodel: 'debug:tiny'\ncontext_size: 64\n"
+    )
+    cfg = AppConfig(model_path=str(models), image_path=str(imgs))
+    loader = ConfigLoader(cfg.model_path)
+    loader.load_from_path()
+    srv = _ServerThread(AppState(cfg, loader))
+    with httpx.Client(base_url=srv.base, timeout=300.0) as c:
+        yield c
+    srv.stop()
+
+
+def test_images_generations_b64(image_server):
+    r = image_server.post("/v1/images/generations", json={
+        "model": "sd", "prompt": "a cat|ugly", "size": "64x64",
+        "response_format": "b64_json", "seed": 3,
+    })
+    assert r.status_code == 200, r.text
+    body = r.json()
+    assert len(body["data"]) == 1
+    png = base64.b64decode(body["data"][0]["b64_json"])
+    assert png[:8] == b"\x89PNG\r\n\x1a\n"
+
+
+def test_images_generations_url_and_fetch(image_server):
+    r = image_server.post("/v1/images/generations", json={
+        "model": "sd", "prompt": "a dog", "size": "64x64", "n": 2, "seed": 5,
+    })
+    assert r.status_code == 200, r.text
+    body = r.json()
+    assert len(body["data"]) == 2
+    url = body["data"][0]["url"]
+    assert "/generated-images/" in url
+    got = image_server.get("/generated-images/" +
+                           url.rsplit("/", 1)[-1])
+    assert got.status_code == 200
+    assert got.content[:8] == b"\x89PNG\r\n\x1a\n"
+
+
+def test_images_usecase_gating(image_server):
+    r = image_server.post("/v1/images/generations", json={
+        "model": "tiny", "prompt": "nope", "size": "64x64",
+    })
+    assert r.status_code == 400
+
+
+def test_images_img2img_base64_file(image_server):
+    first = image_server.post("/v1/images/generations", json={
+        "model": "sd", "prompt": "seed image", "size": "64x64",
+        "response_format": "b64_json", "seed": 1,
+    })
+    b64 = first.json()["data"][0]["b64_json"]
+    r = image_server.post("/v1/images/generations", json={
+        "model": "sd", "prompt": "variation", "size": "64x64",
+        "response_format": "b64_json", "seed": 2, "file": b64,
+    })
+    assert r.status_code == 200, r.text
+    png = base64.b64decode(r.json()["data"][0]["b64_json"])
+    assert png[:8] == b"\x89PNG\r\n\x1a\n"
+
+
+def test_generated_images_path_traversal_guarded(image_server):
+    got = image_server.get("/generated-images/..%2Fsd.yaml")
+    assert got.status_code in (400, 404)
+
+
+def test_images_size_resized_to_request(image_server):
+    # 100x100 buckets to 128 latents internally; API returns the asked size
+    r = image_server.post("/v1/images/generations", json={
+        "model": "sd", "prompt": "exact size", "size": "100x100",
+        "response_format": "b64_json", "seed": 1,
+    })
+    assert r.status_code == 200, r.text
+    import io
+
+    from PIL import Image
+
+    png = base64.b64decode(r.json()["data"][0]["b64_json"])
+    assert Image.open(io.BytesIO(png)).size == (100, 100)
+
+
+def test_images_size_limit(image_server):
+    r = image_server.post("/v1/images/generations", json={
+        "model": "sd", "prompt": "too big", "size": "4096x4096",
+    })
+    assert r.status_code == 400
