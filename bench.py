@@ -2,11 +2,14 @@
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 
-Workload: Llama-3.2-1B-class shapes (synthetic bf16 weights — the reference
+Workload: Llama-3.2-1B-class shapes (synthetic weights — the reference
 publishes no absolute numbers and this environment has zero egress, see
 BASELINE.md), 8 concurrent slots, 128-token prefill each, then timed batched
-decode. This is the hot loop the north star measures (/v1/chat/completions
-output tok/s); the API layers add microseconds, the engine dominates.
+decode. Weights are served int8 per-channel (models/quant.py) with scaled
+int8 KV — the TPU analogue of the reference's default q4-GGUF serving format
+(aio/cpu/text-to-text.yaml); set BENCH_QUANT=none for the bf16 variant.
+This is the hot loop the north star measures (/v1/chat/completions output
+tok/s); the API layers add microseconds, the engine dominates.
 
 vs_baseline: ratio against 800 tok/s aggregate — a documented proxy for
 llama.cpp-CUDA-class serving of a 1B model at batch 8 (~100 tok/s/stream).
@@ -30,14 +33,23 @@ def main() -> None:
 
     # env knobs for smoke runs (the driver uses the defaults)
     preset = os.environ.get("BENCH_MODEL", "debug:1b")
-    steps = int(os.environ.get("BENCH_STEPS", "48"))
-    multi = int(os.environ.get("BENCH_MULTI_STEP", "16"))
+    steps = int(os.environ.get("BENCH_STEPS", "192"))
+    multi = int(os.environ.get("BENCH_MULTI_STEP", "32"))
+    depth = int(os.environ.get("BENCH_DEPTH", "4"))
+    quant = os.environ.get("BENCH_QUANT", "int8")
 
     model = resolve_model(preset, dtype="bfloat16")
+    params = model.params
+    kv_dtype = "bfloat16"
+    if quant == "int8":
+        from localai_tpu.models.quant import quantize_params
+
+        params = quantize_params(params, "int8")
+        kv_dtype = "int8"
     num_slots = 8
     runner = ModelRunner(
-        model.cfg, model.params, num_slots=num_slots, max_ctx=1024,
-        prefill_buckets=[128],
+        model.cfg, params, num_slots=num_slots, max_ctx=1024,
+        prefill_buckets=[128], kv_dtype=kv_dtype,
     )
 
     prompt = list(range(1, 101))  # 100-token synthetic prompt
@@ -59,7 +71,6 @@ def main() -> None:
 
     import numpy as np
 
-    depth = 2
     dispatches = max(1, steps // multi)
     t0 = time.perf_counter()
     q: deque = deque()

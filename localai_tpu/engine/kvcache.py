@@ -23,13 +23,22 @@ import jax.numpy as jnp
 from jax import lax
 
 from localai_tpu.models.llama import LlamaConfig
+from localai_tpu.models.quant import quantize_lastdim as _quant_chunk
 
 
 @jax.tree_util.register_dataclass
 @dataclasses.dataclass
 class KVCache:
+    """k, v: [L, S, Hkv, C, hd]. When the cache dtype is int8, k/v hold
+    symmetric per-(slot, head, position) quantized values and
+    k_scale/v_scale hold the f32 scales [L, S, Hkv, C] — honest scaled
+    int8, not a raw dtype cast (the scale adds hd⁻¹·4 bytes/elem ≈ 1.5%
+    overhead against a 2× KV memory saving)."""
+
     k: jax.Array
     v: jax.Array
+    k_scale: Optional[jax.Array] = None
+    v_scale: Optional[jax.Array] = None
 
     @property
     def num_slots(self) -> int:
@@ -38,6 +47,20 @@ class KVCache:
     @property
     def max_ctx(self) -> int:
         return self.k.shape[3]
+
+    @property
+    def quantized(self) -> bool:
+        return self.k_scale is not None
+
+    def stacked(self):
+        """The pytree scanned alongside layers in models.llama.forward."""
+        if self.k_scale is None:
+            return (self.k, self.v)
+        return (self.k, self.v, self.k_scale, self.v_scale)
+
+    @staticmethod
+    def from_stacked(t) -> "KVCache":
+        return KVCache(*t)
 
 
 def init_cache(
@@ -49,14 +72,29 @@ def init_cache(
 ) -> KVCache:
     shape = (cfg.num_layers, num_slots, cfg.num_kv_heads, max_ctx, cfg.hd)
     dt = jnp.dtype(dtype)
-    if sharding is not None:
-        zeros = jax.jit(
-            lambda: jnp.zeros(shape, dt), out_shardings=sharding
-        )()
-        return KVCache(k=zeros, v=jax.jit(
-            lambda: jnp.zeros(shape, dt), out_shardings=sharding
-        )())
-    return KVCache(k=jnp.zeros(shape, dt), v=jnp.zeros(shape, dt))
+
+    def zeros(shp, d, shd):
+        if shd is not None:
+            return jax.jit(lambda: jnp.zeros(shp, d), out_shardings=shd)()
+        return jnp.zeros(shp, d)
+
+    scale_sharding = None
+    if dt == jnp.int8 and sharding is not None:
+        # scales drop the head_dim axis; reuse the kv spec minus its last entry
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        spec = sharding.spec
+        scale_sharding = NamedSharding(sharding.mesh, P(*tuple(spec)[:4]))
+    if dt == jnp.int8:
+        return KVCache(
+            k=zeros(shape, dt, sharding),
+            v=zeros(shape, dt, sharding),
+            k_scale=zeros(shape[:4], jnp.float32, scale_sharding),
+            v_scale=zeros(shape[:4], jnp.float32, scale_sharding),
+        )
+    return KVCache(k=zeros(shape, dt, sharding), v=zeros(shape, dt, sharding))
+
+
 
 
 def decode_write(positions: jax.Array):
@@ -68,14 +106,26 @@ def decode_write(positions: jax.Array):
     ([S, H, C, hd])."""
 
     def write(layer_kv, k_new, v_new):
+        dt = k_new.dtype
+        s = jnp.arange(layer_kv[0].shape[0])
+        if len(layer_kv) == 4:  # scaled int8 cache
+            k_layer, v_layer, ks_layer, vs_layer = layer_kv
+            kq, ks = _quant_chunk(k_new[:, 0])  # [S, H, hd], [S, H]
+            vq, vs = _quant_chunk(v_new[:, 0])
+            # advanced indices (s, positions) separated by the head slice →
+            # result dims [S, H, ...]
+            new_k = k_layer.at[s, :, positions].set(kq)
+            new_v = v_layer.at[s, :, positions].set(vq)
+            new_ks = ks_layer.at[s, :, positions].set(ks)
+            new_vs = vs_layer.at[s, :, positions].set(vs)
+            keys = new_k.astype(dt) * new_ks[..., None].astype(dt)
+            values = new_v.astype(dt) * new_vs[..., None].astype(dt)
+            return (new_k, new_v, new_ks, new_vs), keys, values
         k_layer, v_layer = layer_kv  # [S, H, C, hd]
-        s = jnp.arange(k_layer.shape[0])
         kdt = k_layer.dtype
-        # advanced indices (s, positions) separated by the head slice →
-        # result dims [S, H, hd], matching k_new[:, 0]
         new_k = k_layer.at[s, :, positions].set(k_new[:, 0].astype(kdt))
         new_v = v_layer.at[s, :, positions].set(v_new[:, 0].astype(kdt))
-        return (new_k, new_v), new_k.astype(k_new.dtype), new_v.astype(v_new.dtype)
+        return (new_k, new_v), new_k.astype(dt), new_v.astype(dt)
 
     return write
 
@@ -88,12 +138,24 @@ def prefill_write(slot: jax.Array, offset: jax.Array):
     T·C). Keys are exposed head-major: [1, H, T, hd]."""
 
     def write(layer_kv, k_new, v_new):
-        k_layer, v_layer = layer_kv  # [S, H, C, hd]
-        kdt = k_layer.dtype
         k_hm = k_new.transpose(0, 2, 1, 3)  # [1, H, T, hd]
         v_hm = v_new.transpose(0, 2, 1, 3)
         zero = jnp.zeros((), jnp.int32)
         idx = (slot, zero, offset, zero)
+        if len(layer_kv) == 4:  # scaled int8 cache
+            k_layer, v_layer, ks_layer, vs_layer = layer_kv
+            kq, ks = _quant_chunk(k_hm)  # [1, H, T, hd], [1, H, T]
+            vq, vs = _quant_chunk(v_hm)
+            new_k = lax.dynamic_update_slice(k_layer, kq, idx)
+            new_v = lax.dynamic_update_slice(v_layer, vq, idx)
+            new_ks = lax.dynamic_update_slice(ks_layer, ks, (slot, zero, offset))
+            new_vs = lax.dynamic_update_slice(vs_layer, vs, (slot, zero, offset))
+            # fresh-context prefill attends over the chunk itself, so the
+            # exposed keys/values are the unquantized chunk — quantization
+            # error only enters on later decode reads
+            return (new_k, new_v, new_ks, new_vs), k_hm, v_hm
+        k_layer, v_layer = layer_kv  # [S, H, C, hd]
+        kdt = k_layer.dtype
         new_k = lax.dynamic_update_slice(k_layer, k_hm.astype(kdt), idx)
         new_v = lax.dynamic_update_slice(v_layer, v_hm.astype(kdt), idx)
         return (new_k, new_v), k_hm, v_hm

@@ -101,6 +101,17 @@ class ModelRunner:
                 cfg.hd, max_ctx,
             )
             self.attn_impl = "xla"
+        # int8 KV: the dequant epilogue fuses into the XLA attention consumer
+        # but would materialize a full bf16 cache copy in front of the Pallas
+        # decode kernel (custom calls don't fuse) — keep XLA for decode.
+        # Prefill attends over the raw chunk, so it keeps the flash kernel.
+        self.decode_attn_impl = self.attn_impl
+        if self.attn_impl == "pallas" and jnp.dtype(kv_dtype) == jnp.int8:
+            log.info(
+                "attention: int8 KV cache; decode uses the fused XLA path "
+                "(prefill keeps Pallas flash)"
+            )
+            self.decode_attn_impl = "xla"
         self.num_slots = num_slots
         self.max_ctx = max_ctx or cfg.max_position_embeddings
         self.mesh = mesh
@@ -172,7 +183,7 @@ class ModelRunner:
         cfg = self.cfg
         pos = state.positions
         attn = None
-        if self.attn_impl == "pallas":
+        if self.decode_attn_impl == "pallas":
             from localai_tpu import ops
 
             def attn(q, keys, values, _mask):  # q [S,1,Hq,hd], keys [S,Hkv,C,hd]
@@ -185,9 +196,9 @@ class ModelRunner:
 
         mask = kvc.decode_mask(cfg, pos, self.max_ctx)
         write = kvc.decode_write(pos)
-        hidden, (new_k, new_v) = mdl.forward(
+        hidden, new_stack = mdl.forward(
             cfg, params, state.tokens[:, None], pos[:, None],
-            write, (kv.k, kv.v), mask, self.rope, attn=attn,
+            write, kv.stacked(), mask, self.rope, attn=attn,
         )
         logits = mdl.logits_from_hidden(cfg, params, hidden[:, 0])
         tokens, keys = smp.sample(
@@ -204,7 +215,7 @@ class ModelRunner:
         new_state = dataclasses.replace(
             state, tokens=tokens, positions=positions, keys=keys, counts=counts
         )
-        return KVCache(new_k, new_v), new_state, tokens
+        return KVCache.from_stacked(new_stack), new_state, tokens
 
     def _decode_n_fn(self, params, kv: KVCache, state: DecodeState, *, n: int):
         """n decode steps in ONE dispatch via lax.scan — amortizes host→device
@@ -254,8 +265,8 @@ class ModelRunner:
         attn = self._prefill_attn(length)
         mask = kvc.prefill_mask(cfg, bucket, length)
         write = kvc.prefill_write(slot, jnp.zeros((), jnp.int32))
-        hidden, (new_k, new_v) = mdl.forward(
-            cfg, params, tokens, positions, write, (kv.k, kv.v), mask, self.rope,
+        hidden, new_stack = mdl.forward(
+            cfg, params, tokens, positions, write, kv.stacked(), mask, self.rope,
             attn=attn,
         )
         last_h = jax.lax.dynamic_index_in_dim(hidden[0], length - 1, keepdims=True)
@@ -274,7 +285,7 @@ class ModelRunner:
             keys=state.keys.at[slot].set(new_key[0]),
             counts=counts,
         )
-        return KVCache(new_k, new_v), new_state, tok[0]
+        return KVCache.from_stacked(new_stack), new_state, tok[0]
 
     def _embed_fn(self, params, tokens, length, *, bucket: int):
         """Mean-pooled final hidden state over the real tokens — the LLM
@@ -283,9 +294,11 @@ class ModelRunner:
         embeddings.go:13). Uses a throwaway single-sequence KV so it never
         touches serving slots."""
         cfg = self.cfg
+        # throwaway scratch cache stays in the compute dtype even when the
+        # serving cache is int8 — it is read back within the same program
         kv_shape = (cfg.num_layers, 1, cfg.num_kv_heads, bucket, cfg.hd)
-        kv = (jnp.zeros(kv_shape, jnp.dtype(self.kv_dtype)),
-              jnp.zeros(kv_shape, jnp.dtype(self.kv_dtype)))
+        kv = (jnp.zeros(kv_shape, jnp.dtype(cfg.dtype)),
+              jnp.zeros(kv_shape, jnp.dtype(cfg.dtype)))
         positions = jnp.arange(bucket, dtype=jnp.int32)[None, :]
         mask = kvc.prefill_mask(cfg, bucket, length)
         write = kvc.prefill_write(jnp.int32(0), jnp.zeros((), jnp.int32))

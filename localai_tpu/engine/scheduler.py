@@ -348,9 +348,10 @@ class Scheduler:
 
     def _start(self, slot: int, handle: GenHandle) -> None:
         req = handle.request
-        base = None
+        base = self._padded_vocab_ban()
         if req.logit_bias:
-            base = np.zeros(self.runner.cfg.vocab_size, np.float32)
+            if base is None:
+                base = np.zeros(self.runner.cfg.vocab_size, np.float32)
             for tid, b in req.logit_bias.items():
                 if 0 <= int(tid) < base.shape[0]:
                     base[int(tid)] = b
@@ -383,15 +384,46 @@ class Scheduler:
             self.total_prompt_tokens += handle.prompt_tokens
         self._consume(slot, ctx, int(first))
 
-    @staticmethod
+    def _padded_vocab_ban(self) -> Optional[np.ndarray]:
+        """Standing bias banning ids the tokenizer cannot produce or decode.
+
+        Model vocabs are often padded wider than the tokenizer (mesh/MXU
+        alignment — e.g. the debug presets pad the 258-id byte tokenizer to
+        512); without the ban, sampling can land on a padded id and the
+        stream silently emits empty deltas. Returns a fresh [V] row
+        (callers mutate it) or None when vocabs already agree."""
+        tok_v = getattr(self.tokenizer, "vocab_size", None)
+        V = self.runner.cfg.vocab_size
+        if not tok_v or tok_v >= V:
+            return None
+        row = np.zeros(V, np.float32)
+        row[tok_v:] = -1e30
+        return row
+
     def _compose_bias(
-        base: Optional[np.ndarray], mask: Optional[np.ndarray]
+        self, base: Optional[np.ndarray], mask: Optional[np.ndarray]
     ) -> Optional[np.ndarray]:
+        base = self._fit_vocab(base, 0.0)
+        # a constraint mask covers the tokenizer's vocab; model vocab may be
+        # padded wider (MXU/mesh-aligned) — padded ids are disallowed
+        mask = self._fit_vocab(mask, -1e30)
         if base is None:
             return mask
         if mask is None:
             return base
         return base + mask
+
+    def _fit_vocab(
+        self, row: Optional[np.ndarray], fill: float
+    ) -> Optional[np.ndarray]:
+        if row is None:
+            return None
+        V = self.runner.cfg.vocab_size
+        if len(row) == V:
+            return row
+        out = np.full(V, fill, np.float32)
+        out[: min(len(row), V)] = row[:V]
+        return out
 
     def _process_rows(
         self, rows: np.ndarray, seq: int,

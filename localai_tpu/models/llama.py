@@ -29,6 +29,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from localai_tpu.models import quant as qnt
+
 PyTree = Any
 
 
@@ -218,9 +220,9 @@ def _layer(cfg: LlamaConfig, x, lp, cos, sin, attend):
     Hq, Hkv, hd = cfg.num_heads, cfg.num_kv_heads, cfg.hd
 
     h = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
-    q = h @ lp["wq"]
-    k = h @ lp["wk"]
-    v = h @ lp["wv"]
+    q = qnt.matmul(h, lp["wq"])
+    k = qnt.matmul(h, lp["wk"])
+    v = qnt.matmul(h, lp["wv"])
     if "bq" in lp:
         q = q + lp["bq"].astype(q.dtype)
         k = k + lp["bk"].astype(k.dtype)
@@ -233,10 +235,11 @@ def _layer(cfg: LlamaConfig, x, lp, cos, sin, attend):
 
     attn, new_kv = attend(q, k, v)
     attn = attn.reshape(*attn.shape[:-2], Hq * hd)
-    x = x + attn @ lp["wo"]
+    x = x + qnt.matmul(attn, lp["wo"])
 
     h = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
-    x = x + (jax.nn.silu(h @ lp["w_gate"]) * (h @ lp["w_up"])) @ lp["w_down"]
+    gated = jax.nn.silu(qnt.matmul(h, lp["w_gate"])) * qnt.matmul(h, lp["w_up"])
+    x = x + qnt.matmul(gated, lp["w_down"])
     return x, new_kv
 
 
@@ -277,7 +280,7 @@ def forward(
     cos_t, sin_t = rope
     cos = cos_t[positions][:, :, None, :]  # [B, T, 1, hd/2]
     sin = sin_t[positions][:, :, None, :]
-    x = params["embed"][tokens].astype(jnp.dtype(cfg.dtype))
+    x = qnt.embed_rows(params["embed"], tokens, jnp.dtype(cfg.dtype))
     if attn is None:
         attn = lambda q, keys, values, m: _grouped_attn(cfg, q, keys, values, m)  # noqa: E731
 
@@ -298,5 +301,5 @@ def forward(
 
 def logits_from_hidden(cfg: LlamaConfig, params: PyTree, x: jax.Array) -> jax.Array:
     if cfg.tie_word_embeddings:
-        return x @ params["embed"].T.astype(x.dtype)
-    return x @ params["lm_head"]
+        return qnt.matmul_t(x, params["embed"])
+    return qnt.matmul(x, params["lm_head"])

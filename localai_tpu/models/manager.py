@@ -83,6 +83,10 @@ def build_serving_model(mcfg: ModelConfig, app: AppConfig) -> ServingModel:
         dtype=eng.dtype,
     )
     params = model.params
+    if eng.quantization:
+        from localai_tpu.models.quant import quantize_params
+
+        params = quantize_params(params, eng.quantization)
     if mesh is not None:
         from localai_tpu.parallel import sharding as shd
 

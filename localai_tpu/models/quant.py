@@ -1,0 +1,197 @@
+"""Weight-only int8 quantization for the llama engine.
+
+TPU-era replacement for the reference's quantized-serving story (its default
+text config is a q4 GGUF served by llama.cpp; the autogptq/exllama2 Python
+backends serve GPTQ/EXL2 — /root/reference/aio/cpu/text-to-text.yaml,
+backend/python/autogptq/backend.py). GGUF block formats are llama.cpp-native
+and gain nothing on TPU; the idiomatic design is symmetric **per-channel
+int8** kept quantized in HBM and dequantized inside the matmul:
+
+    y = (x @ q.astype(bf16)) * scale        # scale per output channel
+
+which XLA fuses into the matmul epilogue — the weight HBM read (the decode
+bottleneck; see BENCH notes) is halved, while the MXU still runs bf16.
+
+Granularity: one f32 scale per output channel (per matmul column, per
+embedding row), the same granularity llama.cpp uses per 32-elem block but
+without the block bookkeeping that would defeat XLA tiling.
+
+``QuantizedTensor`` is a pytree node whose leaves (q, scale) stack/scan like
+plain arrays, so the stacked-layer ``lax.scan`` in models.llama and the
+NamedSharding placement in parallel.sharding both work unchanged.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+PyTree = Any
+
+
+@partial(
+    jax.tree_util.register_dataclass,
+    data_fields=("q", "scale"),
+    meta_fields=("axis", "mode"),
+)
+@dataclasses.dataclass
+class QuantizedTensor:
+    """Symmetric per-channel int8 weight.
+
+    q:     int8, the original weight shape.
+    scale: f32, the weight shape with ``axis`` (the matmul contraction dim)
+           removed — one scale per output channel.
+    axis:  which original axis was reduced (static metadata; used for
+           sharding-spec derivation, not in the compute path).
+    mode:  'w8'   — weight-only: q is cast to the activation dtype in the
+                    matmul (bit-exact dequant, but XLA materializes the cast
+                    so the HBM saving is partial);
+           'w8a8' — activations are dynamically quantized per-token and the
+                    MXU runs a native int8×int8→int32 dot: the weight stays
+                    int8 all the way from HBM to the systolic array (the
+                    full 2× bandwidth + int8-MXU win; adds per-token
+                    activation rounding error).
+    """
+
+    q: jax.Array
+    scale: jax.Array
+    axis: int
+    mode: str = "w8"
+
+    @property
+    def shape(self) -> tuple[int, ...]:
+        return tuple(self.q.shape)
+
+    @property
+    def dtype(self):
+        return self.q.dtype
+
+
+def quantize_tensor(w, axis: int) -> QuantizedTensor:
+    """Symmetric per-channel int8: scale = amax|w| / 127 over ``axis``."""
+    wf = jnp.asarray(w).astype(jnp.float32)
+    amax = jnp.max(jnp.abs(wf), axis=axis)
+    scale = jnp.maximum(amax, 1e-8) / 127.0
+    q = jnp.clip(
+        jnp.round(wf / jnp.expand_dims(scale, axis)), -127, 127
+    ).astype(jnp.int8)
+    return QuantizedTensor(q=q, scale=scale, axis=axis)
+
+
+def quantize_lastdim(x: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """Dynamic symmetric int8 over the last axis: x [..., K] →
+    (q int8 [..., K], scale f32 [...]). The shared recipe for activation
+    quantization (w8a8 matmuls) and the scaled int8 KV cache."""
+    xf = x.astype(jnp.float32)
+    scale = jnp.maximum(jnp.max(jnp.abs(xf), axis=-1), 1e-8) / 127.0
+    q = jnp.clip(jnp.round(xf / scale[..., None]), -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+_quant_activations = quantize_lastdim
+
+
+def _int8_dot(xq: jax.Array, wq: jax.Array, transpose_w: bool) -> jax.Array:
+    """Native int8×int8→int32 dot over the last axis of xq."""
+    k_axis = 1 if transpose_w else 0
+    return jax.lax.dot_general(
+        xq, wq,
+        (((xq.ndim - 1,), (k_axis,)), ((), ())),
+        preferred_element_type=jnp.int32,
+    )
+
+
+def matmul(x: jax.Array, w) -> jax.Array:
+    """x @ w for plain or quantized weights.
+
+    'w8': the int8 weight is cast to x.dtype inside the matmul and the
+    per-output-channel scale applied to the product — exactly
+    x @ (q * scale) with the scale factored out of the contraction.
+    'w8a8': x is dynamically quantized per token and the dot runs on the
+    int8 MXU path; both scales are applied to the int32 accumulator.
+    """
+    if not isinstance(w, QuantizedTensor):
+        return x @ w
+    if w.mode == "w8a8":
+        xq, xs = _quant_activations(x)
+        acc = _int8_dot(xq, w.q, transpose_w=False).astype(jnp.float32)
+        return (acc * xs[..., None] * w.scale).astype(x.dtype)
+    return (x @ w.q.astype(x.dtype)) * w.scale.astype(x.dtype)
+
+
+def matmul_t(x: jax.Array, w) -> jax.Array:
+    """x @ w.T (tied-embedding lm_head). Per-row scales become per-output-
+    column scales under the transpose, so the factoring still holds."""
+    if not isinstance(w, QuantizedTensor):
+        return x @ w.T.astype(x.dtype)
+    if w.mode == "w8a8":
+        xq, xs = _quant_activations(x)
+        acc = _int8_dot(xq, w.q, transpose_w=True).astype(jnp.float32)
+        return (acc * xs[..., None] * w.scale).astype(x.dtype)
+    return (x @ w.q.T.astype(x.dtype)) * w.scale.astype(x.dtype)
+
+
+def embed_rows(w, tokens: jax.Array, dtype) -> jax.Array:
+    """Embedding gather for plain or per-row-quantized tables."""
+    if isinstance(w, QuantizedTensor):
+        return w.q[tokens].astype(dtype) * w.scale[tokens][..., None].astype(dtype)
+    return w[tokens].astype(dtype)
+
+
+# Which params get quantized, and the contraction axis for each.
+# Norm gains and qkv biases stay in their source dtype (tiny, 1-D).
+_LAYER_AXES = {
+    "wq": 1, "wk": 1, "wv": 1, "wo": 1,
+    "w_gate": 1, "w_up": 1, "w_down": 1,
+}
+
+
+def quantize_params(params: PyTree, mode: str = "int8") -> PyTree:
+    """Quantize a llama param pytree's matmul weights in place of bf16.
+
+    embed is quantized per-row (axis=-1) so both the gather and the
+    tied-embedding logits matmul stay exact per-channel; lm_head per
+    output column (axis=0). Stacked layer weights [L, K, N] quantize over
+    K (axis=1) so scales stack [L, N] and scan alongside the weights.
+
+    mode: 'int8' (weight-only) or 'int8_w8a8' (+ dynamic activation quant,
+    native int8 MXU dot — the faster serving default; see QuantizedTensor).
+    """
+    if mode not in ("int8", "int8_w8a8"):
+        raise ValueError(f"unsupported quantization mode {mode!r}")
+    mm_mode = "w8a8" if mode == "int8_w8a8" else "w8"
+
+    def qt(w, axis):
+        return dataclasses.replace(quantize_tensor(w, axis), mode=mm_mode)
+
+    out = dict(params)
+    out["embed"] = qt(params["embed"], axis=1)
+    if "lm_head" in params:
+        out["lm_head"] = qt(params["lm_head"], axis=0)
+    layers = dict(params["layers"])
+    for name, axis in _LAYER_AXES.items():
+        # stacked [L, K, N]: contraction K is axis 1 → per-(layer, col) scale
+        layers[name] = qt(layers[name], axis=axis)
+    out["layers"] = layers
+    return out
+
+
+def dequantize_tensor(qt: QuantizedTensor, dtype="float32") -> jax.Array:
+    return qt.q.astype(dtype) * jnp.expand_dims(qt.scale, qt.axis).astype(dtype)
+
+
+def quantized_spec(qt_path_spec, axis: int):
+    """Derive the scale PartitionSpec from the weight spec by dropping the
+    contracted axis (used by parallel.sharding for quantized params)."""
+    from jax.sharding import PartitionSpec as P
+
+    entries = list(qt_path_spec)
+    # P shorter than rank means trailing dims replicated; pad first
+    while len(entries) < axis + 1:
+        entries.append(None)
+    del entries[axis]
+    return P(*entries)

@@ -26,12 +26,12 @@ from localai_tpu.utils.tokenizer import ByteTokenizer, Tokenizer, load_tokenizer
 # Llama-3-8B dims for honest perf measurement without weight downloads.
 DEBUG_PRESETS: dict[str, LlamaConfig] = {
     "tiny": LlamaConfig(
-        vocab_size=258, hidden_size=64, intermediate_size=128, num_layers=2,
+        vocab_size=512, hidden_size=64, intermediate_size=128, num_layers=2,
         num_heads=4, num_kv_heads=2, max_position_embeddings=512,
         rope_theta=10000.0,
     ),
     "small": LlamaConfig(
-        vocab_size=258, hidden_size=256, intermediate_size=512, num_layers=4,
+        vocab_size=512, hidden_size=256, intermediate_size=512, num_layers=4,
         num_heads=8, num_kv_heads=4, max_position_embeddings=2048,
     ),
     "1b": LlamaConfig(

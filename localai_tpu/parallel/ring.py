@@ -34,6 +34,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 shard_map = jax.shard_map
 
 from localai_tpu.models import llama as mdl
+from localai_tpu.models import quant as qnt
 from localai_tpu.models.llama import LlamaConfig
 
 _NEG_INF = -1e30
@@ -128,7 +129,7 @@ def sp_prefill_forward(
         positions = i * Tc + jnp.arange(Tc, dtype=jnp.int32)
         cos = cos_t[positions][None, :, None, :]
         sin = sin_t[positions][None, :, None, :]
-        x = params["embed"][tokens_c[None]].astype(dtype)
+        x = qnt.embed_rows(params["embed"], tokens_c[None], dtype)
 
         def body(carry, lp):
             def attend(q, k_new, v_new):

@@ -134,10 +134,27 @@ def state_specs(mesh: Mesh) -> dict:
 def shard_params(
     params: Any, cfg: LlamaConfig, mesh: Mesh
 ) -> Any:
-    """Place an already-loaded param pytree onto the mesh."""
+    """Place an already-loaded param pytree onto the mesh.
+
+    Quantized weights (models.quant.QuantizedTensor) place q with the
+    weight's spec and the per-output-channel scale with the same spec minus
+    the contracted axis — a 'model'-sharded weight keeps its scales sharded
+    alongside its output channels, so the dequant epilogue stays local."""
+    from localai_tpu.models.quant import QuantizedTensor, quantized_spec
+
     specs = param_specs(cfg, mesh)
 
     def put(spec_leaf, arr):
+        if isinstance(arr, QuantizedTensor):
+            s_spec = _sanitize(
+                quantized_spec(spec_leaf, arr.axis), arr.scale.shape, mesh
+            )
+            return QuantizedTensor(
+                q=jax.device_put(arr.q, NamedSharding(mesh, spec_leaf)),
+                scale=jax.device_put(arr.scale, NamedSharding(mesh, s_spec)),
+                axis=arr.axis,
+                mode=arr.mode,
+            )
         return jax.device_put(arr, NamedSharding(mesh, spec_leaf))
 
     return jax.tree.map(

@@ -136,7 +136,7 @@ def test_constraint_masking(sched):
         def done(self):
             return self.steps >= 3
 
-    c = OnlyToken(258)
+    c = OnlyToken(512)
     h = sched.generate(
         _req("constrained", max_new_tokens=10, temperature=0.0, constraint=c)
     )
@@ -173,7 +173,7 @@ def test_mixed_constrained_and_unconstrained_batch(sched):
     ]
     con = sched.submit(
         _req("tool", max_new_tokens=10, temperature=0.0,
-             constraint=OnlyToken(258, 66, 5))
+             constraint=OnlyToken(512, 66, 5))
     )
     assert con.result(60).token_ids == [66, 66, 66, 66, 66]
     for h in free:
@@ -211,7 +211,7 @@ def test_seeded_output_independent_of_batch_composition(sched):
     def run_seeded():
         return sched.generate(
             _req("seeded", max_new_tokens=6, temperature=1.0, seed=1234,
-                 constraint=AllowBand(258, 6))
+                 constraint=AllowBand(512, 6))
         ).token_ids
 
     solo = run_seeded()
@@ -268,7 +268,7 @@ def test_constraint_mask_cleared_when_none(sched):
 
     h = sched.generate(
         _req("free region", max_new_tokens=8, temperature=0.0,
-             constraint=MaskThenFree(258))
+             constraint=MaskThenFree(512))
     )
     assert h.token_ids[:2] == [66, 66]
     # after the mask clears, greedy decode must be able to leave token 66
