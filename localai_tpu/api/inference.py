@@ -38,6 +38,88 @@ def merge_request(mcfg: ModelConfig, req: OpenAIRequest) -> ModelConfig:
     return cfg
 
 
+def prepare_multimodal(
+    sm: ServingModel, cfg: ModelConfig, req: OpenAIRequest
+) -> tuple[list[dict], Optional[Any]]:
+    """Multipart message content → text with [img-N] placeholders (global
+    running IDs) + encoded image embeddings.
+
+    Parity: the reference's per-message image collection + multimodal
+    templating (/root/reference/core/http/endpoints/openai/chat.go:296-441,
+    pkg/templates/multimodal.go); the CLIP encode happens here instead of
+    inside the C++ worker (grpc-server.cpp:1397-1424).
+    Returns (message dicts for templating, embeds [n_img, n_patches, D] or
+    None when the request has no images or the model has no vision tower).
+    """
+    from localai_tpu.templates.chat import multimodal_placeholders
+
+    messages: list[dict] = []
+    refs: list[str] = []
+    for m in req.messages:
+        d = m.model_dump(exclude_none=True)
+        imgs = m.media_parts("image")
+        if imgs:
+            d["content"] = multimodal_placeholders(
+                cfg.template.multimodal or "",
+                m.text_content(),
+                n_images=len(imgs),
+                first_image_id=len(refs),
+            )
+            refs.extend(imgs)
+        messages.append(d)
+    if not refs:
+        return messages, None
+    if sm.vision is None:
+        log.warning(
+            "model %s received %d image(s) but has no vision tower "
+            "(set mmproj or use a llava checkpoint); serving text-only",
+            sm.name, len(refs),
+        )
+        return messages, None
+    from localai_tpu.utils.media import fetch_image
+
+    images = [fetch_image(r) for r in refs]
+    return messages, sm.vision.encode(images)
+
+
+def expand_image_placeholders(
+    sm: ServingModel, prompt: str, embeds: Any
+) -> tuple[list[int], Optional[Any], Optional[Any]]:
+    """Tokenize a prompt with [img-N] placeholders: each placeholder becomes
+    n_patches image-token ids, and the matching embedding rows + positions
+    are returned for scatter-injection at prefill (ModelRunner._prefill_mm).
+
+    The TPU-shaped version of llama.cpp's interleaved text/image batch
+    build (grpc-server.cpp:1397-1424): one token stream, one scatter."""
+    import numpy as np
+
+    segs = re.split(r"\[img-(\d+)\]", prompt)
+    tokens = sm.tokenizer.encode(segs[0], add_bos=True)
+    rows, poss = [], []
+    n_patches = embeds.shape[1]
+    for i in range(1, len(segs), 2):
+        idx = int(segs[i])
+        if 0 <= idx < embeds.shape[0]:
+            start = len(tokens)
+            tokens.extend([sm.image_token_id] * n_patches)
+            poss.extend(range(start, start + n_patches))
+            rows.append(embeds[idx])
+        tail = segs[i + 1]
+        if tail:
+            tokens.extend(sm.tokenizer.encode(tail, add_bos=False))
+    if len(rows) < embeds.shape[0]:
+        # a custom template.multimodal without the {{.Images}} loop eats the
+        # placeholders — surface it instead of silently serving text-only
+        log.warning(
+            "%d of %d encoded image(s) had no [img-N] placeholder in the "
+            "rendered prompt (check template.multimodal)",
+            embeds.shape[0] - len(rows), embeds.shape[0],
+        )
+    if not rows:
+        return tokens, None, None
+    return tokens, np.concatenate(rows, 0), np.asarray(poss, np.int32)
+
+
 def build_gen_request(
     sm: ServingModel,
     cfg: ModelConfig,
@@ -46,9 +128,16 @@ def build_gen_request(
     *,
     constraint: Any = None,
     seed_offset: int = 0,
+    mm_embeds: Any = None,
 ) -> GenRequest:
     p = cfg.parameters
-    tokens = sm.tokenizer.encode(prompt, add_bos=True)
+    mm_flat = mm_pos = None
+    if mm_embeds is not None:
+        tokens, mm_flat, mm_pos = expand_image_placeholders(
+            sm, prompt, mm_embeds
+        )
+    else:
+        tokens = sm.tokenizer.encode(prompt, add_bos=True)
     logit_bias = None
     if req.logit_bias:
         logit_bias = {}
@@ -76,6 +165,8 @@ def build_gen_request(
         ignore_eos=req.ignore_eos,
         constraint=constraint,
         correlation_id=req.user or "",
+        mm_embeds=mm_flat,
+        mm_positions=mm_pos,
     )
 
 

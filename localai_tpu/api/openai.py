@@ -125,7 +125,16 @@ async def chat(request: web.Request) -> web.StreamResponse:
             request, inf.response_format_constraint, sm, req
         )
 
-    messages = [m.model_dump(exclude_none=True) for m in req.messages]
+    try:
+        messages, mm_embeds = await _in_executor(
+            request, inf.prepare_multimodal, sm, cfg, req
+        )
+    except Exception as e:  # noqa: BLE001 — bad image refs → 400
+        from localai_tpu.utils.media import MediaError
+
+        if isinstance(e, MediaError):
+            raise web.HTTPBadRequest(text=str(e)) from e
+        raise
     if cfg.template.use_tokenizer_template:
         from localai_tpu.templates.chat import apply_tokenizer_template
 
@@ -140,7 +149,9 @@ async def chat(request: web.Request) -> web.StreamResponse:
     rid = sc.new_id("chatcmpl")
 
     constraint = tctx.constraint if tctx else rf_constraint
-    gr = inf.build_gen_request(sm, cfg, req, prompt, constraint=constraint)
+    gr = inf.build_gen_request(
+        sm, cfg, req, prompt, constraint=constraint, mm_embeds=mm_embeds
+    )
 
     if req.stream:
         return await _chat_stream(request, req, sm, cfg, gr, rid, tctx)
@@ -157,7 +168,8 @@ async def chat(request: web.Request) -> web.StreamResponse:
                 c = await _in_executor(
                     request, inf.response_format_constraint, sm, req)
             gr_i = inf.build_gen_request(
-                sm, cfg, req, prompt, constraint=c, seed_offset=i
+                sm, cfg, req, prompt, constraint=c, seed_offset=i,
+                mm_embeds=mm_embeds,
             )
         else:
             gr_i = gr

@@ -207,7 +207,11 @@ class ModelConfig(BaseModel):
     context_size: Optional[int] = None
     embeddings: bool = False
     seed: Optional[int] = None
-    mmproj: Optional[str] = None            # vision projector weights ref
+    mmproj: Optional[str] = None            # vision tower ref (dir or debug:)
+    image_token_id: Optional[int] = None    # placeholder id for image spans
+                                            # (default: HF image_token_index
+                                            # or 0; embeddings are injected
+                                            # over these positions anyway)
     download_files: list[dict[str, Any]] = Field(default_factory=list)
 
     parameters: PredictionParams = Field(default_factory=PredictionParams)

@@ -175,6 +175,10 @@ class ModelRunner:
         self._prefill = jax.jit(
             self._prefill_fn, static_argnames=("bucket",), donate_argnums=(1, 2)
         )
+        self._prefill_mm = jax.jit(
+            self._prefill_mm_fn, static_argnames=("bucket",),
+            donate_argnums=(1, 2),
+        )
         self._embed = jax.jit(self._embed_fn, static_argnames=("bucket",))
 
     # -- jitted programs -------------------------------------------------
@@ -259,7 +263,7 @@ class ModelRunner:
         return kv, state, tokens
 
     def _prefill_fn(self, params, kv: KVCache, state: DecodeState,
-                    tokens, length, slot, *, bucket: int):
+                    tokens, length, slot, *, bucket: int, embeds=None):
         cfg = self.cfg
         positions = jnp.arange(bucket, dtype=jnp.int32)[None, :]
         attn = self._prefill_attn(length)
@@ -267,7 +271,7 @@ class ModelRunner:
         write = kvc.prefill_write(slot, jnp.zeros((), jnp.int32))
         hidden, new_stack = mdl.forward(
             cfg, params, tokens, positions, write, kv.stacked(), mask, self.rope,
-            attn=attn,
+            attn=attn, embeds=embeds,
         )
         last_h = jax.lax.dynamic_index_in_dim(hidden[0], length - 1, keepdims=True)
         logits = mdl.logits_from_hidden(cfg, params, last_h)  # [1, V]
@@ -286,6 +290,25 @@ class ModelRunner:
             counts=counts,
         )
         return KVCache.from_stacked(new_stack), new_state, tok[0]
+
+    def _prefill_mm_fn(self, params, kv: KVCache, state: DecodeState,
+                       tokens, length, slot, mm_embeds, mm_positions,
+                       *, bucket: int):
+        """Multimodal prefill: token embeddings with image-embedding blocks
+        scattered over the placeholder positions (parity: llama.cpp's
+        image-embedding batch injection, grpc-server.cpp:1397-1424 — but as
+        one fused program instead of interleaved decode batches).
+
+        mm_embeds [n_mm, D] float32, mm_positions [n_mm] i32 (positions are
+        < length by construction in the scheduler)."""
+        from localai_tpu.models import quant as qnt
+
+        dtype = jnp.dtype(self.cfg.dtype)
+        x = qnt.embed_rows(params["embed"], tokens, dtype)  # [1, bucket, D]
+        x = x.at[0, mm_positions].set(mm_embeds.astype(dtype))
+        return self._prefill_fn(
+            params, kv, state, tokens, length, slot, bucket=bucket, embeds=x
+        )
 
     def _embed_fn(self, params, tokens, length, *, bucket: int):
         """Mean-pooled final hidden state over the real tokens — the LLM
@@ -359,6 +382,8 @@ class ModelRunner:
         seed: Optional[int] = None,
         logit_bias: Optional[dict[int, float]] = None,
         bias_row: Optional[np.ndarray] = None,
+        mm_embeds: Optional[np.ndarray] = None,    # [n_mm, D] image embeds
+        mm_positions: Optional[np.ndarray] = None,  # [n_mm] prompt positions
     ) -> int:
         """Prefill a prompt into a slot; returns the first sampled token."""
         if not prompt:
@@ -398,10 +423,20 @@ class ModelRunner:
                 if 0 <= int(tid) < self.cfg.vocab_size:
                     row[int(tid)] += b
         self.set_bias(slot, row)
-        self.kv, self.state, tok = self._prefill(
-            self.params, self.kv, self.state,
-            jnp.asarray(padded), jnp.int32(n), jnp.int32(slot), bucket=bucket,
-        )
+        if mm_embeds is not None and len(mm_embeds):
+            self.kv, self.state, tok = self._prefill_mm(
+                self.params, self.kv, self.state,
+                jnp.asarray(padded), jnp.int32(n), jnp.int32(slot),
+                jnp.asarray(mm_embeds, jnp.float32),
+                jnp.asarray(mm_positions, jnp.int32),
+                bucket=bucket,
+            )
+        else:
+            self.kv, self.state, tok = self._prefill(
+                self.params, self.kv, self.state,
+                jnp.asarray(padded), jnp.int32(n), jnp.int32(slot),
+                bucket=bucket,
+            )
         return int(tok)
 
     def step(self) -> np.ndarray:

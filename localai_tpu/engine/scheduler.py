@@ -71,6 +71,10 @@ class GenRequest:
     ignore_eos: bool = False
     constraint: Optional[TokenConstraint] = None
     correlation_id: str = ""
+    # multimodal injection: image-embedding rows [n_mm, D] scattered over
+    # placeholder token positions [n_mm] during prefill (see ModelRunner)
+    mm_embeds: Optional[Any] = None
+    mm_positions: Optional[Any] = None
 
 
 class StreamItem:
@@ -352,8 +356,14 @@ class Scheduler:
         if req.logit_bias:
             if base is None:
                 base = np.zeros(self.runner.cfg.vocab_size, np.float32)
+            # bound by the tokenizer vocab, not the (possibly padded) model
+            # vocab — a user bias must not resurrect banned padded ids
+            limit = min(
+                base.shape[0],
+                getattr(self.tokenizer, "vocab_size", None) or base.shape[0],
+            )
             for tid, b in req.logit_bias.items():
-                if 0 <= int(tid) < base.shape[0]:
+                if 0 <= int(tid) < limit:
                     base[int(tid)] = b
         mask = (
             req.constraint.allowed_mask() if req.constraint is not None else None
@@ -370,6 +380,8 @@ class Scheduler:
             frequency_penalty=req.frequency_penalty,
             seed=req.seed,
             bias_row=self._compose_bias(base, mask),
+            mm_embeds=req.mm_embeds,
+            mm_positions=req.mm_positions,
         )
         ctx = _SlotCtx(
             handle=handle,

@@ -271,6 +271,8 @@ def forward(
     rope: tuple[jax.Array, jax.Array],
     attn: Any = None,       # optional override: fn(q, keys, values, mask) -> out
                             # (Pallas flash kernels inject here; None = XLA)
+    embeds: Optional[jax.Array] = None,  # [B, T, D] input embeddings override
+                            # (multimodal injection bypasses the token gather)
 ) -> tuple[jax.Array, Any]:
     """Shared transformer trunk: returns (hidden [B, T, D], updated kv_stack).
 
@@ -280,7 +282,10 @@ def forward(
     cos_t, sin_t = rope
     cos = cos_t[positions][:, :, None, :]  # [B, T, 1, hd/2]
     sin = sin_t[positions][:, :, None, :]
-    x = qnt.embed_rows(params["embed"], tokens, jnp.dtype(cfg.dtype))
+    if embeds is None:
+        x = qnt.embed_rows(params["embed"], tokens, jnp.dtype(cfg.dtype))
+    else:
+        x = embeds.astype(jnp.dtype(cfg.dtype))
     if attn is None:
         attn = lambda q, keys, values, m: _grouped_attn(cfg, q, keys, values, m)  # noqa: E731
 

@@ -23,9 +23,18 @@ from localai_tpu.models.llama import LlamaConfig, param_shapes
 log = logging.getLogger(__name__)
 
 
-def load_hf_config(model_dir: str | Path) -> LlamaConfig:
+def read_hf_config(model_dir: str | Path) -> dict:
     with open(Path(model_dir) / "config.json") as f:
-        return LlamaConfig.from_hf(json.load(f))
+        return json.load(f)
+
+
+def load_hf_config(model_dir: str | Path) -> LlamaConfig:
+    hf = read_hf_config(model_dir)
+    if hf.get("model_type") == "llava":
+        # LLaVA composite checkpoint: the language model is described by
+        # text_config and stored under the language_model. prefix
+        return LlamaConfig.from_hf(hf.get("text_config", {}))
+    return LlamaConfig.from_hf(hf)
 
 
 def _open_safetensors(model_dir: Path) -> dict[str, Any]:
@@ -59,17 +68,31 @@ def load_llama_params(
     cfg: Optional[LlamaConfig] = None,
     dtype: str = "bfloat16",
     shard_fn=None,
+    hf: Optional[dict] = None,
 ) -> tuple[LlamaConfig, Any]:
     """Load an HF llama/mistral/qwen2 checkpoint into the stacked pytree.
 
     ``shard_fn(path_tuple, np_array) -> jax.Array`` lets the caller place
     each param with a NamedSharding (device_put per shard); default is
-    single-device jnp.asarray.
+    single-device jnp.asarray. ``hf`` is the already-parsed config.json
+    (avoids re-reading when the caller has it).
     """
     model_dir = Path(model_dir)
+    if hf is None:
+        hf = read_hf_config(model_dir)
+    # tensor-name layout: plain llama vs llava composite (classic
+    # language_model.model.* layout, or model.language_model.* in
+    # transformers ≥4.52 exports)
+    body, head = "model.", "lm_head.weight"
+    is_llava = hf.get("model_type") == "llava"
+    if is_llava:
+        body, head = "language_model.model.", "language_model.lm_head.weight"
     if cfg is None:
-        cfg = load_hf_config(model_dir)
+        cfg = LlamaConfig.from_hf(hf.get("text_config", {}) if is_llava else hf)
     tensors = _open_safetensors(model_dir)
+    if body + "embed_tokens.weight" not in tensors:
+        if "model.language_model.embed_tokens.weight" in tensors:
+            body, head = "model.language_model.", "lm_head.weight"
     dt = jnp.dtype(dtype)
     put = shard_fn or (lambda path, a: jnp.asarray(a, dt))
 
@@ -82,7 +105,7 @@ def load_llama_params(
             mats.append(a.T if transpose else a)
         return np.stack(mats)
 
-    L = "model.layers.{i}."
+    L = body + "layers.{i}."
     layers = {
         "attn_norm": stack(L + "input_layernorm.weight", False),
         "wq": stack(L + "self_attn.q_proj.weight", True),
@@ -100,13 +123,13 @@ def load_llama_params(
         layers["bv"] = stack(L + "self_attn.v_proj.bias", False)
 
     params: dict[str, Any] = {
-        "embed": _get(tensors, "model.embed_tokens.weight"),
-        "final_norm": _get(tensors, "model.norm.weight"),
+        "embed": _get(tensors, body + "embed_tokens.weight"),
+        "final_norm": _get(tensors, body + "norm.weight"),
         "layers": layers,
     }
     if not cfg.tie_word_embeddings:
-        if "lm_head.weight" in tensors:
-            params["lm_head"] = _get(tensors, "lm_head.weight").T
+        if head in tensors:
+            params["lm_head"] = _get(tensors, head).T
         else:
             cfg = LlamaConfig(**{**cfg.__dict__, "tie_word_embeddings": True})
 

@@ -41,6 +41,9 @@ class ServingModel:
     scheduler: Scheduler
     tokenizer: Any
     templates: TemplateCache
+    vision: Optional[Any] = None      # VisionTower when the model is
+                                      # multimodal (mmproj / llava checkpoint)
+    image_token_id: int = 0
     loaded_at: float = dataclasses.field(default_factory=time.monotonic)
     last_used: float = dataclasses.field(default_factory=time.monotonic)
 
@@ -112,6 +115,22 @@ def build_serving_model(mcfg: ModelConfig, app: AppConfig) -> ServingModel:
         multi_step=eng.decode_steps_per_dispatch,
         pipeline_depth=eng.pipeline_depth,
     )
+    # vision tower: explicit mmproj ref, or auto from a llava checkpoint dir
+    vision = None
+    vt_ref = mcfg.mmproj or (
+        str(model.model_dir) if model.hf_type == "llava" else None
+    )
+    if vt_ref:
+        from localai_tpu.models.vision import resolve_vision_tower
+
+        vision = resolve_vision_tower(
+            vt_ref,
+            projection_dim=model.cfg.hidden_size,
+            model_path=app.model_path,
+            seed=mcfg.seed or 0,
+        )
+        log.info("loaded vision tower %s: %d patches -> D=%d",
+                 vt_ref, vision.n_patches, model.cfg.hidden_size)
     log.info(
         "loaded model %s (%s) in %.1fs: slots=%d ctx=%d mesh=%s",
         mcfg.name, mcfg.model, time.monotonic() - t0,
@@ -124,6 +143,11 @@ def build_serving_model(mcfg: ModelConfig, app: AppConfig) -> ServingModel:
         scheduler=scheduler,
         tokenizer=model.tokenizer,
         templates=TemplateCache(app.model_path),
+        vision=vision,
+        image_token_id=(
+            mcfg.image_token_id if mcfg.image_token_id is not None
+            else (model.image_token_id or 0)
+        ),
     )
 
 

@@ -54,6 +54,9 @@ class LoadedModel:
     params: Any
     tokenizer: Tokenizer
     ref: str
+    model_dir: Optional[Path] = None   # resolved checkpoint dir (None: debug)
+    hf_type: str = ""                  # config.json model_type ("llava", ...)
+    image_token_id: Optional[int] = None  # HF image_token_index when present
 
 
 def resolve_model(
@@ -77,11 +80,22 @@ def resolve_model(
 
     for cand in (Path(ref), Path(model_path) / ref):
         if (cand / "config.json").exists():
-            from localai_tpu.models.loader import load_llama_params
+            from localai_tpu.models.loader import (
+                load_llama_params,
+                read_hf_config,
+            )
 
-            cfg, params = load_llama_params(cand, dtype=dtype, shard_fn=shard_fn)
+            hf = read_hf_config(cand)
+            cfg, params = load_llama_params(
+                cand, dtype=dtype, shard_fn=shard_fn, hf=hf
+            )
             cfg = dataclasses.replace(cfg, dtype=dtype)
-            return LoadedModel(cfg, params, load_tokenizer(cand), ref)
+            return LoadedModel(
+                cfg, params, load_tokenizer(cand), ref,
+                model_dir=cand,
+                hf_type=hf.get("model_type", ""),
+                image_token_id=hf.get("image_token_index"),
+            )
     raise FileNotFoundError(
         f"model ref {ref!r} not found (looked for config.json under {ref} and "
         f"{Path(model_path) / ref})"

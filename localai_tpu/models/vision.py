@@ -1,0 +1,315 @@
+"""Vision tower: CLIP ViT encoder + LLaVA-style multimodal projector.
+
+The TPU-era replacement for the reference's CLIP/LLaVA image-embedding path
+(clip_image_encode + embedding injection inside the llama.cpp server,
+/root/reference/backend/cpp/llama/grpc-server.cpp:1397-1424, mmproj GGUF
+sidecar loading grpc-server.cpp:2202-2219). Design is functional JAX:
+
+  * patch embedding is a reshape + one matmul (the conv with stride=patch
+    collapses to patchify→GEMM, which is exactly what the MXU wants),
+  * the transformer reuses the CLIP pre-LN blocks from image.clip,
+  * LLaVA semantics: features from hidden layer ``feature_layer`` (default
+    -2, i.e. the penultimate block's output, before post-LN), CLS dropped,
+    then a 2-layer GELU projector into the language model's hidden space.
+
+Ingests HF llava-family checkpoints (vision_tower.vision_model.* +
+multi_modal_projector.*) or a random-weight debug preset for tests.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from pathlib import Path
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from localai_tpu.image.clip import _act, _mha
+from localai_tpu.image.unet import layer_norm
+
+log = logging.getLogger(__name__)
+
+PyTree = Any
+
+# CLIP preprocessing constants (openai/clip-vit-large-patch14)
+CLIP_MEAN = np.array([0.48145466, 0.4578275, 0.40821073], np.float32)
+CLIP_STD = np.array([0.26862954, 0.26130258, 0.27577711], np.float32)
+
+
+@dataclasses.dataclass(frozen=True)
+class VisionConfig:
+    image_size: int = 336
+    patch_size: int = 14
+    hidden_size: int = 1024
+    intermediate_size: int = 4096
+    num_layers: int = 24
+    num_heads: int = 16
+    projection_dim: int = 4096      # language-model hidden size
+    feature_layer: int = -2         # LLaVA vision_feature_layer
+    activation: str = "quick_gelu"
+    dtype: str = "bfloat16"
+
+    @property
+    def n_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+    @classmethod
+    def from_hf(cls, vision_cfg: dict, *, projection_dim: int,
+                feature_layer: int = -2) -> "VisionConfig":
+        return cls(
+            image_size=vision_cfg.get("image_size", 336),
+            patch_size=vision_cfg.get("patch_size", 14),
+            hidden_size=vision_cfg.get("hidden_size", 1024),
+            intermediate_size=vision_cfg.get("intermediate_size", 4096),
+            num_layers=vision_cfg.get("num_hidden_layers", 24),
+            num_heads=vision_cfg.get("num_attention_heads", 16),
+            projection_dim=projection_dim,
+            feature_layer=feature_layer,
+            activation=vision_cfg.get("hidden_act", "quick_gelu"),
+        )
+
+
+DEBUG_PRESETS: dict[str, VisionConfig] = {
+    # tiny ViT for tests: 32px/8px → 16 patch tokens
+    "vit": VisionConfig(
+        image_size=32, patch_size=8, hidden_size=64, intermediate_size=128,
+        num_layers=2, num_heads=4, projection_dim=64, feature_layer=-1,
+    ),
+}
+
+
+def param_shapes(cfg: VisionConfig) -> PyTree:
+    C, I, P = cfg.hidden_size, cfg.intermediate_size, cfg.patch_size
+    D = cfg.projection_dim
+    layer = {
+        "ln1": {"g": (C,), "b": (C,)},
+        "attn": {"wq": (C, C), "bq": (C,), "wk": (C, C), "bk": (C,),
+                 "wv": (C, C), "bv": (C,), "wo": (C, C), "bo": (C,)},
+        "ln2": {"g": (C,), "b": (C,)},
+        "mlp": {"w1": (C, I), "b1": (I,), "w2": (I, C), "b2": (C,)},
+    }
+    return {
+        "patch_embed": (3 * P * P, C),   # flattened conv kernel (c, i, j)
+        "cls": (C,),
+        "pos_emb": (cfg.n_patches + 1, C),
+        "pre_ln": {"g": (C,), "b": (C,)},
+        "layers": [dict(layer) for _ in range(cfg.num_layers)],
+        "projector": {"w1": (C, D), "b1": (D,), "w2": (D, D), "b2": (D,)},
+    }
+
+
+def init_params(rng: jax.Array, cfg: VisionConfig) -> PyTree:
+    shapes = param_shapes(cfg)
+    flat, treedef = jax.tree.flatten(
+        shapes, is_leaf=lambda x: isinstance(x, tuple)
+    )
+    keys = jax.random.split(rng, len(flat))
+    dtype = jnp.dtype(cfg.dtype)
+
+    def mk(k, shape):
+        if len(shape) == 1:
+            return jnp.ones(shape, jnp.float32)
+        return (jax.random.normal(k, shape, jnp.float32) * 0.02).astype(dtype)
+
+    params = jax.tree.unflatten(treedef, [mk(k, s) for k, s in zip(keys, flat)])
+
+    def fix(path, leaf):
+        name = path[-1].key if hasattr(path[-1], "key") else ""
+        if name in ("bq", "bk", "bv", "bo", "b1", "b2", "b", "cls"):
+            return jnp.zeros_like(leaf)
+        return leaf
+
+    return jax.tree_util.tree_map_with_path(fix, params)
+
+
+def patchify(cfg: VisionConfig, images: jax.Array) -> jax.Array:
+    """images [B, H, W, 3] → patch vectors [B, N, 3·P·P] in conv-kernel
+    order (channel, row, col) so the flattened HF conv weight applies."""
+    B, H, W, _ = images.shape
+    P = cfg.patch_size
+    x = images.reshape(B, H // P, P, W // P, P, 3)
+    # → [B, gh, gw, c, pi, pj]
+    x = x.transpose(0, 1, 3, 5, 2, 4)
+    return x.reshape(B, cfg.n_patches, 3 * P * P)
+
+
+def forward(cfg: VisionConfig, params: PyTree, images: jax.Array) -> jax.Array:
+    """images [B, H, W, 3] f32 (CLIP-normalized) → [B, n_patches, D_model].
+
+    LLaVA semantics: stop at ``feature_layer``, drop CLS, project.
+    """
+    dtype = jnp.dtype(cfg.dtype)
+    B = images.shape[0]
+    patches = patchify(cfg, images).astype(dtype)
+    x = patches @ params["patch_embed"].astype(dtype)  # [B, N, C]
+    cls = jnp.broadcast_to(
+        params["cls"].astype(dtype), (B, 1, cfg.hidden_size)
+    )
+    x = jnp.concatenate([cls, x], axis=1) + params["pos_emb"].astype(dtype)
+    x = layer_norm(x, params["pre_ln"])
+
+    n_run = cfg.num_layers + 1 + cfg.feature_layer if cfg.feature_layer < 0 \
+        else cfg.feature_layer
+    zero = jnp.zeros((1, 1, 1), jnp.float32)
+    for lp in params["layers"][:n_run]:
+        x = x + _mha(layer_norm(x, lp["ln1"]), lp["attn"], cfg.num_heads, zero)
+        h = layer_norm(x, lp["ln2"])
+        h = _act(cfg, h @ lp["mlp"]["w1"].astype(h.dtype)
+                 + lp["mlp"]["b1"].astype(h.dtype))
+        x = x + (h @ lp["mlp"]["w2"].astype(h.dtype)
+                 + lp["mlp"]["b2"].astype(h.dtype))
+
+    x = x[:, 1:]  # drop CLS — LLaVA vision_feature_select_strategy='default'
+    pj = params["projector"]
+    h = x @ pj["w1"].astype(x.dtype) + pj["b1"].astype(x.dtype)
+    h = jax.nn.gelu(h)
+    return h @ pj["w2"].astype(h.dtype) + pj["b2"].astype(h.dtype)
+
+
+def preprocess(images: list[np.ndarray], cfg: VisionConfig) -> np.ndarray:
+    """uint8 RGB arrays (any size) → [B, S, S, 3] f32 CLIP-normalized."""
+    out = np.zeros((len(images), cfg.image_size, cfg.image_size, 3),
+                   np.float32)
+    for i, img in enumerate(images):
+        arr = np.asarray(img)
+        if arr.ndim == 2:
+            arr = np.stack([arr] * 3, -1)
+        if arr.shape[2] == 4:
+            arr = arr[..., :3]
+        if arr.shape[:2] != (cfg.image_size, cfg.image_size):
+            from PIL import Image
+
+            pil = Image.fromarray(arr.astype(np.uint8)).resize(
+                (cfg.image_size, cfg.image_size), Image.BICUBIC
+            )
+            arr = np.asarray(pil)
+        out[i] = (arr.astype(np.float32) / 255.0 - CLIP_MEAN) / CLIP_STD
+    return out
+
+
+class VisionTower:
+    """Loaded vision encoder bound to one language model: encodes images
+    into [n_patches, D_model] embedding blocks for prompt injection."""
+
+    def __init__(self, cfg: VisionConfig, params: PyTree):
+        self.cfg = cfg
+        self.params = params
+        self._fwd = jax.jit(lambda p, im: forward(cfg, p, im))
+
+    @property
+    def n_patches(self) -> int:
+        return self.cfg.n_patches
+
+    def encode(self, images: list[np.ndarray]) -> np.ndarray:
+        """List of RGB uint8 arrays → [B, n_patches, D_model] float32."""
+        batch = preprocess(images, self.cfg)
+        out = self._fwd(self.params, jnp.asarray(batch))
+        return np.asarray(out, np.float32)
+
+
+def resolve_vision_tower(
+    ref: str | Path,
+    *,
+    projection_dim: int,
+    model_path: str | Path = "models",
+    seed: int = 0,
+) -> VisionTower:
+    """'debug:<preset>' → random weights; a dir with an HF llava layout →
+    loaded weights (vision_tower.vision_model.* + multi_modal_projector.*)."""
+    ref = str(ref)
+    if ref.startswith("debug:"):
+        name = ref.split(":", 1)[1]
+        if name not in DEBUG_PRESETS:
+            raise ValueError(
+                f"unknown debug vision preset {name!r}; have "
+                f"{sorted(DEBUG_PRESETS)}"
+            )
+        cfg = dataclasses.replace(
+            DEBUG_PRESETS[name], projection_dim=projection_dim
+        )
+        return VisionTower(cfg, init_params(jax.random.key(seed), cfg))
+    for cand in (Path(ref), Path(model_path) / ref):
+        if (cand / "config.json").exists():
+            return load_llava_vision(cand, projection_dim=projection_dim)
+    raise FileNotFoundError(f"vision tower ref {ref!r} not found")
+
+
+def load_llava_vision(model_dir: str | Path, *,
+                      projection_dim: int) -> VisionTower:
+    """Load the vision half of an HF llava checkpoint directory."""
+    import json
+
+    from localai_tpu.models.loader import _get, _open_safetensors
+
+    model_dir = Path(model_dir)
+    with open(model_dir / "config.json") as f:
+        hf = json.load(f)
+    vcfg_dict = hf.get("vision_config") or hf
+    cfg = VisionConfig.from_hf(
+        vcfg_dict,
+        projection_dim=projection_dim,
+        feature_layer=hf.get("vision_feature_layer", -2),
+    )
+    tensors = _open_safetensors(model_dir)
+
+    def has(name: str) -> bool:
+        return name in tensors
+
+    V = "vision_tower.vision_model."
+    if not has(V + "embeddings.patch_embedding.weight"):
+        V = "model." + V  # transformers ≥4.52 nests under model.
+    P = "multi_modal_projector."
+    if not has(P + "linear_1.weight") and has("model." + P + "linear_1.weight"):
+        P = "model." + P
+
+    def g(name: str) -> np.ndarray:
+        return np.asarray(_get(tensors, name), np.float32)
+
+    conv = g(V + "embeddings.patch_embedding.weight")  # [C, 3, p, p]
+    C = conv.shape[0]
+    layers = []
+    for i in range(cfg.num_layers):
+        L = f"{V}encoder.layers.{i}."
+        layers.append({
+            "ln1": {"g": g(L + "layer_norm1.weight"),
+                    "b": g(L + "layer_norm1.bias")},
+            "attn": {
+                "wq": g(L + "self_attn.q_proj.weight").T,
+                "bq": g(L + "self_attn.q_proj.bias"),
+                "wk": g(L + "self_attn.k_proj.weight").T,
+                "bk": g(L + "self_attn.k_proj.bias"),
+                "wv": g(L + "self_attn.v_proj.weight").T,
+                "bv": g(L + "self_attn.v_proj.bias"),
+                "wo": g(L + "self_attn.out_proj.weight").T,
+                "bo": g(L + "self_attn.out_proj.bias"),
+            },
+            "ln2": {"g": g(L + "layer_norm2.weight"),
+                    "b": g(L + "layer_norm2.bias")},
+            "mlp": {"w1": g(L + "mlp.fc1.weight").T,
+                    "b1": g(L + "mlp.fc1.bias"),
+                    "w2": g(L + "mlp.fc2.weight").T,
+                    "b2": g(L + "mlp.fc2.bias")},
+        })
+    dtype = jnp.dtype(cfg.dtype)
+
+    def put(a: np.ndarray, d=dtype) -> jax.Array:
+        return jnp.asarray(a, d)
+
+    params = {
+        "patch_embed": put(conv.reshape(C, -1).T),
+        "cls": put(g(V + "embeddings.class_embedding"), jnp.float32),
+        "pos_emb": put(g(V + "embeddings.position_embedding.weight")),
+        "pre_ln": {"g": put(g(V + "pre_layrnorm.weight"), jnp.float32),
+                   "b": put(g(V + "pre_layrnorm.bias"), jnp.float32)},
+        "layers": jax.tree.map(put, layers),
+        "projector": {
+            "w1": put(g(P + "linear_1.weight").T),
+            "b1": put(g(P + "linear_1.bias")),
+            "w2": put(g(P + "linear_2.weight").T),
+            "b2": put(g(P + "linear_2.bias")),
+        },
+    }
+    return VisionTower(cfg, params)

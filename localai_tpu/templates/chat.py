@@ -218,9 +218,12 @@ def multimodal_placeholders(
     n_images: int = 0,
     n_audio: int = 0,
     n_video: int = 0,
+    first_image_id: int = 0,
 ) -> str:
     """Parity: TemplateMultiModal (/root/reference/pkg/templates/
-    multimodal.go) — inject [img-N]/[audio-N]/[vid-N] placeholders."""
+    multimodal.go) — inject [img-N]/[audio-N]/[vid-N] placeholders.
+    ``first_image_id`` offsets the IDs so multi-message requests keep one
+    global image numbering (chat.go totalImages counter)."""
     from localai_tpu.templates.gotmpl import (
         go_template_to_jinja,
         looks_like_go_template,
@@ -233,7 +236,7 @@ def multimodal_placeholders(
     env = make_environment()
     return env.from_string(src).render(
         Text=text,
-        Images=[{"ID": i} for i in range(n_images)],
+        Images=[{"ID": first_image_id + i} for i in range(n_images)],
         Audio=[{"ID": i} for i in range(n_audio)],
         Video=[{"ID": i} for i in range(n_video)],
     )

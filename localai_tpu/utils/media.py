@@ -1,0 +1,72 @@
+"""Media ingestion for multimodal chat: image_url parts → RGB arrays.
+
+Parity: GetImageURLAsBase64 (/root/reference/pkg/utils/base64.go:18-60) —
+accepts http(s) URLs, data URIs, and raw base64 payloads. Decoding uses
+PIL; outputs are uint8 RGB numpy arrays ready for the vision tower's
+preprocess (models/vision.py).
+"""
+
+from __future__ import annotations
+
+import base64
+import binascii
+import io
+import logging
+import re
+
+import numpy as np
+
+log = logging.getLogger(__name__)
+
+MAX_IMAGE_BYTES = 32 * 1024 * 1024
+_DATA_URI = re.compile(r"^data:[a-zA-Z0-9.+/-]+;base64,(?P<b64>.+)$", re.S)
+
+
+class MediaError(ValueError):
+    """Raised when an image reference cannot be fetched or decoded."""
+
+
+def fetch_image_bytes(ref: str, *, timeout: float = 30.0) -> bytes:
+    """image_url string → raw encoded bytes (base64.go:18-60 semantics:
+    http(s) fetch, data-URI strip, or raw base64 decode)."""
+    ref = ref.strip()
+    m = _DATA_URI.match(ref)
+    if m:
+        try:
+            return base64.b64decode(m.group("b64"), validate=False)
+        except (binascii.Error, ValueError) as e:
+            raise MediaError(f"invalid base64 data URI: {e}") from e
+    if ref.startswith(("http://", "https://")):
+        import urllib.request
+
+        req = urllib.request.Request(ref, headers={"User-Agent": "localai-tpu"})
+        try:
+            with urllib.request.urlopen(req, timeout=timeout) as resp:
+                data = resp.read(MAX_IMAGE_BYTES + 1)
+        except Exception as e:  # noqa: BLE001 — network errors → request error
+            raise MediaError(f"failed to fetch image URL: {e}") from e
+        if len(data) > MAX_IMAGE_BYTES:
+            raise MediaError("image exceeds size limit")
+        return data
+    # raw base64 (no scheme, no data: header)
+    try:
+        return base64.b64decode(ref, validate=True)
+    except (binascii.Error, ValueError) as e:
+        raise MediaError(
+            "image_url is neither an http(s) URL, data URI, nor base64"
+        ) from e
+
+
+def decode_image(data: bytes) -> np.ndarray:
+    """Encoded image bytes → RGB uint8 array [H, W, 3]."""
+    from PIL import Image
+
+    try:
+        img = Image.open(io.BytesIO(data)).convert("RGB")
+    except Exception as e:  # noqa: BLE001 — corrupt images → request error
+        raise MediaError(f"cannot decode image: {e}") from e
+    return np.asarray(img, np.uint8)
+
+
+def fetch_image(ref: str, *, timeout: float = 30.0) -> np.ndarray:
+    return decode_image(fetch_image_bytes(ref, timeout=timeout))

@@ -69,6 +69,49 @@ def test_greedy_decode_parity_int8_weights_and_kv(small):
     assert t_bf == t_q
 
 
+def test_w8a8_greedy_parity(small):
+    """The native-int8-dot mode (dynamic activation quant) must track bf16
+    greedy decode on the debug model."""
+    prompt = list(range(1, 60))
+    r_bf = ModelRunner(small.cfg, small.params, num_slots=2, max_ctx=256,
+                       prefill_buckets=[64])
+    qp = quantize_params(small.params, "int8_w8a8")
+    assert qp["layers"]["wq"].mode == "w8a8"
+    r_q = ModelRunner(small.cfg, qp, num_slots=2, max_ctx=256,
+                      prefill_buckets=[64], kv_dtype="int8")
+    s_bf, s_q = r_bf.acquire_slot(), r_q.acquire_slot()
+    a = [r_bf.admit(s_bf, prompt, temperature=0.0)]
+    b = [r_q.admit(s_q, prompt, temperature=0.0)]
+    for _ in range(12):
+        a.append(int(r_bf.step()[s_bf]))
+        b.append(int(r_q.step()[s_q]))
+    assert a == b
+
+
+def test_w8a8_matmul_numerics():
+    """Direct check of the int8×int8 dot + dual-scale epilogue against the
+    f32 reference, including the transposed (tied lm_head) path."""
+    import jax
+    import jax.numpy as jnp
+
+    from localai_tpu.models.quant import matmul, matmul_t, quantize_tensor
+
+    k = jax.random.key(0)
+    x = jax.random.normal(k, (4, 64), jnp.float32)
+    w = jax.random.normal(jax.random.key(1), (64, 32), jnp.float32)
+    qt = dataclasses.replace(quantize_tensor(w, axis=0), mode="w8a8")
+    ref = np.asarray(x @ w)
+    got = np.asarray(matmul(x, qt), np.float32)
+    # per-channel weight + per-token activation int8: ~1% relative error
+    assert np.abs(got - ref).max() / np.abs(ref).max() < 0.02
+
+    wt = jax.random.normal(jax.random.key(2), (32, 64), jnp.float32)
+    qtt = dataclasses.replace(quantize_tensor(wt, axis=1), mode="w8a8")
+    ref_t = np.asarray(x @ wt.T)
+    got_t = np.asarray(matmul_t(x, qtt), np.float32)
+    assert np.abs(got_t - ref_t).max() / np.abs(ref_t).max() < 0.02
+
+
 def test_int8_kv_cache_is_scaled_not_cast(small):
     """The int8 KV path stores real scales — a raw dtype cast would zero
     out sub-unit activations and diverge immediately."""
