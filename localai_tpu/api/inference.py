@@ -76,9 +76,14 @@ def prepare_multimodal(
             sm.name, len(refs),
         )
         return messages, None
+    from concurrent.futures import ThreadPoolExecutor
+
     from localai_tpu.utils.media import fetch_image
 
-    images = [fetch_image(r) for r in refs]
+    # fetch concurrently: latency bounds to the slowest single image, not
+    # the sum over refs (remote URLs each carry a 30s timeout)
+    with ThreadPoolExecutor(max_workers=min(8, len(refs))) as pool:
+        images = list(pool.map(fetch_image, refs))
     return messages, sm.vision.encode(images)
 
 

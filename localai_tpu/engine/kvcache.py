@@ -163,6 +163,62 @@ def prefill_write(slot: jax.Array, offset: jax.Array):
     return write
 
 
+def resume_write(slot: jax.Array, offset: jax.Array):
+    """KV write policy for suffix prefill into a slot that keeps a reused
+    prefix (KV prefix-cache reuse; parity: llama.cpp ``common_part`` +
+    slot cache_tokens, /root/reference/backend/cpp/llama/grpc-server.cpp:
+    67-74,1651-1668).
+
+    Writes the chunk [1, T, H, hd] at cache[slot, :, offset:offset+T] like
+    prefill_write, but exposes the slot's FULL cache row as keys
+    ([1, H, C, hd]) so the new tokens attend over the kept prefix."""
+
+    def write(layer_kv, k_new, v_new):
+        k_hm = k_new.transpose(0, 2, 1, 3)  # [1, H, T, hd]
+        v_hm = v_new.transpose(0, 2, 1, 3)
+        zero = jnp.zeros((), jnp.int32)
+        idx = (slot, zero, offset, zero)
+        dt = k_new.dtype
+
+        def row(cache, scales=None):
+            r = lax.dynamic_index_in_dim(cache, slot, 0, keepdims=True)
+            if scales is None:
+                return r.astype(dt)
+            s = lax.dynamic_index_in_dim(scales, slot, 0, keepdims=True)
+            return r.astype(dt) * s[..., None].astype(dt)
+
+        if len(layer_kv) == 4:  # scaled int8 cache
+            k_layer, v_layer, ks_layer, vs_layer = layer_kv
+            kq, ks = _quant_chunk(k_hm)
+            vq, vs = _quant_chunk(v_hm)
+            new_k = lax.dynamic_update_slice(k_layer, kq, idx)
+            new_v = lax.dynamic_update_slice(v_layer, vq, idx)
+            new_ks = lax.dynamic_update_slice(ks_layer, ks, (slot, zero, offset))
+            new_vs = lax.dynamic_update_slice(vs_layer, vs, (slot, zero, offset))
+            return ((new_k, new_v, new_ks, new_vs),
+                    row(new_k, new_ks), row(new_v, new_vs))
+        k_layer, v_layer = layer_kv
+        kdt = k_layer.dtype
+        new_k = lax.dynamic_update_slice(k_layer, k_hm.astype(kdt), idx)
+        new_v = lax.dynamic_update_slice(v_layer, v_hm.astype(kdt), idx)
+        return (new_k, new_v), row(new_k), row(new_v)
+
+    return write
+
+
+def resume_mask(cfg: LlamaConfig, seq_len: int, length: jax.Array,
+                offset: jax.Array, max_ctx: int) -> jax.Array:
+    """[1, T, C] mask for suffix prefill: chunk token t (absolute position
+    offset+t) attends causally over the kept prefix + the chunk."""
+    t = jnp.arange(seq_len)[None, :, None]
+    c = jnp.arange(max_ctx)[None, None, :]
+    pos = offset + t
+    m = c <= pos
+    if cfg.sliding_window:
+        m &= c > pos - cfg.sliding_window
+    return m
+
+
 def decode_mask(cfg: LlamaConfig, positions: jax.Array, max_ctx: int) -> jax.Array:
     """[S, 1, C] attention mask for decode: attend to all written positions
     (≤ current), optionally sliding-window limited (Mistral-style)."""

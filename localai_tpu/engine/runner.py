@@ -179,7 +179,16 @@ class ModelRunner:
             self._prefill_mm_fn, static_argnames=("bucket",),
             donate_argnums=(1, 2),
         )
+        self._prefill_resume = jax.jit(
+            self._prefill_resume_fn, static_argnames=("bucket",),
+            donate_argnums=(1, 2),
+        )
         self._embed = jax.jit(self._embed_fn, static_argnames=("bucket",))
+        # KV prefix reuse (parity: common_part, grpc-server.cpp:67-74):
+        # suffix prefill only pays off past a minimum shared prefix
+        self.prefix_reuse_min = 16
+        self.last_prefix_reused = 0       # tokens reused by the last admit
+        self.total_prefix_reused = 0
 
     # -- jitted programs -------------------------------------------------
 
@@ -310,6 +319,43 @@ class ModelRunner:
             params, kv, state, tokens, length, slot, bucket=bucket, embeds=x
         )
 
+    def _prefill_resume_fn(self, params, kv: KVCache, state: DecodeState,
+                           tokens, length, offset, slot, counts_row,
+                           *, bucket: int):
+        """Suffix prefill: the slot keeps ``offset`` tokens of reused prefix
+        KV; only the tail chunk is computed, attending over prefix + chunk
+        (XLA path — keys span the full cache row, which the fresh-chunk
+        Pallas prefill kernel does not model). ``counts_row`` [V] i32 is the
+        host-side bincount of the FULL prompt (the in-program count would
+        only see the tail); it rides this dispatch so resume stays a single
+        program launch."""
+        cfg = self.cfg
+        positions = offset + jnp.arange(bucket, dtype=jnp.int32)[None, :]
+        mask = kvc.resume_mask(cfg, bucket, length, offset, self.max_ctx)
+        write = kvc.resume_write(slot, offset)
+        hidden, new_stack = mdl.forward(
+            cfg, params, tokens, positions, write, kv.stacked(), mask,
+            self.rope,
+        )
+        last_h = jax.lax.dynamic_index_in_dim(hidden[0], length - 1,
+                                              keepdims=True)
+        logits = mdl.logits_from_hidden(cfg, params, last_h)  # [1, V]
+        counts = state.counts.at[slot].set(counts_row)
+        slot_params = jax.tree.map(lambda a: a[slot][None], state.params)
+        tok, new_key = smp.sample(
+            logits, slot_params, counts[slot][None],
+            state.keys[slot][None], state.bias[slot][None],
+        )
+        new_state = dataclasses.replace(
+            state,
+            tokens=state.tokens.at[slot].set(tok[0]),
+            positions=state.positions.at[slot].set(offset + length),
+            active=state.active.at[slot].set(True),
+            keys=state.keys.at[slot].set(new_key[0]),
+            counts=counts,
+        )
+        return KVCache.from_stacked(new_stack), new_state, tok[0]
+
     def _embed_fn(self, params, tokens, length, *, bucket: int):
         """Mean-pooled final hidden state over the real tokens — the LLM
         embeddings path (parity: llama.cpp embeddings mode behind the
@@ -364,8 +410,18 @@ class ModelRunner:
             f"prompt length {n} exceeds max prefill bucket {self.buckets[-1]}"
         )
 
-    def acquire_slot(self) -> Optional[int]:
-        return self._free_slots.pop(0) if self._free_slots else None
+    def acquire_slot(self, slot: Optional[int] = None) -> Optional[int]:
+        """Claim a free slot — FIFO by default, or a specific free slot
+        (the scheduler targets the slot with the longest reusable prefix)."""
+        if not self._free_slots:
+            return None
+        if slot is not None and slot in self._free_slots:
+            self._free_slots.remove(slot)
+            return slot
+        return self._free_slots.pop(0)
+
+    def free_slots(self) -> list[int]:
+        return list(self._free_slots)
 
     def admit(
         self,
@@ -384,8 +440,14 @@ class ModelRunner:
         bias_row: Optional[np.ndarray] = None,
         mm_embeds: Optional[np.ndarray] = None,    # [n_mm, D] image embeds
         mm_positions: Optional[np.ndarray] = None,  # [n_mm] prompt positions
+        resident: Optional[list[int]] = None,       # slot's previous tokens
+                                                    # (enables prefix reuse)
     ) -> int:
-        """Prefill a prompt into a slot; returns the first sampled token."""
+        """Prefill a prompt into a slot; returns the first sampled token.
+
+        When ``resident`` is given and shares a long-enough prefix with the
+        prompt, the prefix KV is kept and only the tail is prefilled
+        (parity: llama.cpp common_part slot reuse, grpc-server.cpp:67-74)."""
         if not prompt:
             prompt = [0]
         n = len(prompt)
@@ -393,9 +455,16 @@ class ModelRunner:
             # context-exhaustion policy parity (grpc-server.cpp:1573-1592):
             # reject rather than silently shift context.
             raise ValueError(f"prompt ({n} tokens) exceeds context {self.max_ctx}")
-        bucket = self.bucket_for(n)
+        lcp = 0
+        if resident and mm_embeds is None:
+            lcp = self.reusable_prefix(slot, resident, prompt)
+        self.last_prefix_reused = lcp
+        self.total_prefix_reused += lcp
+        tail = prompt[lcp:]
+        bucket = (self._resume_bucket(len(tail), lcp) if lcp
+                  else self.bucket_for(n))
         padded = np.zeros((1, bucket), np.int32)
-        padded[0, :n] = prompt
+        padded[0, : len(tail)] = tail
         self.state = dataclasses.replace(
             self.state,
             params=self.state.params.with_slot(
@@ -423,7 +492,16 @@ class ModelRunner:
                 if 0 <= int(tid) < self.cfg.vocab_size:
                     row[int(tid)] += b
         self.set_bias(slot, row)
-        if mm_embeds is not None and len(mm_embeds):
+        if lcp:
+            crow = np.zeros(self.cfg.vocab_size, np.int32)
+            ids = np.asarray(prompt, np.int64)
+            np.add.at(crow, ids[(ids >= 0) & (ids < self.cfg.vocab_size)], 1)
+            self.kv, self.state, tok = self._prefill_resume(
+                self.params, self.kv, self.state,
+                jnp.asarray(padded), jnp.int32(len(tail)), jnp.int32(lcp),
+                jnp.int32(slot), jnp.asarray(crow), bucket=bucket,
+            )
+        elif mm_embeds is not None and len(mm_embeds):
             self.kv, self.state, tok = self._prefill_mm(
                 self.params, self.kv, self.state,
                 jnp.asarray(padded), jnp.int32(n), jnp.int32(slot),
@@ -438,6 +516,40 @@ class ModelRunner:
                 bucket=bucket,
             )
         return int(tok)
+
+    def reusable_prefix(self, slot: int, resident: Optional[list[int]],
+                        prompt: list[int]) -> int:
+        """Tokens of ``resident`` (the slot's previous prompt+generation)
+        that admit() would actually reuse for ``prompt`` — all feasibility
+        gates applied: KV-validity clipping (the last sampled token's KV is
+        never written), last-token recompute, minimum worthwhile length,
+        and the tail bucket fitting inside the context. The scheduler ranks
+        candidate slots with this same function so its choice can't
+        collapse to zero at admit time."""
+        if not resident or not prompt:
+            return 0
+        valid = resident[: self.slot_position(slot)]
+        lcp = 0
+        for a, b in zip(valid, prompt):
+            if a != b:
+                break
+            lcp += 1
+        # always recompute at least the last token (its logits seed sampling)
+        lcp = min(lcp, len(prompt) - 1)
+        if lcp < self.prefix_reuse_min:
+            return 0
+        if self._resume_bucket(len(prompt) - lcp, lcp) is None:
+            return 0
+        return lcp
+
+    def _resume_bucket(self, tail_len: int, offset: int) -> Optional[int]:
+        """Smallest prefill bucket holding the tail that also fits in the
+        cache past the kept prefix (dynamic_update_slice clamps start
+        indices, so an overhanging bucket would silently shift the write)."""
+        for b in self.buckets:
+            if tail_len <= b and offset + b <= self.max_ctx:
+                return b
+        return None
 
     def step(self) -> np.ndarray:
         """One decode iteration over all slots; returns sampled tokens [S]."""

@@ -192,6 +192,8 @@ class Scheduler:
         self._stopping = False
         self._lock = threading.Lock()
         self._dispatch_seq = 0
+        # per-slot resident tokens (prompt + generated) for KV prefix reuse
+        self._resident: dict[int, list[int]] = {}
         # lifetime metrics (GetMetrics parity)
         self.total_prompt_tokens = 0
         self.total_generated_tokens = 0
@@ -235,6 +237,7 @@ class Scheduler:
             "queue_depth": self._pending.qsize(),
             "total_prompt_tokens": self.total_prompt_tokens,
             "total_generated_tokens": self.total_generated_tokens,
+            "prefix_tokens_reused": self.runner.total_prefix_reused,
         }
 
     def shutdown(self, timeout: float = 10.0) -> None:
@@ -329,19 +332,22 @@ class Scheduler:
 
     def _admit_pending(self) -> bool:
         admitted = False
-        while True:
-            slot = self.runner.acquire_slot()
-            if slot is None:
-                return admitted
+        while self.runner.free_slots():
             try:
                 handle = self._pending.get_nowait()
             except queue.Empty:
-                self.runner.release(slot)
                 return admitted
             if handle.cancelled:
                 handle._finish("cancelled")
-                self.runner.release(slot)
                 continue
+            # prefer the free slot whose resident tokens share the longest
+            # prefix with this prompt (KV prefix-cache reuse)
+            slot = self.runner.acquire_slot(
+                self._best_slot(handle.request.prompt)
+            )
+            if slot is None:
+                handle._finish("error")
+                return admitted
             try:
                 self._start(slot, handle)
                 admitted = True
@@ -371,6 +377,7 @@ class Scheduler:
         first = self.runner.admit(
             slot,
             req.prompt,
+            resident=self._resident.get(slot),
             temperature=req.temperature,
             top_k=req.top_k,
             top_p=req.top_p,
@@ -382,6 +389,14 @@ class Scheduler:
             bias_row=self._compose_bias(base, mask),
             mm_embeds=req.mm_embeds,
             mm_positions=req.mm_positions,
+        )
+        # multimodal KV mixes injected embeddings with token ids, so the
+        # token record alone can't prove prefix equality — never reuse it.
+        # Mirror the runner's empty-prompt normalization ([0]) so the
+        # record stays aligned with the cache rows.
+        self._resident[slot] = (
+            None if req.mm_embeds is not None
+            else list(req.prompt) or [0]
         )
         ctx = _SlotCtx(
             handle=handle,
@@ -395,6 +410,20 @@ class Scheduler:
             self._slots[slot] = ctx
             self.total_prompt_tokens += handle.prompt_tokens
         self._consume(slot, ctx, int(first))
+
+    def _best_slot(self, prompt: list[int]) -> Optional[int]:
+        """Free slot with the longest reusable token prefix (None → FIFO).
+        Uses the runner's own feasibility gates so the ranking can't pick a
+        slot whose reuse collapses to zero at admit time."""
+        best, best_lcp = None, 0
+        for s in self.runner.free_slots():
+            r = self._resident.get(s)
+            if not r:
+                continue
+            lcp = self.runner.reusable_prefix(s, r, prompt)
+            if lcp > best_lcp:
+                best, best_lcp = s, lcp
+        return best
 
     def _padded_vocab_ban(self) -> Optional[np.ndarray]:
         """Standing bias banning ids the tokenizer cannot produce or decode.
@@ -460,6 +489,9 @@ class Scheduler:
 
     def _consume(self, slot: int, ctx: _SlotCtx, token_id: int) -> None:
         """Handle one sampled token for one slot: stream, stop, constrain."""
+        r = self._resident.get(slot)
+        if r is not None:
+            r.append(token_id)
         handle = ctx.handle
         req = handle.request
         if handle.cancelled:
