@@ -43,6 +43,9 @@ class AppConfig:
     parallel_requests: bool = True
     single_active_backend: bool = False
     external_backends: dict[str, str] = field(default_factory=dict)
+    worker_env: dict[str, str] = field(default_factory=dict)  # extra env for
+                                                # spawned worker processes
+                                                # (e.g. device pinning)
 
     # watchdog (parity: run.go:66-69 defaults 5m busy / 15m idle)
     watchdog_idle: bool = False

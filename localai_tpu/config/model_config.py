@@ -297,7 +297,7 @@ class ModelConfig(BaseModel):
         name = (self.backend or "").lower()
         if self.embeddings or "embed" in name:
             guessed.add(Usecase.EMBEDDINGS)
-        if name in ("", "jax", "jax-llm", "transformers"):
+        if name in ("", "jax", "jax-llm", "transformers", "worker"):
             guessed |= {
                 Usecase.CHAT,
                 Usecase.COMPLETION,

@@ -206,10 +206,13 @@ def resume_write(slot: jax.Array, offset: jax.Array):
     return write
 
 
-def resume_mask(cfg: LlamaConfig, seq_len: int, length: jax.Array,
+def resume_mask(cfg: LlamaConfig, seq_len: int,
                 offset: jax.Array, max_ctx: int) -> jax.Array:
     """[1, T, C] mask for suffix prefill: chunk token t (absolute position
-    offset+t) attends causally over the kept prefix + the chunk."""
+    offset+t) attends causally over the kept prefix + the chunk. Padding
+    rows (t ≥ tail length) write garbage KV beyond the sequence, exactly
+    like prefill_mask — those positions are overwritten by later decode
+    steps before anything can attend to them."""
     t = jnp.arange(seq_len)[None, :, None]
     c = jnp.arange(max_ctx)[None, None, :]
     pos = offset + t

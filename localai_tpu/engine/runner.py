@@ -331,7 +331,7 @@ class ModelRunner:
         program launch."""
         cfg = self.cfg
         positions = offset + jnp.arange(bucket, dtype=jnp.int32)[None, :]
-        mask = kvc.resume_mask(cfg, bucket, length, offset, self.max_ctx)
+        mask = kvc.resume_mask(cfg, bucket, offset, self.max_ctx)
         write = kvc.resume_write(slot, offset)
         hidden, new_stack = mdl.forward(
             cfg, params, tokens, positions, write, kv.stacked(), mask,

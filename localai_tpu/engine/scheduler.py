@@ -341,13 +341,13 @@ class Scheduler:
                 handle._finish("cancelled")
                 continue
             # prefer the free slot whose resident tokens share the longest
-            # prefix with this prompt (KV prefix-cache reuse)
+            # prefix with this prompt (KV prefix-cache reuse); the loop
+            # guard guarantees a free slot exists (slot lists are mutated
+            # only on this thread)
             slot = self.runner.acquire_slot(
                 self._best_slot(handle.request.prompt)
             )
-            if slot is None:
-                handle._finish("error")
-                return admitted
+            assert slot is not None
             try:
                 self._start(slot, handle)
                 admitted = True

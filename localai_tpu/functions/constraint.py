@@ -261,7 +261,11 @@ def cached_dfa(pattern: str) -> DFA:
 
 
 def constraint_for_regex(pattern: str, tokenizer: Any) -> FSMConstraint:
-    return FSMConstraint(cached_dfa(pattern), tokenizer)
+    c = FSMConstraint(cached_dfa(pattern), tokenizer)
+    # retained so worker-backed serving can ship the constraint over the
+    # wire (PredictOptions.constraint_regex) and rebuild the FSM remotely
+    c.source_regex = pattern
+    return c
 
 
 def constraint_for_schema(schema: dict, tokenizer: Any, *,

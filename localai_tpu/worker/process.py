@@ -225,10 +225,19 @@ class WorkerPool:
 
     def register_external(self, name: str, address: str) -> WorkerClient:
         """An externally managed worker speaking the same proto (parity:
-        external gRPC backends, initializers.go externalBackends)."""
+        external gRPC backends, initializers.go externalBackends).
+        Idempotent: re-registering the same name+address reuses the
+        existing channel."""
+        with self._lock:
+            ext = self._external.get(name)
+            if ext is not None and ext.address == address:
+                return ext
         client = WorkerClient(address, watchdog=self._watchdog)
         with self._lock:
             self._external[name] = client
+        # the displaced client (address change) is deliberately NOT closed:
+        # another thread may be mid-stream on it; the channel is reclaimed
+        # when its last in-flight RPC finishes and the object is collected
         return client
 
     def get(self, name: str, *, env: Optional[dict] = None) -> WorkerClient:

@@ -1,0 +1,251 @@
+"""Worker-backed serving: the ServingModel facade over a spawned gRPC
+worker process.
+
+This delivers the reference's central lifecycle property — a model crash
+never takes down the API server (/root/reference/pkg/model/
+initializers.go:271-407: spawn, health-gate, LoadModel over gRPC;
+loader.go:170-206: health-check-and-respawn) — for models configured with
+``backend: worker`` or registered in ``external_backends``.
+
+The facade presents the same surface the HTTP endpoints use on the
+in-process ServingModel (tokenizer/templates locally, ``scheduler.submit``
+returning a GenHandle), but the engine runs in its own process; prompts go
+over the wire as token ids and constraints as their source regex
+(PredictOptions.constraint_regex — the worker rebuilds the FSM against the
+same tokenizer).
+"""
+
+from __future__ import annotations
+
+import itertools
+import logging
+import threading
+import time
+from typing import Any, Optional
+
+from localai_tpu.config.app_config import AppConfig
+from localai_tpu.config.model_config import ModelConfig
+from localai_tpu.engine.scheduler import GenHandle, GenRequest
+from localai_tpu.worker import backend_pb2 as pb
+from localai_tpu.worker.client import WorkerClient
+
+log = logging.getLogger(__name__)
+
+_SAMPLING_FIELDS = (
+    "temperature", "top_k", "top_p", "min_p",
+    "repeat_penalty", "presence_penalty", "frequency_penalty", "seed",
+)
+
+
+class WorkerGenHandle(GenHandle):
+    """GenHandle fed from a PredictStream RPC instead of the engine thread.
+    Token ids don't cross the wire, so completion counts come from the
+    final Reply's usage fields."""
+
+    def __init__(self, req: GenRequest, rid: int):
+        super().__init__(req, rid)
+        self._completion_override: Optional[int] = None
+
+    @property
+    def completion_tokens(self) -> int:
+        if self._completion_override is not None:
+            return self._completion_override
+        return len(self.token_ids)
+
+
+def predict_options(gr: GenRequest) -> pb.PredictOptions:
+    """GenRequest → wire options (inverse of worker.server._gen_request)."""
+    opts = pb.PredictOptions(
+        tokens=list(gr.prompt),
+        max_tokens=gr.max_new_tokens,
+        stop=list(gr.stop),
+        ignore_eos=gr.ignore_eos,
+        correlation_id=gr.correlation_id,
+    )
+    for f in _SAMPLING_FIELDS:
+        v = getattr(gr, f)
+        if v is not None:
+            setattr(opts, f, v)
+    if gr.logit_bias:
+        for k, v in gr.logit_bias.items():
+            opts.logit_bias[int(k)] = float(v)
+    if gr.constraint is not None:
+        regex = getattr(gr.constraint, "source_regex", None)
+        if regex:
+            opts.constraint_regex = regex
+        else:
+            log.warning(
+                "constraint without a serializable source regex; the "
+                "worker will decode unconstrained"
+            )
+    return opts
+
+
+class WorkerScheduler:
+    """The scheduler-shaped surface of a worker-backed model: submit() runs
+    a PredictStream RPC on a daemon thread feeding a GenHandle."""
+
+    def __init__(self, owner: "WorkerServingModel"):
+        self._owner = owner
+        self._ids = itertools.count()
+        self._inflight = 0
+        self._lock = threading.Lock()
+
+    @property
+    def busy(self) -> bool:
+        with self._lock:
+            return self._inflight > 0
+
+    def submit(self, gr: GenRequest) -> GenHandle:
+        handle = WorkerGenHandle(gr, next(self._ids))
+        if gr.mm_embeds is not None:
+            # image embeddings don't cross the proto yet; fail loudly
+            # rather than silently serving text-only
+            handle._finish("error")
+            log.error("worker-backed models do not support multimodal input")
+            return handle
+        # mark busy before the thread starts: an eviction sweep between
+        # submit() and the thread's first instruction must not kill the
+        # worker under an accepted request
+        with self._lock:
+            self._inflight += 1
+        threading.Thread(
+            target=self._run, args=(handle,), daemon=True,
+            name=f"worker-req-{handle.id}",
+        ).start()
+        return handle
+
+    def _run(self, handle: WorkerGenHandle) -> None:
+        try:
+            client = self._owner.client()
+            opts = predict_options(handle.request)
+            finish = "stop"
+            for reply in client.predict_stream(opts, timeout=600.0):
+                if handle.cancelled:
+                    finish = "cancelled"
+                    break
+                if reply.finish_reason:
+                    finish = reply.finish_reason
+                    handle._completion_override = reply.tokens or None
+                    if reply.prompt_tokens:
+                        handle.prompt_tokens = reply.prompt_tokens
+                    break
+                if reply.message:
+                    handle._emit(reply.message.decode("utf-8", "replace"),
+                                 None)
+            handle._finish(finish)
+        except Exception as e:  # noqa: BLE001 — worker crash ≠ API crash
+            log.warning("worker request %d failed: %s", handle.id, e)
+            handle._finish("error")
+        finally:
+            with self._lock:
+                self._inflight -= 1
+
+    def metrics(self) -> dict:
+        try:
+            return self._owner.client().metrics()
+        except Exception as e:  # noqa: BLE001
+            return {"error": str(e)}
+
+    def shutdown(self, timeout: float = 10.0) -> None:
+        self._owner.close()
+
+
+class WorkerServingModel:
+    """ServingModel counterpart whose engine lives in a worker process.
+
+    Tokenization/templating stay local (the reference templates in Go while
+    llama.cpp owns the weights); generation RPCs go to the worker. The
+    pool health-checks and respawns on access, and ensure_loaded() re-issues
+    LoadModel after any respawn."""
+
+    def __init__(self, mcfg: ModelConfig, app: AppConfig, pool,
+                 *, external_address: Optional[str] = None):
+        from localai_tpu.models.registry import resolve_tokenizer
+        from localai_tpu.templates.cache import TemplateCache
+
+        self.name = mcfg.name
+        self.config = mcfg
+        self.app = app
+        self.pool = pool
+        self.external_address = external_address
+        self.tokenizer = resolve_tokenizer(
+            mcfg.model or mcfg.name, app.model_path
+        )
+        self.templates = TemplateCache(app.model_path)
+        self.vision = None
+        self.image_token_id = 0
+        if mcfg.mmproj:
+            log.warning(
+                "model %s: mmproj is not supported on worker-backed models "
+                "yet; images will be ignored", mcfg.name,
+            )
+        self.scheduler = WorkerScheduler(self)
+        self.loaded_at = time.monotonic()
+        self.last_used = time.monotonic()
+        self._client_lock = threading.Lock()
+        self._loaded_client: Optional[WorkerClient] = None
+        self.client()  # spawn + load eagerly so config errors surface now
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def client(self) -> WorkerClient:
+        """Healthy client for this model's worker: spawns/respawns via the
+        pool and guarantees the model is loaded (a respawned process comes
+        up empty)."""
+        with self._client_lock:
+            if self.external_address is not None:
+                c = self.pool.register_external(self.name,
+                                                self.external_address)
+            else:
+                c = self.pool.get(self.name, env=self.app.worker_env or None)
+            # the pool hands back the same client object while the worker
+            # stays healthy; a new object means a respawn (empty process) —
+            # only then pay the Status round trip + LoadModel
+            if c is not self._loaded_client:
+                self._ensure_loaded(c)
+                self._loaded_client = c
+            return c
+
+    def _ensure_loaded(self, c: WorkerClient) -> None:
+        st = c.status()
+        if st.state in (pb.StatusResponse.READY, pb.StatusResponse.BUSY):
+            return
+        import yaml
+
+        doc = self.config.model_dump(exclude_none=True, exclude_defaults=True)
+        doc["name"] = self.config.name
+        doc["model"] = self.config.model or self.config.name
+        doc.pop("backend", None)  # the worker itself runs in-process
+        res = c.load_model(
+            config_yaml=yaml.safe_dump(doc),
+            model_path=str(self.app.model_path),
+        )
+        if not res.success:
+            raise RuntimeError(
+                f"worker LoadModel failed for {self.name}: {res.message}"
+            )
+
+    def touch(self) -> None:
+        self.last_used = time.monotonic()
+
+    @property
+    def busy(self) -> bool:
+        return self.scheduler.busy
+
+    def alive(self) -> bool:
+        try:
+            if self.external_address is not None:
+                return self.pool.register_external(
+                    self.name, self.external_address
+                ).health()
+            wp = self.pool._workers.get(self.name)
+            return wp is not None and wp.healthy()
+        except Exception:  # noqa: BLE001
+            return False
+
+    def engine_metrics(self) -> dict:
+        return self.scheduler.metrics()
+
+    def close(self) -> None:
+        self.pool.shutdown(self.name)
