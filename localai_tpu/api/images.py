@@ -126,37 +126,42 @@ async def generations(request: web.Request) -> web.Response:
     sm = await oai._in_executor(request, _image_model, state, req.model)
 
     items = []
-    for prompt in prompts:
-        pos, _, neg = (prompt or "").partition("|")
-        for j in range(n):
-            # distinct images per copy: offset the seed like a new draw
-            s = None if seed is None else int(seed) + j
-            result = await oai._in_executor(
-                request,
-                lambda: sm.generate(
-                    pos, negative_prompt=neg, width=width, height=height,
-                    steps=steps or None, seed=s, init_image=init,
-                ),
-            )
-            img = result.image
-            if img.shape[:2] != (height, width):
-                # the pipeline buckets latent sizes to 64-multiples; return
-                # exactly what the client asked for
-                from PIL import Image
-
-                img = np.asarray(
-                    Image.fromarray(img).resize((width, height)), np.uint8
+    with sm.in_use():  # busy across the whole batch: no eviction mid-request
+        for prompt in prompts:
+            pos, _, neg = (prompt or "").partition("|")
+            for j in range(n):
+                # distinct images per copy: offset the seed like a new draw
+                s = None if seed is None else int(seed) + j
+                result = await oai._in_executor(
+                    request,
+                    lambda: sm.generate(
+                        pos, negative_prompt=neg, width=width, height=height,
+                        steps=steps or None, seed=s, init_image=init,
+                    ),
                 )
-            png = _encode_png(img)
-            if b64:
-                items.append({"b64_json": base64.b64encode(png).decode()})
-            else:
-                name = f"{uuid.uuid4().hex}.png"
-                out = Path(state.config.image_path)
-                out.mkdir(parents=True, exist_ok=True)
-                (out / name).write_bytes(png)
-                base = f"{request.scheme}://{request.host}"
-                items.append({"url": f"{base}/generated-images/{name}"})
+                img = result.image
+                if img.shape[:2] != (height, width):
+                    # the pipeline buckets latent sizes to 64-multiples;
+                    # return exactly what the client asked for
+                    from PIL import Image
+
+                    img = np.asarray(
+                        Image.fromarray(img).resize((width, height)), np.uint8
+                    )
+                png = _encode_png(img)
+                if b64:
+                    items.append(
+                        {"b64_json": base64.b64encode(png).decode()}
+                    )
+                else:
+                    name = f"{uuid.uuid4().hex}.png"
+                    out = Path(state.config.image_path)
+                    out.mkdir(parents=True, exist_ok=True)
+                    (out / name).write_bytes(png)
+                    base = f"{request.scheme}://{request.host}"
+                    items.append(
+                        {"url": f"{base}/generated-images/{name}"}
+                    )
 
     return web.json_response({
         "id": uuid.uuid4().hex,

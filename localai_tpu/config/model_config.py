@@ -163,6 +163,10 @@ class EngineConfig(BaseModel):
                                       # amortizes host→device RTT; lower it
                                       # for tighter streaming cadence
     pipeline_depth: int = 2           # in-flight decode dispatches
+    sp_prefill_threshold: int = 1024  # prompts at/above this many tokens
+                                      # take the ring-attention prefill when
+                                      # the mesh has a 'seq' axis
+    attn_impl: str = "auto"           # auto | pallas | pallas_interpret | xla
 
 
 class DiffusionConfig(BaseModel):

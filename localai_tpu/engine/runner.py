@@ -81,17 +81,27 @@ class ModelRunner:
         seed: int = 0,
         mesh: Optional[jax.sharding.Mesh] = None,
         attn_impl: str = "auto",
+        sp_threshold: int = 1024,
     ):
         from localai_tpu import ops
 
         self.cfg = cfg
         self.params = params
-        # Pallas flash kernels are single-device programs; under a mesh the
-        # XLA path stays (a shard_map'd kernel variant is future work).
-        if mesh is not None:
-            self.attn_impl, self._attn_interpret = "xla", False
-        else:
-            self.attn_impl, self._attn_interpret = ops.resolve_attn_impl(attn_impl)
+        self.attn_impl, self._attn_interpret = ops.resolve_attn_impl(attn_impl)
+        if mesh is not None and self.attn_impl == "pallas":
+            # under a mesh the flash kernels run per-device via shard_map:
+            # slots split on 'data', heads on 'model'. That requires the
+            # head groups to split evenly — otherwise kv heads replicate
+            # (see parallel.sharding.kv_spec) and the kernel's GQA grouping
+            # would misalign, so those configs keep the XLA path.
+            tp = mesh.shape["model"]
+            if cfg.num_heads % tp or cfg.num_kv_heads % tp:
+                log.info(
+                    "attention: heads (%d q / %d kv) not divisible by "
+                    "tensor_parallel %d; using XLA under mesh",
+                    cfg.num_heads, cfg.num_kv_heads, tp,
+                )
+                self.attn_impl = "xla"
         if (self.attn_impl == "pallas" and not self._attn_interpret
                 and (cfg.hd % 128 or (max_ctx or cfg.max_position_embeddings) % 128)):
             # Mosaic lane tiling is 128-wide; unaligned head_dim/ctx (tiny
@@ -183,6 +193,21 @@ class ModelRunner:
             self._prefill_resume_fn, static_argnames=("bucket",),
             donate_argnums=(1, 2),
         )
+        # sequence-parallel prefill: long prompts chunk over the 'seq' mesh
+        # axis and run ring attention (parallel.ring) straight into the
+        # slot cache. TP×SP param-sharding composition is future work, so
+        # the route opens only on a pure-SP mesh.
+        self.sp_enabled = (
+            mesh is not None
+            and mesh.shape.get("seq", 1) > 1
+            and mesh.shape.get("model", 1) == 1
+        )
+        self.sp_threshold = sp_threshold
+        self.last_prefill_path = ""
+        self._prefill_sp = jax.jit(
+            self._prefill_sp_fn, static_argnames=("bucket",),
+            donate_argnums=(1, 2),
+        )
         self._embed = jax.jit(self._embed_fn, static_argnames=("bucket",))
         # KV prefix reuse (parity: common_part, grpc-server.cpp:67-74):
         # suffix prefill only pays off past a minimum shared prefix
@@ -199,12 +224,30 @@ class ModelRunner:
         if self.decode_attn_impl == "pallas":
             from localai_tpu import ops
 
-            def attn(q, keys, values, _mask):  # q [S,1,Hq,hd], keys [S,Hkv,C,hd]
-                out = ops.decode_attention(
-                    q[:, 0], keys, values, pos,
-                    sliding_window=cfg.sliding_window,
-                    interpret=self._attn_interpret,
+            kernel = partial(
+                ops.decode_attention,
+                sliding_window=cfg.sliding_window,
+                interpret=self._attn_interpret,
+            )
+            if self.mesh is not None:
+                from jax.sharding import PartitionSpec as P
+
+                # per-device kernel over (slots/'data', heads/'model'):
+                # decode attention is independent across slots and head
+                # groups, so the shard_map body is the single-device kernel
+                kernel = jax.shard_map(
+                    kernel,
+                    mesh=self.mesh,
+                    in_specs=(P("data", "model", None),
+                              P("data", "model", None, None),
+                              P("data", "model", None, None),
+                              P("data")),
+                    out_specs=P("data", "model", None),
+                    check_vma=False,
                 )
+
+            def attn(q, keys, values, _mask):  # q [S,1,Hq,hd], keys [S,Hkv,C,hd]
+                out = kernel(q[:, 0], keys, values, pos)
                 return out[:, None]
 
         mask = kvc.decode_mask(cfg, pos, self.max_ctx)
@@ -356,6 +399,59 @@ class ModelRunner:
         )
         return KVCache.from_stacked(new_stack), new_state, tok[0]
 
+    def _prefill_sp_fn(self, params, kv: KVCache, state: DecodeState,
+                       tokens, length, slot, *, bucket: int):
+        """Sequence-parallel prefill: the prompt chunks over the 'seq' mesh
+        axis, each device runs blockwise ring attention (KV chunks rotating
+        over ICI via ppermute — parallel.ring), and the resulting per-layer
+        K/V lands in the slot cache. tokens: [bucket] i32 (1-D)."""
+        from localai_tpu.parallel import ring
+
+        cfg = self.cfg
+        hidden, (ks, vs) = ring.sp_prefill_forward(
+            cfg, params, tokens, length, self.mesh, self.rope
+        )
+        # [L, T, Hkv, hd] → cache layout [L, 1, Hkv, T, hd]
+        k_hm = ks.transpose(0, 2, 1, 3)[:, None]
+        v_hm = vs.transpose(0, 2, 1, 3)[:, None]
+        zero = jnp.zeros((), jnp.int32)
+        idx = (zero, slot, zero, zero, zero)
+        if kv.quantized:
+            kq, kscale = kvc._quant_chunk(k_hm)
+            vq, vscale = kvc._quant_chunk(v_hm)
+            new_kv = KVCache(
+                k=jax.lax.dynamic_update_slice(kv.k, kq, idx),
+                v=jax.lax.dynamic_update_slice(kv.v, vq, idx),
+                k_scale=jax.lax.dynamic_update_slice(
+                    kv.k_scale, kscale, idx[:4]),
+                v_scale=jax.lax.dynamic_update_slice(
+                    kv.v_scale, vscale, idx[:4]),
+            )
+        else:
+            kdt = kv.k.dtype
+            new_kv = KVCache(
+                k=jax.lax.dynamic_update_slice(kv.k, k_hm.astype(kdt), idx),
+                v=jax.lax.dynamic_update_slice(kv.v, v_hm.astype(kdt), idx),
+            )
+        last_h = jax.lax.dynamic_index_in_dim(hidden[0], length - 1,
+                                              keepdims=True)
+        logits = mdl.logits_from_hidden(cfg, params, last_h)  # [1, V]
+        counts = smp.count_prompt_tokens(state.counts, slot, tokens, length)
+        slot_params = jax.tree.map(lambda a: a[slot][None], state.params)
+        tok, new_key = smp.sample(
+            logits, slot_params, counts[slot][None], state.keys[slot][None],
+            state.bias[slot][None],
+        )
+        new_state = dataclasses.replace(
+            state,
+            tokens=state.tokens.at[slot].set(tok[0]),
+            positions=state.positions.at[slot].set(length),
+            active=state.active.at[slot].set(True),
+            keys=state.keys.at[slot].set(new_key[0]),
+            counts=counts,
+        )
+        return new_kv, new_state, tok[0]
+
     def _embed_fn(self, params, tokens, length, *, bucket: int):
         """Mean-pooled final hidden state over the real tokens — the LLM
         embeddings path (parity: llama.cpp embeddings mode behind the
@@ -389,13 +485,27 @@ class ModelRunner:
         from localai_tpu import ops
 
         cfg = self.cfg
+        kernel = partial(
+            ops.prefill_attention,
+            sliding_window=cfg.sliding_window,
+            interpret=self._attn_interpret,
+        )
+        if self.mesh is not None:
+            from jax.sharding import PartitionSpec as P
+
+            # single-sequence prefill: only the head dim shards ('model');
+            # each device runs flash attention over its head group
+            kernel = jax.shard_map(
+                kernel,
+                mesh=self.mesh,
+                in_specs=(P(None, "model", None), P("model", None, None),
+                          P("model", None, None), P()),
+                out_specs=P(None, "model", None),
+                check_vma=False,
+            )
 
         def attn(q, keys, values, _mask):  # q [1,T,Hq,hd], keys [1,Hkv,T,hd]
-            out = ops.prefill_attention(
-                q[0], keys[0], values[0], length,
-                sliding_window=cfg.sliding_window,
-                interpret=self._attn_interpret,
-            )
+            out = kernel(q[0], keys[0], values[0], length)
             return out[None]
 
         return attn
@@ -492,7 +602,20 @@ class ModelRunner:
                 if 0 <= int(tid) < self.cfg.vocab_size:
                     row[int(tid)] += b
         self.set_bias(slot, row)
-        if lcp:
+        n_seq = self.mesh.shape.get("seq", 1) if self.mesh is not None else 1
+        use_sp = (
+            self.sp_enabled and not lcp and mm_embeds is None
+            and n >= self.sp_threshold and bucket % n_seq == 0
+        )
+        if use_sp:
+            self.last_prefill_path = "sp"
+            self.kv, self.state, tok = self._prefill_sp(
+                self.params, self.kv, self.state,
+                jnp.asarray(padded[0]), jnp.int32(n), jnp.int32(slot),
+                bucket=bucket,
+            )
+        elif lcp:
+            self.last_prefill_path = "resume"
             crow = np.zeros(self.cfg.vocab_size, np.int32)
             ids = np.asarray(prompt, np.int64)
             np.add.at(crow, ids[(ids >= 0) & (ids < self.cfg.vocab_size)], 1)
@@ -502,6 +625,7 @@ class ModelRunner:
                 jnp.int32(slot), jnp.asarray(crow), bucket=bucket,
             )
         elif mm_embeds is not None and len(mm_embeds):
+            self.last_prefill_path = "mm"
             self.kv, self.state, tok = self._prefill_mm(
                 self.params, self.kv, self.state,
                 jnp.asarray(padded), jnp.int32(n), jnp.int32(slot),
@@ -510,6 +634,7 @@ class ModelRunner:
                 bucket=bucket,
             )
         else:
+            self.last_prefill_path = "full"
             self.kv, self.state, tok = self._prefill(
                 self.params, self.kv, self.state,
                 jnp.asarray(padded), jnp.int32(n), jnp.int32(slot),

@@ -90,6 +90,23 @@ class ImageServingModel:
         with self._lock:
             return self._inflight > 0
 
+    def in_use(self):
+        """Context manager holding the busy flag across a multi-image
+        request so eviction sweeps can't null the pipeline between items."""
+        import contextlib
+
+        @contextlib.contextmanager
+        def cm():
+            with self._lock:
+                self._inflight += 1
+            try:
+                yield self
+            finally:
+                with self._lock:
+                    self._inflight -= 1
+
+        return cm()
+
     def alive(self) -> bool:
         return self.pipeline is not None
 
@@ -132,8 +149,10 @@ def build_serving_model(mcfg: ModelConfig, app: AppConfig) -> ServingModel:
     mesh = None
     t0 = time.monotonic()
     want_tp = max(1, shard.tensor_parallel_size)
+    want_sp = max(1, shard.sequence_parallel_size)
     want_dp = shard.data_parallel_size  # 0 = auto
-    if want_tp > 1 or want_dp not in (0, 1) or app.mesh_shape:
+    if (want_tp > 1 or want_sp > 1 or want_dp not in (0, 1)
+            or app.mesh_shape):
         from localai_tpu.parallel.mesh import MeshPlan, build_mesh
 
         if app.mesh_shape:
@@ -142,8 +161,10 @@ def build_serving_model(mcfg: ModelConfig, app: AppConfig) -> ServingModel:
             import jax
 
             nd = len(jax.devices())
-            dp = want_dp or max(1, nd // want_tp)
-            mesh = build_mesh(MeshPlan(data=dp, model=want_tp))
+            dp = want_dp or max(1, nd // (want_tp * want_sp))
+            mesh = build_mesh(
+                MeshPlan(data=dp, seq=want_sp, model=want_tp)
+            )
 
     model = resolve_model(
         mcfg.model or mcfg.name,
@@ -172,6 +193,8 @@ def build_serving_model(mcfg: ModelConfig, app: AppConfig) -> ServingModel:
         rope_freq_scale=mcfg.rope_freq_scale,
         seed=mcfg.seed or 0,
         mesh=mesh,
+        sp_threshold=eng.sp_prefill_threshold,
+        attn_impl=eng.attn_impl,
     )
     scheduler = Scheduler(
         runner,
@@ -229,6 +252,7 @@ class ModelManager:
         self.loader = loader or ConfigLoader(self.app.model_path)
         self._models: dict[str, Any] = {}   # ServingModel | WorkerServingModel
                                             # | ImageServingModel
+        self._load_locks: dict[str, threading.Lock] = {}
         self._lock = threading.RLock()
         self._pool = None                   # WorkerPool, created on demand
         self._watchdog: Optional[_Watchdog] = None
@@ -267,37 +291,55 @@ class ModelManager:
         return self._get_typed(name, self._load_image, kind="image")
 
     def _get_typed(self, name: str, load, *, kind: str) -> Any:
+        # fast path + cache maintenance under the global lock; the load
+        # itself (worker spawn / weight read, tens of seconds) runs under a
+        # per-name lock so one cold model never stalls warm lookups
+        cached = self._check_cached(name, kind)
+        if cached is not None:
+            return cached
         with self._lock:
-            sm = self._models.get(name)
-            if sm is not None:
-                wrong_kind = isinstance(sm, ImageServingModel) != (
-                    kind == "image"
-                )
-                if wrong_kind:
-                    # one name, two modalities: latest request wins (same
-                    # semantics as single_active_backend), unless in use
-                    if sm.busy:
-                        raise RuntimeError(
-                            f"model {name!r} is busy serving as "
-                            f"{'image' if kind != 'image' else 'llm'}"
-                        )
-                    log.info("model %s switching modality; reloading", name)
-                    self._evict_locked(name)
-                elif sm.alive():
-                    sm.touch()
-                    return sm
-                else:
-                    log.warning("model %s engine died; reloading", name)
-                    self._evict_locked(name)
+            lk = self._load_locks.setdefault(name, threading.Lock())
+        with lk:
+            cached = self._check_cached(name, kind)  # raced loader won?
+            if cached is not None:
+                return cached
             mcfg = self.loader.get(name)
             if mcfg is None:
                 raise KeyError(f"no configuration for model {name!r}")
             if self.app.single_active_backend:
-                for other in list(self._models):
-                    if not self._models[other].busy:
-                        self._evict_locked(other)
+                with self._lock:
+                    for other in list(self._models):
+                        if not self._models[other].busy:
+                            self._evict_locked(other)
             sm = load(mcfg)
-            self._models[name] = sm
+            with self._lock:
+                self._models[name] = sm
+            return sm
+
+    def _check_cached(self, name: str, kind: str) -> Optional[Any]:
+        """Return the cached model if it is the right kind and alive;
+        evict (and return None) otherwise."""
+        with self._lock:
+            sm = self._models.get(name)
+            if sm is None:
+                return None
+            wrong_kind = isinstance(sm, ImageServingModel) != (kind == "image")
+            if wrong_kind:
+                # one name, two modalities: latest request wins (same
+                # semantics as single_active_backend), unless in use
+                if sm.busy:
+                    raise RuntimeError(
+                        f"model {name!r} is busy serving as "
+                        f"{'image' if kind != 'image' else 'llm'}"
+                    )
+                log.info("model %s switching modality; reloading", name)
+                self._evict_locked(name)
+                return None
+            if not sm.alive():
+                log.warning("model %s engine died; reloading", name)
+                self._evict_locked(name)
+                return None
+            sm.touch()
             return sm
 
     def _load(self, mcfg: ModelConfig) -> Any:

@@ -275,7 +275,11 @@ class WorkerPool:
             if name in self._workers:
                 self._drop_locked(name)
                 return True
-            return self._external.pop(name, None) is not None
+            ext = self._external.pop(name, None)
+        if ext is not None:
+            ext.close()  # eviction only fires when idle — safe to close
+            return True
+        return False
 
     def shutdown_all(self) -> None:
         with self._lock:

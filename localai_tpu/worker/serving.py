@@ -234,15 +234,14 @@ class WorkerServingModel:
         return self.scheduler.busy
 
     def alive(self) -> bool:
-        try:
-            if self.external_address is not None:
-                return self.pool.register_external(
-                    self.name, self.external_address
-                ).health()
-            wp = self.pool._workers.get(self.name)
-            return wp is not None and wp.healthy()
-        except Exception:  # noqa: BLE001
-            return False
+        """Cheap liveness only — this runs under the ModelManager lock, so
+        no RPCs here (a blocking health check would serialize every model
+        lookup behind one dead worker). Spawned workers: process poll.
+        External workers: assumed alive; failures surface per-request."""
+        if self.external_address is not None:
+            return True
+        wp = self.pool._workers.get(self.name)
+        return wp is not None and wp.alive
 
     def engine_metrics(self) -> dict:
         return self.scheduler.metrics()
