@@ -1,0 +1,493 @@
+"""OpenAI Assistants + Files APIs with JSON persistence.
+
+Parity: /root/reference/core/http/endpoints/openai/assistant.go (assistant
+CRUD + assistant-file attachments, persisted as ``assistants.json`` /
+``assistantsFile.json`` in the configs dir) and files.go (multipart upload
+into the upload dir, metadata in ``uploadedFiles.json``), reloaded at boot
+by app.go:152-154. The reference keeps these in package-level globals; here
+they live in an AssistantStore owned by AppState, with a lock and atomic
+saves."""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+from pathlib import Path
+from typing import Any, Optional
+
+from aiohttp import web
+
+from localai_tpu.api.schema import error_body
+from localai_tpu.utils.paths import verify_path
+
+log = logging.getLogger(__name__)
+
+ASSISTANTS_FILE = "assistants.json"
+ASSISTANT_FILES_FILE = "assistantsFile.json"
+UPLOADED_FILES_FILE = "uploadedFiles.json"
+
+# request-shape limits (assistant.go:29-36)
+MAX_INSTRUCTIONS = 32768
+MAX_DESCRIPTION = 512
+MAX_NAME = 256
+MAX_TOOLS = 128
+MAX_FILE_IDS = 20
+TOOL_TYPES = {"code_interpreter", "retrieval", "function"}
+
+
+class AssistantStore:
+    """Assistants, assistant-file attachments, and uploaded-file metadata,
+    persisted as JSON and reloaded at construction (boot)."""
+
+    def __init__(self, configs_dir: str | Path, upload_dir: str | Path):
+        self.configs_dir = Path(configs_dir)
+        self.upload_dir = Path(upload_dir)
+        self._lock = threading.Lock()
+        self.assistants: list[dict] = self._load(
+            self.configs_dir / ASSISTANTS_FILE
+        )
+        self.assistant_files: list[dict] = self._load(
+            self.configs_dir / ASSISTANT_FILES_FILE
+        )
+        self.files: list[dict] = self._load(
+            self.upload_dir / UPLOADED_FILES_FILE
+        )
+        # id counters continue past the largest persisted id, so restarts
+        # never mint colliding ids (the reference restarts from 0 and WOULD
+        # collide — assistant.go:124; deliberate divergence)
+        self._next_id = 1 + max(
+            [_id_num(a["id"], "asst_") for a in self.assistants]
+            + [_id_num(f["id"], "file-") for f in self.files]
+            + [_id_num(af["id"], "file-") for af in self.assistant_files]
+            + [0]
+        )
+
+    @staticmethod
+    def _load(path: Path) -> list[dict]:
+        try:
+            data = json.loads(path.read_text())
+            return data if isinstance(data, list) else []
+        except FileNotFoundError:
+            return []
+        except (OSError, ValueError) as e:
+            log.warning("cannot load %s: %s", path, e)
+            return []
+
+    def _save(self, path: Path, data: list[dict]) -> None:
+        path.parent.mkdir(parents=True, exist_ok=True)
+        tmp = path.with_suffix(".tmp")
+        tmp.write_text(json.dumps(data, indent=2))
+        tmp.replace(path)
+
+    def save_assistants(self) -> None:
+        self._save(self.configs_dir / ASSISTANTS_FILE, self.assistants)
+
+    def save_assistant_files(self) -> None:
+        self._save(self.configs_dir / ASSISTANT_FILES_FILE,
+                   self.assistant_files)
+
+    def save_files(self) -> None:
+        self._save(self.upload_dir / UPLOADED_FILES_FILE, self.files)
+
+    def next_id(self) -> int:
+        with self._lock:
+            n = self._next_id
+            self._next_id += 1
+            return n
+
+    # -- lookups -----------------------------------------------------------
+
+    def assistant(self, aid: str) -> Optional[dict]:
+        return next((a for a in self.assistants if a["id"] == aid), None)
+
+    def file(self, fid: str) -> Optional[dict]:
+        return next((f for f in self.files if f["id"] == fid), None)
+
+
+def _id_num(s: str, prefix: str) -> int:
+    try:
+        return int(s.removeprefix(prefix))
+    except ValueError:
+        return 0
+
+
+def _store(request: web.Request) -> AssistantStore:
+    from localai_tpu.api.server import STATE_KEY
+
+    return request.app[STATE_KEY].assistants
+
+
+def _bad(msg: str) -> web.Response:
+    return web.json_response(error_body(msg, code=400), status=400)
+
+
+def _not_found(msg: str) -> web.Response:
+    return web.json_response(error_body(msg, code=404), status=404)
+
+
+def _validate_assistant_request(state, body: dict) -> Optional[str]:
+    """Shape limits + model existence (assistant.go:84-99,418-447)."""
+    if not isinstance(body, dict):
+        return "body must be a JSON object"
+    model = body.get("model", "")
+    if not model:
+        return "model is required"
+    if model not in state.loader.names():
+        return f"Model {model} not found"
+    if len(body.get("name") or "") > MAX_NAME:
+        return "name exceeds maximum length"
+    if len(body.get("description") or "") > MAX_DESCRIPTION:
+        return "description exceeds maximum length"
+    if len(body.get("instructions") or "") > MAX_INSTRUCTIONS:
+        return "instructions exceed maximum length"
+    tools = body.get("tools") or []
+    if len(tools) > MAX_TOOLS:
+        return "too many tools"
+    for t in tools:
+        if not isinstance(t, dict) or t.get("type") not in TOOL_TYPES:
+            return f"invalid tool: {t!r}"
+    if len(body.get("file_ids") or []) > MAX_FILE_IDS:
+        return "too many file_ids"
+    return None
+
+
+def _assistant_from_request(store: AssistantStore, body: dict) -> dict:
+    return {
+        "id": f"asst_{store.next_id()}",
+        "object": "assistant",
+        "created": int(time.time()),
+        "model": body.get("model", ""),
+        "name": body.get("name", ""),
+        "description": body.get("description", ""),
+        "instructions": body.get("instructions", ""),
+        "tools": body.get("tools") or [],
+        "file_ids": body.get("file_ids") or [],
+        "metadata": body.get("metadata") or {},
+    }
+
+
+# ---------------------------------------------------------------------------
+# /v1/assistants
+
+
+async def create_assistant(request: web.Request) -> web.Response:
+    from localai_tpu.api.server import STATE_KEY
+
+    state = request.app[STATE_KEY]
+    store = _store(request)
+    try:
+        body = await request.json()
+    except Exception:
+        return _bad("Cannot parse JSON")
+    err = _validate_assistant_request(state, body)
+    if err:
+        return _bad(err)
+    assistant = _assistant_from_request(store, body)
+    with store._lock:
+        store.assistants.append(assistant)
+        store.save_assistants()
+    return web.json_response(assistant)
+
+
+async def list_assistants(request: web.Request) -> web.Response:
+    store = _store(request)
+    out = list(store.assistants)
+    order = request.query.get("order", "desc")
+    out.sort(key=lambda a: a.get("created", 0), reverse=(order != "asc"))
+    after = request.query.get("after")
+    before = request.query.get("before")
+    if after and after.isdigit():
+        out = [a for a in out if _id_num(a["id"], "asst_") > int(after)]
+    if before and before.isdigit():
+        out = [a for a in out if _id_num(a["id"], "asst_") < int(before)]
+    try:
+        limit = int(request.query.get("limit", "20"))
+    except ValueError:
+        return _bad("Invalid limit query value")
+    return web.json_response(out[:limit])
+
+
+async def get_assistant(request: web.Request) -> web.Response:
+    a = _store(request).assistant(request.match_info["assistant_id"])
+    if a is None:
+        return _not_found("Unable to find assistant")
+    return web.json_response(a)
+
+
+async def modify_assistant(request: web.Request) -> web.Response:
+    from localai_tpu.api.server import STATE_KEY
+
+    state = request.app[STATE_KEY]
+    store = _store(request)
+    try:
+        body = await request.json()
+    except Exception:
+        return _bad("Cannot parse JSON")
+    err = _validate_assistant_request(state, body)
+    if err:
+        return _bad(err)
+    aid = request.match_info["assistant_id"]
+    # built before taking the lock: _assistant_from_request mints an id
+    # under the same (non-reentrant) lock
+    updated = _assistant_from_request(store, body)
+    with store._lock:
+        for i, a in enumerate(store.assistants):
+            if a["id"] == aid:
+                # modify keeps the identity, replaces the definition
+                # (assistant.go:410-447)
+                updated["id"] = aid
+                updated["created"] = a.get("created", updated["created"])
+                store.assistants[i] = updated
+                store.save_assistants()
+                return web.json_response(updated)
+    return _not_found(f"Unable to find assistant with id: {aid}")
+
+
+async def delete_assistant(request: web.Request) -> web.Response:
+    store = _store(request)
+    aid = request.match_info["assistant_id"]
+    with store._lock:
+        for i, a in enumerate(store.assistants):
+            if a["id"] == aid:
+                del store.assistants[i]
+                store.assistant_files = [
+                    af for af in store.assistant_files
+                    if af["assistant_id"] != aid
+                ]
+                store.save_assistants()
+                store.save_assistant_files()
+                return web.json_response({
+                    "id": aid, "object": "assistant.deleted",
+                    "deleted": True,
+                })
+    return web.json_response(
+        {"id": aid, "object": "assistant.deleted", "deleted": False},
+        status=404,
+    )
+
+
+# ---------------------------------------------------------------------------
+# /v1/assistants/{assistant_id}/files
+
+
+async def create_assistant_file(request: web.Request) -> web.Response:
+    store = _store(request)
+    aid = request.match_info["assistant_id"]
+    try:
+        body = await request.json()
+    except Exception:
+        return _bad("Cannot parse JSON")
+    fid = (body or {}).get("file_id", "")
+    a = store.assistant(aid)
+    if a is None:
+        return _not_found(f"Unable to find assistant with id: {aid}")
+    if store.file(fid) is None:
+        return _not_found(f"Unable to find file_id with id: {fid}")
+    af = {
+        "id": fid,
+        "object": "assistant.file",
+        "created_at": int(time.time()),
+        "assistant_id": aid,
+    }
+    with store._lock:
+        if fid not in a["file_ids"]:
+            a["file_ids"].append(fid)
+        store.assistant_files.append(af)
+        store.save_assistants()
+        store.save_assistant_files()
+    return web.json_response(af)
+
+
+async def list_assistant_files(request: web.Request) -> web.Response:
+    store = _store(request)
+    aid = request.match_info["assistant_id"]
+    if store.assistant(aid) is None:
+        return _not_found(f"Unable to find assistant with id: {aid}")
+    data = [af for af in store.assistant_files
+            if af["assistant_id"] == aid]
+    try:
+        limit = int(request.query.get("limit", "20"))
+    except ValueError:
+        return _bad("Invalid limit query value")
+    return web.json_response({
+        "object": "list", "data": data[:limit],
+    })
+
+
+async def get_assistant_file(request: web.Request) -> web.Response:
+    store = _store(request)
+    aid = request.match_info["assistant_id"]
+    fid = request.match_info["file_id"]
+    for af in store.assistant_files:
+        if af["assistant_id"] == aid and af["id"] == fid:
+            return web.json_response(af)
+    return _not_found(
+        f"Unable to find assistant file with id {fid} on assistant {aid}"
+    )
+
+
+async def delete_assistant_file(request: web.Request) -> web.Response:
+    store = _store(request)
+    aid = request.match_info["assistant_id"]
+    fid = request.match_info["file_id"]
+    with store._lock:
+        for i, af in enumerate(store.assistant_files):
+            if af["assistant_id"] == aid and af["id"] == fid:
+                del store.assistant_files[i]
+                a = store.assistant(aid)
+                if a and fid in a.get("file_ids", []):
+                    a["file_ids"].remove(fid)
+                    store.save_assistants()
+                store.save_assistant_files()
+                return web.json_response({
+                    "id": fid, "object": "assistant.file.deleted",
+                    "deleted": True,
+                })
+    return web.json_response(
+        {"id": fid, "object": "assistant.file.deleted", "deleted": False},
+        status=404,
+    )
+
+
+# ---------------------------------------------------------------------------
+# /v1/files
+
+
+async def upload_file(request: web.Request) -> web.Response:
+    from localai_tpu.api.server import STATE_KEY
+
+    state = request.app[STATE_KEY]
+    store = _store(request)
+    reader = await request.multipart()
+    filename = None
+    content = None
+    purpose = ""
+    async for part in reader:
+        if part.name == "file":
+            filename = part.filename or "upload"
+            content = await part.read(decode=False)
+        elif part.name == "purpose":
+            purpose = (await part.text()).strip()
+    if content is None:
+        return _bad("file form field is required")
+    if not purpose:
+        return _bad("Purpose is not defined")
+    limit = state.config.upload_limit_mb * 1024 * 1024
+    if len(content) > limit:
+        return _bad(
+            f"File size {len(content)} exceeds upload limit {limit}"
+        )
+    # sanitize: basename only, traversal-guarded under the upload dir
+    safe_name = Path(filename).name
+    try:
+        save_path = verify_path(safe_name, store.upload_dir)
+    except ValueError:
+        return _bad("invalid filename")
+    if save_path.exists():
+        return _bad("File already exists")
+    store.upload_dir.mkdir(parents=True, exist_ok=True)
+    save_path.write_bytes(content)
+    f = {
+        "id": f"file-{store.next_id()}",
+        "object": "file",
+        "bytes": len(content),
+        "created_at": int(time.time()),
+        "filename": safe_name,
+        "purpose": purpose,
+    }
+    with store._lock:
+        store.files.append(f)
+        store.save_files()
+    return web.json_response(f)
+
+
+async def list_files(request: web.Request) -> web.Response:
+    store = _store(request)
+    purpose = request.query.get("purpose", "")
+    data = [f for f in store.files
+            if not purpose or f.get("purpose") == purpose]
+    return web.json_response({"object": "list", "data": data})
+
+
+def _file_or_404(request: web.Request) -> tuple[Optional[dict], Any]:
+    store = _store(request)
+    fid = request.match_info["file_id"]
+    f = store.file(fid)
+    if f is None:
+        return None, _not_found(f"unable to find file id {fid}")
+    return f, None
+
+
+async def get_file(request: web.Request) -> web.Response:
+    f, err = _file_or_404(request)
+    return err if f is None else web.json_response(f)
+
+
+async def get_file_content(request: web.Request) -> web.Response:
+    f, err = _file_or_404(request)
+    if f is None:
+        return err
+    store = _store(request)
+    try:
+        path = verify_path(f["filename"], store.upload_dir)
+        return web.Response(body=path.read_bytes())
+    except (OSError, ValueError) as e:
+        return web.json_response(error_body(str(e), code=500), status=500)
+
+
+async def delete_file(request: web.Request) -> web.Response:
+    f, err = _file_or_404(request)
+    if f is None:
+        return err
+    store = _store(request)
+    with store._lock:
+        try:
+            verify_path(f["filename"], store.upload_dir).unlink()
+        except FileNotFoundError:
+            pass  # metadata cleanup proceeds (files.go:158-162)
+        except (OSError, ValueError) as e:
+            return web.json_response(
+                error_body(f"Unable to delete file: {e}", code=500),
+                status=500,
+            )
+        store.files = [x for x in store.files if x["id"] != f["id"]]
+        store.save_files()
+    return web.json_response({
+        "id": f["id"], "object": "file", "deleted": True,
+    })
+
+
+def routes() -> list[web.RouteDef]:
+    """Route table (parity: routes/openai.go:25-56 incl. unversioned
+    aliases)."""
+    out = []
+    for base in ("/v1", ""):
+        out += [
+            web.get(f"{base}/assistants", list_assistants),
+            web.post(f"{base}/assistants", create_assistant),
+            web.get(f"{base}/assistants/{{assistant_id}}", get_assistant),
+            web.post(f"{base}/assistants/{{assistant_id}}",
+                     modify_assistant),
+            web.delete(f"{base}/assistants/{{assistant_id}}",
+                       delete_assistant),
+            web.get(f"{base}/assistants/{{assistant_id}}/files",
+                    list_assistant_files),
+            web.post(f"{base}/assistants/{{assistant_id}}/files",
+                     create_assistant_file),
+            web.get(
+                f"{base}/assistants/{{assistant_id}}/files/{{file_id}}",
+                get_assistant_file,
+            ),
+            web.delete(
+                f"{base}/assistants/{{assistant_id}}/files/{{file_id}}",
+                delete_assistant_file,
+            ),
+            web.get(f"{base}/files", list_files),
+            web.post(f"{base}/files", upload_file),
+            web.get(f"{base}/files/{{file_id}}", get_file),
+            web.get(f"{base}/files/{{file_id}}/content", get_file_content),
+            web.delete(f"{base}/files/{{file_id}}", delete_file),
+        ]
+    return out

@@ -125,6 +125,16 @@ def expand_image_placeholders(
     return tokens, np.concatenate(rows, 0), np.asarray(poss, np.int32)
 
 
+def correlation_id(request: Any) -> str:
+    """X-Correlation-ID request header, for tracing a request through the
+    scheduler/worker tier (parity: chat.go:164-169 — header, else the
+    generated request id; callers fall back to their rid)."""
+    try:
+        return request.headers.get("X-Correlation-ID", "")
+    except AttributeError:
+        return ""
+
+
 def build_gen_request(
     sm: ServingModel,
     cfg: ModelConfig,
@@ -134,6 +144,7 @@ def build_gen_request(
     constraint: Any = None,
     seed_offset: int = 0,
     mm_embeds: Any = None,
+    correlation_id: str = "",
 ) -> GenRequest:
     p = cfg.parameters
     mm_flat = mm_pos = None
@@ -169,7 +180,8 @@ def build_gen_request(
         stop=tuple(cfg.stopwords) + tuple(req.stop_list()),
         ignore_eos=req.ignore_eos,
         constraint=constraint,
-        correlation_id=req.user or "",
+        correlation_id=correlation_id or req.user or "",
+        stream=bool(req.stream),
         mm_embeds=mm_flat,
         mm_positions=mm_pos,
     )

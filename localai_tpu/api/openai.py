@@ -147,14 +147,18 @@ async def chat(request: web.Request) -> web.StreamResponse:
             grammar_active=tctx is not None and tctx.constraint is not None,
         )
     rid = sc.new_id("chatcmpl")
+    # trace id: client header, else the request id (parity: chat.go:164-169)
+    cid = inf.correlation_id(request) or rid
 
     constraint = tctx.constraint if tctx else rf_constraint
     gr = inf.build_gen_request(
-        sm, cfg, req, prompt, constraint=constraint, mm_embeds=mm_embeds
+        sm, cfg, req, prompt, constraint=constraint, mm_embeds=mm_embeds,
+        correlation_id=cid,
     )
 
     if req.stream:
-        return await _chat_stream(request, req, sm, cfg, gr, rid, tctx)
+        return await _chat_stream(request, req, sm, cfg, gr, rid, tctx,
+                                  cid=cid)
 
     n = max(1, req.n or 1)
     handles = []
@@ -169,7 +173,7 @@ async def chat(request: web.Request) -> web.StreamResponse:
                     request, inf.response_format_constraint, sm, req)
             gr_i = inf.build_gen_request(
                 sm, cfg, req, prompt, constraint=c, seed_offset=i,
-                mm_embeds=mm_embeds,
+                mm_embeds=mm_embeds, correlation_id=cid,
             )
         else:
             gr_i = gr
@@ -199,15 +203,18 @@ async def chat(request: web.Request) -> web.StreamResponse:
         })
     return web.json_response(sc.chat_response(
         rid, req.model, choices, sc.usage(prompt_tokens, total_completion)
-    ))
+    ), headers={"X-Correlation-ID": cid})
 
 
-async def _chat_stream(request, req, sm, cfg, gr, rid, tctx
+async def _chat_stream(request, req, sm, cfg, gr, rid, tctx, *, cid=""
                        ) -> web.StreamResponse:
     """SSE streaming. Plain chat streams deltas as they decode; with tools
     the text must be parsed whole, so deltas buffer and the final frames
     carry tool_calls (parity: chat.go:107-154,463-508)."""
-    resp = web.StreamResponse(headers=SSE_HEADERS)
+    headers = dict(SSE_HEADERS)
+    if cid:
+        headers["X-Correlation-ID"] = cid
+    resp = web.StreamResponse(headers=headers)
     await resp.prepare(request)
     await resp.write(sse_event(sc.chat_chunk(
         rid, req.model, {"role": "assistant", "content": ""}
@@ -263,6 +270,7 @@ async def completions(request: web.Request) -> web.StreamResponse:
     sm, base_cfg = await _serving(request, req, Usecase.COMPLETION)
     cfg = inf.merge_request(base_cfg, req)
     rid = sc.new_id("cmpl")
+    cid = inf.correlation_id(request) or rid
 
     prompts: list[str]
     if isinstance(req.prompt, list):
@@ -274,36 +282,9 @@ async def completions(request: web.Request) -> web.StreamResponse:
     ]
 
     if req.stream:
-        resp = web.StreamResponse(headers=SSE_HEADERS)
-        await resp.prepare(request)
-        handle = sm.scheduler.submit(
-            inf.build_gen_request(sm, cfg, req, templated[0])
+        return await _completions_stream(
+            request, req, sm, cfg, templated, rid, cid
         )
-        finish = "stop"
-        try:
-            async for item in aiter_handle(handle):
-                if item.finish_reason is not None:
-                    finish = item.finish_reason
-                    break
-                if item.delta:
-                    await resp.write(sse_event(sc.completion_response(
-                        rid, req.model,
-                        [{"index": 0, "text": item.delta,
-                          "finish_reason": None}],
-                        sc.usage(handle.prompt_tokens,
-                                 handle.completion_tokens),
-                    )))
-        except BaseException:
-            handle.cancel()
-            raise
-        await resp.write(sse_event(sc.completion_response(
-            rid, req.model, [{"index": 0, "text": "",
-                              "finish_reason": finish}],
-            sc.usage(handle.prompt_tokens, handle.completion_tokens),
-        )))
-        await resp.write(SSE_DONE)
-        await resp.write_eof()
-        return resp
 
     choices = []
     prompt_total = 0
@@ -313,7 +294,7 @@ async def completions(request: web.Request) -> web.StreamResponse:
         n = max(1, req.n or 1)
         handles = [
             sm.scheduler.submit(inf.build_gen_request(
-                sm, cfg, req, prompt, seed_offset=i))
+                sm, cfg, req, prompt, seed_offset=i, correlation_id=cid))
             for i in range(n)
         ]
         await _await_handles(request, handles)
@@ -329,7 +310,67 @@ async def completions(request: web.Request) -> web.StreamResponse:
             idx += 1
     return web.json_response(sc.completion_response(
         rid, req.model, choices, sc.usage(prompt_total, completion_total)
-    ))
+    ), headers={"X-Correlation-ID": cid})
+
+
+async def _completions_stream(request, req, sm, cfg, templated, rid, cid
+                              ) -> web.StreamResponse:
+    """SSE streaming over EVERY prompt in the list × n choices — each
+    choice index streams concurrently through the continuous-batching
+    engine (a list prompt must not silently degrade to its first element,
+    and stream/non-stream modes must agree on choice indexing)."""
+    import asyncio
+
+    headers = dict(SSE_HEADERS)
+    headers["X-Correlation-ID"] = cid
+    resp = web.StreamResponse(headers=headers)
+    await resp.prepare(request)
+    n = max(1, req.n or 1)
+    # choice index p*n + i — identical to the non-stream loop below
+    handles = [
+        sm.scheduler.submit(inf.build_gen_request(
+            sm, cfg, req, prompt, seed_offset=i, correlation_id=cid))
+        for prompt in templated
+        for i in range(n)
+    ]
+    write_lock = asyncio.Lock()
+
+    async def pump(idx: int, handle) -> None:
+        finish = "stop"
+        async for item in aiter_handle(handle):
+            if item.finish_reason is not None:
+                finish = item.finish_reason
+                break
+            if item.delta:
+                async with write_lock:
+                    await resp.write(sse_event(sc.completion_response(
+                        rid, req.model,
+                        [{"index": idx, "text": item.delta,
+                          "finish_reason": None}],
+                        sc.usage(handle.prompt_tokens,
+                                 handle.completion_tokens),
+                    )))
+        async with write_lock:
+            await resp.write(sse_event(sc.completion_response(
+                rid, req.model, [{"index": idx, "text": "",
+                                  "finish_reason": finish}],
+                sc.usage(handle.prompt_tokens, handle.completion_tokens),
+            )))
+
+    # TaskGroup so one failing pump (e.g. client disconnect mid-write)
+    # cancels its siblings instead of leaving them writing to a dead
+    # response as orphaned tasks
+    try:
+        async with asyncio.TaskGroup() as tg:
+            for i, h in enumerate(handles):
+                tg.create_task(pump(i, h))
+    except BaseException:
+        for h in handles:
+            h.cancel()
+        raise
+    await resp.write(SSE_DONE)
+    await resp.write_eof()
+    return resp
 
 
 async def edits(request: web.Request) -> web.Response:
@@ -337,6 +378,7 @@ async def edits(request: web.Request) -> web.Response:
     sm, base_cfg = await _serving(request, req, Usecase.EDIT)
     cfg = inf.merge_request(base_cfg, req)
     rid = sc.new_id("edit")
+    cid = inf.correlation_id(request) or rid
     inputs: list[str]
     if isinstance(req.prompt, list):
         inputs = [str(p) for p in req.prompt] or [""]
@@ -347,7 +389,8 @@ async def edits(request: web.Request) -> web.Response:
     for i, text_in in enumerate(inputs):
         prompt = build_edit_prompt(sm.templates, cfg, text_in,
                                    req.instruction)
-        h = sm.scheduler.submit(inf.build_gen_request(sm, cfg, req, prompt))
+        h = sm.scheduler.submit(inf.build_gen_request(
+            sm, cfg, req, prompt, correlation_id=cid))
         await _await_handles(request, [h])
         ptotal += h.prompt_tokens
         ctotal += h.completion_tokens

@@ -59,6 +59,23 @@ class AppState:
         self.executor = ThreadPoolExecutor(
             max_workers=32, thread_name_prefix="api-wait"
         )
+        # dynamic config: api_keys.json / external_backends.json hot-reload
+        # (parity: core/startup/config_file_watcher.go)
+        from localai_tpu.config.watcher import (
+            ConfigWatcher,
+            attach_standard_handlers,
+        )
+
+        self.watcher = ConfigWatcher(self.config.config_path)
+        attach_standard_handlers(self.watcher, self)
+        self.watcher.start()
+        # assistants/files persistence, reloaded at boot (parity:
+        # app.go:152-154 LoadConfig of assistants.json/uploadedFiles.json)
+        from localai_tpu.api.assistants import AssistantStore
+
+        self.assistants = AssistantStore(
+            self.config.config_path, self.config.upload_path
+        )
 
     @property
     def gallery_service(self):
@@ -89,6 +106,7 @@ class AppState:
         return len(self.galleries) < before
 
     def shutdown(self) -> None:
+        self.watcher.stop()
         self.manager.shutdown_all()
         if self._gallery_service is not None:
             self._gallery_service.shutdown()
@@ -187,6 +205,7 @@ def create_app(state: Optional[AppState] = None) -> web.Application:
         metrics_middleware,
     ], client_max_size=64 * 1024 * 1024)
     app[STATE_KEY] = state
+    from localai_tpu.api import assistants as assistant_routes
     from localai_tpu.api import audio as audio_routes
     from localai_tpu.api import gallery as gallery_routes
     from localai_tpu.api import images as image_routes
@@ -201,6 +220,7 @@ def create_app(state: Optional[AppState] = None) -> web.Application:
     app.add_routes(jina_routes.routes())
     app.add_routes(audio_routes.routes())
     app.add_routes(image_routes.routes())
+    app.add_routes(assistant_routes.routes())
 
     async def on_cleanup(_app):
         state.shutdown()

@@ -121,7 +121,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         from localai_tpu.api.server import serve
         from localai_tpu.config.app_config import AppConfig
 
-        cfg = AppConfig(
+        # env first (LOCALAI_* for every AppConfig field — parity with the
+        # kong env tags), explicit CLI values override
+        cfg = AppConfig.from_env(
             model_path=args.models_path,
             address=args.address,
             port=args.port,

@@ -33,6 +33,7 @@ class AppConfig:
     opaque_errors: bool = False
     disable_webui: bool = False
     csrf: bool = False
+    upload_limit_mb: int = 15  # parity: run.go:49 UPLOAD_LIMIT default
 
     # model management
     galleries: list[dict] = field(default_factory=list)

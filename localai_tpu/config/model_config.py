@@ -163,6 +163,10 @@ class EngineConfig(BaseModel):
                                       # amortizes host→device RTT; lower it
                                       # for tighter streaming cadence
     pipeline_depth: int = 2           # in-flight decode dispatches
+    stream_latency_ms: float = 100.0  # SSE delivery-lag bound: with a stream
+                                      # attached the scheduler shrinks the
+                                      # dispatch size to keep
+                                      # steps×depth×step_time under this
     sp_prefill_threshold: int = 1024  # prompts at/above this many tokens
                                       # take the ring-attention prefill when
                                       # the mesh has a 'seq' axis

@@ -1,0 +1,137 @@
+"""Dynamic configuration: hot-reload of JSON config files while serving.
+
+Parity: /root/reference/core/startup/config_file_watcher.go — fsnotify
+watch over the configuration directory with per-file handlers for
+``api_keys.json`` (dynamic API keys appended to the startup keys) and
+``external_backends.json`` (name → gRPC address registrations). fsnotify
+isn't available here, so a small polling thread diffs mtimes instead —
+the observable contract (edit the file, behavior changes without a
+restart) is the same.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+from pathlib import Path
+from typing import Callable, Optional
+
+log = logging.getLogger(__name__)
+
+Handler = Callable[[Optional[bytes]], None]
+
+
+class ConfigWatcher:
+    """Polls a directory of dynamic config files and fires a handler when
+    one changes (or disappears — handlers receive None to reset)."""
+
+    def __init__(self, config_dir: str | Path, interval: float = 1.0):
+        self.dir = Path(config_dir)
+        self.interval = interval
+        self._handlers: dict[str, Handler] = {}
+        self._mtimes: dict[str, Optional[float]] = {}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def register(self, filename: str, handler: Handler) -> None:
+        """Attach a handler for one file (parity: AddConfigFileHandler,
+        config_file_watcher.go:53-60 — the handler also runs once at
+        registration so pre-existing files apply at boot)."""
+        self._handlers[filename] = handler
+        self._mtimes[filename] = None
+        self._apply(filename)
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(
+            target=self._run, name="config-watcher", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(self.interval + 2.0)
+            self._thread = None
+
+    def poll_once(self) -> None:
+        """One poll cycle (exposed for tests and for callers that want
+        synchronous application)."""
+        for name in list(self._handlers):
+            self._apply(name)
+
+    # -- internals ---------------------------------------------------------
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval):
+            try:
+                self.poll_once()
+            except Exception:  # noqa: BLE001 — watcher must not die
+                log.exception("config watcher poll failed")
+
+    def _apply(self, name: str) -> None:
+        path = self.dir / name
+        try:
+            mtime: Optional[float] = path.stat().st_mtime
+        except OSError:
+            mtime = None
+        if mtime == self._mtimes.get(name):
+            return
+        data: Optional[bytes] = None
+        if mtime is not None:
+            try:
+                data = path.read_bytes()
+            except OSError as e:
+                # do NOT record the mtime: a transient read failure must be
+                # retried on the next poll, not silently dropped forever
+                log.warning("cannot read %s: %s", path, e)
+                return
+        self._mtimes[name] = mtime
+        try:
+            self._handlers[name](data)
+            log.info("dynamic config %s %s", name,
+                     "applied" if data is not None else "cleared")
+        except Exception:  # noqa: BLE001 — bad file ≠ dead watcher
+            log.exception("handler for %s failed", name)
+
+
+def attach_standard_handlers(watcher: ConfigWatcher, state) -> None:
+    """The reference's two built-in dynamic files
+    (config_file_watcher.go:139-172), applied to the live AppState:
+
+      * api_keys.json — JSON array of keys, appended to the keys the
+        server started with (removing the file restores startup keys).
+      * external_backends.json — JSON object name→address, replacing the
+        dynamic registrations in AppConfig.external_backends.
+    """
+    startup_keys = list(state.config.api_keys)
+    startup_backends = dict(state.config.external_backends)
+
+    def on_api_keys(data: Optional[bytes]) -> None:
+        dynamic: list[str] = []
+        if data:
+            parsed = json.loads(data)
+            if not isinstance(parsed, list):
+                raise ValueError("api_keys.json must be a JSON array")
+            dynamic = [str(k) for k in parsed if k]
+        state.config.api_keys = startup_keys + [
+            k for k in dynamic if k not in startup_keys
+        ]
+
+    def on_external_backends(data: Optional[bytes]) -> None:
+        dynamic: dict[str, str] = {}
+        if data:
+            parsed = json.loads(data)
+            if not isinstance(parsed, dict):
+                raise ValueError(
+                    "external_backends.json must be a JSON object"
+                )
+            dynamic = {str(k): str(v) for k, v in parsed.items()}
+        merged = dict(startup_backends)
+        merged.update(dynamic)
+        state.config.external_backends = merged
+
+    watcher.register("api_keys.json", on_api_keys)
+    watcher.register("external_backends.json", on_external_backends)

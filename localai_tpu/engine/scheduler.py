@@ -71,6 +71,9 @@ class GenRequest:
     ignore_eos: bool = False
     constraint: Optional[TokenConstraint] = None
     correlation_id: str = ""
+    # an SSE client is attached: the scheduler bounds delivery lag by
+    # shrinking the per-dispatch step count while this request is active
+    stream: bool = False
     # multimodal injection: image-embedding rows [n_mm, D] scattered over
     # placeholder token positions [n_mm] during prefill (see ModelRunner)
     mm_embeds: Optional[Any] = None
@@ -175,16 +178,28 @@ class Scheduler:
 
     def __init__(self, runner: ModelRunner, tokenizer: Any,
                  *, default_max_tokens: int = 2048, pipeline_depth: int = 2,
-                 multi_step: int = 16):
+                 multi_step: int = 16, stream_latency_target: float = 0.1):
         self.runner = runner
         self.tokenizer = tokenizer
         self.default_max_tokens = default_max_tokens
         self.pipeline_depth = max(1, pipeline_depth)
         # tokens decoded per dispatch (lax.scan inside one program): amortizes
         # the host→device dispatch RTT that dominates single-step decode on a
-        # tunneled chip. Delivery lag ≈ multi_step×pipeline_depth×step-time —
-        # keep the product small enough for <100ms streaming latency.
+        # tunneled chip. Delivery lag ≈ multi_step×pipeline_depth×step-time;
+        # when any active request has an SSE stream attached, the dispatch
+        # size adapts down (power-of-two steps, so at most log2(multi_step)
+        # program variants ever compile) to keep that product under
+        # stream_latency_target seconds. Batch requests keep the full size.
         self.multi_step = max(1, multi_step)
+        self.stream_latency_target = stream_latency_target
+        self._step_ema: Optional[float] = None   # seconds per decoded token
+        self._last_drain_t: Optional[float] = None
+        self.last_dispatch_steps = 0             # observability + tests
+        # program shapes already dispatched once: the FIRST dispatch of a
+        # new step count includes XLA trace+compile time, which must not be
+        # folded into the per-token EMA (one multi-second compile sample
+        # would pin the adaptive size at 1 for a long recovery)
+        self._seen_shapes: set = set()
         self._pending: "queue.Queue[GenHandle]" = queue.Queue()
         self._slots: dict[int, _SlotCtx] = {}
         self._ids = itertools.count()
@@ -238,6 +253,8 @@ class Scheduler:
             "total_prompt_tokens": self.total_prompt_tokens,
             "total_generated_tokens": self.total_generated_tokens,
             "prefix_tokens_reused": self.runner.total_prefix_reused,
+            "last_dispatch_steps": self.last_dispatch_steps,
+            "step_time_ema": self._step_ema,
         }
 
     def shutdown(self, timeout: float = 10.0) -> None:
@@ -262,11 +279,24 @@ class Scheduler:
         # the whole batch).
         from collections import deque
 
-        inflight: deque[tuple[Any, int]] = deque()
+        inflight: deque[tuple[Any, int, int, bool, float, bool]] = deque()
 
         def drain_one() -> None:
-            toks, seq = inflight.popleft()
+            toks, seq, k, pipelined, t_issue, fresh = inflight.popleft()
             rows = np.asarray(toks)
+            now = time.monotonic()
+            # per-token timing for the adaptive streaming dispatch size:
+            # when this dispatch was issued while another was still on the
+            # device, the interval between drains is pure device time for
+            # its k tokens; otherwise (pipeline_depth=1, or a draining
+            # pipeline) issue→drain wall time is the estimate. The first
+            # dispatch of a new program shape is skipped — it pays compile.
+            if not fresh and k > 0:
+                if pipelined and self._last_drain_t is not None:
+                    self._observe_step_time((now - self._last_drain_t) / k)
+                else:
+                    self._observe_step_time((now - t_issue) / k)
+            self._last_drain_t = now
             if rows.ndim == 1:
                 rows = rows[None]
             self._process_rows(rows, seq)
@@ -274,6 +304,7 @@ class Scheduler:
         while not self._stopping:
             admitted = self._admit_pending()
             if not self._slots:
+                self._last_drain_t = None  # idle gap would pollute the EMA
                 if inflight:
                     drain_one()
                     continue
@@ -296,29 +327,47 @@ class Scheduler:
                     constrained = constrained_slots()
                     if not self._slots or not constrained:
                         continue
+                    steps = self._effective_steps()
                     self._dispatch_seq += 1
-                    if len(constrained) == len(self._slots) or self.multi_step == 1:
-                        self._process_rows(
-                            self.runner.step()[None], self._dispatch_seq
-                        )
+                    if len(constrained) == len(self._slots) or steps == 1:
+                        fresh = self._fresh_shape(1)
+                        t0 = time.monotonic()
+                        rows = self.runner.step()[None]
+                        if not fresh:
+                            self._observe_step_time(time.monotonic() - t0)
+                        self.last_dispatch_steps = 1
+                        self._process_rows(rows, self._dispatch_seq)
                     else:
                         freeze = np.zeros(self.runner.num_slots, bool)
                         freeze[list(constrained)] = True
-                        rows = self.runner.step_frozen_n(freeze, self.multi_step)
+                        fresh = self._fresh_shape(("frozen", steps))
+                        t0 = time.monotonic()
+                        rows = self.runner.step_frozen_n(freeze, steps)
+                        if not fresh:
+                            self._observe_step_time(
+                                (time.monotonic() - t0) / steps
+                            )
+                        self.last_dispatch_steps = steps
                         self._process_rows(
                             rows, self._dispatch_seq, frozen=constrained
                         )
+                    self._last_drain_t = None  # sync path: drain clock stale
                 else:
+                    steps = self._effective_steps()
                     self._dispatch_seq += 1
-                    if self.multi_step > 1:
-                        tokens = self.runner.step_n_async(self.multi_step)
+                    fresh = self._fresh_shape(steps)
+                    t_issue = time.monotonic()
+                    if steps > 1:
+                        tokens = self.runner.step_n_async(steps)
                     else:
                         tokens = self.runner.step_async()
+                    self.last_dispatch_steps = steps
                     try:
                         tokens.copy_to_host_async()
                     except AttributeError:
                         pass
-                    inflight.append((tokens, self._dispatch_seq))
+                    inflight.append((tokens, self._dispatch_seq, steps,
+                                     bool(inflight), t_issue, fresh))
                     if len(inflight) >= self.pipeline_depth:
                         drain_one()
             except Exception:  # noqa: BLE001 — engine must not die silently
@@ -329,6 +378,52 @@ class Scheduler:
                         ctx.handle._finish("error")
                         self.runner.release(slot)
                     self._slots.clear()
+
+    def _fresh_shape(self, key) -> bool:
+        """True exactly once per program shape — its first dispatch pays
+        XLA compile and must not feed the timing EMA."""
+        if key in self._seen_shapes:
+            return False
+        self._seen_shapes.add(key)
+        return True
+
+    def _observe_step_time(self, dt: float) -> None:
+        """Fold one per-token timing sample into the EMA that drives the
+        adaptive streaming dispatch size."""
+        if dt <= 0:
+            return
+        self._step_ema = (
+            dt if self._step_ema is None else 0.8 * self._step_ema + 0.2 * dt
+        )
+
+    def _effective_steps(self) -> int:
+        """Tokens per dispatch for the next dispatch.
+
+        Batch-only traffic takes the full multi_step (throughput). With any
+        SSE stream attached, delivery lag ≈ steps×pipeline_depth×step_time
+        must stay under stream_latency_target, so the step count shrinks to
+        fit — quantized DOWN to a power of two, bounding the number of
+        distinct compiled decode programs at log2(multi_step)+1. With no
+        timing sample yet, streams get single-step dispatches (latency-safe;
+        the EMA fills in from the first post-compile dispatch).
+        """
+        k = self.multi_step
+        if k <= 1:
+            return 1
+        with self._lock:
+            streaming = any(
+                c.handle.request.stream for c in self._slots.values()
+            )
+        if not streaming:
+            return k
+        if self._step_ema is None:
+            return 1
+        budget = self.stream_latency_target / max(1, self.pipeline_depth)
+        n = int(budget / self._step_ema) if self._step_ema > 0 else k
+        p = 1
+        while p * 2 <= min(n, k):
+            p *= 2
+        return p
 
     def _admit_pending(self) -> bool:
         admitted = False

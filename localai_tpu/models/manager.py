@@ -202,6 +202,7 @@ def build_serving_model(mcfg: ModelConfig, app: AppConfig) -> ServingModel:
         default_max_tokens=mcfg.parameters.max_tokens or 2048,
         multi_step=eng.decode_steps_per_dispatch,
         pipeline_depth=eng.pipeline_depth,
+        stream_latency_target=eng.stream_latency_ms / 1000.0,
     )
     # vision tower: explicit mmproj ref, or auto from a llava checkpoint dir
     vision = None

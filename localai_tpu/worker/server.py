@@ -155,6 +155,7 @@ class BackendServicer:
             ignore_eos=req.ignore_eos,
             constraint=constraint,
             correlation_id=req.correlation_id,
+            stream=req.stream,
         )
 
     def Predict(self, request: pb.PredictOptions, context) -> pb.Reply:

@@ -61,6 +61,7 @@ def predict_options(gr: GenRequest) -> pb.PredictOptions:
         stop=list(gr.stop),
         ignore_eos=gr.ignore_eos,
         correlation_id=gr.correlation_id,
+        stream=gr.stream,
     )
     for f in _SAMPLING_FIELDS:
         v = getattr(gr, f)

@@ -369,3 +369,53 @@ def test_auth_enforced(tmp_path):
             assert r.status_code == 200
     finally:
         srv.stop()
+
+
+def test_completions_streaming_list_prompt_serves_all(client):
+    """A list prompt streams EVERY prompt, each on its own choice index
+    (previously only templated[0] streamed and the rest silently dropped)."""
+    seen = {}
+    finishes = {}
+    with client.stream("POST", "/v1/completions", json={
+        "model": "tiny",
+        "prompt": ["alpha", "beta"],
+        "max_tokens": 6,
+        "stream": True,
+    }) as r:
+        assert r.status_code == 200
+        for line in r.iter_lines():
+            if not line.startswith("data: "):
+                continue
+            payload = line[len("data: "):]
+            if payload == "[DONE]":
+                break
+            chunk = json.loads(payload)
+            ch = chunk["choices"][0]
+            idx = ch["index"]
+            if ch["finish_reason"] is not None:
+                finishes[idx] = ch["finish_reason"]
+            else:
+                seen[idx] = seen.get(idx, "") + ch["text"]
+    assert set(finishes) == {0, 1}
+    assert all(f in ("stop", "length") for f in finishes.values())
+    assert set(seen) <= {0, 1}
+
+
+def test_correlation_id_echoed_and_traced(client):
+    """X-Correlation-ID flows from the request header into the scheduler's
+    request (visible in engine metrics) and back out on the response
+    (parity: chat.go:164-169)."""
+    r = client.post("/v1/chat/completions", json={
+        "model": "tiny",
+        "messages": [{"role": "user", "content": "trace me"}],
+        "max_tokens": 4,
+    }, headers={"X-Correlation-ID": "trace-abc-123"})
+    assert r.status_code == 200
+    assert r.headers.get("X-Correlation-ID") == "trace-abc-123"
+    # without the header, the generated request id is echoed instead
+    r2 = client.post("/v1/chat/completions", json={
+        "model": "tiny",
+        "messages": [{"role": "user", "content": "no header"}],
+        "max_tokens": 4,
+    })
+    assert r2.headers.get("X-Correlation-ID", "").startswith("chatcmpl-")

@@ -1,0 +1,181 @@
+"""Assistants + Files APIs: CRUD, attachments, and JSON persistence that
+survives a server restart (parity:
+/root/reference/core/http/endpoints/openai/assistant.go, files.go, and the
+boot-time reload in app.go:152-154)."""
+
+import httpx
+import pytest
+from test_api import TINY_YAML, _ServerThread
+
+from localai_tpu.api.server import AppState
+from localai_tpu.config.app_config import AppConfig
+from localai_tpu.config.loader import ConfigLoader
+
+
+def _make_state(root) -> AppState:
+    models = root / "models"
+    models.mkdir(exist_ok=True)
+    (models / "tiny.yaml").write_text(TINY_YAML)
+    cfg = AppConfig(
+        model_path=str(models),
+        config_path=str(root / "conf"),
+        upload_path=str(root / "uploads"),
+    )
+    loader = ConfigLoader(models)
+    loader.load_from_path(context_size=cfg.context_size)
+    return AppState(cfg, loader)
+
+
+@pytest.fixture()
+def server(tmp_path):
+    srv = _ServerThread(_make_state(tmp_path))
+    yield srv
+    srv.stop()
+
+
+def _upload(client, name="notes.txt", content=b"hello files",
+            purpose="assistants"):
+    return client.post("/v1/files", files={"file": (name, content)},
+                       data={"purpose": purpose})
+
+
+def test_file_upload_listing_content_delete(server):
+    with httpx.Client(base_url=server.base, timeout=30.0) as c:
+        r = _upload(c)
+        assert r.status_code == 200, r.text
+        f = r.json()
+        assert f["object"] == "file"
+        assert f["purpose"] == "assistants"
+        assert f["bytes"] == len(b"hello files")
+
+        # purpose filter (files.go:86-98)
+        assert len(c.get("/v1/files").json()["data"]) == 1
+        assert c.get("/v1/files", params={"purpose": "nope"}).json()[
+            "data"] == []
+
+        # metadata + raw content round trip
+        fid = f["id"]
+        assert c.get(f"/v1/files/{fid}").json()["filename"] == "notes.txt"
+        assert c.get(f"/v1/files/{fid}/content").content == b"hello files"
+
+        # duplicate filename rejected; purpose required
+        assert _upload(c).status_code == 400
+        r = c.post("/v1/files", files={"file": ("x.txt", b"y")})
+        assert r.status_code == 400
+
+        # delete removes metadata and bytes
+        assert c.delete(f"/v1/files/{fid}").json()["deleted"] is True
+        assert c.get(f"/v1/files/{fid}").status_code == 404
+        assert c.get("/v1/files").json()["data"] == []
+
+
+def test_upload_rejects_traversal_and_oversize(server):
+    server.state.config.upload_limit_mb = 0  # 0 MB → everything oversize
+    with httpx.Client(base_url=server.base, timeout=30.0) as c:
+        assert _upload(c).status_code == 400
+    server.state.config.upload_limit_mb = 15
+    with httpx.Client(base_url=server.base, timeout=30.0) as c:
+        # filename is flattened to its basename, not written outside
+        r = _upload(c, name="../../evil.txt")
+        assert r.status_code == 200
+        assert r.json()["filename"] == "evil.txt"
+
+
+def test_assistant_crud_and_files(server):
+    with httpx.Client(base_url=server.base, timeout=30.0) as c:
+        # unknown model rejected (assistant.go:86-89)
+        r = c.post("/v1/assistants", json={"model": "missing"})
+        assert r.status_code == 400
+
+        r = c.post("/v1/assistants", json={
+            "model": "tiny", "name": "helper",
+            "instructions": "be brief",
+            "tools": [{"type": "function"}],
+        })
+        assert r.status_code == 200, r.text
+        a = r.json()
+        assert a["object"] == "assistant"
+        aid = a["id"]
+        assert aid.startswith("asst_")
+
+        # list + get + modify
+        assert [x["id"] for x in c.get("/v1/assistants").json()] == [aid]
+        assert c.get(f"/v1/assistants/{aid}").json()["name"] == "helper"
+        r = c.post(f"/v1/assistants/{aid}", json={
+            "model": "tiny", "name": "renamed",
+        })
+        assert r.json()["name"] == "renamed"
+        assert r.json()["id"] == aid
+
+        # attach an uploaded file
+        fid = _upload(c).json()["id"]
+        r = c.post(f"/v1/assistants/{aid}/files", json={"file_id": fid})
+        assert r.status_code == 200
+        assert r.json()["assistant_id"] == aid
+        files = c.get(f"/v1/assistants/{aid}/files").json()["data"]
+        assert [af["id"] for af in files] == [fid]
+        assert c.get(f"/v1/assistants/{aid}").json()["file_ids"] == [fid]
+        assert c.get(
+            f"/v1/assistants/{aid}/files/{fid}").status_code == 200
+
+        # attaching an unknown file 404s
+        r = c.post(f"/v1/assistants/{aid}/files",
+                   json={"file_id": "file-999"})
+        assert r.status_code == 404
+
+        # detach + delete
+        assert c.delete(
+            f"/v1/assistants/{aid}/files/{fid}").json()["deleted"] is True
+        assert c.get(f"/v1/assistants/{aid}").json()["file_ids"] == []
+        assert c.delete(f"/v1/assistants/{aid}").json()["deleted"] is True
+        assert c.get("/v1/assistants").json() == []
+
+
+def test_assistant_list_pagination(server):
+    with httpx.Client(base_url=server.base, timeout=30.0) as c:
+        ids = []
+        for i in range(5):
+            ids.append(c.post("/v1/assistants", json={
+                "model": "tiny", "name": f"a{i}",
+            }).json()["id"])
+        out = c.get("/v1/assistants", params={"limit": 2}).json()
+        assert len(out) == 2
+        asc = c.get("/v1/assistants", params={"order": "asc"}).json()
+        nums = [int(a["id"].removeprefix("asst_")) for a in asc]
+        assert nums == sorted(nums)
+        after = c.get("/v1/assistants",
+                      params={"after": str(nums[2]), "order": "asc"}).json()
+        assert all(int(a["id"].removeprefix("asst_")) > nums[2]
+                   for a in after)
+
+
+def test_persistence_survives_restart(tmp_path):
+    srv = _ServerThread(_make_state(tmp_path))
+    try:
+        with httpx.Client(base_url=srv.base, timeout=30.0) as c:
+            fid = _upload(c).json()["id"]
+            aid = c.post("/v1/assistants", json={
+                "model": "tiny", "name": "persistent",
+            }).json()["id"]
+            c.post(f"/v1/assistants/{aid}/files", json={"file_id": fid})
+    finally:
+        srv.stop()
+
+    # "restart": a fresh AppState over the same directories
+    srv = _ServerThread(_make_state(tmp_path))
+    try:
+        with httpx.Client(base_url=srv.base, timeout=30.0) as c:
+            assistants = c.get("/v1/assistants").json()
+            assert [a["name"] for a in assistants] == ["persistent"]
+            assert assistants[0]["file_ids"] == [fid]
+            files = c.get("/v1/files").json()["data"]
+            assert [f["id"] for f in files] == [fid]
+            assert c.get(
+                f"/v1/files/{fid}/content").content == b"hello files"
+            # id counters continue past persisted ids — no collisions
+            new_aid = c.post("/v1/assistants", json={
+                "model": "tiny", "name": "second",
+            }).json()["id"]
+            assert new_aid != assistants[0]["id"]
+    finally:
+        srv.stop()

@@ -1,0 +1,87 @@
+"""Dynamic config hot-reload (parity: core/startup/config_file_watcher.go
+— edits to api_keys.json / external_backends.json take effect without a
+server restart)."""
+
+import json
+
+import httpx
+
+from localai_tpu.config.app_config import AppConfig
+from localai_tpu.config.loader import ConfigLoader
+from localai_tpu.config.watcher import ConfigWatcher, attach_standard_handlers
+
+
+class _FakeState:
+    def __init__(self, cfg):
+        self.config = cfg
+
+
+def test_api_keys_merge_and_reset(tmp_path):
+    cfg = AppConfig(config_path=str(tmp_path), api_keys=["boot-key"])
+    w = ConfigWatcher(tmp_path, interval=0.05)
+    attach_standard_handlers(w, _FakeState(cfg))
+    assert cfg.api_keys == ["boot-key"]
+
+    (tmp_path / "api_keys.json").write_text(json.dumps(["dyn-key"]))
+    w.poll_once()
+    assert cfg.api_keys == ["boot-key", "dyn-key"]
+
+    # removing the file restores the startup keys
+    (tmp_path / "api_keys.json").unlink()
+    w.poll_once()
+    assert cfg.api_keys == ["boot-key"]
+
+
+def test_bad_file_does_not_clobber_config(tmp_path):
+    cfg = AppConfig(config_path=str(tmp_path), api_keys=["boot-key"])
+    w = ConfigWatcher(tmp_path, interval=0.05)
+    attach_standard_handlers(w, _FakeState(cfg))
+    (tmp_path / "api_keys.json").write_text("{not json")
+    w.poll_once()
+    assert cfg.api_keys == ["boot-key"]
+
+
+def test_external_backends_hot_reload(tmp_path):
+    cfg = AppConfig(config_path=str(tmp_path),
+                    external_backends={"static": "127.0.0.1:1"})
+    w = ConfigWatcher(tmp_path, interval=0.05)
+    attach_standard_handlers(w, _FakeState(cfg))
+    (tmp_path / "external_backends.json").write_text(
+        json.dumps({"mymodel": "127.0.0.1:9999"})
+    )
+    w.poll_once()
+    assert cfg.external_backends == {
+        "static": "127.0.0.1:1", "mymodel": "127.0.0.1:9999",
+    }
+
+
+def test_key_added_while_serving_takes_effect(tmp_path):
+    """End-to-end: a key written to api_keys.json authenticates a request
+    against the live server — no restart."""
+    from test_api import _ServerThread
+
+    from localai_tpu.api.server import AppState
+
+    models = tmp_path / "models"
+    conf = tmp_path / "conf"
+    models.mkdir()
+    conf.mkdir()
+    cfg = AppConfig(model_path=str(models), config_path=str(conf),
+                    api_keys=["boot-key"])
+    loader = ConfigLoader(models)
+    loader.load_from_path(context_size=cfg.context_size)
+    state = AppState(cfg, loader)
+    srv = _ServerThread(state)
+    try:
+        with httpx.Client(base_url=srv.base, timeout=30.0) as c:
+            def models_with(key):
+                return c.get("/v1/models",
+                             headers={"Authorization": f"Bearer {key}"})
+
+            assert models_with("hot-key").status_code == 401
+            (conf / "api_keys.json").write_text(json.dumps(["hot-key"]))
+            state.watcher.poll_once()
+            assert models_with("hot-key").status_code == 200
+            assert models_with("boot-key").status_code == 200
+    finally:
+        srv.stop()
