@@ -196,11 +196,13 @@ async def list_assistants(request: web.Request) -> web.Response:
     out = list(store.assistants)
     order = request.query.get("order", "desc")
     out.sort(key=lambda a: a.get("created", 0), reverse=(order != "asc"))
-    after = request.query.get("after")
-    before = request.query.get("before")
-    if after and after.isdigit():
+    # cursors accept either the bare number or the full 'asst_N' id the
+    # API hands out (OpenAI clients paginate with the latter)
+    after = request.query.get("after", "").removeprefix("asst_")
+    before = request.query.get("before", "").removeprefix("asst_")
+    if after.isdigit():
         out = [a for a in out if _id_num(a["id"], "asst_") > int(after)]
-    if before and before.isdigit():
+    if before.isdigit():
         out = [a for a in out if _id_num(a["id"], "asst_") < int(before)]
     try:
         limit = int(request.query.get("limit", "20"))

@@ -2,9 +2,11 @@
 
 Parity: /root/reference/core/http/endpoints/jina/rerank.go +
 core/backend/rerank.go — POST /v1/rerank {model, query, documents, top_n}
-→ scored documents. The reference fans out to a cross-encoder Python
-backend; here scoring runs on the serving model's embedding path (cosine
-of mean-pooled hidden states), batched through the same engine.
+→ scored documents. Cross-encoder models (``backend: reranker`` or a
+bert-class checkpoint — models/reranker.py, the analogue of
+backend/python/rerankers/) score (query ⊕ doc) jointly in one batched
+forward; any other model falls back to cosine of mean-pooled embeddings
+through the LLM engine.
 """
 
 from __future__ import annotations
@@ -46,6 +48,18 @@ async def rerank(request: web.Request) -> web.Response:
 
     req = sc.OpenAIRequest(model=body.get("model") or "")
     req.model = _default_model(request, req.model)
+    state = _state(request)
+    mcfg = state.loader.get(req.model)
+    if mcfg is not None and state.manager.is_reranker(mcfg):
+        # joint (query ⊕ doc) scoring — order- and interaction-aware
+        rm = await _in_executor(request, state.manager.get_reranker,
+                                req.model)
+        raw, total_tokens = await _in_executor(
+            request, rm.score, query, documents
+        )
+        return _rerank_response(req.model, documents,
+                                [float(s) for s in raw],
+                                total_tokens, top_n)
     sm, _cfg = await _serving(request, req, Usecase.RERANK)
 
     def score_all():
@@ -63,9 +77,15 @@ async def rerank(request: web.Request) -> web.Response:
         return scores, total_tokens
 
     scores, total_tokens = await _in_executor(request, score_all)
+    return _rerank_response(req.model, documents, scores, total_tokens,
+                            top_n)
+
+
+def _rerank_response(model: str, documents: list[str], scores: list[float],
+                     total_tokens: int, top_n: int) -> web.Response:
     order = sorted(range(len(documents)), key=lambda i: -scores[i])[:top_n]
     return web.json_response({
-        "model": req.model,
+        "model": model,
         "usage": {"total_tokens": total_tokens,
                   "prompt_tokens": total_tokens},
         "results": [

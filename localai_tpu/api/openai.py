@@ -357,16 +357,20 @@ async def _completions_stream(request, req, sm, cfg, templated, rid, cid
                 sc.usage(handle.prompt_tokens, handle.completion_tokens),
             )))
 
-    # TaskGroup so one failing pump (e.g. client disconnect mid-write)
-    # cancels its siblings instead of leaving them writing to a dead
-    # response as orphaned tasks
+    # explicit tasks (not bare gather) so one failing pump (e.g. client
+    # disconnect mid-write) cancels its siblings instead of leaving them
+    # writing to a dead response as orphaned tasks; TaskGroup is 3.11+ and
+    # the package supports 3.10
+    tasks = [asyncio.ensure_future(pump(i, h))
+             for i, h in enumerate(handles)]
     try:
-        async with asyncio.TaskGroup() as tg:
-            for i, h in enumerate(handles):
-                tg.create_task(pump(i, h))
+        await asyncio.gather(*tasks)
     except BaseException:
+        for t in tasks:
+            t.cancel()
         for h in handles:
             h.cancel()
+        await asyncio.gather(*tasks, return_exceptions=True)
         raise
     await resp.write(SSE_DONE)
     await resp.write_eof()
@@ -402,7 +406,7 @@ async def edits(request: web.Request) -> web.Response:
     return web.json_response(sc.completion_response(
         rid, req.model, choices, sc.usage(ptotal, ctotal),
         object_name="edit",
-    ))
+    ), headers={"X-Correlation-ID": cid})
 
 
 # ---------------------------------------------------------------------------

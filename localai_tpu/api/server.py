@@ -260,6 +260,17 @@ def serve(app_config: Optional[AppConfig] = None) -> None:
             state.manager.get(name)
         except Exception as e:  # noqa: BLE001
             log.warning("eager load of %s failed: %s", name, e)
+    if cfg.federated and cfg.federated_router:
+        # join a federation: announce our address to the router (parity:
+        # the p2p node advertising its service tunnel, federated_server.go)
+        import socket
+
+        from localai_tpu.federation import announce
+
+        own = cfg.federated_advertise or (
+            f"http://{socket.gethostname()}:{cfg.port}"
+        )
+        announce(cfg.federated_router, own, cfg.peer_token)
     log.info("serving on %s:%d (%d models configured)",
              cfg.address, cfg.port, len(loader.names()))
     web.run_app(create_app(state), host=cfg.address, port=cfg.port,

@@ -22,6 +22,15 @@ def _env_default(name: str, fallback):
     return fallback
 
 
+def _env_bool(name: str, fallback: bool = False) -> bool:
+    """Boolean env flags parse like AppConfig.from_env — 'false'/'0' must
+    mean False, not truthy-nonempty-string."""
+    v = _env_default(name, None)
+    if v is None:
+        return fallback
+    return str(v).lower() in ("1", "true", "yes", "on")
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="localai-tpu",
@@ -81,6 +90,23 @@ def build_parser() -> argparse.ArgumentParser:
 
     worker = sub.add_parser("worker", help="start a gRPC model worker")
     worker.add_argument("--addr", default="127.0.0.1:50051")
+
+    fed = sub.add_parser(
+        "federated", help="run a federation router over instances")
+    fed.add_argument("--address", default=_env_default("address", "0.0.0.0"))
+    fed.add_argument("--port", type=int,
+                     default=int(_env_default("port", 8080)))
+    fed.add_argument("--peers", default=_env_default("peers", ""),
+                     help="comma-separated instance addresses (host:port)")
+    fed.add_argument("--random-worker", action="store_true",
+                     default=_env_bool("random_worker"),
+                     help="random selection instead of least-used")
+    fed.add_argument("--target-worker",
+                     default=_env_default("target_worker", ""),
+                     help="pin all traffic to one instance")
+    fed.add_argument("--peer-token",
+                     default=_env_default("peer_token", ""),
+                     help="shared secret for /federated/register")
 
     sub.add_parser("version", help="print version")
     return p
@@ -213,6 +239,18 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         from localai_tpu.worker.server import serve_worker
 
         serve_worker(args.addr)
+        return 0
+
+    if cmd == "federated":
+        from localai_tpu.federation import FederatedServer
+
+        fs = FederatedServer(
+            [a.strip() for a in args.peers.split(",") if a.strip()],
+            load_balanced=not args.random_worker,
+            worker_target=args.target_worker,
+            peer_token=args.peer_token,
+        )
+        fs.serve(args.address, args.port)
         return 0
 
     parser.error(f"unknown command {cmd!r}")

@@ -56,8 +56,11 @@ class AppConfig:
 
     # distributed / federation
     p2p: bool = False
-    federated: bool = False
-    peer_token: str = ""
+    federated: bool = False           # announce this instance to a router
+    federated_router: str = ""        # router base URL to announce to
+    federated_advertise: str = ""     # address peers reach us at
+                                      # (default http://<hostname>:<port>)
+    peer_token: str = ""              # shared secret guarding registration
 
     # observability
     debug: bool = False

@@ -1,0 +1,12 @@
+"""Federation: one front door over many LocalAI-TPU instances.
+
+Parity: /root/reference/core/p2p/federated.go + federated_server.go.
+"""
+
+from localai_tpu.federation.server import (
+    FederatedNode,
+    FederatedServer,
+    announce,
+)
+
+__all__ = ["FederatedNode", "FederatedServer", "announce"]

@@ -1,0 +1,319 @@
+"""Federated serving: an HTTP router balancing requests over many
+LocalAI-TPU instances.
+
+Parity: /root/reference/core/p2p/federated.go:39-118 (request table,
+random / least-used selection, offline-node eviction) and
+federated_server.go (the listener proxying each connection to the chosen
+node, with a worker-target override). The reference tunnels raw TCP over
+an edgevpn p2p overlay; on TPU pods the instances are plain HTTP servers
+on a datacenter network, so this router proxies at the HTTP layer instead
+— which also buys per-request (not per-connection) balancing, streaming
+pass-through, and retry-on-another-node failover that a blind TCP splice
+cannot do. Node discovery is explicit (static peer list, or instances
+announcing themselves via POST /federated/register — the moral equivalent
+of the p2p service advertisement), guarded by the shared ``peer_token``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import random
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+from aiohttp import ClientSession, ClientTimeout, TCPConnector, web
+
+log = logging.getLogger(__name__)
+
+FED_KEY = web.AppKey("fed", object)
+SESSION_KEY = web.AppKey("session", ClientSession)
+HEALTH_KEY = web.AppKey("health_task", object)
+
+# hop-by-hop headers never forwarded by an HTTP proxy (RFC 9110 §7.6.1)
+HOP_HEADERS = {
+    "connection", "keep-alive", "proxy-authenticate",
+    "proxy-authorization", "te", "trailers", "transfer-encoding",
+    "upgrade", "host", "content-length",
+}
+
+
+@dataclass
+class FederatedNode:
+    """One backing instance (parity: p2p NodeData)."""
+
+    id: str
+    address: str                    # http://host:port
+    online: bool = True
+    requests_served: int = 0        # the requestTable counter
+    failures: int = 0
+    last_seen: float = field(default_factory=time.monotonic)
+
+    def snapshot(self) -> dict:
+        return {
+            "id": self.id,
+            "address": self.address,
+            "online": self.online,
+            "requests_served": self.requests_served,
+        }
+
+
+class FederatedServer:
+    """Request router over a registry of instances.
+
+    Selection (federated.go:40-101): an explicit ``worker_target`` pins all
+    traffic to one node; otherwise least-used when ``load_balanced``,
+    falling back to random. A background loop health-checks ``/healthz``
+    and flips nodes offline/online; offline nodes leave the request table
+    (syncTableStatus parity)."""
+
+    def __init__(self, nodes: Optional[list[str]] = None, *,
+                 load_balanced: bool = True, worker_target: str = "",
+                 peer_token: str = "", health_interval: float = 5.0):
+        self.load_balanced = load_balanced
+        self.worker_target = worker_target
+        self.peer_token = peer_token
+        self.health_interval = health_interval
+        self._lock = threading.Lock()
+        self._nodes: dict[str, FederatedNode] = {}
+        for addr in nodes or []:
+            self.register(addr)
+
+    # -- registry ----------------------------------------------------------
+
+    @staticmethod
+    def _node_id(address: str) -> str:
+        return address.removeprefix("http://").removeprefix("https://")
+
+    def register(self, address: str) -> FederatedNode:
+        if not address.startswith(("http://", "https://")):
+            address = f"http://{address}"
+        nid = self._node_id(address)
+        with self._lock:
+            node = self._nodes.get(nid)
+            if node is None:
+                node = FederatedNode(id=nid, address=address)
+                self._nodes[nid] = node
+                log.info("federation: registered node %s", nid)
+            node.online = True
+            node.last_seen = time.monotonic()
+            return node
+
+    def nodes(self) -> list[FederatedNode]:
+        with self._lock:
+            return list(self._nodes.values())
+
+    def online_nodes(self) -> list[FederatedNode]:
+        return [n for n in self.nodes() if n.online]
+
+    # -- selection (federated.go:40-101) -----------------------------------
+
+    def select(self, exclude: frozenset[str] = frozenset()
+               ) -> Optional[FederatedNode]:
+        if self.worker_target:
+            with self._lock:
+                n = self._nodes.get(self._node_id(self.worker_target))
+            if n is not None and n.online and n.id not in exclude:
+                return n
+            return None
+        candidates = [n for n in self.online_nodes()
+                      if n.id not in exclude]
+        if not candidates:
+            return None
+        if self.load_balanced:
+            low = min(n.requests_served for n in candidates)
+            candidates = [n for n in candidates
+                          if n.requests_served == low]
+        return random.choice(candidates)
+
+    def record_request(self, node: FederatedNode) -> None:
+        with self._lock:
+            node.requests_served += 1
+
+    def mark_offline(self, node: FederatedNode) -> None:
+        with self._lock:
+            node.online = False
+            node.failures += 1
+        log.warning("federation: node %s marked offline", node.id)
+
+    # -- health loop -------------------------------------------------------
+
+    async def _health_loop(self, session: ClientSession) -> None:
+        while True:
+            await asyncio.sleep(self.health_interval)
+            await self.check_health(session)
+
+    async def check_health(self, session: ClientSession) -> None:
+        for node in self.nodes():
+            try:
+                async with session.get(
+                    f"{node.address}/healthz",
+                    timeout=ClientTimeout(total=3.0),
+                ) as resp:
+                    ok = resp.status == 200
+            except Exception:  # noqa: BLE001 — any failure means offline
+                ok = False
+            with self._lock:
+                if ok:
+                    if not node.online:
+                        log.info("federation: node %s back online", node.id)
+                    node.online = True
+                    node.last_seen = time.monotonic()
+                else:
+                    node.online = False
+
+    # -- HTTP app ----------------------------------------------------------
+
+    def create_app(self) -> web.Application:
+        app = web.Application(client_max_size=64 * 1024 * 1024)
+        app[FED_KEY] = self
+        app.router.add_get("/federated/nodes", _nodes_endpoint)
+        app.router.add_post("/federated/register", _register_endpoint)
+        app.router.add_route("*", "/{tail:.*}", _proxy_endpoint)
+
+        async def on_startup(a):
+            # no total timeout (long generations + SSE streams), but a
+            # read-idle cap so a node that accepts connections and then
+            # wedges (e.g. mid-SIGTERM) cannot hold proxied requests
+            # forever — the health loop only protects FUTURE requests
+            a[SESSION_KEY] = ClientSession(
+                connector=TCPConnector(limit=0),
+                timeout=ClientTimeout(total=None, connect=5.0,
+                                      sock_read=600.0),
+            )
+            a[HEALTH_KEY] = asyncio.create_task(
+                self._health_loop(a[SESSION_KEY])
+            )
+
+        async def on_cleanup(a):
+            a[HEALTH_KEY].cancel()
+            await a[SESSION_KEY].close()
+
+        app.on_startup.append(on_startup)
+        app.on_cleanup.append(on_cleanup)
+        return app
+
+    def serve(self, address: str = "0.0.0.0", port: int = 8080) -> None:
+        """Blocking entry (parity: FederatedServer.Start)."""
+        log.info("federated router on %s:%d (%d nodes)", address, port,
+                 len(self._nodes))
+        web.run_app(self.create_app(), host=address, port=port,
+                    print=None, access_log=None)
+
+
+async def _nodes_endpoint(request: web.Request) -> web.Response:
+    fed: FederatedServer = request.app[FED_KEY]
+    return web.json_response({
+        "nodes": [n.snapshot() for n in fed.nodes()],
+        "load_balanced": fed.load_balanced,
+        "worker_target": fed.worker_target,
+    })
+
+
+async def _register_endpoint(request: web.Request) -> web.Response:
+    fed: FederatedServer = request.app[FED_KEY]
+    if fed.peer_token:
+        import hmac
+
+        header = request.headers.get("Authorization", "")
+        token = header.removeprefix("Bearer ").strip()
+        if not hmac.compare_digest(token, fed.peer_token):
+            return web.json_response({"error": "invalid peer token"},
+                                     status=401)
+    try:
+        body = await request.json()
+        address = str(body["address"])
+    except Exception:
+        return web.json_response({"error": "address is required"},
+                                 status=400)
+    node = fed.register(address)
+    return web.json_response(node.snapshot())
+
+
+async def _proxy_endpoint(request: web.Request) -> web.StreamResponse:
+    """Forward one request to a selected node, streaming the response
+    through. A node that fails before any response byte is marked offline
+    and the request retries on another (the HTTP-level upgrade over the
+    reference's one-shot TCP splice)."""
+    fed: FederatedServer = request.app[FED_KEY]
+    session: ClientSession = request.app[SESSION_KEY]
+    body = await request.read()
+    headers = {k: v for k, v in request.headers.items()
+               if k.lower() not in HOP_HEADERS}
+    tried: set[str] = set()
+    while True:
+        node = fed.select(exclude=frozenset(tried))
+        if node is None:
+            return web.json_response(
+                {"error": {"message": "no online federation nodes",
+                           "type": "federation_error", "code": 503}},
+                status=503,
+            )
+        tried.add(node.id)
+        fed.record_request(node)
+        import aiohttp as _aiohttp
+
+        try:
+            upstream = await session.request(
+                request.method,
+                f"{node.address}{request.rel_url}",
+                headers=headers,
+                data=body if body else None,
+            )
+        except (_aiohttp.ClientError, OSError,
+                asyncio.TimeoutError) as e:
+            # failed before any response byte — safe to fail over
+            fed.mark_offline(node)
+            log.warning("federation: %s failed (%s); failing over",
+                        node.id, e)
+            continue
+        try:
+            # response started: stream it through, no retry past this point
+            resp = web.StreamResponse(status=upstream.status)
+            for k, v in upstream.headers.items():
+                if k.lower() not in HOP_HEADERS:
+                    resp.headers[k] = v
+            resp.headers["X-Federated-Node"] = node.id
+            await resp.prepare(request)
+            async for chunk in upstream.content.iter_any():
+                await resp.write(chunk)
+            await resp.write_eof()
+            return resp
+        finally:
+            upstream.release()
+
+
+def announce(router: str, own_address: str, peer_token: str = "",
+             *, retries: int = 30, interval: float = 2.0) -> threading.Thread:
+    """Register this instance with a federated router, retrying in the
+    background until the router is reachable (parity: the p2p node
+    announcing its service tunnel). Returns the announcing thread."""
+    import json
+    import urllib.request
+
+    def run() -> None:
+        url = f"{router.rstrip('/')}/federated/register"
+        payload = json.dumps({"address": own_address}).encode()
+        for _ in range(retries):
+            try:
+                req = urllib.request.Request(
+                    url, data=payload,
+                    headers={"Content-Type": "application/json",
+                             **({"Authorization": f"Bearer {peer_token}"}
+                                if peer_token else {})},
+                )
+                with urllib.request.urlopen(req, timeout=5.0) as resp:
+                    if resp.status == 200:
+                        log.info("announced %s to federation router %s",
+                                 own_address, router)
+                        return
+            except Exception as e:  # noqa: BLE001
+                log.debug("federation announce retry: %s", e)
+            time.sleep(interval)
+        log.warning("could not announce to federation router %s", router)
+
+    t = threading.Thread(target=run, name="fed-announce", daemon=True)
+    t.start()
+    return t

@@ -137,6 +137,60 @@ class ImageServingModel:
         return out
 
 
+@dataclasses.dataclass
+class RerankServingModel:
+    """A loaded cross-encoder under the same lifecycle management as LLMs
+    (watchdog, eviction, /backend/monitor) — parity: the rerankers backend
+    process, /root/reference/backend/python/rerankers/backend.py."""
+
+    name: str
+    config: ModelConfig
+    encoder: Any                      # models.reranker.CrossEncoder
+    loaded_at: float = dataclasses.field(default_factory=time.monotonic)
+    last_used: float = dataclasses.field(default_factory=time.monotonic)
+    _inflight: int = 0
+    _lock: threading.Lock = dataclasses.field(default_factory=threading.Lock)
+    scored: int = 0
+
+    def touch(self) -> None:
+        self.last_used = time.monotonic()
+
+    @property
+    def busy(self) -> bool:
+        with self._lock:
+            return self._inflight > 0
+
+    def alive(self) -> bool:
+        return self.encoder is not None
+
+    def close(self) -> None:
+        self.encoder = None  # frees params once in-flight scores finish
+
+    def engine_metrics(self) -> dict:
+        return {"type": "rerank", "pairs_scored": self.scored}
+
+    def score(self, query: str, documents: list[str]):
+        """(scores, total_tokens). Token counts come from the same encoder
+        snapshot as the scores — the shared self.encoder may be nulled by
+        an eviction the moment the in-flight count drops."""
+        enc = self.encoder  # snapshot: eviction mid-request keeps params
+        if enc is None:
+            raise RuntimeError(f"reranker {self.name} was evicted")
+        with self._lock:
+            self._inflight += 1
+        try:
+            out = enc.score(query, documents)
+            total_tokens = sum(
+                len(enc.tokenizer.encode(t)) for t in [query] + documents
+            )
+        finally:
+            with self._lock:
+                self._inflight -= 1
+        self.scored += len(documents)
+        self.touch()
+        return out, total_tokens
+
+
 def build_serving_model(mcfg: ModelConfig, app: AppConfig) -> ServingModel:
     """Config → live engine: resolve weights, build mesh/shardings, runner,
     scheduler, tokenizer, templates. Shared by the in-process manager and
@@ -291,6 +345,24 @@ class ModelManager:
         (watchdog, eviction, monitor — same contract as LLMs)."""
         return self._get_typed(name, self._load_image, kind="image")
 
+    def get_reranker(self, name: str) -> RerankServingModel:
+        """Load-or-get a cross-encoder reranker (same lifecycle contract)."""
+        return self._get_typed(name, self._load_reranker, kind="rerank")
+
+    def is_reranker(self, mcfg: ModelConfig) -> bool:
+        """Route a model to the cross-encoder path: explicit
+        ``backend: reranker`` or a bert-class checkpoint (auto-detect,
+        guesser parity)."""
+        if mcfg.backend == "reranker":
+            return True
+        if mcfg.backend:
+            return False
+        from localai_tpu.models.reranker import is_reranker_checkpoint
+
+        return is_reranker_checkpoint(
+            mcfg.model or mcfg.name, self.app.model_path
+        )
+
     def _get_typed(self, name: str, load, *, kind: str) -> Any:
         # fast path + cache maintenance under the global lock; the load
         # itself (worker spawn / weight read, tens of seconds) runs under a
@@ -324,14 +396,17 @@ class ModelManager:
             sm = self._models.get(name)
             if sm is None:
                 return None
-            wrong_kind = isinstance(sm, ImageServingModel) != (kind == "image")
-            if wrong_kind:
+            cached_kind = (
+                "image" if isinstance(sm, ImageServingModel)
+                else "rerank" if isinstance(sm, RerankServingModel)
+                else "llm"
+            )
+            if cached_kind != kind:
                 # one name, two modalities: latest request wins (same
                 # semantics as single_active_backend), unless in use
                 if sm.busy:
                     raise RuntimeError(
-                        f"model {name!r} is busy serving as "
-                        f"{'image' if kind != 'image' else 'llm'}"
+                        f"model {name!r} is busy serving as {cached_kind}"
                     )
                 log.info("model %s switching modality; reloading", name)
                 self._evict_locked(name)
@@ -376,6 +451,18 @@ class ModelManager:
         log.info("loaded image model %s in %.1fs", mcfg.name,
                  time.monotonic() - t0)
         return ImageServingModel(name=mcfg.name, config=mcfg, pipeline=pipe)
+
+    def _load_reranker(self, mcfg: ModelConfig) -> RerankServingModel:
+        from localai_tpu.models.reranker import resolve_reranker
+
+        t0 = time.monotonic()
+        enc = resolve_reranker(
+            mcfg.model or mcfg.name, model_path=self.app.model_path,
+            seed=mcfg.seed or 0,
+        )
+        log.info("loaded reranker %s in %.1fs", mcfg.name,
+                 time.monotonic() - t0)
+        return RerankServingModel(name=mcfg.name, config=mcfg, encoder=enc)
 
     # -- shutdown ---------------------------------------------------------
 

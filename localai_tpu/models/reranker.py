@@ -1,0 +1,364 @@
+"""Cross-encoder reranker: joint (query ⊕ document) relevance scoring.
+
+Parity: the reference's rerankers backend
+(/root/reference/backend/python/rerankers/backend.py — wraps the
+`rerankers` library's cross-encoder models, e.g.
+cross-encoder/ms-marco-MiniLM). The TPU-native version implements the
+BERT-class bidirectional encoder + classification head directly in
+functional JAX: all (query, doc) pairs of a request score in ONE batched
+forward (pairs padded to a shared length bucket → static shapes, MXU-sized
+matmuls), instead of the reference's per-pair Python loop.
+
+Why a cross-encoder and not embedding cosine: mean-pooled embedding
+similarity is order- and interaction-blind (bag-of-tokens); the joint
+encoder attends across the query/document boundary, so token order and
+query-conditioned context change the score. The API keeps cosine as the
+fallback for models without a cross-encoder head (api/jina.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+from functools import partial
+from pathlib import Path
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+log = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 384
+    intermediate_size: int = 1536
+    num_layers: int = 6
+    num_heads: int = 12
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    layer_norm_eps: float = 1e-12
+    dtype: str = "float32"
+    cls_id: int = 101
+    sep_id: int = 102
+    pad_id: int = 0
+
+    @classmethod
+    def from_hf(cls, hf: dict, **overrides) -> "BertConfig":
+        kwargs = dict(
+            vocab_size=hf.get("vocab_size", 30522),
+            hidden_size=hf.get("hidden_size", 384),
+            intermediate_size=hf.get("intermediate_size", 1536),
+            num_layers=hf.get("num_hidden_layers", 6),
+            num_heads=hf.get("num_attention_heads", 12),
+            max_position_embeddings=hf.get("max_position_embeddings", 512),
+            type_vocab_size=hf.get("type_vocab_size", 2),
+            layer_norm_eps=hf.get("layer_norm_eps", 1e-12),
+            pad_id=hf.get("pad_token_id", 0),
+        )
+        kwargs.update(overrides)
+        return cls(**kwargs)
+
+
+DEBUG_RERANKERS = {
+    "reranker-tiny": BertConfig(
+        vocab_size=512, hidden_size=64, intermediate_size=128,
+        num_layers=2, num_heads=4, max_position_embeddings=256,
+        # byte tokenizer: reuse BOS/EOS as CLS/SEP, byte 0 as PAD
+        cls_id=256, sep_id=257, pad_id=0,
+    ),
+}
+
+
+def init_params(key, cfg: BertConfig) -> dict:
+    """Random-init parameter pytree (debug presets / tests)."""
+    dt = jnp.dtype(cfg.dtype)
+    D, I = cfg.hidden_size, cfg.intermediate_size
+    ks = iter(jax.random.split(key, 8 + 12 * cfg.num_layers))
+
+    def dense(k, din, dout):
+        return {
+            "w": (jax.random.normal(next(ks), (din, dout)) * 0.02).astype(dt),
+            "b": jnp.zeros((dout,), dt),
+        }
+
+    def ln():
+        return {"g": jnp.ones((D,), dt), "b": jnp.zeros((D,), dt)}
+
+    layers = []
+    for _ in range(cfg.num_layers):
+        layers.append({
+            "q": dense(next(ks), D, D),
+            "k": dense(next(ks), D, D),
+            "v": dense(next(ks), D, D),
+            "attn_out": dense(next(ks), D, D),
+            "attn_ln": ln(),
+            "ffn_in": dense(next(ks), D, I),
+            "ffn_out": dense(next(ks), I, D),
+            "ffn_ln": ln(),
+        })
+    return {
+        "word_emb": (jax.random.normal(
+            next(ks), (cfg.vocab_size, D)) * 0.02).astype(dt),
+        "pos_emb": (jax.random.normal(
+            next(ks), (cfg.max_position_embeddings, D)) * 0.02).astype(dt),
+        "type_emb": (jax.random.normal(
+            next(ks), (cfg.type_vocab_size, D)) * 0.02).astype(dt),
+        "emb_ln": ln(),
+        "layers": layers,
+        "pooler": dense(next(ks), D, D),
+        "classifier": dense(next(ks), D, 1),
+    }
+
+
+def _ln(x, p, eps):
+    mu = jnp.mean(x, -1, keepdims=True)
+    var = jnp.var(x, -1, keepdims=True)
+    return (x - mu) * jax.lax.rsqrt(var + eps) * p["g"] + p["b"]
+
+
+def _dense(x, p):
+    return x @ p["w"] + p["b"]
+
+
+def forward(params: dict, cfg: BertConfig, ids, segments, mask):
+    """[B, L] ids/segments/mask → [B] relevance logits.
+
+    Standard post-LN BERT encoder with bidirectional attention; the pad
+    mask adds -inf to attention scores of padded keys. CLS pooling + tanh
+    pooler + linear head (the cross-encoder scoring shape)."""
+    B, L = ids.shape
+    H = cfg.num_heads
+    Dh = cfg.hidden_size // H
+    pos = jnp.arange(L)[None, :]
+    x = (
+        jnp.take(params["word_emb"], ids, axis=0)
+        + jnp.take(params["pos_emb"], pos, axis=0)
+        + jnp.take(params["type_emb"], segments, axis=0)
+    )
+    x = _ln(x, params["emb_ln"], cfg.layer_norm_eps)
+    # [B, 1, 1, L] additive key mask
+    kmask = jnp.where(mask[:, None, None, :], 0.0, -1e30)
+    for lp in params["layers"]:
+        q = _dense(x, lp["q"]).reshape(B, L, H, Dh).transpose(0, 2, 1, 3)
+        k = _dense(x, lp["k"]).reshape(B, L, H, Dh).transpose(0, 2, 1, 3)
+        v = _dense(x, lp["v"]).reshape(B, L, H, Dh).transpose(0, 2, 1, 3)
+        scores = (q @ k.transpose(0, 1, 3, 2)) / jnp.sqrt(
+            jnp.asarray(Dh, x.dtype)
+        )
+        attn = jax.nn.softmax(scores + kmask, axis=-1)
+        ctx = (attn @ v).transpose(0, 2, 1, 3).reshape(B, L, -1)
+        x = _ln(x + _dense(ctx, lp["attn_out"]), lp["attn_ln"],
+                cfg.layer_norm_eps)
+        h = jax.nn.gelu(_dense(x, lp["ffn_in"]), approximate=False)
+        x = _ln(x + _dense(h, lp["ffn_out"]), lp["ffn_ln"],
+                cfg.layer_norm_eps)
+    pooled = jnp.tanh(_dense(x[:, 0], params["pooler"]))
+    return _dense(pooled, params["classifier"])[:, 0]
+
+
+class CrossEncoder:
+    """Batched (query, doc) scorer over length buckets.
+
+    Pairs are packed ``[CLS] query [SEP] doc [SEP]`` with segment ids
+    0/1 (query/document), padded to the smallest bucket that fits, and
+    scored in one jitted forward per (bucket, padded-batch) shape."""
+
+    def __init__(self, cfg: BertConfig, params: dict, tokenizer: Any,
+                 buckets: tuple[int, ...] = (64, 128, 256, 512)):
+        self.cfg = cfg
+        self.params = params
+        self.tokenizer = tokenizer
+        self.buckets = tuple(
+            b for b in sorted(buckets) if b <= cfg.max_position_embeddings
+        ) or (cfg.max_position_embeddings,)
+        self._fwd = jax.jit(partial(forward, cfg=cfg))
+
+    def _pair(self, q: list[int], d: list[int], L: int
+              ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+        c = self.cfg
+        # truncate the document first (the query is the anchor), matching
+        # the longest_first truncation cross-encoders use
+        budget = L - 3
+        q = q[: max(1, budget // 2)] if len(q) + len(d) > budget else q
+        d = d[: budget - len(q)]
+        ids = [c.cls_id] + q + [c.sep_id] + d + [c.sep_id]
+        seg = [0] * (len(q) + 2) + [1] * (len(d) + 1)
+        mask = [1] * len(ids)
+        pad = L - len(ids)
+        return (
+            np.asarray(ids + [c.pad_id] * pad, np.int32),
+            np.asarray(seg + [0] * pad, np.int32),
+            np.asarray(mask + [0] * pad, np.bool_),
+        )
+
+    def score(self, query: str, documents: list[str]) -> np.ndarray:
+        """[n_docs] relevance scores, one batched forward per bucket."""
+        enc = self.tokenizer.encode
+        q = enc(query)
+        docs = [enc(d) for d in documents]
+        L = self.buckets[-1]
+        for b in self.buckets:
+            if all(len(q) + len(d) + 3 <= b for d in docs):
+                L = b
+                break
+        rows = [self._pair(q, d, L) for d in docs]
+        ids = np.stack([r[0] for r in rows])
+        seg = np.stack([r[1] for r in rows])
+        mask = np.stack([r[2] for r in rows])
+        # pad the batch to a power of two: bounded compile count
+        B = 1
+        while B < len(rows):
+            B *= 2
+        if B > len(rows):
+            padn = B - len(rows)
+            ids = np.concatenate([ids, np.repeat(ids[:1], padn, 0)])
+            seg = np.concatenate([seg, np.repeat(seg[:1], padn, 0)])
+            mask = np.concatenate([mask, np.repeat(mask[:1], padn, 0)])
+        out = self._fwd(self.params, ids=jnp.asarray(ids),
+                        segments=jnp.asarray(seg), mask=jnp.asarray(mask))
+        return np.asarray(out)[: len(rows)].astype(np.float32)
+
+
+# ---------------------------------------------------------------------------
+# loading
+
+
+def _map_hf_bert(cfg: BertConfig, tensors: dict) -> dict:
+    """HF bert cross-encoder layout → our pytree (weights are [out, in] in
+    torch Linear; ours are [in, out])."""
+
+    from localai_tpu.models.loader import _get
+
+    def t(name):
+        return jnp.asarray(_get(tensors, name))
+
+    def dense(prefix):
+        return {"w": t(f"{prefix}.weight").T, "b": t(f"{prefix}.bias")}
+
+    def ln(prefix):
+        return {"g": t(f"{prefix}.weight"), "b": t(f"{prefix}.bias")}
+
+    layers = []
+    for i in range(cfg.num_layers):
+        p = f"bert.encoder.layer.{i}"
+        layers.append({
+            "q": dense(f"{p}.attention.self.query"),
+            "k": dense(f"{p}.attention.self.key"),
+            "v": dense(f"{p}.attention.self.value"),
+            "attn_out": dense(f"{p}.attention.output.dense"),
+            "attn_ln": ln(f"{p}.attention.output.LayerNorm"),
+            "ffn_in": dense(f"{p}.intermediate.dense"),
+            "ffn_out": dense(f"{p}.output.dense"),
+            "ffn_ln": ln(f"{p}.output.LayerNorm"),
+        })
+    return {
+        "word_emb": t("bert.embeddings.word_embeddings.weight"),
+        "pos_emb": t("bert.embeddings.position_embeddings.weight"),
+        "type_emb": t("bert.embeddings.token_type_embeddings.weight"),
+        "emb_ln": ln("bert.embeddings.LayerNorm"),
+        "layers": layers,
+        "pooler": dense("bert.pooler.dense"),
+        "classifier": dense("classifier"),
+    }
+
+
+class _BertTokenizerAdapter:
+    """HFTokenizer view used for pair packing: encode without specials
+    (CLS/SEP are added by the packer), expose the special ids."""
+
+    def __init__(self, model_dir: Path):
+        from localai_tpu.utils.tokenizer import load_tokenizer
+
+        self._tok = load_tokenizer(model_dir)
+        self.vocab_size = self._tok.vocab_size
+
+    def encode(self, text: str) -> list[int]:
+        return self._tok.encode(text, add_bos=False)
+
+    def special_id(self, token: str) -> Optional[int]:
+        """Vocab id of a special token like [CLS], if the tokenizer knows
+        it (ids hardcoded in configs are wrong for re-vocabbed models —
+        and an out-of-vocab id turns jnp.take into NaN fill)."""
+        raw = getattr(self._tok, "_tok", None)
+        if raw is not None and hasattr(raw, "token_to_id"):
+            return raw.token_to_id(token)
+        return None
+
+
+def resolve_reranker(
+    ref: str, model_path: str | Path = "models", seed: int = 0
+) -> CrossEncoder:
+    """Model ref → CrossEncoder.
+
+    * ``debug:reranker-tiny`` — random-weight preset over the byte
+      tokenizer (tests, zero downloads).
+    * a dir holding config.json (model_type: bert) + safetensors — an HF
+      cross-encoder checkpoint (cross-encoder/ms-marco-* layout).
+    """
+    from localai_tpu.utils.tokenizer import ByteTokenizer
+
+    if ref.startswith("debug:"):
+        name = ref.split(":", 1)[1]
+        if name not in DEBUG_RERANKERS:
+            raise ValueError(
+                f"unknown debug reranker {name!r}; "
+                f"have {sorted(DEBUG_RERANKERS)}"
+            )
+        cfg = DEBUG_RERANKERS[name]
+        tok = ByteTokenizer()
+        # packer adds CLS/SEP itself; bare byte encoding here
+        tok_adapter = type("T", (), {
+            "encode": staticmethod(lambda text: list(text.encode("utf-8"))),
+            "vocab_size": tok.vocab_size,
+        })()
+        return CrossEncoder(
+            cfg, init_params(jax.random.key(seed), cfg), tok_adapter
+        )
+
+    for cand in (Path(ref), Path(model_path) / ref):
+        if (cand / "config.json").exists():
+            hf = json.loads((cand / "config.json").read_text())
+            tok = _BertTokenizerAdapter(cand)
+            overrides = {}
+            for field_name, token, default in (
+                ("cls_id", "[CLS]", 101),
+                ("sep_id", "[SEP]", 102),
+                ("pad_id", "[PAD]", hf.get("pad_token_id", 0)),
+            ):
+                tid = tok.special_id(token)
+                overrides[field_name] = tid if tid is not None else default
+            cfg = BertConfig.from_hf(hf, **overrides)
+            if max(cfg.cls_id, cfg.sep_id, cfg.pad_id) >= cfg.vocab_size:
+                raise ValueError(
+                    f"reranker {ref!r}: special ids "
+                    f"(cls={cfg.cls_id}, sep={cfg.sep_id}) exceed "
+                    f"vocab_size={cfg.vocab_size}"
+                )
+            from localai_tpu.models.loader import _open_safetensors
+
+            tensors = _open_safetensors(cand)
+            params = _map_hf_bert(cfg, tensors)
+            return CrossEncoder(cfg, params, tok)
+    raise FileNotFoundError(f"reranker ref {ref!r} not found")
+
+
+def is_reranker_checkpoint(ref: str, model_path: str | Path) -> bool:
+    """True when the ref resolves to a bert-class encoder checkpoint (the
+    auto-detect used by model loading; parity: the reference routes by
+    explicit backend name only — we also sniff model_type)."""
+    if ref.startswith("debug:"):
+        return ref.split(":", 1)[1] in DEBUG_RERANKERS
+    for cand in (Path(ref), Path(model_path) / ref):
+        cj = cand / "config.json"
+        if cj.exists():
+            try:
+                hf = json.loads(cj.read_text())
+            except ValueError:
+                return False
+            return hf.get("model_type") in ("bert", "roberta", "xlm-roberta")
+    return False

@@ -1,0 +1,220 @@
+"""Federation router: registry, balancing, failover, announcement
+(parity: /root/reference/core/p2p/federated.go:39-118 selection +
+request table; federated_server.go proxy loop)."""
+
+import asyncio
+import threading
+import time
+
+import httpx
+import pytest
+from aiohttp import web
+
+from localai_tpu.federation import FederatedNode, FederatedServer, announce
+
+
+class _AppThread:
+    """Any aiohttp app on a random port, in its own loop thread."""
+
+    def __init__(self, app: web.Application):
+        self.port = None
+        self._loop = asyncio.new_event_loop()
+        self._started = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, args=(app,), daemon=True
+        )
+        self._thread.start()
+        assert self._started.wait(15), "app failed to start"
+
+    def _run(self, app):
+        asyncio.set_event_loop(self._loop)
+
+        async def boot():
+            runner = web.AppRunner(app)
+            await runner.setup()
+            site = web.TCPSite(runner, "127.0.0.1", 0)
+            await site.start()
+            self.port = runner.addresses[0][1]
+            self._runner = runner
+            self._started.set()
+
+        self._loop.run_until_complete(boot())
+        self._loop.run_forever()
+
+    @property
+    def addr(self) -> str:
+        return f"127.0.0.1:{self.port}"
+
+    def stop(self):
+        if getattr(self, "_stopped", False):
+            return
+        self._stopped = True
+
+        async def down():
+            await self._runner.cleanup()
+
+        fut = asyncio.run_coroutine_threadsafe(down(), self._loop)
+        fut.result(10)
+        self._loop.call_soon_threadsafe(self._loop.stop)
+        self._thread.join(10)
+
+
+def _instance_app(name: str) -> web.Application:
+    """A stub LocalAI instance: /healthz + an identifying endpoint + SSE."""
+    app = web.Application()
+
+    async def healthz(_):
+        return web.json_response({"status": "ok"})
+
+    async def whoami(request):
+        return web.json_response({
+            "instance": name, "path": str(request.rel_url),
+            "echo": (await request.text()) or None,
+        })
+
+    async def sse(_):
+        resp = web.StreamResponse(
+            headers={"Content-Type": "text/event-stream"})
+        await resp.prepare(_)
+        for i in range(3):
+            await resp.write(f"data: {name}-{i}\n\n".encode())
+        await resp.write_eof()
+        return resp
+
+    app.router.add_get("/healthz", healthz)
+    app.router.add_route("*", "/sse", sse)
+    app.router.add_route("*", "/{tail:.*}", whoami)
+    return app
+
+
+@pytest.fixture()
+def cluster():
+    """Two stub instances + a router in front."""
+    a = _AppThread(_instance_app("a"))
+    b = _AppThread(_instance_app("b"))
+    fed = FederatedServer([a.addr, b.addr], load_balanced=True,
+                          health_interval=0.2)
+    router = _AppThread(fed.create_app())
+    yield a, b, fed, router
+    for srv in (router, a, b):
+        srv.stop()
+
+
+# -- selection unit tests (federated.go:40-101) -----------------------------
+
+
+def test_least_used_selection():
+    fed = FederatedServer(["n1:1", "n2:1"], load_balanced=True)
+    n1, n2 = fed.nodes()
+    n1.requests_served = 5
+    assert fed.select() is n2
+    n2.requests_served = 9
+    assert fed.select() is n1
+
+
+def test_offline_nodes_excluded_and_target_pinning():
+    fed = FederatedServer(["n1:1", "n2:1"], load_balanced=True)
+    n1, n2 = fed.nodes()
+    fed.mark_offline(n1)
+    assert fed.select() is n2
+    fed.mark_offline(n2)
+    assert fed.select() is None
+
+    pinned = FederatedServer(["n1:1", "n2:1"], worker_target="n2:1")
+    assert pinned.select().id == "n2:1"
+    pinned.mark_offline(pinned.select())
+    assert pinned.select() is None  # target down ≠ silently rerouted
+
+
+def test_register_is_idempotent_and_revives():
+    fed = FederatedServer([])
+    n = fed.register("127.0.0.1:9000")
+    fed.mark_offline(n)
+    again = fed.register("http://127.0.0.1:9000")
+    assert again is n
+    assert n.online
+    assert len(fed.nodes()) == 1
+
+
+# -- end-to-end proxy behavior ----------------------------------------------
+
+
+def test_proxy_balances_over_instances(cluster):
+    a, b, fed, router = cluster
+    with httpx.Client(base_url=f"http://{router.addr}",
+                      timeout=10.0) as c:
+        seen = set()
+        for _ in range(6):
+            r = c.post("/v1/chat/completions", json={"x": 1})
+            assert r.status_code == 200
+            seen.add(r.json()["instance"])
+            assert r.headers["X-Federated-Node"] in (a.addr, b.addr)
+        # least-used over two equal nodes must use both
+        assert seen == {"a", "b"}
+        # body and path pass through untouched
+        r = c.post("/v1/some/path?q=2", content=b"payload")
+        assert r.json()["path"] == "/v1/some/path?q=2"
+        assert r.json()["echo"] == "payload"
+
+
+def test_proxy_streams_sse(cluster):
+    _, _, _, router = cluster
+    with httpx.Client(base_url=f"http://{router.addr}",
+                      timeout=10.0) as c:
+        with c.stream("GET", "/sse") as r:
+            lines = [ln for ln in r.iter_lines() if ln]
+        assert len(lines) == 3
+        assert all(ln.startswith("data: ") for ln in lines)
+
+
+def test_failover_when_node_dies(cluster):
+    a, b, fed, router = cluster
+    with httpx.Client(base_url=f"http://{router.addr}",
+                      timeout=10.0) as c:
+        b_node = next(n for n in fed.nodes() if n.id == b.addr)
+        b.stop()
+        # force selection toward the dead node first: it has fewer requests
+        for n in fed.nodes():
+            n.requests_served = 0
+        b_node.requests_served = -1
+        r = c.get("/v1/anything")
+        assert r.status_code == 200
+        assert r.json()["instance"] == "a"   # failed over transparently
+        assert not b_node.online
+        # with every node down, a clean 503 (not a hang)
+        a.stop()
+        r = c.get("/v1/anything")
+        assert r.status_code == 503
+
+
+def test_nodes_endpoint_and_registration_token(cluster):
+    a, b, fed, router = cluster
+    fed.peer_token = "sekrit"
+    with httpx.Client(base_url=f"http://{router.addr}",
+                      timeout=10.0) as c:
+        nodes = c.get("/federated/nodes").json()["nodes"]
+        assert {n["id"] for n in nodes} == {a.addr, b.addr}
+        r = c.post("/federated/register",
+                   json={"address": "127.0.0.1:1"})
+        assert r.status_code == 401
+        r = c.post("/federated/register",
+                   json={"address": "127.0.0.1:1"},
+                   headers={"Authorization": "Bearer sekrit"})
+        assert r.status_code == 200
+        assert len(fed.nodes()) == 3
+
+
+def test_announce_retries_until_router_up():
+    stub = _AppThread(_instance_app("solo"))
+    fed = FederatedServer([], peer_token="tok", health_interval=0.2)
+    router = _AppThread(fed.create_app())
+    try:
+        announce(f"http://{router.addr}", f"http://{stub.addr}",
+                 peer_token="tok", retries=10, interval=0.1)
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and not fed.nodes():
+            time.sleep(0.05)
+        assert [n.id for n in fed.nodes()] == [stub.addr]
+    finally:
+        router.stop()
+        stub.stop()

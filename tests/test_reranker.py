@@ -1,0 +1,210 @@
+"""Cross-encoder rerank: joint (query ⊕ doc) scoring through a bert-class
+encoder (parity: /root/reference/backend/python/rerankers/backend.py),
+with cosine-of-embeddings as the fallback path.
+
+The adversarial fixture: mean-pooled byte-embedding cosine is a
+bag-of-tokens score — it CANNOT separate a document from its anagram
+(identical multiset of bytes → identical mean embedding → identical
+cosine). The cross-encoder attends over positions and the query/document
+boundary, so it separates them.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from localai_tpu.models.reranker import (
+    BertConfig,
+    CrossEncoder,
+    init_params,
+    forward,
+    resolve_reranker,
+)
+
+
+@pytest.fixture(scope="module")
+def encoder() -> CrossEncoder:
+    return resolve_reranker("debug:reranker-tiny")
+
+
+def test_score_shapes_and_determinism(encoder):
+    docs = ["first doc", "second doc", "third"]
+    s1 = encoder.score("a query", docs)
+    s2 = encoder.score("a query", docs)
+    assert s1.shape == (3,)
+    np.testing.assert_allclose(s1, s2, rtol=1e-5)
+    # batch padding must not change scores: same pair alone or in a batch
+    solo = encoder.score("a query", ["first doc"])
+    np.testing.assert_allclose(solo[0], s1[0], rtol=1e-4)
+
+
+def test_scores_are_query_conditioned(encoder):
+    docs = ["alpha beta", "gamma delta"]
+    a = encoder.score("query one", docs)
+    b = encoder.score("a different query", docs)
+    assert not np.allclose(a, b)
+
+
+def test_cross_encoder_beats_cosine_structurally(encoder):
+    """The two adversarial properties cosine-of-embeddings structurally
+    CANNOT have, regardless of weights:
+
+    * symmetry — cos(embed(a), embed(b)) == cos(embed(b), embed(a)) by
+      definition, but relevance is directional (a question is relevant to
+      its answer more than vice versa). The joint encoder is asymmetric
+      (segment ids + packing order).
+    * order blindness at the interaction level — cosine compares two
+      independently pooled vectors; the joint encoder attends across the
+      query/document boundary, so permuting the document changes the
+      query-conditioned score even when pooled summaries barely move.
+    """
+    from localai_tpu.engine.runner import ModelRunner
+    from localai_tpu.models.registry import resolve_model
+
+    doc = "the cat sat on the mat"
+    anagram = "".join(sorted(doc))  # same bytes, destroyed order
+    query = "where did the cat sit"
+
+    # the fallback path the API uses for non-reranker models
+    tiny = resolve_model("debug:tiny", dtype="float32")
+    runner = ModelRunner(tiny.cfg, tiny.params, num_slots=1, max_ctx=96,
+                        prefill_buckets=[64], kv_dtype="float32")
+
+    def cos(a, b):
+        va = np.asarray(runner.embed(tiny.tokenizer.encode(a)))
+        vb = np.asarray(runner.embed(tiny.tokenizer.encode(b)))
+        return float(
+            va @ vb / (np.linalg.norm(va) * np.linalg.norm(vb))
+        )
+
+    # cosine is exactly symmetric; the joint score is not
+    assert cos(query, doc) == pytest.approx(cos(doc, query), abs=1e-12)
+    fwd = float(encoder.score(query, [doc])[0])
+    rev = float(encoder.score(doc, [query])[0])
+    assert abs(fwd - rev) > 1e-7, "joint scoring should be directional"
+
+    # the anagram pair stays separable under the joint score
+    ce = encoder.score(query, [doc, anagram])
+    assert abs(float(ce[0]) - float(ce[1])) > 1e-7, (
+        "cross-encoder collapsed the anagram pair"
+    )
+
+
+def test_long_document_truncation(encoder):
+    long_doc = "x" * 5000
+    s = encoder.score("q", [long_doc])
+    assert np.isfinite(s).all()
+
+
+def test_hf_bert_checkpoint_loading(tmp_path):
+    """A bert cross-encoder checkpoint dir (config.json + safetensors +
+    tokenizer.json) loads and scores — the ms-marco layout."""
+    from safetensors.numpy import save_file
+
+    cfg = BertConfig(
+        vocab_size=64, hidden_size=32, intermediate_size=64,
+        num_layers=1, num_heads=2, max_position_embeddings=64,
+        type_vocab_size=2, cls_id=1, sep_id=2, pad_id=0,
+    )
+    rng = np.random.default_rng(0)
+
+    def w(*shape):
+        return rng.standard_normal(shape).astype(np.float32) * 0.02
+
+    tensors = {
+        "bert.embeddings.word_embeddings.weight": w(64, 32),
+        "bert.embeddings.position_embeddings.weight": w(64, 32),
+        "bert.embeddings.token_type_embeddings.weight": w(2, 32),
+        "bert.embeddings.LayerNorm.weight": np.ones(32, np.float32),
+        "bert.embeddings.LayerNorm.bias": np.zeros(32, np.float32),
+        "bert.pooler.dense.weight": w(32, 32),
+        "bert.pooler.dense.bias": np.zeros(32, np.float32),
+        "classifier.weight": w(1, 32),
+        "classifier.bias": np.zeros(1, np.float32),
+    }
+    p = "bert.encoder.layer.0"
+    for name, shape in [
+        (f"{p}.attention.self.query", (32, 32)),
+        (f"{p}.attention.self.key", (32, 32)),
+        (f"{p}.attention.self.value", (32, 32)),
+        (f"{p}.attention.output.dense", (32, 32)),
+        (f"{p}.intermediate.dense", (64, 32)),
+        (f"{p}.output.dense", (32, 64)),
+    ]:
+        tensors[f"{name}.weight"] = w(*shape)
+        tensors[f"{name}.bias"] = np.zeros(shape[0], np.float32)
+    for lnn in (f"{p}.attention.output.LayerNorm", f"{p}.output.LayerNorm"):
+        tensors[f"{lnn}.weight"] = np.ones(32, np.float32)
+        tensors[f"{lnn}.bias"] = np.zeros(32, np.float32)
+
+    d = tmp_path / "ce-model"
+    d.mkdir()
+    save_file(tensors, d / "model.safetensors")
+    (d / "config.json").write_text(json.dumps({
+        "model_type": "bert", "vocab_size": 64, "hidden_size": 32,
+        "intermediate_size": 64, "num_hidden_layers": 1,
+        "num_attention_heads": 2, "max_position_embeddings": 64,
+        "type_vocab_size": 2, "pad_token_id": 0,
+    }))
+    # minimal wordlevel tokenizer.json
+    vocab = {"[PAD]": 0, "[CLS]": 1, "[SEP]": 2,
+             **{w_: i + 3 for i, w_ in enumerate(
+                 ["cat", "dog", "sat", "ran", "the", "a"])}}
+    (d / "tokenizer.json").write_text(json.dumps({
+        "version": "1.0",
+        "truncation": None, "padding": None,
+        "added_tokens": [], "normalizer": {"type": "Lowercase"},
+        "pre_tokenizer": {"type": "Whitespace"},
+        "post_processor": None, "decoder": None,
+        "model": {"type": "WordLevel", "vocab": vocab, "unk_token": "[PAD]"},
+    }))
+
+    enc = resolve_reranker(str(d))
+    scores = enc.score("the cat", ["cat sat", "dog ran"])
+    assert scores.shape == (2,)
+    assert np.isfinite(scores).all()
+    # loaded weights match a direct forward with the same params
+    direct = forward(
+        enc.params, enc.cfg,
+        *(np.asarray(x)[None] for x in enc._pair(
+            enc.tokenizer.encode("the cat"),
+            enc.tokenizer.encode("cat sat"), 64)),
+    )
+    np.testing.assert_allclose(float(direct[0]), float(scores[0]),
+                               rtol=1e-4)
+
+
+def test_rerank_http_routes_to_cross_encoder(tmp_path):
+    """`backend: reranker` models serve /v1/rerank through the joint
+    scorer and appear under lifecycle management."""
+    import httpx
+    from test_api import _ServerThread, make_state
+
+    (tmp_path / "ce.yaml").write_text(
+        "name: ce\nmodel: 'debug:reranker-tiny'\nbackend: reranker\n"
+        "known_usecases: [rerank]\n"
+    )
+    srv = _ServerThread(make_state(tmp_path))
+    try:
+        with httpx.Client(base_url=srv.base, timeout=60.0) as c:
+            r = c.post("/v1/rerank", json={
+                "model": "ce",
+                "query": "where did the cat sit",
+                "documents": ["the cat sat on the mat", "unrelated text",
+                              "more filler"],
+                "top_n": 2,
+            })
+            assert r.status_code == 200, r.text
+            body = r.json()
+            assert len(body["results"]) == 2
+            assert body["usage"]["total_tokens"] > 0
+            # scores are returned sorted
+            rs = [x["relevance_score"] for x in body["results"]]
+            assert rs == sorted(rs, reverse=True)
+        assert srv.state.manager.loaded_names() == ["ce"]
+        sm = srv.state.manager.get_reranker("ce")
+        assert sm.engine_metrics()["type"] == "rerank"
+        assert sm.engine_metrics()["pairs_scored"] == 3
+    finally:
+        srv.stop()
