@@ -33,6 +33,9 @@ STATE_KEY = web.AppKey("state", object)
 # paths reachable without an API key (parity: auth exemption filter,
 # core/http/middleware/auth.go:17+)
 AUTH_EXEMPT = {"/", "/healthz", "/readyz", "/version"}
+# UI documents are key-free to GET (they hold no data; their JS calls the
+# protected JSON APIs with the key the operator enters in the page header)
+from localai_tpu.api.ui import UI_PREFIXES  # noqa: E402
 
 
 class AppState:
@@ -157,6 +160,9 @@ async def auth_middleware(request: web.Request, handler):
     keys = state.config.api_keys
     if not keys or request.path in AUTH_EXEMPT:
         return await handler(request)
+    if (request.method == "GET" and not state.config.disable_webui
+            and request.path.startswith(UI_PREFIXES)):
+        return await handler(request)
     header = request.headers.get("Authorization", "")
     token = header.removeprefix("Bearer ").strip()
     if token and any(secrets.compare_digest(token, k) for k in keys):
@@ -187,6 +193,12 @@ async def cors_middleware(request: web.Request, handler):
 
 async def welcome(request: web.Request) -> web.Response:
     state = request.app[STATE_KEY]
+    if not state.config.disable_webui:
+        from localai_tpu.api import ui
+
+        # browsers get the UI home; API clients keep the JSON welcome
+        if ui.wants_html(request):
+            return await ui.home(request)
     return web.json_response({
         "message": "LocalAI-TPU",
         "models": state.loader.names(),
@@ -221,6 +233,10 @@ def create_app(state: Optional[AppState] = None) -> web.Application:
     app.add_routes(audio_routes.routes())
     app.add_routes(image_routes.routes())
     app.add_routes(assistant_routes.routes())
+    if not state.config.disable_webui:
+        from localai_tpu.api import ui as ui_routes
+
+        app.add_routes(ui_routes.routes())
 
     async def on_cleanup(_app):
         state.shutdown()

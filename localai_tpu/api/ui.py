@@ -1,0 +1,439 @@
+"""Web UI: server-rendered pages over the existing JSON APIs.
+
+Parity: /root/reference/core/http/routes/ui.go (432 LoC) +
+core/http/views/*.html + elements/gallery.go — home with model status,
+gallery browser with live install-job progress, chat with SSE streaming,
+text2image, and tts playground. The reference renders HTMX templates
+pulling CDN assets; this environment is zero-egress, so every page here is
+a single self-contained document (inline CSS + vanilla JS over fetch/SSE)
+served from the same process. API keys: pages are readable without a key
+(they hold no data), while every JS call attaches the key the operator
+saves in the header field (localStorage) — the JSON APIs stay protected.
+"""
+
+from __future__ import annotations
+
+import html
+import json
+
+from aiohttp import web
+
+CSS = """
+:root { --bg:#0f1217; --panel:#171c24; --line:#2a3240; --fg:#e6e9ee;
+  --dim:#8b95a5; --acc:#4f9cf7; --ok:#38b26f; --warn:#d9923b; }
+* { box-sizing:border-box; }
+body { margin:0; background:var(--bg); color:var(--fg);
+  font:15px/1.5 system-ui, sans-serif; }
+a { color:var(--acc); text-decoration:none; }
+header { display:flex; gap:1.2rem; align-items:center;
+  padding:.7rem 1.2rem; border-bottom:1px solid var(--line);
+  background:var(--panel); flex-wrap:wrap; }
+header .brand { font-weight:700; }
+header nav { display:flex; gap:.9rem; }
+header input { margin-left:auto; }
+main { max-width:980px; margin:1.4rem auto; padding:0 1rem; }
+.card { background:var(--panel); border:1px solid var(--line);
+  border-radius:10px; padding:1rem 1.2rem; margin-bottom:1rem; }
+table { width:100%; border-collapse:collapse; }
+td, th { text-align:left; padding:.45rem .5rem;
+  border-bottom:1px solid var(--line); }
+.badge { font-size:.78em; padding:.1rem .5rem; border-radius:999px;
+  border:1px solid var(--line); color:var(--dim); }
+.badge.loaded { color:var(--ok); border-color:var(--ok); }
+button, input, textarea, select { background:#0c0f14; color:var(--fg);
+  border:1px solid var(--line); border-radius:7px; padding:.45rem .7rem;
+  font:inherit; }
+button { cursor:pointer; background:var(--acc); color:#fff;
+  border-color:transparent; }
+button.sub { background:transparent; color:var(--acc);
+  border-color:var(--line); }
+progress { width:100%; height:8px; }
+#log { white-space:pre-wrap; }
+.msg { padding:.55rem .8rem; border-radius:9px; margin:.4rem 0;
+  max-width:85%; white-space:pre-wrap; }
+.msg.user { background:#23344e; margin-left:auto; }
+.msg.assistant { background:#1d242f; }
+.row { display:flex; gap:.6rem; align-items:center; }
+.row > * { flex:1; }
+.row > button { flex:0; }
+.dim { color:var(--dim); }
+img.out { max-width:100%; border-radius:10px; margin-top:.8rem; }
+"""
+
+JS_COMMON = """
+function authHeaders(extra) {
+  const h = Object.assign({'Content-Type': 'application/json'}, extra||{});
+  const k = localStorage.getItem('apiKey');
+  if (k) h['Authorization'] = 'Bearer ' + k;
+  return h;
+}
+function saveKey(el) { localStorage.setItem('apiKey', el.value); }
+function initKey() {
+  const el = document.getElementById('apikey');
+  if (el) el.value = localStorage.getItem('apiKey') || '';
+}
+document.addEventListener('DOMContentLoaded', initKey);
+"""
+
+
+def _page(title: str, body: str, script: str = "") -> web.Response:
+    doc = f"""<!doctype html>
+<html><head><meta charset="utf-8">
+<meta name="viewport" content="width=device-width, initial-scale=1">
+<title>{html.escape(title)} — LocalAI-TPU</title>
+<style>{CSS}</style></head>
+<body>
+<header>
+  <span class="brand">LocalAI-TPU</span>
+  <nav>
+    <a href="/">Home</a>
+    <a href="/browse">Models</a>
+    <a href="/chat/">Chat</a>
+    <a href="/text2image/">Image</a>
+    <a href="/tts/">TTS</a>
+  </nav>
+  <input id="apikey" placeholder="API key (if set)"
+         onchange="saveKey(this)" size="18">
+</header>
+<main>{body}</main>
+<script>{JS_COMMON}{script}</script>
+</body></html>"""
+    return web.Response(text=doc, content_type="text/html")
+
+
+def _state(request: web.Request):
+    from localai_tpu.api.server import STATE_KEY
+
+    return request.app[STATE_KEY]
+
+
+def _model_names(request: web.Request, usecase=None) -> list[str]:
+    state = _state(request)
+    names = []
+    for n in state.loader.names():
+        cfg = state.loader.get(n)
+        if usecase is None or (cfg is not None and cfg.has_usecase(usecase)):
+            names.append(n)
+    return names
+
+
+def _model_select(names: list[str], selected: str = "") -> str:
+    opts = "".join(
+        f'<option value="{html.escape(n)}"'
+        f'{" selected" if n == selected else ""}>{html.escape(n)}</option>'
+        for n in names
+    )
+    return f'<select id="model">{opts}</select>'
+
+
+# ---------------------------------------------------------------------------
+# home
+
+
+async def home(request: web.Request) -> web.Response:
+    """GET / for browsers (parity: WelcomeEndpoint + index.html —
+    installed models with load state and per-usecase links)."""
+    state = _state(request)
+    loaded = set(state.manager.loaded_names())
+    rows = []
+    from localai_tpu.config.model_config import Usecase
+
+    for name in state.loader.names():
+        cfg = state.loader.get(name)
+        status = ('<span class="badge loaded">loaded</span>'
+                  if name in loaded else '<span class="badge">idle</span>')
+        links = []
+        if cfg is not None and cfg.has_usecase(Usecase.CHAT):
+            links.append(f'<a href="/chat/{html.escape(name)}">chat</a>')
+        if cfg is not None and cfg.has_usecase(Usecase.IMAGE):
+            links.append(
+                f'<a href="/text2image/{html.escape(name)}">image</a>')
+        if cfg is not None and cfg.has_usecase(Usecase.TTS):
+            links.append(f'<a href="/tts/{html.escape(name)}">tts</a>')
+        rows.append(
+            f"<tr><td>{html.escape(name)}</td><td>{status}</td>"
+            f"<td>{' · '.join(links)}</td></tr>"
+        )
+    body = f"""
+<div class="card"><h2>Installed models</h2>
+<table><tr><th>Model</th><th>State</th><th></th></tr>
+{''.join(rows) or '<tr><td colspan=3 class="dim">none installed — '
+ '<a href="/browse">browse the gallery</a></td></tr>'}</table></div>
+<div class="card dim">OpenAI-compatible API at <code>/v1</code> ·
+<a href="/metrics">metrics</a> · <a href="/system">system</a></div>"""
+    return _page("Home", body)
+
+
+# ---------------------------------------------------------------------------
+# gallery browser
+
+
+async def browse(request: web.Request) -> web.Response:
+    """GET /browse (parity: routes/ui.go:124-303 + elements/gallery.go —
+    searchable gallery, install with live job progress, delete)."""
+    body = """
+<div class="card">
+  <h2>Model gallery</h2>
+  <div class="row">
+    <input id="q" placeholder="search models…" oninput="render()">
+  </div>
+  <div id="list" class="dim">loading…</div>
+</div>"""
+    script = """
+// gallery entries are THIRD-PARTY data (fetched index YAMLs): build the
+// table with textContent/dataset, never innerHTML interpolation — a
+// crafted name/description must not script-inject into the operator's
+// browser (which holds the API key in localStorage)
+let MODELS = [];
+async function load() {
+  try {
+    const r = await fetch('/models/available', {headers: authHeaders()});
+    MODELS = await r.json();
+  } catch (e) { MODELS = []; }
+  render();
+}
+function render() {
+  const q = (document.getElementById('q').value || '').toLowerCase();
+  const list = document.getElementById('list');
+  list.textContent = '';
+  const table = document.createElement('table');
+  let shown = 0;
+  MODELS.forEach((m, i) => {
+    if (q && !(m.name + ' ' + (m.description||''))
+        .toLowerCase().includes(q)) return;
+    shown++;
+    const tr = table.insertRow();
+    const td = tr.insertCell();
+    const b = document.createElement('b');
+    b.textContent = m.name;
+    const desc = document.createElement('span');
+    desc.className = 'dim';
+    desc.textContent = m.description || '';
+    const job = document.createElement('div');
+    job.id = 'job-' + i;
+    td.append(b, document.createElement('br'), desc, job);
+    const act = tr.insertCell();
+    const btn = document.createElement('button');
+    if (m.installed) {
+      btn.className = 'sub'; btn.textContent = 'delete';
+      btn.onclick = () => del(m.name);
+    } else {
+      btn.textContent = 'install';
+      btn.onclick = () => install(m.name, i);
+    }
+    act.appendChild(btn);
+  });
+  if (shown) list.appendChild(table);
+  else list.textContent = 'no models match';
+}
+function showErr(slot, text) {
+  slot.textContent = '';
+  const e = document.createElement('span');
+  e.style.color = 'var(--warn)';
+  e.textContent = text;
+  slot.appendChild(e);
+}
+async function install(id, i) {
+  const slot = document.getElementById('job-' + i);
+  slot.innerHTML = '<progress max="100" value="0"></progress>';
+  const r = await fetch('/models/apply', {method: 'POST',
+    headers: authHeaders(), body: JSON.stringify({id})});
+  const body = await r.json().catch(() => ({}));
+  const uuid = body.uuid;
+  if (!r.ok || !uuid) {
+    showErr(slot, (body.error && body.error.message) ||
+            ('install failed (' + r.status + ')'));
+    return;
+  }
+  const timer = setInterval(async () => {
+    const s = await (await fetch('/models/jobs/' + uuid,
+                                 {headers: authHeaders()})).json();
+    slot.querySelector('progress').value = s.progress || 0;
+    if (s.processed) {
+      clearInterval(timer);
+      slot.textContent = '';
+      if (s.error) {
+        showErr(slot, s.error);
+      } else {
+        const ok = document.createElement('span');
+        ok.className = 'badge loaded';
+        ok.textContent = 'installed';
+        slot.appendChild(ok);
+        load();
+      }
+    }
+  }, 700);
+}
+async function del(name) {
+  await fetch('/models/delete/' + encodeURIComponent(name),
+              {method: 'POST', headers: authHeaders()});
+  load();
+}
+load();
+"""
+    return _page("Models", body, script)
+
+
+# ---------------------------------------------------------------------------
+# chat
+
+
+async def chat_page(request: web.Request) -> web.Response:
+    """GET /chat/[model] (parity: ui.go:305-359 + chat.html — streaming
+    chat over /v1/chat/completions SSE)."""
+    from localai_tpu.config.model_config import Usecase
+
+    names = _model_names(request, Usecase.CHAT)
+    selected = request.match_info.get("model", "")
+    body = f"""
+<div class="card">
+  <div class="row"><h2 style="flex:1">Chat</h2>{_model_select(names, selected)}</div>
+  <div id="msgs"></div>
+  <div class="row">
+    <textarea id="inp" rows="2" placeholder="say something…"
+      onkeydown="if(event.key==='Enter'&&!event.shiftKey){{event.preventDefault();send();}}"></textarea>
+    <button onclick="send()">Send</button>
+  </div>
+</div>"""
+    script = """
+const HISTORY = [];
+function bubble(cls, text) {
+  const d = document.createElement('div');
+  d.className = 'msg ' + cls; d.textContent = text;
+  document.getElementById('msgs').appendChild(d);
+  d.scrollIntoView(); return d;
+}
+async function send() {
+  const inp = document.getElementById('inp');
+  const text = inp.value.trim();
+  if (!text) return;
+  inp.value = '';
+  HISTORY.push({role: 'user', content: text});
+  bubble('user', text);
+  const out = bubble('assistant', '…');
+  const resp = await fetch('/v1/chat/completions', {method: 'POST',
+    headers: authHeaders(),
+    body: JSON.stringify({model: document.getElementById('model').value,
+      messages: HISTORY, stream: true})});
+  if (!resp.ok) { out.textContent = 'error: ' + await resp.text(); return; }
+  const reader = resp.body.getReader();
+  const dec = new TextDecoder();
+  let acc = '', buf = '';
+  for (;;) {
+    const {done, value} = await reader.read();
+    if (done) break;
+    buf += dec.decode(value, {stream: true});
+    const frames = buf.split('\\n\\n'); buf = frames.pop();
+    for (const f of frames) {
+      const line = f.split('\\n').find(l => l.startsWith('data: '));
+      if (!line || line === 'data: [DONE]') continue;
+      const delta = JSON.parse(line.slice(6)).choices[0].delta;
+      if (delta && delta.content) {
+        acc += delta.content; out.textContent = acc;
+      }
+    }
+  }
+  HISTORY.push({role: 'assistant', content: acc});
+}
+"""
+    return _page("Chat", body, script)
+
+
+# ---------------------------------------------------------------------------
+# text2image
+
+
+async def text2image_page(request: web.Request) -> web.Response:
+    """GET /text2image/[model] (parity: ui.go:361-395 + text2image.html)."""
+    from localai_tpu.config.model_config import Usecase
+
+    names = _model_names(request, Usecase.IMAGE)
+    selected = request.match_info.get("model", "")
+    body = f"""
+<div class="card">
+  <div class="row"><h2 style="flex:1">Generate image</h2>{_model_select(names, selected)}</div>
+  <div class="row">
+    <input id="prompt" placeholder="a photo of…">
+    <button id="go" onclick="gen()">Generate</button>
+  </div>
+  <div id="out" class="dim"></div>
+</div>"""
+    script = """
+async function gen() {
+  const out = document.getElementById('out');
+  const btn = document.getElementById('go');
+  btn.disabled = true; out.textContent = 'generating…';
+  try {
+    const r = await fetch('/v1/images/generations', {method: 'POST',
+      headers: authHeaders(),
+      body: JSON.stringify({model: document.getElementById('model').value,
+        prompt: document.getElementById('prompt').value,
+        response_format: 'b64_json'})});
+    const body = await r.json();
+    if (!r.ok) throw new Error(JSON.stringify(body.error || body));
+    out.innerHTML = body.data.map(d =>
+      `<img class="out" src="data:image/png;base64,${d.b64_json}">`).join('');
+  } catch (e) { out.textContent = 'error: ' + e.message; }
+  btn.disabled = false;
+}
+"""
+    return _page("Text to image", body, script)
+
+
+# ---------------------------------------------------------------------------
+# tts
+
+
+async def tts_page(request: web.Request) -> web.Response:
+    """GET /tts/[model] (parity: ui.go:397-430 + tts.html)."""
+    from localai_tpu.config.model_config import Usecase
+
+    names = _model_names(request, Usecase.TTS) or _model_names(request)
+    selected = request.match_info.get("model", "")
+    body = f"""
+<div class="card">
+  <div class="row"><h2 style="flex:1">Text to speech</h2>{_model_select(names, selected)}</div>
+  <div class="row">
+    <input id="text" placeholder="text to speak…">
+    <button onclick="speak()">Speak</button>
+  </div>
+  <div id="out"></div>
+</div>"""
+    script = """
+async function speak() {
+  const out = document.getElementById('out');
+  out.textContent = 'synthesizing…';
+  const r = await fetch('/tts', {method: 'POST', headers: authHeaders(),
+    body: JSON.stringify({model: document.getElementById('model').value,
+      input: document.getElementById('text').value})});
+  if (!r.ok) { out.textContent = 'error: ' + await r.text(); return; }
+  const url = URL.createObjectURL(await r.blob());
+  out.innerHTML = `<audio controls autoplay src="${url}"></audio>`;
+}
+"""
+    return _page("TTS", body, script)
+
+
+# ---------------------------------------------------------------------------
+# wiring
+
+
+# page prefixes GETtable without an API key (imported by the server's
+# auth middleware — single source of truth for the exemption)
+UI_PREFIXES = ("/browse", "/chat/", "/text2image/", "/tts/")
+
+
+def wants_html(request: web.Request) -> bool:
+    return "text/html" in request.headers.get("Accept", "")
+
+
+def routes() -> list[web.RouteDef]:
+    return [
+        web.get("/browse", browse),
+        web.get("/chat/", chat_page),
+        web.get("/chat/{model}", chat_page),
+        web.get("/text2image/", text2image_page),
+        web.get("/text2image/{model}", text2image_page),
+        web.get("/tts/", tts_page),
+        web.get("/tts/{model}", tts_page),
+    ]

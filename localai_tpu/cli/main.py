@@ -91,6 +91,57 @@ def build_parser() -> argparse.ArgumentParser:
     worker = sub.add_parser("worker", help="start a gRPC model worker")
     worker.add_argument("--addr", default="127.0.0.1:50051")
 
+    tts = sub.add_parser("tts", help="synthesize speech to a wav file")
+    tts.add_argument("text", nargs="+")
+    tts.add_argument("--model", "-m", default="")
+    tts.add_argument("--voice", "-v", default="alloy")
+    tts.add_argument("--language", "-l", default="")
+    tts.add_argument("--output-file", "-o", default="tts.wav")
+    tts.add_argument("--models-path", default=_env_default(
+        "models_path", "models"))
+
+    tr = sub.add_parser("transcript", help="transcribe a wav file")
+    tr.add_argument("filename")
+    tr.add_argument("--model", "-m", default="")
+    tr.add_argument("--language", "-l", default="")
+    tr.add_argument("--translate", action="store_true")
+    tr.add_argument("--models-path", default=_env_default(
+        "models_path", "models"))
+
+    sg = sub.add_parser("sound-generation",
+                        help="generate audio from a text description")
+    sg.add_argument("text", nargs="+")
+    sg.add_argument("--model", "-m", default="")
+    sg.add_argument("--duration", "-d", type=float, default=3.0)
+    sg.add_argument("--output-file", "-o", default="sound.wav")
+
+    util = sub.add_parser("util", help="model utilities")
+    util_sub = util.add_subparsers(dest="util_command")
+    ci = util_sub.add_parser(
+        "checkpoint-info",
+        help="tensor names/shapes/dtypes of a safetensors checkpoint "
+             "(the safetensors-era gguf-info)")
+    ci.add_argument("path")
+    ci.add_argument("--header", action="store_true",
+                    help="also print config.json")
+    scan = util_sub.add_parser(
+        "scan", help="scan installed models for unsafe weight formats")
+    scan.add_argument("--models-path", default=_env_default(
+        "models_path", "models"))
+    uh = util_sub.add_parser(
+        "usecase-heuristic",
+        help="print the usecases a model config will serve")
+    uh.add_argument("name")
+    uh.add_argument("--models-path", default=_env_default(
+        "models_path", "models"))
+
+    exp = sub.add_parser(
+        "explorer", help="dashboard over a federation router's nodes")
+    exp.add_argument("--address", default="0.0.0.0")
+    exp.add_argument("--port", type=int, default=8085)
+    exp.add_argument("--router", required=True,
+                     help="federation router base URL")
+
     fed = sub.add_parser(
         "federated", help="run a federation router over instances")
     fed.add_argument("--address", default=_env_default("address", "0.0.0.0"))
@@ -120,6 +171,68 @@ def _parse_mesh(spec: str) -> Optional[dict]:
         k, _, v = part.partition("=")
         out[k.strip()] = int(v)
     return out
+
+
+def _run_util(args, parser) -> int:
+    """`util` subcommands (parity: core/cli/util.go — gguf-info/hf-scan/
+    usecase-heuristic, re-targeted at the safetensors ecosystem)."""
+    if args.util_command == "checkpoint-info":
+        from pathlib import Path
+
+        p = Path(args.path)
+        files = [p] if p.is_file() else sorted(p.glob("*.safetensors"))
+        if not files:
+            parser.error(f"no safetensors under {p}")
+        cfg_dir = p.parent if p.is_file() else p
+        if args.header and (cfg_dir / "config.json").exists():
+            print((cfg_dir / "config.json").read_text())
+        from safetensors import safe_open
+
+        total = 0
+        for fp in files:
+            with safe_open(str(fp), framework="numpy") as h:
+                for name in h.keys():
+                    sl = h.get_slice(name)
+                    shape, dtype = sl.get_shape(), sl.get_dtype()
+                    n = 1
+                    for d in shape:
+                        n *= d
+                    total += n
+                    print(f"{name}\t{dtype}\t{list(shape)}")
+        print(f"# total parameters: {total:,}")
+        return 0
+
+    if args.util_command == "scan":
+        # safetensors-era hf-scan: weights must be safetensors; pickle
+        # formats (.bin/.pt/.ckpt) execute arbitrary code at load
+        from pathlib import Path
+
+        bad = []
+        for f in Path(args.models_path).rglob("*"):
+            if f.suffix in (".bin", ".pt", ".pth", ".ckpt", ".pickle",
+                            ".pkl"):
+                bad.append(f)
+        for f in bad:
+            print(f"UNSAFE (pickle-format weights): {f}")
+        print(f"{len(bad)} finding(s)")
+        return 1 if bad else 0
+
+    if args.util_command == "usecase-heuristic":
+        from localai_tpu.config.loader import ConfigLoader
+        from localai_tpu.config.model_config import Usecase
+
+        loader = ConfigLoader(args.models_path)
+        loader.load_from_path()
+        mcfg = loader.get(args.name)
+        if mcfg is None:
+            parser.error(f"model {args.name!r} not found")
+        for uc in Usecase:
+            if mcfg.has_usecase(uc):
+                print(uc.value)
+        return 0
+
+    parser.error("unknown util subcommand")
+    return 2
 
 
 def main(argv: Optional[Sequence[str]] = None) -> int:
@@ -239,6 +352,89 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         from localai_tpu.worker.server import serve_worker
 
         serve_worker(args.addr)
+        return 0
+
+    if cmd == "tts":
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        from localai_tpu.audio import write_wav
+        from localai_tpu.audio.tts import synthesize
+        from localai_tpu.config.loader import ConfigLoader
+
+        voice = args.voice
+        if args.model:
+            loader = ConfigLoader(args.models_path)
+            loader.load_from_path()
+            mcfg = loader.get(args.model)
+            tcfg = getattr(mcfg, "tts", None) if mcfg else None
+            if tcfg is not None and getattr(tcfg, "voice", ""):
+                voice = tcfg.voice
+        samples = synthesize(" ".join(args.text), voice=voice)
+        with open(args.output_file, "wb") as f:
+            f.write(write_wav(samples))
+        print(args.output_file)
+        return 0
+
+    if cmd == "transcript":
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        from pathlib import Path
+
+        from localai_tpu.audio import read_wav
+        from localai_tpu.config.loader import ConfigLoader
+        from localai_tpu.models import whisper as wh
+
+        loader = ConfigLoader(args.models_path)
+        loader.load_from_path()
+        name = args.model
+        if not name:
+            from localai_tpu.config.model_config import Usecase
+
+            for cfg in loader.all():
+                if cfg.has_usecase(Usecase.TRANSCRIPT):
+                    name = cfg.name
+                    break
+        mcfg = loader.get(name) if name else None
+        ref = (mcfg.model if mcfg else name) or name
+        if not ref:
+            parser.error("no transcription model configured; pass --model")
+        if ref.startswith("debug:"):
+            model = wh.debug_model()
+        else:
+            for cand in (Path(ref), Path(args.models_path) / ref):
+                if (cand / "config.json").exists():
+                    model = wh.load_hf_whisper(cand)
+                    break
+            else:
+                parser.error(f"whisper model {ref!r} not found")
+        audio = read_wav(Path(args.filename).read_bytes())
+        result = model.transcribe(
+            audio, language=args.language or None,
+            translate=args.translate,
+        )
+        for seg in result.get("segments", []):
+            print(f"[{seg['start']:7.2f}s → {seg['end']:7.2f}s] "
+                  f"{seg['text']}")
+        print(result.get("text", ""))
+        return 0
+
+    if cmd == "sound-generation":
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        from localai_tpu.audio import write_wav
+        from localai_tpu.audio.tts import generate_sound
+
+        samples = generate_sound(" ".join(args.text),
+                                 duration=args.duration)
+        with open(args.output_file, "wb") as f:
+            f.write(write_wav(samples))
+        print(args.output_file)
+        return 0
+
+    if cmd == "util":
+        return _run_util(args, parser)
+
+    if cmd == "explorer":
+        from localai_tpu.federation.explorer import serve_explorer
+
+        serve_explorer(args.router, args.address, args.port)
         return 0
 
     if cmd == "federated":

@@ -221,6 +221,11 @@ class ModelConfig(BaseModel):
                                             # or 0; embeddings are injected
                                             # over these positions anyway)
     download_files: list[dict[str, Any]] = Field(default_factory=list)
+    # LoRA adapters merged into base weights at load (parity:
+    # backend_config.go:139-141; diffusers backend.py:300-314)
+    lora_adapter: str = ""
+    lora_base: str = ""                     # unused: merge needs no base copy
+    lora_scale: float = 1.0
 
     parameters: PredictionParams = Field(default_factory=PredictionParams)
     template: TemplateConfig = Field(default_factory=TemplateConfig)

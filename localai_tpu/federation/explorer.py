@@ -1,0 +1,88 @@
+"""Explorer: a dashboard over a federation router's node registry.
+
+Parity: /root/reference/core/explorer/ + core/http/views/explorer.html —
+the reference's explorer crawls community p2p networks into a discovery
+database and serves a dashboard; without a p2p overlay, the TPU-native
+explorer points at a federation router (the node registry IS the network)
+and renders its nodes with live health/traffic numbers.
+"""
+
+from __future__ import annotations
+
+import html
+import json
+import logging
+import urllib.request
+
+from aiohttp import web
+
+log = logging.getLogger(__name__)
+
+
+def fetch_nodes(router: str, timeout: float = 5.0) -> dict:
+    url = f"{router.rstrip('/')}/federated/nodes"
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return json.loads(resp.read())
+
+
+async def _fetch_nodes_async(request: web.Request) -> dict:
+    import asyncio
+
+    # urllib blocks (up to its 5s timeout); keep it off the event loop so
+    # a slow router can't freeze the dashboard for other viewers
+    return await asyncio.get_running_loop().run_in_executor(
+        None, fetch_nodes, request.app["router_url"]
+    )
+
+
+async def _index(request: web.Request) -> web.Response:
+    router = request.app["router_url"]
+    try:
+        data = await _fetch_nodes_async(request)
+        err = ""
+    except Exception as e:  # noqa: BLE001 — router down renders as such
+        data = {"nodes": []}
+        err = str(e)
+    rows = "".join(
+        f"<tr><td>{html.escape(n['id'])}</td>"
+        f"<td>{'🟢 online' if n['online'] else '🔴 offline'}</td>"
+        f"<td>{n['requests_served']}</td></tr>"
+        for n in data.get("nodes", [])
+    )
+    doc = f"""<!doctype html><html><head><meta charset="utf-8">
+<meta http-equiv="refresh" content="5">
+<title>LocalAI-TPU explorer</title>
+<style>body{{font:15px system-ui;background:#0f1217;color:#e6e9ee;
+margin:2rem auto;max-width:760px}}td,th{{padding:.4rem .6rem;text-align:
+left;border-bottom:1px solid #2a3240}}table{{width:100%;border-collapse:
+collapse}}.err{{color:#d9923b}}</style></head><body>
+<h2>Federation explorer</h2>
+<p>router: <code>{html.escape(router)}</code>
+{f'<span class="err">({html.escape(err)})</span>' if err else ''}</p>
+<table><tr><th>Node</th><th>Status</th><th>Requests served</th></tr>
+{rows or '<tr><td colspan=3>no nodes registered</td></tr>'}</table>
+<p style="color:#8b95a5">auto-refreshes every 5s</p>
+</body></html>"""
+    return web.Response(text=doc, content_type="text/html")
+
+
+async def _api(request: web.Request) -> web.Response:
+    try:
+        return web.json_response(await _fetch_nodes_async(request))
+    except Exception as e:  # noqa: BLE001
+        return web.json_response({"error": str(e)}, status=502)
+
+
+def create_explorer_app(router: str) -> web.Application:
+    app = web.Application()
+    app["router_url"] = router
+    app.router.add_get("/", _index)
+    app.router.add_get("/api/nodes", _api)
+    return app
+
+
+def serve_explorer(router: str, address: str = "0.0.0.0",
+                   port: int = 8085) -> None:
+    log.info("explorer on %s:%d over router %s", address, port, router)
+    web.run_app(create_explorer_app(router), host=address, port=port,
+                print=None, access_log=None)

@@ -80,6 +80,10 @@ class FederatedServer:
         self._nodes: dict[str, FederatedNode] = {}
         for addr in nodes or []:
             self.register(addr)
+        if worker_target:
+            # the pin target must exist in the registry or select() would
+            # answer 503 forever when it wasn't also listed as a peer
+            self.register(worker_target)
 
     # -- registry ----------------------------------------------------------
 
@@ -262,13 +266,28 @@ async def _proxy_endpoint(request: web.Request) -> web.StreamResponse:
                 headers=headers,
                 data=body if body else None,
             )
-        except (_aiohttp.ClientError, OSError,
-                asyncio.TimeoutError) as e:
-            # failed before any response byte — safe to fail over
+        except (_aiohttp.ClientConnectorError,
+                ConnectionRefusedError) as e:
+            # connection never established — nothing was delivered, so
+            # retrying on another node cannot double-execute
             fed.mark_offline(node)
-            log.warning("federation: %s failed (%s); failing over",
+            log.warning("federation: %s unreachable (%s); failing over",
                         node.id, e)
             continue
+        except (_aiohttp.ClientError, OSError,
+                asyncio.TimeoutError) as e:
+            # the request MAY have reached the node (timeout waiting for
+            # a slow response, reset mid-flight): retrying could
+            # double-execute a non-idempotent call — surface the error
+            fed.mark_offline(node)
+            log.warning("federation: %s failed mid-request (%s)",
+                        node.id, e)
+            return web.json_response(
+                {"error": {"message": f"federation node {node.id} "
+                           f"failed mid-request: {e}",
+                           "type": "federation_error", "code": 502}},
+                status=502,
+            )
         try:
             # response started: stream it through, no retry past this point
             resp = web.StreamResponse(status=upstream.status)

@@ -293,7 +293,8 @@ def load_text_encoder(d: Path):
     return cfg, params
 
 
-def load_diffusers_pipeline(d: Path, **defaults):
+def load_diffusers_pipeline(d: Path, *, lora_adapter: str = "",
+                            lora_scale: float = 1.0, **defaults):
     """Directory with unet/ vae/ text_encoder/ tokenizer/ → DiffusionPipeline."""
     from localai_tpu.image.pipeline import DiffusionPipeline
 
@@ -301,6 +302,13 @@ def load_diffusers_pipeline(d: Path, **defaults):
     unet_cfg, unet_params = load_unet(d / "unet")
     vae_cfg, vae_params = load_vae(d / "vae")
     text_cfg, text_params = load_text_encoder(d / "text_encoder")
+    if lora_adapter:
+        # merged host-side before device placement: the fused weights keep
+        # the jitted UNet unchanged (see image/lora.py)
+        from localai_tpu.image.lora import apply_lora
+
+        apply_lora(unet_params, text_params, lora_adapter,
+                   scale=lora_scale)
     tokenizer = _load_clip_tokenizer(d / "tokenizer", text_cfg)
     log.info("loaded diffusers pipeline from %s (unet %dch, ctx %d)",
              d, unet_cfg.model_channels, unet_cfg.context_dim)

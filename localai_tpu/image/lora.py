@@ -1,0 +1,263 @@
+"""LoRA adapters for the diffusion pipeline: merged into base weights at
+load time.
+
+Parity: /root/reference/backend/python/diffusers/backend.py:300-381 —
+`load_lora_weights` reads a kohya-format safetensors file
+(``lora_unet_*`` / ``lora_te_*`` keys with lora_down/lora_up/alpha) and
+folds ΔW = scale · (alpha/r) · up @ down into each target layer; the
+diffusers/peft layout (``unet.…lora_A/lora_B``) is the other format in
+the wild. Merging (not runtime adapters) is the TPU-right choice: the
+fused weight keeps every matmul a single MXU op and the jitted UNet
+unchanged — a runtime adapter would add two thin matmuls per layer per
+step.
+
+Key normalization: kohya flattens module paths with underscores
+(``lora_unet_down_blocks_0_…_to_q``). We walk OUR param tree (whose
+structure mirrors the diffusers module tree by construction —
+image/loader.py) and emit every targetable site keyed by its flattened
+name, so lookups are exact instead of parsing underscore-ambiguous names.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from pathlib import Path
+from typing import Any, Callable, Optional
+
+import numpy as np
+
+log = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class _Site:
+    """One LoRA-targetable weight: how to read and write it."""
+
+    get: Callable[[], np.ndarray]
+    set: Callable[[Any], None]
+    kind: str  # linear | conv1x1 | conv
+
+
+def _linear(d: dict, key: str) -> _Site:
+    # ours: [in, out]; ΔW comes [out, in]
+    return _Site(lambda: d[key], lambda v: d.__setitem__(key, v), "linear")
+
+
+def _conv_site(d: dict, key: str = "w") -> _Site:
+    # ours: [kh, kw, in, out]; ΔW [out, in, kh, kw]
+    return _Site(lambda: d[key], lambda v: d.__setitem__(key, v), "conv")
+
+
+def _conv1x1(d: dict, key: str = "w") -> _Site:
+    return _Site(lambda: d[key], lambda v: d.__setitem__(key, v), "conv1x1")
+
+
+def _attn_sites(out: dict, base: str, ap: dict) -> None:
+    out[f"{base}.to_q"] = _linear(ap, "wq")
+    out[f"{base}.to_k"] = _linear(ap, "wk")
+    out[f"{base}.to_v"] = _linear(ap, "wv")
+    out[f"{base}.to_out.0"] = _linear(ap, "wo")
+
+
+def _st_sites(out: dict, base: str, sp: dict) -> None:
+    out[f"{base}.proj_in"] = _conv1x1(sp["proj_in"])
+    out[f"{base}.proj_out"] = _conv1x1(sp["proj_out"])
+    for b, bp in enumerate(sp["blocks"]):
+        tb = f"{base}.transformer_blocks.{b}"
+        _attn_sites(out, f"{tb}.attn1", bp["attn1"])
+        _attn_sites(out, f"{tb}.attn2", bp["attn2"])
+        out[f"{tb}.ff.net.0.proj"] = _linear(bp["ff"], "w1")
+        out[f"{tb}.ff.net.2"] = _linear(bp["ff"], "w2")
+
+
+def _res_sites(out: dict, base: str, rp: dict) -> None:
+    out[f"{base}.conv1"] = _conv_site(rp["conv1"])
+    out[f"{base}.conv2"] = _conv_site(rp["conv2"])
+    if "temb" in rp:
+        out[f"{base}.time_emb_proj"] = _linear(rp["temb"], "w")
+    if "skip" in rp:
+        out[f"{base}.conv_shortcut"] = _conv_site(rp["skip"])
+
+
+def unet_sites(params: dict) -> dict[str, _Site]:
+    """Every LoRA-targetable UNet weight keyed by its diffusers module
+    path (the tree mirrors image/loader.py's construction)."""
+    out: dict[str, _Site] = {}
+    for lvl, lp in enumerate(params["down"]):
+        base = f"down_blocks.{lvl}"
+        for j, rp in enumerate(lp["res"]):
+            _res_sites(out, f"{base}.resnets.{j}", rp)
+        for j, sp in enumerate(lp["attn"] or []):
+            _st_sites(out, f"{base}.attentions.{j}", sp)
+    _res_sites(out, "mid_block.resnets.0", params["mid"]["res1"])
+    _res_sites(out, "mid_block.resnets.1", params["mid"]["res2"])
+    _st_sites(out, "mid_block.attentions.0", params["mid"]["attn"])
+    for lvl, lp in enumerate(params["up"]):
+        base = f"up_blocks.{lvl}"
+        for j, rp in enumerate(lp["res"]):
+            _res_sites(out, f"{base}.resnets.{j}", rp)
+        for j, sp in enumerate(lp["attn"] or []):
+            _st_sites(out, f"{base}.attentions.{j}", sp)
+    return out
+
+
+def text_encoder_sites(params: dict) -> dict[str, _Site]:
+    out: dict[str, _Site] = {}
+    for i, layer in enumerate(params["layers"]):
+        base = f"text_model.encoder.layers.{i}"
+        ap = layer["attn"]
+        for ours, theirs in (("q", "q_proj"), ("k", "k_proj"),
+                             ("v", "v_proj"), ("o", "out_proj")):
+            out[f"{base}.self_attn.{theirs}"] = _Site(
+                lambda a=ap, k=f"w{ours}": a[k],
+                lambda v, a=ap, k=f"w{ours}": a.__setitem__(k, v),
+                "linear",
+            )
+        out[f"{base}.mlp.fc1"] = _linear(layer["mlp"], "w1")
+        out[f"{base}.mlp.fc2"] = _linear(layer["mlp"], "w2")
+    return out
+
+
+# ---------------------------------------------------------------------------
+# LoRA file parsing
+
+
+@dataclasses.dataclass
+class LoraLayer:
+    down: np.ndarray   # [r, in] (or [r, in, kh, kw] for convs)
+    up: np.ndarray     # [out, r] (or [out, r, 1, 1])
+    alpha: Optional[float]
+
+    def delta(self, scale: float) -> np.ndarray:
+        """[out, in(...)] merged update (backend.py:360-376)."""
+        r = self.down.shape[0]
+        # alpha == 0 is a valid author-zeroed layer — only MISSING alpha
+        # defaults to 1.0
+        weight = scale * (
+            (self.alpha / r) if self.alpha is not None else 1.0
+        )
+        if self.down.ndim == 4:
+            up = self.up[:, :, 0, 0]                 # [out, r]
+            dw = np.einsum("or,ri...->oi...", up, self.down)
+        else:
+            dw = self.up @ self.down                 # [out, in]
+        return (weight * dw).astype(np.float32)
+
+
+def read_lora_file(path: str | Path) -> dict[tuple[str, str], LoraLayer]:
+    """LoRA safetensors → {(component, flat_module_name): LoraLayer} with
+    component in {"unet", "te"}; accepts kohya (lora_unet_*/lora_te_*,
+    lora_down/lora_up/alpha) and diffusers/peft (unet./text_encoder.
+    prefixes, lora_A/lora_B) layouts."""
+    from safetensors import safe_open
+
+    raw: dict[str, np.ndarray] = {}
+    with safe_open(str(path), framework="numpy") as h:
+        for k in h.keys():
+            arr = h.get_tensor(k)
+            if arr.dtype == np.uint16:
+                import ml_dtypes
+
+                arr = arr.view(ml_dtypes.bfloat16)
+            raw[k] = np.asarray(arr, np.float32)
+
+    groups: dict[tuple[str, str], dict] = {}
+
+    def put(component: str, module: str, part: str, value) -> None:
+        groups.setdefault((component, module.replace(".", "_")), {})[
+            part] = value
+
+    for key, val in raw.items():
+        if key.startswith(("lora_unet_", "lora_te_")):
+            component = "unet" if key.startswith("lora_unet_") else "te"
+            body = key.split("_", 2)[-1]
+            module, _, part = body.partition(".")
+            if part.startswith("lora_down"):
+                put(component, module, "down", val)
+            elif part.startswith("lora_up"):
+                put(component, module, "up", val)
+            elif part == "alpha":
+                put(component, module, "alpha", float(val))
+        elif ".lora_A." in key or ".lora_B." in key or \
+                ".lora.down." in key or ".lora.up." in key:
+            k = key
+            component = "unet"
+            for pre, comp in (("unet.", "unet"), ("text_encoder.", "te"),
+                              ("te.", "te")):
+                if k.startswith(pre):
+                    component, k = comp, k[len(pre):]
+                    break
+            for marker, part in ((".lora_A.", "down"), (".lora_B.", "up"),
+                                 (".lora.down.", "down"),
+                                 (".lora.up.", "up")):
+                if marker in k:
+                    module = k.split(marker)[0]
+                    put(component, module, part, val)
+                    break
+        elif key.endswith(".alpha"):
+            put("unet", key[: -len(".alpha")], "alpha", float(val))
+
+    out: dict[tuple[str, str], LoraLayer] = {}
+    for gk, g in groups.items():
+        if "down" in g and "up" in g:
+            out[gk] = LoraLayer(g["down"], g["up"], g.get("alpha"))
+        else:
+            log.debug("incomplete LoRA group %s (parts: %s)", gk,
+                      sorted(g))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# merging
+
+
+def apply_lora(
+    unet_params: dict,
+    text_params: Optional[dict],
+    lora_path: str | Path,
+    scale: float = 1.0,
+) -> int:
+    """Fold a LoRA file into the (host-side numpy) param trees in place.
+    Returns the number of layers merged. Unknown target modules are
+    skipped with a warning (a LoRA for a different architecture must not
+    silently corrupt weights — shape mismatches raise)."""
+    layers = read_lora_file(lora_path)
+    if not layers:
+        raise ValueError(f"no LoRA layers found in {lora_path}")
+    sites: dict[tuple[str, str], _Site] = {}
+    for name, site in unet_sites(unet_params).items():
+        sites[("unet", name.replace(".", "_"))] = site
+    if text_params is not None:
+        for name, site in text_encoder_sites(text_params).items():
+            sites[("te", name.replace(".", "_"))] = site
+
+    merged = 0
+    for key, layer in layers.items():
+        site = sites.get(key)
+        if site is None:
+            log.warning("LoRA target %s/%s has no matching module; "
+                        "skipping", *key)
+            continue
+        dw = layer.delta(scale)
+        w = np.asarray(site.get(), np.float32)
+        if site.kind == "linear":
+            upd = dw.T                                   # [in, out]
+        elif site.kind == "conv1x1":
+            if dw.ndim == 4:
+                dw = dw[:, :, 0, 0]
+            upd = dw.T[None, None]                       # [1,1,in,out]
+        else:  # conv [kh,kw,in,out] ← ΔW [out,in,kh,kw]
+            if dw.ndim == 2:                             # 1x1-shaped file
+                dw = dw[:, :, None, None]
+            upd = dw.transpose(2, 3, 1, 0)
+        if upd.shape != w.shape:
+            raise ValueError(
+                f"LoRA {key} shape {upd.shape} does not match target "
+                f"{w.shape} — wrong base model?"
+            )
+        site.set(w + upd)
+        merged += 1
+    log.info("merged %d LoRA layer(s) from %s (scale %.2f)", merged,
+             lora_path, scale)
+    return merged

@@ -233,6 +233,8 @@ def resolve_image_model(
                 f"unknown debug image preset {name!r}; have "
                 f"{sorted(_DEBUG_PRESETS)}"
             )
+        defaults.pop("lora_adapter", None)
+        defaults.pop("lora_scale", None)
         return _debug_pipeline(name, **defaults)
     for cand in (Path(ref), Path(model_path) / ref):
         if (cand / "model_index.json").exists() or (cand / "unet").is_dir():

@@ -179,10 +179,7 @@ class RerankServingModel:
         with self._lock:
             self._inflight += 1
         try:
-            out = enc.score(query, documents)
-            total_tokens = sum(
-                len(enc.tokenizer.encode(t)) for t in [query] + documents
-            )
+            out, total_tokens = enc.score_with_usage(query, documents)
         finally:
             with self._lock:
                 self._inflight -= 1
@@ -308,6 +305,7 @@ class ModelManager:
         self._models: dict[str, Any] = {}   # ServingModel | WorkerServingModel
                                             # | ImageServingModel
         self._load_locks: dict[str, threading.Lock] = {}
+        self._reranker_detect: dict[tuple, bool] = {}
         self._lock = threading.RLock()
         self._pool = None                   # WorkerPool, created on demand
         self._watchdog: Optional[_Watchdog] = None
@@ -352,16 +350,31 @@ class ModelManager:
     def is_reranker(self, mcfg: ModelConfig) -> bool:
         """Route a model to the cross-encoder path: explicit
         ``backend: reranker`` or a bert-class checkpoint (auto-detect,
-        guesser parity)."""
+        guesser parity). The filesystem sniff is cached — this runs on
+        every /v1/rerank request, on the event loop."""
         if mcfg.backend == "reranker":
             return True
         if mcfg.backend:
             return False
+        key = (mcfg.name, mcfg.model)
+        now = time.monotonic()
+        with self._lock:
+            hit = self._reranker_detect.get(key)
+        # positive hits are stable (a bert checkpoint stays bert);
+        # negatives expire so installing the checkpoint later is picked
+        # up without a restart
+        if hit is not None:
+            found, at = hit
+            if found or now - at < 30.0:
+                return found
         from localai_tpu.models.reranker import is_reranker_checkpoint
 
-        return is_reranker_checkpoint(
+        found = is_reranker_checkpoint(
             mcfg.model or mcfg.name, self.app.model_path
         )
+        with self._lock:
+            self._reranker_detect[key] = (found, now)
+        return found
 
     def _get_typed(self, name: str, load, *, kind: str) -> Any:
         # fast path + cache maintenance under the global lock; the load
@@ -444,6 +457,16 @@ class ModelManager:
             kwargs["default_cfg_scale"] = d.cfg_scale
         if d.clip_skip:
             kwargs["clip_skip"] = d.clip_skip
+        if mcfg.lora_adapter:
+            from pathlib import Path
+
+            lp = Path(mcfg.lora_adapter)
+            if not lp.is_absolute():
+                # relative adapters resolve against the models dir
+                # (parity: backend.py:300-305)
+                lp = Path(self.app.model_path) / lp
+            kwargs["lora_adapter"] = str(lp)
+            kwargs["lora_scale"] = mcfg.lora_scale
         t0 = time.monotonic()
         pipe = resolve_image_model(
             mcfg.model or mcfg.name, model_path=self.app.model_path, **kwargs

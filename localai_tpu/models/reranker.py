@@ -198,9 +198,16 @@ class CrossEncoder:
 
     def score(self, query: str, documents: list[str]) -> np.ndarray:
         """[n_docs] relevance scores, one batched forward per bucket."""
+        return self.score_with_usage(query, documents)[0]
+
+    def score_with_usage(self, query: str, documents: list[str]
+                         ) -> tuple[np.ndarray, int]:
+        """(scores, total input tokens) — usage comes from the one
+        tokenization pass the forward needs anyway."""
         enc = self.tokenizer.encode
         q = enc(query)
         docs = [enc(d) for d in documents]
+        total_tokens = len(q) + sum(len(d) for d in docs)
         L = self.buckets[-1]
         for b in self.buckets:
             if all(len(q) + len(d) + 3 <= b for d in docs):
@@ -221,7 +228,8 @@ class CrossEncoder:
             mask = np.concatenate([mask, np.repeat(mask[:1], padn, 0)])
         out = self._fwd(self.params, ids=jnp.asarray(ids),
                         segments=jnp.asarray(seg), mask=jnp.asarray(mask))
-        return np.asarray(out)[: len(rows)].astype(np.float32)
+        scores = np.asarray(out)[: len(rows)].astype(np.float32)
+        return scores, total_tokens
 
 
 # ---------------------------------------------------------------------------

@@ -3,8 +3,9 @@
 Parity: /root/reference/pkg/downloader/uri.go — schemes
 ``huggingface://owner/repo/file@branch``, ``github:``/``github://``,
 ``file://``, http(s); sha256 verification; resume via ``.partial`` suffix;
-progress callbacks. ``oci://``/``ollama://`` are recognized but gated off
-(no OCI client in this environment).
+progress callbacks. ``oci://`` (image layers extracted beside the target)
+and ``ollama://`` (model layer blob) ride the registry client in
+localai_tpu.utils.oci.
 """
 
 from __future__ import annotations
@@ -96,10 +97,20 @@ def download_uri(
     if uri.startswith(FILE_PREFIX):
         src = Path(uri[len(FILE_PREFIX):])
         shutil.copyfile(src, dest)
-    elif uri.startswith((OCI_PREFIX, OLLAMA_PREFIX)):
-        raise NotImplementedError(
-            f"OCI/Ollama registries are not available in this build: {uri}"
-        )
+    elif uri.startswith(OLLAMA_PREFIX):
+        # the model layer blob becomes the destination file
+        # (parity: uri.go:221-223 → OllamaFetchModel)
+        from localai_tpu.utils.oci import ollama_fetch_model
+
+        ollama_fetch_model(uri[len(OLLAMA_PREFIX):], dest, progress)
+    elif uri.startswith(OCI_PREFIX):
+        # image layers extract into the destination's directory; there is
+        # no single output file to checksum (parity: uri.go:226-232 —
+        # the reference also returns before its sha check)
+        from localai_tpu.utils.oci import oci_extract_image
+
+        oci_extract_image(uri[len(OCI_PREFIX):], dest.parent, progress)
+        return dest
     else:
         _http_download(resolve_url(uri), dest, progress, timeout)
 

@@ -1,0 +1,258 @@
+"""OCI registry v2 client: ollama model pulls and OCI image extraction.
+
+Parity: /root/reference/pkg/oci/{ollama,image,blob}.go — the reference
+uses containerd + go-containerregistry; this is a dependency-free
+implementation of the small slice of the distribution spec those paths
+actually use: anonymous Bearer token auth, manifest fetch (including
+manifest lists), digest-verified blob download, the ollama model-layer
+convention (mediaType containing "model"), and tar-layer extraction with
+a path traversal guard.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import tarfile
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Callable, Optional
+
+log = logging.getLogger(__name__)
+
+ProgressFn = Callable[[int, int], None]
+
+MANIFEST_TYPES = (
+    "application/vnd.oci.image.manifest.v1+json",
+    "application/vnd.docker.distribution.manifest.v2+json",
+)
+INDEX_TYPES = (
+    "application/vnd.oci.image.index.v1+json",
+    "application/vnd.docker.distribution.manifest.list.v2+json",
+)
+
+
+@dataclass
+class ImageRef:
+    """registry/repository:tag(@digest) parsed per the docker reference
+    grammar (parity: ParseImageParts, pkg/oci/image.go)."""
+
+    registry: str
+    repository: str
+    reference: str  # tag or sha256:... digest
+    scheme: str = "https"
+
+    @property
+    def base(self) -> str:
+        return f"{self.scheme}://{self.registry}/v2/{self.repository}"
+
+
+def parse_image_ref(image: str, *, default_registry: str = "docker.io",
+                    default_tag: str = "latest") -> ImageRef:
+    """'gemma:2b' → registry.ollama.ai/library/gemma:2b style defaulting;
+    full refs like 'quay.io/org/repo:tag' and digests pass through."""
+    scheme = "https"
+    if image.startswith("http://"):     # tests / local registries
+        scheme, image = "http", image[len("http://"):]
+    elif image.startswith("https://"):
+        image = image[len("https://"):]
+    digest = ""
+    if "@" in image:
+        image, digest = image.split("@", 1)
+    head, _, rest = image.partition("/")
+    if rest and ("." in head or ":" in head or head == "localhost"):
+        registry, path = head, rest
+    else:
+        registry, path = default_registry, image
+    if registry == "docker.io":
+        registry = "registry-1.docker.io"
+    tag = default_tag
+    if ":" in path.rsplit("/", 1)[-1]:
+        path, tag = path.rsplit(":", 1)
+    if "/" not in path:
+        path = f"library/{path}"
+    return ImageRef(registry, path, digest or tag, scheme=scheme)
+
+
+class RegistryClient:
+    """Minimal distribution-spec client with anonymous token auth."""
+
+    def __init__(self, ref: ImageRef, timeout: float = 60.0):
+        import requests
+
+        self.ref = ref
+        self.timeout = timeout
+        self._session = requests.Session()
+        self._token: Optional[str] = None
+
+    def _get(self, url: str, headers: Optional[dict] = None, *,
+             stream: bool = False):
+        h = dict(headers or {})
+        if self._token:
+            h["Authorization"] = f"Bearer {self._token}"
+        resp = self._session.get(url, headers=h, stream=stream,
+                                 timeout=self.timeout)
+        if resp.status_code == 401 and self._token is None:
+            self._authenticate(resp.headers.get("WWW-Authenticate", ""))
+            if self._token:
+                h["Authorization"] = f"Bearer {self._token}"
+                resp = self._session.get(url, headers=h, stream=stream,
+                                         timeout=self.timeout)
+        resp.raise_for_status()
+        return resp
+
+    def _authenticate(self, challenge: str) -> None:
+        """Bearer realm="…",service="…"(,scope="…") → anonymous token
+        (parity: the transport go-containerregistry sets up)."""
+        if not challenge.startswith("Bearer "):
+            return
+        fields = {}
+        for part in challenge[len("Bearer "):].split(","):
+            k, _, v = part.strip().partition("=")
+            fields[k] = v.strip('"')
+        realm = fields.get("realm")
+        if not realm:
+            return
+        params = {
+            "service": fields.get("service", ""),
+            "scope": fields.get(
+                "scope", f"repository:{self.ref.repository}:pull"
+            ),
+        }
+        resp = self._session.get(realm, params=params, timeout=self.timeout)
+        resp.raise_for_status()
+        body = resp.json()
+        self._token = body.get("token") or body.get("access_token")
+
+    # -- manifests ---------------------------------------------------------
+
+    def manifest(self, reference: Optional[str] = None) -> dict:
+        """Fetch and (for indexes) resolve to a concrete image manifest —
+        linux/amd64 preferred, else the first entry."""
+        ref = reference or self.ref.reference
+        resp = self._get(
+            f"{self.ref.base}/manifests/{ref}",
+            headers={"Accept": ", ".join(MANIFEST_TYPES + INDEX_TYPES)},
+        )
+        m = resp.json()
+        mtype = m.get("mediaType", "")
+        if mtype in INDEX_TYPES or "manifests" in m and "layers" not in m:
+            entries = m.get("manifests", [])
+            if not entries:
+                raise ValueError("empty manifest index")
+            chosen = next(
+                (e for e in entries
+                 if (e.get("platform") or {}).get("os") == "linux"
+                 and (e.get("platform") or {}).get("architecture")
+                 == "amd64"),
+                entries[0],
+            )
+            return self.manifest(chosen["digest"])
+        return m
+
+    # -- blobs -------------------------------------------------------------
+
+    def fetch_blob(self, digest: str, dest: str | Path,
+                   progress: Optional[ProgressFn] = None,
+                   expected_size: int = 0) -> Path:
+        """Stream a blob to dest, verifying the sha256 digest (parity:
+        FetchImageBlob, pkg/oci/blob.go:15)."""
+        dest = Path(dest)
+        dest.parent.mkdir(parents=True, exist_ok=True)
+        algo, _, want = digest.partition(":")
+        if algo != "sha256":
+            raise ValueError(f"unsupported digest algorithm {algo!r}")
+        h = hashlib.sha256()
+        done = 0
+        partial = dest.with_suffix(dest.suffix + ".partial")
+        resp = self._get(f"{self.ref.base}/blobs/{digest}", stream=True)
+        total = int(resp.headers.get("Content-Length") or expected_size)
+        with open(partial, "wb") as f:
+            for chunk in resp.iter_content(1 << 20):
+                f.write(chunk)
+                h.update(chunk)
+                done += len(chunk)
+                if progress:
+                    progress(done, total)
+        if h.hexdigest() != want:
+            partial.unlink(missing_ok=True)
+            raise ValueError(
+                f"digest mismatch for {digest}: got sha256:{h.hexdigest()}"
+            )
+        partial.replace(dest)
+        return dest
+
+
+def ollama_fetch_model(image: str, dest: str | Path,
+                       progress: Optional[ProgressFn] = None) -> Path:
+    """ollama://gemma:2b → download the model layer (the GGUF weights) to
+    ``dest`` (parity: OllamaFetchModel, pkg/oci/ollama.go:79 — the layer
+    whose mediaType contains "model")."""
+    ref = parse_image_ref(image, default_registry="registry.ollama.ai")
+    client = RegistryClient(ref)
+    manifest = client.manifest()
+    layer = next(
+        (l for l in manifest.get("layers", [])
+         if "model" in l.get("mediaType", "")),
+        None,
+    )
+    if layer is None:
+        raise ValueError(f"no model layer in ollama manifest for {image}")
+    return client.fetch_blob(
+        layer["digest"], dest, progress,
+        expected_size=layer.get("size", 0),
+    )
+
+
+def _safe_extract_tar(tf: tarfile.TarFile, dest: Path) -> None:
+    """Extract with a traversal guard (parity: the reference relies on
+    containerd's archive code; VerifyPath is our equivalent contract)."""
+    from localai_tpu.utils.paths import verify_path
+
+    for member in tf.getmembers():
+        if member.issym() or member.islnk():
+            # links could point outside the tree; models don't need them
+            log.warning("skipping link %s in layer tar", member.name)
+            continue
+        verify_path(member.name, dest)  # raises on ../ escapes
+        tf.extract(member, dest)
+
+
+def oci_extract_image(image: str, dest_dir: str | Path,
+                      progress: Optional[ProgressFn] = None) -> Path:
+    """oci://registry/repo:tag → pull all layers and extract them in order
+    into ``dest_dir`` (parity: GetImage + ExtractOCIImage,
+    pkg/oci/image.go — uri.go:226-232 extracts into the target's dir)."""
+    import gzip
+    import shutil
+    import tempfile
+
+    ref = parse_image_ref(image)
+    client = RegistryClient(ref)
+    manifest = client.manifest()
+    dest_dir = Path(dest_dir)
+    dest_dir.mkdir(parents=True, exist_ok=True)
+    for layer in manifest.get("layers", []):
+        digest = layer["digest"]
+        with tempfile.NamedTemporaryFile(suffix=".layer",
+                                         delete=False) as tmp:
+            tmp_path = Path(tmp.name)
+        raw = tmp_path
+        try:
+            client.fetch_blob(digest, tmp_path, progress,
+                              expected_size=layer.get("size", 0))
+            if layer.get("mediaType", "").endswith("gzip"):
+                raw = tmp_path.with_suffix(".tar")
+                with gzip.open(tmp_path, "rb") as src, \
+                        open(raw, "wb") as out:
+                    shutil.copyfileobj(src, out)
+            with tarfile.open(raw) as tf:
+                _safe_extract_tar(tf, dest_dir)
+        finally:
+            # failure mid-extraction must not strand the decompressed
+            # multi-GB .tar in the temp dir
+            if raw is not tmp_path:
+                raw.unlink(missing_ok=True)
+            tmp_path.unlink(missing_ok=True)
+    return dest_dir

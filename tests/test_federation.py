@@ -218,3 +218,23 @@ def test_announce_retries_until_router_up():
     finally:
         router.stop()
         stub.stop()
+
+
+def test_explorer_renders_router_nodes():
+    """`explorer` dashboard over a router's registry (parity:
+    core/explorer + explorer.html, re-pointed at federation)."""
+    from localai_tpu.federation.explorer import create_explorer_app
+
+    fed = FederatedServer(["n1:9991", "n2:9992"], health_interval=60)
+    router = _AppThread(fed.create_app())
+    explorer = _AppThread(create_explorer_app(f"http://{router.addr}"))
+    try:
+        with httpx.Client(timeout=10.0) as c:
+            page = c.get(f"http://{explorer.addr}/")
+            assert page.status_code == 200
+            assert "n1:9991" in page.text and "n2:9992" in page.text
+            api = c.get(f"http://{explorer.addr}/api/nodes").json()
+            assert len(api["nodes"]) == 2
+    finally:
+        explorer.stop()
+        router.stop()

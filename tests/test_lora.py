@@ -1,0 +1,128 @@
+"""LoRA adapter merging for the diffusion pipeline (parity:
+/root/reference/backend/python/diffusers/backend.py:300-381 — kohya and
+diffusers/peft safetensors layouts folded into base weights at load)."""
+
+import json
+
+import numpy as np
+import pytest
+from safetensors.numpy import save_file
+from test_image import _write_diffusers_fixture
+
+from localai_tpu.image.loader import load_diffusers_pipeline, load_unet
+from localai_tpu.image.lora import (
+    apply_lora,
+    read_lora_file,
+    unet_sites,
+)
+
+
+def _kohya_lora(path, modules, r=4, alpha=2.0, seed=0):
+    """Write a kohya-format LoRA safetensors for given (name, din, dout)."""
+    rng = np.random.default_rng(seed)
+    t = {}
+    for name, din, dout in modules:
+        key = "lora_unet_" + name.replace(".", "_")
+        t[f"{key}.lora_down.weight"] = rng.standard_normal(
+            (r, din)).astype(np.float32)
+        t[f"{key}.lora_up.weight"] = rng.standard_normal(
+            (dout, r)).astype(np.float32)
+        t[f"{key}.alpha"] = np.asarray(alpha, np.float32)
+    save_file(t, str(path))
+    return t
+
+
+MID_Q = "mid_block.attentions.0.transformer_blocks.0.attn1.to_q"
+
+
+def test_read_lora_file_formats(tmp_path):
+    # kohya
+    _kohya_lora(tmp_path / "k.safetensors", [(MID_Q, 64, 64)])
+    layers = read_lora_file(tmp_path / "k.safetensors")
+    ((comp, name),) = layers.keys()
+    assert comp == "unet"
+    assert name == MID_Q.replace(".", "_")
+    layer = layers[(comp, name)]
+    assert layer.down.shape == (4, 64)
+    assert layer.up.shape == (64, 4)
+    assert layer.alpha == 2.0
+    # diffusers/peft
+    rng = np.random.default_rng(1)
+    save_file({
+        f"unet.{MID_Q}.lora_A.weight":
+            rng.standard_normal((4, 64)).astype(np.float32),
+        f"unet.{MID_Q}.lora_B.weight":
+            rng.standard_normal((64, 4)).astype(np.float32),
+        "text_encoder.text_model.encoder.layers.0.mlp.fc1.lora_A.weight":
+            rng.standard_normal((4, 64)).astype(np.float32),
+        "text_encoder.text_model.encoder.layers.0.mlp.fc1.lora_B.weight":
+            rng.standard_normal((128, 4)).astype(np.float32),
+    }, str(tmp_path / "p.safetensors"))
+    layers = read_lora_file(tmp_path / "p.safetensors")
+    assert ("unet", MID_Q.replace(".", "_")) in layers
+    assert ("te",
+            "text_model_encoder_layers_0_mlp_fc1") in layers
+
+
+def test_apply_lora_merges_expected_delta(tmp_path):
+    root = tmp_path / "model"
+    _write_diffusers_fixture(root)
+    _, params = load_unet(root / "unet")
+    before = np.array(
+        params["mid"]["attn"]["blocks"][0]["attn1"]["wq"])
+    t = _kohya_lora(tmp_path / "l.safetensors", [(MID_Q, 64, 64)],
+                    r=4, alpha=2.0)
+    n = apply_lora(params, None, tmp_path / "l.safetensors", scale=1.0)
+    assert n == 1
+    after = params["mid"]["attn"]["blocks"][0]["attn1"]["wq"]
+    key = "lora_unet_" + MID_Q.replace(".", "_")
+    want = (2.0 / 4.0) * (
+        t[f"{key}.lora_up.weight"] @ t[f"{key}.lora_down.weight"]
+    )
+    np.testing.assert_allclose(after - before, want.T, rtol=1e-5)
+
+
+def test_apply_lora_shape_mismatch_raises(tmp_path):
+    root = tmp_path / "model"
+    _write_diffusers_fixture(root)
+    _, params = load_unet(root / "unet")
+    _kohya_lora(tmp_path / "bad.safetensors", [(MID_Q, 32, 32)])
+    with pytest.raises(ValueError, match="does not match target"):
+        apply_lora(params, None, tmp_path / "bad.safetensors")
+
+
+def test_apply_lora_skips_unknown_targets(tmp_path, caplog):
+    root = tmp_path / "model"
+    _write_diffusers_fixture(root)
+    _, params = load_unet(root / "unet")
+    _kohya_lora(tmp_path / "na.safetensors",
+                [("down_blocks.9.attentions.0.transformer_blocks.0."
+                  "attn1.to_q", 64, 64), (MID_Q, 64, 64)])
+    n = apply_lora(params, None, tmp_path / "na.safetensors")
+    assert n == 1  # the real target merged, the bogus one skipped
+
+
+def test_unet_sites_cover_attention_and_resnets(tmp_path):
+    root = tmp_path / "model"
+    _write_diffusers_fixture(root)
+    _, params = load_unet(root / "unet")
+    sites = unet_sites(params)
+    assert MID_Q in sites
+    assert "down_blocks.0.resnets.0.conv1" in sites
+    assert "mid_block.attentions.0.transformer_blocks.0.ff.net.0.proj" \
+        in sites
+
+
+def test_pipeline_output_changes_with_lora(tmp_path):
+    root = tmp_path / "model"
+    _write_diffusers_fixture(root)
+    _kohya_lora(tmp_path / "l.safetensors", [(MID_Q, 64, 64)], seed=3)
+    base = load_diffusers_pipeline(root, default_steps=2)
+    tuned = load_diffusers_pipeline(
+        root, default_steps=2,
+        lora_adapter=str(tmp_path / "l.safetensors"), lora_scale=1.0,
+    )
+    a = base.generate("a cat", width=64, height=64, seed=7).image
+    b = tuned.generate("a cat", width=64, height=64, seed=7).image
+    assert a.shape == b.shape
+    assert not np.array_equal(a, b)

@@ -1,0 +1,62 @@
+"""Web UI pages (parity: core/http/routes/ui.go + views/*.html — home,
+gallery browser, chat, text2image, tts), content negotiation on /, the
+disable_webui flag, and key-free page access with key-protected APIs."""
+
+import httpx
+import pytest
+from test_api import _ServerThread, make_state
+
+
+@pytest.fixture(scope="module")
+def server(tmp_path_factory):
+    srv = _ServerThread(make_state(
+        tmp_path_factory.mktemp("models"), write_tiny=True))
+    yield srv
+    srv.stop()
+
+
+def test_home_content_negotiation(server):
+    with httpx.Client(base_url=server.base, timeout=30.0) as c:
+        as_api = c.get("/")  # httpx default Accept */*
+        assert as_api.headers["content-type"].startswith("application/json")
+        as_browser = c.get("/", headers={"Accept": "text/html"})
+        assert as_browser.headers["content-type"].startswith("text/html")
+        assert "tiny" in as_browser.text
+        assert "LocalAI-TPU" in as_browser.text
+
+
+def test_all_pages_render(server):
+    with httpx.Client(base_url=server.base, timeout=30.0) as c:
+        for path in ("/browse", "/chat/", "/chat/tiny", "/text2image/",
+                     "/tts/", "/tts/tiny"):
+            r = c.get(path)
+            assert r.status_code == 200, path
+            assert r.headers["content-type"].startswith("text/html"), path
+        # the chat page preselects the path model
+        assert 'selected>tiny' in c.get("/chat/tiny").text
+
+
+def test_disable_webui(tmp_path):
+    state = make_state(tmp_path, write_tiny=True)
+    state.config.disable_webui = True
+    srv = _ServerThread(state)
+    try:
+        with httpx.Client(base_url=srv.base, timeout=30.0) as c:
+            r = c.get("/", headers={"Accept": "text/html"})
+            assert r.headers["content-type"].startswith("application/json")
+            assert c.get("/browse").status_code == 404
+    finally:
+        srv.stop()
+
+
+def test_pages_keyless_apis_protected(tmp_path):
+    state = make_state(tmp_path, write_tiny=True)
+    state.config.api_keys = ["sekrit"]
+    srv = _ServerThread(state)
+    try:
+        with httpx.Client(base_url=srv.base, timeout=30.0) as c:
+            assert c.get("/chat/").status_code == 200     # page: key-free
+            assert c.get("/v1/models").status_code == 401  # API: protected
+            assert c.get("/models/available").status_code == 401
+    finally:
+        srv.stop()
