@@ -415,7 +415,6 @@ async def edits(request: web.Request) -> web.Response:
 
 async def embeddings(request: web.Request) -> web.Response:
     req = await _read_request(request)
-    sm, base_cfg = await _serving(request, req, Usecase.EMBEDDINGS)
 
     inputs: list[Any]
     if req.input is None:
@@ -426,6 +425,29 @@ async def embeddings(request: web.Request) -> web.Response:
         inputs = list(req.input) or [""]
         if inputs and all(isinstance(x, int) for x in inputs):
             inputs = [inputs]  # one tokenized input
+
+    # bert-class sentence encoders embed in one batched forward (parity:
+    # the sentencetransformers backend); other models mean-pool through
+    # the LLM engine below
+    state = _state(request)
+    mcfg = state.loader.get(req.model)
+    if mcfg is not None and state.manager.is_embedder(mcfg):
+        if not all(isinstance(t, str) for t in inputs):
+            # pre-tokenized input carries the LLM tokenizer's ids — a
+            # bert sentence encoder has a different vocab; embedding the
+            # repr-string would silently return meaningless vectors
+            raise web.HTTPBadRequest(
+                text="token-array input is not supported for "
+                     "sentence-encoder backends; send text"
+            )
+        em = await _in_executor(request, state.manager.get_embedder,
+                                req.model)
+        vecs, ptokens = await _in_executor(request, em.embed, inputs)
+        return web.json_response(sc.embeddings_response(
+            req.model, [[float(x) for x in v] for v in vecs], ptokens
+        ))
+
+    sm, base_cfg = await _serving(request, req, Usecase.EMBEDDINGS)
 
     def embed_all() -> tuple[list[list[float]], int]:
         vecs = []

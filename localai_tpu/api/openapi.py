@@ -1,0 +1,167 @@
+"""OpenAPI document + Swagger UI endpoint.
+
+Parity: /root/reference/core/http/app.go:30 (the /swagger handler served
+from generated swagger docs). The reference generates its spec offline
+with swaggo annotations; here the spec is assembled at request time from
+the live route table — every registered route appears, enriched with
+hand-written schemas for the OpenAI-compatible surfaces, so the document
+can never drift from the actual router. The UI page is self-contained
+(zero-egress environment: no CDN assets) — a minimal request explorer
+over the spec.
+"""
+
+from __future__ import annotations
+
+import html
+
+from aiohttp import web
+
+from localai_tpu.version import __version__
+
+# richer docs for the endpoints users hit most; everything else gets an
+# auto-generated stub from the route table
+_DOCS: dict[tuple[str, str], dict] = {
+    ("POST", "/v1/chat/completions"): {
+        "summary": "OpenAI-compatible chat completion",
+        "requestBody": {
+            "model": "string", "messages": "array", "stream": "boolean",
+            "tools": "array", "max_tokens": "integer",
+            "temperature": "number",
+        },
+    },
+    ("POST", "/v1/completions"): {
+        "summary": "Text completion (list prompts fan out to choices)",
+        "requestBody": {"model": "string", "prompt": "string|array",
+                        "stream": "boolean", "n": "integer"},
+    },
+    ("POST", "/v1/embeddings"): {
+        "summary": "Embeddings (LLM mean-pool or bert sentence encoder)",
+        "requestBody": {"model": "string", "input": "string|array"},
+    },
+    ("POST", "/v1/images/generations"): {
+        "summary": "Image generation (diffusers-class pipelines)",
+        "requestBody": {"model": "string", "prompt": "string",
+                        "size": "string", "response_format": "string"},
+    },
+    ("POST", "/v1/audio/transcriptions"): {
+        "summary": "Speech-to-text (whisper engine, multipart upload)",
+    },
+    ("POST", "/v1/audio/speech"): {
+        "summary": "Text-to-speech",
+        "requestBody": {"model": "string", "input": "string",
+                        "voice": "string"},
+    },
+    ("POST", "/v1/rerank"): {
+        "summary": "Jina-compatible rerank (cross-encoder or cosine)",
+        "requestBody": {"model": "string", "query": "string",
+                        "documents": "array", "top_n": "integer"},
+    },
+    ("POST", "/v1/files"): {"summary": "Upload a file (multipart)"},
+    ("POST", "/v1/assistants"): {"summary": "Create an assistant"},
+    ("POST", "/models/apply"): {
+        "summary": "Install a model from a gallery (async job)",
+        "requestBody": {"id": "string", "name": "string"},
+    },
+}
+
+
+def build_spec(app: web.Application) -> dict:
+    """Live route table → OpenAPI 3.0 document."""
+    paths: dict[str, dict] = {}
+    for route in app.router.routes():
+        resource = route.resource
+        if resource is None or route.method in ("HEAD", "OPTIONS"):
+            continue
+        path = resource.canonical
+        doc = _DOCS.get((route.method, path), {})
+        op: dict = {
+            "summary": doc.get(
+                "summary",
+                (route.handler.__doc__ or "").strip().split("\n")[0]
+                or f"{route.method} {path}",
+            ),
+            "responses": {"200": {"description": "OK"}},
+        }
+        body = doc.get("requestBody")
+        if body:
+            op["requestBody"] = {"content": {"application/json": {
+                "schema": {
+                    "type": "object",
+                    "properties": {
+                        k: {"type": "string"
+                            if "|" in v or v == "string" else v}
+                        for k, v in body.items()
+                    },
+                },
+            }}}
+        params = [p[1:-1] for p in path.split("/")
+                  if p.startswith("{") and p.endswith("}")]
+        if params:
+            op["parameters"] = [
+                {"name": p, "in": "path", "required": True,
+                 "schema": {"type": "string"}} for p in params
+            ]
+        paths.setdefault(path, {})[route.method.lower()] = op
+    return {
+        "openapi": "3.0.3",
+        "info": {
+            "title": "LocalAI-TPU API",
+            "description": "OpenAI-compatible serving on JAX/TPU",
+            "version": __version__,
+        },
+        "security": [{"bearerAuth": []}],
+        "components": {"securitySchemes": {"bearerAuth": {
+            "type": "http", "scheme": "bearer",
+        }}},
+        "paths": dict(sorted(paths.items())),
+    }
+
+
+async def spec_json(request: web.Request) -> web.Response:
+    """GET /swagger/doc.json (the generated-docs path in the reference)."""
+    return web.json_response(build_spec(request.app))
+
+
+async def swagger_ui(request: web.Request) -> web.Response:
+    """GET /swagger — a self-contained API explorer over the live spec."""
+    doc = f"""<!doctype html><html><head><meta charset="utf-8">
+<title>LocalAI-TPU API</title>
+<style>body{{font:14px/1.5 system-ui;background:#0f1217;color:#e6e9ee;
+margin:1.5rem auto;max-width:900px;padding:0 1rem}}
+.ep{{border:1px solid #2a3240;border-radius:8px;margin:.5rem 0;
+background:#171c24}}summary{{padding:.5rem .8rem;cursor:pointer}}
+.m{{display:inline-block;min-width:52px;font-weight:700}}
+.GET{{color:#38b26f}}.POST{{color:#4f9cf7}}.DELETE{{color:#d9573b}}
+pre{{background:#0c0f14;padding:.6rem .8rem;border-radius:6px;
+overflow:auto;margin:.4rem .8rem .8rem}}</style></head><body>
+<h2>LocalAI-TPU API <small style="color:#8b95a5">{html.escape(__version__)}
+</small></h2>
+<p><a href="/swagger/doc.json" style="color:#4f9cf7">doc.json</a>
+(OpenAPI 3.0)</p><div id="eps">loading…</div>
+<script>
+(async () => {{
+  const spec = await (await fetch('/swagger/doc.json')).json();
+  const out = [];
+  for (const [path, ops] of Object.entries(spec.paths)) {{
+    for (const [m, op] of Object.entries(ops)) {{
+      const M = m.toUpperCase();
+      const body = op.requestBody
+        ? '<pre>' + JSON.stringify(
+            op.requestBody.content['application/json'].schema.properties,
+            null, 2) + '</pre>' : '';
+      out.push(`<details class="ep"><summary><span class="m ${{M}}">${{M}}
+        </span> <code>${{path}}</code> — ${{op.summary || ''}}</summary>
+        ${{body}}</details>`);
+    }}
+  }}
+  document.getElementById('eps').innerHTML = out.join('');
+}})();
+</script></body></html>"""
+    return web.Response(text=doc, content_type="text/html")
+
+
+def routes() -> list[web.RouteDef]:
+    return [
+        web.get("/swagger", swagger_ui),
+        web.get("/swagger/doc.json", spec_json),
+    ]

@@ -32,7 +32,10 @@ STATE_KEY = web.AppKey("state", object)
 
 # paths reachable without an API key (parity: auth exemption filter,
 # core/http/middleware/auth.go:17+)
-AUTH_EXEMPT = {"/", "/healthz", "/readyz", "/version"}
+# /swagger docs expose only the route list, which the exempt "/" JSON
+# welcome already lists; the explorer page fetches doc.json without auth
+AUTH_EXEMPT = {"/", "/healthz", "/readyz", "/version", "/swagger",
+               "/swagger/doc.json"}
 # UI documents are key-free to GET (they hold no data; their JS calls the
 # protected JSON APIs with the key the operator enters in the page header)
 from localai_tpu.api.ui import UI_PREFIXES  # noqa: E402
@@ -237,6 +240,9 @@ def create_app(state: Optional[AppState] = None) -> web.Application:
         from localai_tpu.api import ui as ui_routes
 
         app.add_routes(ui_routes.routes())
+    from localai_tpu.api import openapi as openapi_routes
+
+    app.add_routes(openapi_routes.routes())
 
     async def on_cleanup(_app):
         state.shutdown()
@@ -248,6 +254,19 @@ def create_app(state: Optional[AppState] = None) -> web.Application:
 def serve(app_config: Optional[AppConfig] = None) -> None:
     """Blocking server entry (parity: appHTTP.Listen, run.go:199)."""
     cfg = app_config or AppConfig()
+    if cfg.coordinator_address and cfg.num_processes > 1:
+        # multi-host leader: join the jax.distributed group BEFORE any
+        # jax use so jax.devices() spans every host (parallel/multihost)
+        from localai_tpu.parallel.multihost import initialize
+
+        initialize(cfg.coordinator_address, cfg.num_processes,
+                   cfg.process_id)
+    if cfg.mirror_port:
+        # open the follower command channel NOW: followers connect at
+        # boot, long before the first request lazily loads a model
+        from localai_tpu.parallel.multihost import get_leader
+
+        get_leader(cfg.mirror_port, cfg.mirror_followers)
     cfg.ensure_dirs()
     loader = ConfigLoader(cfg.model_path)
     loader.load_from_path(context_size=cfg.context_size)

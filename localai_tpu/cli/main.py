@@ -91,6 +91,22 @@ def build_parser() -> argparse.ArgumentParser:
     worker = sub.add_parser("worker", help="start a gRPC model worker")
     worker.add_argument("--addr", default="127.0.0.1:50051")
 
+    fol = sub.add_parser(
+        "follower",
+        help="multi-host follower: replicate a leader's engine calls")
+    fol.add_argument("--leader", required=True,
+                     help="leader's mirror channel host:port")
+    fol.add_argument("--model", required=True)
+    fol.add_argument("--models-path",
+                     default=_env_default("models_path", "models"))
+    fol.add_argument("--coordinator",
+                     default=_env_default("coordinator_address", ""),
+                     help="jax.distributed coordinator host:port")
+    fol.add_argument("--num-processes", type=int,
+                     default=int(_env_default("num_processes", 1)))
+    fol.add_argument("--process-id", type=int,
+                     default=int(_env_default("process_id", 1)))
+
     tts = sub.add_parser("tts", help="synthesize speech to a wav file")
     tts.add_argument("text", nargs="+")
     tts.add_argument("--model", "-m", default="")
@@ -352,6 +368,30 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         from localai_tpu.worker.server import serve_worker
 
         serve_worker(args.addr)
+        return 0
+
+    if cmd == "follower":
+        from localai_tpu.config.app_config import AppConfig
+        from localai_tpu.config.loader import ConfigLoader
+
+        if args.coordinator:
+            from localai_tpu.parallel.multihost import initialize
+
+            initialize(args.coordinator, args.num_processes,
+                       args.process_id)
+        app_cfg = AppConfig.from_env(model_path=args.models_path)
+        loader = ConfigLoader(args.models_path)
+        loader.load_from_path(context_size=app_cfg.context_size)
+        mcfg = loader.get(args.model)
+        if mcfg is None:
+            parser.error(f"model {args.model!r} not found")
+        from localai_tpu.models.manager import build_runner
+        from localai_tpu.parallel.multihost import CommandFollower
+
+        _model, runner = build_runner(mcfg, app_cfg)
+        print(f"follower replica of {args.model} ready; replaying from "
+              f"{args.leader}", flush=True)
+        CommandFollower(args.leader, {args.model: runner}).run_forever()
         return 0
 
     if cmd == "tts":

@@ -54,6 +54,14 @@ class AppConfig:
     watchdog_idle_timeout: float = 15 * 60.0
     watchdog_busy_timeout: float = 5 * 60.0
 
+    # multi-host SPMD (parallel/multihost.py): jax.distributed + the
+    # leader's command-mirroring channel
+    coordinator_address: str = ""     # host:port for jax.distributed
+    num_processes: int = 1
+    process_id: int = 0
+    mirror_port: int = 0              # leader: broadcast engine calls here
+    mirror_followers: int = 0         # block serving until N followers join
+
     # distributed / federation
     p2p: bool = False
     federated: bool = False           # announce this instance to a router

@@ -75,6 +75,7 @@ class ConfigLoader:
             if not cfg.name:
                 cfg.name = entry.stem
             cfg.set_defaults(context_size=context_size)
+            self._autodetect(cfg)
             if cfg.validate_config():
                 self.register(cfg)
             else:
@@ -85,8 +86,20 @@ class ConfigLoader:
         if not cfg.name:
             cfg.name = Path(path).stem
         cfg.set_defaults(context_size=context_size)
+        self._autodetect(cfg)
         self.register(cfg)
         return cfg
+
+    def _autodetect(self, cfg: ModelConfig) -> None:
+        """Backend selection for bare `model:` configs by checkpoint sniff
+        (the greedy-loader/guesser collapse — models/detect.py)."""
+        try:
+            from localai_tpu.models.detect import autodetect_config
+
+            autodetect_config(cfg, self.model_path)
+        except Exception as e:  # noqa: BLE001 — sniffing must not block load
+            log.warning("backend autodetect for %s failed: %s",
+                        cfg.name, e)
 
     # -- registry --------------------------------------------------------
 

@@ -196,7 +196,16 @@ def read_lora_file(path: str | Path) -> dict[tuple[str, str], LoraLayer]:
                     put(component, module, part, val)
                     break
         elif key.endswith(".alpha"):
-            put("unet", key[: -len(".alpha")], "alpha", float(val))
+            # diffusers/peft layout stores alpha beside lora_A/lora_B —
+            # strip the same component prefix so it joins their group
+            k = key[: -len(".alpha")]
+            component = "unet"
+            for pre, comp in (("unet.", "unet"), ("text_encoder.", "te"),
+                              ("te.", "te")):
+                if k.startswith(pre):
+                    component, k = comp, k[len(pre):]
+                    break
+            put(component, k, "alpha", float(val))
 
     out: dict[tuple[str, str], LoraLayer] = {}
     for gk, g in groups.items():

@@ -1,0 +1,95 @@
+"""Backend auto-detection: checkpoint layout → engine family.
+
+The TPU-era shape of the reference's greedy backend loader
+(/root/reference/pkg/model/initializers.go:271-407 — when no backend is
+named, walk an ordered list of backends and take the first that loads,
+and core/config/guesser.go — infer config from the model file). CUDA
+LocalAI needs trial loading because several backends can serve the same
+GGUF; here each checkpoint family has exactly one JAX engine, so the
+chain collapses to layout sniffing with an ordered preference when a dir
+is ambiguous. Empty result means the default LLM engine.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+from pathlib import Path
+from typing import Optional
+
+log = logging.getLogger(__name__)
+
+# model_type values → backend family, checked in order (a llava dir also
+# contains a vision config; llama wins because the LLM engine serves it).
+# bert-class checkpoints split on the scoring head: with a classifier
+# they're cross-encoders (rerank), without one they're sentence encoders
+# (embeddings).
+_BERT_TYPES = ("bert", "roberta", "xlm-roberta")
+
+_DEBUG_BACKENDS = [
+    ("sd-", "diffusers"),
+    ("whisper", "whisper"),
+    ("reranker", "reranker"),
+    ("bert", "bert-embeddings"),
+]
+
+
+def detect_backend(ref: str, model_path: str | Path = "models"
+                   ) -> Optional[str]:
+    """Sniff a checkpoint ref; returns a backend name ("diffusers",
+    "whisper", "reranker") or None for the default LLM engine / when the
+    files are not present yet (detection re-runs after install)."""
+    if ref.startswith("debug:"):
+        name = ref.split(":", 1)[1]
+        for prefix, backend in _DEBUG_BACKENDS:
+            if name.startswith(prefix):
+                return backend
+        return None
+    for cand in (Path(ref), Path(model_path) / ref):
+        if not cand.is_dir():
+            continue
+        # diffusers pipeline layout beats everything: its config.json (if
+        # any) describes a component, not the pipeline
+        if (cand / "model_index.json").exists() or (cand / "unet").is_dir():
+            return "diffusers"
+        cj = cand / "config.json"
+        if cj.exists():
+            try:
+                hf = json.loads(cj.read_text())
+            except ValueError:
+                return None
+            mt = str(hf.get("model_type", ""))
+            if mt == "whisper":
+                return "whisper"
+            if mt in _BERT_TYPES:
+                return (
+                    "reranker" if _has_classifier(cand)
+                    else "bert-embeddings"
+                )
+            return None
+    return None
+
+
+def _has_classifier(model_dir: Path) -> bool:
+    try:
+        from safetensors import safe_open
+
+        for fp in sorted(model_dir.glob("*.safetensors")):
+            with safe_open(str(fp), framework="numpy") as h:
+                if "classifier.weight" in h.keys():
+                    return True
+    except Exception as e:  # noqa: BLE001 — sniff failure → embedder
+        log.debug("classifier sniff failed for %s: %s", model_dir, e)
+    return False
+
+
+def autodetect_config(cfg, model_path: str | Path) -> None:
+    """Fill ModelConfig.backend for a bare `model:` YAML so usecase
+    guessing and endpoint routing land on the right engine (parity:
+    guesser.go run at config load)."""
+    if cfg.backend:
+        return
+    detected = detect_backend(cfg.model or cfg.name, model_path)
+    if detected:
+        log.info("model %s: detected %s checkpoint", cfg.name, detected)
+        cfg.backend = detected

@@ -188,17 +188,67 @@ class RerankServingModel:
         return out, total_tokens
 
 
-def build_serving_model(mcfg: ModelConfig, app: AppConfig) -> ServingModel:
-    """Config → live engine: resolve weights, build mesh/shardings, runner,
-    scheduler, tokenizer, templates. Shared by the in-process manager and
-    the gRPC worker tier (localai_tpu.worker.server), so both load paths
-    behave identically."""
+@dataclasses.dataclass
+class EmbeddingServingModel:
+    """A loaded sentence encoder under lifecycle management (parity: the
+    sentencetransformers backend process,
+    /root/reference/backend/python/sentencetransformers/backend.py)."""
+
+    name: str
+    config: ModelConfig
+    encoder: Any                      # models.reranker.SentenceEncoder
+    loaded_at: float = dataclasses.field(default_factory=time.monotonic)
+    last_used: float = dataclasses.field(default_factory=time.monotonic)
+    _inflight: int = 0
+    _lock: threading.Lock = dataclasses.field(default_factory=threading.Lock)
+    embedded: int = 0
+
+    def touch(self) -> None:
+        self.last_used = time.monotonic()
+
+    @property
+    def busy(self) -> bool:
+        with self._lock:
+            return self._inflight > 0
+
+    def alive(self) -> bool:
+        return self.encoder is not None
+
+    def close(self) -> None:
+        self.encoder = None
+
+    def engine_metrics(self) -> dict:
+        return {"type": "embeddings", "texts_embedded": self.embedded}
+
+    def embed(self, texts: list[str]):
+        """(vectors, total_tokens) — token counts come from the same
+        encoder snapshot as the vectors (eviction can null self.encoder
+        the moment the in-flight count drops)."""
+        enc = self.encoder  # snapshot vs concurrent eviction
+        if enc is None:
+            raise RuntimeError(f"embedder {self.name} was evicted")
+        with self._lock:
+            self._inflight += 1
+        try:
+            out, total = enc.embed_with_usage(texts)
+        finally:
+            with self._lock:
+                self._inflight -= 1
+        self.embedded += len(texts)
+        self.touch()
+        return out, total
+
+
+def build_runner(mcfg: ModelConfig, app: AppConfig) -> tuple[Any, ModelRunner]:
+    """Config → (resolved model, live ModelRunner): weights, mesh,
+    shardings. Shared by the serving path and multi-host followers — a
+    follower MUST construct a bit-identical runner (same config, same
+    seed) so replayed commands keep every host in the same program."""
     from localai_tpu.models.registry import resolve_model
 
     eng = mcfg.engine
     shard = mcfg.sharding
     mesh = None
-    t0 = time.monotonic()
     want_tp = max(1, shard.tensor_parallel_size)
     want_sp = max(1, shard.sequence_parallel_size)
     want_dp = shard.data_parallel_size  # 0 = auto
@@ -247,6 +297,31 @@ def build_serving_model(mcfg: ModelConfig, app: AppConfig) -> ServingModel:
         sp_threshold=eng.sp_prefill_threshold,
         attn_impl=eng.attn_impl,
     )
+    return model, runner
+
+
+def build_serving_model(mcfg: ModelConfig, app: AppConfig) -> ServingModel:
+    """Config → live engine: resolve weights, build mesh/shardings, runner,
+    scheduler, tokenizer, templates. Shared by the in-process manager and
+    the gRPC worker tier (localai_tpu.worker.server), so both load paths
+    behave identically."""
+    t0 = time.monotonic()
+    eng = mcfg.engine
+    model, runner = build_runner(mcfg, app)
+    mesh = runner.mesh
+    ctx = runner.max_ctx
+    if app.mirror_port:
+        # multi-host leader: every engine call re-broadcasts to the
+        # follower group before running locally (parallel/multihost.py)
+        from localai_tpu.parallel.multihost import (
+            MirroredRunner,
+            get_leader,
+        )
+
+        leader = get_leader(app.mirror_port, app.mirror_followers)
+        if app.mirror_followers:
+            leader.wait_for(app.mirror_followers)
+        runner = MirroredRunner(runner, leader, mcfg.name)
     scheduler = Scheduler(
         runner,
         model.tokenizer,
@@ -347,6 +422,16 @@ class ModelManager:
         """Load-or-get a cross-encoder reranker (same lifecycle contract)."""
         return self._get_typed(name, self._load_reranker, kind="rerank")
 
+    def get_embedder(self, name: str) -> EmbeddingServingModel:
+        """Load-or-get a bert-class sentence encoder (same contract)."""
+        return self._get_typed(name, self._load_embedder, kind="embed")
+
+    def is_embedder(self, mcfg: ModelConfig) -> bool:
+        """Route /v1/embeddings to the sentence encoder for bert-class
+        checkpoints (backend: bert-embeddings, set explicitly or by
+        autodetection at config load)."""
+        return mcfg.backend in ("bert-embeddings", "sentencetransformers")
+
     def is_reranker(self, mcfg: ModelConfig) -> bool:
         """Route a model to the cross-encoder path: explicit
         ``backend: reranker`` or a bert-class checkpoint (auto-detect,
@@ -412,6 +497,7 @@ class ModelManager:
             cached_kind = (
                 "image" if isinstance(sm, ImageServingModel)
                 else "rerank" if isinstance(sm, RerankServingModel)
+                else "embed" if isinstance(sm, EmbeddingServingModel)
                 else "llm"
             )
             if cached_kind != kind:
@@ -442,7 +528,24 @@ class ModelManager:
             return WorkerServingModel(
                 mcfg, self.app, self.pool(), external_address=ext or None
             )
-        return build_serving_model(mcfg, self.app)
+        try:
+            return build_serving_model(mcfg, self.app)
+        except Exception:
+            # greedy-chain tail: name the engine the checkpoint actually
+            # belongs to instead of a cryptic tensor-mapping error
+            # (parity: initializers.go falling through its backend list)
+            from localai_tpu.models.detect import detect_backend
+
+            family = detect_backend(
+                mcfg.model or mcfg.name, self.app.model_path
+            )
+            if family:
+                raise RuntimeError(
+                    f"model {mcfg.name!r} is a {family} checkpoint, not "
+                    f"an LLM — set `backend: {family}` (or use the "
+                    f"matching endpoint)"
+                ) from None
+            raise
 
     def _load_image(self, mcfg: ModelConfig) -> ImageServingModel:
         from localai_tpu.image import resolve_image_model
@@ -474,6 +577,19 @@ class ModelManager:
         log.info("loaded image model %s in %.1fs", mcfg.name,
                  time.monotonic() - t0)
         return ImageServingModel(name=mcfg.name, config=mcfg, pipeline=pipe)
+
+    def _load_embedder(self, mcfg: ModelConfig) -> EmbeddingServingModel:
+        from localai_tpu.models.reranker import resolve_sentence_encoder
+
+        t0 = time.monotonic()
+        enc = resolve_sentence_encoder(
+            mcfg.model or mcfg.name, model_path=self.app.model_path,
+            seed=mcfg.seed or 0,
+        )
+        log.info("loaded sentence encoder %s in %.1fs", mcfg.name,
+                 time.monotonic() - t0)
+        return EmbeddingServingModel(name=mcfg.name, config=mcfg,
+                                     encoder=enc)
 
     def _load_reranker(self, mcfg: ModelConfig) -> RerankServingModel:
         from localai_tpu.models.reranker import resolve_reranker

@@ -72,6 +72,10 @@ DEBUG_RERANKERS = {
         cls_id=256, sep_id=257, pad_id=0,
     ),
 }
+DEBUG_EMBEDDERS = {
+    # the same trunk serves sentence embeddings (mean pool, no head)
+    "bert-tiny": DEBUG_RERANKERS["reranker-tiny"],
+}
 
 
 def init_params(key, cfg: BertConfig) -> dict:
@@ -125,12 +129,13 @@ def _dense(x, p):
     return x @ p["w"] + p["b"]
 
 
-def forward(params: dict, cfg: BertConfig, ids, segments, mask):
-    """[B, L] ids/segments/mask → [B] relevance logits.
+def encode_hidden(params: dict, cfg: BertConfig, ids, segments, mask):
+    """[B, L] ids/segments/mask → [B, L, D] final hidden states.
 
     Standard post-LN BERT encoder with bidirectional attention; the pad
-    mask adds -inf to attention scores of padded keys. CLS pooling + tanh
-    pooler + linear head (the cross-encoder scoring shape)."""
+    mask adds -inf to attention scores of padded keys. Shared by the
+    cross-encoder head (CLS → pooler → classifier) and the sentence
+    embedder (masked mean pool)."""
     B, L = ids.shape
     H = cfg.num_heads
     Dh = cfg.hidden_size // H
@@ -157,8 +162,52 @@ def forward(params: dict, cfg: BertConfig, ids, segments, mask):
         h = jax.nn.gelu(_dense(x, lp["ffn_in"]), approximate=False)
         x = _ln(x + _dense(h, lp["ffn_out"]), lp["ffn_ln"],
                 cfg.layer_norm_eps)
+    return x
+
+
+def forward(params: dict, cfg: BertConfig, ids, segments, mask):
+    """[B, L] → [B] relevance logits (cross-encoder scoring head)."""
+    x = encode_hidden(params, cfg, ids, segments, mask)
     pooled = jnp.tanh(_dense(x[:, 0], params["pooler"]))
     return _dense(pooled, params["classifier"])[:, 0]
+
+
+def embed_forward(params: dict, cfg: BertConfig, ids, segments, mask):
+    """[B, L] → [B, D] L2-normalized masked mean-pooled embeddings (the
+    sentence-transformers default pooling: modules.json mean pooling +
+    normalize)."""
+    x = encode_hidden(params, cfg, ids, segments, mask)
+    m = mask[:, :, None].astype(x.dtype)
+    summed = jnp.sum(x * m, axis=1)
+    counts = jnp.maximum(jnp.sum(m, axis=1), 1.0)
+    mean = summed / counts
+    return mean / jnp.maximum(
+        jnp.linalg.norm(mean, axis=-1, keepdims=True), 1e-12
+    )
+
+
+def _pick_bucket(buckets: tuple[int, ...], lengths: list[int]) -> int:
+    """Smallest bucket holding every packed row (falls back to the max)."""
+    L = buckets[-1]
+    for b in buckets:
+        if all(n <= b for n in lengths):
+            return b
+    return L
+
+
+def _pad_batch_pow2(*arrays: np.ndarray) -> tuple[np.ndarray, ...]:
+    """Pad the batch dim up to a power of two (bounded compile count) by
+    repeating row 0; callers slice the result back to the true count."""
+    n = arrays[0].shape[0]
+    B = 1
+    while B < n:
+        B *= 2
+    if B == n:
+        return arrays
+    padn = B - n
+    return tuple(
+        np.concatenate([a, np.repeat(a[:1], padn, 0)]) for a in arrays
+    )
 
 
 class CrossEncoder:
@@ -208,28 +257,59 @@ class CrossEncoder:
         q = enc(query)
         docs = [enc(d) for d in documents]
         total_tokens = len(q) + sum(len(d) for d in docs)
-        L = self.buckets[-1]
-        for b in self.buckets:
-            if all(len(q) + len(d) + 3 <= b for d in docs):
-                L = b
-                break
+        L = _pick_bucket(self.buckets,
+                         [len(q) + len(d) + 3 for d in docs])
         rows = [self._pair(q, d, L) for d in docs]
-        ids = np.stack([r[0] for r in rows])
-        seg = np.stack([r[1] for r in rows])
-        mask = np.stack([r[2] for r in rows])
-        # pad the batch to a power of two: bounded compile count
-        B = 1
-        while B < len(rows):
-            B *= 2
-        if B > len(rows):
-            padn = B - len(rows)
-            ids = np.concatenate([ids, np.repeat(ids[:1], padn, 0)])
-            seg = np.concatenate([seg, np.repeat(seg[:1], padn, 0)])
-            mask = np.concatenate([mask, np.repeat(mask[:1], padn, 0)])
+        ids, seg, mask = _pad_batch_pow2(
+            np.stack([r[0] for r in rows]),
+            np.stack([r[1] for r in rows]),
+            np.stack([r[2] for r in rows]),
+        )
         out = self._fwd(self.params, ids=jnp.asarray(ids),
                         segments=jnp.asarray(seg), mask=jnp.asarray(mask))
         scores = np.asarray(out)[: len(rows)].astype(np.float32)
         return scores, total_tokens
+
+
+class SentenceEncoder:
+    """Batched text → embedding scorer over the BERT trunk (parity: the
+    sentencetransformers backend,
+    /root/reference/backend/python/sentencetransformers/backend.py —
+    SentenceTransformer.encode). Texts pack as [CLS] text [SEP], pad to a
+    length bucket, one jitted forward per shape."""
+
+    def __init__(self, cfg: BertConfig, params: dict, tokenizer: Any,
+                 buckets: tuple[int, ...] = (64, 128, 256, 512)):
+        self.cfg = cfg
+        self.params = params
+        self.tokenizer = tokenizer
+        self.buckets = tuple(
+            b for b in sorted(buckets) if b <= cfg.max_position_embeddings
+        ) or (cfg.max_position_embeddings,)
+        self._fwd = jax.jit(partial(embed_forward, cfg=cfg))
+
+    def embed(self, texts: list[str]) -> np.ndarray:
+        """[N, D] normalized embeddings in one batched forward."""
+        return self.embed_with_usage(texts)[0]
+
+    def embed_with_usage(self, texts: list[str]
+                         ) -> tuple[np.ndarray, int]:
+        """([N, D], total input tokens) from one tokenization pass."""
+        c = self.cfg
+        toks = [self.tokenizer.encode(t) for t in texts]
+        total_tokens = sum(len(t) for t in toks)
+        L = _pick_bucket(self.buckets, [len(t) + 2 for t in toks])
+        ids = np.full((len(toks), L), c.pad_id, np.int32)
+        mask = np.zeros((len(toks), L), np.bool_)
+        for i, t in enumerate(toks):
+            row = [c.cls_id] + t[: L - 2] + [c.sep_id]
+            ids[i, : len(row)] = row
+            mask[i, : len(row)] = True
+        ids, seg, mask = _pad_batch_pow2(ids, np.zeros_like(ids), mask)
+        out = self._fwd(self.params, ids=jnp.asarray(ids),
+                        segments=jnp.asarray(seg), mask=jnp.asarray(mask))
+        vecs = np.asarray(out)[: len(toks)].astype(np.float32)
+        return vecs, total_tokens
 
 
 # ---------------------------------------------------------------------------
@@ -242,6 +322,11 @@ def _map_hf_bert(cfg: BertConfig, tensors: dict) -> dict:
 
     from localai_tpu.models.loader import _get
 
+    # cross-encoders prefix the trunk with "bert."; plain
+    # sentence-transformer exports don't
+    root = "bert." if "bert.embeddings.word_embeddings.weight" in tensors \
+        else ""
+
     def t(name):
         return jnp.asarray(_get(tensors, name))
 
@@ -253,7 +338,7 @@ def _map_hf_bert(cfg: BertConfig, tensors: dict) -> dict:
 
     layers = []
     for i in range(cfg.num_layers):
-        p = f"bert.encoder.layer.{i}"
+        p = f"{root}encoder.layer.{i}"
         layers.append({
             "q": dense(f"{p}.attention.self.query"),
             "k": dense(f"{p}.attention.self.key"),
@@ -264,15 +349,20 @@ def _map_hf_bert(cfg: BertConfig, tensors: dict) -> dict:
             "ffn_out": dense(f"{p}.output.dense"),
             "ffn_ln": ln(f"{p}.output.LayerNorm"),
         })
-    return {
-        "word_emb": t("bert.embeddings.word_embeddings.weight"),
-        "pos_emb": t("bert.embeddings.position_embeddings.weight"),
-        "type_emb": t("bert.embeddings.token_type_embeddings.weight"),
-        "emb_ln": ln("bert.embeddings.LayerNorm"),
+    out = {
+        "word_emb": t(f"{root}embeddings.word_embeddings.weight"),
+        "pos_emb": t(f"{root}embeddings.position_embeddings.weight"),
+        "type_emb": t(f"{root}embeddings.token_type_embeddings.weight"),
+        "emb_ln": ln(f"{root}embeddings.LayerNorm"),
         "layers": layers,
-        "pooler": dense("bert.pooler.dense"),
-        "classifier": dense("classifier"),
     }
+    # sentence-transformer checkpoints ship the trunk only; the scoring
+    # head exists just on cross-encoders
+    if f"{root}pooler.dense.weight" in tensors:
+        out["pooler"] = dense(f"{root}pooler.dense")
+    if "classifier.weight" in tensors:
+        out["classifier"] = dense("classifier")
+    return out
 
 
 class _BertTokenizerAdapter:
@@ -308,8 +398,6 @@ def resolve_reranker(
     * a dir holding config.json (model_type: bert) + safetensors — an HF
       cross-encoder checkpoint (cross-encoder/ms-marco-* layout).
     """
-    from localai_tpu.utils.tokenizer import ByteTokenizer
-
     if ref.startswith("debug:"):
         name = ref.split(":", 1)[1]
         if name not in DEBUG_RERANKERS:
@@ -318,14 +406,10 @@ def resolve_reranker(
                 f"have {sorted(DEBUG_RERANKERS)}"
             )
         cfg = DEBUG_RERANKERS[name]
-        tok = ByteTokenizer()
         # packer adds CLS/SEP itself; bare byte encoding here
-        tok_adapter = type("T", (), {
-            "encode": staticmethod(lambda text: list(text.encode("utf-8"))),
-            "vocab_size": tok.vocab_size,
-        })()
         return CrossEncoder(
-            cfg, init_params(jax.random.key(seed), cfg), tok_adapter
+            cfg, init_params(jax.random.key(seed), cfg),
+            _byte_tok_adapter(),
         )
 
     for cand in (Path(ref), Path(model_path) / ref):
@@ -353,6 +437,54 @@ def resolve_reranker(
             params = _map_hf_bert(cfg, tensors)
             return CrossEncoder(cfg, params, tok)
     raise FileNotFoundError(f"reranker ref {ref!r} not found")
+
+
+def _byte_tok_adapter():
+    from localai_tpu.utils.tokenizer import ByteTokenizer
+
+    tok = ByteTokenizer()
+    return type("T", (), {
+        "encode": staticmethod(lambda text: list(text.encode("utf-8"))),
+        "vocab_size": tok.vocab_size,
+    })()
+
+
+def resolve_sentence_encoder(
+    ref: str, model_path: str | Path = "models", seed: int = 0
+) -> SentenceEncoder:
+    """Model ref → SentenceEncoder (sentence-transformers-class bert
+    embedding checkpoints, or the ``debug:bert-tiny`` preset)."""
+    if ref.startswith("debug:"):
+        name = ref.split(":", 1)[1]
+        if name not in DEBUG_EMBEDDERS:
+            raise ValueError(
+                f"unknown debug embedder {name!r}; "
+                f"have {sorted(DEBUG_EMBEDDERS)}"
+            )
+        cfg = DEBUG_EMBEDDERS[name]
+        return SentenceEncoder(
+            cfg, init_params(jax.random.key(seed), cfg),
+            _byte_tok_adapter(),
+        )
+    for cand in (Path(ref), Path(model_path) / ref):
+        if (cand / "config.json").exists():
+            hf = json.loads((cand / "config.json").read_text())
+            tok = _BertTokenizerAdapter(cand)
+            overrides = {}
+            for field_name, token, default in (
+                ("cls_id", "[CLS]", 101),
+                ("sep_id", "[SEP]", 102),
+                ("pad_id", "[PAD]", hf.get("pad_token_id", 0)),
+            ):
+                tid = tok.special_id(token)
+                overrides[field_name] = tid if tid is not None else default
+            cfg = BertConfig.from_hf(hf, **overrides)
+            from localai_tpu.models.loader import _open_safetensors
+
+            tensors = _open_safetensors(cand)
+            params = _map_hf_bert(cfg, tensors)
+            return SentenceEncoder(cfg, params, tok)
+    raise FileNotFoundError(f"embedding model ref {ref!r} not found")
 
 
 def is_reranker_checkpoint(ref: str, model_path: str | Path) -> bool:

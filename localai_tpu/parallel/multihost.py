@@ -1,0 +1,267 @@
+"""Multi-host serving: jax.distributed init + deterministic command
+mirroring.
+
+Parity: the reference's RPC weight-sharding worker tier
+(/root/reference/backend/cpp/llama/grpc-server.cpp run with llama.cpp's
+RPC backend + core/p2p worker discovery) — one leader fans work out to
+follower hosts holding weight shards. The TPU-native shape is
+multi-controller JAX: every host calls jax.distributed.initialize, sees
+the global device set, and must execute the SAME jitted programs in the
+SAME order so XLA's ICI/DCN collectives line up. The serving stack is
+dynamic (requests arrive only at the leader), so the leader re-broadcasts
+every engine-mutating call (admit / step_n / set_bias / release) over a
+lightweight TCP command channel; followers replay them against their
+local ModelRunner replica (same config, same seed → identical traces,
+identical collective schedule). Model parallelism itself stays inside
+XLA via the mesh (parallel/mesh.py) — this module only solves the
+"same program, same order, every host" contract.
+
+Scale note: commands are tiny (token ids + sampling params; the bias row
+is the largest at V floats) and ride DCN once per dispatch of
+multi_step×slots tokens — negligible next to the per-step ICI traffic
+XLA already schedules.
+"""
+
+from __future__ import annotations
+
+import base64
+import io
+import json
+import logging
+import socket
+import struct
+import threading
+from typing import Any, Optional
+
+import numpy as np
+
+log = logging.getLogger(__name__)
+
+
+def initialize(coordinator: str, num_processes: int, process_id: int,
+               local_device_ids: Optional[list[int]] = None) -> None:
+    """jax.distributed.initialize wrapper (must run before first jax use).
+
+    After this, jax.devices() spans every host and a Mesh built over it
+    gives pjit programs whose collectives cross ICI/DCN as laid out."""
+    import jax
+
+    kwargs: dict[str, Any] = {}
+    if local_device_ids is not None:
+        kwargs["local_device_ids"] = local_device_ids
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=num_processes,
+        process_id=process_id,
+        **kwargs,
+    )
+    log.info("jax.distributed up: process %d/%d, %d global / %d local "
+             "devices", process_id, num_processes,
+             jax.device_count(), jax.local_device_count())
+
+
+# ---------------------------------------------------------------------------
+# command channel
+
+
+def _pack(obj: dict) -> bytes:
+    body = json.dumps(obj, separators=(",", ":")).encode()
+    return struct.pack(">I", len(body)) + body
+
+
+def _read_exact(sock: socket.socket, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("command channel closed")
+        buf += chunk
+    return buf
+
+
+def _encode_arg(v: Any) -> Any:
+    if isinstance(v, np.ndarray) or (
+        hasattr(v, "__array__") and not isinstance(v, (int, float, bool))
+        and not isinstance(v, (list, tuple, str, dict))
+    ):
+        bio = io.BytesIO()
+        np.save(bio, np.asarray(v), allow_pickle=False)
+        return {"__np__": base64.b64encode(bio.getvalue()).decode()}
+    return v
+
+
+def _decode_arg(v: Any) -> Any:
+    if isinstance(v, dict) and "__np__" in v:
+        return np.load(io.BytesIO(base64.b64decode(v["__np__"])),
+                       allow_pickle=False)
+    return v
+
+
+class CommandLeader:
+    """Accepts follower connections and broadcasts every command in
+    issue order. Followers that lag apply backpressure (sendall) — the
+    group advances in lockstep, which is exactly the SPMD contract."""
+
+    def __init__(self, port: int = 0, expected: int = 0):
+        self._srv = socket.create_server(("0.0.0.0", port))
+        self.port = self._srv.getsockname()[1]
+        self._conns: list[socket.socket] = []
+        self._lock = threading.Lock()
+        self._accepting = threading.Thread(
+            target=self._accept_loop, daemon=True, name="mh-accept"
+        )
+        self._accepting.start()
+        self.expected = expected
+
+    def _accept_loop(self) -> None:
+        while True:
+            try:
+                conn, addr = self._srv.accept()
+            except OSError:
+                return
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            with self._lock:
+                self._conns.append(conn)
+            log.info("multihost: follower %s joined (%d connected)",
+                     addr, len(self._conns))
+
+    def wait_for(self, n: int, timeout: float = 120.0) -> None:
+        import time
+
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self._lock:
+                if len(self._conns) >= n:
+                    return
+            time.sleep(0.05)
+        raise TimeoutError(f"only {len(self._conns)} followers joined")
+
+    def broadcast(self, model: str, method: str, *args, **kwargs) -> None:
+        msg = _pack({
+            "model": model,
+            "m": method,
+            "a": [_encode_arg(a) for a in args],
+            "k": {k: _encode_arg(v) for k, v in kwargs.items()},
+        })
+        with self._lock:
+            dead = []
+            for conn in self._conns:
+                try:
+                    conn.sendall(msg)
+                except OSError as e:
+                    log.error("multihost: follower lost (%s)", e)
+                    dead.append(conn)
+            for conn in dead:
+                # a lost follower breaks SPMD — surviving processes would
+                # deadlock in collectives. Fail loudly; the supervisor
+                # restarts the group (the reference's worker tier dies the
+                # same way when an RPC shard drops).
+                self._conns.remove(conn)
+            if dead and self.expected:
+                raise RuntimeError(
+                    "multihost follower disconnected; restart the group"
+                )
+
+    def close(self) -> None:
+        self._srv.close()
+        with self._lock:
+            for conn in self._conns:
+                conn.close()
+            self._conns.clear()
+
+
+class CommandFollower:
+    """Connects to the leader and replays commands onto registered
+    ModelRunner replicas (keyed by model name) until the channel closes."""
+
+    def __init__(self, leader: str, targets: dict[str, Any],
+                 connect_timeout: float = 120.0):
+        import time
+
+        host, _, port = leader.rpartition(":")
+        deadline = time.monotonic() + connect_timeout
+        while True:
+            try:
+                self._sock = socket.create_connection(
+                    (host, int(port)), timeout=10.0)
+                break
+            except OSError:
+                # leader may still be booting; keep retrying until the
+                # window closes (group formation is racy by nature)
+                if time.monotonic() >= deadline:
+                    raise
+                time.sleep(1.0)
+        self._sock.settimeout(None)
+        self.targets = targets
+
+    def run_forever(self) -> None:
+        try:
+            while True:
+                self.step()
+        except ConnectionError:
+            log.info("multihost: leader channel closed; follower exiting")
+
+    def step(self) -> None:
+        """Apply exactly one mirrored command (tests drive this)."""
+        (length,) = struct.unpack(">I", _read_exact(self._sock, 4))
+        msg = json.loads(_read_exact(self._sock, length))
+        target = self.targets.get(msg["model"])
+        if target is None:
+            # every host must run every program or collectives desync —
+            # a model this follower doesn't serve is a deployment error
+            raise RuntimeError(
+                f"follower has no replica of model {msg['model']!r}"
+            )
+        args = [_decode_arg(a) for a in msg["a"]]
+        kwargs = {k: _decode_arg(v) for k, v in msg["k"].items()}
+        getattr(target, msg["m"])(*args, **kwargs)
+
+    def close(self) -> None:
+        self._sock.close()
+
+
+_leader_singleton: Optional[CommandLeader] = None
+_leader_lock = threading.Lock()
+
+
+def get_leader(port: int, expected: int = 0) -> CommandLeader:
+    """Process-wide command channel (all mirrored models share it; the
+    model name in each message routes replay on the follower side)."""
+    global _leader_singleton
+    with _leader_lock:
+        if _leader_singleton is None:
+            _leader_singleton = CommandLeader(port, expected=expected)
+        return _leader_singleton
+
+
+# methods whose device effects must replay on every host; the leader's
+# return values are host-local reads and never cross the channel
+MIRRORED = (
+    "admit", "step", "step_n", "step_async", "step_n_async",
+    "step_frozen_n", "set_bias", "release", "acquire_slot", "embed",
+)
+
+
+class MirroredRunner:
+    """Leader-side ModelRunner proxy: broadcast each mutating call to the
+    follower group, then apply it locally. Pure reads pass through.
+
+    Determinism contract: followers constructed their runner from the
+    same config/seed, so replaying the call stream step-for-step keeps
+    every host inside the same jitted program at the same time."""
+
+    def __init__(self, runner: Any, leader: CommandLeader, model: str):
+        self._runner = runner
+        self._leader = leader
+        self._model = model
+
+    def __getattr__(self, name: str):
+        attr = getattr(self._runner, name)
+        if name not in MIRRORED or not callable(attr):
+            return attr
+
+        def call(*args, **kwargs):
+            self._leader.broadcast(self._model, name, *args, **kwargs)
+            return attr(*args, **kwargs)
+
+        return call

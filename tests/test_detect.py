@@ -1,0 +1,112 @@
+"""Backend auto-detection (the greedy-loader/guesser collapse — parity:
+/root/reference/pkg/model/initializers.go:271-407 ordered backend chain +
+core/config/guesser.go): a bare `model:` YAML routes to the right engine
+by checkpoint sniffing."""
+
+import json
+
+from localai_tpu.config.loader import ConfigLoader
+from localai_tpu.config.model_config import Usecase
+from localai_tpu.models.detect import detect_backend
+
+
+def test_detect_debug_presets():
+    assert detect_backend("debug:sd-tiny") == "diffusers"
+    assert detect_backend("debug:whisper-tiny") == "whisper"
+    assert detect_backend("debug:reranker-tiny") == "reranker"
+    assert detect_backend("debug:bert-tiny") == "bert-embeddings"
+    assert detect_backend("debug:tiny") is None
+
+
+def test_detect_dir_layouts(tmp_path):
+    sd = tmp_path / "sd"
+    (sd / "unet").mkdir(parents=True)
+    assert detect_backend("sd", tmp_path) == "diffusers"
+
+    w = tmp_path / "w"
+    w.mkdir()
+    (w / "config.json").write_text(json.dumps({"model_type": "whisper"}))
+    assert detect_backend("w", tmp_path) == "whisper"
+
+    # bert splits on the scoring head: classifier → cross-encoder
+    # reranker, trunk-only → sentence embedder
+    import numpy as np
+    from safetensors.numpy import save_file
+
+    ce = tmp_path / "ce"
+    ce.mkdir()
+    (ce / "config.json").write_text(json.dumps({"model_type": "bert"}))
+    save_file({"classifier.weight": np.zeros((1, 4), np.float32)},
+              ce / "model.safetensors")
+    assert detect_backend("ce", tmp_path) == "reranker"
+
+    st = tmp_path / "st"
+    st.mkdir()
+    (st / "config.json").write_text(json.dumps({"model_type": "bert"}))
+    save_file({"embeddings.word_embeddings.weight":
+               np.zeros((4, 4), np.float32)}, st / "model.safetensors")
+    assert detect_backend("st", tmp_path) == "bert-embeddings"
+
+    llm = tmp_path / "llm"
+    llm.mkdir()
+    (llm / "config.json").write_text(json.dumps({"model_type": "llama"}))
+    assert detect_backend("llm", tmp_path) is None
+
+    # not-yet-downloaded ref: no decision (detection re-runs post-install)
+    assert detect_backend("missing", tmp_path) is None
+
+
+def test_bare_yaml_routes_to_detected_backend(tmp_path):
+    """A config with only `model:` serves the right usecases."""
+    sd = tmp_path / "sd-ckpt"
+    (sd / "unet").mkdir(parents=True)
+    (tmp_path / "img.yaml").write_text("model: sd-ckpt\n")
+    (tmp_path / "llm.yaml").write_text("model: 'debug:tiny'\n")
+    (tmp_path / "stt.yaml").write_text("model: 'debug:whisper-tiny'\n")
+    loader = ConfigLoader(tmp_path)
+    loader.load_from_path()
+
+    img = loader.get("img")
+    assert img.backend == "diffusers"
+    assert img.has_usecase(Usecase.IMAGE)
+    assert not img.has_usecase(Usecase.CHAT)
+
+    llm = loader.get("llm")
+    assert llm.backend == ""
+    assert llm.has_usecase(Usecase.CHAT)
+
+    stt = loader.get("stt")
+    assert stt.backend == "whisper"
+    assert stt.has_usecase(Usecase.TRANSCRIPT)
+
+
+def test_explicit_backend_wins(tmp_path):
+    sd = tmp_path / "sd-ckpt"
+    (sd / "unet").mkdir(parents=True)
+    (tmp_path / "m.yaml").write_text(
+        "model: sd-ckpt\nbackend: worker\n")
+    loader = ConfigLoader(tmp_path)
+    loader.load_from_path()
+    assert loader.get("m").backend == "worker"
+
+
+def test_cross_family_load_error_names_the_engine(tmp_path):
+    """Loading a diffusers checkpoint through the LLM path fails with an
+    actionable error naming the detected family."""
+    import pytest
+
+    from localai_tpu.config.app_config import AppConfig
+    from localai_tpu.models.manager import ModelManager
+
+    sd = tmp_path / "sd-ckpt"
+    (sd / "unet").mkdir(parents=True)
+    (tmp_path / "m.yaml").write_text("model: sd-ckpt\nbackend: ''\n")
+    app = AppConfig(model_path=str(tmp_path))
+    loader = ConfigLoader(tmp_path)
+    loader.load_from_path()
+    # force the LLM path despite detection (explicit empty backend is
+    # overridden by autodetect; simulate a stale config object)
+    loader.get("m").backend = ""
+    mgr = ModelManager(app, loader)
+    with pytest.raises(RuntimeError, match="diffusers checkpoint"):
+        mgr.get("m")

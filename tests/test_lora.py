@@ -126,3 +126,19 @@ def test_pipeline_output_changes_with_lora(tmp_path):
     b = tuned.generate("a cat", width=64, height=64, seed=7).image
     assert a.shape == b.shape
     assert not np.array_equal(a, b)
+
+
+def test_peft_alpha_joins_group(tmp_path):
+    """diffusers/peft-layout alpha tensors group with their lora_A/B
+    (previously dropped → merge at the wrong scale)."""
+    rng = np.random.default_rng(5)
+    save_file({
+        f"unet.{MID_Q}.lora_A.weight":
+            rng.standard_normal((4, 64)).astype(np.float32),
+        f"unet.{MID_Q}.lora_B.weight":
+            rng.standard_normal((64, 4)).astype(np.float32),
+        f"unet.{MID_Q}.alpha": np.asarray(2.0, np.float32),
+    }, str(tmp_path / "pa.safetensors"))
+    layers = read_lora_file(tmp_path / "pa.safetensors")
+    layer = layers[("unet", MID_Q.replace(".", "_"))]
+    assert layer.alpha == 2.0

@@ -208,3 +208,110 @@ def test_rerank_http_routes_to_cross_encoder(tmp_path):
         assert sm.engine_metrics()["pairs_scored"] == 3
     finally:
         srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# sentence embeddings over the same trunk (sentencetransformers parity)
+
+
+def test_sentence_encoder_embeddings():
+    from localai_tpu.models.reranker import resolve_sentence_encoder
+
+    enc = resolve_sentence_encoder("debug:bert-tiny")
+    vecs, total = enc.embed_with_usage(
+        ["the cat sat", "a dog ran fast", "short"])
+    assert vecs.shape == (3, 64)
+    # normalized
+    np.testing.assert_allclose(np.linalg.norm(vecs, axis=1), 1.0,
+                               rtol=1e-4)
+    assert total == sum(len(t.encode()) for t in
+                        ["the cat sat", "a dog ran fast", "short"])
+    # deterministic + batch-composition independent
+    solo = enc.embed(["the cat sat"])
+    np.testing.assert_allclose(solo[0], vecs[0], rtol=1e-4)
+    # distinct inputs, distinct embeddings
+    assert not np.allclose(vecs[0], vecs[1])
+
+
+def test_embeddings_http_routes_to_bert(tmp_path):
+    """`backend: bert-embeddings` models serve /v1/embeddings through the
+    sentence encoder under lifecycle management."""
+    import httpx
+    from test_api import _ServerThread, make_state
+
+    (tmp_path / "st.yaml").write_text(
+        "name: st\nmodel: 'debug:bert-tiny'\nbackend: bert-embeddings\n"
+    )
+    srv = _ServerThread(make_state(tmp_path))
+    try:
+        with httpx.Client(base_url=srv.base, timeout=60.0) as c:
+            r = c.post("/v1/embeddings", json={
+                "model": "st",
+                "input": ["hello world", "another text"],
+            })
+            assert r.status_code == 200, r.text
+            body = r.json()
+            assert len(body["data"]) == 2
+            assert len(body["data"][0]["embedding"]) == 64
+            assert body["usage"]["prompt_tokens"] > 0
+        em = srv.state.manager.get_embedder("st")
+        assert em.engine_metrics()["texts_embedded"] == 2
+    finally:
+        srv.stop()
+
+
+def test_hf_sentence_transformer_layout_loads(tmp_path):
+    """A trunk-only bert checkpoint (no pooler/classifier, no `bert.`
+    prefix) loads as a sentence encoder."""
+    from safetensors.numpy import save_file
+
+    from localai_tpu.models.reranker import resolve_sentence_encoder
+
+    rng = np.random.default_rng(0)
+
+    def w(*shape):
+        return rng.standard_normal(shape).astype(np.float32) * 0.02
+
+    tensors = {
+        "embeddings.word_embeddings.weight": w(64, 32),
+        "embeddings.position_embeddings.weight": w(64, 32),
+        "embeddings.token_type_embeddings.weight": w(2, 32),
+        "embeddings.LayerNorm.weight": np.ones(32, np.float32),
+        "embeddings.LayerNorm.bias": np.zeros(32, np.float32),
+    }
+    p = "encoder.layer.0"
+    for name, shape in [
+        (f"{p}.attention.self.query", (32, 32)),
+        (f"{p}.attention.self.key", (32, 32)),
+        (f"{p}.attention.self.value", (32, 32)),
+        (f"{p}.attention.output.dense", (32, 32)),
+        (f"{p}.intermediate.dense", (64, 32)),
+        (f"{p}.output.dense", (32, 64)),
+    ]:
+        tensors[f"{name}.weight"] = w(*shape)
+        tensors[f"{name}.bias"] = np.zeros(shape[0], np.float32)
+    for lnn in (f"{p}.attention.output.LayerNorm", f"{p}.output.LayerNorm"):
+        tensors[f"{lnn}.weight"] = np.ones(32, np.float32)
+        tensors[f"{lnn}.bias"] = np.zeros(32, np.float32)
+    d = tmp_path / "st-model"
+    d.mkdir()
+    save_file(tensors, d / "model.safetensors")
+    (d / "config.json").write_text(json.dumps({
+        "model_type": "bert", "vocab_size": 64, "hidden_size": 32,
+        "intermediate_size": 64, "num_hidden_layers": 1,
+        "num_attention_heads": 2, "max_position_embeddings": 64,
+        "type_vocab_size": 2, "pad_token_id": 0,
+    }))
+    vocab = {"[PAD]": 0, "[CLS]": 1, "[SEP]": 2, "cat": 3, "dog": 4}
+    (d / "tokenizer.json").write_text(json.dumps({
+        "version": "1.0", "truncation": None, "padding": None,
+        "added_tokens": [], "normalizer": {"type": "Lowercase"},
+        "pre_tokenizer": {"type": "Whitespace"},
+        "post_processor": None, "decoder": None,
+        "model": {"type": "WordLevel", "vocab": vocab,
+                  "unk_token": "[PAD]"},
+    }))
+    enc = resolve_sentence_encoder(str(d))
+    vecs = enc.embed(["cat", "dog"])
+    assert vecs.shape == (2, 32)
+    assert np.isfinite(vecs).all()

@@ -60,3 +60,34 @@ def test_pages_keyless_apis_protected(tmp_path):
             assert c.get("/models/available").status_code == 401
     finally:
         srv.stop()
+
+
+def test_swagger_spec_and_ui(server):
+    """OpenAPI doc generated from the live route table + explorer page
+    (parity: the /swagger handler, core/http/app.go:30)."""
+    with httpx.Client(base_url=server.base, timeout=30.0) as c:
+        spec = c.get("/swagger/doc.json").json()
+        assert spec["openapi"].startswith("3.")
+        assert "/v1/chat/completions" in spec["paths"]
+        assert "post" in spec["paths"]["/v1/chat/completions"]
+        body = spec["paths"]["/v1/chat/completions"]["post"]["requestBody"]
+        assert "messages" in body["content"]["application/json"][
+            "schema"]["properties"]
+        # path params are declared
+        assert spec["paths"]["/v1/files/{file_id}"]["get"]["parameters"][
+            0]["name"] == "file_id"
+        page = c.get("/swagger")
+        assert page.status_code == 200
+        assert "doc.json" in page.text
+
+
+def test_swagger_reachable_with_api_keys(tmp_path):
+    state = make_state(tmp_path, write_tiny=True)
+    state.config.api_keys = ["sekrit"]
+    srv = _ServerThread(state)
+    try:
+        with httpx.Client(base_url=srv.base, timeout=30.0) as c:
+            assert c.get("/swagger").status_code == 200
+            assert c.get("/swagger/doc.json").status_code == 200
+    finally:
+        srv.stop()
