@@ -136,16 +136,72 @@ def _tts_params(state, model_name: str) -> tuple[str, float]:
     return voice, speed
 
 
+_vits_lock = threading.Lock()
+
+
+def _vits_for(state, name: str):
+    """name → loaded VitsTTS when the model config points at a vits
+    checkpoint; None → parametric fallback. Cached on AppState like the
+    whisper path. Runs in the executor (weight loads block for seconds)."""
+    if not name:
+        return None
+    mcfg = state.loader.get(name)
+    if mcfg is None:
+        return None
+    ref = mcfg.model or name
+    from pathlib import Path
+
+    if ref.startswith("debug:"):
+        return None  # debug TTS rides the parametric synth
+    if mcfg.backend not in ("vits", "tts"):
+        from localai_tpu.models.detect import detect_backend
+
+        if detect_backend(ref, state.config.model_path) != "vits":
+            return None
+    with _vits_lock:
+        cache = getattr(state, "_vits_cache", None)
+        if cache is None:
+            cache = state._vits_cache = {}
+        model = cache.get(name)
+        if model is None:
+            from localai_tpu.audio.vits import load_hf_vits
+
+            for cand in (Path(ref), Path(state.config.model_path) / ref):
+                if (cand / "config.json").exists():
+                    model = load_hf_vits(cand)
+                    break
+            else:
+                raise web.HTTPNotFound(
+                    text=f"vits model {ref!r} not found")
+            cache[name] = model
+        return model
+
+
 async def _speak(request: web.Request, text: str, voice: str,
-                 speed: float) -> web.Response:
+                 speed: float, model_name: str = "") -> web.Response:
     from localai_tpu.api.openai import _in_executor
     from localai_tpu.audio import write_wav
     from localai_tpu.audio import tts as ttsmod
 
     if not text:
         raise web.HTTPBadRequest(text="empty input text")
+    state = _state(request)
 
     def run():
+        # model resolution + (first-use) weight load happen HERE, on the
+        # executor — a multi-second vits load must not block the loop
+        vits = _vits_for(state, model_name)
+        if vits is not None:
+            # neural path (VITS voice checkpoint); `voice` selects the
+            # speaker for multispeaker models
+            spk = None
+            if voice.isdigit():
+                spk = int(voice)
+            wav = vits.synthesize(
+                text, speaker_id=spk,
+                speaking_rate=vits.cfg.speaking_rate * speed,
+            )
+            return write_wav(wav, rate=vits.cfg.sampling_rate)
         return write_wav(ttsmod.synthesize(text, voice=voice, speed=speed))
 
     data = await _in_executor(request, run)
@@ -166,7 +222,8 @@ async def speech(request: web.Request) -> web.Response:
         speed = float(body.get("speed") or speed)
     except (TypeError, ValueError):
         raise web.HTTPBadRequest(text="speed must be a number")
-    return await _speak(request, text, voice, speed)
+    return await _speak(request, text, voice, speed,
+                        model_name=body.get("model") or "")
 
 
 async def elevenlabs_tts(request: web.Request) -> web.Response:

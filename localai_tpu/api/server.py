@@ -266,7 +266,8 @@ def serve(app_config: Optional[AppConfig] = None) -> None:
         # boot, long before the first request lazily loads a model
         from localai_tpu.parallel.multihost import get_leader
 
-        get_leader(cfg.mirror_port, cfg.mirror_followers)
+        get_leader(cfg.mirror_port, cfg.mirror_followers,
+                   token=cfg.peer_token)
     cfg.ensure_dirs()
     loader = ConfigLoader(cfg.model_path)
     loader.load_from_path(context_size=cfg.context_size)

@@ -1,0 +1,674 @@
+"""VITS text-to-speech in functional JAX: the neural voice path.
+
+Parity: the reference's piper TTS backend (/root/reference/backend/go/tts
+— piper runs VITS-architecture voices) and the coqui/parler neural-TTS
+python backends. This implements VITS inference — text encoder with
+windowed relative attention, stochastic/deterministic duration predictor
+(rational-quadratic-spline conv flows), residual-coupling flow, and the
+HiFi-GAN decoder — natively in JAX, loading HuggingFace `VitsModel`
+checkpoints (model_type "vits": facebook/mms-tts-*, kakao-enterprise
+vits variants). Numerics mirror transformers' torch implementation
+layer-for-layer (verified in tests/test_vits.py against torch on random
+tiny checkpoints); weight-normed convs are fused at load.
+
+TPU notes: synthesis is one batched pass dominated by the HiFi-GAN
+transposed convs — MXU-friendly dense convs, all stax-free functional
+code. Shapes depend on text length and predicted durations, so the
+forward runs eagerly (one synthesis ≈ one dispatch chain); bucketing
+would only matter for high-QPS TTS serving.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import math
+from pathlib import Path
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+log = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass(frozen=True)
+class VitsConfig:
+    vocab_size: int = 38
+    hidden_size: int = 192
+    num_layers: int = 6
+    num_heads: int = 2
+    window_size: int = 4
+    use_bias: bool = True
+    ffn_dim: int = 768
+    ffn_kernel_size: int = 3
+    layer_norm_eps: float = 1e-5
+    flow_size: int = 192
+    spectrogram_bins: int = 513
+    prior_encoder_num_flows: int = 4
+    prior_encoder_num_wavenet_layers: int = 4
+    wavenet_kernel_size: int = 5
+    wavenet_dilation_rate: int = 1
+    use_stochastic_duration_prediction: bool = True
+    duration_predictor_num_flows: int = 4
+    duration_predictor_kernel_size: int = 3
+    duration_predictor_filter_channels: int = 256
+    duration_predictor_flow_bins: int = 10
+    duration_predictor_tail_bound: float = 5.0
+    depth_separable_channels: int = 2
+    depth_separable_num_layers: int = 3
+    upsample_initial_channel: int = 512
+    upsample_rates: tuple = (8, 8, 2, 2)
+    upsample_kernel_sizes: tuple = (16, 16, 4, 4)
+    resblock_kernel_sizes: tuple = (3, 7, 11)
+    resblock_dilation_sizes: tuple = ((1, 3, 5), (1, 3, 5), (1, 3, 5))
+    leaky_relu_slope: float = 0.1
+    num_speakers: int = 1
+    speaker_embedding_size: int = 0
+    sampling_rate: int = 16000
+    speaking_rate: float = 1.0
+    noise_scale: float = 0.667
+    noise_scale_duration: float = 0.8
+    pad_token_id: int = 0
+    add_blank: bool = True
+
+    @classmethod
+    def from_hf(cls, hf: dict) -> "VitsConfig":
+        aliases = {"num_layers": "num_hidden_layers",
+                   "num_heads": "num_attention_heads"}
+        kw = {}
+        for f in dataclasses.fields(cls):
+            src = aliases.get(f.name, f.name)
+            if src in hf:
+                v = hf[src]
+                if isinstance(v, list):
+                    v = tuple(tuple(x) if isinstance(x, list) else x
+                              for x in v)
+                kw[f.name] = v
+        return cls(**kw)
+
+
+# ---------------------------------------------------------------------------
+# primitives (all tensors [B, C, L] to mirror the torch layouts 1:1)
+
+
+def conv1d(x, w, b=None, *, stride=1, dilation=1, padding=0, groups=1):
+    """torch.nn.Conv1d semantics: x [B,C,L], w [O,I/g,k]."""
+    out = jax.lax.conv_general_dilated(
+        x, w, window_strides=(stride,), padding=[(padding, padding)],
+        rhs_dilation=(dilation,), feature_group_count=groups,
+        dimension_numbers=("NCH", "OIH", "NCH"),
+    )
+    if b is not None:
+        out = out + b[None, :, None]
+    return out
+
+
+def conv_transpose1d(x, w, b=None, *, stride=1, padding=0):
+    """torch.nn.ConvTranspose1d semantics: w [I,O,k].
+
+    Expressed as the equivalent fractionally-strided conv — dilate the
+    input by `stride`, run a regular conv with the spatially-flipped,
+    in/out-swapped kernel and padding k-1-p. Output length matches
+    torch's (L-1)*stride - 2p + k exactly."""
+    k = w.shape[-1]
+    w_conv = jnp.flip(w, axis=-1).transpose(1, 0, 2)  # [O,I,k]
+    out = jax.lax.conv_general_dilated(
+        x, w_conv, window_strides=(1,),
+        padding=[(k - 1 - padding, k - 1 - padding)],
+        lhs_dilation=(stride,),
+        dimension_numbers=("NCH", "OIH", "NCH"),
+    )
+    if b is not None:
+        out = out + b[None, :, None]
+    return out
+
+
+def layer_norm_cl(x, g, b, eps):
+    """LayerNorm over the channel dim of [B,C,L] (torch transposes to
+    channels-last; normalizing axis 1 directly is equivalent)."""
+    mu = jnp.mean(x, axis=1, keepdims=True)
+    var = jnp.var(x, axis=1, keepdims=True)
+    return ((x - mu) * jax.lax.rsqrt(var + eps)) * g[None, :, None] \
+        + b[None, :, None]
+
+
+def leaky_relu(x, slope):
+    return jnp.where(x >= 0, x, x * slope)
+
+
+class _P:
+    """Flat HF-named tensor dict with weight-norm fusion on read."""
+
+    def __init__(self, tensors: dict[str, np.ndarray]):
+        self.t = tensors
+
+    def __contains__(self, k):
+        return (k in self.t or f"{k}_g" in self.t
+                or f"{k.rsplit('.', 1)[0]}.parametrizations.weight."
+                    "original0" in self.t)
+
+    def get(self, name: str) -> jnp.ndarray:
+        if name in self.t:
+            return jnp.asarray(self.t[name])
+        # weight-norm storage: weight_g/weight_v or parametrizations
+        if name.endswith(".weight"):
+            base = name[: -len(".weight")]
+            pairs = (
+                (f"{base}.weight_g", f"{base}.weight_v"),
+                (f"{base}.parametrizations.weight.original0",
+                 f"{base}.parametrizations.weight.original1"),
+            )
+            for gk, vk in pairs:
+                if gk in self.t:
+                    g = np.asarray(self.t[gk], np.float32)
+                    v = np.asarray(self.t[vk], np.float32)
+                    norm = np.sqrt(
+                        (v ** 2).sum(axis=tuple(range(1, v.ndim)),
+                                     keepdims=True)
+                    )
+                    return jnp.asarray(g * v / np.maximum(norm, 1e-12))
+        raise KeyError(name)
+
+    def opt(self, name: str):
+        try:
+            return self.get(name)
+        except KeyError:
+            return None
+
+
+# ---------------------------------------------------------------------------
+# text encoder (relative-position attention — VitsAttention parity)
+
+
+def _relative_embeddings(rel, window, length):
+    pad = max(length - (window + 1), 0)
+    if pad > 0:
+        rel = jnp.pad(rel, ((0, 0), (pad, pad), (0, 0)))
+    start = max((window + 1) - length, 0)
+    return rel[:, start: start + 2 * length - 1]
+
+
+def _rel_to_abs(x):
+    bh, length, _ = x.shape
+    x = jnp.pad(x, ((0, 0), (0, 0), (0, 1)))
+    xf = x.reshape(bh, length * 2 * length)
+    xf = jnp.pad(xf, ((0, 0), (0, length - 1)))
+    return xf.reshape(bh, length + 1, 2 * length - 1)[:, :length,
+                                                      length - 1:]
+
+
+def _abs_to_rel(x):
+    bh, length, _ = x.shape
+    x = jnp.pad(x, ((0, 0), (0, 0), (0, length - 1)))
+    xf = x.reshape(bh, length * (2 * length - 1))
+    xf = jnp.pad(xf, ((0, 0), (length, 0)))
+    return xf.reshape(bh, length, 2 * length)[:, :, 1:]
+
+
+def _attention(p: _P, pre: str, cfg: VitsConfig, x, attn_mask):
+    """x [B,L,H] → [B,L,H] (channels-last like the torch module)."""
+    B, L, H = x.shape
+    nh = cfg.num_heads
+    hd = H // nh
+    scale = hd ** -0.5
+
+    def proj(name):
+        w = p.get(f"{pre}.{name}.weight")
+        out = x @ w.T
+        b = p.opt(f"{pre}.{name}.bias")
+        return out + b if b is not None else out
+
+    q = (proj("q_proj") * scale).reshape(B, L, nh, hd).transpose(
+        0, 2, 1, 3).reshape(B * nh, L, hd)
+    k = proj("k_proj").reshape(B, L, nh, hd).transpose(
+        0, 2, 1, 3).reshape(B * nh, L, hd)
+    v = proj("v_proj").reshape(B, L, nh, hd).transpose(
+        0, 2, 1, 3).reshape(B * nh, L, hd)
+    weights = q @ k.transpose(0, 2, 1)
+    if cfg.window_size:
+        rel_k = _relative_embeddings(
+            p.get(f"{pre}.emb_rel_k"), cfg.window_size, L)
+        weights = weights + _rel_to_abs(q @ rel_k.transpose(0, 2, 1))
+    if attn_mask is not None:
+        weights = jnp.where(
+            attn_mask.reshape(1, 1, 1, L), weights.reshape(B, nh, L, L),
+            -1e9,
+        ).reshape(B * nh, L, L)
+    probs = jax.nn.softmax(weights, axis=-1)
+    out = probs @ v
+    if cfg.window_size:
+        rel_v = _relative_embeddings(
+            p.get(f"{pre}.emb_rel_v"), cfg.window_size, L)
+        out = out + _abs_to_rel(probs) @ rel_v
+    out = out.reshape(B, nh, L, hd).transpose(0, 2, 1, 3).reshape(B, L, H)
+    w_o = p.get(f"{pre}.out_proj.weight")
+    out = out @ w_o.T
+    b_o = p.opt(f"{pre}.out_proj.bias")
+    return out + b_o if b_o is not None else out
+
+
+def _ln_last(x, g, b, eps):
+    mu = jnp.mean(x, -1, keepdims=True)
+    var = jnp.var(x, -1, keepdims=True)
+    return (x - mu) * jax.lax.rsqrt(var + eps) * g + b
+
+
+def _feed_forward(p: _P, pre: str, cfg: VitsConfig, x, pad_cl):
+    """x [B,L,H]; pad_cl [B,L,1] — VitsFeedForward parity (asymmetric
+    conv padding)."""
+    h = (x * pad_cl).transpose(0, 2, 1)
+    mask = pad_cl.transpose(0, 2, 1)
+    k = cfg.ffn_kernel_size
+    if k > 1:
+        h = jnp.pad(h, ((0, 0), (0, 0), ((k - 1) // 2, k // 2)))
+    h = conv1d(h, p.get(f"{pre}.conv_1.weight"),
+               p.get(f"{pre}.conv_1.bias"))
+    h = jax.nn.relu(h)
+    h = h * mask
+    if k > 1:
+        h = jnp.pad(h, ((0, 0), (0, 0), ((k - 1) // 2, k // 2)))
+    h = conv1d(h, p.get(f"{pre}.conv_2.weight"),
+               p.get(f"{pre}.conv_2.bias"))
+    return (h * mask).transpose(0, 2, 1)
+
+
+def text_encoder(p: _P, cfg: VitsConfig, ids, pad_mask):
+    """ids [B,L]; pad_mask [B,L] → (hidden [B,H,L], m_p, logs_p [B,F,L])."""
+    x = jnp.take(p.get("text_encoder.embed_tokens.weight"), ids, axis=0)
+    x = x * math.sqrt(cfg.hidden_size)
+    pad_cl = pad_mask[:, :, None].astype(x.dtype)
+    x = x * pad_cl
+    for i in range(cfg.num_layers):
+        pre = f"text_encoder.encoder.layers.{i}"
+        attn = _attention(p, f"{pre}.attention", cfg, x, pad_mask)
+        x = _ln_last(x + attn, p.get(f"{pre}.layer_norm.weight"),
+                     p.get(f"{pre}.layer_norm.bias"), cfg.layer_norm_eps)
+        ff = _feed_forward(p, f"{pre}.feed_forward", cfg, x, pad_cl)
+        x = _ln_last(x + ff, p.get(f"{pre}.final_layer_norm.weight"),
+                     p.get(f"{pre}.final_layer_norm.bias"),
+                     cfg.layer_norm_eps)
+    x = x * pad_cl
+    stats = conv1d(x.transpose(0, 2, 1),
+                   p.get("text_encoder.project.weight"),
+                   p.get("text_encoder.project.bias"))
+    stats = stats * pad_cl.transpose(0, 2, 1)
+    m_p, logs_p = jnp.split(stats, 2, axis=1)
+    return x.transpose(0, 2, 1), m_p, logs_p
+
+
+# ---------------------------------------------------------------------------
+# WaveNet + residual coupling flow (reverse only — inference)
+
+
+def _wavenet(p: _P, pre: str, cfg: VitsConfig, x, pad, num_layers,
+             cond=None):
+    """VitsWaveNet parity: x [B,H,L]."""
+    H = cfg.hidden_size
+    if cond is not None:
+        cond = conv1d(cond, p.get(f"{pre}.cond_layer.weight"),
+                      p.get(f"{pre}.cond_layer.bias"))
+    outputs = jnp.zeros_like(x)
+    for i in range(num_layers):
+        dilation = cfg.wavenet_dilation_rate ** i
+        padding = (cfg.wavenet_kernel_size * dilation - dilation) // 2
+        h = conv1d(x, p.get(f"{pre}.in_layers.{i}.weight"),
+                   p.get(f"{pre}.in_layers.{i}.bias"),
+                   dilation=dilation, padding=padding)
+        if cond is not None:
+            off = i * 2 * H
+            h = h + cond[:, off: off + 2 * H]
+        acts = jnp.tanh(h[:, :H]) * jax.nn.sigmoid(h[:, H:])
+        rs = conv1d(acts, p.get(f"{pre}.res_skip_layers.{i}.weight"),
+                    p.get(f"{pre}.res_skip_layers.{i}.bias"))
+        if i < num_layers - 1:
+            x = (x + rs[:, :H]) * pad
+            outputs = outputs + rs[:, H:]
+        else:
+            outputs = outputs + rs
+    return outputs * pad
+
+
+def flow_reverse(p: _P, cfg: VitsConfig, z, pad, cond=None):
+    """VitsResidualCouplingBlock reverse (inference direction)."""
+    half = cfg.flow_size // 2
+    for i in reversed(range(cfg.prior_encoder_num_flows)):
+        z = jnp.flip(z, axis=1)
+        pre = f"flow.flows.{i}"
+        first, second = z[:, :half], z[:, half:]
+        h = conv1d(first, p.get(f"{pre}.conv_pre.weight"),
+                   p.get(f"{pre}.conv_pre.bias")) * pad
+        h = _wavenet(p, f"{pre}.wavenet", cfg, h, pad,
+                     cfg.prior_encoder_num_wavenet_layers, cond)
+        mean = conv1d(h, p.get(f"{pre}.conv_post.weight"),
+                      p.get(f"{pre}.conv_post.bias")) * pad
+        second = (second - mean) * pad
+        z = jnp.concatenate([first, second], axis=1)
+    return z
+
+
+# ---------------------------------------------------------------------------
+# duration predictors
+
+
+def _dds(p: _P, pre: str, cfg: VitsConfig, x, pad, cond=None):
+    """VitsDilatedDepthSeparableConv parity."""
+    if cond is not None:
+        x = x + cond
+    k = cfg.duration_predictor_kernel_size
+    for i in range(cfg.depth_separable_num_layers):
+        dilation = k ** i
+        padding = (k * dilation - dilation) // 2
+        h = conv1d(x * pad, p.get(f"{pre}.convs_dilated.{i}.weight"),
+                   p.get(f"{pre}.convs_dilated.{i}.bias"),
+                   dilation=dilation, padding=padding,
+                   groups=x.shape[1])
+        h = layer_norm_cl(h, p.get(f"{pre}.norms_1.{i}.weight"),
+                          p.get(f"{pre}.norms_1.{i}.bias"),
+                          cfg.layer_norm_eps)
+        h = jax.nn.gelu(h, approximate=False)
+        h = conv1d(h, p.get(f"{pre}.convs_pointwise.{i}.weight"),
+                   p.get(f"{pre}.convs_pointwise.{i}.bias"))
+        h = layer_norm_cl(h, p.get(f"{pre}.norms_2.{i}.weight"),
+                          p.get(f"{pre}.norms_2.{i}.bias"),
+                          cfg.layer_norm_eps)
+        h = jax.nn.gelu(h, approximate=False)
+        x = x + h
+    return x * pad
+
+
+def _rq_spline_reverse(inputs, uw, uh, ud, tail_bound):
+    """_unconstrained_rational_quadratic_spline (reverse) — vectorized
+    with masking instead of boolean indexing."""
+    min_bin_width = min_bin_height = min_derivative = 1e-3
+    inside = (inputs >= -tail_bound) & (inputs <= tail_bound)
+    num_bins = uw.shape[-1]
+    constant = math.log(math.exp(1 - min_derivative) - 1)
+    ud = jnp.pad(ud, [(0, 0)] * (ud.ndim - 1) + [(1, 1)],
+                 constant_values=constant)
+
+    widths = jax.nn.softmax(uw, axis=-1)
+    widths = min_bin_width + (1 - min_bin_width * num_bins) * widths
+    cumw = jnp.cumsum(widths, -1)
+    cumw = jnp.pad(cumw, [(0, 0)] * (cumw.ndim - 1) + [(1, 0)])
+    cumw = 2 * tail_bound * cumw - tail_bound
+    cumw = cumw.at[..., 0].set(-tail_bound)
+    cumw = cumw.at[..., -1].set(tail_bound)
+    widths = cumw[..., 1:] - cumw[..., :-1]
+
+    derivs = min_derivative + jax.nn.softplus(ud)
+
+    heights = jax.nn.softmax(uh, axis=-1)
+    heights = min_bin_height + (1 - min_bin_height * num_bins) * heights
+    cumh = jnp.cumsum(heights, -1)
+    cumh = jnp.pad(cumh, [(0, 0)] * (cumh.ndim - 1) + [(1, 0)])
+    cumh = 2 * tail_bound * cumh - tail_bound
+    cumh = cumh.at[..., 0].set(-tail_bound)
+    cumh = cumh.at[..., -1].set(tail_bound)
+    heights = cumh[..., 1:] - cumh[..., :-1]
+
+    # reverse mode bins locate on the height axis
+    locs = cumh.at[..., -1].add(1e-6)
+    safe_in = jnp.clip(inputs, -tail_bound, tail_bound)
+    bin_idx = jnp.sum(
+        (safe_in[..., None] >= locs).astype(jnp.int32), axis=-1
+    ) - 1
+    bin_idx = jnp.clip(bin_idx, 0, num_bins - 1)[..., None]
+
+    def g(a):
+        return jnp.take_along_axis(a, bin_idx, axis=-1)[..., 0]
+
+    in_cumw = g(cumw)
+    in_w = g(widths)
+    in_cumh = g(cumh)
+    delta = heights / widths
+    in_delta = g(delta)
+    in_d = g(derivs)
+    in_d1 = g(derivs[..., 1:])
+    in_h = g(heights)
+
+    i1 = in_d + in_d1 - 2 * in_delta
+    i2 = safe_in - in_cumh
+    i3 = i2 * i1
+    a = in_h * (in_delta - in_d) + i3
+    b = in_h * in_d - i3
+    c = -in_delta * i2
+    disc = b ** 2 - 4 * a * c
+    root = (2 * c) / (-b - jnp.sqrt(jnp.maximum(disc, 0.0)))
+    outputs = root * in_w + in_cumw
+    return jnp.where(inside, outputs, inputs)
+
+
+def _conv_flow_reverse(p: _P, pre: str, cfg: VitsConfig, z, pad, cond):
+    half = cfg.depth_separable_channels // 2
+    first, second = z[:, :half], z[:, half:]
+    h = conv1d(first, p.get(f"{pre}.conv_pre.weight"),
+               p.get(f"{pre}.conv_pre.bias"))
+    h = _dds(p, f"{pre}.conv_dds", cfg, h, pad, cond)
+    h = conv1d(h, p.get(f"{pre}.conv_proj.weight"),
+               p.get(f"{pre}.conv_proj.bias")) * pad
+    B, C, L = first.shape
+    nb = cfg.duration_predictor_flow_bins
+    h = h.reshape(B, C, -1, L).transpose(0, 1, 3, 2)
+    scale = math.sqrt(cfg.hidden_size)
+    second = _rq_spline_reverse(
+        second, h[..., :nb] / scale, h[..., nb: 2 * nb] / scale,
+        h[..., 2 * nb:], cfg.duration_predictor_tail_bound,
+    )
+    return jnp.concatenate([first, second], axis=1) * pad
+
+
+def stochastic_duration_reverse(p: _P, cfg: VitsConfig, x, pad,
+                                noise, cond=None):
+    """VitsStochasticDurationPredictor reverse → log durations [B,1,L].
+    ``noise`` is the [B,2,L] latent draw (0 → deterministic)."""
+    pre = "duration_predictor"
+    x = conv1d(x, p.get(f"{pre}.conv_pre.weight"),
+               p.get(f"{pre}.conv_pre.bias"))
+    if cond is not None:
+        x = x + conv1d(cond, p.get(f"{pre}.cond.weight"),
+                       p.get(f"{pre}.cond.bias"))
+    x = _dds(p, f"{pre}.conv_dds", cfg, x, pad)
+    x = conv1d(x, p.get(f"{pre}.conv_proj.weight"),
+               p.get(f"{pre}.conv_proj.bias")) * pad
+
+    # flows reversed, dropping the "useless vflow" (modeling_vits.py:792)
+    n = cfg.duration_predictor_num_flows
+    latents = noise
+    # order: flows[n] .. flows[2], then flows[0] (ElementwiseAffine)
+    for idx in list(range(n, 1, -1)) + [0]:
+        latents = jnp.flip(latents, axis=1)
+        fp = f"{pre}.flows.{idx}"
+        if idx == 0:
+            tr = p.get(f"{fp}.translate")
+            ls = p.get(f"{fp}.log_scale")
+            latents = (latents - tr[None]) * jnp.exp(-ls[None]) * pad
+        else:
+            latents = _conv_flow_reverse(p, fp, cfg, latents, pad, x)
+    log_duration = latents[:, :1]
+    return log_duration
+
+
+def duration_predictor(p: _P, cfg: VitsConfig, x, pad, cond=None):
+    """Deterministic VitsDurationPredictor → log durations [B,1,L]."""
+    pre = "duration_predictor"
+    if cond is not None:
+        x = x + conv1d(cond, p.get(f"{pre}.cond.weight"),
+                       p.get(f"{pre}.cond.bias"))
+    k = cfg.duration_predictor_kernel_size
+    h = conv1d(x * pad, p.get(f"{pre}.conv_1.weight"),
+               p.get(f"{pre}.conv_1.bias"), padding=k // 2)
+    h = layer_norm_cl(jax.nn.relu(h), p.get(f"{pre}.norm_1.weight"),
+                      p.get(f"{pre}.norm_1.bias"), cfg.layer_norm_eps)
+    h = conv1d(h * pad, p.get(f"{pre}.conv_2.weight"),
+               p.get(f"{pre}.conv_2.bias"), padding=k // 2)
+    h = layer_norm_cl(jax.nn.relu(h), p.get(f"{pre}.norm_2.weight"),
+                      p.get(f"{pre}.norm_2.bias"), cfg.layer_norm_eps)
+    return conv1d(h * pad, p.get(f"{pre}.proj.weight"),
+                  p.get(f"{pre}.proj.bias")) * pad
+
+
+# ---------------------------------------------------------------------------
+# HiFi-GAN decoder
+
+
+def hifigan(p: _P, cfg: VitsConfig, spec, cond=None):
+    """spec [B,F,L] → waveform [B, L*prod(upsample_rates)]."""
+    x = conv1d(spec, p.get("decoder.conv_pre.weight"),
+               p.get("decoder.conv_pre.bias"), padding=3)
+    if cond is not None:
+        x = x + conv1d(cond, p.get("decoder.cond.weight"),
+                       p.get("decoder.cond.bias"))
+    nk = len(cfg.resblock_kernel_sizes)
+    for i, (rate, k) in enumerate(zip(cfg.upsample_rates,
+                                      cfg.upsample_kernel_sizes)):
+        x = leaky_relu(x, cfg.leaky_relu_slope)
+        x = conv_transpose1d(
+            x, p.get(f"decoder.upsampler.{i}.weight"),
+            p.get(f"decoder.upsampler.{i}.bias"),
+            stride=rate, padding=(k - rate) // 2,
+        )
+        acc = None
+        for j in range(nk):
+            rb = f"decoder.resblocks.{i * nk + j}"
+            ks = cfg.resblock_kernel_sizes[j]
+            h = x
+            for ci, dil in enumerate(cfg.resblock_dilation_sizes[j]):
+                r = leaky_relu(h, cfg.leaky_relu_slope)
+                r = conv1d(r, p.get(f"{rb}.convs1.{ci}.weight"),
+                           p.get(f"{rb}.convs1.{ci}.bias"),
+                           dilation=dil,
+                           padding=(ks * dil - dil) // 2)
+                r = leaky_relu(r, cfg.leaky_relu_slope)
+                r = conv1d(r, p.get(f"{rb}.convs2.{ci}.weight"),
+                           p.get(f"{rb}.convs2.{ci}.bias"),
+                           padding=(ks - 1) // 2)
+                h = h + r
+            acc = h if acc is None else acc + h
+        x = acc / nk
+    x = leaky_relu(x, 0.01)  # torch F.leaky_relu default slope
+    x = conv1d(x, p.get("decoder.conv_post.weight"), padding=3)
+    return jnp.tanh(x)[:, 0]
+
+
+# ---------------------------------------------------------------------------
+# tokenizer + model
+
+
+class VitsCharTokenizer:
+    """HF VitsTokenizer behavior: char → id via vocab.json, optional
+    lowercasing and blank interspersal (tokenizer_config.json)."""
+
+    def __init__(self, model_dir: Path):
+        self.vocab = json.loads(
+            (model_dir / "vocab.json").read_text()
+        )
+        tc = {}
+        tc_path = model_dir / "tokenizer_config.json"
+        if tc_path.exists():
+            tc = json.loads(tc_path.read_text())
+        self.do_lower = tc.get("do_lower_case", True)
+        self.add_blank = tc.get("add_blank", True)
+        self.pad_id = self.vocab.get(tc.get("pad_token", "<pad>"), 0)
+
+    def encode(self, text: str) -> list[int]:
+        if self.do_lower:
+            text = text.lower()
+        ids = [self.vocab[ch] for ch in text if ch in self.vocab]
+        if not ids:
+            ids = [self.pad_id]
+        if self.add_blank:
+            out = [self.pad_id] * (2 * len(ids) + 1)
+            out[1::2] = ids
+            return out
+        return ids
+
+
+class VitsTTS:
+    """One loaded VITS voice: text → waveform."""
+
+    def __init__(self, cfg: VitsConfig, params: _P, tokenizer: Any):
+        self.cfg = cfg
+        self.p = params
+        self.tokenizer = tokenizer
+
+    def synthesize(self, text: str, *, speaker_id: Optional[int] = None,
+                   noise_scale: Optional[float] = None,
+                   noise_scale_duration: Optional[float] = None,
+                   speaking_rate: Optional[float] = None,
+                   seed: int = 0) -> np.ndarray:
+        """float32 waveform in [-1, 1] at cfg.sampling_rate."""
+        cfg = self.cfg
+        ids = np.asarray([self.tokenizer.encode(text)], np.int32)
+        pad_mask = np.ones_like(ids, np.float32)
+        wav = self._forward(
+            ids, pad_mask,
+            noise_scale=cfg.noise_scale if noise_scale is None
+            else noise_scale,
+            noise_scale_duration=cfg.noise_scale_duration
+            if noise_scale_duration is None else noise_scale_duration,
+            speaking_rate=cfg.speaking_rate if speaking_rate is None
+            else speaking_rate,
+            speaker_id=speaker_id, seed=seed,
+        )
+        return np.asarray(wav[0], np.float32)
+
+    def _forward(self, ids, pad_mask, *, noise_scale,
+                 noise_scale_duration, speaking_rate, speaker_id, seed):
+        cfg, p = self.cfg, self.p
+        key = jax.random.key(seed)
+        pad = pad_mask[:, None, :]  # [B,1,L]
+        cond = None
+        if cfg.num_speakers > 1 and speaker_id is not None:
+            emb = p.get("embed_speaker.weight")[speaker_id]
+            cond = jnp.asarray(emb)[None, :, None]
+        hidden, m_p, logs_p = text_encoder(p, cfg, jnp.asarray(ids),
+                                           jnp.asarray(pad_mask))
+        if cfg.use_stochastic_duration_prediction:
+            k1, key = jax.random.split(key)
+            noise = jax.random.normal(
+                k1, (ids.shape[0], 2, ids.shape[1])
+            ) * noise_scale_duration
+            log_d = stochastic_duration_reverse(
+                p, cfg, hidden, pad, noise, cond)
+        else:
+            log_d = duration_predictor(p, cfg, hidden, pad, cond)
+        durations = np.ceil(
+            np.asarray(jnp.exp(log_d)) * np.asarray(pad)
+            / speaking_rate
+        )[:, 0]  # [B,L]
+        total = max(int(durations.sum()), 1)
+
+        # length regulation: repeat each text position by its duration
+        # (host-side — output length is data-dependent)
+        reps = durations[0].astype(np.int64)
+        gather = np.repeat(np.arange(ids.shape[1]), reps)
+        if gather.size == 0:
+            gather = np.zeros(1, np.int64)
+        m_up = jnp.asarray(np.asarray(m_p)[:, :, gather])
+        logs_up = jnp.asarray(np.asarray(logs_p)[:, :, gather])
+        out_pad = jnp.ones((1, 1, m_up.shape[-1]), m_up.dtype)
+
+        k2, key = jax.random.split(key)
+        prior = m_up + jax.random.normal(k2, m_up.shape) \
+            * jnp.exp(logs_up) * noise_scale
+        latents = flow_reverse(p, cfg, prior, out_pad, cond)
+        wav = hifigan(p, cfg, latents * out_pad, cond)
+        del total
+        return wav
+
+
+def load_hf_vits(model_dir: str | Path) -> VitsTTS:
+    """HF VitsModel checkpoint dir (config.json model_type "vits" +
+    safetensors + vocab.json) → VitsTTS."""
+    model_dir = Path(model_dir)
+    hf = json.loads((model_dir / "config.json").read_text())
+    cfg = VitsConfig.from_hf(hf)
+    from localai_tpu.models.loader import _get, _open_safetensors
+
+    raw = _open_safetensors(model_dir)
+    tensors = {name: np.asarray(_get(raw, name), np.float32)
+               for name in raw}
+    return VitsTTS(cfg, _P(tensors), VitsCharTokenizer(model_dir))

@@ -106,6 +106,9 @@ def build_parser() -> argparse.ArgumentParser:
                      default=int(_env_default("num_processes", 1)))
     fol.add_argument("--process-id", type=int,
                      default=int(_env_default("process_id", 1)))
+    fol.add_argument("--peer-token",
+                     default=_env_default("peer_token", ""),
+                     help="shared secret for the mirror channel")
 
     tts = sub.add_parser("tts", help="synthesize speech to a wav file")
     tts.add_argument("text", nargs="+")
@@ -391,7 +394,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         _model, runner = build_runner(mcfg, app_cfg)
         print(f"follower replica of {args.model} ready; replaying from "
               f"{args.leader}", flush=True)
-        CommandFollower(args.leader, {args.model: runner}).run_forever()
+        CommandFollower(args.leader, {args.model: runner},
+                        token=args.peer_token).run_forever()
         return 0
 
     if cmd == "tts":

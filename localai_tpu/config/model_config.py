@@ -226,6 +226,9 @@ class ModelConfig(BaseModel):
     lora_adapter: str = ""
     lora_base: str = ""                     # unused: merge needs no base copy
     lora_scale: float = 1.0
+    # remote-API backends (backend: huggingface — pkg/langchain parity)
+    api_token: str = ""
+    api_base: str = ""
 
     parameters: PredictionParams = Field(default_factory=PredictionParams)
     template: TemplateConfig = Field(default_factory=TemplateConfig)
@@ -310,7 +313,8 @@ class ModelConfig(BaseModel):
         name = (self.backend or "").lower()
         if self.embeddings or "embed" in name:
             guessed.add(Usecase.EMBEDDINGS)
-        if name in ("", "jax", "jax-llm", "transformers", "worker"):
+        if name in ("", "jax", "jax-llm", "transformers", "worker",
+                    "huggingface", "langchain-huggingface"):
             guessed |= {
                 Usecase.CHAT,
                 Usecase.COMPLETION,
@@ -325,7 +329,7 @@ class ModelConfig(BaseModel):
             guessed.add(Usecase.IMAGE)
         if "whisper" in name:
             guessed.add(Usecase.TRANSCRIPT)
-        if "tts" in name:
+        if "tts" in name or name == "vits":
             guessed.add(Usecase.TTS)
         if "musicgen" in name or "sound" in name:
             guessed.add(Usecase.SOUND_GENERATION)

@@ -56,8 +56,8 @@ def load_gallery_index(gallery: Gallery) -> list[GalleryModel]:
 def available_models(
     galleries: list[Gallery], models_path: str | Path = "models"
 ) -> list[GalleryModel]:
-    """All models across galleries, flagged installed when their config
-    YAML exists in the models dir."""
+    """All models across galleries plus the shipped index, flagged
+    installed when their config YAML exists in the models dir."""
     models_path = Path(models_path)
     out: list[GalleryModel] = []
     for g in galleries:
@@ -66,9 +66,18 @@ def available_models(
         except Exception as e:  # noqa: BLE001 — one dead gallery ≠ no list
             log.warning("gallery %s unavailable: %s", g.name, e)
             continue
-        for m in models:
-            m.installed = (models_path / f"{safe_name(m.name)}.yaml").exists()
         out.extend(models)
+    # the shipped multi-family index (parity: the reference's bundled
+    # gallery); configured galleries win on name collisions
+    from localai_tpu.gallery.index_data import shipped_index
+
+    seen = {m.name for m in out}
+    for m in shipped_index():
+        if m.name not in seen:
+            m.gallery = "shipped"
+            out.append(m)
+    for m in out:
+        m.installed = (models_path / f"{safe_name(m.name)}.yaml").exists()
     return out
 
 
@@ -85,7 +94,15 @@ def resolve_ref(
         return m
     if downloader.looks_like_url(ref):
         return GalleryModel(name=name or "model", url=ref)
-    return find_model(galleries, ref)
+    m = find_model(galleries, ref)
+    if m is not None:
+        return m
+    # shipped index short names, gallery-qualified as shipped@name too
+    from localai_tpu.gallery.index_data import SHIPPED_MODELS
+
+    short = ref.removeprefix("shipped@")
+    hit = SHIPPED_MODELS.get(short)
+    return hit.model_copy(deep=True) if hit is not None else None
 
 
 def find_model(

@@ -168,6 +168,16 @@ def read_lora_file(path: str | Path) -> dict[tuple[str, str], LoraLayer]:
         groups.setdefault((component, module.replace(".", "_")), {})[
             part] = value
 
+    def split_component(k: str) -> tuple[str, str]:
+        """diffusers-layout key → (component, module-relative key); the
+        ONE prefix table shared by weights and alpha (drift here silently
+        merged alphas at the wrong scale)."""
+        for pre, comp in (("unet.", "unet"), ("text_encoder.", "te"),
+                          ("te.", "te")):
+            if k.startswith(pre):
+                return comp, k[len(pre):]
+        return "unet", k
+
     for key, val in raw.items():
         if key.startswith(("lora_unet_", "lora_te_")):
             component = "unet" if key.startswith("lora_unet_") else "te"
@@ -181,13 +191,7 @@ def read_lora_file(path: str | Path) -> dict[tuple[str, str], LoraLayer]:
                 put(component, module, "alpha", float(val))
         elif ".lora_A." in key or ".lora_B." in key or \
                 ".lora.down." in key or ".lora.up." in key:
-            k = key
-            component = "unet"
-            for pre, comp in (("unet.", "unet"), ("text_encoder.", "te"),
-                              ("te.", "te")):
-                if k.startswith(pre):
-                    component, k = comp, k[len(pre):]
-                    break
+            component, k = split_component(key)
             for marker, part in ((".lora_A.", "down"), (".lora_B.", "up"),
                                  (".lora.down.", "down"),
                                  (".lora.up.", "up")):
@@ -197,14 +201,8 @@ def read_lora_file(path: str | Path) -> dict[tuple[str, str], LoraLayer]:
                     break
         elif key.endswith(".alpha"):
             # diffusers/peft layout stores alpha beside lora_A/lora_B —
-            # strip the same component prefix so it joins their group
-            k = key[: -len(".alpha")]
-            component = "unet"
-            for pre, comp in (("unet.", "unet"), ("text_encoder.", "te"),
-                              ("te.", "te")):
-                if k.startswith(pre):
-                    component, k = comp, k[len(pre):]
-                    break
+            # the same prefix split keeps it in their group
+            component, k = split_component(key[: -len(".alpha")])
             put(component, k, "alpha", float(val))
 
     out: dict[tuple[str, str], LoraLayer] = {}

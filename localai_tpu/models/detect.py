@@ -61,6 +61,8 @@ def detect_backend(ref: str, model_path: str | Path = "models"
             mt = str(hf.get("model_type", ""))
             if mt == "whisper":
                 return "whisper"
+            if mt == "vits":
+                return "vits"
             if mt in _BERT_TYPES:
                 return (
                     "reranker" if _has_classifier(cand)

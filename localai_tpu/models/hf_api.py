@@ -1,0 +1,172 @@
+"""HuggingFace Inference-API backend: serve a remote hosted model.
+
+Parity: /root/reference/pkg/langchain/huggingface.go + backend/go/llm/
+langchain/langchain.go — the `langchain-huggingface` backend forwards
+prompts to the HF Inference API with the HUGGINGFACEHUB_API_TOKEN. Here
+it's a scheduler-shaped facade (same surface the HTTP endpoints drive on
+every other ServingModel), so remote models slot into the normal model
+lifecycle, watchdogs, and endpoints. Prompt text round-trips through the
+byte tokenizer (ids are UTF-8 bytes → lossless decode back to text for
+the wire)."""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+import urllib.request
+
+from localai_tpu.config.app_config import AppConfig
+from localai_tpu.config.model_config import ModelConfig
+from localai_tpu.engine.scheduler import GenHandle, GenRequest
+from localai_tpu.utils.tokenizer import ByteTokenizer
+
+log = logging.getLogger(__name__)
+
+DEFAULT_API_BASE = "https://api-inference.huggingface.co/models"
+TOKEN_ENV = ("HUGGINGFACEHUB_API_TOKEN", "HF_TOKEN")
+
+
+def _resolve_token(mcfg: ModelConfig) -> str:
+    token = getattr(mcfg, "api_token", "") or ""
+    if token:
+        return token
+    for env in TOKEN_ENV:
+        if os.environ.get(env):
+            return os.environ[env]
+    return ""
+
+
+class HFApiScheduler:
+    """submit() posts to the Inference API on a daemon thread feeding a
+    GenHandle (the remote analogue of the worker tier's scheduler)."""
+
+    def __init__(self, repo: str, token: str, api_base: str,
+                 timeout: float = 120.0):
+        self.repo = repo
+        self.token = token
+        self.api_base = api_base.rstrip("/")
+        self.timeout = timeout
+        self._ids = iter(range(1 << 62))
+        self._inflight = 0
+        self._lock = threading.Lock()
+        self._tok = ByteTokenizer()
+
+    @property
+    def busy(self) -> bool:
+        with self._lock:
+            return self._inflight > 0
+
+    def submit(self, gr: GenRequest) -> GenHandle:
+        handle = GenHandle(gr, next(self._ids))
+        with self._lock:
+            self._inflight += 1
+        threading.Thread(
+            target=self._run, args=(handle,), daemon=True,
+            name=f"hf-api-{handle.id}",
+        ).start()
+        return handle
+
+    def _run(self, handle: GenHandle) -> None:
+        try:
+            text = self._predict(handle.request)
+            handle._emit(text, None)
+            handle._finish("stop")
+        except Exception as e:  # noqa: BLE001 — remote failure ≠ crash
+            log.warning("HF API request failed: %s", e)
+            handle._finish("error")
+        finally:
+            with self._lock:
+                self._inflight -= 1
+
+    def _predict(self, gr: GenRequest) -> str:
+        prompt = self._tok.decode(gr.prompt)
+        parameters: dict = {
+            "max_new_tokens": gr.max_new_tokens,
+            "return_full_text": False,
+        }
+        if gr.temperature is not None and gr.temperature > 0:
+            parameters["temperature"] = gr.temperature
+        if gr.top_p is not None:
+            parameters["top_p"] = gr.top_p
+        if gr.top_k is not None:
+            parameters["top_k"] = gr.top_k
+        if gr.stop:
+            parameters["stop"] = list(gr.stop)[:4]  # API caps stop seqs
+        req = urllib.request.Request(
+            f"{self.api_base}/{self.repo}",
+            data=json.dumps({
+                "inputs": prompt, "parameters": parameters,
+            }).encode(),
+            headers={
+                "Content-Type": "application/json",
+                **({"Authorization": f"Bearer {self.token}"}
+                   if self.token else {}),
+            },
+        )
+        with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+            body = json.loads(resp.read())
+        # text-generation responses: [{"generated_text": ...}]; some
+        # endpoints return {"generated_text": ...} or {"error": ...}
+        if isinstance(body, dict):
+            if "error" in body:
+                raise RuntimeError(str(body["error"]))
+            body = [body]
+        if body and isinstance(body[0], dict):
+            return str(body[0].get("generated_text", ""))
+        return ""
+
+    def metrics(self) -> dict:
+        with self._lock:
+            return {"type": "hf-api", "inflight": self._inflight,
+                    "repo": self.repo}
+
+    def shutdown(self, timeout: float = 10.0) -> None:
+        pass  # nothing held locally
+
+
+class HFApiServingModel:
+    """ServingModel facade over the Inference API (no local weights)."""
+
+    def __init__(self, mcfg: ModelConfig, app: AppConfig):
+        from localai_tpu.templates.cache import TemplateCache
+
+        token = _resolve_token(mcfg)
+        if not token:
+            # parity: NewHuggingFace errors without a token
+            # (huggingface.go:17-19)
+            raise ValueError(
+                f"model {mcfg.name!r}: backend huggingface needs an API "
+                f"token (api_token: in the config, or "
+                f"{'/'.join(TOKEN_ENV)} in the environment)"
+            )
+        self.name = mcfg.name
+        self.config = mcfg
+        self.tokenizer = ByteTokenizer()
+        self.templates = TemplateCache(app.model_path)
+        self.vision = None
+        self.image_token_id = 0
+        self.scheduler = HFApiScheduler(
+            mcfg.model or mcfg.name, token,
+            getattr(mcfg, "api_base", "") or DEFAULT_API_BASE,
+        )
+        self.loaded_at = time.monotonic()
+        self.last_used = time.monotonic()
+
+    def touch(self) -> None:
+        self.last_used = time.monotonic()
+
+    @property
+    def busy(self) -> bool:
+        return self.scheduler.busy
+
+    def alive(self) -> bool:
+        return True  # remote; failures surface per-request
+
+    def engine_metrics(self) -> dict:
+        return self.scheduler.metrics()
+
+    def close(self) -> None:
+        pass

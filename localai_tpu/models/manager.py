@@ -318,7 +318,8 @@ def build_serving_model(mcfg: ModelConfig, app: AppConfig) -> ServingModel:
             get_leader,
         )
 
-        leader = get_leader(app.mirror_port, app.mirror_followers)
+        leader = get_leader(app.mirror_port, app.mirror_followers,
+                            token=app.peer_token)
         if app.mirror_followers:
             leader.wait_for(app.mirror_followers)
         runner = MirroredRunner(runner, leader, mcfg.name)
@@ -528,6 +529,10 @@ class ModelManager:
             return WorkerServingModel(
                 mcfg, self.app, self.pool(), external_address=ext or None
             )
+        if mcfg.backend in ("huggingface", "langchain-huggingface"):
+            from localai_tpu.models.hf_api import HFApiServingModel
+
+            return HFApiServingModel(mcfg, self.app)
         try:
             return build_serving_model(mcfg, self.app)
         except Exception:

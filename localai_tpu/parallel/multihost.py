@@ -100,13 +100,20 @@ def _decode_arg(v: Any) -> Any:
 class CommandLeader:
     """Accepts follower connections and broadcasts every command in
     issue order. Followers that lag apply backpressure (sendall) — the
-    group advances in lockstep, which is exactly the SPMD contract."""
+    group advances in lockstep, which is exactly the SPMD contract.
 
-    def __init__(self, port: int = 0, expected: int = 0):
+    Joining requires a token handshake when ``token`` is set (the group's
+    shared ``peer_token``): the broadcast stream carries every user
+    prompt, so an unauthenticated listener would be an exfiltration
+    channel — and a stranger's disconnect would poison the SPMD group."""
+
+    def __init__(self, port: int = 0, expected: int = 0,
+                 token: str = ""):
         self._srv = socket.create_server(("0.0.0.0", port))
         self.port = self._srv.getsockname()[1]
         self._conns: list[socket.socket] = []
         self._lock = threading.Lock()
+        self.token = token
         self._accepting = threading.Thread(
             target=self._accept_loop, daemon=True, name="mh-accept"
         )
@@ -120,10 +127,32 @@ class CommandLeader:
             except OSError:
                 return
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            try:
+                self._handshake(conn)
+            except Exception as e:  # noqa: BLE001 — reject, keep serving
+                log.warning("multihost: rejected connection from %s (%s)",
+                            addr, e)
+                conn.close()
+                continue
             with self._lock:
                 self._conns.append(conn)
             log.info("multihost: follower %s joined (%d connected)",
                      addr, len(self._conns))
+
+    def _handshake(self, conn: socket.socket) -> None:
+        import hmac
+
+        conn.settimeout(10.0)
+        (length,) = struct.unpack(">I", _read_exact(conn, 4))
+        if length > 4096:
+            raise ValueError("oversized handshake")
+        hello = json.loads(_read_exact(conn, length))
+        offered = str(hello.get("token", ""))
+        if self.token and not hmac.compare_digest(offered, self.token):
+            conn.sendall(_pack({"ok": False, "error": "bad token"}))
+            raise PermissionError("bad peer token")
+        conn.sendall(_pack({"ok": True}))
+        conn.settimeout(None)
 
     def wait_for(self, n: int, timeout: float = 120.0) -> None:
         import time
@@ -175,7 +204,7 @@ class CommandFollower:
     ModelRunner replicas (keyed by model name) until the channel closes."""
 
     def __init__(self, leader: str, targets: dict[str, Any],
-                 connect_timeout: float = 120.0):
+                 connect_timeout: float = 120.0, token: str = ""):
         import time
 
         host, _, port = leader.rpartition(":")
@@ -191,6 +220,15 @@ class CommandFollower:
                 if time.monotonic() >= deadline:
                     raise
                 time.sleep(1.0)
+        # handshake: offer the shared peer token, wait for the verdict
+        self._sock.sendall(_pack({"token": token}))
+        (length,) = struct.unpack(">I", _read_exact(self._sock, 4))
+        verdict = json.loads(_read_exact(self._sock, length))
+        if not verdict.get("ok"):
+            self._sock.close()
+            raise PermissionError(
+                f"leader rejected follower: {verdict.get('error')}"
+            )
         self._sock.settimeout(None)
         self.targets = targets
 
@@ -224,13 +262,15 @@ _leader_singleton: Optional[CommandLeader] = None
 _leader_lock = threading.Lock()
 
 
-def get_leader(port: int, expected: int = 0) -> CommandLeader:
+def get_leader(port: int, expected: int = 0,
+               token: str = "") -> CommandLeader:
     """Process-wide command channel (all mirrored models share it; the
     model name in each message routes replay on the follower side)."""
     global _leader_singleton
     with _leader_lock:
         if _leader_singleton is None:
-            _leader_singleton = CommandLeader(port, expected=expected)
+            _leader_singleton = CommandLeader(port, expected=expected,
+                                              token=token)
         return _leader_singleton
 
 

@@ -56,8 +56,10 @@ def fixture_gallery(tmp_path):
 
 def test_find_and_available(fixture_gallery, tmp_models_dir):
     models = available_models([fixture_gallery], tmp_models_dir)
-    assert [m.name for m in models] == ["fixture-model"]
+    # configured gallery entries lead; the shipped index follows
+    assert models[0].name == "fixture-model"
     assert not models[0].installed
+    assert all(m.gallery == "shipped" for m in models[1:])
 
     assert find_model([fixture_gallery], "fixture-model") is not None
     assert find_model([fixture_gallery], "test@fixture-model") is not None
@@ -222,3 +224,64 @@ def test_gallery_http_api(fixture_gallery, tmp_models_dir):
             assert r.status_code == 200
     finally:
         srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# shipped multi-family index (parity: the reference's bundled gallery)
+
+
+def test_shipped_index_families_and_resolution(tmp_path):
+    from localai_tpu.gallery import available_models, resolve_ref
+    from localai_tpu.gallery.index_data import SHIPPED_MODELS, shipped_index
+
+    models = shipped_index()
+    assert len(models) >= 30
+    # every north-star modality is represented
+    backends = {
+        (m.config_file or {}).get("backend", "") for m in models
+    }
+    assert {"", "whisper", "diffusers", "reranker",
+            "bert-embeddings"} <= backends
+    # entries are well-formed: a name, an installable payload, a config
+    for m in models:
+        assert m.name
+        assert m.config_file and m.config_file.get("model")
+        assert m.files or m.url
+        for f in m.files:
+            assert f.uri.startswith("huggingface://")
+
+    # short-name resolution without any configured gallery
+    m = resolve_ref([], "qwen2.5-7b-instruct")
+    assert m is not None
+    assert m.config_file["context_size"] == 131072
+    assert resolve_ref([], "shipped@whisper-base") is not None
+    assert resolve_ref([], "no-such-model") is None
+
+    # shipped entries appear in the available listing, install-flagged
+    listing = available_models([], tmp_path)
+    names = {m.name for m in listing}
+    assert "all-minilm-l6-v2" in names
+    assert "stable-diffusion-1.5" in names
+    (tmp_path / "whisper-base.yaml").write_text("name: whisper-base\n")
+    listing = available_models([], tmp_path)
+    flags = {m.name: m.installed for m in listing}
+    assert flags["whisper-base"] is True
+    assert flags["whisper-tiny"] is False
+
+
+def test_shipped_index_yields_to_configured_galleries(tmp_path):
+    """A configured gallery entry with the same name wins over shipped."""
+    import json
+
+    from localai_tpu.gallery import Gallery, available_models
+
+    idx = tmp_path / "idx.json"
+    idx.write_text(json.dumps([{
+        "name": "whisper-base", "description": "gallery override",
+        "url": "file:///unused.yaml",
+    }]))
+    g = Gallery(name="g", url=f"file://{idx}")
+    listing = available_models([g], tmp_path)
+    mine = [m for m in listing if m.name == "whisper-base"]
+    assert len(mine) == 1
+    assert mine[0].description == "gallery override"

@@ -140,3 +140,32 @@ def test_scheduler_over_mirrored_runner():
         stop.set()
         follower.close()
         ch.close()
+
+
+def test_mirror_channel_requires_peer_token():
+    """With a token set, unauthenticated connections are rejected and
+    never join the follower group (the stream carries user prompts)."""
+    import socket
+    import struct
+    import time
+
+    ch = CommandLeader(port=0, token="sekrit")
+    replica = _runner()
+    # wrong token → refused
+    with pytest.raises(PermissionError, match="rejected"):
+        CommandFollower(f"127.0.0.1:{ch.port}", {"m": replica},
+                        token="wrong", connect_timeout=5.0)
+    # raw connection that never handshakes → never joins
+    raw = socket.create_connection(("127.0.0.1", ch.port), timeout=5.0)
+    raw.sendall(struct.pack(">I", 2) + b"{}")
+    time.sleep(0.3)
+    assert len(ch._conns) == 0
+    raw.close()
+    # right token joins and replays
+    f = CommandFollower(f"127.0.0.1:{ch.port}", {"m": replica},
+                        token="sekrit")
+    ch.wait_for(1)
+    ch.broadcast("m", "release", 0)
+    f.step()
+    f.close()
+    ch.close()

@@ -1,0 +1,179 @@
+"""VITS neural TTS: numerical parity against the torch reference
+implementation (transformers.VitsModel) on tiny random checkpoints, plus
+loader/tokenizer behavior. This pins the JAX port layer-for-layer — the
+strongest correctness evidence available without real voice downloads."""
+
+import json
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+from transformers import VitsConfig as HFVitsConfig  # noqa: E402
+from transformers import VitsModel  # noqa: E402
+
+from localai_tpu.audio.vits import (  # noqa: E402
+    VitsCharTokenizer,
+    VitsConfig,
+    VitsTTS,
+    _P,
+    load_hf_vits,
+)
+
+TINY = dict(
+    vocab_size=24,
+    hidden_size=16,
+    num_hidden_layers=2,
+    num_attention_heads=2,
+    window_size=4,
+    ffn_dim=32,
+    flow_size=8,
+    spectrogram_bins=9,
+    prior_encoder_num_flows=2,
+    prior_encoder_num_wavenet_layers=2,
+    duration_predictor_num_flows=2,
+    duration_predictor_filter_channels=16,
+    depth_separable_num_layers=2,
+    upsample_initial_channel=32,
+    upsample_rates=[4, 4],
+    upsample_kernel_sizes=[8, 8],
+    resblock_kernel_sizes=[3, 5],
+    resblock_dilation_sizes=[[1, 3], [1, 3]],
+    sampling_rate=16000,
+)
+
+
+def _build_torch_model(use_sdp: bool, seed: int = 0):
+    torch.manual_seed(seed)
+    hf_cfg = HFVitsConfig(
+        **TINY, use_stochastic_duration_prediction=use_sdp,
+    )
+    model = VitsModel(hf_cfg).eval()
+    model.noise_scale = 0.0
+    model.noise_scale_duration = 0.0
+    return hf_cfg, model
+
+
+def _jax_tts(hf_cfg, model) -> VitsTTS:
+    state = {k: v.detach().numpy() for k, v in model.state_dict().items()}
+    cfg = VitsConfig.from_hf(hf_cfg.to_dict())
+    return VitsTTS(cfg, _P(state), tokenizer=None)
+
+
+@pytest.mark.parametrize("use_sdp", [False, True],
+                         ids=["deterministic-dp", "stochastic-dp"])
+def test_waveform_matches_torch(use_sdp):
+    hf_cfg, model = _build_torch_model(use_sdp)
+    tts = _jax_tts(hf_cfg, model)
+
+    ids = torch.tensor([[1, 5, 9, 3, 7, 2, 11, 4]])
+    with torch.no_grad():
+        want = model(ids).waveform.numpy()[0]
+
+    got = tts._forward(
+        ids.numpy(), np.ones(ids.shape, np.float32),
+        noise_scale=0.0, noise_scale_duration=0.0, speaking_rate=1.0,
+        speaker_id=None, seed=0,
+    )
+    got = np.asarray(got[0], np.float32)
+    assert got.shape == want.shape, (got.shape, want.shape)
+    np.testing.assert_allclose(got, want, atol=2e-4)
+
+
+def test_multispeaker_conditioning_matches_torch():
+    torch.manual_seed(1)
+    hf_cfg = HFVitsConfig(
+        **TINY, use_stochastic_duration_prediction=False,
+        num_speakers=3, speaker_embedding_size=8,
+    )
+    model = VitsModel(hf_cfg).eval()
+    model.noise_scale = 0.0
+    model.noise_scale_duration = 0.0
+    tts = _jax_tts(hf_cfg, model)
+    ids = torch.tensor([[2, 4, 6, 8]])
+    for spk in (0, 2):
+        with torch.no_grad():
+            want = model(ids, speaker_id=spk).waveform.numpy()[0]
+        got = np.asarray(tts._forward(
+            ids.numpy(), np.ones(ids.shape, np.float32),
+            noise_scale=0.0, noise_scale_duration=0.0,
+            speaking_rate=1.0, speaker_id=spk, seed=0,
+        )[0], np.float32)
+        assert got.shape == want.shape
+        np.testing.assert_allclose(got, want, atol=2e-4)
+
+
+def test_checkpoint_dir_loading_and_synthesis(tmp_path):
+    """Full load path: config.json + safetensors (with weight-norm keys
+    as torch saves them) + vocab.json → audible output."""
+    from safetensors.numpy import save_file
+
+    hf_cfg, model = _build_torch_model(use_sdp=True)
+    state = {k: v.detach().numpy().copy()
+             for k, v in model.state_dict().items()}
+    d = tmp_path / "voice"
+    d.mkdir()
+    save_file(state, d / "model.safetensors")
+    (d / "config.json").write_text(json.dumps(
+        {"model_type": "vits", **hf_cfg.to_dict()}, default=str))
+    vocab = {ch: i for i, ch in enumerate("<pad> abcdefghijklmnopq")}
+    vocab["<pad>"] = 0
+    (d / "vocab.json").write_text(json.dumps(vocab))
+    (d / "tokenizer_config.json").write_text(json.dumps({
+        "do_lower_case": True, "add_blank": True, "pad_token": "<pad>",
+    }))
+
+    tts = load_hf_vits(d)
+    wav = tts.synthesize("abc def", noise_scale=0.0,
+                         noise_scale_duration=0.0)
+    assert wav.dtype == np.float32
+    assert wav.size > 100
+    assert np.isfinite(wav).all()
+    assert np.abs(wav).max() <= 1.0
+    # deterministic at zero noise
+    wav2 = tts.synthesize("abc def", noise_scale=0.0,
+                          noise_scale_duration=0.0)
+    np.testing.assert_array_equal(wav, wav2)
+
+
+def test_char_tokenizer_interspersal(tmp_path):
+    (tmp_path / "vocab.json").write_text(json.dumps(
+        {"<pad>": 0, "a": 1, "b": 2}))
+    (tmp_path / "tokenizer_config.json").write_text(json.dumps(
+        {"do_lower_case": True, "add_blank": True, "pad_token": "<pad>"}))
+    tok = VitsCharTokenizer(tmp_path)
+    # blanks interspersed around every kept char; unknown chars dropped
+    assert tok.encode("aB!") == [0, 1, 0, 2, 0]
+    assert tok.encode("??") == [0, 0, 0]  # pad fallback, then blanks
+
+
+def test_tts_endpoint_routes_to_vits(tmp_path):
+    """A vits checkpoint config serves /v1/audio/speech through the
+    neural path (parity: the piper TTS backend routing)."""
+    import httpx
+    from safetensors.numpy import save_file
+    from test_api import _ServerThread, make_state
+
+    hf_cfg, model = _build_torch_model(use_sdp=True)
+    d = tmp_path / "voice-ckpt"
+    d.mkdir()
+    save_file({k: v.detach().numpy().copy()
+               for k, v in model.state_dict().items()},
+              d / "model.safetensors")
+    (d / "config.json").write_text(json.dumps(
+        {"model_type": "vits", **hf_cfg.to_dict()}, default=str))
+    vocab = {ch: i for i, ch in enumerate("<pad> abcdefghijklmnopq")}
+    vocab["<pad>"] = 0
+    (d / "vocab.json").write_text(json.dumps(vocab))
+    (tmp_path / "voice.yaml").write_text("name: voice\nmodel: voice-ckpt\n")
+    srv = _ServerThread(make_state(tmp_path))
+    try:
+        # autodetect routed the bare YAML to the vits backend
+        assert srv.state.loader.get("voice").backend == "vits"
+        with httpx.Client(base_url=srv.base, timeout=120.0) as c:
+            r = c.post("/tts", json={"model": "voice", "input": "abc"})
+            assert r.status_code == 200, r.text
+            assert r.content[:4] == b"RIFF"
+            assert len(r.content) > 500
+    finally:
+        srv.stop()
