@@ -153,7 +153,10 @@ def _vits_for(state, name: str):
 
     if ref.startswith("debug:"):
         return None  # debug TTS rides the parametric synth
-    if mcfg.backend not in ("vits", "tts"):
+    if mcfg.backend != "vits":
+        # `backend: tts` and bare configs: neural only when a vits
+        # checkpoint actually exists — the parametric synth stays the
+        # fallback (tts.py docstring contract)
         from localai_tpu.models.detect import detect_backend
 
         if detect_backend(ref, state.config.model_path) != "vits":

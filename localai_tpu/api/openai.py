@@ -99,7 +99,9 @@ async def _in_executor(request: web.Request, fn, *args):
 
 async def _await_handles(request: web.Request, handles, timeout: float = 600.0):
     """Wait for generations, cancelling them all if the client goes away
-    (otherwise orphaned work would hold decode slots to max_tokens)."""
+    (otherwise orphaned work would hold decode slots to max_tokens).
+    A handle that finished with reason "error" and produced nothing is a
+    backend failure — surface 502, not a successful empty completion."""
     try:
         for h in handles:
             await _in_executor(request, h.result, timeout)
@@ -107,6 +109,11 @@ async def _await_handles(request: web.Request, handles, timeout: float = 600.0):
         for h in handles:
             h.cancel()
         raise
+    for h in handles:
+        if h.finish_reason == "error" and not h.text:
+            raise web.HTTPBadGateway(
+                text="generation failed in the backend (see server logs)"
+            )
 
 
 # ---------------------------------------------------------------------------

@@ -7,8 +7,10 @@ backends) behind the TTS/SoundGeneration RPCs and /v1/audio/speech,
 this built-in engine is the zero-download path: a deterministic formant
 synthesizer (phoneme-ish classes → pitch/formant/duration tracks →
 harmonic + noise bank) producing intelligible-cadence speech audio
-entirely as vectorized JAX ops. Neural TTS checkpoints plug in behind the
-same worker contract later.
+entirely as vectorized JAX ops. Neural voices are served by the VITS
+engine (localai_tpu.audio.vits — piper's architecture, loading HF
+VitsModel checkpoints); this module remains the fallback for models
+without a vits checkpoint.
 
 The synthesis is one jitted program over fixed-size frame tracks, so a
 request costs one device dispatch.

@@ -314,7 +314,8 @@ class ModelConfig(BaseModel):
         if self.embeddings or "embed" in name:
             guessed.add(Usecase.EMBEDDINGS)
         if name in ("", "jax", "jax-llm", "transformers", "worker",
-                    "huggingface", "langchain-huggingface"):
+                    "huggingface", "langchain-huggingface", "mamba",
+                    "rwkv"):
             guessed |= {
                 Usecase.CHAT,
                 Usecase.COMPLETION,

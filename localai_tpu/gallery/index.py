@@ -68,14 +68,15 @@ def available_models(
             continue
         out.extend(models)
     # the shipped multi-family index (parity: the reference's bundled
-    # gallery); configured galleries win on name collisions
-    from localai_tpu.gallery.index_data import shipped_index
+    # gallery); configured galleries win on name collisions. Shallow
+    # copies only — this runs per HTTP listing request, and the flags
+    # set here are scalars (deep copies happen at resolve/install time).
+    from localai_tpu.gallery.index_data import _ENTRIES
 
     seen = {m.name for m in out}
-    for m in shipped_index():
+    for m in _ENTRIES:
         if m.name not in seen:
-            m.gallery = "shipped"
-            out.append(m)
+            out.append(m.model_copy(update={"gallery": "shipped"}))
     for m in out:
         m.installed = (models_path / f"{safe_name(m.name)}.yaml").exists()
     return out

@@ -189,6 +189,21 @@ _ENTRIES: list[GalleryModel] = [
             "Whisper large v3 turbo STT", backend="whisper",
             usecases=["transcript"], license="apache-2.0",
             tags=["audio"], files=_sharded(2)),
+    # -- recurrent-state families (mamba / rwkv) ---------------------------
+    _family("mamba-130m", "state-spaces/mamba-130m-hf",
+            "Mamba 130M (selective state space LM)", backend="mamba",
+            usecases=["chat", "completion"], license="apache-2.0",
+            files=["config.json", "tokenizer.json",
+                   "tokenizer_config.json", "model.safetensors"]),
+    _family("mamba-2.8b", "state-spaces/mamba-2.8b-hf",
+            "Mamba 2.8B (selective state space LM)", backend="mamba",
+            usecases=["chat", "completion"], license="apache-2.0",
+            files=_sharded(3)),
+    _family("rwkv-4-pile-169m", "RWKV/rwkv-4-169m-pile",
+            "RWKV-4 169M (linear attention LM)", backend="rwkv",
+            usecases=["chat", "completion"], license="apache-2.0",
+            files=["config.json", "tokenizer.json",
+                   "tokenizer_config.json", "model.safetensors"]),
     # -- vits (neural text-to-speech) --------------------------------------
     _family("mms-tts-eng", "facebook/mms-tts-eng",
             "MMS English VITS voice (neural TTS)",

@@ -31,6 +31,8 @@ _DEBUG_BACKENDS = [
     ("whisper", "whisper"),
     ("reranker", "reranker"),
     ("bert", "bert-embeddings"),
+    ("mamba", "mamba"),
+    ("rwkv", "rwkv"),
 ]
 
 
@@ -63,6 +65,10 @@ def detect_backend(ref: str, model_path: str | Path = "models"
                 return "whisper"
             if mt == "vits":
                 return "vits"
+            if mt in ("mamba", "mamba2"):
+                return "mamba"
+            if mt == "rwkv":
+                return "rwkv"
             if mt in _BERT_TYPES:
                 return (
                     "reranker" if _has_classifier(cand)

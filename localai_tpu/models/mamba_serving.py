@@ -1,0 +1,157 @@
+"""Mamba serving facade: the scheduler-shaped surface over MambaLM.
+
+Parity: the mamba backend process
+(/root/reference/backend/python/mamba/backend.py) — a dedicated
+generation path rather than the slot engine (SSMs keep O(1) recurrent
+state per stream instead of a paged KV cache, so the llama engine's
+slot/page machinery doesn't apply). Requests run one-at-a-time per model
+on a worker thread (matching the reference backend's serial generate);
+the standard endpoints see the same scheduler.submit → GenHandle
+contract as every other ServingModel."""
+
+from __future__ import annotations
+
+import itertools
+import logging
+import threading
+import time
+
+from localai_tpu.config.app_config import AppConfig
+from localai_tpu.config.model_config import ModelConfig
+from localai_tpu.engine.scheduler import GenHandle, GenRequest
+from localai_tpu.engine.stream import IncrementalDetokenizer, StopChecker
+
+log = logging.getLogger(__name__)
+
+
+class MambaScheduler:
+    """submit() runs generation on a daemon thread feeding the handle;
+    a model-wide lock serializes generations (one recurrent state)."""
+
+    def __init__(self, lm, tokenizer):
+        self.lm = lm
+        self.tokenizer = tokenizer
+        self._ids = itertools.count()
+        self._gen_lock = threading.Lock()
+        self._inflight = 0
+        self._lock = threading.Lock()
+        self.total_generated = 0
+
+    @property
+    def busy(self) -> bool:
+        with self._lock:
+            return self._inflight > 0
+
+    def submit(self, gr: GenRequest) -> GenHandle:
+        handle = GenHandle(gr, next(self._ids))
+        with self._lock:
+            self._inflight += 1
+        threading.Thread(target=self._run, args=(handle,), daemon=True,
+                         name=f"mamba-{handle.id}").start()
+        return handle
+
+    def _run(self, handle: GenHandle) -> None:
+        gr = handle.request
+        try:
+            detok = IncrementalDetokenizer(self.tokenizer.decode)
+            stopper = StopChecker(gr.stop)
+            eos = set() if gr.ignore_eos else set(
+                getattr(self.tokenizer, "eos_ids", set())
+            ) | {self.lm.cfg.eos_token_id}
+            finish = "length"
+            with self._gen_lock:
+                def on_token(t: int) -> None:
+                    if handle.cancelled:
+                        raise _Cancelled
+                    handle._emit(stopper.push(detok.push(t)), t)
+                    if stopper.stopped is not None:
+                        raise _Stopped
+
+                try:
+                    self.lm.generate(
+                        gr.prompt,
+                        max_new_tokens=gr.max_new_tokens or 256,
+                        temperature=gr.temperature or 0.0,
+                        seed=gr.seed or 0,
+                        eos_ids=eos,
+                        on_token=on_token,
+                    )
+                    finish = "length"
+                except _Stopped:
+                    finish = "stop"
+                except _Cancelled:
+                    finish = "cancelled"
+            handle._emit(stopper.flush(), None)
+            if finish == "length" and len(handle.token_ids) < (
+                    gr.max_new_tokens or 256):
+                finish = "stop"  # ended on EOS before the budget
+            with self._lock:
+                self.total_generated += len(handle.token_ids)
+            handle._finish(finish)
+        except Exception as e:  # noqa: BLE001 — request error ≠ crash
+            log.warning("mamba generation failed: %s", e)
+            handle._finish("error")
+        finally:
+            with self._lock:
+                self._inflight -= 1
+
+    def metrics(self) -> dict:
+        with self._lock:
+            return {"type": "mamba", "inflight": self._inflight,
+                    "total_generated_tokens": self.total_generated}
+
+    def shutdown(self, timeout: float = 10.0) -> None:
+        pass
+
+
+class _Stopped(Exception):
+    pass
+
+
+class _Cancelled(Exception):
+    pass
+
+
+class MambaServingModel:
+    """ServingModel facade for recurrent-state models (backend: mamba or
+    rwkv — both expose the MambaLM/RwkvLM generate surface)."""
+
+    def __init__(self, mcfg: ModelConfig, app: AppConfig):
+        from localai_tpu.templates.cache import TemplateCache
+
+        t0 = time.monotonic()
+        self.name = mcfg.name
+        self.config = mcfg
+        if mcfg.backend == "rwkv":
+            from localai_tpu.models.rwkv import resolve_rwkv as resolve
+        else:
+            from localai_tpu.models.mamba import resolve_mamba as resolve
+        self.lm = resolve(
+            mcfg.model or mcfg.name, model_path=app.model_path,
+            dtype=mcfg.engine.dtype, seed=mcfg.seed or 0,
+        )
+        self.tokenizer = self.lm.tokenizer
+        self.templates = TemplateCache(app.model_path)
+        self.vision = None
+        self.image_token_id = 0
+        self.scheduler = MambaScheduler(self.lm, self.tokenizer)
+        self.loaded_at = time.monotonic()
+        self.last_used = time.monotonic()
+        log.info("loaded mamba model %s in %.1fs", mcfg.name,
+                 time.monotonic() - t0)
+
+    def touch(self) -> None:
+        self.last_used = time.monotonic()
+
+    @property
+    def busy(self) -> bool:
+        return self.scheduler.busy
+
+    def alive(self) -> bool:
+        return self.lm is not None
+
+    def engine_metrics(self) -> dict:
+        return self.scheduler.metrics()
+
+    def close(self) -> None:
+        self.lm = None

@@ -533,6 +533,10 @@ class ModelManager:
             from localai_tpu.models.hf_api import HFApiServingModel
 
             return HFApiServingModel(mcfg, self.app)
+        if mcfg.backend in ("mamba", "rwkv"):
+            from localai_tpu.models.mamba_serving import MambaServingModel
+
+            return MambaServingModel(mcfg, self.app)
         try:
             return build_serving_model(mcfg, self.app)
         except Exception:

@@ -1,0 +1,291 @@
+"""RWKV (v4) language models in functional JAX.
+
+Parity: SURVEY item 47 — the reference serves RWKV through llama.cpp's
+rwkv GGUF support; transformers' torch implementation
+(models/rwkv/modeling_rwkv.py) is the numeric reference here, verified
+in tests/test_rwkv.py. Loads HF `RwkvForCausalLM` checkpoints
+(model_type "rwkv": RWKV/rwkv-4-*-pile).
+
+Architecture: linear-attention WKV recurrence (numerically-stabilized
+exponential accumulators) + token-shift mixing — like mamba, O(1)
+recurrent state per stream, no KV cache. Prefill vectorizes everything
+but the WKV recurrence (ONE `lax.scan` per layer); decode is a fused
+single-token step over the state pytree.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+from pathlib import Path
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+log = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass(frozen=True)
+class RwkvConfig:
+    vocab_size: int = 50277
+    hidden_size: int = 768
+    attention_hidden_size: int = 768
+    intermediate_size: int = 3072
+    num_layers: int = 12
+    layer_norm_epsilon: float = 1e-5
+    eos_token_id: int = 0
+
+    @classmethod
+    def from_hf(cls, hf: dict) -> "RwkvConfig":
+        H = hf.get("hidden_size", 768)
+        return cls(
+            vocab_size=hf.get("vocab_size", 50277),
+            hidden_size=H,
+            attention_hidden_size=hf.get("attention_hidden_size") or H,
+            intermediate_size=hf.get("intermediate_size") or 4 * H,
+            num_layers=hf.get("num_hidden_layers", 12),
+            layer_norm_epsilon=hf.get("layer_norm_epsilon", 1e-5),
+            eos_token_id=hf.get("eos_token_id", 0) or 0,
+        )
+
+
+def _ln(x, g, b, eps):
+    mu = jnp.mean(x, -1, keepdims=True)
+    var = jnp.var(x, -1, keepdims=True)
+    return (x - mu) * jax.lax.rsqrt(var + eps) * g + b
+
+
+@dataclasses.dataclass
+class LayerState:
+    """Per-layer recurrent state (the 5 tensors of the torch cache)."""
+
+    ffn_shift: jax.Array   # [B,H] last hidden fed to the FFN mix
+    attn_shift: jax.Array  # [B,H] last hidden fed to the attention mix
+    num: jax.Array         # [B,A] WKV numerator accumulator
+    den: jax.Array         # [B,A] WKV denominator accumulator
+    mx: jax.Array          # [B,A] running max (stability)
+
+
+jax.tree_util.register_dataclass(
+    LayerState,
+    data_fields=("ffn_shift", "attn_shift", "num", "den", "mx"),
+    meta_fields=(),
+)
+
+
+def _init_state(cfg: RwkvConfig, batch: int) -> list[LayerState]:
+    H, A = cfg.hidden_size, cfg.attention_hidden_size
+    z = jnp.zeros((batch, H), jnp.float32)
+    za = jnp.zeros((batch, A), jnp.float32)
+    return [
+        LayerState(z, z, za, za, za - 1e38)
+        for _ in range(cfg.num_layers)
+    ]
+
+
+def _wkv_step(num, den, mx, k, v, time_decay, time_first):
+    """One WKV update (modeling_rwkv.py:184-226, stabilized form)."""
+    max_out = jnp.maximum(mx, k + time_first)
+    e1 = jnp.exp(mx - max_out)
+    e2 = jnp.exp(k + time_first - max_out)
+    out = (e1 * num + e2 * v) / (e1 * den + e2)
+    max_state = jnp.maximum(mx + time_decay, k)
+    e1 = jnp.exp(mx + time_decay - max_state)
+    e2 = jnp.exp(k - max_state)
+    return out, e1 * num + e2 * v, e1 * den + e2, max_state
+
+
+def _attention(p, i, cfg, x, shifted, st: LayerState):
+    """x [B,L,H]; shifted [B,L,H] (token-shifted hiddens). Returns
+    (out, new LayerState pieces)."""
+    pre = f"rwkv.blocks.{i}.attention"
+    mk = p[f"{pre}.time_mix_key"][0]
+    mv = p[f"{pre}.time_mix_value"][0]
+    mr = p[f"{pre}.time_mix_receptance"][0]
+    key = (x * mk + shifted * (1 - mk)) @ p[f"{pre}.key.weight"].T
+    value = (x * mv + shifted * (1 - mv)) @ p[f"{pre}.value.weight"].T
+    recept = jax.nn.sigmoid(
+        (x * mr + shifted * (1 - mr)) @ p[f"{pre}.receptance.weight"].T
+    )
+    time_decay = -jnp.exp(p[f"{pre}.time_decay"].astype(jnp.float32))
+    time_first = p[f"{pre}.time_first"].astype(jnp.float32)
+
+    def scan_fn(carry, t):
+        num, den, mx = carry
+        k_t, v_t = t
+        out, num, den, mx = _wkv_step(
+            num, den, mx, k_t.astype(jnp.float32), v_t,
+            time_decay, time_first,
+        )
+        return (num, den, mx), out
+
+    (num, den, mx), outs = jax.lax.scan(
+        scan_fn, (st.num, st.den, st.mx),
+        (key.transpose(1, 0, 2), value.transpose(1, 0, 2)),
+    )
+    rwkv_out = outs.transpose(1, 0, 2).astype(x.dtype)
+    out = (recept * rwkv_out) @ p[f"{pre}.output.weight"].T
+    return out, num, den, mx
+
+
+def _feed_forward(p, i, cfg, x, shifted):
+    pre = f"rwkv.blocks.{i}.feed_forward"
+    mk = p[f"{pre}.time_mix_key"][0]
+    mr = p[f"{pre}.time_mix_receptance"][0]
+    key = (x * mk + shifted * (1 - mk)) @ p[f"{pre}.key.weight"].T
+    key = jnp.square(jax.nn.relu(key))
+    value = key @ p[f"{pre}.value.weight"].T
+    recept = jax.nn.sigmoid(
+        (x * mr + shifted * (1 - mr)) @ p[f"{pre}.receptance.weight"].T
+    )
+    return recept * value
+
+
+def _shift(x, first_row):
+    """Token shift: row t sees row t-1; the first row sees the carried
+    state (zeros on a fresh sequence)."""
+    return jnp.concatenate([first_row[:, None], x[:, :-1]], axis=1)
+
+
+def forward(p, cfg: RwkvConfig, ids, states: Optional[list] = None):
+    """ids [B,L] → (logits [B,L,V], new states). States None = fresh."""
+    B, L = ids.shape
+    if states is None:
+        states = _init_state(cfg, B)
+    h = jnp.take(p["rwkv.embeddings.weight"], ids, axis=0)
+    eps = cfg.layer_norm_epsilon
+    new_states = []
+    for i in range(cfg.num_layers):
+        blk = f"rwkv.blocks.{i}"
+        if i == 0:
+            h = _ln(h, p[f"{blk}.pre_ln.weight"],
+                    p[f"{blk}.pre_ln.bias"], eps)
+        st = states[i]
+        x1 = _ln(h, p[f"{blk}.ln1.weight"], p[f"{blk}.ln1.bias"], eps)
+        attn, num, den, mx = _attention(
+            p, i, cfg, x1, _shift(x1, st.attn_shift.astype(x1.dtype)), st
+        )
+        h = h + attn
+        x2 = _ln(h, p[f"{blk}.ln2.weight"], p[f"{blk}.ln2.bias"], eps)
+        h = h + _feed_forward(
+            p, i, cfg, x2, _shift(x2, st.ffn_shift.astype(x2.dtype))
+        )
+        new_states.append(LayerState(
+            ffn_shift=x2[:, -1].astype(jnp.float32),
+            attn_shift=x1[:, -1].astype(jnp.float32),
+            num=num, den=den, mx=mx,
+        ))
+    h = _ln(h, p["rwkv.ln_out.weight"], p["rwkv.ln_out.bias"], eps)
+    return h @ p["head.weight"].T, new_states
+
+
+class RwkvLM:
+    """One loaded RWKV checkpoint: prompt → tokens, O(1) state (the same
+    generate surface MambaLM exposes, shared by the recurrent-serving
+    facade)."""
+
+    def __init__(self, cfg: RwkvConfig, params: dict, tokenizer: Any):
+        self.cfg = cfg
+        self.params = params
+        self.tokenizer = tokenizer
+        self._fwd = jax.jit(
+            lambda p, ids, states: forward(p, cfg, ids, states)
+        )
+        self._fresh = jax.jit(lambda p, ids: forward(p, cfg, ids, None))
+
+    def generate(self, prompt: list[int], *, max_new_tokens: int = 128,
+                 temperature: float = 0.0, seed: int = 0,
+                 eos_ids: Optional[set[int]] = None,
+                 on_token=None) -> list[int]:
+        eos = eos_ids if eos_ids is not None else {self.cfg.eos_token_id}
+        ids = jnp.asarray([prompt or [0]], jnp.int32)
+        logits, states = self._fresh(self.params, ids)
+        key = jax.random.key(seed)
+        out: list[int] = []
+        last = logits[:, -1]
+        for _ in range(max_new_tokens):
+            if temperature and temperature > 0:
+                key, k = jax.random.split(key)
+                tok = jax.random.categorical(k, last / temperature, -1)
+            else:
+                tok = jnp.argmax(last, axis=-1)
+            t = int(tok[0])
+            if t in eos:
+                break
+            out.append(t)
+            if on_token is not None:
+                on_token(t)
+            logits, states = self._fwd(
+                self.params, tok[:, None].astype(jnp.int32), states
+            )
+            last = logits[:, -1]
+        return out
+
+
+def resolve_rwkv(ref: str, model_path: str | Path = "models",
+                 dtype: str = "float32", seed: int = 0) -> RwkvLM:
+    """HF RwkvForCausalLM checkpoint dir or ``debug:rwkv-tiny``."""
+    if ref == "debug:rwkv-tiny":
+        from localai_tpu.utils.tokenizer import ByteTokenizer
+
+        cfg = RwkvConfig(
+            vocab_size=512, hidden_size=64, attention_hidden_size=64,
+            intermediate_size=128, num_layers=2, eos_token_id=257,
+        )
+        return RwkvLM(cfg, init_params(jax.random.key(seed), cfg),
+                      ByteTokenizer())
+    for cand in (Path(ref), Path(model_path) / ref):
+        if (cand / "config.json").exists():
+            hf = json.loads((cand / "config.json").read_text())
+            cfg = RwkvConfig.from_hf(hf)
+            from localai_tpu.models.loader import _get, _open_safetensors
+            from localai_tpu.utils.tokenizer import load_tokenizer
+
+            raw = _open_safetensors(cand)
+            params = {
+                name: jnp.asarray(np.asarray(_get(raw, name), np.float32))
+                for name in raw
+            }
+            return RwkvLM(cfg, params, load_tokenizer(cand))
+    raise FileNotFoundError(f"rwkv ref {ref!r} not found")
+
+
+def init_params(key, cfg: RwkvConfig) -> dict:
+    ks = iter(jax.random.split(key, 4 + 10 * cfg.num_layers))
+    H, A, I = (cfg.hidden_size, cfg.attention_hidden_size,
+               cfg.intermediate_size)
+
+    def w(shape, scale=0.05):
+        return jax.random.normal(next(ks), shape) * scale
+
+    p = {
+        "rwkv.embeddings.weight": w((cfg.vocab_size, H)),
+        "rwkv.ln_out.weight": jnp.ones((H,)),
+        "rwkv.ln_out.bias": jnp.zeros((H,)),
+        "head.weight": w((cfg.vocab_size, H)),
+    }
+    for i in range(cfg.num_layers):
+        blk = f"rwkv.blocks.{i}"
+        if i == 0:
+            p[f"{blk}.pre_ln.weight"] = jnp.ones((H,))
+            p[f"{blk}.pre_ln.bias"] = jnp.zeros((H,))
+        for ln in ("ln1", "ln2"):
+            p[f"{blk}.{ln}.weight"] = jnp.ones((H,))
+            p[f"{blk}.{ln}.bias"] = jnp.zeros((H,))
+        at = f"{blk}.attention"
+        p[f"{at}.time_decay"] = jnp.zeros((A,))
+        p[f"{at}.time_first"] = jnp.zeros((A,))
+        for m in ("key", "value", "receptance"):
+            p[f"{at}.time_mix_{m}"] = jnp.full((1, 1, H), 0.5)
+            p[f"{at}.{m}.weight"] = w((A, H))
+        p[f"{at}.output.weight"] = w((H, A))
+        ff = f"{blk}.feed_forward"
+        p[f"{ff}.time_mix_key"] = jnp.full((1, 1, H), 0.5)
+        p[f"{ff}.time_mix_receptance"] = jnp.full((1, 1, H), 0.5)
+        p[f"{ff}.key.weight"] = w((I, H))
+        p[f"{ff}.receptance.weight"] = w((H, H))
+        p[f"{ff}.value.weight"] = w((H, I))
+    return p

@@ -127,17 +127,26 @@ class CommandLeader:
             except OSError:
                 return
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            try:
-                self._handshake(conn)
-            except Exception as e:  # noqa: BLE001 — reject, keep serving
-                log.warning("multihost: rejected connection from %s (%s)",
-                            addr, e)
-                conn.close()
-                continue
-            with self._lock:
-                self._conns.append(conn)
-            log.info("multihost: follower %s joined (%d connected)",
-                     addr, len(self._conns))
+            # handshake on its own thread: a silent connection (port
+            # scanner, TCP health check) must not stall other joins for
+            # its 10s timeout
+            threading.Thread(
+                target=self._admit, args=(conn, addr), daemon=True,
+                name="mh-handshake",
+            ).start()
+
+    def _admit(self, conn: socket.socket, addr) -> None:
+        try:
+            self._handshake(conn)
+        except Exception as e:  # noqa: BLE001 — reject, keep serving
+            log.warning("multihost: rejected connection from %s (%s)",
+                        addr, e)
+            conn.close()
+            return
+        with self._lock:
+            self._conns.append(conn)
+        log.info("multihost: follower %s joined (%d connected)",
+                 addr, len(self._conns))
 
     def _handshake(self, conn: socket.socket) -> None:
         import hmac

@@ -117,3 +117,42 @@ def test_chat_through_remote_backend(tmp_path, mock_hf):
         assert mock_hf.requests[0]["_auth"] == "Bearer tok-xyz"
     finally:
         srv.stop()
+
+
+def test_remote_failure_surfaces_502(tmp_path):
+    """A backend that fails before emitting anything must NOT produce a
+    successful empty completion."""
+    import json as _json
+    import threading
+    from http.server import BaseHTTPRequestHandler, HTTPServer
+
+    from test_api import _ServerThread, make_state
+
+    class Deny(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_POST(self):
+            out = _json.dumps({"error": "model is loading"}).encode()
+            self.send_response(503)
+            self.send_header("Content-Length", str(len(out)))
+            self.end_headers()
+            self.wfile.write(out)
+
+    httpd = HTTPServer(("127.0.0.1", 0), Deny)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    (tmp_path / "bad.yaml").write_text(
+        "name: bad\nmodel: org/m\nbackend: huggingface\n"
+        f"api_token: t\napi_base: http://127.0.0.1:{httpd.server_address[1]}\n"
+    )
+    srv = _ServerThread(make_state(tmp_path))
+    try:
+        with httpx.Client(base_url=srv.base, timeout=60.0) as c:
+            r = c.post("/v1/chat/completions", json={
+                "model": "bad",
+                "messages": [{"role": "user", "content": "x"}],
+            })
+            assert r.status_code == 502, r.text
+    finally:
+        srv.stop()
+        httpd.shutdown()

@@ -1,0 +1,161 @@
+"""Mamba SSM models: numerical parity against transformers' torch slow
+path on tiny random checkpoints, O(1)-state decode equivalence, and
+serving through the normal endpoints (parity:
+/root/reference/backend/python/mamba/backend.py)."""
+
+import json
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+from transformers import MambaConfig as HFMambaConfig  # noqa: E402
+from transformers import MambaForCausalLM  # noqa: E402
+
+from localai_tpu.models.mamba import (  # noqa: E402
+    MambaConfig,
+    MambaLM,
+    forward_prefill,
+    forward_step,
+    resolve_mamba,
+)
+
+TINY = dict(
+    vocab_size=64,
+    hidden_size=32,
+    intermediate_size=64,
+    state_size=8,
+    conv_kernel=4,
+    num_hidden_layers=2,
+    time_step_rank=4,
+    use_cache=True,
+)
+
+
+def _torch_model(seed=0):
+    torch.manual_seed(seed)
+    hf_cfg = HFMambaConfig(**TINY)
+    model = MambaForCausalLM(hf_cfg).eval()
+    return hf_cfg, model
+
+
+def _params_from(model):
+    import jax.numpy as jnp
+
+    return {k: jnp.asarray(v.detach().numpy())
+            for k, v in model.state_dict().items()}
+
+
+def test_prefill_logits_match_torch():
+    hf_cfg, model = _torch_model()
+    cfg = MambaConfig.from_hf(hf_cfg.to_dict())
+    params = _params_from(model)
+    ids = torch.tensor([[3, 14, 15, 9, 26, 5]])
+    with torch.no_grad():
+        want = model(ids).logits.numpy()
+    got = np.asarray(forward_prefill(params, cfg, ids.numpy())[0])
+    np.testing.assert_allclose(got, want, atol=2e-4)
+
+
+def test_step_matches_prefill():
+    """Decode with rolling conv + SSM states is bit-equivalent to
+    re-running the full prefix — the O(1)-state contract."""
+    hf_cfg, model = _torch_model(seed=2)
+    cfg = MambaConfig.from_hf(hf_cfg.to_dict())
+    params = _params_from(model)
+    prefix = np.asarray([[7, 21, 3, 44]])
+    logits, states = forward_prefill(params, cfg, prefix)
+    nxt = np.asarray([11], np.int32)
+    step_logits, states = forward_step(params, cfg, nxt, states)
+    full = forward_prefill(
+        params, cfg, np.concatenate([prefix, nxt[None]], 1))[0]
+    np.testing.assert_allclose(
+        np.asarray(step_logits)[0], np.asarray(full)[0, -1], atol=2e-4)
+
+
+def test_generate_greedy_matches_torch():
+    hf_cfg, model = _torch_model(seed=3)
+    cfg = MambaConfig.from_hf(hf_cfg.to_dict())
+    lm = MambaLM(cfg, _params_from(model), tokenizer=None)
+    prompt = [5, 9, 13]
+    with torch.no_grad():
+        want = model.generate(
+            torch.tensor([prompt]), max_new_tokens=8, do_sample=False,
+        ).numpy()[0][len(prompt):]
+    got = lm.generate(prompt, max_new_tokens=8, temperature=0.0,
+                      eos_ids=set())
+    assert got == [int(t) for t in want]
+
+
+def test_debug_preset_generates():
+    lm = resolve_mamba("debug:mamba-tiny")
+    toks = lm.generate(list(b"hello"), max_new_tokens=6, temperature=0.0)
+    assert len(toks) <= 6
+    # deterministic
+    assert toks == lm.generate(list(b"hello"), max_new_tokens=6,
+                               temperature=0.0)
+
+
+def test_serving_via_http(tmp_path):
+    """`backend: mamba` (autodetected from debug ref name) serves chat."""
+    import httpx
+    from test_api import _ServerThread, make_state
+
+    (tmp_path / "m.yaml").write_text(
+        "name: m\nmodel: 'debug:mamba-tiny'\n"
+        "parameters: {temperature: 0.0, max_tokens: 8}\n"
+    )
+    srv = _ServerThread(make_state(tmp_path))
+    try:
+        assert srv.state.loader.get("m").backend == "mamba"
+        with httpx.Client(base_url=srv.base, timeout=120.0) as c:
+            r = c.post("/v1/chat/completions", json={
+                "model": "m",
+                "messages": [{"role": "user", "content": "hi"}],
+                "max_tokens": 6,
+            })
+            assert r.status_code == 200, r.text
+            body = r.json()
+            assert body["choices"][0]["finish_reason"] in ("stop",
+                                                           "length")
+            # streaming path
+            with c.stream("POST", "/v1/chat/completions", json={
+                "model": "m",
+                "messages": [{"role": "user", "content": "stream"}],
+                "max_tokens": 6, "stream": True,
+            }) as s:
+                frames = [ln for ln in s.iter_lines()
+                          if ln.startswith("data: ")]
+            assert frames[-1] == "data: [DONE]"
+    finally:
+        srv.stop()
+
+
+def test_hf_checkpoint_dir_loads(tmp_path):
+    from safetensors.numpy import save_file
+
+    hf_cfg, model = _torch_model(seed=4)
+    d = tmp_path / "mamba-ckpt"
+    d.mkdir()
+    save_file({k: v.detach().numpy().copy()
+               for k, v in model.state_dict().items()},
+              d / "model.safetensors")
+    (d / "config.json").write_text(json.dumps(
+        {"model_type": "mamba", **{k: v for k, v in
+                                   hf_cfg.to_dict().items()
+                                   if isinstance(v, (int, float, str,
+                                                     bool, list))}}))
+    # byte-ish vocab tokenizer stand-in
+    (d / "tokenizer.json").write_text(json.dumps({
+        "version": "1.0", "truncation": None, "padding": None,
+        "added_tokens": [], "normalizer": None,
+        "pre_tokenizer": {"type": "Whitespace"},
+        "post_processor": None, "decoder": None,
+        "model": {"type": "WordLevel",
+                  "vocab": {"a": 1, "b": 2, "[UNK]": 0},
+                  "unk_token": "[UNK]"},
+    }))
+    lm = resolve_mamba(str(d))
+    toks = lm.generate([1, 2], max_new_tokens=4, temperature=0.0,
+                       eos_ids=set())
+    assert len(toks) == 4
