@@ -132,11 +132,19 @@ async def generations(request: web.Request) -> web.Response:
             for j in range(n):
                 # distinct images per copy: offset the seed like a new draw
                 s = None if seed is None else int(seed) + j
+                # with a ControlNet attached, the request's image guides
+                # (control) instead of seeding img2img (backend.py parity:
+                # the controlnet pipelines take the image as control input)
+                has_cn = getattr(sm.pipeline, "controlnet_params",
+                                 None) is not None
                 result = await oai._in_executor(
                     request,
                     lambda: sm.generate(
                         pos, negative_prompt=neg, width=width, height=height,
-                        steps=steps or None, seed=s, init_image=init,
+                        steps=steps or None, seed=s,
+                        init_image=None if has_cn else init,
+                        control_image=init if has_cn else None,
+                        control_scale=mcfg.diffusers.control_scale,
                     ),
                 )
                 img = result.image

@@ -185,6 +185,9 @@ class DiffusionConfig(BaseModel):
     pipeline_type: Optional[str] = None
     enable_parameters: Optional[str] = None
     steps: Optional[int] = None
+    # ControlNet model ref loaded next to the pipeline (backend.py:192-208)
+    control_net: Optional[str] = None
+    control_scale: float = 1.0
 
 
 class TTSConfig(BaseModel):

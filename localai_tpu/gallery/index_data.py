@@ -247,6 +247,35 @@ _ENTRIES: list[GalleryModel] = [
         },
     ),
     GalleryModel(
+        name="sdxl-base-1.0",
+        description="Stable Diffusion XL base 1.0 (dual text encoders, "
+                    "1024px) — diffusers layout",
+        license="openrail++",
+        tags=["image-generation"],
+        files=[f for sub, names in {
+            "unet": ["config.json",
+                     "diffusion_pytorch_model.safetensors"],
+            "vae": ["config.json", "diffusion_pytorch_model.safetensors"],
+            "text_encoder": ["config.json", "model.safetensors"],
+            "text_encoder_2": ["config.json", "model.safetensors"],
+            "tokenizer": ["merges.txt", "vocab.json",
+                          "tokenizer_config.json"],
+            "tokenizer_2": ["merges.txt", "vocab.json",
+                            "tokenizer_config.json"],
+        }.items() for f in _hf_files(
+            "stabilityai/stable-diffusion-xl-base-1.0",
+            [f"{sub}/{n}" for n in names])] + _hf_files(
+            "stabilityai/stable-diffusion-xl-base-1.0",
+            ["model_index.json"]),
+        config_file={
+            "name": "sdxl-base-1.0",
+            "model": "stable-diffusion-xl-base-1.0",
+            "backend": "diffusers",
+            "known_usecases": ["image"],
+            "diffusers": {"scheduler_type": "euler", "steps": 25},
+        },
+    ),
+    GalleryModel(
         name="dreamshaper-8",
         description="DreamShaper 8 (SD1.5 fine-tune) — the reference AIO "
                     "image model family",

@@ -71,26 +71,60 @@ def _mha(x, p, num_heads: int, mask):
     return out @ p["wo"].astype(x.dtype) + p["bo"].astype(x.dtype)
 
 
-def forward(cfg: CLIPTextConfig, params: PyTree, tokens,
-            clip_skip: int = 0) -> jax.Array:
-    """tokens [B, T] i32 → hidden states [B, T, C] (the context fed to the
-    UNet cross-attention). clip_skip=N>0 returns the states N layers early
-    (diffusers convention: skip=1 is the default final-layer output)."""
+def _run_layers(cfg: CLIPTextConfig, params: PyTree, tokens,
+                stop_after: int) -> tuple[jax.Array, jax.Array]:
+    """THE encoder loop (shared by forward and encode_sdxl so mask /
+    activation / residual semantics cannot drift between the SD and SDXL
+    paths). Returns (hidden after ``stop_after`` layers, hidden after the
+    second-to-last executed layer)."""
     dtype = jnp.dtype(cfg.dtype)
     B, T = tokens.shape
     x = params["token_emb"][tokens].astype(dtype)
     x = x + params["pos_emb"][:T].astype(dtype)
     causal = jnp.triu(jnp.full((T, T), -1e9, jnp.float32), 1)[None, None]
-    stop = max(0, clip_skip - 1)
-    layers = params["layers"]
-    for li, lp in enumerate(layers):
-        if li >= len(layers) - stop:
+    penultimate = x
+    for li, lp in enumerate(params["layers"]):
+        if li >= stop_after:
             break
-        x = x + _mha(layer_norm(x, lp["ln1"]), lp["attn"], cfg.num_heads, causal)
+        x = x + _mha(layer_norm(x, lp["ln1"]), lp["attn"], cfg.num_heads,
+                     causal)
         h = layer_norm(x, lp["ln2"])
-        h = _act(cfg, h @ lp["mlp"]["w1"].astype(h.dtype) + lp["mlp"]["b1"].astype(h.dtype))
-        x = x + (h @ lp["mlp"]["w2"].astype(h.dtype) + lp["mlp"]["b2"].astype(h.dtype))
+        h = _act(cfg, h @ lp["mlp"]["w1"].astype(h.dtype)
+                 + lp["mlp"]["b1"].astype(h.dtype))
+        x = x + (h @ lp["mlp"]["w2"].astype(h.dtype)
+                 + lp["mlp"]["b2"].astype(h.dtype))
+        if li == stop_after - 2:
+            penultimate = x
+    return x, penultimate
+
+
+def forward(cfg: CLIPTextConfig, params: PyTree, tokens,
+            clip_skip: int = 0) -> jax.Array:
+    """tokens [B, T] i32 → hidden states [B, T, C] (the context fed to the
+    UNet cross-attention). clip_skip=N>0 returns the states N layers early
+    (diffusers convention: skip=1 is the default final-layer output)."""
+    stop = len(params["layers"]) - max(0, clip_skip - 1)
+    x, _ = _run_layers(cfg, params, tokens, stop)
     return layer_norm(x, params["ln_f"])
+
+
+def encode_sdxl(cfg: CLIPTextConfig, params: PyTree, tokens
+                ) -> tuple[jax.Array, jax.Array]:
+    """SDXL text conditioning: (penultimate hidden states [B,T,C] — the
+    hidden_states[-2] diffusers feeds the UNet, WITHOUT the final
+    layer norm — and the pooled embedding [B, proj|C] from the final
+    layer at the EOT position, through text_projection when present)."""
+    x, penultimate = _run_layers(cfg, params, tokens,
+                                 len(params["layers"]))
+    final = layer_norm(x, params["ln_f"])
+    # EOT position: CLIP pools at the highest token id (the end token)
+    eot = jnp.argmax(tokens, axis=-1)
+    pooled = jnp.take_along_axis(
+        final, eot[:, None, None].repeat(final.shape[-1], -1), axis=1
+    )[:, 0]
+    if "text_projection" in params:
+        pooled = pooled @ params["text_projection"].astype(pooled.dtype)
+    return penultimate, pooled
 
 
 def param_shapes(cfg: CLIPTextConfig) -> PyTree:

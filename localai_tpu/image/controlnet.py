@@ -1,0 +1,173 @@
+"""ControlNet: condition-image guidance for the diffusion pipeline.
+
+Parity: /root/reference/backend/python/diffusers/backend.py:192-208 —
+`control_net` model option loading a ControlNetModel next to the SD
+pipeline. Architecture (diffusers ControlNetModel): a copy of the UNet's
+encoder (conv_in → down blocks → mid) plus a small conv stack embedding
+the condition image, emitting one zero-conv residual per UNet skip and
+one for the mid block; the base UNet adds them during its up pass. The
+JAX forward below reuses the unet module's blocks (same param mapping,
+NHWC) so the checkpoint loader is the unet loader plus the controlnet-
+specific heads."""
+
+from __future__ import annotations
+
+import json
+import logging
+from pathlib import Path
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from localai_tpu.image import unet as unet_mod
+from localai_tpu.image.unet import UNetConfig, conv2d
+
+log = logging.getLogger(__name__)
+
+PyTree = Any
+
+
+def cond_embedding(p: PyTree, image) -> jax.Array:
+    """Condition image [B,H,W,3] in [0,1] → [B,h,w,C] features (the
+    controlnet_cond_embedding conv stack: conv_in, silu conv blocks with
+    stride-2 downsamples, zero conv_out)."""
+    h = jax.nn.silu(conv2d(image, p["conv_in"]))
+    for blk in p["blocks"]:
+        h = jax.nn.silu(conv2d(h, blk["a"]))
+        h = jax.nn.silu(conv2d(h, blk["b"], stride=2))
+    return conv2d(h, p["conv_out"])
+
+
+def forward(cfg: UNetConfig, params: PyTree, latents, timesteps, context,
+            cond_image, conditioning_scale=1.0,
+            pooled_text=None, time_ids=None):
+    """ControlNet pass → (down_residuals list, mid_residual), each scaled
+    by conditioning_scale, shaped to add onto the base UNet's skips."""
+    dtype = jnp.dtype(cfg.dtype)
+    x = latents.astype(dtype)
+    context = context.astype(dtype)
+
+    temb = unet_mod.timestep_embedding(timesteps, cfg.model_channels)
+    te = params["time_emb"]
+    temb = temb @ te["w1"] + te["b1"]
+    temb = jax.nn.silu(temb) @ te["w2"] + te["b2"]
+    if cfg.addition_embed and pooled_text is not None:
+        B = pooled_text.shape[0]
+        tid = unet_mod.timestep_embedding(
+            time_ids.reshape(-1), cfg.addition_time_embed_dim
+        ).reshape(B, -1)
+        aug = jnp.concatenate(
+            [pooled_text.astype(jnp.float32), tid], axis=-1)
+        ae = params["add_emb"]
+        aug = aug @ ae["w1"] + ae["b1"]
+        aug = jax.nn.silu(aug) @ ae["w2"] + ae["b2"]
+        temb = temb + aug
+
+    h = conv2d(x, params["conv_in"])
+    h = h + cond_embedding(params["cond_emb"], cond_image.astype(dtype))
+
+    feats = [h]
+    for lvl, lp in enumerate(params["down"]):
+        for i, rp in enumerate(lp["res"]):
+            h = unet_mod.res_block(h, temb, rp)
+            if lp.get("attn"):
+                h = unet_mod.spatial_transformer(
+                    h, context, lp["attn"][i], cfg, cfg.heads_at(lvl))
+            feats.append(h)
+        if lp.get("down"):
+            h = unet_mod.downsample(h, lp["down"])
+            feats.append(h)
+
+    mid = params["mid"]
+    n_lvls = len(params["down"])
+    h = unet_mod.res_block(h, temb, mid["res1"])
+    h = unet_mod.spatial_transformer(h, context, mid["attn"], cfg,
+                                     cfg.heads_at(n_lvls - 1))
+    h = unet_mod.res_block(h, temb, mid["res2"])
+
+    scale = jnp.asarray(conditioning_scale, jnp.float32).astype(dtype)
+    down_res = [
+        conv2d(f, zp) * scale
+        for f, zp in zip(feats, params["zero_convs"])
+    ]
+    mid_res = conv2d(h, params["mid_zero"]) * scale
+    return down_res, mid_res
+
+
+def load_controlnet(d: str | Path):
+    """diffusers ControlNetModel dir → (UNetConfig, params)."""
+    from localai_tpu.image.loader import (
+        _conv,
+        _lin,
+        _open_dir,
+        _res_params,
+        _st_params,
+    )
+
+    d = Path(d)
+    with open(d / "config.json") as f:
+        cfg = UNetConfig.from_hf(json.load(f))
+    t = _open_dir(d)
+    w1, b1 = _lin(t, "time_embedding.linear_1")
+    w2, b2 = _lin(t, "time_embedding.linear_2")
+    params: dict[str, Any] = {
+        "conv_in": _conv(t, "conv_in"),
+        "time_emb": {"w1": w1, "b1": b1, "w2": w2, "b2": b2},
+    }
+    if "add_embedding.linear_1.weight" in t:
+        aw1, ab1 = _lin(t, "add_embedding.linear_1")
+        aw2, ab2 = _lin(t, "add_embedding.linear_2")
+        params["add_emb"] = {"w1": aw1, "b1": ab1, "w2": aw2, "b2": ab2}
+
+    # condition embedding conv stack
+    ce = "controlnet_cond_embedding"
+    blocks = []
+    i = 0
+    while f"{ce}.blocks.{i}.weight" in t:
+        blocks.append({
+            "a": _conv(t, f"{ce}.blocks.{i}"),
+            "b": _conv(t, f"{ce}.blocks.{i + 1}"),
+        })
+        i += 2
+    params["cond_emb"] = {
+        "conv_in": _conv(t, f"{ce}.conv_in"),
+        "blocks": blocks,
+        "conv_out": _conv(t, f"{ce}.conv_out"),
+    }
+
+    down = []
+    for lvl in range(len(cfg.channel_mult)):
+        base = f"down_blocks.{lvl}"
+        has_attn = f"{base}.attentions.0.norm.weight" in t
+        lp: dict[str, Any] = {
+            "res": [_res_params(t, f"{base}.resnets.{j}")
+                    for j in range(cfg.num_res_blocks)],
+            "attn": [_st_params(t, f"{base}.attentions.{j}")
+                     for j in range(cfg.num_res_blocks)]
+            if has_attn else None,
+        }
+        if f"{base}.downsamplers.0.conv.weight" in t:
+            lp["down"] = _conv(t, f"{base}.downsamplers.0.conv")
+        down.append(lp)
+    params["down"] = down
+    params["mid"] = {
+        "res1": _res_params(t, "mid_block.resnets.0"),
+        "attn": _st_params(t, "mid_block.attentions.0"),
+        "res2": _res_params(t, "mid_block.resnets.1"),
+    }
+    zero = []
+    j = 0
+    while f"controlnet_down_blocks.{j}.weight" in t:
+        zero.append(_conv(t, f"controlnet_down_blocks.{j}"))
+        j += 1
+    params["zero_convs"] = zero
+    params["mid_zero"] = _conv(t, "controlnet_mid_block")
+    return cfg, params
+
+
+def resolve_controlnet(ref: str, model_path: str | Path = "models"):
+    for cand in (Path(ref), Path(model_path) / ref):
+        if (cand / "config.json").exists():
+            return load_controlnet(cand)
+    raise FileNotFoundError(f"controlnet ref {ref!r} not found")

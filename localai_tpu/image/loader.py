@@ -139,6 +139,11 @@ def load_unet(d: Path):
         "norm_out": _norm(t, "conv_norm_out"),
         "conv_out": _conv(t, "conv_out"),
     }
+    if "add_embedding.linear_1.weight" in t:
+        # SDXL text_time micro-conditioning MLP
+        aw1, ab1 = _lin(t, "add_embedding.linear_1")
+        aw2, ab2 = _lin(t, "add_embedding.linear_2")
+        params["add_emb"] = {"w1": aw1, "b1": ab1, "w2": aw2, "b2": ab2}
     down = []
     for lvl in range(len(cfg.channel_mult)):
         base = f"down_blocks.{lvl}"
@@ -290,6 +295,9 @@ def load_text_encoder(d: Path):
         "layers": layers,
         "ln_f": _norm(t, f"{pre}final_layer_norm"),
     }
+    if "text_projection.weight" in t:
+        # SDXL text_encoder_2 pools through a projection (no bias)
+        params["text_projection"] = _np(t, "text_projection.weight").T
     return cfg, params
 
 
@@ -302,6 +310,16 @@ def load_diffusers_pipeline(d: Path, *, lora_adapter: str = "",
     unet_cfg, unet_params = load_unet(d / "unet")
     vae_cfg, vae_params = load_vae(d / "vae")
     text_cfg, text_params = load_text_encoder(d / "text_encoder")
+    extra = {}
+    if (d / "text_encoder_2").is_dir():
+        # SDXL layout: second (OpenCLIP-class) encoder + tokenizer_2
+        text2_cfg, text2_params = load_text_encoder(d / "text_encoder_2")
+        extra = {
+            "text2_cfg": text2_cfg,
+            "text2_params": _to_device(text2_params, text2_cfg.dtype),
+            "tokenizer2": _load_clip_tokenizer(d / "tokenizer_2",
+                                               text2_cfg),
+        }
     if lora_adapter:
         # merged host-side before device placement: the fused weights keep
         # the jitted UNet unchanged (see image/lora.py)
@@ -316,7 +334,7 @@ def load_diffusers_pipeline(d: Path, *, lora_adapter: str = "",
         unet_cfg, _to_device(unet_params, unet_cfg.dtype),
         vae_cfg, _to_device(vae_params, vae_cfg.dtype),
         text_cfg, _to_device(text_params, text_cfg.dtype),
-        tokenizer, ref=str(d), **defaults,
+        tokenizer, ref=str(d), **extra, **defaults,
     )
 
 

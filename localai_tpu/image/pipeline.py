@@ -44,6 +44,7 @@ class DiffusionPipeline:
 
     def __init__(self, unet_cfg, unet_params, vae_cfg, vae_params,
                  text_cfg, text_params, tokenizer, *,
+                 text2_cfg=None, text2_params=None, tokenizer2=None,
                  default_scheduler: str = "euler",
                  default_steps: int = 15, default_cfg_scale: float = 7.0,
                  clip_skip: int = 0, ref: str = ""):
@@ -54,15 +55,31 @@ class DiffusionPipeline:
         self.text_cfg = text_cfg
         self.text_params = text_params
         self.tokenizer = tokenizer
+        # SDXL: second text encoder (OpenCLIP-class) + its tokenizer; the
+        # two hidden states concatenate into the UNet context and encoder
+        # 2's projected pooled output feeds the text_time conditioning
+        self.text2_cfg = text2_cfg
+        self.text2_params = text2_params
+        self.tokenizer2 = tokenizer2 or tokenizer
         self.default_scheduler = default_scheduler
         self.default_steps = default_steps
         self.default_cfg_scale = default_cfg_scale
         self.clip_skip = clip_skip
         self.ref = ref
+        # ControlNet (optional): set via attach_controlnet()
+        self.controlnet_cfg = None
+        self.controlnet_params = None
         self._encode_text = jax.jit(self._encode_text_fn)
+        if self.is_sdxl:
+            self._encode_text_xl = jax.jit(self._encode_text_xl_fn)
         self._unet_step = jax.jit(self._unet_step_fn)
         self._decode = jax.jit(self._decode_fn)
         self._encode_img = jax.jit(self._encode_img_fn)
+
+    @property
+    def is_sdxl(self) -> bool:
+        return self.text2_params is not None and getattr(
+            self.unet_cfg, "addition_embed", False)
 
     # -- jitted programs -------------------------------------------------
 
@@ -71,15 +88,54 @@ class DiffusionPipeline:
             self.text_cfg, self.text_params, tokens, clip_skip=self.clip_skip
         )
 
-    def _unet_step_fn(self, x, sigma, t, context, cfg_scale):
-        """Batched CFG: one UNet dispatch over [uncond; cond]."""
+    def _encode_text_xl_fn(self, tokens1, tokens2):
+        """SDXL conditioning: concat of both encoders' penultimate hidden
+        states + encoder 2's projected pooled output."""
+        h1, _ = clip_mod.encode_sdxl(
+            self.text_cfg, self.text_params, tokens1)
+        h2, pooled = clip_mod.encode_sdxl(
+            self.text2_cfg, self.text2_params, tokens2)
+        return jnp.concatenate(
+            [h1.astype(jnp.float32), h2.astype(jnp.float32)], axis=-1
+        ), pooled.astype(jnp.float32)
+
+    def _unet_step_fn(self, x, sigma, t, cond, cfg_scale):
+        """Batched CFG: one UNet dispatch over [uncond; cond]; with a
+        ControlNet attached, its residual pass rides the same batch."""
         xin = sch.scale_model_input(x, sigma)
         both = jnp.concatenate([xin, xin], axis=0)
         ts = jnp.full((both.shape[0],), t, jnp.float32)
-        eps = unet_mod.forward(self.unet_cfg, self.unet_params, both, ts, context)
+        down_res = mid_res = None
+        if "control_image" in cond and self.controlnet_params is not None:
+            from localai_tpu.image import controlnet as cn
+
+            down_res, mid_res = cn.forward(
+                self.controlnet_cfg, self.controlnet_params, both, ts,
+                cond["context"], cond["control_image"],
+                conditioning_scale=cond["control_scale"],
+                pooled_text=cond.get("pooled"),
+                time_ids=cond.get("time_ids"),
+            )
+        eps = unet_mod.forward(
+            self.unet_cfg, self.unet_params, both, ts, cond["context"],
+            pooled_text=cond.get("pooled"), time_ids=cond.get("time_ids"),
+            down_residuals=down_res, mid_residual=mid_res,
+        )
         eps_u, eps_c = jnp.split(eps, 2, axis=0)
         eps = eps_u + cfg_scale * (eps_c - eps_u)
         return sch.denoised_from_eps(x, eps, sigma)
+
+    def attach_controlnet(self, ref: str, model_path: str = "models"):
+        """Load a ControlNetModel next to this pipeline (parity:
+        backend.py:192-208)."""
+        from localai_tpu.image import controlnet as cn
+        from localai_tpu.image.loader import _to_device
+
+        self.controlnet_cfg, params = cn.resolve_controlnet(
+            ref, model_path)
+        self.controlnet_params = _to_device(params,
+                                            self.controlnet_cfg.dtype)
+        return self
 
     def _decode_fn(self, latents):
         img = vae_mod.decode(
@@ -101,11 +157,42 @@ class DiffusionPipeline:
         row[0, : len(ids)] = ids
         return row
 
-    def _context(self, prompt: str, negative: str) -> jax.Array:
+    def _tokenize2(self, text: str) -> np.ndarray:
+        """SDXL's second (OpenCLIP) tokenizer pads with id 0 ("!"), NOT
+        the eos token — pad-position hidden states feed cross-attention,
+        so the padding id is part of the trained conditioning. The eos
+        token stays the highest id, which is what the pooled-embedding
+        argmax keys on."""
+        T = self.text2_cfg.max_length
+        eos = self.text2_cfg.eos_token_id
+        ids = list(self.tokenizer2.encode(text))[: T - 1]
+        if not ids or ids[-1] != eos:
+            ids = ids[: T - 1] + [eos]
+        row = np.zeros((1, T), np.int32)
+        row[0, : len(ids)] = ids
+        return row
+
+    def _prepare_cond(self, prompt: str, negative: str,
+                      width: int, height: int) -> dict:
+        """The conditioning pytree fed to every UNet step: [uncond; cond]
+        context, plus SDXL's pooled text + size/crop time_ids."""
         toks = np.concatenate(
             [self._tokenize(negative or ""), self._tokenize(prompt)], axis=0
         )
-        return self._encode_text(jnp.asarray(toks))
+        if not self.is_sdxl:
+            return {"context": self._encode_text(jnp.asarray(toks))}
+        toks2 = np.concatenate(
+            [self._tokenize2(negative or ""), self._tokenize2(prompt)],
+            axis=0,
+        )
+        context, pooled = self._encode_text_xl(
+            jnp.asarray(toks), jnp.asarray(toks2)
+        )
+        # micro-conditioning: (orig_h, orig_w, crop_t, crop_l, tgt_h, tgt_w)
+        tid = jnp.asarray(
+            [[height, width, 0, 0, height, width]] * 2, jnp.float32
+        )
+        return {"context": context, "pooled": pooled, "time_ids": tid}
 
     @staticmethod
     def _bucket(v: int, lo: int = 64, quantum: int = 64, hi: int = 2048) -> int:
@@ -125,6 +212,8 @@ class DiffusionPipeline:
         scheduler: Optional[str] = None,
         init_image: Optional[np.ndarray] = None,   # [H,W,3] uint8 (img2img)
         strength: float = 0.75,
+        control_image: Optional[np.ndarray] = None,  # [H,W,3] uint8
+        control_scale: float = 1.0,
     ) -> GenerationResult:
         rule, karras = sch.resolve(scheduler or self.default_scheduler)
         steps = int(steps or self.default_steps)
@@ -139,7 +228,12 @@ class DiffusionPipeline:
         lw, lh = width // ds, height // ds
         L = self.vae_cfg.latent_channels
 
-        context = self._context(prompt, negative_prompt)
+        cond = self._prepare_cond(prompt, negative_prompt, width, height)
+        if control_image is not None and self.controlnet_params is not None:
+            ci = jnp.asarray(control_image, jnp.float32)[None] / 255.0
+            ci = jax.image.resize(ci, (1, height, width, 3), "linear")
+            cond["control_image"] = jnp.concatenate([ci, ci], axis=0)
+            cond["control_scale"] = jnp.float32(control_scale)
         sigmas, timesteps = sch.build_sigmas(steps, karras=karras)
 
         rng, nkey = jax.random.split(rng)
@@ -160,7 +254,7 @@ class DiffusionPipeline:
         for i in range(start, steps):
             sigma, sigma_next = float(sigmas[i]), float(sigmas[i + 1])
             denoised = self._unet_step(
-                x, jnp.float32(sigma), jnp.float32(timesteps[i]), context,
+                x, jnp.float32(sigma), jnp.float32(timesteps[i]), cond,
                 jnp.float32(guidance),
             )
             noise_i = None

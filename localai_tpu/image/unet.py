@@ -33,8 +33,22 @@ class UNetConfig:
     attn_levels: tuple[int, ...] = (0, 1, 2)   # levels with spatial transformers
     transformer_depth: int = 1
     num_heads: int = 8
+    heads_per_level: tuple[int, ...] = ()      # SDXL: per-level head counts
+                                               # (empty → num_heads everywhere)
     context_dim: int = 768                     # CLIP hidden size
+    # SDXL micro-conditioning (addition_embed_type="text_time"): pooled
+    # text + size/crop time_ids through an extra MLP added to the
+    # timestep embedding
+    addition_embed: bool = False
+    addition_time_embed_dim: int = 256
+    addition_pooled_dim: int = 1280
     dtype: str = "bfloat16"
+
+    def heads_at(self, level: int) -> int:
+        if self.heads_per_level:
+            return self.heads_per_level[
+                min(level, len(self.heads_per_level) - 1)]
+        return self.num_heads
 
     @classmethod
     def from_hf(cls, hf: dict) -> "UNetConfig":
@@ -45,9 +59,17 @@ class UNetConfig:
         attn_levels = tuple(
             i for i, t in enumerate(down_types) if "CrossAttn" in t
         ) or tuple(range(len(block_out) - 1))
-        heads = hf.get("attention_head_dim", 8)
+        # diffusers quirk: attention_head_dim historically holds the HEAD
+        # COUNT for SD-class unets (8 for SD1.5, [5,10,20] for SDXL)
+        heads = hf.get("num_attention_heads") or hf.get(
+            "attention_head_dim", 8)
+        heads_per_level: tuple[int, ...] = ()
         if isinstance(heads, (list, tuple)):
-            heads = heads[0]
+            heads_per_level = tuple(int(h) for h in heads)
+            heads = heads_per_level[0]
+        add = hf.get("addition_embed_type") == "text_time"
+        pooled_dim = hf.get("projection_class_embeddings_input_dim")
+        time_dim = hf.get("addition_time_embed_dim", 256)
         return cls(
             in_channels=hf.get("in_channels", 4),
             out_channels=hf.get("out_channels", 4),
@@ -57,8 +79,14 @@ class UNetConfig:
             attn_levels=attn_levels,
             transformer_depth=hf.get("transformer_layers_per_block", 1)
             if isinstance(hf.get("transformer_layers_per_block", 1), int) else 1,
-            num_heads=hf.get("num_attention_heads") or heads,
+            num_heads=heads,
+            heads_per_level=heads_per_level,
             context_dim=hf.get("cross_attention_dim", 768),
+            addition_embed=add,
+            addition_time_embed_dim=time_dim,
+            addition_pooled_dim=(
+                (pooled_dim - 6 * time_dim) if add and pooled_dim else 1280
+            ),
         )
 
 
@@ -149,18 +177,20 @@ def _attn_proj(x, ctx, p, num_heads: int) -> jax.Array:
     return out @ p["wo"].astype(x.dtype) + p["bo"].astype(x.dtype)
 
 
-def spatial_transformer(x, context, p, cfg: UNetConfig) -> jax.Array:
+def spatial_transformer(x, context, p, cfg: UNetConfig,
+                        num_heads: int = 0) -> jax.Array:
     """GN → 1×1 in → transformer blocks (self, cross, GEGLU FF) → 1×1 out,
     residual around the whole stack."""
+    heads = num_heads or cfg.num_heads
     B, H, W, C = x.shape
     h = group_norm(x, p["norm"])
     h = conv2d(h, p["proj_in"])
     h = h.reshape(B, H * W, C)
     for bp in p["blocks"]:
         h = h + _attn_proj(layer_norm(h, bp["ln1"]), layer_norm(h, bp["ln1"]),
-                           bp["attn1"], cfg.num_heads)
+                           bp["attn1"], heads)
         h = h + _attn_proj(layer_norm(h, bp["ln2"]), context,
-                           bp["attn2"], cfg.num_heads)
+                           bp["attn2"], heads)
         h = h + _geglu(layer_norm(h, bp["ln3"]), bp["ff"])
     h = h.reshape(B, H, W, C)
     h = conv2d(h, p["proj_out"])
@@ -182,9 +212,17 @@ def upsample(x, p) -> jax.Array:
 # forward
 # ---------------------------------------------------------------------------
 
-def forward(cfg: UNetConfig, params: PyTree, latents, timesteps, context):
+def forward(cfg: UNetConfig, params: PyTree, latents, timesteps, context,
+            pooled_text=None, time_ids=None,
+            down_residuals=None, mid_residual=None):
     """Denoise step: latents [B,h,w,Cin], timesteps [B], context [B,T,ctx]
-    → predicted noise [B,h,w,Cout]."""
+    → predicted noise [B,h,w,Cout].
+
+    SDXL micro-conditioning (cfg.addition_embed): ``pooled_text``
+    [B, pooled_dim] and ``time_ids`` [B, 6] feed the text_time addition
+    MLP, added to the timestep embedding. ControlNet guidance:
+    ``down_residuals`` (one per skip) add onto the saved skips and
+    ``mid_residual`` onto the mid-block output (image/controlnet.py)."""
     dtype = jnp.dtype(cfg.dtype)
     x = latents.astype(dtype)
     context = context.astype(dtype)
@@ -194,29 +232,53 @@ def forward(cfg: UNetConfig, params: PyTree, latents, timesteps, context):
     temb = temb @ te["w1"] + te["b1"]
     temb = jax.nn.silu(temb) @ te["w2"] + te["b2"]
 
+    if cfg.addition_embed and pooled_text is not None:
+        B = pooled_text.shape[0]
+        # sinusoidal per time_id, flattened: [B, 6*addition_time_embed_dim]
+        tid = timestep_embedding(
+            time_ids.reshape(-1), cfg.addition_time_embed_dim
+        ).reshape(B, -1)
+        aug = jnp.concatenate(
+            [pooled_text.astype(jnp.float32), tid], axis=-1
+        )
+        ae = params["add_emb"]
+        aug = aug @ ae["w1"] + ae["b1"]
+        aug = jax.nn.silu(aug) @ ae["w2"] + ae["b2"]
+        temb = temb + aug
+
     h = conv2d(x, params["conv_in"])
     skips = [h]
     for lvl, lp in enumerate(params["down"]):
         for i, rp in enumerate(lp["res"]):
             h = res_block(h, temb, rp)
             if lp.get("attn"):
-                h = spatial_transformer(h, context, lp["attn"][i], cfg)
+                h = spatial_transformer(h, context, lp["attn"][i], cfg,
+                                        cfg.heads_at(lvl))
             skips.append(h)
         if lp.get("down"):
             h = downsample(h, lp["down"])
             skips.append(h)
 
+    if down_residuals is not None:
+        skips = [s + r.astype(s.dtype)
+                 for s, r in zip(skips, down_residuals)]
+
     mid = params["mid"]
+    n_lvls = len(params["down"])
     h = res_block(h, temb, mid["res1"])
-    h = spatial_transformer(h, context, mid["attn"], cfg)
+    h = spatial_transformer(h, context, mid["attn"], cfg,
+                            cfg.heads_at(n_lvls - 1))
     h = res_block(h, temb, mid["res2"])
+    if mid_residual is not None:
+        h = h + mid_residual.astype(h.dtype)
 
     for lvl, lp in enumerate(params["up"]):
         for i, rp in enumerate(lp["res"]):
             h = jnp.concatenate([h, skips.pop()], axis=-1)
             h = res_block(h, temb, rp)
             if lp.get("attn"):
-                h = spatial_transformer(h, context, lp["attn"][i], cfg)
+                h = spatial_transformer(h, context, lp["attn"][i], cfg,
+                                        cfg.heads_at(n_lvls - 1 - lvl))
         if lp.get("up"):
             h = upsample(h, lp["up"])
 

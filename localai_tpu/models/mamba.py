@@ -102,13 +102,16 @@ def _ssm_params(p, pre, cfg, x):
     return dA, dBu, C
 
 
-def _block_prefill(p, i, cfg, u):
-    """One block over the full sequence; returns (out, conv_state,
-    ssm_state)."""
+def _block_prefill(p, i, cfg, u, length):
+    """One block over the full (possibly right-padded) sequence; returns
+    (out, conv_state, ssm_state). ``length`` gates the recurrence so pad
+    positions past it never touch the carried states (prompt-length
+    bucketing — one compiled program per bucket, not per length)."""
     pre, x, gate = _mixer_common(p, i, cfg, u)
     B_, L, D = x.shape
     k = cfg.conv_kernel
-    # causal depthwise conv over time (torch Conv1d groups=D, pad k-1)
+    # causal depthwise conv over time (torch Conv1d groups=D, pad k-1);
+    # right-padding is safe — causality keeps positions < length exact
     xt = x.transpose(0, 2, 1)                        # [B,D,L]
     w = p[f"{pre}.conv1d.weight"]                    # [D,1,k]
     conv = jax.lax.conv_general_dilated(
@@ -119,23 +122,25 @@ def _block_prefill(p, i, cfg, u):
     if cfg.use_conv_bias:
         conv = conv + p[f"{pre}.conv1d.bias"][None, :, None]
     x = jax.nn.silu(conv).transpose(0, 2, 1)         # [B,L,D]
-    # rolling conv state for decode: last k-1... torch keeps k slots of
-    # PRE-conv activations (padded from the left)
-    conv_state = jnp.pad(xt, ((0, 0), (0, 0), (max(k - L, 0), 0)))[
-        :, :, -k:]
+    # decode conv state = pre-conv inputs at positions [length-k, length)
+    padded = jnp.pad(xt, ((0, 0), (0, 0), (k, 0)))
+    conv_state = jax.lax.dynamic_slice(
+        padded, (0, 0, length), (B_, D, k)
+    )
     dA, dBu, C = _ssm_params(p, pre, cfg, x)
     ssm0 = jnp.zeros((B_, D, cfg.state_size), jnp.float32)
 
     def scan_fn(state, t):
-        dA_t, dBu_t, C_t = t
-        state = dA_t * state + dBu_t                 # [B,D,N]
+        dA_t, dBu_t, C_t, idx = t
+        nxt = dA_t * state + dBu_t                   # [B,D,N]
+        state = jnp.where(idx < length, nxt, state)
         y = jnp.einsum("bdn,bn->bd", state, C_t)
         return state, y
 
     ssm_state, ys = jax.lax.scan(
         scan_fn, ssm0,
         (dA.transpose(1, 0, 2, 3), dBu.transpose(1, 0, 2, 3),
-         C.transpose(1, 0, 2)),
+         C.transpose(1, 0, 2), jnp.arange(L)),
     )
     y = ys.transpose(1, 0, 2)                        # [B,L,D]
     y = y + x * p[f"{pre}.D"][None, None]
@@ -171,20 +176,32 @@ def _block_step(p, i, cfg, u, conv_state, ssm_state):
     return out, conv_state, ssm_state
 
 
-def forward_prefill(p, cfg: MambaConfig, ids):
-    """ids [B,L] → (logits [B,L,V], states list)."""
+def forward_prefill(p, cfg: MambaConfig, ids, length=None, full=True):
+    """ids [B,L] (right-padded to a bucket) → (logits, states list).
+
+    ``full=True`` returns logits over every position [B,L,V] (parity
+    tests); the serving path uses full=False, which projects the lm head
+    ONLY at position length-1 — on a long prompt the [L, V] logits tensor
+    is pure waste (generate() consumes one row)."""
+    if length is None:
+        length = ids.shape[1]
     h = jnp.take(p["backbone.embeddings.weight"], ids, axis=0)
     states = []
     for i in range(cfg.num_layers):
         res = h.astype(jnp.float32)
         normed = _rms(h, p[f"backbone.layers.{i}.norm.weight"],
                       cfg.layer_norm_epsilon)
-        out, cs, ss = _block_prefill(p, i, cfg, normed)
+        out, cs, ss = _block_prefill(p, i, cfg, normed, length)
         h = (res + out).astype(h.dtype)
         states.append((cs, ss))
     h = _rms(h, p["backbone.norm_f.weight"], cfg.layer_norm_epsilon)
-    logits = h @ _lm_head(p).T
-    return logits, states
+    if full:
+        return h @ _lm_head(p).T, states
+    last = jnp.take_along_axis(
+        h, jnp.asarray(length - 1).reshape(1, 1, 1).repeat(
+            h.shape[-1], -1), axis=1
+    )[:, 0]
+    return last @ _lm_head(p).T, states
 
 
 def forward_step(p, cfg: MambaConfig, token, states):
@@ -216,8 +233,11 @@ class MambaLM:
         self._step = jax.jit(
             lambda p, tok, states: forward_step(p, cfg, tok, states)
         )
+        # prompts pad to power-of-two buckets: one compiled prefill per
+        # bucket, not per prompt length
         self._prefill = jax.jit(
-            lambda p, ids: forward_prefill(p, cfg, ids)
+            lambda p, ids, length: forward_prefill(p, cfg, ids, length,
+                                                   full=False)
         )
 
     def generate(self, prompt: list[int], *, max_new_tokens: int = 128,
@@ -225,11 +245,17 @@ class MambaLM:
                  eos_ids: Optional[set[int]] = None,
                  on_token=None) -> list[int]:
         eos = eos_ids if eos_ids is not None else {self.cfg.eos_token_id}
-        ids = jnp.asarray([prompt or [0]], jnp.int32)
-        logits, states = self._prefill(self.params, ids)
+        toks = prompt or [0]
+        bucket = 16
+        while bucket < len(toks):
+            bucket *= 2
+        ids = np.zeros((1, bucket), np.int32)
+        ids[0, : len(toks)] = toks
+        last, states = self._prefill(
+            self.params, jnp.asarray(ids), jnp.int32(len(toks))
+        )
         key = jax.random.key(seed)
         out: list[int] = []
-        last = logits[:, -1]
         for _ in range(max_new_tokens):
             if temperature and temperature > 0:
                 key, k = jax.random.split(key)

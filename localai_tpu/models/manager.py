@@ -583,6 +583,8 @@ class ModelManager:
         pipe = resolve_image_model(
             mcfg.model or mcfg.name, model_path=self.app.model_path, **kwargs
         )
+        if d.control_net:
+            pipe.attach_controlnet(d.control_net, self.app.model_path)
         log.info("loaded image model %s in %.1fs", mcfg.name,
                  time.monotonic() - t0)
         return ImageServingModel(name=mcfg.name, config=mcfg, pipeline=pipe)

@@ -98,9 +98,10 @@ def _wkv_step(num, den, mx, k, v, time_decay, time_first):
     return out, e1 * num + e2 * v, e1 * den + e2, max_state
 
 
-def _attention(p, i, cfg, x, shifted, st: LayerState):
+def _attention(p, i, cfg, x, shifted, st: LayerState, length):
     """x [B,L,H]; shifted [B,L,H] (token-shifted hiddens). Returns
-    (out, new LayerState pieces)."""
+    (out, new LayerState pieces). ``length`` gates the WKV carry so
+    right-padded bucket positions never pollute the state."""
     pre = f"rwkv.blocks.{i}.attention"
     mk = p[f"{pre}.time_mix_key"][0]
     mv = p[f"{pre}.time_mix_value"][0]
@@ -115,16 +116,20 @@ def _attention(p, i, cfg, x, shifted, st: LayerState):
 
     def scan_fn(carry, t):
         num, den, mx = carry
-        k_t, v_t = t
-        out, num, den, mx = _wkv_step(
+        k_t, v_t, idx = t
+        out, n2, d2, m2 = _wkv_step(
             num, den, mx, k_t.astype(jnp.float32), v_t,
             time_decay, time_first,
         )
-        return (num, den, mx), out
+        # pad positions past the true length must not touch the carry
+        keep = idx < length
+        return (jnp.where(keep, n2, num), jnp.where(keep, d2, den),
+                jnp.where(keep, m2, mx)), out
 
     (num, den, mx), outs = jax.lax.scan(
         scan_fn, (st.num, st.den, st.mx),
-        (key.transpose(1, 0, 2), value.transpose(1, 0, 2)),
+        (key.transpose(1, 0, 2), value.transpose(1, 0, 2),
+         jnp.arange(key.shape[1])),
     )
     rwkv_out = outs.transpose(1, 0, 2).astype(x.dtype)
     out = (recept * rwkv_out) @ p[f"{pre}.output.weight"].T
@@ -150,13 +155,26 @@ def _shift(x, first_row):
     return jnp.concatenate([first_row[:, None], x[:, :-1]], axis=1)
 
 
-def forward(p, cfg: RwkvConfig, ids, states: Optional[list] = None):
-    """ids [B,L] → (logits [B,L,V], new states). States None = fresh."""
+def forward(p, cfg: RwkvConfig, ids, states: Optional[list] = None,
+            length=None, full=True):
+    """ids [B,L] (right-padded to a bucket) → (logits, new states).
+    States None = fresh. ``full=False`` projects the head only at
+    position length-1 (the serving path: one row is all generate()
+    reads)."""
     B, L = ids.shape
+    if length is None:
+        length = L
     if states is None:
         states = _init_state(cfg, B)
     h = jnp.take(p["rwkv.embeddings.weight"], ids, axis=0)
     eps = cfg.layer_norm_epsilon
+
+    def at_last(x):  # [B,L,H] → [B,H] at position length-1
+        return jnp.take_along_axis(
+            x, jnp.asarray(length - 1).reshape(1, 1, 1).repeat(
+                x.shape[-1], -1), axis=1
+        )[:, 0]
+
     new_states = []
     for i in range(cfg.num_layers):
         blk = f"rwkv.blocks.{i}"
@@ -166,7 +184,8 @@ def forward(p, cfg: RwkvConfig, ids, states: Optional[list] = None):
         st = states[i]
         x1 = _ln(h, p[f"{blk}.ln1.weight"], p[f"{blk}.ln1.bias"], eps)
         attn, num, den, mx = _attention(
-            p, i, cfg, x1, _shift(x1, st.attn_shift.astype(x1.dtype)), st
+            p, i, cfg, x1, _shift(x1, st.attn_shift.astype(x1.dtype)),
+            st, length,
         )
         h = h + attn
         x2 = _ln(h, p[f"{blk}.ln2.weight"], p[f"{blk}.ln2.bias"], eps)
@@ -174,12 +193,14 @@ def forward(p, cfg: RwkvConfig, ids, states: Optional[list] = None):
             p, i, cfg, x2, _shift(x2, st.ffn_shift.astype(x2.dtype))
         )
         new_states.append(LayerState(
-            ffn_shift=x2[:, -1].astype(jnp.float32),
-            attn_shift=x1[:, -1].astype(jnp.float32),
+            ffn_shift=at_last(x2).astype(jnp.float32),
+            attn_shift=at_last(x1).astype(jnp.float32),
             num=num, den=den, mx=mx,
         ))
     h = _ln(h, p["rwkv.ln_out.weight"], p["rwkv.ln_out.bias"], eps)
-    return h @ p["head.weight"].T, new_states
+    if full:
+        return h @ p["head.weight"].T, new_states
+    return at_last(h) @ p["head.weight"].T, new_states
 
 
 class RwkvLM:
@@ -194,18 +215,29 @@ class RwkvLM:
         self._fwd = jax.jit(
             lambda p, ids, states: forward(p, cfg, ids, states)
         )
-        self._fresh = jax.jit(lambda p, ids: forward(p, cfg, ids, None))
+        # prompts pad to power-of-two buckets: one compiled prefill per
+        # bucket, not per prompt length
+        self._fresh = jax.jit(
+            lambda p, ids, length: forward(p, cfg, ids, None, length,
+                                           full=False)
+        )
 
     def generate(self, prompt: list[int], *, max_new_tokens: int = 128,
                  temperature: float = 0.0, seed: int = 0,
                  eos_ids: Optional[set[int]] = None,
                  on_token=None) -> list[int]:
         eos = eos_ids if eos_ids is not None else {self.cfg.eos_token_id}
-        ids = jnp.asarray([prompt or [0]], jnp.int32)
-        logits, states = self._fresh(self.params, ids)
+        toks = prompt or [0]
+        bucket = 16
+        while bucket < len(toks):
+            bucket *= 2
+        ids = np.zeros((1, bucket), np.int32)
+        ids[0, : len(toks)] = toks
+        last, states = self._fresh(
+            self.params, jnp.asarray(ids), jnp.int32(len(toks))
+        )
         key = jax.random.key(seed)
         out: list[int] = []
-        last = logits[:, -1]
         for _ in range(max_new_tokens):
             if temperature and temperature > 0:
                 key, k = jax.random.split(key)
@@ -245,10 +277,19 @@ def resolve_rwkv(ref: str, model_path: str | Path = "models",
             from localai_tpu.utils.tokenizer import load_tokenizer
 
             raw = _open_safetensors(cand)
-            params = {
-                name: jnp.asarray(np.asarray(_get(raw, name), np.float32))
-                for name in raw
-            }
+            params = {}
+            for name in raw:
+                arr = np.asarray(_get(raw, name), np.float32)
+                # time_decay/time_first and norms stay f32 (the WKV
+                # exponentials are numerically fragile); the big matmul
+                # weights honor the configured dtype
+                keep_f32 = (
+                    arr.ndim == 1
+                    or name.endswith(("time_decay", "time_first"))
+                )
+                params[name] = jnp.asarray(
+                    arr, jnp.float32 if keep_f32 else jnp.dtype(dtype)
+                )
             return RwkvLM(cfg, params, load_tokenizer(cand))
     raise FileNotFoundError(f"rwkv ref {ref!r} not found")
 

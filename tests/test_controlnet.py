@@ -1,0 +1,163 @@
+"""ControlNet guidance (parity:
+/root/reference/backend/python/diffusers/backend.py:192-208 — a
+ControlNetModel loaded next to the SD pipeline; the request image becomes
+the control condition)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from localai_tpu.image.loader import load_diffusers_pipeline
+
+
+def _write_controlnet_fixture(root):
+    """Tiny ControlNetModel matching test_image's SD fixture shapes
+    (block_out [32,64], attn on level 0, 1 res block, vae downscale 2 →
+    one stride-2 cond block)."""
+    from safetensors.numpy import save_file
+
+    rng = np.random.default_rng(11)
+
+    def t(*shape):
+        return rng.standard_normal(shape).astype(np.float32) * 0.05
+
+    def conv(cin, cout, k=3):
+        return t(cout, cin, k, k)
+
+    c = {}
+    c["conv_in.weight"], c["conv_in.bias"] = conv(4, 32), t(32)
+    c["time_embedding.linear_1.weight"] = t(128, 32)
+    c["time_embedding.linear_1.bias"] = t(128)
+    c["time_embedding.linear_2.weight"] = t(128, 128)
+    c["time_embedding.linear_2.bias"] = t(128)
+
+    ce = "controlnet_cond_embedding"
+    c[f"{ce}.conv_in.weight"], c[f"{ce}.conv_in.bias"] = conv(3, 16), t(16)
+    c[f"{ce}.blocks.0.weight"], c[f"{ce}.blocks.0.bias"] = conv(16, 16), t(16)
+    c[f"{ce}.blocks.1.weight"], c[f"{ce}.blocks.1.bias"] = conv(16, 32), t(32)
+    c[f"{ce}.conv_out.weight"], c[f"{ce}.conv_out.bias"] = conv(32, 32), t(32)
+
+    def res(prefix, cin, cout):
+        c[f"{prefix}.norm1.weight"], c[f"{prefix}.norm1.bias"] = t(cin), t(cin)
+        c[f"{prefix}.conv1.weight"] = conv(cin, cout)
+        c[f"{prefix}.conv1.bias"] = t(cout)
+        c[f"{prefix}.time_emb_proj.weight"] = t(cout, 128)
+        c[f"{prefix}.time_emb_proj.bias"] = t(cout)
+        c[f"{prefix}.norm2.weight"], c[f"{prefix}.norm2.bias"] = t(cout), t(cout)
+        c[f"{prefix}.conv2.weight"] = conv(cout, cout)
+        c[f"{prefix}.conv2.bias"] = t(cout)
+        if cin != cout:
+            c[f"{prefix}.conv_shortcut.weight"] = conv(cin, cout, 1)
+            c[f"{prefix}.conv_shortcut.bias"] = t(cout)
+
+    def st(prefix, ch, ctx=64):
+        c[f"{prefix}.norm.weight"], c[f"{prefix}.norm.bias"] = t(ch), t(ch)
+        c[f"{prefix}.proj_in.weight"] = conv(ch, ch, 1)
+        c[f"{prefix}.proj_in.bias"] = t(ch)
+        c[f"{prefix}.proj_out.weight"] = conv(ch, ch, 1)
+        c[f"{prefix}.proj_out.bias"] = t(ch)
+        b = f"{prefix}.transformer_blocks.0"
+        for ln in ("norm1", "norm2", "norm3"):
+            c[f"{b}.{ln}.weight"], c[f"{b}.{ln}.bias"] = t(ch), t(ch)
+        for attn, kv in (("attn1", ch), ("attn2", ctx)):
+            c[f"{b}.{attn}.to_q.weight"] = t(ch, ch)
+            c[f"{b}.{attn}.to_k.weight"] = t(ch, kv)
+            c[f"{b}.{attn}.to_v.weight"] = t(ch, kv)
+            c[f"{b}.{attn}.to_out.0.weight"] = t(ch, ch)
+            c[f"{b}.{attn}.to_out.0.bias"] = t(ch)
+        inner = ch * 4
+        c[f"{b}.ff.net.0.proj.weight"] = t(inner * 2, ch)
+        c[f"{b}.ff.net.0.proj.bias"] = t(inner * 2)
+        c[f"{b}.ff.net.2.weight"] = t(ch, inner)
+        c[f"{b}.ff.net.2.bias"] = t(ch)
+
+    res("down_blocks.0.resnets.0", 32, 32)
+    st("down_blocks.0.attentions.0", 32)
+    c["down_blocks.0.downsamplers.0.conv.weight"] = conv(32, 32)
+    c["down_blocks.0.downsamplers.0.conv.bias"] = t(32)
+    res("down_blocks.1.resnets.0", 32, 64)
+    res("mid_block.resnets.0", 64, 64)
+    st("mid_block.attentions.0", 64)
+    res("mid_block.resnets.1", 64, 64)
+    # zero convs: one per skip [32, 32, 32, 64] + mid 64
+    for j, ch in enumerate([32, 32, 32, 64]):
+        c[f"controlnet_down_blocks.{j}.weight"] = conv(ch, ch, 1)
+        c[f"controlnet_down_blocks.{j}.bias"] = t(ch)
+    c["controlnet_mid_block.weight"] = conv(64, 64, 1)
+    c["controlnet_mid_block.bias"] = t(64)
+
+    root.mkdir(parents=True)
+    save_file(c, str(root / "model.safetensors"))
+    (root / "config.json").write_text(json.dumps({
+        "block_out_channels": [32, 64], "layers_per_block": 1,
+        "down_block_types": ["CrossAttnDownBlock2D", "DownBlock2D"],
+        "cross_attention_dim": 64, "attention_head_dim": 4,
+        "in_channels": 4,
+    }))
+
+
+@pytest.fixture(scope="module")
+def controlled(tmp_path_factory):
+    from test_image import _write_diffusers_fixture
+
+    base = tmp_path_factory.mktemp("cn")
+    _write_diffusers_fixture(base / "model")
+    _write_controlnet_fixture(base / "cn-model")
+    pipe = load_diffusers_pipeline(base / "model", default_steps=2)
+    pipe.attach_controlnet(str(base / "cn-model"))
+    return pipe
+
+
+def test_control_image_steers_generation(controlled):
+    ctrl = np.zeros((64, 64, 3), np.uint8)
+    ctrl[:, 32:] = 255  # half-white condition
+    a = controlled.generate("a cat", width=64, height=64, seed=3,
+                            control_image=ctrl)
+    no_ctrl = controlled.generate("a cat", width=64, height=64, seed=3)
+    assert a.image.shape == no_ctrl.image.shape
+    assert not np.array_equal(a.image, no_ctrl.image)
+    # scale 0 ≡ no control (zero residuals)
+    zero = controlled.generate("a cat", width=64, height=64, seed=3,
+                               control_image=ctrl, control_scale=0.0)
+    np.testing.assert_array_equal(zero.image, no_ctrl.image)
+    # a different condition image produces a different result
+    b = controlled.generate("a cat", width=64, height=64, seed=3,
+                            control_image=255 - ctrl)
+    assert not np.array_equal(a.image, b.image)
+
+
+def test_controlnet_via_config_and_api(tmp_path):
+    """`diffusers.control_net` in the model YAML loads the ControlNet and
+    the request image guides generation."""
+    import base64
+    import io
+
+    import httpx
+    from PIL import Image
+    from test_api import _ServerThread, make_state
+    from test_image import _write_diffusers_fixture
+
+    _write_diffusers_fixture(tmp_path / "sd-ckpt")
+    _write_controlnet_fixture(tmp_path / "cn-ckpt")
+    (tmp_path / "img.yaml").write_text(
+        "name: img\nmodel: sd-ckpt\nbackend: diffusers\n"
+        "known_usecases: [image]\n"
+        "diffusers:\n  steps: 2\n  control_net: cn-ckpt\n"
+    )
+    srv = _ServerThread(make_state(tmp_path))
+    try:
+        buf = io.BytesIO()
+        Image.new("RGB", (64, 64), (255, 0, 0)).save(buf, format="PNG")
+        with httpx.Client(base_url=srv.base, timeout=300.0) as c:
+            r = c.post("/v1/images/generations", json={
+                "model": "img", "prompt": "a house", "size": "64x64",
+                "response_format": "b64_json",
+                "file": base64.b64encode(buf.getvalue()).decode(),
+                "seed": 1,
+            })
+            assert r.status_code == 200, r.text
+            png = base64.b64decode(r.json()["data"][0]["b64_json"])
+            assert png[:4] == b"\x89PNG"
+    finally:
+        srv.stop()

@@ -1,0 +1,188 @@
+"""SDXL-class pipeline: dual text encoders (penultimate hidden concat +
+projected pooled), text_time micro-conditioning, per-level head counts
+(parity: the reference's StableDiffusionXLPipeline routing,
+/root/reference/backend/python/diffusers/backend.py:213-260)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from localai_tpu.image.loader import load_diffusers_pipeline
+
+
+def _write_sdxl_fixture(root):
+    """Tiny random SDXL-layout checkpoint: unet with addition embeddings
+    and per-level heads, two text encoders (the second with a pooled
+    projection), shared tiny VAE."""
+    from safetensors.numpy import save_file
+    from test_image import _write_diffusers_fixture
+
+    # start from the SD fixture (vae + text_encoder + unet), then replace
+    # the unet with the addition-embed variant and add encoder 2
+    _write_diffusers_fixture(root)
+    rng = np.random.default_rng(7)
+
+    def t(*shape):
+        return rng.standard_normal(shape).astype(np.float32) * 0.05
+
+    def conv(cin, cout, k=3):
+        return t(cout, cin, k, k)
+
+    u = {}
+    u["conv_in.weight"], u["conv_in.bias"] = conv(4, 32), t(32)
+    u["time_embedding.linear_1.weight"] = t(128, 32)
+    u["time_embedding.linear_1.bias"] = t(128)
+    u["time_embedding.linear_2.weight"] = t(128, 128)
+    u["time_embedding.linear_2.bias"] = t(128)
+    # text_time addition MLP: pooled(32) + 6*time_dim(8) = 80 → 128
+    u["add_embedding.linear_1.weight"] = t(128, 80)
+    u["add_embedding.linear_1.bias"] = t(128)
+    u["add_embedding.linear_2.weight"] = t(128, 128)
+    u["add_embedding.linear_2.bias"] = t(128)
+
+    def res(prefix, cin, cout):
+        u[f"{prefix}.norm1.weight"], u[f"{prefix}.norm1.bias"] = t(cin), t(cin)
+        u[f"{prefix}.conv1.weight"] = conv(cin, cout)
+        u[f"{prefix}.conv1.bias"] = t(cout)
+        u[f"{prefix}.time_emb_proj.weight"] = t(cout, 128)
+        u[f"{prefix}.time_emb_proj.bias"] = t(cout)
+        u[f"{prefix}.norm2.weight"], u[f"{prefix}.norm2.bias"] = t(cout), t(cout)
+        u[f"{prefix}.conv2.weight"] = conv(cout, cout)
+        u[f"{prefix}.conv2.bias"] = t(cout)
+        if cin != cout:
+            u[f"{prefix}.conv_shortcut.weight"] = conv(cin, cout, 1)
+            u[f"{prefix}.conv_shortcut.bias"] = t(cout)
+
+    def st(prefix, ch, depth=1, ctx=96):
+        u[f"{prefix}.norm.weight"], u[f"{prefix}.norm.bias"] = t(ch), t(ch)
+        u[f"{prefix}.proj_in.weight"] = conv(ch, ch, 1)
+        u[f"{prefix}.proj_in.bias"] = t(ch)
+        u[f"{prefix}.proj_out.weight"] = conv(ch, ch, 1)
+        u[f"{prefix}.proj_out.bias"] = t(ch)
+        for d in range(depth):
+            b = f"{prefix}.transformer_blocks.{d}"
+            for ln in ("norm1", "norm2", "norm3"):
+                u[f"{b}.{ln}.weight"], u[f"{b}.{ln}.bias"] = t(ch), t(ch)
+            for attn, kv in (("attn1", ch), ("attn2", ctx)):
+                u[f"{b}.{attn}.to_q.weight"] = t(ch, ch)
+                u[f"{b}.{attn}.to_k.weight"] = t(ch, kv)
+                u[f"{b}.{attn}.to_v.weight"] = t(ch, kv)
+                u[f"{b}.{attn}.to_out.0.weight"] = t(ch, ch)
+                u[f"{b}.{attn}.to_out.0.bias"] = t(ch)
+            inner = ch * 4
+            u[f"{b}.ff.net.0.proj.weight"] = t(inner * 2, ch)
+            u[f"{b}.ff.net.0.proj.bias"] = t(inner * 2)
+            u[f"{b}.ff.net.2.weight"] = t(ch, inner)
+            u[f"{b}.ff.net.2.bias"] = t(ch)
+
+    # SDXL shape: level 0 plain, level 1 cross-attn with depth 2
+    res("down_blocks.0.resnets.0", 32, 32)
+    u["down_blocks.0.downsamplers.0.conv.weight"] = conv(32, 32)
+    u["down_blocks.0.downsamplers.0.conv.bias"] = t(32)
+    res("down_blocks.1.resnets.0", 32, 64)
+    st("down_blocks.1.attentions.0", 64, depth=2)
+    res("mid_block.resnets.0", 64, 64)
+    st("mid_block.attentions.0", 64, depth=2)
+    res("mid_block.resnets.1", 64, 64)
+    res("up_blocks.0.resnets.0", 64 + 64, 64)
+    st("up_blocks.0.attentions.0", 64, depth=2)
+    res("up_blocks.0.resnets.1", 64 + 32, 64)
+    st("up_blocks.0.attentions.1", 64, depth=2)
+    u["up_blocks.0.upsamplers.0.conv.weight"] = conv(64, 64)
+    u["up_blocks.0.upsamplers.0.conv.bias"] = t(64)
+    res("up_blocks.1.resnets.0", 64 + 32, 32)
+    res("up_blocks.1.resnets.1", 32 + 32, 32)
+    u["conv_norm_out.weight"], u["conv_norm_out.bias"] = t(32), t(32)
+    u["conv_out.weight"], u["conv_out.bias"] = conv(32, 4), t(4)
+
+    (root / "unet" / "model.safetensors").unlink()
+    save_file(u, str(root / "unet" / "model.safetensors"))
+    (root / "unet" / "config.json").write_text(json.dumps({
+        "block_out_channels": [32, 64], "layers_per_block": 1,
+        "down_block_types": ["DownBlock2D", "CrossAttnDownBlock2D"],
+        "cross_attention_dim": 96, "attention_head_dim": [2, 4],
+        "in_channels": 4, "out_channels": 4,
+        "addition_embed_type": "text_time",
+        "addition_time_embed_dim": 8,
+        "projection_class_embeddings_input_dim": 80,
+    }))
+
+    # second text encoder: hidden 32 with a 32-dim pooled projection;
+    # context = 64 (enc1) + 32 (enc2) = 96
+    c2 = {}
+    C2, I2 = 32, 64
+    c2["text_model.embeddings.token_embedding.weight"] = t(100, C2)
+    c2["text_model.embeddings.position_embedding.weight"] = t(16, C2)
+    for i in range(2):
+        b = f"text_model.encoder.layers.{i}"
+        for ln in ("layer_norm1", "layer_norm2"):
+            c2[f"{b}.{ln}.weight"], c2[f"{b}.{ln}.bias"] = t(C2), t(C2)
+        for p in ("q_proj", "k_proj", "v_proj", "out_proj"):
+            c2[f"{b}.self_attn.{p}.weight"] = t(C2, C2)
+            c2[f"{b}.self_attn.{p}.bias"] = t(C2)
+        c2[f"{b}.mlp.fc1.weight"], c2[f"{b}.mlp.fc1.bias"] = t(I2, C2), t(I2)
+        c2[f"{b}.mlp.fc2.weight"], c2[f"{b}.mlp.fc2.bias"] = t(C2, I2), t(C2)
+    c2["text_model.final_layer_norm.weight"] = t(C2)
+    c2["text_model.final_layer_norm.bias"] = t(C2)
+    c2["text_projection.weight"] = t(32, C2)
+    (root / "text_encoder_2").mkdir()
+    save_file(c2, str(root / "text_encoder_2" / "model.safetensors"))
+    (root / "text_encoder_2" / "config.json").write_text(json.dumps({
+        "vocab_size": 100, "hidden_size": C2, "intermediate_size": I2,
+        "num_hidden_layers": 2, "num_attention_heads": 4,
+        "max_position_embeddings": 16, "eos_token_id": 99,
+        "projection_dim": 32,
+        "architectures": ["CLIPTextModelWithProjection"],
+    }))
+    (root / "model_index.json").write_text(json.dumps(
+        {"_class_name": "StableDiffusionXLPipeline"}
+    ))
+
+
+@pytest.fixture(scope="module")
+def sdxl(tmp_path_factory):
+    root = tmp_path_factory.mktemp("sdxl") / "model"
+    _write_sdxl_fixture(root)
+    return load_diffusers_pipeline(root, default_steps=2)
+
+
+def test_sdxl_layout_detected(sdxl):
+    assert sdxl.is_sdxl
+    assert sdxl.unet_cfg.addition_embed
+    assert sdxl.unet_cfg.heads_per_level == (2, 4)
+    assert sdxl.unet_cfg.attn_levels == (1,)
+    assert sdxl.unet_cfg.context_dim == 96
+    assert "add_emb" in sdxl.unet_params
+    assert "text_projection" in sdxl.text2_params
+    # depth-2 transformer stacks loaded data-driven
+    assert len(sdxl.unet_params["mid"]["attn"]["blocks"]) == 2
+
+
+def test_sdxl_generation(sdxl):
+    a = sdxl.generate("a castle", width=64, height=64, seed=5)
+    assert a.image.shape == (64, 64, 3)
+    assert a.image.dtype == np.uint8
+    # deterministic per seed
+    b = sdxl.generate("a castle", width=64, height=64, seed=5)
+    np.testing.assert_array_equal(a.image, b.image)
+    # prompt reaches the model through BOTH encoders
+    c = sdxl.generate("a dog", width=64, height=64, seed=5)
+    assert not np.array_equal(a.image, c.image)
+
+
+def test_sdxl_conditioning_shapes(sdxl):
+    cond = sdxl._prepare_cond("hello", "bad", 64, 64)
+    assert cond["context"].shape == (2, 16, 96)
+    assert cond["pooled"].shape == (2, 32)
+    assert cond["time_ids"].shape == (2, 6)
+    # pooled actually conditions the unet: zeroing it changes the output
+    import jax.numpy as jnp
+
+    x = jnp.zeros((1, 8, 8, 4), jnp.float32)
+    d1 = sdxl._unet_step(x, jnp.float32(1.0), jnp.float32(500.0), cond,
+                         jnp.float32(5.0))
+    cond2 = dict(cond, pooled=cond["pooled"] * 0 + 1.0)
+    d2 = sdxl._unet_step(x, jnp.float32(1.0), jnp.float32(500.0), cond2,
+                         jnp.float32(5.0))
+    assert not np.allclose(np.asarray(d1), np.asarray(d2))
