@@ -17,6 +17,7 @@ Implements the scheduler's TokenConstraint protocol
 
 from __future__ import annotations
 
+import ctypes
 import logging
 from typing import Any, Optional, Sequence
 
@@ -27,6 +28,19 @@ from localai_tpu.functions.fsm import DFA, compile_dfa
 log = logging.getLogger(__name__)
 
 NEG = np.float32(-1e30)
+
+_NATIVE_SENTINEL = object()
+_native_lib: Any = _NATIVE_SENTINEL
+
+
+def _native_fsm():
+    """The compiled fsm_walk C module, or None (numpy fallback)."""
+    global _native_lib
+    if _native_lib is _NATIVE_SENTINEL:
+        from localai_tpu.native import load
+
+        _native_lib = load("fsm_walk")
+    return _native_lib
 
 
 # ---------------------------------------------------------------------------
@@ -128,9 +142,36 @@ class TokenTrie:
 
     def walk(self, dfa: DFA, state: int) -> np.ndarray:
         """DFA final state per trie node, starting every token at `state`.
-        Dead-state propagation makes `final != DEAD` ⇔ whole token legal."""
+        Dead-state propagation makes `final != DEAD` ⇔ whole token legal.
+
+        Takes the native single-pass kernel when the C module compiled
+        (localai_tpu/native/fsm_walk.c — parents precede children in the
+        node order, so one linear loop resolves every node); the numpy
+        per-level gather below is the fallback."""
         states = np.zeros(self.n_nodes, dtype=np.int32)
         states[0] = state
+        lib = _native_fsm()
+        if lib is not None:
+            # contiguous int32/uint8 views cached on the DFA object
+            trans = dfa.__dict__.get("_trans_i32")
+            if trans is None:
+                trans = np.ascontiguousarray(dfa.trans, dtype=np.int32)
+                dfa.__dict__["_trans_i32"] = trans
+            cls = dfa.__dict__.get("_cls_u8")
+            if cls is None:
+                cls = np.ascontiguousarray(
+                    dfa.byte_class.astype(np.uint8))
+                dfa.__dict__["_cls_u8"] = cls
+            lib.fsm_walk(
+                trans.ctypes.data_as(ctypes.c_void_p),
+                ctypes.c_int32(trans.shape[1]),
+                cls.ctypes.data_as(ctypes.c_void_p),
+                self.parent.ctypes.data_as(ctypes.c_void_p),
+                self.edge.ctypes.data_as(ctypes.c_void_p),
+                ctypes.c_int64(self.n_nodes),
+                states.ctypes.data_as(ctypes.c_void_p),
+            )
+            return states
         cls = dfa.byte_class
         for nodes in self.levels:
             states[nodes] = dfa.trans[
@@ -224,13 +265,35 @@ class FSMConstraint:
         row = self._masks.get(state)
         if row is None:
             finals = self._final_states(state)
-            tok_final = finals[self.trie.leaf_of_token]
-            allowed = self.trie.token_ok & (tok_final != DFA.DEAD)
-            row = np.where(allowed, np.float32(0.0), NEG).astype(np.float32)
+            lib = _native_fsm()
+            if lib is not None:
+                # fused gather+compare+select in C (fsm_walk.c:fsm_mask):
+                # no [V] temporaries on a mask-cache miss
+                row = np.empty(len(self.trie.leaf_of_token), np.float32)
+                ok_u8 = self.trie.__dict__.get("_ok_u8")
+                if ok_u8 is None:
+                    ok_u8 = np.ascontiguousarray(
+                        self.trie.token_ok.astype(np.uint8))
+                    self.trie.__dict__["_ok_u8"] = ok_u8
+                lib.fsm_mask(
+                    finals.ctypes.data_as(ctypes.c_void_p),
+                    self.trie.leaf_of_token.ctypes.data_as(
+                        ctypes.c_void_p),
+                    ok_u8.ctypes.data_as(ctypes.c_void_p),
+                    ctypes.c_int64(len(self.trie.leaf_of_token)),
+                    ctypes.c_int32(DFA.DEAD),
+                    row.ctypes.data_as(ctypes.c_void_p),
+                )
+            else:
+                tok_final = finals[self.trie.leaf_of_token]
+                allowed = self.trie.token_ok & (tok_final != DFA.DEAD)
+                row = np.where(allowed, np.float32(0.0),
+                               NEG).astype(np.float32)
+            allowed_any = bool((row == 0.0).any())
             if bool(self.dfa.accept[state]):
                 for e in self.eos_ids:
                     row[e] = 0.0
-            elif not allowed.any():
+            elif not allowed_any:
                 # dead grammar state with nothing allowed: permit EOS so the
                 # slot can finish instead of sampling uniformly over -1e30
                 for e in self.eos_ids:

@@ -188,6 +188,12 @@ class DiffusionPipeline:
         context, pooled = self._encode_text_xl(
             jnp.asarray(toks), jnp.asarray(toks2)
         )
+        if not negative:
+            # SDXL base ships force_zeros_for_empty_prompt=true: an empty
+            # negative conditions on ZERO embeddings, not on the encoded
+            # empty string (diffusers parity)
+            context = context.at[0].set(0.0)
+            pooled = pooled.at[0].set(0.0)
         # micro-conditioning: (orig_h, orig_w, crop_t, crop_l, tgt_h, tgt_w)
         tid = jnp.asarray(
             [[height, width, 0, 0, height, width]] * 2, jnp.float32

@@ -41,7 +41,6 @@ class UNetConfig:
     # timestep embedding
     addition_embed: bool = False
     addition_time_embed_dim: int = 256
-    addition_pooled_dim: int = 1280
     dtype: str = "bfloat16"
 
     def heads_at(self, level: int) -> int:
@@ -68,7 +67,6 @@ class UNetConfig:
             heads_per_level = tuple(int(h) for h in heads)
             heads = heads_per_level[0]
         add = hf.get("addition_embed_type") == "text_time"
-        pooled_dim = hf.get("projection_class_embeddings_input_dim")
         time_dim = hf.get("addition_time_embed_dim", 256)
         return cls(
             in_channels=hf.get("in_channels", 4),
@@ -84,9 +82,6 @@ class UNetConfig:
             context_dim=hf.get("cross_attention_dim", 768),
             addition_embed=add,
             addition_time_embed_dim=time_dim,
-            addition_pooled_dim=(
-                (pooled_dim - 6 * time_dim) if add and pooled_dim else 1280
-            ),
         )
 
 

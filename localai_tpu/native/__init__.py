@@ -1,0 +1,67 @@
+"""Native (C) runtime components, compiled on demand.
+
+The reference's runtime hot paths are native (llama.cpp's grammar
+sampler, tokenizer, slot engine — C++); our device math lives in XLA, but
+a few HOST-side per-token paths deserve native code too. Modules here
+compile with the system compiler at first use (cc -O3 -shared) into the
+user cache dir and load via ctypes — no pip, no pybind11, and every
+caller keeps a pure-Python fallback, so a missing toolchain degrades to
+the numpy path instead of failing.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import logging
+import os
+import subprocess
+import tempfile
+from pathlib import Path
+from typing import Optional
+
+log = logging.getLogger(__name__)
+
+_SRC_DIR = Path(__file__).parent
+_cache: dict[str, Optional[ctypes.CDLL]] = {}
+
+
+def _build_dir() -> Path:
+    d = Path(os.environ.get("LOCALAI_NATIVE_CACHE")
+             or Path(tempfile.gettempdir()) / "localai_tpu_native")
+    d.mkdir(parents=True, exist_ok=True)
+    return d
+
+
+def load(name: str) -> Optional[ctypes.CDLL]:
+    """Compile (once per source hash) and load ``name``.c; None when no
+    compiler is available — callers fall back to Python."""
+    if name in _cache:
+        return _cache[name]
+    lib: Optional[ctypes.CDLL] = None
+    try:
+        src = _SRC_DIR / f"{name}.c"
+        code = src.read_bytes()
+        tag = hashlib.sha256(code).hexdigest()[:16]
+        out = _build_dir() / f"{name}-{tag}.so"
+        if not out.exists():
+            for cc in ("cc", "gcc", "clang"):
+                try:
+                    subprocess.run(
+                        [cc, "-O3", "-fPIC", "-shared", str(src),
+                         "-o", str(out)],
+                        check=True, capture_output=True, timeout=120,
+                    )
+                    break
+                except (OSError, subprocess.SubprocessError):
+                    continue
+            else:
+                raise RuntimeError("no working C compiler")
+        lib = ctypes.CDLL(str(out))
+        log.debug("native module %s loaded from %s", name, out)
+    except Exception as e:  # noqa: BLE001 — fall back to Python
+        log.info("native module %s unavailable (%s); using Python path",
+                 name, e)
+        lib = None
+    _cache[name] = lib
+    return lib
