@@ -13,7 +13,6 @@ Parity:
 from __future__ import annotations
 
 import logging
-import threading
 
 from aiohttp import web
 
@@ -28,38 +27,36 @@ def _state(request: web.Request):
     return request.app[STATE_KEY]
 
 
-_whisper_lock = threading.Lock()
-
-
 def _whisper_for(state, name: str):
-    """name → loaded WhisperModel, cached on AppState (the analogue of
-    ModelManager.get for the transcription modality)."""
-    from localai_tpu.models import whisper as wh
+    """name → whisper AudioServingModel through the ModelManager, so STT
+    models get the same watchdog/eviction/monitor coverage as every other
+    loaded model (no private AppState caches)."""
+    try:
+        return state.manager.get_whisper(name)
+    except FileNotFoundError as e:
+        raise web.HTTPNotFound(text=str(e))
+    except KeyError:
+        # bare refs keep working without a YAML: a debug: preset or an
+        # on-disk checkpoint dir named directly registers a transient
+        # config (previous behavior, now under lifecycle management)
+        from pathlib import Path
 
-    with _whisper_lock:
-        cache = getattr(state, "_whisper_cache", None)
-        if cache is None:
-            cache = state._whisper_cache = {}
-        model = cache.get(name)
-        if model is not None:
-            return model
-        mcfg = state.loader.get(name)
-        ref = (mcfg.model if mcfg else name) or name
-        if ref.startswith("debug:"):
-            model = wh.debug_model()
-        else:
-            from pathlib import Path
+        from localai_tpu.config.model_config import ModelConfig
 
-            for cand in (Path(ref), Path(state.config.model_path) / ref):
-                if (cand / "config.json").exists():
-                    model = wh.load_hf_whisper(cand)
-                    break
-            else:
-                raise web.HTTPNotFound(
-                    text=f"whisper model {ref!r} not found"
-                )
-        cache[name] = model
-        return model
+        resolvable = name.startswith("debug:") or any(
+            (cand / "config.json").exists()
+            for cand in (Path(name), Path(state.config.model_path) / name)
+        )
+        if not resolvable:
+            raise web.HTTPNotFound(text=f"model {name!r} not configured")
+        state.loader.register(ModelConfig(
+            name=name, model=name, backend="whisper",
+            known_usecases=[Usecase.TRANSCRIPT],
+        ))
+        try:
+            return state.manager.get_whisper(name)
+        except FileNotFoundError as e:
+            raise web.HTTPNotFound(text=str(e))
 
 
 def _transcript_model(request: web.Request, name: str) -> str:
@@ -97,10 +94,10 @@ async def transcribe(request: web.Request) -> web.Response:
     state = _state(request)
 
     def run():
-        model = _whisper_for(state, name)
+        sm = _whisper_for(state, name)
         audio = read_wav(audio_bytes)
-        return model.transcribe(
-            audio,
+        return sm.run(
+            "transcribe", audio,
             language=fields.get("language") or None,
             translate=fields.get("translate", "") in ("1", "true"),
         )
@@ -136,21 +133,16 @@ def _tts_params(state, model_name: str) -> tuple[str, float]:
     return voice, speed
 
 
-_vits_lock = threading.Lock()
-
-
 def _vits_for(state, name: str):
-    """name → loaded VitsTTS when the model config points at a vits
-    checkpoint; None → parametric fallback. Cached on AppState like the
-    whisper path. Runs in the executor (weight loads block for seconds)."""
+    """name → VITS AudioServingModel through the ModelManager when the
+    config points at a vits checkpoint; None → parametric fallback. Runs
+    in the executor (weight loads block for seconds)."""
     if not name:
         return None
     mcfg = state.loader.get(name)
     if mcfg is None:
         return None
     ref = mcfg.model or name
-    from pathlib import Path
-
     if ref.startswith("debug:"):
         return None  # debug TTS rides the parametric synth
     if mcfg.backend != "vits":
@@ -161,23 +153,10 @@ def _vits_for(state, name: str):
 
         if detect_backend(ref, state.config.model_path) != "vits":
             return None
-    with _vits_lock:
-        cache = getattr(state, "_vits_cache", None)
-        if cache is None:
-            cache = state._vits_cache = {}
-        model = cache.get(name)
-        if model is None:
-            from localai_tpu.audio.vits import load_hf_vits
-
-            for cand in (Path(ref), Path(state.config.model_path) / ref):
-                if (cand / "config.json").exists():
-                    model = load_hf_vits(cand)
-                    break
-            else:
-                raise web.HTTPNotFound(
-                    text=f"vits model {ref!r} not found")
-            cache[name] = model
-        return model
+    try:
+        return state.manager.get_vits(name)
+    except FileNotFoundError as e:
+        raise web.HTTPNotFound(text=str(e))
 
 
 async def _speak(request: web.Request, text: str, voice: str,
@@ -193,18 +172,24 @@ async def _speak(request: web.Request, text: str, voice: str,
     def run():
         # model resolution + (first-use) weight load happen HERE, on the
         # executor — a multi-second vits load must not block the loop
-        vits = _vits_for(state, model_name)
-        if vits is not None:
+        sm = _vits_for(state, model_name)
+        if sm is not None:
             # neural path (VITS voice checkpoint); `voice` selects the
-            # speaker for multispeaker models
+            # speaker for multispeaker models. Snapshot the model ref
+            # before reading cfg — a concurrent eviction nulls sm.model,
+            # and run() re-raises that case as its designed error.
+            model = sm.model
+            if model is None:
+                raise RuntimeError(f"vits model {sm.name} was evicted")
+            cfg = model.cfg
             spk = None
             if voice.isdigit():
                 spk = int(voice)
-            wav = vits.synthesize(
-                text, speaker_id=spk,
-                speaking_rate=vits.cfg.speaking_rate * speed,
+            wav = sm.run(
+                "synthesize", text, speaker_id=spk,
+                speaking_rate=cfg.speaking_rate * speed,
             )
-            return write_wav(wav, rate=vits.cfg.sampling_rate)
+            return write_wav(wav, rate=cfg.sampling_rate)
         return write_wav(ttsmod.synthesize(text, voice=voice, speed=speed))
 
     data = await _in_executor(request, run)

@@ -163,27 +163,36 @@ async def chat(request: web.Request) -> web.StreamResponse:
         correlation_id=cid,
     )
 
+    async def extra_choice_request(i: int):
+        """Choice i>0 needs a FRESH constraint (FSM state is per-request)
+        — the one shared rebuild path for stream and non-stream n>1."""
+        c = None
+        if tctx is not None:
+            c = (await _in_executor(
+                request, inf.prepare_tools, sm, cfg, req)).constraint
+        elif rf_constraint is not None:
+            c = await _in_executor(
+                request, inf.response_format_constraint, sm, req)
+        return inf.build_gen_request(
+            sm, cfg, req, prompt, constraint=c, seed_offset=i,
+            mm_embeds=mm_embeds, correlation_id=cid,
+        )
+
     if req.stream:
+        n = max(1, req.n or 1)
+        if n > 1 and tctx is None:
+            # every choice streams concurrently on its own index (tool
+            # calls still buffer whole, so they stay single-choice)
+            extra = [await extra_choice_request(i) for i in range(1, n)]
+            return await _chat_stream_n(request, req, sm, [gr] + extra,
+                                        rid, cid)
         return await _chat_stream(request, req, sm, cfg, gr, rid, tctx,
                                   cid=cid)
 
     n = max(1, req.n or 1)
     handles = []
     for i in range(n):
-        if i > 0:
-            c = None
-            if tctx is not None:
-                c = (await _in_executor(
-                    request, inf.prepare_tools, sm, cfg, req)).constraint
-            elif rf_constraint is not None:
-                c = await _in_executor(
-                    request, inf.response_format_constraint, sm, req)
-            gr_i = inf.build_gen_request(
-                sm, cfg, req, prompt, constraint=c, seed_offset=i,
-                mm_embeds=mm_embeds, correlation_id=cid,
-            )
-        else:
-            gr_i = gr
+        gr_i = gr if i == 0 else await extra_choice_request(i)
         handles.append(sm.scheduler.submit(gr_i))
     await _await_handles(request, handles)
     choices = []
@@ -263,6 +272,65 @@ async def _chat_stream(request, req, sm, cfg, gr, rid, tctx, *, cid=""
         rid, req.model, {}, finish_reason=finish,
         usage_dict=sc.usage(handle.prompt_tokens, handle.completion_tokens),
     )))
+    await resp.write(SSE_DONE)
+    await resp.write_eof()
+    return resp
+
+
+async def _chat_stream_n(request, req, sm, grs, rid, cid
+                         ) -> web.StreamResponse:
+    """n>1 plain-chat streaming: all choices decode concurrently through
+    the batching engine, interleaved on the one SSE stream by index."""
+    import asyncio
+
+    headers = dict(SSE_HEADERS)
+    headers["X-Correlation-ID"] = cid
+    resp = web.StreamResponse(headers=headers)
+    await resp.prepare(request)
+    handles = [sm.scheduler.submit(gr) for gr in grs]
+    write_lock = asyncio.Lock()
+    for i in range(len(handles)):
+        await resp.write(sse_event(sc.chat_chunk(
+            rid, req.model, {"role": "assistant", "content": ""}, index=i
+        )))
+
+    async def pump(idx: int, handle) -> None:
+        finish = "stop"
+        async for item in aiter_handle(handle):
+            if item.finish_reason is not None:
+                finish = item.finish_reason
+                break
+            if item.delta:
+                async with write_lock:
+                    await resp.write(sse_event(sc.chat_chunk(
+                        rid, req.model, {"content": item.delta},
+                        index=idx,
+                    )))
+        async with write_lock:
+            await resp.write(sse_event(sc.chat_chunk(
+                rid, req.model, {}, finish_reason=finish, index=idx,
+            )))
+
+    tasks = [asyncio.ensure_future(pump(i, h))
+             for i, h in enumerate(handles)]
+    try:
+        await asyncio.gather(*tasks)
+    except BaseException:
+        for t in tasks:
+            t.cancel()
+        for h in handles:
+            h.cancel()
+        await asyncio.gather(*tasks, return_exceptions=True)
+        raise
+    # ONE usage frame for the whole request (prompt tokens counted once —
+    # per-choice usage would n-fold-overcount for metering clients)
+    usage_frame = sc.chat_chunk(rid, req.model, {})
+    usage_frame["choices"] = []
+    usage_frame["usage"] = sc.usage(
+        handles[0].prompt_tokens,
+        sum(h.completion_tokens for h in handles),
+    )
+    await resp.write(sse_event(usage_frame))
     await resp.write(SSE_DONE)
     await resp.write_eof()
     return resp

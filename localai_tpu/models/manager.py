@@ -239,6 +239,59 @@ class EmbeddingServingModel:
         return out, total
 
 
+@dataclasses.dataclass
+class AudioServingModel:
+    """A loaded whisper or VITS model under lifecycle management —
+    idle/busy watchdog, eviction, /backend/monitor visibility (the same
+    contract the image pipelines got in round 2; the audio caches used to
+    live in private AppState dicts outside the manager)."""
+
+    name: str
+    config: ModelConfig
+    model: Any                        # WhisperModel | VitsTTS
+    kind: str = "whisper"             # "whisper" | "vits"
+    loaded_at: float = dataclasses.field(default_factory=time.monotonic)
+    last_used: float = dataclasses.field(default_factory=time.monotonic)
+    _inflight: int = 0
+    _lock: threading.Lock = dataclasses.field(default_factory=threading.Lock)
+    served: int = 0
+
+    def touch(self) -> None:
+        self.last_used = time.monotonic()
+
+    @property
+    def busy(self) -> bool:
+        with self._lock:
+            return self._inflight > 0
+
+    def alive(self) -> bool:
+        return self.model is not None
+
+    def close(self) -> None:
+        self.model = None
+
+    def engine_metrics(self) -> dict:
+        return {"type": self.kind, "requests_served": self.served}
+
+    def run(self, fn_name: str, *args, **kwargs):
+        """Invoke a model method with busy accounting (watchdog-visible);
+        snapshots the model ref so a concurrent eviction can't null it
+        mid-request."""
+        model = self.model
+        if model is None:
+            raise RuntimeError(f"{self.kind} model {self.name} was evicted")
+        with self._lock:
+            self._inflight += 1
+        try:
+            out = getattr(model, fn_name)(*args, **kwargs)
+        finally:
+            with self._lock:
+                self._inflight -= 1
+        self.served += 1
+        self.touch()
+        return out
+
+
 def build_runner(mcfg: ModelConfig, app: AppConfig) -> tuple[Any, ModelRunner]:
     """Config → (resolved model, live ModelRunner): weights, mesh,
     shardings. Shared by the serving path and multi-host followers — a
@@ -427,6 +480,14 @@ class ModelManager:
         """Load-or-get a bert-class sentence encoder (same contract)."""
         return self._get_typed(name, self._load_embedder, kind="embed")
 
+    def get_whisper(self, name: str) -> AudioServingModel:
+        """Load-or-get a whisper STT model (same lifecycle contract)."""
+        return self._get_typed(name, self._load_whisper, kind="whisper")
+
+    def get_vits(self, name: str) -> AudioServingModel:
+        """Load-or-get a VITS voice (same lifecycle contract)."""
+        return self._get_typed(name, self._load_vits, kind="vits")
+
     def is_embedder(self, mcfg: ModelConfig) -> bool:
         """Route /v1/embeddings to the sentence encoder for bert-class
         checkpoints (backend: bert-embeddings, set explicitly or by
@@ -499,6 +560,7 @@ class ModelManager:
                 "image" if isinstance(sm, ImageServingModel)
                 else "rerank" if isinstance(sm, RerankServingModel)
                 else "embed" if isinstance(sm, EmbeddingServingModel)
+                else sm.kind if isinstance(sm, AudioServingModel)
                 else "llm"
             )
             if cached_kind != kind:
@@ -601,6 +663,45 @@ class ModelManager:
                  time.monotonic() - t0)
         return EmbeddingServingModel(name=mcfg.name, config=mcfg,
                                      encoder=enc)
+
+    def _load_whisper(self, mcfg: ModelConfig) -> AudioServingModel:
+        from pathlib import Path
+
+        from localai_tpu.models import whisper as wh
+
+        ref = mcfg.model or mcfg.name
+        t0 = time.monotonic()
+        if ref.startswith("debug:"):
+            model = wh.debug_model()
+        else:
+            for cand in (Path(ref), Path(self.app.model_path) / ref):
+                if (cand / "config.json").exists():
+                    model = wh.load_hf_whisper(cand)
+                    break
+            else:
+                raise FileNotFoundError(f"whisper model {ref!r} not found")
+        log.info("loaded whisper %s in %.1fs", mcfg.name,
+                 time.monotonic() - t0)
+        return AudioServingModel(name=mcfg.name, config=mcfg, model=model,
+                                 kind="whisper")
+
+    def _load_vits(self, mcfg: ModelConfig) -> AudioServingModel:
+        from pathlib import Path
+
+        from localai_tpu.audio.vits import load_hf_vits
+
+        ref = mcfg.model or mcfg.name
+        t0 = time.monotonic()
+        for cand in (Path(ref), Path(self.app.model_path) / ref):
+            if (cand / "config.json").exists():
+                model = load_hf_vits(cand)
+                break
+        else:
+            raise FileNotFoundError(f"vits model {ref!r} not found")
+        log.info("loaded vits voice %s in %.1fs", mcfg.name,
+                 time.monotonic() - t0)
+        return AudioServingModel(name=mcfg.name, config=mcfg, model=model,
+                                 kind="vits")
 
     def _load_reranker(self, mcfg: ModelConfig) -> RerankServingModel:
         from localai_tpu.models.reranker import resolve_reranker

@@ -376,6 +376,7 @@ def test_completions_streaming_list_prompt_serves_all(client):
     (previously only templated[0] streamed and the rest silently dropped)."""
     seen = {}
     finishes = {}
+    usage = None
     with client.stream("POST", "/v1/completions", json={
         "model": "tiny",
         "prompt": ["alpha", "beta"],
@@ -419,3 +420,40 @@ def test_correlation_id_echoed_and_traced(client):
         "max_tokens": 4,
     })
     assert r2.headers.get("X-Correlation-ID", "").startswith("chatcmpl-")
+
+
+def test_chat_streaming_n_choices(client):
+    """stream + n>1: every choice streams on its own index and finishes."""
+    finishes = {}
+    usage = None
+    roles = set()
+    with client.stream("POST", "/v1/chat/completions", json={
+        "model": "tiny",
+        "messages": [{"role": "user", "content": "variants"}],
+        "max_tokens": 5,
+        "n": 3,
+        "stream": True,
+    }) as r:
+        assert r.status_code == 200
+        for line in r.iter_lines():
+            if not line.startswith("data: "):
+                continue
+            payload = line[len("data: "):]
+            if payload == "[DONE]":
+                break
+            frame = json.loads(payload)
+            if not frame["choices"]:
+                usage = frame.get("usage")
+                continue
+            ch = frame["choices"][0]
+            if ch["delta"].get("role"):
+                roles.add(ch["index"])
+            if ch["finish_reason"] is not None:
+                finishes[ch["index"]] = ch["finish_reason"]
+    assert set(finishes) == {0, 1, 2}
+    assert roles == {0, 1, 2}
+    assert all(f in ("stop", "length") for f in finishes.values())
+    # one usage frame, prompt tokens counted once
+    assert usage is not None
+    assert usage["completion_tokens"] <= 15
+    assert 0 < usage["prompt_tokens"] < 40

@@ -185,3 +185,46 @@ def test_audio_worker_grpc(tmp_path):
         c.close()
     finally:
         server.stop(grace=None)
+
+
+def test_audio_models_under_lifecycle_management(tmp_path):
+    """Whisper/VITS models load through the ModelManager: they appear in
+    loaded_names, expose metrics, and evict like every other model (the
+    round-2 image-cache criticism, applied to audio)."""
+    import json
+
+    import httpx
+    from test_api import _ServerThread, make_state
+
+    (tmp_path / "w.yaml").write_text(
+        "name: w\nmodel: 'debug:whisper-tiny'\nbackend: whisper\n"
+        "known_usecases: [transcript]\n"
+    )
+    srv = _ServerThread(make_state(tmp_path))
+    try:
+        import io
+        import wave
+
+        import numpy as np
+
+        buf = io.BytesIO()
+        with wave.open(buf, "wb") as wf:
+            wf.setnchannels(1)
+            wf.setsampwidth(2)
+            wf.setframerate(16000)
+            wf.writeframes(np.zeros(16000, np.int16).tobytes())
+        with httpx.Client(base_url=srv.base, timeout=300.0) as c:
+            r = c.post("/v1/audio/transcriptions",
+                       files={"file": ("a.wav", buf.getvalue())},
+                       data={"model": "w"})
+            assert r.status_code == 200, r.text
+        assert "w" in srv.state.manager.loaded_names()
+        sm = srv.state.manager.get_whisper("w")
+        m = sm.engine_metrics()
+        assert m["type"] == "whisper"
+        assert m["requests_served"] == 1
+        # manager-level eviction works
+        assert srv.state.manager.shutdown_model("w")
+        assert "w" not in srv.state.manager.loaded_names()
+    finally:
+        srv.stop()
