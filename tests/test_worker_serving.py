@@ -67,7 +67,7 @@ def test_worker_backend_serving_and_crash_isolation(tmp_path):
             prompt=sm.tokenizer.encode("hello"), max_new_tokens=4,
             temperature=0.0,
         ))
-        h.result(timeout=120)
+        h.result(timeout=240)
         assert h.finish_reason in ("stop", "length")
         first_text = h.text
 
@@ -88,7 +88,7 @@ def test_worker_backend_serving_and_crash_isolation(tmp_path):
                 wp.proc.kill()
                 killed = True
         assert killed
-        h2.result(timeout=60)
+        h2.result(timeout=120)
         assert h2.finish_reason == "error"
 
         # next request: manager respawns (alive() is false) and serves
@@ -97,7 +97,7 @@ def test_worker_backend_serving_and_crash_isolation(tmp_path):
             prompt=sm2.tokenizer.encode("hello"), max_new_tokens=4,
             temperature=0.0,
         ))
-        h3.result(timeout=120)
+        h3.result(timeout=240)
         assert h3.finish_reason in ("stop", "length")
         assert h3.text == first_text  # deterministic greedy, same engine cfg
     finally:
@@ -127,7 +127,7 @@ def test_external_backend_routing(tmp_path):
             prompt=sm.tokenizer.encode("hi"), max_new_tokens=4,
             temperature=0.0,
         ))
-        h.result(timeout=120)
+        h.result(timeout=240)
         assert h.finish_reason in ("stop", "length")
         # no process was spawned by the manager's own pool
         assert "wtiny" not in mgr.pool()._workers
