@@ -16,6 +16,14 @@ llama.cpp-CUDA-class serving of a 1B model at batch 8 (~100 tok/s/stream).
 The reference itself publishes no numbers (BASELINE.md), so this constant is
 the stand-in target until a measured reference run exists; it is held fixed
 across rounds so the trend is comparable.
+
+Round-3 measurement (for the record, in case the end-of-round run hits
+tunnel trouble): 1246.37 tok/s = 1.558x with the int8 default on the real
+chip (2026-07-30, before a multi-hour axon tunnel outage that began
+~07:30 UTC). Sweeps the same day: bf16 1180 (int8 +6% — decode is NOT
+purely weight-bandwidth-bound on this tunneled chip), multi_step 16/32/64
+within noise (1234/1246/1261), so the next lever is on-device per-step
+work (attention over padded KV / sampling), not dispatch amortization.
 """
 
 import json
