@@ -157,7 +157,7 @@ class EngineConfig(BaseModel):
     )
     dtype: str = "bfloat16"           # compute/weight dtype
     kv_dtype: str = "bfloat16"        # KV-cache dtype (int8 supported)
-    quantization: Optional[str] = None  # e.g. "int8" weight-only
+    quantization: Optional[str] = None  # "int8" | "int8_w8a8" | "int4"
     donate_kv: bool = True            # buffer donation for in-place KV updates
     decode_steps_per_dispatch: int = 16  # tokens per dispatch (lax.scan) —
                                       # amortizes host→device RTT; lower it

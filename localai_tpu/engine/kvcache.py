@@ -97,13 +97,17 @@ def init_cache(
 
 
 
-def decode_write(positions: jax.Array):
+def decode_write(positions: jax.Array, raw: bool = False):
     """KV write policy for batched single-token decode.
 
     positions: [S] — write location per slot. Returns a ``kv_write`` closure
     for models.llama.forward: writes k/v_new [S, 1, H, hd] at
     cache[s, :, positions[s]] and exposes the full per-layer cache as keys
-    ([S, H, C, hd])."""
+    ([S, H, C, hd]).
+
+    ``raw=True`` (int8 cache + Pallas decode kernel): keys/values are passed
+    through as ``(int8 cache, f32 scales)`` tuples — dequantization happens
+    inside the flash kernel, so no [S, H, C, hd] bf16 copy is ever built."""
 
     def write(layer_kv, k_new, v_new):
         dt = k_new.dtype
@@ -118,9 +122,12 @@ def decode_write(positions: jax.Array):
             new_v = v_layer.at[s, :, positions].set(vq)
             new_ks = ks_layer.at[s, :, positions].set(ks)
             new_vs = vs_layer.at[s, :, positions].set(vs)
+            new_kv = (new_k, new_v, new_ks, new_vs)
+            if raw:
+                return new_kv, (new_k, new_ks), (new_v, new_vs)
             keys = new_k.astype(dt) * new_ks[..., None].astype(dt)
             values = new_v.astype(dt) * new_vs[..., None].astype(dt)
-            return (new_k, new_v, new_ks, new_vs), keys, values
+            return new_kv, keys, values
         k_layer, v_layer = layer_kv  # [S, H, C, hd]
         kdt = k_layer.dtype
         new_k = k_layer.at[s, :, positions].set(k_new[:, 0].astype(kdt))

@@ -111,17 +111,11 @@ class ModelRunner:
                 cfg.hd, max_ctx,
             )
             self.attn_impl = "xla"
-        # int8 KV: the dequant epilogue fuses into the XLA attention consumer
-        # but would materialize a full bf16 cache copy in front of the Pallas
-        # decode kernel (custom calls don't fuse) — keep XLA for decode.
-        # Prefill attends over the raw chunk, so it keeps the flash kernel.
+        # int8 KV rides the same flash decode kernel: per-position scales
+        # fuse into the online-softmax loop (ops.attention), so the default
+        # quantized config is both length-aware (block-skip past each slot's
+        # frontier) and half-bandwidth — no XLA fallback, no bf16 cache copy.
         self.decode_attn_impl = self.attn_impl
-        if self.attn_impl == "pallas" and jnp.dtype(kv_dtype) == jnp.int8:
-            log.info(
-                "attention: int8 KV cache; decode uses the fused XLA path "
-                "(prefill keeps Pallas flash)"
-            )
-            self.decode_attn_impl = "xla"
         self.num_slots = num_slots
         self.max_ctx = max_ctx or cfg.max_position_embeddings
         self.mesh = mesh
@@ -221,6 +215,7 @@ class ModelRunner:
         cfg = self.cfg
         pos = state.positions
         attn = None
+        raw_kv = self.decode_attn_impl == "pallas" and kv.quantized
         if self.decode_attn_impl == "pallas":
             from localai_tpu import ops
 
@@ -235,23 +230,31 @@ class ModelRunner:
                 # per-device kernel over (slots/'data', heads/'model'):
                 # decode attention is independent across slots and head
                 # groups, so the shard_map body is the single-device kernel
+                in_specs = [P("data", "model", None),
+                            P("data", "model", None, None),
+                            P("data", "model", None, None),
+                            P("data")]
+                if raw_kv:
+                    in_specs += [P("data", "model", None),
+                                 P("data", "model", None)]
                 kernel = jax.shard_map(
                     kernel,
                     mesh=self.mesh,
-                    in_specs=(P("data", "model", None),
-                              P("data", "model", None, None),
-                              P("data", "model", None, None),
-                              P("data")),
+                    in_specs=tuple(in_specs),
                     out_specs=P("data", "model", None),
                     check_vma=False,
                 )
 
             def attn(q, keys, values, _mask):  # q [S,1,Hq,hd], keys [S,Hkv,C,hd]
-                out = kernel(q[:, 0], keys, values, pos)
+                if raw_kv:  # (int8 cache, f32 scales) — fused dequant
+                    out = kernel(q[:, 0], keys[0], values[0], pos,
+                                 keys[1], values[1])
+                else:
+                    out = kernel(q[:, 0], keys, values, pos)
                 return out[:, None]
 
         mask = kvc.decode_mask(cfg, pos, self.max_ctx)
-        write = kvc.decode_write(pos)
+        write = kvc.decode_write(pos, raw=raw_kv)
         hidden, new_stack = mdl.forward(
             cfg, params, state.tokens[:, None], pos[:, None],
             write, kv.stacked(), mask, self.rope, attn=attn,

@@ -54,7 +54,14 @@ class QuantizedTensor:
                     MXU runs a native int8×int8→int32 dot: the weight stays
                     int8 all the way from HBM to the systolic array (the
                     full 2× bandwidth + int8-MXU win; adds per-token
-                    activation rounding error).
+                    activation rounding error);
+           'w4'   — group-wise int4 weight-only (native jnp.int4 storage —
+                    XLA packs two nibbles per byte in HBM, halving the int8
+                    read again). scale keeps the contraction axis at
+                    K/group size, one scale per (group, output channel) —
+                    the GPTQ/q4 granularity (parity: the reference's
+                    default q4 GGUF, aio/cpu/text-to-text.yaml, and its
+                    autogptq/exllama2 backends) without block bookkeeping.
     """
 
     q: jax.Array
@@ -70,6 +77,13 @@ class QuantizedTensor:
     def dtype(self):
         return self.q.dtype
 
+    @property
+    def group(self) -> int:
+        """Contraction-axis group size (w4 modes); 0 for per-channel int8."""
+        if self.mode not in ("w4",):
+            return 0
+        return self.q.shape[self.axis] // self.scale.shape[self.axis]
+
 
 def quantize_tensor(w, axis: int) -> QuantizedTensor:
     """Symmetric per-channel int8: scale = amax|w| / 127 over ``axis``."""
@@ -80,6 +94,46 @@ def quantize_tensor(w, axis: int) -> QuantizedTensor:
         jnp.round(wf / jnp.expand_dims(scale, axis)), -127, 127
     ).astype(jnp.int8)
     return QuantizedTensor(q=q, scale=scale, axis=axis)
+
+
+def _group_size(K: int, group: int) -> int:
+    """Largest divisor of K that is ≤ group (small debug dims stay exact)."""
+    g = min(K, group)
+    while K % g:
+        g -= 1
+    return g
+
+
+def quantize_tensor4(w, axis: int, group: int = 128) -> QuantizedTensor:
+    """Symmetric group-wise int4: the contraction axis splits into groups of
+    ``group``; scale = amax|w| / 7 per (group, output channel). q is native
+    jnp.int4 in [-7, 7]; scale keeps the axis at size K/group."""
+    wf = jnp.asarray(w).astype(jnp.float32)
+    shape = wf.shape
+    K = shape[axis]
+    g = _group_size(K, group)
+    gc = K // g
+    grouped = wf.reshape(shape[:axis] + (gc, g) + shape[axis + 1:])
+    amax = jnp.max(jnp.abs(grouped), axis=axis + 1)        # [..., gc, ...]
+    scale = jnp.maximum(amax, 1e-8) / 7.0
+    q = jnp.clip(
+        jnp.round(grouped / jnp.expand_dims(scale, axis + 1)), -7, 7
+    ).astype(jnp.int4)
+    return QuantizedTensor(
+        q=q.reshape(shape), scale=scale, axis=axis, mode="w4"
+    )
+
+
+def _grouped_dequant(qt: QuantizedTensor, dtype) -> jax.Array:
+    """w4 dequant to ``dtype``: expand scale over its groups."""
+    shape = qt.q.shape
+    gc = qt.scale.shape[qt.axis]
+    g = shape[qt.axis] // gc
+    grouped = qt.q.reshape(
+        shape[:qt.axis] + (gc, g) + shape[qt.axis + 1:]
+    ).astype(dtype)
+    out = grouped * jnp.expand_dims(qt.scale, qt.axis + 1).astype(dtype)
+    return out.reshape(shape)
 
 
 def quantize_lastdim(x: jax.Array) -> tuple[jax.Array, jax.Array]:
@@ -113,9 +167,19 @@ def matmul(x: jax.Array, w) -> jax.Array:
     x @ (q * scale) with the scale factored out of the contraction.
     'w8a8': x is dynamically quantized per token and the dot runs on the
     int8 MXU path; both scales are applied to the int32 accumulator.
+    'w4': group-wise scales can't factor out of the whole contraction, so
+    the dot runs per group (a [Gc]-batched matmul with G-deep contractions
+    — still MXU-shaped at G=128) and the scaled partials sum.
     """
     if not isinstance(w, QuantizedTensor):
         return x @ w
+    if w.mode == "w4":
+        K, N = w.q.shape[-2], w.q.shape[-1]
+        gc = w.scale.shape[-2]
+        wg = w.q.reshape(gc, K // gc, N).astype(x.dtype)
+        xg = x.reshape(*x.shape[:-1], gc, K // gc)
+        acc = jnp.einsum("...gk,gkn->...gn", xg, wg)
+        return (acc * w.scale.astype(x.dtype)).sum(-2)
     if w.mode == "w8a8":
         xq, xs = _quant_activations(x)
         acc = _int8_dot(xq, w.q, transpose_w=False).astype(jnp.float32)
@@ -128,6 +192,9 @@ def matmul_t(x: jax.Array, w) -> jax.Array:
     column scales under the transpose, so the factoring still holds."""
     if not isinstance(w, QuantizedTensor):
         return x @ w.T.astype(x.dtype)
+    # no 'w4' branch: quantize_params keeps embedding tables per-row int8
+    # even in int4 mode (gather + tied-logits exactness; ~2% of 4-bit 8B),
+    # so a w4 table can never reach the transposed path
     if w.mode == "w8a8":
         xq, xs = _quant_activations(x)
         acc = _int8_dot(xq, w.q, transpose_w=True).astype(jnp.float32)
@@ -150,7 +217,8 @@ _LAYER_AXES = {
 }
 
 
-def quantize_params(params: PyTree, mode: str = "int8") -> PyTree:
+def quantize_params(params: PyTree, mode: str = "int8",
+                    group: int = 128) -> PyTree:
     """Quantize a llama param pytree's matmul weights in place of bf16.
 
     embed is quantized per-row (axis=-1) so both the gather and the
@@ -158,18 +226,28 @@ def quantize_params(params: PyTree, mode: str = "int8") -> PyTree:
     output column (axis=0). Stacked layer weights [L, K, N] quantize over
     K (axis=1) so scales stack [L, N] and scan alongside the weights.
 
-    mode: 'int8' (weight-only) or 'int8_w8a8' (+ dynamic activation quant,
-    native int8 MXU dot — the faster serving default; see QuantizedTensor).
+    mode: 'int8' (weight-only), 'int8_w8a8' (+ dynamic activation quant,
+    native int8 MXU dot), or 'int4' (group-wise int4 weight-only, the
+    TPU analogue of the reference's default q4 serving — see
+    QuantizedTensor). For 'int4', layer matmuls go group-wise while embed
+    stays per-row int8: gather accuracy is cheap (int8 embed is 2% of 4-bit
+    8B total) and the tied-logits path keeps its exact per-channel form.
     """
-    if mode not in ("int8", "int8_w8a8"):
+    if mode not in ("int8", "int8_w8a8", "int4"):
         raise ValueError(f"unsupported quantization mode {mode!r}")
-    mm_mode = "w8a8" if mode == "int8_w8a8" else "w8"
 
-    def qt(w, axis):
-        return dataclasses.replace(quantize_tensor(w, axis), mode=mm_mode)
+    if mode == "int4":
+        def qt(w, axis):
+            return quantize_tensor4(w, axis, group=group)
+    else:
+        mm_mode = "w8a8" if mode == "int8_w8a8" else "w8"
+
+        def qt(w, axis):
+            return dataclasses.replace(quantize_tensor(w, axis), mode=mm_mode)
 
     out = dict(params)
-    out["embed"] = qt(params["embed"], axis=1)
+    out["embed"] = (quantize_tensor(params["embed"], axis=1)
+                    if mode == "int4" else qt(params["embed"], axis=1))
     if "lm_head" in params:
         out["lm_head"] = qt(params["lm_head"], axis=0)
     layers = dict(params["layers"])
@@ -181,14 +259,20 @@ def quantize_params(params: PyTree, mode: str = "int8") -> PyTree:
 
 
 def dequantize_tensor(qt: QuantizedTensor, dtype="float32") -> jax.Array:
+    if qt.mode == "w4":
+        return _grouped_dequant(qt, dtype)
     return qt.q.astype(dtype) * jnp.expand_dims(qt.scale, qt.axis).astype(dtype)
 
 
-def quantized_spec(qt_path_spec, axis: int):
-    """Derive the scale PartitionSpec from the weight spec by dropping the
-    contracted axis (used by parallel.sharding for quantized params)."""
+def quantized_spec(qt_path_spec, axis: int, grouped: bool = False):
+    """Derive the scale PartitionSpec from the weight spec: drop the
+    contracted axis (per-channel int8) or keep it (group-wise w4 — the
+    scale's group axis tiles the weight's contraction axis, so it shards
+    the same way when divisible; parallel.sharding sanitizes the rest)."""
     from jax.sharding import PartitionSpec as P
 
+    if grouped:
+        return P(*qt_path_spec)
     entries = list(qt_path_spec)
     # P shorter than rank means trailing dims replicated; pad first
     while len(entries) < axis + 1:

@@ -48,24 +48,59 @@ def _pick_block(total: int, target: int) -> int:
     return b
 
 
+def _pick_block_aligned(total: int, target: int) -> int:
+    """Like _pick_block, but when ``total`` is 128-divisible the block is
+    too, so every dynamic DMA offset (i·block) stays lane/sublane-aligned
+    for Mosaic (e.g. total=640: plain _pick_block gives 320 — offset 320 is
+    not 128-aligned; this gives 128). Unaligned totals only reach the
+    kernels in interpret mode (the runner gates max_ctx%128 on hardware)."""
+    if total % 128:
+        return _pick_block(total, target)
+    b = (min(total, max(target, 128)) // 128) * 128
+    while total % b:
+        b -= 128
+    return b
+
+
 def _flash_loop(q, kv_slice, kbuf, vbuf, ksem, vsem, lo, nb, block_k,
-                mask_for_block):
+                mask_for_block, scales=None):
     """Online-softmax loop over KV blocks [lo, nb) with double-buffered DMA.
 
     q: [rows, hd] f32 (pre-scaled). ``kv_slice(hbm_ref, i)`` yields the
     [block_k, hd] HBM slice for block i; ``mask_for_block(i)`` the
     [rows or 1, block_k] keep-mask. Returns the attention output [rows, hd].
+
+    ``scales`` fuses scaled-int8 KV dequantization into the loop:
+    (ks_slice, vs_slice, ksbuf, vsbuf, kssem, vssem) where the slice fns
+    yield the [block_k] f32 per-position scale rows. The dequant never
+    materializes K/V in bf16 — per-position K scales distribute over the
+    score matmul columns (q·(k·s) = (q·k)·s) and V scales over the
+    probability columns (p@(v·s) = (p·s)@v), so both apply as [1, block_k]
+    row multiplies on the VPU while the MXU matmuls stay int8-sourced.
     """
     k_hbm, v_hbm = kv_slice
     rows, hd = q.shape
+    quantized = scales is not None
+    if quantized:
+        ks_hbm, vs_hbm, ksbuf, vsbuf, kssem, vssem = scales
 
     def start(i, slot):
         pltpu.make_async_copy(k_hbm(i), kbuf.at[slot], ksem.at[slot]).start()
         pltpu.make_async_copy(v_hbm(i), vbuf.at[slot], vsem.at[slot]).start()
+        if quantized:
+            pltpu.make_async_copy(
+                ks_hbm(i), ksbuf.at[slot], kssem.at[slot]).start()
+            pltpu.make_async_copy(
+                vs_hbm(i), vsbuf.at[slot], vssem.at[slot]).start()
 
     def wait(i, slot):
         pltpu.make_async_copy(k_hbm(i), kbuf.at[slot], ksem.at[slot]).wait()
         pltpu.make_async_copy(v_hbm(i), vbuf.at[slot], vsem.at[slot]).wait()
+        if quantized:
+            pltpu.make_async_copy(
+                ks_hbm(i), ksbuf.at[slot], kssem.at[slot]).wait()
+            pltpu.make_async_copy(
+                vs_hbm(i), vsbuf.at[slot], vssem.at[slot]).wait()
 
     start(lo, 0)
 
@@ -81,11 +116,17 @@ def _flash_loop(q, kv_slice, kbuf, vbuf, ksem, vsem, lo, nb, block_k,
         k = kbuf[slot].astype(jnp.float32)
         v = vbuf[slot].astype(jnp.float32)
         s = q @ k.T  # [rows, block_k] — MXU
+        if quantized:
+            s = s * ksbuf[slot][None, :]
         s = jnp.where(mask_for_block(i), s, _NEG_INF)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)
         alpha = jnp.exp(m - m_new)
+        # denominator sums the raw probabilities; V scales touch only the
+        # weighted-value numerator
         l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        if quantized:
+            p = p * vsbuf[slot][None, :]
         acc_new = acc * alpha + p @ v
         return m_new, l_new, acc_new
 
@@ -101,11 +142,17 @@ def _flash_loop(q, kv_slice, kbuf, vbuf, ksem, vsem, lo, nb, block_k,
 # ---------------------------------------------------------------------------
 
 
-def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref,
-                   kbuf, vbuf, ksem, vsem, *, block_k: int,
-                   sm_scale: float, sliding_window: Optional[int]):
+def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, *rest, block_k: int,
+                   sm_scale: float, sliding_window: Optional[int],
+                   quantized: bool):
     # k_ref/v_ref are the FULL [S, Hkv, C, hd] cache in HBM (Mosaic only
-    # allows whole-array ANY refs); slot/head are picked in the DMA slice
+    # allows whole-array ANY refs); slot/head are picked in the DMA slice.
+    # When quantized, ks/vs_ref are the [S, Hkv, C] f32 per-position scales.
+    if quantized:
+        (ks_ref, vs_ref, o_ref,
+         kbuf, vbuf, ksbuf, vsbuf, ksem, vsem, kssem, vssem) = rest
+    else:
+        o_ref, kbuf, vbuf, ksem, vsem = rest
     s_idx = pl.program_id(0)
     h_idx = pl.program_id(1)
     pos = pos_ref[s_idx]
@@ -120,6 +167,9 @@ def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref,
     def slice_of(ref):
         return lambda i: ref.at[s_idx, h_idx, pl.ds(i * block_k, block_k), :]
 
+    def scale_slice_of(ref):
+        return lambda i: ref.at[s_idx, h_idx, pl.ds(i * block_k, block_k)]
+
     def mask_for_block(i):
         idx = i * block_k + lax.broadcasted_iota(jnp.int32, (1, block_k), 1)
         keep = idx <= pos
@@ -127,8 +177,13 @@ def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref,
             keep &= idx > pos - sliding_window
         return keep
 
+    scales = None
+    if quantized:
+        scales = (scale_slice_of(ks_ref), scale_slice_of(vs_ref),
+                  ksbuf, vsbuf, kssem, vssem)
     out = _flash_loop(q, (slice_of(k_ref), slice_of(v_ref)),
-                      kbuf, vbuf, ksem, vsem, lo, nb, block_k, mask_for_block)
+                      kbuf, vbuf, ksem, vsem, lo, nb, block_k, mask_for_block,
+                      scales=scales)
     o_ref[0, 0] = out.astype(o_ref.dtype)
 
 
@@ -137,44 +192,60 @@ def decode_attention(
     k_cache: jax.Array,      # [S, Hkv, C, hd] head-major slot cache
     v_cache: jax.Array,      # [S, Hkv, C, hd]
     positions: jax.Array,    # [S] i32 — current token's KV write position
+    k_scale: Optional[jax.Array] = None,  # [S, Hkv, C] f32 (scaled-int8 KV)
+    v_scale: Optional[jax.Array] = None,
     *,
     sliding_window: Optional[int] = None,
     block_k: int = 512,
     interpret: bool = False,
 ) -> jax.Array:
-    """Flash GQA decode attention over the slot cache. Returns [S, Hq, hd]."""
+    """Flash GQA decode attention over the slot cache. Returns [S, Hq, hd].
+
+    With ``k_scale``/``v_scale`` the cache is scaled int8 and dequantization
+    fuses into the flash loop (scores/probs column scaling) — decode reads
+    half the KV bytes of bf16 and never materializes a dequantized cache.
+    """
     S, Hq, hd = q.shape
     Hkv, C = k_cache.shape[1], k_cache.shape[2]
     g = Hq // Hkv
-    bk = _pick_block(C, block_k)
+    bk = _pick_block_aligned(C, block_k)
     qg = q.reshape(S, Hkv, g, hd)
+    quantized = k_scale is not None
 
     kernel = functools.partial(
         _decode_kernel, block_k=bk, sm_scale=hd ** -0.5,
-        sliding_window=sliding_window,
+        sliding_window=sliding_window, quantized=quantized,
     )
+    in_specs = [
+        # SMEM blocks must cover the whole array; index by slot inside
+        pl.BlockSpec((S,), lambda s, h: (0,), memory_space=pltpu.SMEM),
+        pl.BlockSpec((1, 1, g, hd), lambda s, h: (s, h, 0, 0)),
+        # K/V stay whole in HBM (ANY refs must be unblocked); the
+        # kernel DMAs block_k slices per (slot, head) itself
+        pl.BlockSpec(memory_space=pl.ANY),
+        pl.BlockSpec(memory_space=pl.ANY),
+    ]
+    scratch = [
+        pltpu.VMEM((2, bk, hd), k_cache.dtype),
+        pltpu.VMEM((2, bk, hd), v_cache.dtype),
+    ]
+    args = [positions.astype(jnp.int32), qg, k_cache, v_cache]
+    if quantized:
+        in_specs += [pl.BlockSpec(memory_space=pl.ANY),
+                     pl.BlockSpec(memory_space=pl.ANY)]
+        scratch += [pltpu.VMEM((2, bk), jnp.float32),
+                    pltpu.VMEM((2, bk), jnp.float32)]
+        args += [k_scale, v_scale]
+    scratch += [pltpu.SemaphoreType.DMA((2,))] * (4 if quantized else 2)
     out = pl.pallas_call(
         kernel,
         grid=(S, Hkv),
-        in_specs=[
-            # SMEM blocks must cover the whole array; index by slot inside
-            pl.BlockSpec((S,), lambda s, h: (0,), memory_space=pltpu.SMEM),
-            pl.BlockSpec((1, 1, g, hd), lambda s, h: (s, h, 0, 0)),
-            # K/V stay whole in HBM (ANY refs must be unblocked); the
-            # kernel DMAs block_k slices per (slot, head) itself
-            pl.BlockSpec(memory_space=pl.ANY),
-            pl.BlockSpec(memory_space=pl.ANY),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, 1, g, hd), lambda s, h: (s, h, 0, 0)),
         out_shape=jax.ShapeDtypeStruct((S, Hkv, g, hd), q.dtype),
-        scratch_shapes=[
-            pltpu.VMEM((2, bk, hd), k_cache.dtype),
-            pltpu.VMEM((2, bk, hd), v_cache.dtype),
-            pltpu.SemaphoreType.DMA((2,)),
-            pltpu.SemaphoreType.DMA((2,)),
-        ],
+        scratch_shapes=scratch,
         interpret=interpret,
-    )(positions.astype(jnp.int32), qg, k_cache, v_cache)
+    )(*args)
     return out.reshape(S, Hq, hd)
 
 
@@ -238,8 +309,8 @@ def prefill_attention(
     T, Hq, hd = q.shape
     Hkv = k.shape[0]
     g = Hq // Hkv
-    bq = _pick_block(T, block_q)
-    bk = _pick_block(T, block_k)
+    bq = _pick_block_aligned(T, block_q)
+    bk = _pick_block_aligned(T, block_k)
     qg = q.reshape(T, Hkv, g, hd)
 
     kernel = functools.partial(

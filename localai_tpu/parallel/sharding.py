@@ -147,7 +147,8 @@ def shard_params(
     def put(spec_leaf, arr):
         if isinstance(arr, QuantizedTensor):
             s_spec = _sanitize(
-                quantized_spec(spec_leaf, arr.axis), arr.scale.shape, mesh
+                quantized_spec(spec_leaf, arr.axis, grouped=arr.mode == "w4"),
+                arr.scale.shape, mesh,
             )
             return QuantizedTensor(
                 q=jax.device_put(arr.q, NamedSharding(mesh, spec_leaf)),

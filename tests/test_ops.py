@@ -75,3 +75,51 @@ def test_runner_pallas_matches_xla_end_to_end():
             toks.append(int(r.step()[s]))
         outs[impl] = toks
     assert outs["xla"] == outs["pallas_interpret"]
+
+
+@pytest.mark.parametrize("window", [None, 24])
+def test_decode_attention_int8_kv_matches_dequant_xla(window):
+    """Fused int8-KV dequant in the flash decode kernel: scales applied to
+    score/prob columns must equal attention over the dequantized cache."""
+    cfg = _cfg(window=window)
+    S, C = 4, 64
+    rng = np.random.default_rng(2)
+    q = jnp.asarray(rng.normal(size=(S, cfg.num_heads, cfg.hd)), jnp.float32)
+    kq = jnp.asarray(rng.integers(-127, 128, (S, cfg.num_kv_heads, C, cfg.hd)),
+                     jnp.int8)
+    vq = jnp.asarray(rng.integers(-127, 128, (S, cfg.num_kv_heads, C, cfg.hd)),
+                     jnp.int8)
+    ks = jnp.asarray(rng.uniform(0.005, 0.02, (S, cfg.num_kv_heads, C)),
+                     jnp.float32)
+    vs = jnp.asarray(rng.uniform(0.005, 0.02, (S, cfg.num_kv_heads, C)),
+                     jnp.float32)
+    pos = jnp.asarray([0, 5, 31, 63], jnp.int32)
+
+    k = kq.astype(jnp.float32) * ks[..., None]
+    v = vq.astype(jnp.float32) * vs[..., None]
+    ref = mdl._grouped_attn(cfg, q[:, None], k, v,
+                            kvc.decode_mask(cfg, pos, C))[:, 0]
+    out = ops_attn.decode_attention(q, kq, vq, pos, ks, vs,
+                                    sliding_window=window,
+                                    block_k=16, interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_runner_int8_kv_pallas_matches_xla_end_to_end():
+    """int8-KV serving must run the flash decode kernel (no XLA fallback)
+    and agree with the fused-XLA int8 path on greedy output."""
+    model = resolve_model("debug:tiny", dtype="float32")
+    outs = {}
+    for impl in ("xla", "pallas_interpret"):
+        r = ModelRunner(model.cfg, model.params, num_slots=2, max_ctx=64,
+                        prefill_buckets=[16], kv_dtype="int8",
+                        attn_impl=impl)
+        if impl.startswith("pallas"):
+            assert r.decode_attn_impl == "pallas"
+        s = r.acquire_slot()
+        toks = [r.admit(s, list(b"int8 kv parity"), temperature=0.0)]
+        for _ in range(8):
+            toks.append(int(r.step()[s]))
+        outs[impl] = toks
+    assert outs["xla"] == outs["pallas_interpret"]
