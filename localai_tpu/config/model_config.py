@@ -171,6 +171,12 @@ class EngineConfig(BaseModel):
                                       # take the ring-attention prefill when
                                       # the mesh has a 'seq' axis
     attn_impl: str = "auto"           # auto | pallas | pallas_interpret | xla
+    # Speculative decoding (parity: DraftModel/NDraft,
+    # /root/reference/core/config/backend_config.go:143,
+    # backend/backend.proto:210): a small same-vocab model proposes n_draft
+    # tokens per window; the target verifies them in one batched forward.
+    draft_model: Optional[str] = None
+    n_draft: int = 4
 
 
 class DiffusionConfig(BaseModel):

@@ -1,0 +1,163 @@
+"""Disk-persistent prompt KV cache.
+
+Parity: ``prompt_cache_path`` / ``prompt_cache_all`` / ``prompt_cache_ro``
+(/root/reference/core/config/backend_config.go:120-122, proto
+backend.proto:132-138) — llama.cpp persists a session's KV state to a file
+and reloads it to skip recomputing a shared prompt prefix across restarts.
+
+TPU redesign: instead of one mmap'd session file, a directory of npz blobs
+keyed by the sha256 of the cached token sequence, plus an ``index.json``
+mapping key → tokens. On admit, the scheduler looks up the entry with the
+longest common prefix against the incoming prompt and loads its KV rows
+straight into the slot cache (``ModelRunner.load_prefix``); the existing
+suffix-prefill path then computes only the tail — the disk tier simply
+feeds the same prefix-reuse machinery the in-memory resident records use
+(engine/runner.py ``reusable_prefix``). Writes go through tmp+rename so a
+crash never leaves a torn entry; the directory is LRU-capped by mtime.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import logging
+import os
+from pathlib import Path
+from typing import Optional
+
+import numpy as np
+
+log = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class CacheHit:
+    tokens: list[int]       # the stored sequence (resident-record shaped)
+    arrays: dict            # k/v (+ scales) rows for tokens[:n]
+    n: int                  # cached KV rows
+    lcp: int                # usable common prefix vs the looked-up prompt
+
+
+class PromptKVCache:
+    """One directory of (index.json, <key>.npz) entries."""
+
+    def __init__(self, path: str | os.PathLike, *, read_only: bool = False,
+                 max_entries: int = 32, min_prefix: int = 16):
+        self.dir = Path(path)
+        self.read_only = read_only
+        self.max_entries = max_entries
+        self.min_prefix = min_prefix
+        if not self.dir.exists() and not read_only:
+            self.dir.mkdir(parents=True, exist_ok=True)
+        self._index: dict[str, list[int]] = {}
+        self._load_index()
+        # telemetry
+        self.hits = 0
+        self.misses = 0
+        self.stores = 0
+
+    # -- index ------------------------------------------------------------
+
+    def _index_path(self) -> Path:
+        return self.dir / "index.json"
+
+    def _load_index(self) -> None:
+        try:
+            raw = json.loads(self._index_path().read_text())
+            self._index = {k: list(map(int, v)) for k, v in raw.items()}
+        except (OSError, ValueError):
+            self._index = {}
+
+    def _write_index(self) -> None:
+        tmp = self._index_path().with_suffix(".tmp")
+        tmp.write_text(json.dumps(self._index))
+        tmp.replace(self._index_path())
+
+    @staticmethod
+    def _key(tokens: list[int]) -> str:
+        return hashlib.sha256(
+            np.asarray(tokens, np.int64).tobytes()
+        ).hexdigest()[:32]
+
+    # -- public -----------------------------------------------------------
+
+    def lookup(self, prompt: list[int]) -> Optional[CacheHit]:
+        """Entry with the longest common prefix ≥ min_prefix, or None."""
+        best_key, best_tokens, best_lcp = None, None, 0
+        for key, tokens in self._index.items():
+            lcp = 0
+            for a, b in zip(tokens, prompt):
+                if a != b:
+                    break
+                lcp += 1
+            if lcp > best_lcp:
+                best_key, best_tokens, best_lcp = key, tokens, lcp
+        # the last prompt token is always recomputed (its logits seed
+        # sampling), so a full-prompt hit still leaves a 1-token tail
+        best_lcp = min(best_lcp, len(prompt) - 1)
+        if best_key is None or best_lcp < self.min_prefix:
+            self.misses += 1
+            return None
+        path = self.dir / f"{best_key}.npz"
+        try:
+            with np.load(path) as z:
+                arrays = {name: z[name] for name in z.files}
+        except (OSError, ValueError) as e:
+            log.warning("prompt cache entry %s unreadable: %s", best_key, e)
+            self._index.pop(best_key, None)
+            self.misses += 1
+            return None
+        n = int(arrays["k"].shape[2])
+        try:  # LRU touch
+            os.utime(path)
+        except OSError:
+            pass
+        self.hits += 1
+        return CacheHit(tokens=list(best_tokens), arrays=arrays, n=n,
+                        lcp=best_lcp)
+
+    def store(self, tokens: list[int], arrays: dict) -> None:
+        """Persist KV rows for ``tokens[:n]`` (n = arrays['k'].shape[2])."""
+        if self.read_only:
+            return
+        n = int(arrays["k"].shape[2])
+        if n < self.min_prefix:
+            return
+        key = self._key(tokens)
+        if key in self._index:
+            return
+        self.dir.mkdir(parents=True, exist_ok=True)
+        path = self.dir / f"{key}.npz"
+        tmp = self.dir / f".{key}.tmp.npz"
+        with open(tmp, "wb") as f:
+            np.savez(f, **arrays)
+        tmp.replace(path)
+        self._index[key] = list(map(int, tokens))
+        self._write_index()
+        self.stores += 1
+        self._evict()
+
+    def _evict(self) -> None:
+        if len(self._index) <= self.max_entries:
+            return
+        entries = []
+        for key in list(self._index):
+            p = self.dir / f"{key}.npz"
+            try:
+                entries.append((p.stat().st_mtime, key))
+            except OSError:
+                self._index.pop(key, None)
+        entries.sort()
+        for _, key in entries[: len(self._index) - self.max_entries]:
+            (self.dir / f"{key}.npz").unlink(missing_ok=True)
+            self._index.pop(key, None)
+        self._write_index()
+
+    def stats(self) -> dict:
+        return {
+            "entries": len(self._index),
+            "hits": self.hits,
+            "misses": self.misses,
+            "stores": self.stores,
+        }

@@ -757,3 +757,87 @@ class ModelRunner:
 
     def slot_position(self, slot: int) -> int:
         return int(self.state.positions[slot])
+
+    # -- prompt-cache persistence (engine.promptcache) -------------------
+
+    def snapshot_prefix(self, slot: int, n: Optional[int] = None) -> dict:
+        """Device-array snapshot of one slot's first ``n`` KV rows.
+
+        The slices are NEW device buffers enqueued in program order, so the
+        snapshot is consistent even though later dispatches donate and
+        overwrite the cache — callers may hand it to another thread and
+        materialize it there (pack_prefix) without stalling the engine."""
+        p = n if n is not None else self.slot_position(slot)
+        out: dict = {"kv_dtype": str(self.kv_dtype)}
+        out["k"] = self.kv.k[:, slot, :, :p]
+        out["v"] = self.kv.v[:, slot, :, :p]
+        if self.kv.quantized:
+            out["k_scale"] = self.kv.k_scale[:, slot, :, :p]
+            out["v_scale"] = self.kv.v_scale[:, slot, :, :p]
+        return out
+
+    @staticmethod
+    def pack_prefix(snapshot: dict) -> dict:
+        """Materialize a snapshot_prefix result as npz-serializable numpy.
+        bfloat16 rows are stored as uint16 bit-views (numpy's npz format
+        has no native bfloat16); scaled-int8 caches keep their scales."""
+        out: dict = {"kv_dtype": np.asarray(snapshot["kv_dtype"])}
+        for name in ("k", "v", "k_scale", "v_scale"):
+            if name not in snapshot:
+                continue
+            host = np.asarray(snapshot[name])
+            if host.dtype.name == "bfloat16":
+                out[name] = host.view(np.uint16)
+                out[f"{name}_bf16"] = _ONE
+            else:
+                out[name] = host
+        return out
+
+    def export_prefix(self, slot: int, n: Optional[int] = None) -> dict:
+        """snapshot_prefix + pack_prefix in one (synchronous) call."""
+        return self.pack_prefix(self.snapshot_prefix(slot, n))
+
+    def load_prefix(self, slot: int, arrays: dict, n: int) -> bool:
+        """Write exported KV rows into a slot and set its frontier to ``n``
+        (admit() then reuses them via the resident/resume path). Returns
+        False on any mismatch (dtype, shape, context) — callers fall back
+        to a full prefill."""
+        if str(arrays.get("kv_dtype")) != str(self.kv_dtype):
+            return False
+        if n > self.max_ctx - 1:
+            return False
+
+        def unpack(name):
+            host = arrays[name]
+            if f"{name}_bf16" in arrays:
+                import ml_dtypes
+
+                host = host.view(ml_dtypes.bfloat16)
+            return host
+
+        k, v = unpack("k"), unpack("v")
+        L, H, hd = self.cfg.num_layers, self.cfg.num_kv_heads, self.cfg.hd
+        if k.shape != (L, H, n, hd) or v.shape != (L, H, n, hd):
+            return False
+        kv = self.kv
+        new = {
+            "k": kv.k.at[:, slot, :, :n].set(jnp.asarray(k, kv.k.dtype)),
+            "v": kv.v.at[:, slot, :, :n].set(jnp.asarray(v, kv.v.dtype)),
+        }
+        if kv.quantized:
+            if "k_scale" not in arrays or "v_scale" not in arrays:
+                return False
+            new["k_scale"] = kv.k_scale.at[:, slot, :, :n].set(
+                jnp.asarray(arrays["k_scale"], jnp.float32))
+            new["v_scale"] = kv.v_scale.at[:, slot, :, :n].set(
+                jnp.asarray(arrays["v_scale"], jnp.float32))
+        self.kv = KVCache(**new)
+        self.state = dataclasses.replace(
+            self.state,
+            positions=self.state.positions.at[slot].set(n),
+            active=self.state.active.at[slot].set(False),
+        )
+        return True
+
+
+_ONE = np.asarray(1)

@@ -178,9 +178,38 @@ class Scheduler:
 
     def __init__(self, runner: ModelRunner, tokenizer: Any,
                  *, default_max_tokens: int = 2048, pipeline_depth: int = 2,
-                 multi_step: int = 16, stream_latency_target: float = 0.1):
+                 multi_step: int = 16, stream_latency_target: float = 0.1,
+                 spec: Optional[Any] = None,
+                 prompt_cache: Optional[Any] = None,
+                 prompt_cache_all: bool = False):
         self.runner = runner
         self.tokenizer = tokenizer
+        # speculative decoding (engine.speculative.SpecDecoder): when set and
+        # no grammar constraint is active, dispatches run draft+verify
+        # windows instead of plain multi-step decode. Slot lifecycle ops
+        # route through the spec decoder so the draft's state mirrors the
+        # target's. After any non-speculative dispatch the drafts are stale
+        # (missing KV for the plainly-decoded tokens) — _spec_dirty forces a
+        # per-slot draft resync before the next window.
+        self.spec = spec
+        self._spec_dirty = False
+        self._engine = spec if spec is not None else runner
+        # disk prompt-KV persistence (engine.promptcache): looked up when the
+        # in-memory resident record can't cover the prompt; finished slots
+        # store their prefix back (prompt only, or prompt+generation with
+        # prompt_cache_all). Parity: backend_config.go:120-122.
+        self.prompt_cache = prompt_cache
+        self.prompt_cache_all = prompt_cache_all
+        # stores run off-thread: the engine thread only enqueues a device
+        # snapshot (cheap slice dispatches); the writer does the blocking
+        # D2H copy + npz write so completions never stall the decode loop
+        self._pc_queue: "queue.Queue[Optional[tuple]]" = queue.Queue()
+        self._pc_thread: Optional[threading.Thread] = None
+        if prompt_cache is not None and not prompt_cache.read_only:
+            self._pc_thread = threading.Thread(
+                target=self._pc_writer, name="prompt-cache", daemon=True
+            )
+            self._pc_thread.start()
         self.default_max_tokens = default_max_tokens
         self.pipeline_depth = max(1, pipeline_depth)
         # tokens decoded per dispatch (lax.scan inside one program): amortizes
@@ -255,12 +284,35 @@ class Scheduler:
             "prefix_tokens_reused": self.runner.total_prefix_reused,
             "last_dispatch_steps": self.last_dispatch_steps,
             "step_time_ema": self._step_ema,
+            **(
+                {"spec_acceptance_rate": self.spec.acceptance_rate,
+                 "spec_windows": self.spec.total_windows}
+                if self.spec is not None else {}
+            ),
         }
+
+    def _pc_writer(self) -> None:
+        """Writer loop: materialize KV snapshots and persist them."""
+        while True:
+            item = self._pc_queue.get()
+            if item is None:
+                return
+            tokens, snapshot = item
+            try:
+                self.prompt_cache.store(
+                    tokens, self.runner.pack_prefix(snapshot)
+                )
+            except Exception as e:  # noqa: BLE001 — cache ≠ serving
+                log.warning("prompt-cache store failed: %s", e)
 
     def shutdown(self, timeout: float = 10.0) -> None:
         self._stopping = True
         self._wake.set()
         self._thread.join(timeout)
+        if self._pc_thread is not None:
+            self._pc_queue.put(None)  # flush: writer drains FIFO first
+            self._pc_thread.join(timeout)
+            self._pc_thread = None
 
     # -- engine thread ---------------------------------------------------
 
@@ -285,6 +337,8 @@ class Scheduler:
             toks, seq, k, pipelined, t_issue, fresh = inflight.popleft()
             rows = np.asarray(toks)
             now = time.monotonic()
+            if k == 0 and self.spec is not None:  # speculative window
+                self.spec.observe_window(rows)
             # per-token timing for the adaptive streaming dispatch size:
             # when this dispatch was issued while another was still on the
             # device, the interval between drains is pure device time for
@@ -322,6 +376,10 @@ class Scheduler:
                 if constrained_slots():
                     # sync mode: drain the pipeline so set_bias updates from
                     # processed tokens apply to the very next dispatch
+                    if self.spec is not None:
+                        # plain dispatches leave the drafts without KV for
+                        # the tokens they decode — resync before next window
+                        self._spec_dirty = True
                     while inflight:
                         drain_one()
                     constrained = constrained_slots()
@@ -352,7 +410,31 @@ class Scheduler:
                             rows, self._dispatch_seq, frozen=constrained
                         )
                     self._last_drain_t = None  # sync path: drain clock stale
+                elif (self.spec is not None and self._spec_dirty
+                        and inflight):
+                    # a resync must see the COMPLETE resident record — drain
+                    # the in-flight plain dispatches before rebuilding drafts
+                    drain_one()
+                    continue
+                elif self._spec_usable():
+                    self._dispatch_seq += 1
+                    self._fresh_shape("spec")
+                    t_issue = time.monotonic()
+                    tokens = self.spec.step_spec_async()
+                    self.last_dispatch_steps = self.spec.gamma + 1
+                    try:
+                        tokens.copy_to_host_async()
+                    except AttributeError:
+                        pass
+                    # k=0 marks a spec window: rows carry SKIP sentinels and
+                    # contribute acceptance telemetry, not the step-time EMA
+                    inflight.append((tokens, self._dispatch_seq, 0,
+                                     bool(inflight), t_issue, True))
+                    if len(inflight) >= self.pipeline_depth:
+                        drain_one()
                 else:
+                    if self.spec is not None:
+                        self._spec_dirty = True
                     steps = self._effective_steps()
                     self._dispatch_seq += 1
                     fresh = self._fresh_shape(steps)
@@ -376,8 +458,33 @@ class Scheduler:
                 with self._lock:
                     for slot, ctx in list(self._slots.items()):
                         ctx.handle._finish("error")
-                        self.runner.release(slot)
+                        self._engine.release(slot)
                     self._slots.clear()
+
+    def _spec_usable(self) -> bool:
+        """Speculative windows require: a spec decoder, every active slot
+        far enough from the context edge (a window writes gamma+1 KV rows),
+        and fresh drafts (resynced if plain dispatches intervened)."""
+        if self.spec is None:
+            return False
+        gamma = self.spec.gamma
+        with self._lock:
+            slots = {s: c.handle for s, c in self._slots.items()}
+            gen = {s: c.generated for s, c in self._slots.items()}
+        for s, h in slots.items():
+            if (h.prompt_tokens + gen[s] + gamma + 2
+                    >= self.runner.max_ctx):
+                return False
+        if self._spec_dirty:
+            # draft KV is stale for every active slot; rebuild from the
+            # resident token record (absent for multimodal slots — wait
+            # until those finish)
+            if any(self._resident.get(s) is None for s in slots):
+                return False
+            for s in slots:
+                self.spec.resync_draft(s, self._resident[s])
+            self._spec_dirty = False
+        return True
 
     def _fresh_shape(self, key) -> bool:
         """True exactly once per program shape — its first dispatch pays
@@ -427,7 +534,7 @@ class Scheduler:
 
     def _admit_pending(self) -> bool:
         admitted = False
-        while self.runner.free_slots():
+        while self._engine.free_slots():
             try:
                 handle = self._pending.get_nowait()
             except queue.Empty:
@@ -439,7 +546,7 @@ class Scheduler:
             # prefix with this prompt (KV prefix-cache reuse); the loop
             # guard guarantees a free slot exists (slot lists are mutated
             # only on this thread)
-            slot = self.runner.acquire_slot(
+            slot = self._engine.acquire_slot(
                 self._best_slot(handle.request.prompt)
             )
             assert slot is not None
@@ -449,7 +556,7 @@ class Scheduler:
             except Exception as e:  # noqa: BLE001 — bad request ≠ dead engine
                 log.warning("admit failed: %s", e)
                 handle._finish("error")
-                self.runner.release(slot)
+                self._engine.release(slot)
 
     def _start(self, slot: int, handle: GenHandle) -> None:
         req = handle.request
@@ -469,10 +576,20 @@ class Scheduler:
         mask = (
             req.constraint.allowed_mask() if req.constraint is not None else None
         )
-        first = self.runner.admit(
+        resident = self._resident.get(slot)
+        if self.prompt_cache is not None and req.mm_embeds is None:
+            mem_lcp = (
+                self._engine.reusable_prefix(slot, resident, req.prompt)
+                if resident else 0
+            )
+            hit = self.prompt_cache.lookup(req.prompt)
+            if (hit is not None and hit.lcp > mem_lcp
+                    and self.runner.load_prefix(slot, hit.arrays, hit.n)):
+                resident = hit.tokens
+        first = self._engine.admit(
             slot,
             req.prompt,
-            resident=self._resident.get(slot),
+            resident=resident,
             temperature=req.temperature,
             top_k=req.top_k,
             top_p=req.top_p,
@@ -511,11 +628,11 @@ class Scheduler:
         Uses the runner's own feasibility gates so the ranking can't pick a
         slot whose reuse collapses to zero at admit time."""
         best, best_lcp = None, 0
-        for s in self.runner.free_slots():
+        for s in self._engine.free_slots():
             r = self._resident.get(s)
             if not r:
                 continue
-            lcp = self.runner.reusable_prefix(s, r, prompt)
+            lcp = self._engine.reusable_prefix(s, r, prompt)
             if lcp > best_lcp:
                 best, best_lcp = s, lcp
         return best
@@ -580,7 +697,10 @@ class Scheduler:
                     continue
                 if i > 0 and frozen is not None and slot in frozen:
                     continue
-                self._consume(slot, ctx, int(rows[i, slot]))
+                tok = int(rows[i, slot])
+                if tok < 0:  # SKIP sentinel: speculative window ended early
+                    continue
+                self._consume(slot, ctx, tok)
 
     def _consume(self, slot: int, ctx: _SlotCtx, token_id: int) -> None:
         """Handle one sampled token for one slot: stream, stop, constrain."""
@@ -620,7 +740,7 @@ class Scheduler:
             if mask is not None or ctx.mask_set:
                 # always refresh when a mask was ever set, so an FSM entering
                 # a free-text region (mask=None) clears the stale device mask
-                self.runner.set_bias(slot, self._compose_bias(ctx.base_bias, mask))
+                self._engine.set_bias(slot, self._compose_bias(ctx.base_bias, mask))
                 ctx.mask_set = mask is not None
 
         limit = req.max_new_tokens or self.default_max_tokens
@@ -638,5 +758,24 @@ class Scheduler:
         with self._lock:
             self._slots.pop(slot, None)
             self.total_generated_tokens += ctx.handle.completion_tokens
-        self.runner.release(slot)
+        if (self.prompt_cache is not None
+                and not self.prompt_cache.read_only
+                and reason in ("stop", "length")):
+            r = self._resident.get(slot)
+            if r:
+                # prompt_cache_all keeps generation too; otherwise prompt
+                # only. Generated length comes from the host record — no
+                # device sync on the engine thread.
+                pos = min(len(r) - 1, self.runner.max_ctx - 1)
+                keep = (pos if self.prompt_cache_all
+                        else min(ctx.handle.prompt_tokens, pos))
+                if keep >= self.prompt_cache.min_prefix:
+                    try:
+                        self._pc_queue.put((
+                            list(r[:keep]),
+                            self.runner.snapshot_prefix(slot, keep),
+                        ))
+                    except Exception as e:  # noqa: BLE001 — cache ≠ serving
+                        log.warning("prompt-cache snapshot failed: %s", e)
+        self._engine.release(slot)
         ctx.handle._finish(reason)

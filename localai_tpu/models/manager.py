@@ -376,6 +376,48 @@ def build_serving_model(mcfg: ModelConfig, app: AppConfig) -> ServingModel:
         if app.mirror_followers:
             leader.wait_for(app.mirror_followers)
         runner = MirroredRunner(runner, leader, mcfg.name)
+    spec = None
+    if eng.draft_model and app.mirror_port:
+        log.warning(
+            "%s: draft_model is not supported with multi-host command "
+            "mirroring yet; serving without speculative decoding", mcfg.name
+        )
+    elif eng.draft_model:
+        from localai_tpu.engine.speculative import build_spec_decoder
+
+        spec = build_spec_decoder(
+            runner, eng.draft_model,
+            model_path=app.model_path,
+            gamma=max(1, eng.n_draft),
+            dtype=eng.dtype,
+        )
+        log.info(
+            "%s: speculative decoding with draft %s (n_draft=%d)",
+            mcfg.name, eng.draft_model, eng.n_draft,
+        )
+    prompt_cache = None
+    if mcfg.prompt_cache_path and app.mirror_port:
+        log.warning(
+            "%s: prompt_cache_path is not supported with multi-host command "
+            "mirroring (KV loads would desync followers); ignoring", mcfg.name
+        )
+    elif mcfg.prompt_cache_path:
+        from pathlib import Path
+
+        from localai_tpu.engine.promptcache import PromptKVCache
+
+        pc_path = Path(mcfg.prompt_cache_path)
+        if not pc_path.is_absolute():
+            pc_path = Path(app.model_path) / pc_path
+        prompt_cache = PromptKVCache(
+            pc_path, read_only=mcfg.prompt_cache_ro,
+            min_prefix=runner.prefix_reuse_min,
+        )
+        log.info(
+            "%s: prompt KV cache at %s (%s%s)", mcfg.name, pc_path,
+            "ro, " if mcfg.prompt_cache_ro else "",
+            "prompt+generation" if mcfg.prompt_cache_all else "prompt only",
+        )
     scheduler = Scheduler(
         runner,
         model.tokenizer,
@@ -383,6 +425,9 @@ def build_serving_model(mcfg: ModelConfig, app: AppConfig) -> ServingModel:
         multi_step=eng.decode_steps_per_dispatch,
         pipeline_depth=eng.pipeline_depth,
         stream_latency_target=eng.stream_latency_ms / 1000.0,
+        spec=spec,
+        prompt_cache=prompt_cache,
+        prompt_cache_all=mcfg.prompt_cache_all,
     )
     # vision tower: explicit mmproj ref, or auto from a llava checkpoint dir
     vision = None

@@ -102,6 +102,65 @@ def resolve_model(
     )
 
 
+def synthetic_quantized_params(
+    cfg: LlamaConfig, mode: str = "int8", group: int = 128, seed: int = 0
+) -> Any:
+    """Random weights generated DIRECTLY in quantized form — an 8B-class
+    bf16 init (16 GB) would not fit a single v5e chip, but its int8 form
+    (8 GB) does. Used by bench.py for north-star-shaped synthetic serving;
+    scale magnitudes match init_params' 0.02-std gaussians so activations
+    stay in a realistic range."""
+    import jax.numpy as jnp
+
+    from localai_tpu.models import llama as mdl
+    from localai_tpu.models.quant import QuantizedTensor, _group_size
+
+    if mode not in ("int8", "int4"):
+        raise ValueError(f"unsupported synthetic quant mode {mode!r}")
+    shapes = mdl.param_shapes(cfg)
+    keys = iter(jax.random.split(jax.random.key(seed), 32))
+
+    def qweight(shape, axis, bits):
+        lim, mm = (7, "w4") if bits == 4 else (127, "w8")
+        # raw uint8 bits reinterpreted as int8 — no int32 intermediates
+        # (randint would spike 4× the tensor size during generation)
+        v = jax.lax.bitcast_convert_type(
+            jax.random.bits(next(keys), shape, jnp.uint8), jnp.int8
+        )
+        if bits == 4:
+            q = jnp.maximum(v >> 4, -7).astype(jnp.int4)
+        else:
+            q = jnp.maximum(v, -127)
+        if bits == 4:
+            K = shape[axis]
+            gc = K // _group_size(K, group)
+            sshape = shape[:axis] + (gc,) + shape[axis + 1:]
+        else:
+            sshape = shape[:axis] + shape[axis + 1:]
+        scale = jnp.full(sshape, 0.02 / lim, jnp.float32)
+        return QuantizedTensor(q=q, scale=scale, axis=axis, mode=mm)
+
+    bits = 4 if mode == "int4" else 8
+    dtype = jnp.dtype(cfg.dtype)
+    params: dict = {
+        # embeddings stay int8 even in int4 mode (see quantize_params)
+        "embed": qweight(shapes["embed"], 1, 8),
+        "final_norm": jnp.ones(shapes["final_norm"], dtype),
+    }
+    if "lm_head" in shapes:
+        params["lm_head"] = qweight(shapes["lm_head"], 0, bits)
+    layers = {}
+    for name, shape in shapes["layers"].items():
+        if name in ("attn_norm", "mlp_norm"):
+            layers[name] = jnp.ones(shape, dtype)
+        elif name in ("bq", "bk", "bv"):
+            layers[name] = jnp.zeros(shape, dtype)
+        else:
+            layers[name] = qweight(shape, 1, bits)
+    params["layers"] = layers
+    return params
+
+
 def resolve_tokenizer(ref: str, model_path: str | Path = "models"):
     """Tokenizer-only resolution — never touches weights (the tokenize CLI
     and API must not pull GBs of params into RAM to encode a string)."""

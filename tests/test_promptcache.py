@@ -1,0 +1,172 @@
+"""Disk prompt-KV persistence (prompt_cache_path / _all / _ro).
+
+Parity: /root/reference/core/config/backend_config.go:120-122 — llama.cpp
+persists session KV to disk and reloads it to skip recomputing a shared
+prefix across process restarts. The contract test: a COLD-START scheduler
+(fresh runner, same cache dir) must reuse the stored prefix and produce
+identical greedy output.
+"""
+
+import numpy as np
+import pytest
+
+from localai_tpu.engine.promptcache import PromptKVCache
+from localai_tpu.engine.runner import ModelRunner
+from localai_tpu.engine.scheduler import GenRequest, Scheduler
+from localai_tpu.models.registry import resolve_model
+
+
+@pytest.fixture(scope="module")
+def small():
+    return resolve_model("debug:small", dtype="float32")
+
+
+def _mk(model, **kw):
+    kw.setdefault("kv_dtype", "float32")
+    return ModelRunner(model.cfg, model.params, num_slots=2, max_ctx=128,
+                       prefill_buckets=[32, 64], **kw)
+
+
+def _sched(model, cache, **kw):
+    return Scheduler(_mk(model, **kw.pop("runner_kw", {})), model.tokenizer,
+                     multi_step=4, prompt_cache=cache, **kw)
+
+
+PROMPT = list(b"the shared system prompt that should be cached once")
+
+
+def test_cold_start_reuses_disk_cache(small, tmp_path):
+    cache = PromptKVCache(tmp_path / "pc")
+    s1 = _sched(small, cache)
+    try:
+        ref = s1.generate(GenRequest(prompt=PROMPT, max_new_tokens=8,
+                                     temperature=0.0, ignore_eos=True),
+                          timeout=120).token_ids
+    finally:
+        s1.shutdown()
+    assert cache.stores == 1
+
+    # brand-new runner + scheduler (cold start), same cache dir
+    cache2 = PromptKVCache(tmp_path / "pc")
+    s2 = _sched(small, cache2)
+    try:
+        got = s2.generate(GenRequest(prompt=PROMPT, max_new_tokens=8,
+                                     temperature=0.0, ignore_eos=True),
+                          timeout=120).token_ids
+        assert cache2.hits == 1
+        # the runner really skipped prefix recompute
+        assert s2.runner.total_prefix_reused >= len(PROMPT) - 33
+    finally:
+        s2.shutdown()
+    assert got == ref
+
+
+def test_prompt_cache_all_stores_generation(small, tmp_path):
+    cache = PromptKVCache(tmp_path / "pc")
+    s1 = _sched(small, cache, prompt_cache_all=True)
+    try:
+        h = s1.generate(GenRequest(prompt=PROMPT, max_new_tokens=8,
+                                   temperature=0.0, ignore_eos=True),
+                        timeout=120)
+    finally:
+        s1.shutdown()
+    key = next(iter(cache._index))
+    stored = cache._index[key]
+    # prompt + generated tokens (minus the final unfed one) are all cached
+    assert len(stored) > len(PROMPT)
+
+
+def test_read_only_cache_never_writes(small, tmp_path):
+    cache = PromptKVCache(tmp_path / "pc", read_only=True)
+    s1 = _sched(small, cache)
+    try:
+        s1.generate(GenRequest(prompt=PROMPT, max_new_tokens=4,
+                               temperature=0.0, ignore_eos=True), timeout=120)
+    finally:
+        s1.shutdown()
+    assert cache.stores == 0
+    assert not (tmp_path / "pc").exists() or not list(
+        (tmp_path / "pc").glob("*.npz")
+    )
+
+
+def test_int8_kv_roundtrip(small, tmp_path):
+    """Scaled-int8 caches persist their scales and reload bit-exact."""
+    cache = PromptKVCache(tmp_path / "pc")
+    s1 = _sched(small, cache, runner_kw={"kv_dtype": "int8"})
+    try:
+        ref = s1.generate(GenRequest(prompt=PROMPT, max_new_tokens=6,
+                                     temperature=0.0, ignore_eos=True),
+                          timeout=120).token_ids
+    finally:
+        s1.shutdown()
+
+    cache2 = PromptKVCache(tmp_path / "pc")
+    s2 = _sched(small, cache2, runner_kw={"kv_dtype": "int8"})
+    try:
+        got = s2.generate(GenRequest(prompt=PROMPT, max_new_tokens=6,
+                                     temperature=0.0, ignore_eos=True),
+                          timeout=120).token_ids
+        assert cache2.hits == 1
+    finally:
+        s2.shutdown()
+    assert got == ref
+
+
+def test_dtype_mismatch_falls_back(small, tmp_path):
+    """An int8 entry must not load into a bf16 cache — admit falls back to
+    a full prefill instead of corrupting the slot."""
+    cache = PromptKVCache(tmp_path / "pc")
+    s1 = _sched(small, cache, runner_kw={"kv_dtype": "int8"})
+    try:
+        s1.generate(GenRequest(prompt=PROMPT, max_new_tokens=4,
+                               temperature=0.0, ignore_eos=True), timeout=120)
+    finally:
+        s1.shutdown()
+
+    cache2 = PromptKVCache(tmp_path / "pc")
+    s2 = _sched(small, cache2)  # float32 KV
+    try:
+        h = s2.generate(GenRequest(prompt=PROMPT, max_new_tokens=4,
+                                   temperature=0.0, ignore_eos=True),
+                        timeout=120)
+        assert len(h.token_ids) == 4
+        assert s2.runner.total_prefix_reused == 0
+    finally:
+        s2.shutdown()
+
+
+def test_bf16_kv_roundtrip_bitview(small, tmp_path):
+    """bfloat16 rows survive the uint16 bit-view serialization."""
+    model = resolve_model("debug:small")  # bf16 default
+    cache = PromptKVCache(tmp_path / "pc")
+    r1 = ModelRunner(model.cfg, model.params, num_slots=2, max_ctx=128,
+                     prefill_buckets=[64])
+    s = r1.acquire_slot()
+    r1.admit(s, PROMPT, temperature=0.0)
+    blob = r1.export_prefix(s)
+    cache.store(PROMPT, blob)
+
+    r2 = ModelRunner(model.cfg, model.params, num_slots=2, max_ctx=128,
+                     prefill_buckets=[64])
+    hit = cache.lookup(PROMPT + [5])
+    assert hit is not None
+    s2 = r2.acquire_slot()
+    assert r2.load_prefix(s2, hit.arrays, hit.n)
+    k1 = np.asarray(r1.kv.k[:, s, :, :hit.n].astype(np.float32))
+    k2 = np.asarray(r2.kv.k[:, s2, :, :hit.n].astype(np.float32))
+    np.testing.assert_array_equal(k1, k2)
+
+
+def test_eviction_caps_entries(small, tmp_path):
+    cache = PromptKVCache(tmp_path / "pc", max_entries=2, min_prefix=4)
+    r = _mk(small)
+    s = r.acquire_slot()
+    for i in range(4):
+        prompt = [10 + i] * 8
+        r.admit(s, prompt, temperature=0.0)
+        cache.store(prompt, r.export_prefix(s, 8))
+        r.release(s)
+        s = r.acquire_slot()
+    assert len(cache._index) == 2
+    assert len(list((tmp_path / "pc").glob("*.npz"))) == 2

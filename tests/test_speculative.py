@@ -1,0 +1,245 @@
+"""Speculative decoding: draft propose + target verify in one program.
+
+Parity: DraftModel/NDraft (/root/reference/core/config/backend_config.go:143,
+backend/backend.proto:210). The acceptance scan runs the real sampler chain,
+so greedy spec output must equal greedy non-spec output exactly.
+"""
+
+import numpy as np
+import pytest
+
+from localai_tpu.engine.runner import ModelRunner
+from localai_tpu.engine.speculative import SKIP, SpecDecoder
+from localai_tpu.models.registry import resolve_model
+
+
+@pytest.fixture(scope="module")
+def small():
+    return resolve_model("debug:small", dtype="float32")
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    return resolve_model("debug:tiny", dtype="float32")
+
+
+def _mk(model, **kw):
+    return ModelRunner(model.cfg, model.params, num_slots=2, max_ctx=128,
+                       prefill_buckets=[32], **kw)
+
+
+def _spec_tokens(spec, prompt, windows, slot):
+    toks = [spec.admit(slot, prompt, temperature=0.0)]
+    for _ in range(windows):
+        rows = spec.step_spec()
+        for t in range(rows.shape[0]):
+            if rows[t, slot] != SKIP:
+                toks.append(int(rows[t, slot]))
+    return toks
+
+
+def test_greedy_spec_matches_plain_decode(small, tiny):
+    """Emitted tokens come from the target's own sampling chain, so greedy
+    spec == greedy plain decode regardless of draft quality."""
+    prompt = list(b"speculation target")
+    plain = _mk(small)
+    s = plain.acquire_slot()
+    ref = [plain.admit(s, prompt, temperature=0.0)]
+    for _ in range(12):
+        ref.append(int(plain.step()[s]))
+
+    spec = SpecDecoder(_mk(small), _mk(tiny), gamma=3)
+    slot = spec.acquire_slot()
+    got = _spec_tokens(spec, prompt, windows=12, slot=slot)
+    assert got[: len(ref)] == ref
+
+
+def test_self_draft_accepts_everything(small):
+    """With the draft == the target, every proposal matches the target's
+    greedy choice, so each window emits all gamma+1 tokens."""
+    spec = SpecDecoder(_mk(small), _mk(small), gamma=3)
+    slot = spec.acquire_slot()
+    spec.admit(slot, list(b"identical twins"), temperature=0.0)
+    rows = spec.step_spec()
+    assert (rows[:, slot] != SKIP).all()
+    # normalized by ACTIVE slot-windows: full acceptance reads 1.0 even
+    # though slot 1 is idle
+    assert spec.acceptance_rate == 1.0
+
+
+def test_spec_positions_and_state_advance(small, tiny):
+    spec = SpecDecoder(_mk(small), _mk(tiny), gamma=3)
+    slot = spec.acquire_slot()
+    prompt = list(b"position check")
+    spec.admit(slot, prompt, temperature=0.0)
+    p0 = spec.slot_position(slot)
+    assert p0 == len(prompt)
+    rows = spec.step_spec()
+    emitted = int((rows[:, slot] != SKIP).sum())
+    assert 1 <= emitted <= 4
+    assert spec.slot_position(slot) == p0 + emitted
+    # draft frontier tracks the target's
+    assert int(spec.draft.state.positions[slot]) == p0 + emitted
+
+
+def test_spec_int8_kv(small, tiny):
+    """Spec verify writes through the scaled-int8 KV path."""
+    from localai_tpu.models.quant import quantize_params
+
+    spec = SpecDecoder(
+        _mk(small, kv_dtype="int8"),
+        _mk(tiny, kv_dtype="int8"),
+        gamma=2,
+    )
+    slot = spec.acquire_slot()
+    toks = _spec_tokens(spec, list(b"int8 spec"), windows=4, slot=slot)
+    assert len(toks) >= 5
+    assert all(0 <= t < small.cfg.vocab_size for t in toks)
+
+
+def test_seeded_sampled_spec_matches_plain(small, tiny):
+    """Keys advance once per emitted token, so a seeded sampled stream is
+    reproducible through the speculative path too."""
+    prompt = list(b"seeded stream")
+    plain = _mk(small)
+    s = plain.acquire_slot()
+    ref = [plain.admit(s, prompt, temperature=0.8, seed=7)]
+    for _ in range(10):
+        ref.append(int(plain.step()[s]))
+
+    spec = SpecDecoder(_mk(small), _mk(tiny), gamma=3)
+    slot = spec.acquire_slot()
+    got = [spec.admit(slot, prompt, temperature=0.8, seed=7)]
+    for _ in range(10):
+        rows = spec.step_spec()
+        for t in range(rows.shape[0]):
+            if rows[t, slot] != SKIP:
+                got.append(int(rows[t, slot]))
+    assert got[: len(ref)] == ref
+
+
+def test_vocab_mismatch_rejected(small):
+    import dataclasses
+
+    import jax
+
+    from localai_tpu.models.llama import init_params
+
+    cfg = dataclasses.replace(small.cfg, vocab_size=256)
+    params = init_params(jax.random.key(0), cfg)
+    odd = ModelRunner(cfg, params, num_slots=2, max_ctx=128,
+                      prefill_buckets=[32])
+    with pytest.raises(ValueError, match="vocab"):
+        SpecDecoder(_mk(small), odd, gamma=2)
+
+
+def test_scheduler_with_spec_matches_plain(small, tiny):
+    """End-to-end scheduler: spec-enabled greedy output equals plain."""
+    from localai_tpu.engine.scheduler import GenRequest, Scheduler
+
+    prompt = list(b"scheduler spec parity")
+    plain_sched = Scheduler(_mk(small), small.tokenizer, multi_step=4)
+    try:
+        ref = plain_sched.generate(
+            GenRequest(prompt=prompt, max_new_tokens=20, temperature=0.0,
+                       ignore_eos=True), timeout=120,
+        ).token_ids
+    finally:
+        plain_sched.shutdown()
+
+    spec = SpecDecoder(_mk(small), _mk(tiny), gamma=3)
+    sched = Scheduler(spec.target, small.tokenizer, multi_step=4, spec=spec)
+    try:
+        got = sched.generate(
+            GenRequest(prompt=prompt, max_new_tokens=20, temperature=0.0,
+                       ignore_eos=True), timeout=120,
+        ).token_ids
+        m = sched.metrics()
+        assert m["spec_windows"] > 0
+        assert m["spec_acceptance_rate"] > 0.0
+    finally:
+        sched.shutdown()
+    assert got == ref
+
+
+def test_scheduler_spec_with_constraint_interlude(small, tiny):
+    """A grammar-constrained request forces plain dispatches; afterwards the
+    drafts resync and speculative windows resume producing correct text."""
+    from localai_tpu.engine.scheduler import GenRequest, Scheduler
+
+    class OnlyTokens:
+        """Constraint allowing a fixed token set for 4 tokens."""
+
+        def __init__(self, allowed, n=4):
+            self.allowed = allowed
+            self.left = n
+
+        def allowed_mask(self):
+            import numpy as np
+
+            row = np.full(small.cfg.vocab_size, -1e30, np.float32)
+            row[self.allowed] = 0.0
+            return row
+
+        def advance(self, token_id):
+            self.left -= 1
+
+        @property
+        def done(self):
+            return self.left <= 0
+
+    spec = SpecDecoder(_mk(small), _mk(tiny), gamma=3)
+    sched = Scheduler(spec.target, small.tokenizer, multi_step=4, spec=spec)
+    try:
+        h1 = sched.generate(
+            GenRequest(prompt=list(b"constrained"), max_new_tokens=8,
+                       temperature=0.0, ignore_eos=True,
+                       constraint=OnlyTokens([65, 66, 67])), timeout=120,
+        )
+        assert all(t in (65, 66, 67) for t in h1.token_ids)
+        # after the constrained request, plain decode ran → drafts stale;
+        # the next request must resync and still produce correct output
+        h2 = sched.generate(
+            GenRequest(prompt=list(b"after constraint"), max_new_tokens=12,
+                       temperature=0.0, ignore_eos=True), timeout=120,
+        )
+        assert len(h2.token_ids) == 12
+        assert sched.metrics()["spec_windows"] > 0
+    finally:
+        sched.shutdown()
+
+
+def test_serving_model_with_draft_config(tmp_path):
+    """Config → engine wiring: engine.draft_model builds a SpecDecoder."""
+    from localai_tpu.config.app_config import AppConfig
+    from localai_tpu.config.model_config import ModelConfig
+    from localai_tpu.models.manager import build_serving_model
+
+    mcfg = ModelConfig.model_validate({
+        "name": "spec-small",
+        "model": "debug:small",
+        "context_size": 128,
+        "parameters": {"max_tokens": 16},
+        "engine": {
+            "max_slots": 2,
+            "prefill_buckets": [32],
+            "dtype": "float32",
+            "kv_dtype": "float32",
+            "draft_model": "debug:tiny",
+            "n_draft": 3,
+        },
+    })
+    app = AppConfig(model_path=str(tmp_path))
+    sm = build_serving_model(mcfg, app)
+    try:
+        assert sm.scheduler.spec is not None
+        assert sm.scheduler.spec.gamma == 3
+        from localai_tpu.engine.scheduler import GenRequest
+
+        h = sm.scheduler.generate(
+            GenRequest(prompt=list(b"hello draft"), max_new_tokens=10,
+                       temperature=0.0, ignore_eos=True), timeout=120,
+        )
+        assert len(h.token_ids) == 10
+    finally:
+        sm.scheduler.shutdown()
