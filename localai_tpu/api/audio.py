@@ -122,6 +122,41 @@ async def transcribe(request: web.Request) -> web.Response:
                               "segments": result["segments"]})
 
 
+def _reference_voice(state, model_name: str, voice: str):
+    """Resolve a reference-voice recording for cloning (vall-e-x
+    ``audio_path`` parity, backend_config.go:19-26): the model's TTS
+    section points at a wav file, or a directory holding one wav per
+    voice name ({voice}.wav). Returns float32 @16 kHz or None."""
+    from pathlib import Path
+
+    mcfg = state.loader.get(model_name) if model_name else None
+    tts_cfg = getattr(mcfg, "tts", None) if mcfg is not None else None
+    ap = getattr(tts_cfg, "audio_path", None) if tts_cfg is not None else None
+    if not ap:
+        return None
+    base = Path(ap)
+    if not base.is_absolute():
+        base = Path(state.config.model_path) / base
+    if base.is_dir():
+        from localai_tpu.utils.paths import verify_path
+
+        try:
+            # the voice name is caller-supplied — confine it to audio_path
+            cand = verify_path(f"{voice}.wav", base)
+        except ValueError:
+            return None
+    else:
+        cand = base
+    if not cand.is_file():
+        return None
+    from localai_tpu.audio.wav import read_wav
+
+    try:
+        return read_wav(cand.read_bytes())
+    except Exception:  # noqa: BLE001 — bad reference ≠ failed request
+        return None
+
+
 def _tts_params(state, model_name: str) -> tuple[str, float]:
     """Resolve default voice/speed from the named TTS config, if any."""
     voice, speed = "alloy", 1.0
@@ -172,6 +207,7 @@ async def _speak(request: web.Request, text: str, voice: str,
     def run():
         # model resolution + (first-use) weight load happen HERE, on the
         # executor — a multi-second vits load must not block the loop
+        ref_audio = _reference_voice(state, model_name, voice)
         sm = _vits_for(state, model_name)
         if sm is not None:
             # neural path (VITS voice checkpoint); `voice` selects the
@@ -183,14 +219,25 @@ async def _speak(request: web.Request, text: str, voice: str,
                 raise RuntimeError(f"vits model {sm.name} was evicted")
             cfg = model.cfg
             spk = None
-            if voice.isdigit():
+            spk_emb = None
+            if ref_audio is not None and cfg.speaker_embedding_size:
+                # voice cloning: reference recording → identity embedding
+                # → continuous conditioning (audio.speaker)
+                from localai_tpu.audio.speaker import get_speaker_encoder
+
+                enc = get_speaker_encoder()
+                spk_emb = enc.project(enc.embed(ref_audio),
+                                      cfg.speaker_embedding_size)
+            elif voice.isdigit():
                 spk = int(voice)
             wav = sm.run(
                 "synthesize", text, speaker_id=spk,
+                speaker_embedding=spk_emb,
                 speaking_rate=cfg.speaking_rate * speed,
             )
             return write_wav(wav, rate=cfg.sampling_rate)
-        return write_wav(ttsmod.synthesize(text, voice=voice, speed=speed))
+        return write_wav(ttsmod.synthesize(text, voice=voice, speed=speed,
+                                           ref_audio=ref_audio))
 
     data = await _in_executor(request, run)
     return web.Response(body=data, content_type="audio/wav")

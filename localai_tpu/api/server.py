@@ -38,7 +38,7 @@ AUTH_EXEMPT = {"/", "/healthz", "/readyz", "/version", "/swagger",
                "/swagger/doc.json"}
 # UI documents are key-free to GET (they hold no data; their JS calls the
 # protected JSON APIs with the key the operator enters in the page header)
-from localai_tpu.api.ui import UI_PREFIXES  # noqa: E402
+from localai_tpu.api.ui import UI_EXACT, UI_PREFIXES  # noqa: E402
 
 
 class AppState:
@@ -164,7 +164,8 @@ async def auth_middleware(request: web.Request, handler):
     if not keys or request.path in AUTH_EXEMPT:
         return await handler(request)
     if (request.method == "GET" and not state.config.disable_webui
-            and request.path.startswith(UI_PREFIXES)):
+            and (request.path.startswith(UI_PREFIXES)
+                 or request.path in UI_EXACT)):
         return await handler(request)
     header = request.headers.get("Authorization", "")
     token = header.removeprefix("Bearer ").strip()

@@ -13,6 +13,7 @@ saves in the header field (localStorage) — the JSON APIs stay protected.
 
 from __future__ import annotations
 
+import asyncio
 import html
 import json
 
@@ -89,8 +90,10 @@ def _page(title: str, body: str, script: str = "") -> web.Response:
     <a href="/">Home</a>
     <a href="/browse">Models</a>
     <a href="/chat/">Chat</a>
+    <a href="/talk/">Talk</a>
     <a href="/text2image/">Image</a>
     <a href="/tts/">TTS</a>
+    <a href="/swarm">Swarm</a>
   </nav>
   <input id="apikey" placeholder="API key (if set)"
          onchange="saveKey(this)" size="18">
@@ -415,12 +418,205 @@ async function speak() {
 
 
 # ---------------------------------------------------------------------------
+# talk (voice chat)
+
+
+async def talk_page(request: web.Request) -> web.Response:
+    """GET /talk/[model] — the voice-chat loop (parity:
+    /root/reference/core/http/views/talk.html): mic → WAV (encoded
+    client-side — the transcription endpoint speaks WAV, not webm) →
+    /v1/audio/transcriptions → /v1/chat/completions →
+    /v1/audio/speech → playback."""
+    from localai_tpu.config.model_config import Usecase
+
+    chat_models = _model_names(request, Usecase.CHAT) \
+        or _model_names(request)
+    stt = _model_names(request, Usecase.TRANSCRIPT)
+    tts = _model_names(request, Usecase.TTS)
+    selected = request.match_info.get("model", "")
+
+    def select(id_, names):
+        opts = "".join(
+            f'<option value="{html.escape(n)}"'
+            f'{" selected" if n == selected else ""}>'
+            f'{html.escape(n)}</option>'
+            for n in names) or "<option value=''>(default)</option>"
+        return f'<select id="{id_}">{opts}</select>'
+
+    body = f"""
+<div class="card">
+  <div class="row"><h2 style="flex:1">Talk</h2>
+    <label>chat {select("model", chat_models)}</label>
+    <label>stt {select("sttmodel", stt)}</label>
+    <label>tts {select("ttsmodel", tts)}</label>
+  </div>
+  <div class="row">
+    <button id="rec" onclick="toggleRec()">● Record</button>
+    <span id="status">idle</span>
+  </div>
+  <div id="log"></div>
+  <div id="out"></div>
+</div>"""
+    script = """
+let ctx, source, proc, stream, chunks = [], recording = false, history = [];
+function logLine(who, text) {
+  const d = document.createElement('div');
+  d.textContent = who + ': ' + text;
+  document.getElementById('log').appendChild(d);
+}
+function wavBlob(buffers, rate) {
+  let n = 0; buffers.forEach(b => n += b.length);
+  const pcm = new Int16Array(n); let off = 0;
+  buffers.forEach(b => { for (let i = 0; i < b.length; i++)
+    pcm[off++] = Math.max(-1, Math.min(1, b[i])) * 32767; });
+  const buf = new ArrayBuffer(44 + pcm.length * 2);
+  const v = new DataView(buf);
+  const ws = (o, s) => { for (let i = 0; i < s.length; i++)
+    v.setUint8(o + i, s.charCodeAt(i)); };
+  ws(0, 'RIFF'); v.setUint32(4, 36 + pcm.length * 2, true); ws(8, 'WAVE');
+  ws(12, 'fmt '); v.setUint32(16, 16, true); v.setUint16(20, 1, true);
+  v.setUint16(22, 1, true); v.setUint32(24, rate, true);
+  v.setUint32(28, rate * 2, true); v.setUint16(32, 2, true);
+  v.setUint16(34, 16, true); ws(36, 'data');
+  v.setUint32(40, pcm.length * 2, true);
+  new Int16Array(buf, 44).set(pcm);
+  return new Blob([buf], {type: 'audio/wav'});
+}
+async function toggleRec() {
+  const btn = document.getElementById('rec');
+  const status = document.getElementById('status');
+  if (!recording) {
+    stream = await navigator.mediaDevices.getUserMedia({audio: true});
+    ctx = new AudioContext();
+    source = ctx.createMediaStreamSource(stream);
+    proc = ctx.createScriptProcessor(4096, 1, 1);
+    chunks = [];
+    proc.onaudioprocess = e =>
+      chunks.push(new Float32Array(e.inputBuffer.getChannelData(0)));
+    source.connect(proc); proc.connect(ctx.destination);
+    recording = true; btn.textContent = '■ Stop'; status.textContent =
+      'recording…';
+    return;
+  }
+  recording = false; btn.textContent = '● Record';
+  proc.disconnect(); source.disconnect();
+  stream.getTracks().forEach(t => t.stop());  // release the microphone
+  const rate = ctx.sampleRate; ctx.close();
+  status.textContent = 'transcribing…';
+  const fd = new FormData();
+  fd.append('file', wavBlob(chunks, rate), 'talk.wav');
+  fd.append('model', document.getElementById('sttmodel').value);
+  // multipart: the browser must set its own boundary content-type
+  const auth = {}; const k = localStorage.getItem('apiKey');
+  if (k) auth['Authorization'] = 'Bearer ' + k;
+  const tr = await fetch('/v1/audio/transcriptions',
+    {method: 'POST', headers: auth, body: fd});
+  if (!tr.ok) { status.textContent = 'stt error: ' + await tr.text();
+    return; }
+  const text = (await tr.json()).text;
+  logLine('you', text);
+  history.push({role: 'user', content: text});
+  status.textContent = 'thinking…';
+  const cr = await fetch('/v1/chat/completions', {method: 'POST',
+    headers: authHeaders(),
+    body: JSON.stringify({model: document.getElementById('model').value,
+      messages: history})});
+  if (!cr.ok) { status.textContent = 'chat error: ' + await cr.text();
+    return; }
+  const reply = (await cr.json()).choices[0].message.content;
+  history.push({role: 'assistant', content: reply});
+  logLine('assistant', reply);
+  status.textContent = 'speaking…';
+  const sr = await fetch('/v1/audio/speech', {method: 'POST',
+    headers: authHeaders(),
+    body: JSON.stringify({model: document.getElementById('ttsmodel').value,
+      input: reply})});
+  if (!sr.ok) { status.textContent = 'tts error: ' + await sr.text();
+    return; }
+  const url = URL.createObjectURL(await sr.blob());
+  document.getElementById('out').innerHTML =
+    `<audio controls autoplay src="${url}"></audio>`;
+  status.textContent = 'idle';
+}
+"""
+    return _page("Talk", body, script)
+
+
+# ---------------------------------------------------------------------------
+# swarm (federation status)
+
+
+async def swarm_page(request: web.Request) -> web.Response:
+    """GET /swarm[?router=URL] — federation-nodes dashboard (parity:
+    /root/reference/core/http/views/p2p.html + routes/ui.go:432). The node
+    table comes from the router's /federated/nodes registry, fetched
+    server-side (/swarm/nodes) so the browser needs no cross-origin
+    access."""
+    router = request.query.get("router", "http://127.0.0.1:8080")
+    body = f"""
+<div class="card">
+  <div class="row"><h2 style="flex:1">Federation swarm</h2>
+    <input id="router" value="{html.escape(router)}" size="28">
+    <button onclick="refresh()">Refresh</button>
+  </div>
+  <div id="nodes">loading…</div>
+</div>"""
+    script = """
+function esc(v) {  // router-supplied fields are untrusted — escape all
+  const d = document.createElement('div');
+  d.textContent = String(v);
+  return d.innerHTML;
+}
+async function refresh() {
+  const out = document.getElementById('nodes');
+  const router = encodeURIComponent(document.getElementById('router').value);
+  const r = await fetch('/swarm/nodes?router=' + router,
+    {headers: authHeaders()});
+  if (!r.ok) { out.textContent = 'error: ' + await r.text(); return; }
+  const data = await r.json();
+  const rows = (data.nodes || []).map(n =>
+    `<tr><td>${esc(n.id)}</td><td>${esc(n.address)}</td>` +
+    `<td>${n.online ? 'online' : 'OFFLINE'}</td>` +
+    `<td>${esc(n.requests)}</td><td>${esc(n.failures)}</td></tr>`).join('');
+  out.innerHTML = `<p>${esc(data.online ?? 0)}/${(data.nodes || []).length}` +
+    ` nodes online</p><table><tr><th>id</th><th>address</th><th>state</th>` +
+    `<th>requests</th><th>failures</th></tr>${rows}</table>`;
+}
+refresh();
+"""
+    return _page("Swarm", body, script)
+
+
+async def swarm_nodes(request: web.Request) -> web.Response:
+    """GET /swarm/nodes?router=URL — server-side registry fetch."""
+    from localai_tpu.federation.explorer import fetch_nodes
+
+    router = request.query.get("router", "http://127.0.0.1:8080")
+    if not router.startswith(("http://", "https://")):
+        raise web.HTTPBadRequest(text="router must be an http(s) URL")
+    if "?" in router or "#" in router:
+        # a query/fragment would neutralize the appended /federated/nodes
+        # suffix and turn the proxy into a generic URL fetcher
+        raise web.HTTPBadRequest(text="router URL must not carry a query")
+    loop = asyncio.get_running_loop()
+    try:
+        data = await loop.run_in_executor(None, fetch_nodes, router)
+    except Exception as e:  # noqa: BLE001 — router down renders as such
+        raise web.HTTPBadGateway(text=f"router unreachable: {e}")
+    return web.json_response(data)
+
+
+# ---------------------------------------------------------------------------
 # wiring
 
 
 # page prefixes GETtable without an API key (imported by the server's
 # auth middleware — single source of truth for the exemption)
-UI_PREFIXES = ("/browse", "/chat/", "/text2image/", "/tts/")
+UI_PREFIXES = ("/browse", "/chat/", "/text2image/", "/tts/", "/talk/")
+# exact-match key-free pages (prefix matching would also exempt JSON
+# sub-routes like /swarm/nodes, which must stay API-key-protected — that
+# endpoint performs server-side fetches of the operator-named router)
+UI_EXACT = ("/swarm",)
 
 
 def wants_html(request: web.Request) -> bool:
@@ -436,4 +632,8 @@ def routes() -> list[web.RouteDef]:
         web.get("/text2image/{model}", text2image_page),
         web.get("/tts/", tts_page),
         web.get("/tts/{model}", tts_page),
+        web.get("/talk/", talk_page),
+        web.get("/talk/{model}", talk_page),
+        web.get("/swarm", swarm_page),
+        web.get("/swarm/nodes", swarm_nodes),
     ]

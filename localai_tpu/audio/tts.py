@@ -96,9 +96,19 @@ def _synth(f1_track, f2_track, noise_track, voiced_track, pitch_track,
 
 
 def synthesize(text: str, voice: str = "alloy",
-               speed: float = 1.0) -> np.ndarray:
-    """text → mono float32 speech-like audio at 16 kHz."""
+               speed: float = 1.0,
+               ref_audio: "np.ndarray | None" = None) -> np.ndarray:
+    """text → mono float32 speech-like audio at 16 kHz.
+
+    ``ref_audio`` is the parametric voice-cloning path (vall-e-x
+    audio_path parity): the synthesized voice takes its pitch from the
+    reference recording (audio.speaker.estimate_pitch) instead of the
+    name-hash, so output prosody tracks the reference speaker."""
     pitch0, vib = _voice_seed(voice or "alloy")
+    if ref_audio is not None and len(ref_audio):
+        from localai_tpu.audio.speaker import estimate_pitch
+
+        pitch0 = estimate_pitch(ref_audio)
     f1s, f2s, mixes, amps, pitches = [], [], [], [], []
     for i, ch in enumerate(text[:2000]):
         f1, f2, mix, frames = _char_params(ch)

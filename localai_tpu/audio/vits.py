@@ -595,11 +595,17 @@ class VitsTTS:
         self.tokenizer = tokenizer
 
     def synthesize(self, text: str, *, speaker_id: Optional[int] = None,
+                   speaker_embedding: Optional[np.ndarray] = None,
                    noise_scale: Optional[float] = None,
                    noise_scale_duration: Optional[float] = None,
                    speaking_rate: Optional[float] = None,
                    seed: int = 0) -> np.ndarray:
-        """float32 waveform in [-1, 1] at cfg.sampling_rate."""
+        """float32 waveform in [-1, 1] at cfg.sampling_rate.
+
+        ``speaker_embedding`` conditions the flow/decoder/duration nets on
+        a CONTINUOUS [speaker_embedding_size] vector — the voice-cloning
+        path (audio.speaker.SpeakerEncoder output), bypassing the trained
+        speaker table. Takes precedence over ``speaker_id``."""
         cfg = self.cfg
         ids = np.asarray([self.tokenizer.encode(text)], np.int32)
         pad_mask = np.ones_like(ids, np.float32)
@@ -611,17 +617,33 @@ class VitsTTS:
             if noise_scale_duration is None else noise_scale_duration,
             speaking_rate=cfg.speaking_rate if speaking_rate is None
             else speaking_rate,
-            speaker_id=speaker_id, seed=seed,
+            speaker_id=speaker_id, speaker_embedding=speaker_embedding,
+            seed=seed,
         )
         return np.asarray(wav[0], np.float32)
 
     def _forward(self, ids, pad_mask, *, noise_scale,
-                 noise_scale_duration, speaking_rate, speaker_id, seed):
+                 noise_scale_duration, speaking_rate, speaker_id, seed,
+                 speaker_embedding=None):
         cfg, p = self.cfg, self.p
         key = jax.random.key(seed)
         pad = pad_mask[:, None, :]  # [B,1,L]
         cond = None
-        if cfg.num_speakers > 1 and speaker_id is not None:
+        if speaker_embedding is not None and cfg.speaker_embedding_size:
+            emb = np.asarray(speaker_embedding, np.float32)
+            if emb.shape != (cfg.speaker_embedding_size,):
+                raise ValueError(
+                    f"speaker_embedding must be [{cfg.speaker_embedding_size}]"
+                    f", got {emb.shape}"
+                )
+            # match the trained table's scale so the conditioning convs see
+            # in-distribution magnitudes
+            tab = p.get("embed_speaker.weight")
+            if tab is not None:
+                emb = emb * float(np.linalg.norm(
+                    np.asarray(tab), axis=1).mean())
+            cond = jnp.asarray(emb)[None, :, None]
+        elif cfg.num_speakers > 1 and speaker_id is not None:
             emb = p.get("embed_speaker.weight")[speaker_id]
             cond = jnp.asarray(emb)[None, :, None]
         hidden, m_p, logs_p = text_encoder(p, cfg, jnp.asarray(ids),

@@ -153,6 +153,16 @@ def build_parser() -> argparse.ArgumentParser:
     uh.add_argument("name")
     uh.add_argument("--models-path", default=_env_default(
         "models_path", "models"))
+    cv = util_sub.add_parser(
+        "convert",
+        help="convert a GGUF checkpoint (f32/f16/q8_0/q4_0/q4_1/q4_k/q6_k) "
+             "to the native safetensors layout; serve the result with "
+             "quantization: int4/int8 for q4/q8-class bandwidth")
+    cv.add_argument("gguf", help="path to the .gguf file")
+    cv.add_argument("out", nargs="?", default=None,
+                    help="output dir (default: <gguf stem> next to it)")
+    cv.add_argument("--dtype", default="bfloat16",
+                    choices=["bfloat16", "float32", "float16"])
 
     exp = sub.add_parser(
         "explorer", help="dashboard over a federation router's nodes")
@@ -235,6 +245,19 @@ def _run_util(args, parser) -> int:
             print(f"UNSAFE (pickle-format weights): {f}")
         print(f"{len(bad)} finding(s)")
         return 1 if bad else 0
+
+    if args.util_command == "convert":
+        from pathlib import Path
+
+        from localai_tpu.utils.gguf import convert_gguf
+
+        src = Path(args.gguf)
+        if not src.is_file():
+            parser.error(f"{src}: not a file")
+        out = Path(args.out) if args.out else src.with_suffix("")
+        convert_gguf(src, out, dtype=args.dtype)
+        print(f"converted {src} -> {out}")
+        return 0
 
     if args.util_command == "usecase-heuristic":
         from localai_tpu.config.loader import ConfigLoader

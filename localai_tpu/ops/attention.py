@@ -71,10 +71,10 @@ def _flash_loop(q, kv_slice, kbuf, vbuf, ksem, vsem, lo, nb, block_k,
     [rows or 1, block_k] keep-mask. Returns the attention output [rows, hd].
 
     ``scales`` fuses scaled-int8 KV dequantization into the loop:
-    (ks_slice, vs_slice, ksbuf, vsbuf, kssem, vssem) where the slice fns
-    yield the [block_k] f32 per-position scale rows. The dequant never
-    materializes K/V in bf16 — per-position K scales distribute over the
-    score matmul columns (q·(k·s) = (q·k)·s) and V scales over the
+    (ks_block, vs_block) functions yielding block i's [block_k] f32
+    per-position scales (read from VMEM-resident scale rows). The dequant
+    never materializes K/V in bf16 — per-position K scales distribute over
+    the score matmul columns (q·(k·s) = (q·k)·s) and V scales over the
     probability columns (p@(v·s) = (p·s)@v), so both apply as [1, block_k]
     row multiplies on the VPU while the MXU matmuls stay int8-sourced.
     """
@@ -82,25 +82,15 @@ def _flash_loop(q, kv_slice, kbuf, vbuf, ksem, vsem, lo, nb, block_k,
     rows, hd = q.shape
     quantized = scales is not None
     if quantized:
-        ks_hbm, vs_hbm, ksbuf, vsbuf, kssem, vssem = scales
+        ks_block, vs_block = scales
 
     def start(i, slot):
         pltpu.make_async_copy(k_hbm(i), kbuf.at[slot], ksem.at[slot]).start()
         pltpu.make_async_copy(v_hbm(i), vbuf.at[slot], vsem.at[slot]).start()
-        if quantized:
-            pltpu.make_async_copy(
-                ks_hbm(i), ksbuf.at[slot], kssem.at[slot]).start()
-            pltpu.make_async_copy(
-                vs_hbm(i), vsbuf.at[slot], vssem.at[slot]).start()
 
     def wait(i, slot):
         pltpu.make_async_copy(k_hbm(i), kbuf.at[slot], ksem.at[slot]).wait()
         pltpu.make_async_copy(v_hbm(i), vbuf.at[slot], vsem.at[slot]).wait()
-        if quantized:
-            pltpu.make_async_copy(
-                ks_hbm(i), ksbuf.at[slot], kssem.at[slot]).wait()
-            pltpu.make_async_copy(
-                vs_hbm(i), vsbuf.at[slot], vssem.at[slot]).wait()
 
     start(lo, 0)
 
@@ -117,7 +107,7 @@ def _flash_loop(q, kv_slice, kbuf, vbuf, ksem, vsem, lo, nb, block_k,
         v = vbuf[slot].astype(jnp.float32)
         s = q @ k.T  # [rows, block_k] — MXU
         if quantized:
-            s = s * ksbuf[slot][None, :]
+            s = s * ks_block(i)[None, :]
         s = jnp.where(mask_for_block(i), s, _NEG_INF)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)
@@ -126,7 +116,7 @@ def _flash_loop(q, kv_slice, kbuf, vbuf, ksem, vsem, lo, nb, block_k,
         # weighted-value numerator
         l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
         if quantized:
-            p = p * vsbuf[slot][None, :]
+            p = p * vs_block(i)[None, :]
         acc_new = acc * alpha + p @ v
         return m_new, l_new, acc_new
 
@@ -147,10 +137,11 @@ def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, *rest, block_k: int,
                    quantized: bool):
     # k_ref/v_ref are the FULL [S, Hkv, C, hd] cache in HBM (Mosaic only
     # allows whole-array ANY refs); slot/head are picked in the DMA slice.
-    # When quantized, ks/vs_ref are the [S, Hkv, C] f32 per-position scales.
+    # When quantized, ks/vs_ref are this (slot, head)'s [C] f32 scale rows,
+    # auto-loaded into VMEM by their BlockSpec (a scale row is ≤32 KB even
+    # at 8k context — no manual DMA needed).
     if quantized:
-        (ks_ref, vs_ref, o_ref,
-         kbuf, vbuf, ksbuf, vsbuf, ksem, vsem, kssem, vssem) = rest
+        ks_ref, vs_ref, o_ref, kbuf, vbuf, ksem, vsem = rest
     else:
         o_ref, kbuf, vbuf, ksem, vsem = rest
     s_idx = pl.program_id(0)
@@ -167,9 +158,6 @@ def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, *rest, block_k: int,
     def slice_of(ref):
         return lambda i: ref.at[s_idx, h_idx, pl.ds(i * block_k, block_k), :]
 
-    def scale_slice_of(ref):
-        return lambda i: ref.at[s_idx, h_idx, pl.ds(i * block_k, block_k)]
-
     def mask_for_block(i):
         idx = i * block_k + lax.broadcasted_iota(jnp.int32, (1, block_k), 1)
         keep = idx <= pos
@@ -179,8 +167,8 @@ def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, *rest, block_k: int,
 
     scales = None
     if quantized:
-        scales = (scale_slice_of(ks_ref), scale_slice_of(vs_ref),
-                  ksbuf, vsbuf, kssem, vssem)
+        scales = (lambda i: ks_ref[0, 0, pl.ds(i * block_k, block_k)],
+                  lambda i: vs_ref[0, 0, pl.ds(i * block_k, block_k)])
     out = _flash_loop(q, (slice_of(k_ref), slice_of(v_ref)),
                       kbuf, vbuf, ksem, vsem, lo, nb, block_k, mask_for_block,
                       scales=scales)
@@ -231,12 +219,12 @@ def decode_attention(
     ]
     args = [positions.astype(jnp.int32), qg, k_cache, v_cache]
     if quantized:
-        in_specs += [pl.BlockSpec(memory_space=pl.ANY),
-                     pl.BlockSpec(memory_space=pl.ANY)]
-        scratch += [pltpu.VMEM((2, bk), jnp.float32),
-                    pltpu.VMEM((2, bk), jnp.float32)]
+        # scale rows ride normal VMEM blocks — one [C] f32 row per
+        # (slot, head) grid step (≤32 KB at 8k context)
+        in_specs += [pl.BlockSpec((1, 1, C), lambda s, h: (s, h, 0)),
+                     pl.BlockSpec((1, 1, C), lambda s, h: (s, h, 0))]
         args += [k_scale, v_scale]
-    scratch += [pltpu.SemaphoreType.DMA((2,))] * (4 if quantized else 2)
+    scratch += [pltpu.SemaphoreType.DMA((2,))] * 2
     out = pl.pallas_call(
         kernel,
         grid=(S, Hkv),

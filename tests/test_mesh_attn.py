@@ -132,3 +132,44 @@ def test_sp_through_build_serving_model(tmp_path):
         assert sm.runner.last_prefill_path == "sp"
     finally:
         sm.scheduler.shutdown()
+
+
+def test_int8_engine_prefix_resume_under_mesh(small):
+    """VERDICT r3 #10: the quantized engine and the prefix-resume admit
+    path exercised under a 2×2 mesh — greedy output must match the
+    unsharded int8 runner, and the second admit must reuse the prefix."""
+    import jax
+
+    if len(jax.devices()) < 4:
+        pytest.skip("needs 4 virtual devices")
+    from localai_tpu.models.quant import quantize_params
+
+    qp = quantize_params(small.params)
+    prompt1 = list(range(1, 50))
+    prompt2 = prompt1 + [60, 61, 62, 63]
+
+    def drive(runner):
+        s = runner.acquire_slot()
+        out1 = [runner.admit(s, prompt1, temperature=0.0)]
+        out1 += [int(runner.step()[s]) for _ in range(4)]
+        resident = prompt1 + out1
+        runner.release(s)
+        s2 = runner.acquire_slot(s)
+        out2 = [runner.admit(s2, prompt2, resident=resident,
+                             temperature=0.0)]
+        out2 += [int(runner.step()[s2]) for _ in range(4)]
+        return out1, out2, runner.last_prefix_reused
+
+    ref1, ref2, _ = drive(ModelRunner(
+        small.cfg, qp, num_slots=4, max_ctx=256, prefill_buckets=[64],
+        kv_dtype="int8"))
+
+    mesh = build_mesh(MeshPlan(data=2, model=2), devices=jax.devices()[:4])
+    sp = shd.shard_params(qp, small.cfg, mesh)
+    got1, got2, reused = drive(ModelRunner(
+        small.cfg, sp, num_slots=4, max_ctx=256, prefill_buckets=[64],
+        kv_dtype="int8", mesh=mesh))
+
+    assert reused >= 16  # the resume path actually engaged under the mesh
+    assert got1 == ref1
+    assert got2 == ref2

@@ -91,3 +91,97 @@ def test_swagger_reachable_with_api_keys(tmp_path):
             assert c.get("/swagger/doc.json").status_code == 200
     finally:
         srv.stop()
+
+
+def test_talk_and_swarm_pages_render(server):
+    """VERDICT r3 #9: talk (voice) view + swarm status page exist."""
+    with httpx.Client(base_url=server.base, timeout=30.0) as c:
+        talk = c.get("/talk/")
+        assert talk.status_code == 200
+        # the full voice loop is wired client-side
+        for probe in ("/v1/audio/transcriptions", "/v1/chat/completions",
+                      "/v1/audio/speech", "wavBlob", "getUserMedia"):
+            assert probe in talk.text
+        swarm = c.get("/swarm")
+        assert swarm.status_code == 200
+        assert "/swarm/nodes" in swarm.text
+        # nav links both pages from every page
+        home = c.get("/", headers={"Accept": "text/html"}).text
+        assert 'href="/talk/"' in home and 'href="/swarm"' in home
+
+
+def test_swarm_nodes_proxy(server):
+    """/swarm/nodes proxies a live federation router's registry."""
+    import threading
+
+    from localai_tpu.federation.server import FederatedServer
+
+    router = FederatedServer(nodes=["127.0.0.1:9"], health_interval=3600)
+    import asyncio
+
+    from aiohttp import web as aioweb
+
+    loop = asyncio.new_event_loop()
+    started = threading.Event()
+    port_box = {}
+
+    def run():
+        asyncio.set_event_loop(loop)
+
+        async def boot():
+            runner = aioweb.AppRunner(router.create_app())
+            await runner.setup()
+            site = aioweb.TCPSite(runner, "127.0.0.1", 0)
+            await site.start()
+            port_box["port"] = runner.addresses[0][1]
+            port_box["runner"] = runner
+            started.set()
+
+        loop.run_until_complete(boot())
+        loop.run_forever()
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    assert started.wait(15)
+    try:
+        with httpx.Client(base_url=server.base, timeout=30.0) as c:
+            r = c.get("/swarm/nodes",
+                      params={"router": f"http://127.0.0.1:{port_box['port']}"})
+            assert r.status_code == 200
+            data = r.json()
+            assert len(data["nodes"]) == 1
+            assert data["nodes"][0]["address"] == "http://127.0.0.1:9"
+            # bad router URL is rejected, unreachable router is a 502
+            assert c.get("/swarm/nodes",
+                         params={"router": "ftp://x"}).status_code == 400
+            assert c.get(
+                "/swarm/nodes",
+                params={"router": "http://127.0.0.1:1"},
+            ).status_code == 502
+    finally:
+        fut = asyncio.run_coroutine_threadsafe(
+            port_box["runner"].cleanup(), loop)
+        fut.result(10)
+        loop.call_soon_threadsafe(loop.stop)
+        t.join(10)
+
+
+def test_swarm_nodes_protected_but_page_keyless(tmp_path):
+    """The swarm PAGE is key-free; the /swarm/nodes proxy (server-side
+    fetch of an operator-named router) requires the API key, and router
+    URLs carrying a query/fragment are rejected."""
+    state = make_state(tmp_path, write_tiny=True)
+    state.config.api_keys = ["sekrit"]
+    srv = _ServerThread(state)
+    try:
+        with httpx.Client(base_url=srv.base, timeout=30.0) as c:
+            assert c.get("/swarm").status_code == 200
+            assert c.get("/swarm/nodes",
+                         params={"router": "http://127.0.0.1:1"}
+                         ).status_code == 401
+            r = c.get("/swarm/nodes",
+                      params={"router": "http://h/x?"},
+                      headers={"Authorization": "Bearer sekrit"})
+            assert r.status_code == 400
+    finally:
+        srv.stop()
