@@ -9,6 +9,7 @@ welcome/health).
 from __future__ import annotations
 
 import logging
+import threading
 
 from aiohttp import web
 
@@ -127,6 +128,54 @@ async def engine_metrics(request: web.Request) -> web.Response:
     return web.json_response(_state(request).manager.metrics())
 
 
+_trace_lock = threading.Lock()
+
+
+async def backend_trace(request: web.Request) -> web.Response:
+    """POST {seconds?, dir?} → capture a device/XLA profiler trace
+    (jax.profiler, TensorBoard/XProf format) while serving continues.
+    The TPU-era upgrade of the reference's pprof-style debug surface:
+    traces show per-program device time, fusion layout, and HBM traffic —
+    the ground truth for kernel/serving optimization. API-key-protected;
+    one capture at a time; ``dir`` must stay under generated assets."""
+    import asyncio
+    import time as _time
+
+    body = await request.json() if request.can_read_body else {}
+    seconds = float(body.get("seconds", 3.0))
+    if not 0.1 <= seconds <= 60.0:
+        raise web.HTTPBadRequest(text="seconds must be in [0.1, 60]")
+    from localai_tpu.utils.paths import verify_path
+
+    state = _state(request)
+    base = state.config.backend_assets_path or "."
+    try:
+        out = verify_path(str(body.get("dir", "traces")), base)
+    except ValueError as e:
+        raise web.HTTPBadRequest(text=str(e))
+
+    def capture() -> str:
+        import jax
+
+        if not _trace_lock.acquire(blocking=False):
+            raise RuntimeError("a trace capture is already running")
+        try:
+            path = str(out / _time.strftime("trace-%Y%m%d-%H%M%S"))
+            jax.profiler.start_trace(path)
+            _time.sleep(seconds)
+            jax.profiler.stop_trace()
+            return path
+        finally:
+            _trace_lock.release()
+
+    loop = asyncio.get_running_loop()
+    try:
+        path = await loop.run_in_executor(None, capture)
+    except RuntimeError as e:
+        raise web.HTTPConflict(text=str(e))
+    return web.json_response({"trace_dir": path, "seconds": seconds})
+
+
 def routes() -> list[web.RouteDef]:
     return [
         web.get("/healthz", healthz),
@@ -139,4 +188,5 @@ def routes() -> list[web.RouteDef]:
         web.post("/backend/monitor", backend_monitor),
         web.post("/backend/shutdown", backend_shutdown),
         web.get("/backend/metrics", engine_metrics),
+        web.post("/backend/trace", backend_trace),
     ]

@@ -457,3 +457,26 @@ def test_chat_streaming_n_choices(client):
     assert usage is not None
     assert usage["completion_tokens"] <= 15
     assert 0 < usage["prompt_tokens"] < 40
+
+
+def test_backend_trace_capture(tmp_path):
+    """POST /backend/trace captures a jax profiler trace to disk."""
+    state = make_state(tmp_path, write_tiny=True)
+    srv = _ServerThread(state)
+    try:
+        import httpx
+
+        with httpx.Client(base_url=srv.base, timeout=120.0) as c:
+            r = c.post("/backend/trace", json={"seconds": 0.2})
+            assert r.status_code == 200
+            out = r.json()["trace_dir"]
+            import pathlib
+
+            assert pathlib.Path(out).exists()
+            assert c.post("/backend/trace",
+                          json={"seconds": 999}).status_code == 400
+            assert c.post("/backend/trace",
+                          json={"seconds": 0.2, "dir": "../../x"}
+                          ).status_code == 400
+    finally:
+        srv.stop()

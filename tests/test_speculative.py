@@ -243,3 +243,32 @@ def test_serving_model_with_draft_config(tmp_path):
         assert len(h.token_ids) == 10
     finally:
         sm.scheduler.shutdown()
+
+
+def test_spec_under_mesh_matches_single_device(small, tiny):
+    """Speculative decoding with dp×tp-sharded target AND draft must
+    reproduce the single-device greedy stream."""
+    import jax
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    from localai_tpu.parallel import sharding as shd
+    from localai_tpu.parallel.mesh import MeshPlan, build_mesh
+
+    prompt = list(b"mesh speculation")
+    ref_spec = SpecDecoder(_mk(small), _mk(tiny), gamma=3)
+    slot = ref_spec.acquire_slot()
+    ref = _spec_tokens(ref_spec, prompt, windows=6, slot=slot)
+
+    mesh = build_mesh(MeshPlan(data=2, model=4))
+
+    def mk_mesh(model):
+        sp = shd.shard_params(model.params, model.cfg, mesh)
+        return ModelRunner(model.cfg, sp, num_slots=4, max_ctx=128,
+                           prefill_buckets=[32], mesh=mesh)
+
+    spec = SpecDecoder(mk_mesh(small), mk_mesh(tiny), gamma=3)
+    slot = spec.acquire_slot()
+    got = _spec_tokens(spec, prompt, windows=6, slot=slot)
+    n = min(len(ref), len(got))
+    assert got[:n] == ref[:n]
