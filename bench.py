@@ -1,17 +1,27 @@
 """Benchmark: steady-state decode throughput of the TPU llama engine.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...} — and
+is engineered to ALWAYS print it (VERDICT r4 #1): all measurement runs in a
+worker thread while the main thread holds a hard deadline
+(BENCH_BUDGET_S, default 1320 s) and flushes the best result seen so far the
+moment the budget expires, even if the TPU tunnel hangs mid-dispatch (the
+r3/r4 failure modes: backend-init UNAVAILABLE and a mid-run tunnel stall).
+
+Phase order is cheapest-first so a number is on the board within minutes:
+  1. 1B-class int8 (the rounds-1-3 trend config)   → landed as primary
+  2. Llama-3-8B-shaped int8 (the north star)       → promoted to primary,
+     1B demoted to "secondary", IF the remaining budget can fit it.
 
 PRIMARY metric (north star, VERDICT r3 #1): Llama-3-8B-shaped serving
 (debug:llama3-8b — exact 8B dims, synthetic weights generated directly in
 quantized form; BASELINE.md records that the reference publishes no absolute
 numbers and this environment has zero egress). 8 concurrent slots, 100-token
-prompts, then timed batched decode. Weights are served int8 per-channel with
-scaled int8 KV — the TPU analogue of the reference's default q4-GGUF serving
-(aio/cpu/text-to-text.yaml); the int8-KV decode path runs the Pallas flash
-kernel with fused dequant + per-slot length-aware block skipping
-(ops/attention.py). BENCH_QUANT=int4 serves group-wise int4 (closer to q4's
-bits, faster still); =none serves bf16 (1B only — 8B bf16 exceeds one chip).
+prompts, then timed batched decode. Weights are served int8 — the TPU
+analogue of the reference's default q4-GGUF serving (aio/cpu/text-to-text
+.yaml); the int8-KV decode path runs the Pallas flash kernel with fused
+dequant + per-slot length-aware block skipping (ops/attention.py).
+BENCH_QUANT=int4 serves group-wise int4; =int8_w8a8 runs the native int8-MXU
+dot; =none serves bf16 (1B only — 8B bf16 exceeds one chip).
 
 BASELINE (8B): 400 tok/s aggregate. Derivation: llama.cpp (the reference's
 serving engine) on an A100-class GPU decodes 8B q4 at ~110-130 tok/s
@@ -23,13 +33,14 @@ scale: one v5e chip's weight-bandwidth roofline for int8-8B decode is
 is the physical ceiling for int8 (int4 raises it to ~4).
 
 SECONDARY metric: the rounds-1-3 1B-class config (800 tok/s baseline proxy,
-same constant as before) so the cross-round trend is not lost.
-Round-3 1B reference points, same chip (2026-07-30): int8 1246 tok/s
-(XLA decode, pre-Pallas-int8), bf16 1180, multi_step 16/32/64 within noise.
+same constant as before). Round-3 reference points, same chip (2026-07-30):
+int8 1246 tok/s (XLA decode, pre-Pallas-int8), bf16 1180.
 """
 
 import json
 import os
+import sys
+import threading
 import time
 
 BASELINES = {
@@ -51,6 +62,12 @@ def run_decode_bench(preset: str, quant: str, steps: int, multi: int,
     from collections import deque
 
     import jax
+
+    if os.environ.get("BENCH_PLATFORM"):
+        # smoke runs: sitecustomize presets JAX_PLATFORMS=axon before any
+        # env override can land, so route via jax.config (honored until the
+        # backend initializes — same trick as tests/conftest.py)
+        jax.config.update("jax_platforms", os.environ["BENCH_PLATFORM"])
     import numpy as np
 
     from localai_tpu.engine.runner import ModelRunner
@@ -61,7 +78,7 @@ def run_decode_bench(preset: str, quant: str, steps: int, multi: int,
     )
 
     kv_dtype = "bfloat16"
-    if quant in ("int8", "int4"):
+    if quant in ("int8", "int4", "int8_w8a8"):
         import dataclasses
 
         cfg = dataclasses.replace(DEBUG_PRESETS[preset], dtype="bfloat16")
@@ -70,6 +87,7 @@ def run_decode_bench(preset: str, quant: str, steps: int, multi: int,
     else:
         model = resolve_model(f"debug:{preset}", dtype="bfloat16")
         cfg, params = model.cfg, model.params
+    jax.block_until_ready(jax.tree.leaves(params)[0])
 
     runner = ModelRunner(
         cfg, params, num_slots=num_slots, max_ctx=max_ctx,
@@ -104,6 +122,75 @@ def run_decode_bench(preset: str, quant: str, steps: int, multi: int,
     return dispatches * multi * num_slots / dt
 
 
+class _Board:
+    """The one-JSON-line contract: whoever prints, prints best-known-now."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.result = None       # current best primary line (dict)
+        self.printed = False
+
+    def offer(self, result: dict, primary: bool) -> None:
+        with self.lock:
+            if self.result is None:
+                self.result = result
+            elif primary and self.result.get("value"):
+                # promote: previous (1B) result becomes the secondary
+                sec = {k: v for k, v in self.result.items() if k != "secondary"}
+                result["secondary"] = sec
+                self.result = result
+            elif primary:
+                self.result = result
+            elif self.result.get("value") == 0.0 and result.get("value"):
+                # primary placeholder failed — promote the working number
+                result.setdefault("note", self.result.get("note", ""))
+                self.result = result
+
+    def flush(self) -> None:
+        with self.lock:
+            if self.printed:
+                return
+            self.printed = True
+            out = self.result or {
+                "metric": "decode_throughput", "value": 0.0, "unit": "tok/s",
+                "vs_baseline": 0.0, "note": "no phase completed in budget",
+            }
+            sys.stdout.write(json.dumps(out) + "\n")
+            sys.stdout.flush()
+
+
+def _measure(board: _Board, preset: str, quant: str, steps: int, multi: int,
+             depth: int, primary: bool) -> None:
+    short = "llama8b" if "8b" in preset else "llama1b" if "1b" in preset \
+        else preset
+    base = BASELINES.get(short, 800.0)
+    t0 = time.monotonic()
+    try:
+        tok_s = run_decode_bench(preset, quant, steps, multi, depth)
+        board.offer({
+            "metric": f"decode_throughput_{short}_bs8_{quant}",
+            "value": round(tok_s, 2),
+            "unit": "tok/s",
+            "vs_baseline": round(tok_s / base, 4),
+            "phase_s": round(time.monotonic() - t0, 1),
+        }, primary)
+    except Exception as e:  # noqa: BLE001 — keep a number on the board
+        note = f"{type(e).__name__}: {e}"[:300]
+        board.offer({
+            "metric": f"decode_throughput_{short}_bs8_{quant}",
+            "value": 0.0,
+            "unit": "tok/s",
+            "vs_baseline": 0.0,
+            "note": note,
+        }, primary and board.result is None)
+        if primary:
+            # a crashed north-star phase must stay diagnosable even when a
+            # secondary number stands — annotate whatever line will print
+            with board.lock:
+                if board.result is not None and board.result.get("value"):
+                    board.result.setdefault("note", f"primary failed: {note}")
+
+
 def main() -> None:
     # env knobs for smoke runs (the driver uses the defaults); the historic
     # "debug:1b" form is accepted alongside the bare preset name
@@ -113,46 +200,37 @@ def main() -> None:
     multi = int(os.environ.get("BENCH_MULTI_STEP", "32"))
     depth = int(os.environ.get("BENCH_DEPTH", "4"))
     quant = os.environ.get("BENCH_QUANT", "int8")
-    with_secondary = os.environ.get("BENCH_SECONDARY", "1") != "0"
+    budget = float(os.environ.get("BENCH_BUDGET_S", "1320"))
+    # minimum remaining budget to even start the 8B phase: weight gen +
+    # prefill/decode compiles + timed run, measured ~200-400 s on a healthy
+    # tunnel — 480 leaves margin for a slow compile without risking the board
+    min_8b = float(os.environ.get("BENCH_8B_MIN_S", "480"))
+    deadline = time.monotonic() + budget
 
-    short = "llama8b" if "8b" in preset else "llama1b" if "1b" in preset \
-        else preset
-    try:
-        tok_s = run_decode_bench(preset, quant, steps, multi, depth)
-        base = BASELINES.get(short, 800.0)
-        result = {
-            "metric": f"decode_throughput_{short}_bs8_{quant}",
-            "value": round(tok_s, 2),
-            "unit": "tok/s",
-            "vs_baseline": round(tok_s / base, 4),
-        }
-    except Exception as e:  # noqa: BLE001 — keep a number on the board
-        result = {
-            "metric": f"decode_throughput_{short}_bs8_{quant}",
-            "value": 0.0,
-            "unit": "tok/s",
-            "vs_baseline": 0.0,
-            "note": f"{type(e).__name__}: {e}"[:300],
-        }
+    board = _Board()
+    phases: list[tuple] = []
+    if preset in ("llama3-8b", "8b"):          # cheap trend config first,
+        phases.append(("1b", "int8", False))   # then the north star
+        phases.append(("llama3-8b", quant, True))
+    else:
+        phases.append((preset, quant, True))
 
-    if with_secondary and "1b" not in preset:
-        try:
-            tok_1b = run_decode_bench("1b", "int8", steps, multi, depth)
-            sec = {
-                "metric": "decode_throughput_llama1b_bs8_int8",
-                "value": round(tok_1b, 2),
-                "unit": "tok/s",
-                "vs_baseline": round(tok_1b / BASELINES["llama1b"], 4),
-            }
-            if result["value"]:
-                result["secondary"] = sec
-            else:  # primary failed — promote the 1B line, keep the note
-                sec["note"] = result.get("note", "primary run failed")
-                result = sec
-        except Exception:
-            pass
+    def work():
+        for p, q, primary in phases:
+            remaining = deadline - time.monotonic()
+            if remaining <= 30:
+                return
+            if "8b" in p and remaining < min_8b:
+                return  # can't fit the 8B phase — the 1B line stands
+            _measure(board, p, q, steps, multi, depth, primary)
 
-    print(json.dumps(result))
+    t = threading.Thread(target=work, daemon=True)
+    t.start()
+    t.join(timeout=budget)
+    board.flush()
+    # hard-exit: a hung TPU tunnel must not keep the process (and the
+    # driver's timeout clock) alive after the number is printed
+    os._exit(0)
 
 
 if __name__ == "__main__":

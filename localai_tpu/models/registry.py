@@ -115,13 +115,15 @@ def synthetic_quantized_params(
     from localai_tpu.models import llama as mdl
     from localai_tpu.models.quant import QuantizedTensor, _group_size
 
-    if mode not in ("int8", "int4"):
+    if mode not in ("int8", "int4", "int8_w8a8"):
         raise ValueError(f"unsupported synthetic quant mode {mode!r}")
     shapes = mdl.param_shapes(cfg)
     keys = iter(jax.random.split(jax.random.key(seed), 32))
 
+    mm8 = "w8a8" if mode == "int8_w8a8" else "w8"
+
     def qweight(shape, axis, bits):
-        lim, mm = (7, "w4") if bits == 4 else (127, "w8")
+        lim, mm = (7, "w4") if bits == 4 else (127, mm8)
         # raw uint8 bits reinterpreted as int8 — no int32 intermediates
         # (randint would spike 4× the tensor size during generation)
         v = jax.lax.bitcast_convert_type(
