@@ -184,11 +184,13 @@ def _measure(board: _Board, preset: str, quant: str, steps: int, multi: int,
             "note": note,
         }, primary and board.result is None)
         if primary:
-            # a crashed north-star phase must stay diagnosable even when a
-            # secondary number stands — annotate whatever line will print
+            # a crashed north-star phase must stay diagnosable no matter
+            # which line ends up printing — annotate it under its own key
             with board.lock:
-                if board.result is not None and board.result.get("value"):
-                    board.result.setdefault("note", f"primary failed: {note}")
+                if (board.result is not None
+                        and board.result.get("metric")
+                        != f"decode_throughput_{short}_bs8_{quant}"):
+                    board.result["primary_note"] = note
 
 
 def main() -> None:

@@ -588,7 +588,11 @@ refresh();
 
 
 async def swarm_nodes(request: web.Request) -> web.Response:
-    """GET /swarm/nodes?router=URL — server-side registry fetch."""
+    """GET /swarm/nodes?router=URL — server-side registry fetch.
+
+    The target is restricted to an allowlist (the configured federation
+    router plus loopback) so an API-key holder can't use the server as an
+    internal-network probe (ADVICE r4)."""
     from localai_tpu.federation.explorer import fetch_nodes
 
     router = request.query.get("router", "http://127.0.0.1:8080")
@@ -598,6 +602,25 @@ async def swarm_nodes(request: web.Request) -> web.Response:
         # a query/fragment would neutralize the appended /federated/nodes
         # suffix and turn the proxy into a generic URL fetcher
         raise web.HTTPBadRequest(text="router URL must not carry a query")
+    from urllib.parse import urlsplit
+
+    parts = urlsplit(router)
+    if parts.username is not None or parts.password is not None:
+        # userinfo would desynchronize any naive host check from where
+        # urlopen actually connects
+        raise web.HTTPBadRequest(text="router URL must not carry userinfo")
+    cfg = getattr(_state(request), "config", None)
+    allowed = {
+        r.rstrip("/") for r in (
+            getattr(cfg, "federated_router", ""),
+            getattr(cfg, "swarm_routers", "") or "",
+        ) for r in r.split(",") if r.strip()
+    }
+    if router.rstrip("/") not in allowed and parts.hostname not in (
+            "127.0.0.1", "localhost", "::1"):
+        raise web.HTTPForbidden(
+            text="router not in the configured allowlist "
+                 "(federated_router / swarm_routers)")
     loop = asyncio.get_running_loop()
     try:
         data = await loop.run_in_executor(None, fetch_nodes, router)

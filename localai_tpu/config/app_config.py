@@ -69,6 +69,8 @@ class AppConfig:
     federated_advertise: str = ""     # address peers reach us at
                                       # (default http://<hostname>:<port>)
     peer_token: str = ""              # shared secret guarding registration
+    swarm_routers: str = ""           # extra comma-separated router URLs the
+                                      # swarm UI may query (allowlist)
 
     # observability
     debug: bool = False

@@ -23,6 +23,7 @@ import hashlib
 import json
 import logging
 import os
+import threading
 from pathlib import Path
 from typing import Optional
 
@@ -36,7 +37,9 @@ class CacheHit:
     tokens: list[int]       # the stored sequence (resident-record shaped)
     arrays: dict            # k/v (+ scales) rows for tokens[:n]
     n: int                  # cached KV rows
-    lcp: int                # usable common prefix vs the looked-up prompt
+    # no lcp field: the scheduler re-scores the hit through
+    # ModelRunner.reusable_prefix(valid_n=n) so one definition (with all
+    # feasibility gates) decides both ranking and admit behavior
 
 
 class PromptKVCache:
@@ -50,6 +53,10 @@ class PromptKVCache:
         self.min_prefix = min_prefix
         if not self.dir.exists() and not read_only:
             self.dir.mkdir(parents=True, exist_ok=True)
+        # lookup() runs on the scheduler engine thread while store()/_evict()
+        # run on the prompt-cache writer thread — every _index access (and
+        # the index-file write) goes through this lock
+        self._lock = threading.Lock()
         self._index: dict[str, list[int]] = {}
         self._load_index()
         # telemetry
@@ -85,7 +92,9 @@ class PromptKVCache:
     def lookup(self, prompt: list[int]) -> Optional[CacheHit]:
         """Entry with the longest common prefix ≥ min_prefix, or None."""
         best_key, best_tokens, best_lcp = None, None, 0
-        for key, tokens in self._index.items():
+        with self._lock:
+            items = list(self._index.items())
+        for key, tokens in items:
             lcp = 0
             for a, b in zip(tokens, prompt):
                 if a != b:
@@ -105,7 +114,8 @@ class PromptKVCache:
                 arrays = {name: z[name] for name in z.files}
         except (OSError, ValueError) as e:
             log.warning("prompt cache entry %s unreadable: %s", best_key, e)
-            self._index.pop(best_key, None)
+            with self._lock:
+                self._index.pop(best_key, None)
             self.misses += 1
             return None
         n = int(arrays["k"].shape[2])
@@ -114,8 +124,7 @@ class PromptKVCache:
         except OSError:
             pass
         self.hits += 1
-        return CacheHit(tokens=list(best_tokens), arrays=arrays, n=n,
-                        lcp=best_lcp)
+        return CacheHit(tokens=list(best_tokens), arrays=arrays, n=n)
 
     def store(self, tokens: list[int], arrays: dict) -> None:
         """Persist KV rows for ``tokens[:n]`` (n = arrays['k'].shape[2])."""
@@ -125,38 +134,43 @@ class PromptKVCache:
         if n < self.min_prefix:
             return
         key = self._key(tokens)
-        if key in self._index:
-            return
+        with self._lock:
+            if key in self._index:
+                return
         self.dir.mkdir(parents=True, exist_ok=True)
         path = self.dir / f"{key}.npz"
         tmp = self.dir / f".{key}.tmp.npz"
         with open(tmp, "wb") as f:
             np.savez(f, **arrays)
         tmp.replace(path)
-        self._index[key] = list(map(int, tokens))
-        self._write_index()
+        with self._lock:
+            self._index[key] = list(map(int, tokens))
+            self._write_index()
         self.stores += 1
         self._evict()
 
     def _evict(self) -> None:
-        if len(self._index) <= self.max_entries:
-            return
-        entries = []
-        for key in list(self._index):
-            p = self.dir / f"{key}.npz"
-            try:
-                entries.append((p.stat().st_mtime, key))
-            except OSError:
+        with self._lock:
+            if len(self._index) <= self.max_entries:
+                return
+            entries = []
+            for key in list(self._index):
+                p = self.dir / f"{key}.npz"
+                try:
+                    entries.append((p.stat().st_mtime, key))
+                except OSError:
+                    self._index.pop(key, None)
+            entries.sort()
+            for _, key in entries[: len(self._index) - self.max_entries]:
+                (self.dir / f"{key}.npz").unlink(missing_ok=True)
                 self._index.pop(key, None)
-        entries.sort()
-        for _, key in entries[: len(self._index) - self.max_entries]:
-            (self.dir / f"{key}.npz").unlink(missing_ok=True)
-            self._index.pop(key, None)
-        self._write_index()
+            self._write_index()
 
     def stats(self) -> dict:
+        with self._lock:
+            n_entries = len(self._index)
         return {
-            "entries": len(self._index),
+            "entries": n_entries,
             "hits": self.hits,
             "misses": self.misses,
             "stores": self.stores,

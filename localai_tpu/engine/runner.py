@@ -646,17 +646,22 @@ class ModelRunner:
         return int(tok)
 
     def reusable_prefix(self, slot: int, resident: Optional[list[int]],
-                        prompt: list[int]) -> int:
+                        prompt: list[int],
+                        valid_n: Optional[int] = None) -> int:
         """Tokens of ``resident`` (the slot's previous prompt+generation)
         that admit() would actually reuse for ``prompt`` — all feasibility
         gates applied: KV-validity clipping (the last sampled token's KV is
         never written), last-token recompute, minimum worthwhile length,
         and the tail bucket fitting inside the context. The scheduler ranks
         candidate slots with this same function so its choice can't
-        collapse to zero at admit time."""
+        collapse to zero at admit time. ``valid_n`` overrides the KV
+        validity frontier (disk prompt-cache hits score their own row count
+        instead of the slot's current position)."""
         if not resident or not prompt:
             return 0
-        valid = resident[: self.slot_position(slot)]
+        if valid_n is None:
+            valid_n = self.slot_position(slot)
+        valid = resident[:valid_n]
         lcp = 0
         for a, b in zip(valid, prompt):
             if a != b:

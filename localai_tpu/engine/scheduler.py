@@ -583,7 +583,16 @@ class Scheduler:
                 if resident else 0
             )
             hit = self.prompt_cache.lookup(req.prompt)
-            if (hit is not None and hit.lcp > mem_lcp
+            # score the disk hit through the same feasibility gates as the
+            # in-memory resident (validity = its own row count): a hit whose
+            # tail bucket can't fit would admit() as a full prefill, losing
+            # in-memory reuse that was available (ADVICE r4)
+            disk_lcp = (
+                self._engine.reusable_prefix(
+                    slot, hit.tokens, req.prompt, valid_n=hit.n)
+                if hit is not None else 0
+            )
+            if (disk_lcp > mem_lcp
                     and self.runner.load_prefix(slot, hit.arrays, hit.n)):
                 resident = hit.tokens
         first = self._engine.admit(

@@ -285,8 +285,9 @@ class SpecDecoder:
     def set_bias(self, slot: int, bias_row) -> None:
         self.target.set_bias(slot, bias_row)
 
-    def reusable_prefix(self, slot: int, resident, prompt) -> int:
-        return self.target.reusable_prefix(slot, resident, prompt)
+    def reusable_prefix(self, slot: int, resident, prompt,
+                        valid_n=None) -> int:
+        return self.target.reusable_prefix(slot, resident, prompt, valid_n)
 
     def slot_position(self, slot: int) -> int:
         return self.target.slot_position(slot)

@@ -268,6 +268,35 @@ def gguf_to_hf_config(meta: dict) -> dict:
             g("attention.layer_norm_rms_epsilon", 1e-5)),
         "tie_word_embeddings": False,
     }
+    # non-default head_dim ({arch}.attention.key_length — e.g. gemma-style
+    # wide heads): without it the converted checkpoint gets wrong shapes
+    key_len = g("attention.key_length")
+    if key_len is not None and int(key_len) != cfg["hidden_size"] // heads:
+        cfg["head_dim"] = int(key_len)
+    # rope scaling ({arch}.rope.scaling.*): a Llama-3.1-class GGUF converted
+    # without this serves silently wrong RoPE beyond the base context
+    stype = g("rope.scaling.type")
+    if stype and stype != "none":
+        rope_type = {"linear": "linear", "yarn": "yarn",
+                     "llama3": "llama3"}.get(str(stype))
+        if rope_type is None:
+            log.warning(
+                "gguf: unsupported rope scaling type %r — emitting config "
+                "without rope_scaling (long-context behavior will differ)",
+                stype,
+            )
+        else:
+            rs: dict = {"rope_type": rope_type}
+            factor = g("rope.scaling.factor")
+            if factor is not None:
+                rs["factor"] = float(factor)
+            octx = g("rope.scaling.original_context_length")
+            if octx is not None:
+                rs["original_max_position_embeddings"] = int(octx)
+            attn_f = g("rope.scaling.attn_factor")
+            if attn_f is not None:
+                rs["attention_factor"] = float(attn_f)
+            cfg["rope_scaling"] = rs
     return cfg
 
 

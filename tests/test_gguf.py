@@ -254,3 +254,46 @@ def test_unpermute_inverts_llamacpp_permute():
                     .swapaxes(1, 2).reshape(w.shape))
         assert not np.array_equal(permuted, w)
         np.testing.assert_array_equal(G._unpermute(permuted, heads), w)
+
+
+def test_config_maps_rope_scaling_and_head_dim():
+    """GGUF rope-scaling metadata and a non-default head_dim must survive
+    into the emitted HF config (ADVICE r4: a Llama-3.1-class GGUF otherwise
+    serves silently wrong RoPE beyond the base context)."""
+    meta = {
+        "general.architecture": "llama",
+        "llama.embedding_length": 64,
+        "llama.attention.head_count": 4,
+        "llama.attention.head_count_kv": 2,
+        "llama.attention.key_length": 32,          # != 64 // 4
+        "llama.rope.scaling.type": "yarn",
+        "llama.rope.scaling.factor": 4.0,
+        "llama.rope.scaling.original_context_length": 4096,
+        "llama.rope.scaling.attn_factor": 1.2,
+    }
+    cfg = G.gguf_to_hf_config(meta)
+    assert cfg["head_dim"] == 32
+    rs = cfg["rope_scaling"]
+    assert rs["rope_type"] == "yarn"
+    assert rs["factor"] == 4.0
+    assert rs["original_max_position_embeddings"] == 4096
+    assert rs["attention_factor"] == 1.2
+    # default head_dim is omitted; unsupported scaling type is dropped
+    cfg2 = G.gguf_to_hf_config({
+        "general.architecture": "llama",
+        "llama.embedding_length": 64,
+        "llama.attention.head_count": 4,
+        "llama.attention.key_length": 16,
+        "llama.rope.scaling.type": "longrope",
+    })
+    assert "head_dim" not in cfg2      # 16 == 64 // 4, the derived default
+    assert "rope_scaling" not in cfg2
+    cfg3 = G.gguf_to_hf_config({
+        "general.architecture": "llama",
+        "llama.embedding_length": 64,
+        "llama.attention.head_count": 4,
+        "llama.attention.key_length": 16,
+        "llama.rope.scaling.type": "linear",
+        "llama.rope.scaling.factor": 2.0,
+    })
+    assert cfg3["rope_scaling"] == {"rope_type": "linear", "factor": 2.0}

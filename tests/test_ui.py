@@ -158,6 +158,18 @@ def test_swarm_nodes_proxy(server):
                 "/swarm/nodes",
                 params={"router": "http://127.0.0.1:1"},
             ).status_code == 502
+            # non-loopback, non-configured routers are refused: the proxy
+            # must not double as an internal-network probe
+            assert c.get(
+                "/swarm/nodes",
+                params={"router": "http://10.99.0.1:8500"},
+            ).status_code == 403
+            # userinfo must not smuggle a loopback-looking host past the
+            # allowlist (urlopen would connect to 10.99.0.1)
+            assert c.get(
+                "/swarm/nodes",
+                params={"router": "http://127.0.0.1:x@10.99.0.1:8500"},
+            ).status_code == 400
     finally:
         fut = asyncio.run_coroutine_threadsafe(
             port_box["runner"].cleanup(), loop)
