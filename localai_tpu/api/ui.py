@@ -604,14 +604,17 @@ async def swarm_nodes(request: web.Request) -> web.Response:
         raise web.HTTPBadRequest(text="router URL must not carry a query")
     from urllib.parse import urlsplit
 
-    parts = urlsplit(router)
+    try:
+        parts = urlsplit(router)
+    except ValueError:
+        raise web.HTTPBadRequest(text="malformed router URL")
     if parts.username is not None or parts.password is not None:
         # userinfo would desynchronize any naive host check from where
         # urlopen actually connects
         raise web.HTTPBadRequest(text="router URL must not carry userinfo")
     cfg = getattr(_state(request), "config", None)
     allowed = {
-        r.rstrip("/") for r in (
+        r.strip().rstrip("/") for r in (
             getattr(cfg, "federated_router", ""),
             getattr(cfg, "swarm_routers", "") or "",
         ) for r in r.split(",") if r.strip()

@@ -198,15 +198,19 @@ def _sinusoids(length: int, channels: int) -> jax.Array:
 def encode(cfg: WhisperConfig, params: PyTree, mel: jax.Array) -> jax.Array:
     """mel [n_mels, frames] → encoder states [frames//2, D]."""
     x = mel.T[None]  # [1, frames, n_mels]
+    # explicit (1, 1) padding, NOT "SAME": at conv2's stride 2, XLA SAME
+    # resolves to (0, 1) while the reference torch Conv1d(padding=1) pads
+    # both sides — SAME silently shifted every frame by one input step
+    # (caught by tests/test_llama_torch.py::test_whisper_matches_torch)
     x = jax.nn.gelu(
         lax.conv_general_dilated(
-            x, params["conv1_w"].transpose(2, 1, 0), (1,), "SAME",
+            x, params["conv1_w"].transpose(2, 1, 0), (1,), ((1, 1),),
             dimension_numbers=("NWC", "WIO", "NWC"),
         ) + params["conv1_b"]
     )
     x = jax.nn.gelu(
         lax.conv_general_dilated(
-            x, params["conv2_w"].transpose(2, 1, 0), (2,), "SAME",
+            x, params["conv2_w"].transpose(2, 1, 0), (2,), ((1, 1),),
             dimension_numbers=("NWC", "WIO", "NWC"),
         ) + params["conv2_b"]
     )

@@ -1,0 +1,200 @@
+"""Cross-framework numerics: the llama engine and whisper against their
+torch/transformers reference implementations on tiny random checkpoints.
+
+This closes the flagship-path correctness blind spot (VERDICT r4 #3): the
+repo torch-verifies mamba/rwkv/vits/musicgen/image, but the two
+highest-traffic paths — the llama serving engine and whisper — were pinned
+only by self-consistency tests. Pattern follows tests/test_vits.py: build a
+tiny random HF model, save_pretrained → the repo's own loader → compare.
+
+Covers: plain llama, GQA + llama3-type rope scaling, qwen2 attention bias
+(the reference serves all three families through llama.cpp — gallery
+index.yaml llama3/qwen2 entries), prefill logits, and greedy decode through
+the real ModelRunner (KV cache + bucketed prefill + on-device sampling).
+"""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+from transformers import LlamaConfig as HFLlamaConfig  # noqa: E402
+from transformers import LlamaForCausalLM  # noqa: E402
+from transformers import Qwen2Config as HFQwen2Config  # noqa: E402
+from transformers import Qwen2ForCausalLM  # noqa: E402
+
+from localai_tpu.models.loader import load_llama_params  # noqa: E402
+
+
+def _load_f32(d):
+    import dataclasses
+
+    cfg, params = load_llama_params(d, dtype="float32")
+    # the loader keeps the config's serving dtype (bfloat16); numerics
+    # comparison wants the whole forward in f32
+    return dataclasses.replace(cfg, dtype="float32"), params
+
+
+def _save(model, tmp_path, name):
+    d = tmp_path / name
+    model.save_pretrained(d, safe_serialization=True)
+    return d
+
+
+def _tiny_llama(seed=0, **kw):
+    torch.manual_seed(seed)
+    base = dict(
+        vocab_size=96, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=4,
+        max_position_embeddings=256, rope_theta=10000.0,
+        tie_word_embeddings=False,
+    )
+    base.update(kw)
+    return LlamaForCausalLM(HFLlamaConfig(**base)).eval()
+
+
+def _tiny_qwen2(seed=3):
+    torch.manual_seed(seed)
+    cfg = HFQwen2Config(
+        vocab_size=96, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=256, rope_theta=10000.0,
+        tie_word_embeddings=False,
+    )
+    return Qwen2ForCausalLM(cfg).eval()   # qkv bias on by default
+
+
+def _our_prefill_logits(cfg, params, prompt, max_ctx=64):
+    """Logits for every prompt position through the engine's own forward
+    (same mask/rope/kv plumbing as ModelRunner._prefill_fn)."""
+    import jax.numpy as jnp
+
+    from localai_tpu.engine import kvcache as kvc
+    from localai_tpu.models import llama as mdl
+
+    bucket = len(prompt)
+    tokens = jnp.asarray(np.asarray(prompt, np.int32)[None])
+    positions = jnp.arange(bucket, dtype=jnp.int32)[None]
+    kv = kvc.init_cache(cfg, 1, max_ctx, "float32")
+    mask = kvc.prefill_mask(cfg, bucket, jnp.int32(bucket))
+    write = kvc.prefill_write(jnp.int32(0), jnp.zeros((), jnp.int32))
+    rope = mdl.rope_table(cfg, max_ctx)
+    hidden, _ = mdl.forward(
+        cfg, params, tokens, positions, write, kv.stacked(), mask, rope
+    )
+    return np.asarray(mdl.logits_from_hidden(cfg, params, hidden[0]))
+
+
+def _torch_logits(model, prompt):
+    with torch.no_grad():
+        return model(torch.tensor([prompt])).logits[0].float().numpy()
+
+
+def _greedy_torch(model, prompt, n):
+    ids = list(prompt)
+    with torch.no_grad():
+        for _ in range(n):
+            logits = model(torch.tensor([ids])).logits[0, -1]
+            ids.append(int(logits.argmax()))
+    return ids[len(prompt):]
+
+
+def _greedy_ours(cfg, params, prompt, n):
+    from localai_tpu.engine.runner import ModelRunner
+
+    runner = ModelRunner(
+        cfg, params, num_slots=2, max_ctx=64, prefill_buckets=[16, 32],
+        kv_dtype="float32",
+    )
+    slot = runner.acquire_slot()
+    out = [runner.admit(slot, list(prompt), temperature=0.0)]
+    while len(out) < n:
+        out.append(int(runner.step()[slot]))
+    return out
+
+
+CASES = [
+    ("llama", {}),
+    ("llama_gqa_rope3", dict(
+        num_key_value_heads=2,
+        rope_scaling={
+            "rope_type": "llama3", "factor": 4.0, "low_freq_factor": 1.0,
+            "high_freq_factor": 4.0, "original_max_position_embeddings": 64,
+        },
+    )),
+    ("qwen2_bias", None),
+]
+
+
+@pytest.mark.parametrize("name,kw", CASES)
+def test_prefill_logits_match_torch(name, kw, tmp_path):
+    model = _tiny_qwen2() if kw is None else _tiny_llama(**kw)
+    d = _save(model, tmp_path, name)
+    cfg, params = _load_f32(d)
+    if kw is None:
+        assert cfg.attention_bias
+    prompt = [5, 17, 3, 42, 9, 88, 1, 63]
+    ours = _our_prefill_logits(cfg, params, prompt)
+    ref = _torch_logits(model, prompt)
+    np.testing.assert_allclose(ours, ref, atol=2e-4, rtol=2e-4)
+
+
+@pytest.mark.parametrize("name,kw", CASES)
+def test_engine_greedy_decode_matches_torch(name, kw, tmp_path):
+    model = _tiny_qwen2() if kw is None else _tiny_llama(**kw)
+    d = _save(model, tmp_path, name)
+    cfg, params = _load_f32(d)
+    prompt = [5, 17, 3, 42, 9, 88, 1, 63]
+    n = 12
+    assert _greedy_ours(cfg, params, prompt, n) == \
+        _greedy_torch(model, prompt, n)
+
+
+def test_whisper_matches_torch(tmp_path):
+    """Encoder + teacher-forced decoder logits against HF whisper."""
+    from transformers import WhisperConfig as HFWhisperConfig
+    from transformers import WhisperForConditionalGeneration
+
+    from localai_tpu.models import whisper as wh
+
+    torch.manual_seed(1)
+    hf_cfg = HFWhisperConfig(
+        vocab_size=128, num_mel_bins=16, d_model=32,
+        encoder_layers=2, encoder_attention_heads=2,
+        decoder_layers=2, decoder_attention_heads=2,
+        encoder_ffn_dim=64, decoder_ffn_dim=64,
+        max_source_positions=40, max_target_positions=24,
+        pad_token_id=0, bos_token_id=1, eos_token_id=2,
+        decoder_start_token_id=1, suppress_tokens=[],
+        begin_suppress_tokens=[],
+    )
+    model = WhisperForConditionalGeneration(hf_cfg).eval()
+    d = tmp_path / "whisper"
+    model.save_pretrained(d, safe_serialization=True)
+    ours = wh.load_hf_whisper(d)
+
+    rng = np.random.default_rng(0)
+    # HF conv2 stride-2 halves the frame axis: feed 2*max_source_positions
+    mel = rng.normal(size=(16, 80)).astype(np.float32) * 0.3
+    dec_ids = [3, 7, 11, 2]
+    with torch.no_grad():
+        enc_ref = model.model.encoder(
+            torch.tensor(mel[None])).last_hidden_state[0].numpy()
+        logits_ref = model(
+            input_features=torch.tensor(mel[None]),
+            decoder_input_ids=torch.tensor([dec_ids]),
+        ).logits[0].numpy()
+
+    import jax.numpy as jnp
+
+    enc = wh.encode(ours.cfg, ours.params, jnp.asarray(mel))
+    np.testing.assert_allclose(np.asarray(enc), enc_ref, atol=2e-4, rtol=2e-4)
+    # decode_logits returns the logits at position length-1 of a padded
+    # token buffer — teacher-force each prefix length
+    padded = jnp.asarray(np.asarray(dec_ids, np.int32))
+    for ln in range(1, len(dec_ids) + 1):
+        logits = wh.decode_logits(
+            ours.cfg, ours.params, padded, jnp.int32(ln), enc
+        )
+        np.testing.assert_allclose(
+            np.asarray(logits), logits_ref[ln - 1], atol=2e-4, rtol=2e-4
+        )
