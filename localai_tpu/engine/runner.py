@@ -189,12 +189,17 @@ class ModelRunner:
         )
         # sequence-parallel prefill: long prompts chunk over the 'seq' mesh
         # axis and run ring attention (parallel.ring) straight into the
-        # slot cache. TP×SP param-sharding composition is future work, so
-        # the route opens only on a pure-SP mesh.
+        # slot cache. Composes with TP: weights stay 'model'-sharded
+        # (Megatron layout + per-layer psums) while activations shard over
+        # 'seq' — requires the head groups to split evenly so each device's
+        # ring carries a consistent Hkv/tp head shard.
+        sp_tp = mesh.shape.get("model", 1) if mesh is not None else 1
         self.sp_enabled = (
             mesh is not None
             and mesh.shape.get("seq", 1) > 1
-            and mesh.shape.get("model", 1) == 1
+            and (sp_tp == 1
+                 or (cfg.num_heads % sp_tp == 0
+                     and cfg.num_kv_heads % sp_tp == 0))
         )
         self.sp_threshold = sp_threshold
         self.last_prefill_path = ""

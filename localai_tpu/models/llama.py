@@ -214,10 +214,21 @@ def init_params(rng: jax.Array, cfg: LlamaConfig) -> PyTree:
 # Forward
 # ---------------------------------------------------------------------------
 
-def _layer(cfg: LlamaConfig, x, lp, cos, sin, attend):
+def _layer(cfg: LlamaConfig, x, lp, cos, sin, attend, reduce=None):
     """One decoder layer. ``attend(q, k_new, v_new) -> (attn_out, new_kv)``
-    is injected so prefill/decode/KV-cache policies stay out of the math."""
+    is injected so prefill/decode/KV-cache policies stay out of the math.
+
+    ``reduce`` (optional) is applied to the two row-parallel matmul outputs
+    (attention-out, mlp-down) — under manual tensor parallelism inside
+    shard_map it is ``lax.psum(·, 'model')``, turning the per-device
+    partial sums into the Megatron two-psums-per-layer pattern. When None
+    (single device, or GSPMD-managed sharding) the products are complete."""
     Hq, Hkv, hd = cfg.num_heads, cfg.num_kv_heads, cfg.hd
+    if reduce is not None:
+        # local head counts under manual TP: weight shards carry Hq/tp and
+        # Hkv/tp heads on each device
+        Hq = lp["wq"].shape[-1] // hd
+        Hkv = lp["wk"].shape[-1] // hd
 
     h = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
     q = qnt.matmul(h, lp["wq"])
@@ -235,11 +246,13 @@ def _layer(cfg: LlamaConfig, x, lp, cos, sin, attend):
 
     attn, new_kv = attend(q, k, v)
     attn = attn.reshape(*attn.shape[:-2], Hq * hd)
-    x = x + qnt.matmul(attn, lp["wo"])
+    wo_out = qnt.matmul(attn, lp["wo"])
+    x = x + (reduce(wo_out) if reduce is not None else wo_out)
 
     h = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
     gated = jax.nn.silu(qnt.matmul(h, lp["w_gate"])) * qnt.matmul(h, lp["w_up"])
-    x = x + qnt.matmul(gated, lp["w_down"])
+    down = qnt.matmul(gated, lp["w_down"])
+    x = x + (reduce(down) if reduce is not None else down)
     return x, new_kv
 
 

@@ -102,6 +102,23 @@ def ring_attention(
     return out.astype(q.dtype)
 
 
+def _tp_param_specs(cfg: LlamaConfig, mesh: Mesh, params: Any) -> Any:
+    """Per-leaf PartitionSpecs for the trunk params under TP ('model' axis),
+    mirroring parallel.sharding.shard_params' placement (quantized leaves
+    expand to (q, scale) specs)."""
+    from localai_tpu.models.llama import param_shapes
+    from localai_tpu.parallel import sharding as shd
+
+    specs = shd.param_specs(cfg, mesh, shapes=param_shapes(cfg))
+    # drop spec entries (lm_head) that the trunk params may not carry
+    specs = {k: v for k, v in specs.items() if k in params}
+    return jax.tree.map(
+        lambda sp, arr: shd.expand_quantized_spec(sp, arr, mesh),
+        specs, {k: params[k] for k in specs},
+        is_leaf=lambda x: isinstance(x, P),
+    )
+
+
 def sp_prefill_forward(
     cfg: LlamaConfig,
     params: Any,
@@ -110,26 +127,65 @@ def sp_prefill_forward(
     mesh: Mesh,
     rope: tuple[jax.Array, jax.Array],
 ) -> tuple[jax.Array, tuple[jax.Array, jax.Array]]:
-    """Sequence-parallel prefill of one long sequence.
+    """Sequence/context-parallel prefill of one long sequence, composed
+    with tensor parallelism when the mesh's 'model' axis is >1.
+
+    Composition (SURVEY §5.7 "sequence-sharded prefill over ICI"):
+      * activations shard over 'seq' (each device owns a token chunk);
+      * weights shard over 'model' exactly as in decode (Megatron layout,
+        parallel.sharding.param_specs) — each device computes its local
+        head group / ffn slice and the two row-parallel products psum over
+        'model' (models.llama._layer's ``reduce`` hook);
+      * ring attention rotates KV chunks over the 'seq' ICI ring per local
+        head group — the two axes compose orthogonally (KV hops carry
+        Hkv/tp heads, so TP also shrinks ring traffic per device);
+      * a vocab-sharded embedding gathers locally and psums over 'model'.
 
     Returns (hidden [1, T, D], (k, v) each [L, T, Hkv, hd]) with T sharded
-    on the 'seq' axis. NOTE: the slot cache (engine.kvcache) is head-major
-    [L, S, Hkv, C, hd] — transpose the returned stacks to [L, Hkv, T, hd]
-    before inserting into a slot.
+    on 'seq' and Hkv sharded on 'model'. NOTE: the slot cache
+    (engine.kvcache) is head-major [L, S, Hkv, C, hd] — transpose the
+    returned stacks to [L, Hkv, T, hd] before inserting into a slot.
     """
     n = mesh.shape["seq"]
+    tp = mesh.shape.get("model", 1)
     T = tokens.shape[0]
     if T % n:
         raise ValueError(f"sequence length {T} not divisible by seq={n}")
+    if tp > 1 and (cfg.num_heads % tp or cfg.num_kv_heads % tp):
+        raise ValueError(
+            f"heads ({cfg.num_heads} q / {cfg.num_kv_heads} kv) not "
+            f"divisible by tensor_parallel {tp}"
+        )
     Tc = T // n
     dtype = jnp.dtype(cfg.dtype)
+    reduce = (lambda t: lax.psum(t, "model")) if tp > 1 else None
+
+    if tp > 1:
+        pspec = _tp_param_specs(cfg, mesh, params)
+        embed_sharded = tuple(pspec["embed"].q if hasattr(pspec["embed"], "q")
+                              else pspec["embed"])[:1] == ("model",)
+    else:
+        pspec = jax.tree.map(lambda _: P(), params)
+        embed_sharded = False
+
+    def embed_local(table, ids):
+        """Token gather under a vocab-sharded table: local rows + psum."""
+        v_local = table.shape[0]
+        offset = lax.axis_index("model") * v_local
+        local = jnp.clip(ids - offset, 0, v_local - 1)
+        rows = qnt.embed_rows(table, local, dtype)
+        in_range = ((ids >= offset) & (ids < offset + v_local))[..., None]
+        return lax.psum(jnp.where(in_range, rows, 0), "model")
 
     def local_fn(params, tokens_c, length, cos_t, sin_t):
         i = lax.axis_index("seq")
         positions = i * Tc + jnp.arange(Tc, dtype=jnp.int32)
         cos = cos_t[positions][None, :, None, :]
         sin = sin_t[positions][None, :, None, :]
-        x = qnt.embed_rows(params["embed"], tokens_c[None], dtype)
+        if embed_sharded:
+            x = embed_local(params["embed"], tokens_c[None])
+        else:
+            x = qnt.embed_rows(params["embed"], tokens_c[None], dtype)
 
         def body(carry, lp):
             def attend(q, k_new, v_new):
@@ -139,20 +195,20 @@ def sp_prefill_forward(
                 )
                 return out[None], (k_new[0], v_new[0])
 
-            return mdl._layer(cfg, carry, lp, cos, sin, attend)
+            return mdl._layer(cfg, carry, lp, cos, sin, attend, reduce=reduce)
 
         x, kvs = lax.scan(body, x, params["layers"])
         x = mdl.rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
         return x, kvs
 
-    pspec = jax.tree.map(lambda _: P(), params)
+    kv_heads = "model" if tp > 1 else None
     fn = shard_map(
         local_fn,
         mesh=mesh,
         in_specs=(pspec, P("seq"), P(), P(), P()),
         out_specs=(
             P(None, "seq", None),
-            (P(None, "seq", None, None), P(None, "seq", None, None)),
+            (P(None, "seq", kv_heads, None), P(None, "seq", kv_heads, None)),
         ),
         check_vma=False,
     )

@@ -131,32 +131,39 @@ def state_specs(mesh: Mesh) -> dict:
     }
 
 
+def expand_quantized_spec(spec_leaf: P, arr: Any, mesh: Mesh) -> Any:
+    """Spec for one param leaf: plain arrays keep ``spec_leaf``; quantized
+    weights (models.quant.QuantizedTensor) expand to a QuantizedTensor of
+    specs — q with the weight's spec, the per-output-channel scale with the
+    same spec minus the contracted axis — so a 'model'-sharded weight keeps
+    its scales sharded alongside its output channels and the dequant
+    epilogue stays local. The single source of truth for both placement
+    (shard_params) and manual-SPMD in_specs (parallel.ring)."""
+    from localai_tpu.models.quant import QuantizedTensor, quantized_spec
+
+    if isinstance(arr, QuantizedTensor):
+        s_spec = _sanitize(
+            quantized_spec(spec_leaf, arr.axis, grouped=arr.mode == "w4"),
+            arr.scale.shape, mesh,
+        )
+        return QuantizedTensor(
+            q=spec_leaf, scale=s_spec, axis=arr.axis, mode=arr.mode)
+    return spec_leaf
+
+
 def shard_params(
     params: Any, cfg: LlamaConfig, mesh: Mesh
 ) -> Any:
-    """Place an already-loaded param pytree onto the mesh.
-
-    Quantized weights (models.quant.QuantizedTensor) place q with the
-    weight's spec and the per-output-channel scale with the same spec minus
-    the contracted axis — a 'model'-sharded weight keeps its scales sharded
-    alongside its output channels, so the dequant epilogue stays local."""
-    from localai_tpu.models.quant import QuantizedTensor, quantized_spec
-
+    """Place an already-loaded param pytree onto the mesh (specs per
+    param_specs + expand_quantized_spec)."""
     specs = param_specs(cfg, mesh)
 
     def put(spec_leaf, arr):
-        if isinstance(arr, QuantizedTensor):
-            s_spec = _sanitize(
-                quantized_spec(spec_leaf, arr.axis, grouped=arr.mode == "w4"),
-                arr.scale.shape, mesh,
-            )
-            return QuantizedTensor(
-                q=jax.device_put(arr.q, NamedSharding(mesh, spec_leaf)),
-                scale=jax.device_put(arr.scale, NamedSharding(mesh, s_spec)),
-                axis=arr.axis,
-                mode=arr.mode,
-            )
-        return jax.device_put(arr, NamedSharding(mesh, spec_leaf))
+        spec = expand_quantized_spec(spec_leaf, arr, mesh)
+        return jax.tree.map(
+            lambda s, a: jax.device_put(a, NamedSharding(mesh, s)),
+            spec, arr, is_leaf=lambda x: isinstance(x, P),
+        )
 
     return jax.tree.map(
         put, specs, params, is_leaf=lambda x: isinstance(x, P)

@@ -173,3 +173,47 @@ def test_int8_engine_prefix_resume_under_mesh(small):
     assert reused >= 16  # the resume path actually engaged under the mesh
     assert got1 == ref1
     assert got2 == ref2
+
+
+def test_sp_prefill_composes_with_tp(small):
+    """TP×SP at the engine level (VERDICT r4 #4): a seq=4 × model=2 mesh
+    serves a long prompt through the ring-attention prefill with
+    'model'-sharded weights, matching the unsharded greedy output."""
+    mesh = build_mesh(MeshPlan(seq=4, model=2))
+    sp = shd.shard_params(small.params, small.cfg, mesh)
+    r = ModelRunner(small.cfg, sp, num_slots=2, max_ctx=512,
+                    prefill_buckets=[64, 256], mesh=mesh, sp_threshold=100)
+    assert r.sp_enabled
+    p = list(range(1, 201))
+    s = r.acquire_slot()
+    out = [r.admit(s, p, temperature=0.0)] + [int(r.step()[s])
+                                              for _ in range(6)]
+    assert r.last_prefill_path == "sp"
+
+    rx = ModelRunner(small.cfg, small.params, num_slots=2, max_ctx=512,
+                     prefill_buckets=[64, 256])
+    s2 = rx.acquire_slot()
+    ref = [rx.admit(s2, p, temperature=0.0)] + [int(rx.step()[s2])
+                                                for _ in range(6)]
+    assert out == ref
+
+
+def test_sp_tp_gate_closed_for_indivisible_heads(small):
+    """A config whose head counts don't divide the 'model' axis must keep
+    the SP route closed instead of serving a wrong shard layout."""
+    import dataclasses
+
+    mesh = build_mesh(MeshPlan(seq=2, model=4))
+    cfg = dataclasses.replace(small.cfg, num_kv_heads=3, num_heads=6,
+                              head_dim=32)
+    from localai_tpu.models import llama as mdl
+
+    params = mdl.init_params(jax.random.key(1), cfg)
+    # param_specs itself refuses this layout; replicate instead — the
+    # runner must still keep the SP route closed
+    repl = jax.tree.map(
+        lambda a: jax.device_put(a, NamedSharding(mesh, P())), params
+    )
+    r = ModelRunner(cfg, repl, num_slots=2, max_ctx=256,
+                    prefill_buckets=[64], mesh=mesh, sp_threshold=100)
+    assert not r.sp_enabled

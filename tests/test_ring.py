@@ -117,3 +117,46 @@ def test_sp_prefill_sliding_window_model(seq_mesh):
     ref, _ = _reference_forward(cfg, base.params, tokens, jnp.int32(length))
     np.testing.assert_allclose(np.asarray(hidden)[0], np.asarray(ref)[0],
                                rtol=2e-4, atol=2e-4)
+
+
+def test_sp_prefill_tp_composition():
+    """TP×SP (VERDICT r4 #4): weights 'model'-sharded (Megatron layout),
+    activations 'seq'-sharded, ring attention per local head group — must
+    match the single-device trunk."""
+    from localai_tpu.parallel import sharding as shd
+
+    mesh = build_mesh(MeshPlan(seq=4, model=2))
+    model = resolve_model("debug:tiny", dtype="float32")
+    sp = shd.shard_params(model.params, model.cfg, mesh)
+    T, length = 64, 57
+    rng = np.random.default_rng(5)
+    tokens = jnp.asarray(rng.integers(0, model.cfg.vocab_size, T), jnp.int32)
+
+    hidden, (k, v) = sp_prefill_forward(
+        model.cfg, sp, tokens, jnp.int32(length), mesh,
+        mdl.rope_table(model.cfg, T),
+    )
+    ref, (ref_k, ref_v) = _reference_forward(
+        model.cfg, model.params, tokens, jnp.int32(length)
+    )
+    np.testing.assert_allclose(
+        np.asarray(hidden)[0, :length], np.asarray(ref)[0, :length],
+        rtol=2e-4, atol=2e-4,
+    )
+    np.testing.assert_allclose(np.asarray(k)[:, :length],
+                               np.asarray(ref_k)[:, :length],
+                               rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(np.asarray(v)[:, :length],
+                               np.asarray(ref_v)[:, :length],
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_sp_prefill_tp_requires_divisible_heads():
+    mesh = build_mesh(MeshPlan(seq=4, model=2))
+    cfg = LlamaConfig(num_heads=3, num_kv_heads=3, head_dim=8,
+                      hidden_size=24, vocab_size=64, num_layers=1,
+                      intermediate_size=32, dtype="float32")
+    params = mdl.init_params(jax.random.key(0), cfg)
+    with pytest.raises(ValueError, match="divisible"):
+        sp_prefill_forward(cfg, params, jnp.zeros(16, jnp.int32),
+                           jnp.int32(16), mesh, mdl.rope_table(cfg, 16))
