@@ -199,7 +199,11 @@ class ModelRunner:
             and mesh.shape.get("seq", 1) > 1
             and (sp_tp == 1
                  or (cfg.num_heads % sp_tp == 0
-                     and cfg.num_kv_heads % sp_tp == 0))
+                     and cfg.num_kv_heads % sp_tp == 0
+                     and cfg.intermediate_size % sp_tp == 0))
+            # expert-parallel MoE prefill stays on the GSPMD path — the
+            # manual ring shard_map doesn't slice router weights per shard
+            and (cfg.num_experts == 0 or mesh.shape.get("expert", 1) == 1)
         )
         self.sp_threshold = sp_threshold
         self.last_prefill_path = ""

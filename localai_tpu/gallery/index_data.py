@@ -20,6 +20,7 @@ _SHARDS = {
     2: [f"model-{i:05d}-of-00002.safetensors" for i in range(1, 3)],
     3: [f"model-{i:05d}-of-00003.safetensors" for i in range(1, 4)],
     4: [f"model-{i:05d}-of-00004.safetensors" for i in range(1, 5)],
+    19: [f"model-{i:05d}-of-00019.safetensors" for i in range(1, 20)],
 }
 
 
@@ -107,6 +108,12 @@ _ENTRIES: list[GalleryModel] = [
     _llm("openhermes-2.5-mistral-7b", "teknium/OpenHermes-2.5-Mistral-7B",
          "OpenHermes 2.5 Mistral 7B", ctx=32768, files=_sharded(2),
          license="apache-2.0"),
+    _llm("mixtral-8x7b-instruct", "mistralai/Mixtral-8x7B-Instruct-v0.1",
+         "Mixtral 8x7B sparse MoE instruct (8 experts, top-2 routing; "
+         "expert-sharded over the 'expert' mesh axis)",
+         ctx=32768, files=_sharded(19), license="apache-2.0",
+         tags=["moe"],
+         sharding={"expert_parallel_size": 8}),
     # -- qwen family --------------------------------------------------------
     _llm("qwen2.5-0.5b-instruct", "Qwen/Qwen2.5-0.5B-Instruct",
          "Qwen 2.5 0.5B Instruct", ctx=32768, license="apache-2.0",

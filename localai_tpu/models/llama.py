@@ -50,6 +50,9 @@ class LlamaConfig:
     attention_bias: bool = False          # Qwen2-style qkv bias
     rope_scaling: Optional[dict] = None   # HF rope_scaling dict
     sliding_window: Optional[int] = None  # Mistral-style (mask-only)
+    num_experts: int = 0                  # Mixtral-class sparse MoE MLP
+                                          # (0 = dense mlp)
+    num_experts_per_tok: int = 2          # router top-k
     dtype: str = "bfloat16"
 
     @property
@@ -80,6 +83,8 @@ class LlamaConfig:
             or hf.get("model_type") == "qwen2",
             rope_scaling=hf.get("rope_scaling"),
             sliding_window=hf.get("sliding_window"),
+            num_experts=hf.get("num_local_experts", 0),
+            num_experts_per_tok=hf.get("num_experts_per_tok", 2),
         )
 
 
@@ -186,6 +191,17 @@ def param_shapes(cfg: LlamaConfig) -> dict:
             "w_down": (L, F, D),
         },
     }
+    if cfg.num_experts:
+        E = cfg.num_experts
+        # Mixtral-class sparse MoE: expert-stacked ffn + a tiny router.
+        # The leading E axis shards over the 'expert' mesh axis
+        # (parallel.sharding), F over 'model' — expert × tensor parallelism.
+        shapes["layers"].update({
+            "moe_gate": (L, D, E),
+            "w_gate": (L, E, D, F),
+            "w_up": (L, E, D, F),
+            "w_down": (L, E, F, D),
+        })
     if cfg.attention_bias:
         shapes["layers"]["bq"] = (L, Hq * hd)
         shapes["layers"]["bk"] = (L, Hkv * hd)
@@ -250,10 +266,43 @@ def _layer(cfg: LlamaConfig, x, lp, cos, sin, attend, reduce=None):
     x = x + (reduce(wo_out) if reduce is not None else wo_out)
 
     h = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
-    gated = jax.nn.silu(qnt.matmul(h, lp["w_gate"])) * qnt.matmul(h, lp["w_up"])
-    down = qnt.matmul(gated, lp["w_down"])
-    x = x + (reduce(down) if reduce is not None else down)
+    if "moe_gate" in lp:
+        out = _moe_mlp(cfg, h, lp, reduce)
+    else:
+        gated = (jax.nn.silu(qnt.matmul(h, lp["w_gate"]))
+                 * qnt.matmul(h, lp["w_up"]))
+        down = qnt.matmul(gated, lp["w_down"])
+        out = reduce(down) if reduce is not None else down
+    x = x + out
     return x, new_kv
+
+
+def _moe_mlp(cfg: LlamaConfig, h, lp, reduce=None):
+    """Mixtral-class sparse MoE MLP (parity: the reference's Mixtral GGUFs
+    served by llama.cpp, gallery/index.yaml mixtral entries).
+
+    Routing matches HF MixtralSparseMoeBlock: softmax over ALL experts,
+    top-k, renormalize the selected weights. Compute is the dense-einsum
+    formulation: every expert runs on every token and the router weights
+    (zero off the top-k) select — the idiomatic TPU layout, since decode is
+    weight-bandwidth-bound anyway (all expert weights stream from HBM once
+    per step regardless) and it keeps static shapes/no gathers, letting the
+    E axis shard over the 'expert' mesh axis and F over 'model'."""
+    E, k = cfg.num_experts, cfg.num_experts_per_tok
+    logits = qnt.matmul(h, lp["moe_gate"]).astype(jnp.float32)   # [B, T, E]
+    probs = jax.nn.softmax(logits, axis=-1)
+    topv, topi = lax.top_k(probs, k)
+    topv = topv / jnp.sum(topv, axis=-1, keepdims=True)
+    # scatter the renormalized top-k back to a dense [B, T, E] weighting
+    wfull = jnp.sum(
+        jax.nn.one_hot(topi, E, dtype=topv.dtype) * topv[..., None], axis=-2
+    )
+    g = qnt.moe_up(h, lp["w_gate"])                              # [B, T, E, F]
+    u = qnt.moe_up(h, lp["w_up"])
+    a = jax.nn.silu(g) * u
+    d = qnt.moe_down(a, lp["w_down"])                            # [B, T, E, D]
+    out = jnp.einsum("...te,...ted->...td", wfull.astype(d.dtype), d)
+    return reduce(out) if reduce is not None else out
 
 
 def _grouped_attn(cfg: LlamaConfig, q, keys, values, mask):

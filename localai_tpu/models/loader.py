@@ -113,10 +113,30 @@ def load_llama_params(
         "wv": stack(L + "self_attn.v_proj.weight", True),
         "wo": stack(L + "self_attn.o_proj.weight", True),
         "mlp_norm": stack(L + "post_attention_layernorm.weight", False),
-        "w_gate": stack(L + "mlp.gate_proj.weight", True),
-        "w_up": stack(L + "mlp.up_proj.weight", True),
-        "w_down": stack(L + "mlp.down_proj.weight", True),
     }
+    if cfg.num_experts:
+        # Mixtral layout: block_sparse_moe.gate (router) +
+        # experts.{j}.w1/w3/w2 (gate/up/down) → expert-stacked [L, E, K, N]
+        def stack_experts(wname: str) -> np.ndarray:
+            outer = []
+            for i in range(cfg.num_layers):
+                outer.append(np.stack([
+                    _get(tensors,
+                         f"{body}layers.{i}.block_sparse_moe."
+                         f"experts.{j}.{wname}.weight").T
+                    for j in range(cfg.num_experts)
+                ]))
+            return np.stack(outer)
+
+        layers["moe_gate"] = stack(
+            L + "block_sparse_moe.gate.weight", True)
+        layers["w_gate"] = stack_experts("w1")
+        layers["w_up"] = stack_experts("w3")
+        layers["w_down"] = stack_experts("w2")
+    else:
+        layers["w_gate"] = stack(L + "mlp.gate_proj.weight", True)
+        layers["w_up"] = stack(L + "mlp.up_proj.weight", True)
+        layers["w_down"] = stack(L + "mlp.down_proj.weight", True)
     if cfg.attention_bias:
         layers["bq"] = stack(L + "self_attn.q_proj.bias", False)
         layers["bk"] = stack(L + "self_attn.k_proj.bias", False)

@@ -202,6 +202,26 @@ def matmul_t(x: jax.Array, w) -> jax.Array:
     return (x @ w.q.T.astype(x.dtype)) * w.scale.astype(x.dtype)
 
 
+def moe_up(x: jax.Array, w) -> jax.Array:
+    """x [..., D] against expert-stacked w [E, D, F] → [..., E, F].
+
+    MoE expert weights quantize per-channel int8 only (mode 'w8'): the
+    expert einsum layout is fixed here, so the (post-scan-slice) axis
+    metadata a w4 group dequant would need never comes into play."""
+    if not isinstance(w, QuantizedTensor):
+        return jnp.einsum("...d,edf->...ef", x, w)
+    acc = jnp.einsum("...d,edf->...ef", x, w.q.astype(x.dtype))
+    return acc * w.scale.astype(x.dtype)          # scale [E, F]
+
+
+def moe_down(a: jax.Array, w) -> jax.Array:
+    """a [..., E, F] against expert-stacked w [E, F, D] → [..., E, D]."""
+    if not isinstance(w, QuantizedTensor):
+        return jnp.einsum("...ef,efd->...ed", a, w)
+    acc = jnp.einsum("...ef,efd->...ed", a, w.q.astype(a.dtype))
+    return acc * w.scale.astype(a.dtype)          # scale [E, D]
+
+
 def embed_rows(w, tokens: jax.Array, dtype) -> jax.Array:
     """Embedding gather for plain or per-row-quantized tables."""
     if isinstance(w, QuantizedTensor):
@@ -251,9 +271,16 @@ def quantize_params(params: PyTree, mode: str = "int8",
     if "lm_head" in params:
         out["lm_head"] = qt(params["lm_head"], axis=0)
     layers = dict(params["layers"])
+    moe = layers.get("w_gate") is not None and layers["w_gate"].ndim == 4
     for name, axis in _LAYER_AXES.items():
-        # stacked [L, K, N]: contraction K is axis 1 → per-(layer, col) scale
-        layers[name] = qt(layers[name], axis=axis)
+        if moe and name in ("w_gate", "w_up", "w_down"):
+            # expert-stacked [L, E, K, N]: contraction K is axis 2;
+            # per-channel int8 regardless of mode (moe_up/moe_down fix the
+            # einsum layout — group-wise w4 metadata wouldn't survive the
+            # scan slice)
+            layers[name] = quantize_tensor(layers[name], axis=2)
+        else:
+            layers[name] = qt(layers[name], axis=axis)
     out["layers"] = layers
     return out
 

@@ -34,6 +34,11 @@ DEBUG_PRESETS: dict[str, LlamaConfig] = {
         vocab_size=512, hidden_size=256, intermediate_size=512, num_layers=4,
         num_heads=8, num_kv_heads=4, max_position_embeddings=2048,
     ),
+    "tiny-moe": LlamaConfig(
+        vocab_size=512, hidden_size=64, intermediate_size=96, num_layers=2,
+        num_heads=4, num_kv_heads=2, max_position_embeddings=512,
+        num_experts=4, num_experts_per_tok=2,
+    ),
     "1b": LlamaConfig(
         vocab_size=128256, hidden_size=2048, intermediate_size=8192,
         num_layers=16, num_heads=32, num_kv_heads=8,
@@ -157,6 +162,11 @@ def synthetic_quantized_params(
             layers[name] = jnp.ones(shape, dtype)
         elif name in ("bq", "bk", "bv"):
             layers[name] = jnp.zeros(shape, dtype)
+        elif name == "moe_gate":  # tiny router stays in the compute dtype
+            layers[name] = (jax.random.normal(
+                next(keys), shape, jnp.float32) * 0.02).astype(dtype)
+        elif len(shape) == 4:     # expert-stacked moe weights: int8 only
+            layers[name] = qweight(shape, 2, 8)
         else:
             layers[name] = qweight(shape, 1, bits)
     params["layers"] = layers

@@ -17,9 +17,10 @@ full sequence on any device.
 
 ``sp_prefill_forward`` runs the whole llama trunk under shard_map with
 activations sharded on 'seq', reusing models.llama._layer so the math stays
-in one place. Params must be replicated across the 'seq' axis (TP×SP
-composition is tracked as future work); the returned per-layer K/V is
-'seq'-sharded and ready for slot-cache insertion.
+in one place. Params are replicated across 'seq' but may be 'model'-sharded
+(TP×SP composition — see sp_prefill_forward's docstring); the returned
+per-layer K/V is 'seq'-sharded (and head-sharded under TP), ready for
+slot-cache insertion.
 """
 
 from __future__ import annotations
@@ -151,10 +152,20 @@ def sp_prefill_forward(
     T = tokens.shape[0]
     if T % n:
         raise ValueError(f"sequence length {T} not divisible by seq={n}")
-    if tp > 1 and (cfg.num_heads % tp or cfg.num_kv_heads % tp):
+    if tp > 1 and (cfg.num_heads % tp or cfg.num_kv_heads % tp
+                   or cfg.intermediate_size % tp):
+        # intermediate_size matters too: _sanitize would silently REPLICATE
+        # an indivisible ffn weight while the manual psum still assumes
+        # partial sums — multiplying the MLP branch by tp
         raise ValueError(
-            f"heads ({cfg.num_heads} q / {cfg.num_kv_heads} kv) not "
-            f"divisible by tensor_parallel {tp}"
+            f"heads ({cfg.num_heads} q / {cfg.num_kv_heads} kv) or "
+            f"intermediate_size ({cfg.intermediate_size}) not divisible "
+            f"by tensor_parallel {tp}"
+        )
+    if cfg.num_experts and mesh.shape.get("expert", 1) > 1:
+        raise ValueError(
+            "expert-parallel MoE prefill runs on the GSPMD path, not the "
+            "manual ring shard_map (runner gates SP off for this mesh)"
         )
     Tc = T // n
     dtype = jnp.dtype(cfg.dtype)

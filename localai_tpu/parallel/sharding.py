@@ -88,6 +88,14 @@ def param_specs(
         specs["layers"]["bq"] = P(None, "model")
         specs["layers"]["bk"] = P(None, "model")
         specs["layers"]["bv"] = P(None, "model")
+    if cfg.num_experts:
+        # Mixtral-class MoE: experts over 'expert' (expert parallelism),
+        # ffn width over 'model' (TP) — the two compose; the router is tiny
+        # and replicated
+        specs["layers"]["moe_gate"] = P(None, None, None)
+        specs["layers"]["w_gate"] = P(None, "expert", None, "model")
+        specs["layers"]["w_up"] = P(None, "expert", None, "model")
+        specs["layers"]["w_down"] = P(None, "expert", "model", None)
     if not cfg.tie_word_embeddings:
         specs["lm_head"] = P(None, "model")
 

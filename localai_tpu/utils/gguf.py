@@ -268,6 +268,11 @@ def gguf_to_hf_config(meta: dict) -> dict:
             g("attention.layer_norm_rms_epsilon", 1e-5)),
         "tie_word_embeddings": False,
     }
+    # Mixtral-class MoE ({arch}.expert_count / expert_used_count)
+    ec = g("expert_count")
+    if ec:
+        cfg["num_local_experts"] = int(ec)
+        cfg["num_experts_per_tok"] = int(g("expert_used_count", 2))
     # non-default head_dim ({arch}.attention.key_length — e.g. gemma-style
     # wide heads): without it the converted checkpoint gets wrong shapes
     key_len = g("attention.key_length")
@@ -320,10 +325,20 @@ def _hf_name(name: str) -> str | None:
             "ffn_down.weight": "mlp.down_proj.weight",
             "attn_norm.weight": "input_layernorm.weight",
             "ffn_norm.weight": "post_attention_layernorm.weight",
+            "ffn_gate_inp.weight": "block_sparse_moe.gate.weight",
         }
         if rest in mapping:
             return f"model.layers.{idx}.{mapping[rest]}"
     return None
+
+
+# llama.cpp's expert-stacked MoE tensors → per-expert HF names (w1=gate,
+# w3=up, w2=down, matching MixtralSparseMoeBlock)
+_MOE_STACKED = {
+    "ffn_gate_exps.weight": "w1",
+    "ffn_up_exps.weight": "w3",
+    "ffn_down_exps.weight": "w2",
+}
 
 
 def convert_gguf(src: str | Path, out_dir: str | Path,
@@ -346,6 +361,17 @@ def convert_gguf(src: str | Path, out_dir: str | Path,
     tensors: dict[str, np.ndarray] = {}
     skipped = []
     for name in gg.tensors:
+        if name.startswith("blk.") and name.split(".", 2)[2] in _MOE_STACKED:
+            # expert-stacked [E, N, K] → per-expert Mixtral names
+            _, idx, rest = name.split(".", 2)
+            wname = _MOE_STACKED[rest]
+            stacked = gg.load_tensor(name)
+            for j in range(stacked.shape[0]):
+                tensors[
+                    f"model.layers.{idx}.block_sparse_moe."
+                    f"experts.{j}.{wname}.weight"
+                ] = np.ascontiguousarray(stacked[j].astype(np_dtype))
+            continue
         hf_name = _hf_name(name)
         if hf_name is None:
             skipped.append(name)

@@ -26,7 +26,7 @@ import pytest  # noqa: E402
 SLOW_MODULES = {
     "test_api", "test_audio", "test_cli", "test_controlnet", "test_engine",
     "test_hf_api", "test_image", "test_llama_torch", "test_lora",
-    "test_mamba", "test_mesh_attn",
+    "test_mamba", "test_mesh_attn", "test_moe",
     "test_multihost", "test_musicgen", "test_ops", "test_prefix",
     "test_promptcache", "test_quant", "test_reranker", "test_ring",
     "test_rwkv", "test_sdxl", "test_sharding", "test_speculative",

@@ -297,3 +297,68 @@ def test_config_maps_rope_scaling_and_head_dim():
         "llama.rope.scaling.factor": 2.0,
     })
     assert cfg3["rope_scaling"] == {"rope_type": "linear", "factor": 2.0}
+
+
+def test_convert_moe_gguf(tmp_path):
+    """A Mixtral-style GGUF (stacked ffn_*_exps + ffn_gate_inp router +
+    expert_count metadata) converts to per-expert Mixtral tensor names and
+    an MoE config the native loader serves."""
+    rng = np.random.default_rng(11)
+    D, F, L, H, HKV, V, E = 32, 48, 2, 4, 2, 64, 4
+    hd = D // H
+
+    def w(*shape):
+        return (rng.normal(size=shape) * 0.05).astype(np.float32)
+
+    tensors = {"token_embd.weight": (w(V, D), G.F32),
+               "output_norm.weight": (np.ones(D, np.float32), G.F32),
+               "output.weight": (w(V, D), G.F32)}
+    for i in range(L):
+        tensors[f"blk.{i}.attn_q.weight"] = (w(H * hd, D), G.F32)
+        tensors[f"blk.{i}.attn_k.weight"] = (w(HKV * hd, D), G.F32)
+        tensors[f"blk.{i}.attn_v.weight"] = (w(HKV * hd, D), G.F32)
+        tensors[f"blk.{i}.attn_output.weight"] = (w(D, H * hd), G.F32)
+        tensors[f"blk.{i}.ffn_gate_inp.weight"] = (w(E, D), G.F32)
+        tensors[f"blk.{i}.ffn_gate_exps.weight"] = (w(E, F, D), G.F32)
+        tensors[f"blk.{i}.ffn_up_exps.weight"] = (w(E, F, D), G.F32)
+        tensors[f"blk.{i}.ffn_down_exps.weight"] = (w(E, D, F), G.F32)
+        tensors[f"blk.{i}.attn_norm.weight"] = (np.ones(D, np.float32), G.F32)
+        tensors[f"blk.{i}.ffn_norm.weight"] = (np.ones(D, np.float32), G.F32)
+    meta = [
+        ("general.architecture", 8, "llama"),
+        ("llama.vocab_size", 4, V),
+        ("llama.embedding_length", 4, D),
+        ("llama.feed_forward_length", 4, F),
+        ("llama.block_count", 4, L),
+        ("llama.attention.head_count", 4, H),
+        ("llama.attention.head_count_kv", 4, HKV),
+        ("llama.expert_count", 4, E),
+        ("llama.expert_used_count", 4, 2),
+        ("llama.context_length", 4, 128),
+        ("llama.rope.freq_base", 6, 10000.0),
+        ("llama.attention.layer_norm_rms_epsilon", 6, 1e-5),
+    ]
+    src = tmp_path / "moe.gguf"
+    write_gguf(src, meta, tensors)
+    out = G.convert_gguf(src, tmp_path / "moe", dtype="float32")
+
+    cfg_json = json.loads((out / "config.json").read_text())
+    assert cfg_json["num_local_experts"] == E
+    assert cfg_json["num_experts_per_tok"] == 2
+
+    from safetensors import safe_open
+
+    with safe_open(str(out / "model.safetensors"), framework="numpy") as h:
+        names = set(h.keys())
+    assert "model.layers.0.block_sparse_moe.gate.weight" in names
+    assert "model.layers.1.block_sparse_moe.experts.3.w2.weight" in names
+
+    from localai_tpu.models.loader import load_llama_params
+
+    cfg, params = load_llama_params(out, dtype="float32")
+    assert cfg.num_experts == E
+    assert params["layers"]["w_gate"].shape == (L, E, D, F)
+    # the stacked GGUF expert slice equals the per-expert HF tensor
+    exp0 = tensors["blk.0.ffn_gate_exps.weight"][0][0]     # [F, D]
+    np.testing.assert_allclose(
+        np.asarray(params["layers"]["w_gate"][0, 0]), exp0.T, atol=1e-6)
