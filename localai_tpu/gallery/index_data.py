@@ -283,6 +283,37 @@ _ENTRIES: list[GalleryModel] = [
         },
     ),
     GalleryModel(
+        name="flux.1-schnell",
+        description="FLUX.1 [schnell] rectified-flow MMDiT (4-step "
+                    "distilled; dual CLIP+T5 text encoders) — the "
+                    "reference's GPU AIO image default family",
+        license="apache-2.0",
+        tags=["image-generation", "flux"],
+        files=[f for sub, names in {
+            "transformer": ["config.json"] + [
+                f"diffusion_pytorch_model-0000{i}-of-00003.safetensors"
+                for i in (1, 2, 3)],
+            "vae": ["config.json", "diffusion_pytorch_model.safetensors"],
+            "text_encoder": ["config.json", "model.safetensors"],
+            "text_encoder_2": ["config.json"] + [
+                f"model-0000{i}-of-00002.safetensors" for i in (1, 2)],
+            "tokenizer": ["merges.txt", "vocab.json",
+                          "tokenizer_config.json"],
+            "tokenizer_2": ["spiece.model", "tokenizer.json",
+                            "tokenizer_config.json"],
+        }.items() for f in _hf_files(
+            "black-forest-labs/FLUX.1-schnell",
+            [f"{sub}/{n}" for n in names])] + _hf_files(
+            "black-forest-labs/FLUX.1-schnell", ["model_index.json"]),
+        config_file={
+            "name": "flux.1-schnell",
+            "model": "FLUX.1-schnell",
+            "backend": "diffusers",
+            "known_usecases": ["image"],
+            "diffusers": {"steps": 4, "cfg_scale": 0.0},
+        },
+    ),
+    GalleryModel(
         name="dreamshaper-8",
         description="DreamShaper 8 (SD1.5 fine-tune) — the reference AIO "
                     "image model family",

@@ -7,6 +7,8 @@ backend.py:74-474) and the NCNN stable-diffusion Go backend
 rebuilt as pure-functional JAX: an SD-class UNet with cross-attention,
 an AutoencoderKL VAE, a CLIP text encoder, and sigma-space samplers, all
 jitted with static shapes (one compiled step program per latent size).
+FLUX-class rectified-flow MMDiT models (image.flux / image.mmdit, dual
+CLIP+T5 conditioning) serve behind the same resolve_image_model router.
 """
 
 from localai_tpu.image.pipeline import DiffusionPipeline, resolve_image_model

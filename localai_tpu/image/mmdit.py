@@ -1,0 +1,325 @@
+"""FLUX-class rectified-flow transformer (MMDiT) in JAX.
+
+Parity target: the reference's diffusers backend serving FLUX.1
+(/root/reference/backend/python/diffusers/backend.py:21,249-262 —
+`FluxPipeline`, the GPU AIO image default `aio/gpu-8g/image-gen.yaml`).
+Architecture follows diffusers `FluxTransformer2DModel`: double-stream
+MMDiT blocks (separate image/text streams with joint attention and
+AdaLN-zero modulation from timestep+pooled-text+guidance embeddings),
+then single-stream blocks over the merged sequence (parallel attention +
+MLP), 3-axis rotary position embeddings over (batch, y, x) ids, and an
+AdaLN-continuous output head — verified against an independent torch
+implementation in tests/test_flux.py.
+
+TPU design: the whole velocity prediction is ONE jitted call per latent
+bucket; double and single blocks each run as a ``lax.scan`` over stacked
+weights (one compiled body per block type regardless of depth); all
+matmuls are batched over the packed 2x2-patch token sequence — MXU-shaped,
+static lengths.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+PyTree = Any
+
+
+@dataclasses.dataclass
+class FluxConfig:
+    in_channels: int = 64            # 16 latent ch x 2x2 patch
+    num_layers: int = 19             # double-stream blocks
+    num_single_layers: int = 38      # single-stream blocks
+    attention_head_dim: int = 128
+    num_attention_heads: int = 24
+    joint_attention_dim: int = 4096  # T5 d_model
+    pooled_projection_dim: int = 768 # CLIP pooled dim
+    guidance_embeds: bool = True     # FLUX.1-dev distilled guidance
+    axes_dims_rope: tuple = (16, 56, 56)
+    dtype: str = "float32"
+
+    @property
+    def dim(self) -> int:
+        return self.attention_head_dim * self.num_attention_heads
+
+    @classmethod
+    def from_hf(cls, hf: dict) -> "FluxConfig":
+        return cls(
+            in_channels=hf.get("in_channels", 64),
+            num_layers=hf.get("num_layers", 19),
+            num_single_layers=hf.get("num_single_layers", 38),
+            attention_head_dim=hf.get("attention_head_dim", 128),
+            num_attention_heads=hf.get("num_attention_heads", 24),
+            joint_attention_dim=hf.get("joint_attention_dim", 4096),
+            pooled_projection_dim=hf.get("pooled_projection_dim", 768),
+            guidance_embeds=hf.get("guidance_embeds", True),
+            axes_dims_rope=tuple(hf.get("axes_dims_rope", (16, 56, 56))),
+        )
+
+
+# -- embeddings -------------------------------------------------------------
+
+def timestep_embedding(t: jax.Array, dim: int = 256) -> jax.Array:
+    """diffusers Timesteps(flip_sin_to_cos=True, shift=0): [B, dim] f32."""
+    half = dim // 2
+    freqs = jnp.exp(-math.log(10000.0) * jnp.arange(half) / half)
+    args = t.astype(jnp.float32)[:, None] * freqs[None]
+    return jnp.concatenate([jnp.cos(args), jnp.sin(args)], axis=-1)
+
+
+def rope_3d(cfg: FluxConfig, ids: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """ids [N, 3] → (cos, sin) [N, head_dim], interleaved-pair layout
+    (diffusers get_1d_rotary_pos_embed with repeat_interleave_real)."""
+    cos_parts, sin_parts = [], []
+    for axis, dim in enumerate(cfg.axes_dims_rope):
+        freqs = 1.0 / (10000.0 ** (jnp.arange(0, dim, 2,
+                                              dtype=jnp.float32) / dim))
+        angles = ids[:, axis].astype(jnp.float32)[:, None] * freqs[None]
+        cos_parts.append(jnp.repeat(jnp.cos(angles), 2, axis=-1))
+        sin_parts.append(jnp.repeat(jnp.sin(angles), 2, axis=-1))
+    return (jnp.concatenate(cos_parts, -1).astype(jnp.float32),
+            jnp.concatenate(sin_parts, -1).astype(jnp.float32))
+
+
+def _apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
+    """x [B, H, N, hd], cos/sin [N, hd] interleaved pairs."""
+    xr = x.reshape(*x.shape[:-1], -1, 2)
+    rot = jnp.stack([-xr[..., 1], xr[..., 0]], axis=-1).reshape(x.shape)
+    return (x.astype(jnp.float32) * cos + rot.astype(jnp.float32) * sin
+            ).astype(x.dtype)
+
+
+def _ln(x, eps: float = 1e-6):
+    """LayerNorm without affine (elementwise_affine=False everywhere)."""
+    xf = x.astype(jnp.float32)
+    mu = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.mean((xf - mu) ** 2, axis=-1, keepdims=True)
+    return ((xf - mu) * lax.rsqrt(var + eps)).astype(x.dtype)
+
+
+def _rmsn(x, w, eps: float = 1e-6):
+    """Per-head qk RMSNorm (weight over head_dim)."""
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(xf * xf, axis=-1, keepdims=True)
+    return (xf * lax.rsqrt(var + eps) * w.astype(jnp.float32)).astype(x.dtype)
+
+
+def _mlp(p, x):
+    return jax.nn.gelu(x @ p["w1"] + p["b1"], approximate=True) @ p["w2"] \
+        + p["b2"]
+
+
+def _heads(x, H):
+    B, N, _ = x.shape
+    return x.reshape(B, N, H, -1).transpose(0, 2, 1, 3)   # [B, H, N, hd]
+
+
+def _unheads(x):
+    B, H, N, hd = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(B, N, H * hd)
+
+
+def _attention(q, k, v):
+    """[B, H, N, hd] — plain sdpa in f32 softmax."""
+    hd = q.shape[-1]
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32)
+    probs = jax.nn.softmax(scores / math.sqrt(hd), axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", probs.astype(v.dtype), v)
+
+
+# -- forward ----------------------------------------------------------------
+
+def forward(
+    cfg: FluxConfig,
+    params: PyTree,
+    img: jax.Array,        # [B, Nimg, in_channels] packed 2x2 latent patches
+    txt: jax.Array,        # [B, Ntxt, joint_attention_dim] T5 states
+    pooled: jax.Array,     # [B, pooled_projection_dim] CLIP pooled
+    timestep: jax.Array,   # [B] f32 in [0, 1] (sigma)
+    img_ids: jax.Array,    # [Nimg, 3]
+    txt_ids: jax.Array,    # [Ntxt, 3]
+    guidance: Optional[jax.Array] = None,   # [B] f32 (dev-distilled)
+) -> jax.Array:
+    """Velocity prediction [B, Nimg, in_channels]."""
+    H = cfg.num_attention_heads
+    dt = jnp.dtype(cfg.dtype)
+    Ntxt = txt.shape[1]
+
+    temb = _mlp2(params["time_mlp"],
+                 timestep_embedding(timestep * 1000.0))
+    if cfg.guidance_embeds:
+        g = guidance if guidance is not None else jnp.ones_like(timestep)
+        temb = temb + _mlp2(params["guid_mlp"],
+                            timestep_embedding(g * 1000.0))
+    temb = temb + _mlp2(params["text_mlp"], pooled.astype(jnp.float32))
+    temb = jax.nn.silu(temb)                                  # [B, dim]
+
+    x = (img.astype(dt) @ params["x_embed_w"].astype(dt)
+         + params["x_embed_b"].astype(dt))
+    c = (txt.astype(dt) @ params["ctx_embed_w"].astype(dt)
+         + params["ctx_embed_b"].astype(dt))
+
+    cos, sin = rope_3d(cfg, jnp.concatenate([txt_ids, img_ids], axis=0))
+
+    def mod(p, name):
+        out = temb @ p[f"{name}_w"] + p[f"{name}_b"]
+        return out.astype(dt)
+
+    def double_body(carry, p):
+        x, c = carry
+        m_x = mod(p, "mod_x")[:, None]                  # [B, 1, 6*dim]
+        m_c = mod(p, "mod_c")[:, None]
+        sh_x, sc_x, g_x, shm_x, scm_x, gm_x = jnp.split(m_x, 6, axis=-1)
+        sh_c, sc_c, g_c, shm_c, scm_c, gm_c = jnp.split(m_c, 6, axis=-1)
+
+        xn = _ln(x) * (1 + sc_x) + sh_x
+        cn = _ln(c) * (1 + sc_c) + sh_c
+        q_x = _rmsn(_heads(xn @ p["wq_x"] + p["bq_x"], H), p["qn_x"])
+        k_x = _rmsn(_heads(xn @ p["wk_x"] + p["bk_x"], H), p["kn_x"])
+        v_x = _heads(xn @ p["wv_x"] + p["bv_x"], H)
+        q_c = _rmsn(_heads(cn @ p["wq_c"] + p["bq_c"], H), p["qn_c"])
+        k_c = _rmsn(_heads(cn @ p["wk_c"] + p["bk_c"], H), p["kn_c"])
+        v_c = _heads(cn @ p["wv_c"] + p["bv_c"], H)
+
+        q = _apply_rope(jnp.concatenate([q_c, q_x], axis=2), cos, sin)
+        k = _apply_rope(jnp.concatenate([k_c, k_x], axis=2), cos, sin)
+        v = jnp.concatenate([v_c, v_x], axis=2)
+        att = _unheads(_attention(q, k, v))
+        a_c, a_x = att[:, :Ntxt], att[:, Ntxt:]
+
+        x = x + g_x * (a_x @ p["wo_x"] + p["bo_x"])
+        xm = _ln(x) * (1 + scm_x) + shm_x
+        x = x + gm_x * _mlp({"w1": p["ff_x_w1"], "b1": p["ff_x_b1"],
+                             "w2": p["ff_x_w2"], "b2": p["ff_x_b2"]}, xm)
+        c = c + g_c * (a_c @ p["wo_c"] + p["bo_c"])
+        cm = _ln(c) * (1 + scm_c) + shm_c
+        c = c + gm_c * _mlp({"w1": p["ff_c_w1"], "b1": p["ff_c_b1"],
+                             "w2": p["ff_c_w2"], "b2": p["ff_c_b2"]}, cm)
+        return (x, c), None
+
+    (x, c), _ = lax.scan(double_body, (x, c), params["double"])
+
+    s = jnp.concatenate([c, x], axis=1)                  # [B, Ntxt+Nimg, dim]
+
+    def single_body(s, p):
+        m = mod(p, "mod")[:, None]                       # [B, 1, 3*dim]
+        sh, sc, g = jnp.split(m, 3, axis=-1)
+        sn = _ln(s) * (1 + sc) + sh
+        q = _rmsn(_heads(sn @ p["wq"] + p["bq"], H), p["qn"])
+        k = _rmsn(_heads(sn @ p["wk"] + p["bk"], H), p["kn"])
+        v = _heads(sn @ p["wv"] + p["bv"], H)
+        att = _unheads(_attention(_apply_rope(q, cos, sin),
+                                  _apply_rope(k, cos, sin), v))
+        mlp = jax.nn.gelu(sn @ p["mlp_w"] + p["mlp_b"], approximate=True)
+        proj = (jnp.concatenate([att, mlp], axis=-1) @ p["out_w"]
+                + p["out_b"])
+        return s + g * proj, None
+
+    s, _ = lax.scan(single_body, s, params["single"])
+    x = s[:, Ntxt:]
+
+    # temb already went through SiLU above (every AdaLN consumer takes
+    # silu(embedding) @ linear — diffusers applies the SiLU inside each
+    # norm module; here it's hoisted once)
+    out_mod = temb @ params["norm_out_w"] + params["norm_out_b"]
+    scale, shift = jnp.split(out_mod.astype(dt)[:, None], 2, axis=-1)
+    x = _ln(x) * (1 + scale) + shift
+    return x @ params["proj_out_w"].astype(dt) + params["proj_out_b"].astype(dt)
+
+
+def _mlp2(p, x):
+    """linear_1 → SiLU → linear_2 (the diffusers TimestepEmbedding shape)."""
+    return jax.nn.silu(x @ p["w1"] + p["b1"]) @ p["w2"] + p["b2"]
+
+
+# -- parameters -------------------------------------------------------------
+
+def param_shapes(cfg: FluxConfig) -> dict:
+    D, Ld, Ls = cfg.dim, cfg.num_layers, cfg.num_single_layers
+    hd = cfg.attention_head_dim
+    F = 4 * D
+    shapes: dict = {
+        "x_embed_w": (cfg.in_channels, D), "x_embed_b": (D,),
+        "ctx_embed_w": (cfg.joint_attention_dim, D), "ctx_embed_b": (D,),
+        "time_mlp": {"w1": (256, D), "b1": (D,), "w2": (D, D), "b2": (D,)},
+        "text_mlp": {"w1": (cfg.pooled_projection_dim, D), "b1": (D,),
+                     "w2": (D, D), "b2": (D,)},
+        "norm_out_w": (D, 2 * D), "norm_out_b": (2 * D,),
+        "proj_out_w": (D, cfg.in_channels), "proj_out_b": (cfg.in_channels,),
+        "double": {},
+        "single": {},
+    }
+    if cfg.guidance_embeds:
+        shapes["guid_mlp"] = {"w1": (256, D), "b1": (D,),
+                              "w2": (D, D), "b2": (D,)}
+    dd = {"mod_x_w": (D, 6 * D), "mod_x_b": (6 * D,),
+          "mod_c_w": (D, 6 * D), "mod_c_b": (6 * D,)}
+    for st in ("x", "c"):
+        dd.update({
+            f"wq_{st}": (D, D), f"bq_{st}": (D,),
+            f"wk_{st}": (D, D), f"bk_{st}": (D,),
+            f"wv_{st}": (D, D), f"bv_{st}": (D,),
+            f"wo_{st}": (D, D), f"bo_{st}": (D,),
+            f"qn_{st}": (hd,), f"kn_{st}": (hd,),
+            f"ff_{st}_w1": (D, F), f"ff_{st}_b1": (F,),
+            f"ff_{st}_w2": (F, D), f"ff_{st}_b2": (D,),
+        })
+    shapes["double"] = {k: (Ld,) + v for k, v in dd.items()}
+    ss = {"mod_w": (D, 3 * D), "mod_b": (3 * D,),
+          "wq": (D, D), "bq": (D,), "wk": (D, D), "bk": (D,),
+          "wv": (D, D), "bv": (D,), "qn": (hd,), "kn": (hd,),
+          "mlp_w": (D, F), "mlp_b": (F,),
+          "out_w": (D + F, D), "out_b": (D,)}
+    shapes["single"] = {k: (Ls,) + v for k, v in ss.items()}
+    return shapes
+
+
+def init_params(rng: jax.Array, cfg: FluxConfig) -> PyTree:
+    """Random init keyed by leaf NAME (qk-norm gains → ones, biases →
+    zeros, weights → 0.02-std gaussians) — shape heuristics would misfire
+    on tiny test configs where dims collide."""
+    shapes = param_shapes(cfg)
+    flat, treedef = jax.tree.flatten_with_path(
+        shapes, is_leaf=lambda x: isinstance(x, tuple))
+    keys = jax.random.split(rng, len(flat))
+
+    def mk(path, shape, k):
+        name = path[-1].key
+        if name.startswith(("qn", "kn")):
+            return jnp.ones(shape, jnp.float32)
+        if name.startswith("b") or name.endswith(("_b", "b1", "b2")):
+            return jnp.zeros(shape, jnp.float32)
+        return jax.random.normal(k, shape, jnp.float32) * 0.02
+
+    return jax.tree.unflatten(
+        treedef, [mk(p, s, k) for (p, s), k in zip(flat, keys)])
+
+
+# -- rectified-flow schedule ------------------------------------------------
+
+def flow_sigmas(steps: int, image_seq_len: int, *,
+                base_shift: float = 0.5, max_shift: float = 1.15,
+                dynamic: bool = True, shift: float = 1.0) -> np.ndarray:
+    """FlowMatchEulerDiscrete sigmas, [steps + 1] with a trailing 0.
+
+    ``dynamic`` applies FLUX.1-dev's resolution-dependent timestep shift
+    (diffusers calculate_shift); ``dynamic=False`` applies the static
+    ``shift`` the checkpoint's scheduler_config declares — FLUX.1-schnell
+    is distilled for shift=1.0 (identity), so forcing the dynamic shift on
+    it would run every step at the wrong sigma."""
+    sigmas = np.linspace(1.0, 1.0 / steps, steps)
+    if dynamic:
+        m = (max_shift - base_shift) / (4096 - 256)
+        b = base_shift - m * 256
+        mu = image_seq_len * m + b
+        sigmas = np.exp(mu) / (np.exp(mu) + (1.0 / sigmas - 1.0))
+    elif shift != 1.0:
+        sigmas = shift * sigmas / (1.0 + (shift - 1.0) * sigmas)
+    return np.append(sigmas, 0.0).astype(np.float32)

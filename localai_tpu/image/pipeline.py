@@ -39,6 +39,26 @@ class GenerationResult:
     seed: int
 
 
+def bucket_dim(v: int, lo: int = 64, quantum: int = 64,
+               hi: int = 2048) -> int:
+    """Round a requested image dimension up to the compile-bucket quantum
+    (shared by the UNet and FLUX pipelines — one recompile-bounding
+    contract)."""
+    v = max(lo, min(v, hi))
+    return ((v + quantum - 1) // quantum) * quantum
+
+
+def tokenize_clip(tokenizer, text_cfg, text: str) -> np.ndarray:
+    """[1, max_length] i32 CLIP token row, eos-padded (the SD/FLUX primary
+    text-encoder convention)."""
+    T = text_cfg.max_length
+    eos = text_cfg.eos_token_id
+    ids = list(tokenizer.encode(text))[: T - 1]
+    row = np.full((1, T), eos, np.int32)
+    row[0, : len(ids)] = ids
+    return row
+
+
 class DiffusionPipeline:
     """One loaded diffusion model (UNet + VAE + text encoder + tokenizer)."""
 
@@ -150,12 +170,7 @@ class DiffusionPipeline:
     # -- host API --------------------------------------------------------
 
     def _tokenize(self, text: str) -> np.ndarray:
-        T = self.text_cfg.max_length
-        eos = self.text_cfg.eos_token_id
-        ids = list(self.tokenizer.encode(text))[: T - 1]
-        row = np.full((1, T), eos, np.int32)
-        row[0, : len(ids)] = ids
-        return row
+        return tokenize_clip(self.tokenizer, self.text_cfg, text)
 
     def _tokenize2(self, text: str) -> np.ndarray:
         """SDXL's second (OpenCLIP) tokenizer pads with id 0 ("!"), NOT
@@ -202,8 +217,7 @@ class DiffusionPipeline:
 
     @staticmethod
     def _bucket(v: int, lo: int = 64, quantum: int = 64, hi: int = 2048) -> int:
-        v = max(lo, min(v, hi))
-        return ((v + quantum - 1) // quantum) * quantum
+        return bucket_dim(v, lo, quantum, hi)
 
     def generate(
         self,
@@ -328,15 +342,29 @@ def resolve_image_model(
     """
     if ref.startswith("debug:"):
         name = ref.split(":", 1)[1]
+        if name == "flux-tiny":
+            from localai_tpu.image.flux import debug_flux_pipeline
+
+            defaults.pop("lora_adapter", None)
+            defaults.pop("lora_scale", None)
+            return debug_flux_pipeline(**defaults)
         if name not in _DEBUG_PRESETS:
             raise ValueError(
                 f"unknown debug image preset {name!r}; have "
-                f"{sorted(_DEBUG_PRESETS)}"
+                f"{sorted(_DEBUG_PRESETS) + ['flux-tiny']}"
             )
         defaults.pop("lora_adapter", None)
         defaults.pop("lora_scale", None)
         return _debug_pipeline(name, **defaults)
     for cand in (Path(ref), Path(model_path) / ref):
+        if (cand / "transformer").is_dir():
+            # FLUX-class layout: MMDiT under transformer/, T5 under
+            # text_encoder_2/ — distinct from the UNet layout below
+            from localai_tpu.image.flux import load_flux_pipeline
+
+            defaults.pop("lora_adapter", None)
+            defaults.pop("lora_scale", None)
+            return load_flux_pipeline(cand, **defaults)
         if (cand / "model_index.json").exists() or (cand / "unet").is_dir():
             from localai_tpu.image.loader import load_diffusers_pipeline
 

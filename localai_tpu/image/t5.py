@@ -1,0 +1,169 @@
+"""T5 text encoder (encoder-only) in JAX — the FLUX-class pipelines'
+sequence conditioning model.
+
+Parity: the reference's diffusers backend loads FLUX.1 whose second text
+encoder is T5-XXL (/root/reference/backend/python/diffusers/backend.py:
+249-262, `FluxPipeline.from_pretrained`). This is the encoder stack of HF
+`T5EncoderModel` (relative-position-bias attention, pre-RMSNorm, gated-GELU
+FFN, no biases), loadable from its safetensors and torch-verified in
+tests/test_flux.py.
+
+TPU notes: the layer loop is a ``lax.scan`` over stacked weights; the
+relative position bias is computed once (shared across layers, as in T5)
+and added to the attention logits.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from pathlib import Path
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+PyTree = Any
+
+
+@dataclasses.dataclass
+class T5Config:
+    vocab_size: int = 32128
+    d_model: int = 4096
+    d_kv: int = 64
+    d_ff: int = 10240
+    num_layers: int = 24
+    num_heads: int = 64
+    relative_attention_num_buckets: int = 32
+    relative_attention_max_distance: int = 128
+    layer_norm_epsilon: float = 1e-6
+    max_length: int = 512
+    dtype: str = "float32"
+
+    @classmethod
+    def from_hf(cls, hf: dict) -> "T5Config":
+        return cls(
+            vocab_size=hf.get("vocab_size", 32128),
+            d_model=hf.get("d_model", 4096),
+            d_kv=hf.get("d_kv", 64),
+            d_ff=hf.get("d_ff", 10240),
+            num_layers=hf.get("num_layers", 24),
+            num_heads=hf.get("num_heads", 64),
+            relative_attention_num_buckets=hf.get(
+                "relative_attention_num_buckets", 32),
+            relative_attention_max_distance=hf.get(
+                "relative_attention_max_distance", 128),
+            layer_norm_epsilon=hf.get("layer_norm_epsilon", 1e-6),
+        )
+
+
+def _rms(x, w, eps):
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(xf * xf, axis=-1, keepdims=True)
+    return (xf * lax.rsqrt(var + eps)).astype(x.dtype) * w.astype(x.dtype)
+
+
+def _relative_buckets(rel_pos, num_buckets: int, max_dist: int):
+    """HF T5 bidirectional relative-position bucketing."""
+    nb = num_buckets // 2
+    ret = jnp.where(rel_pos > 0, nb, 0)
+    n = jnp.abs(rel_pos)
+    max_exact = nb // 2
+    is_small = n < max_exact
+    large = max_exact + (
+        jnp.log(n.astype(jnp.float32) / max_exact + 1e-9)
+        / float(np.log(max_dist / max_exact)) * (nb - max_exact)
+    ).astype(jnp.int32)
+    large = jnp.minimum(large, nb - 1)
+    return ret + jnp.where(is_small, n, large)
+
+
+def position_bias(cfg: T5Config, rel_embed: jax.Array, T: int) -> jax.Array:
+    """[H, T, T] f32 — shared across layers (computed by layer 0 in HF)."""
+    ctx = jnp.arange(T)[:, None]
+    mem = jnp.arange(T)[None, :]
+    buckets = _relative_buckets(
+        mem - ctx, cfg.relative_attention_num_buckets,
+        cfg.relative_attention_max_distance,
+    )
+    return rel_embed[buckets].transpose(2, 0, 1).astype(jnp.float32)
+
+
+def encode(cfg: T5Config, params: PyTree, tokens: jax.Array,
+           mask: jax.Array | None = None) -> jax.Array:
+    """tokens [B, T] i32 → hidden states [B, T, D].
+
+    ``mask`` [B, T] bool (True = real token); None attends everywhere —
+    matching diffusers' FLUX text encoding, which passes full attention
+    over the padded T5 sequence."""
+    H, dk = cfg.num_heads, cfg.d_kv
+    x = params["embed"][tokens].astype(jnp.dtype(cfg.dtype))
+    T = tokens.shape[-1]
+    bias = position_bias(cfg, params["rel_embed"], T)  # [H, T, T]
+    if mask is not None:
+        bias = jnp.where(mask[:, None, None, :], bias[None], -1e9)
+    else:
+        bias = bias[None]
+
+    def body(h, lp):
+        a_in = _rms(h, lp["ln1"], cfg.layer_norm_epsilon)
+        q = (a_in @ lp["wq"]).reshape(*a_in.shape[:-1], H, dk)
+        k = (a_in @ lp["wk"]).reshape(*a_in.shape[:-1], H, dk)
+        v = (a_in @ lp["wv"]).reshape(*a_in.shape[:-1], H, dk)
+        # T5 does NOT scale by sqrt(dk): the init absorbs it
+        scores = jnp.einsum("bthd,bshd->bhts", q, k)
+        scores = scores.astype(jnp.float32) + bias
+        probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
+        att = jnp.einsum("bhts,bshd->bthd", probs, v)
+        att = att.reshape(*att.shape[:-2], H * dk)
+        h = h + att @ lp["wo"]
+
+        f_in = _rms(h, lp["ln2"], cfg.layer_norm_epsilon)
+        gelu = jax.nn.gelu(f_in @ lp["wi0"], approximate=True)
+        h = h + (gelu * (f_in @ lp["wi1"])) @ lp["wo2"]
+        return h, None
+
+    x, _ = lax.scan(body, x, params["layers"])
+    return _rms(x, params["final_ln"], cfg.layer_norm_epsilon)
+
+
+def load_hf_t5(d: str | Path) -> tuple[T5Config, PyTree]:
+    """Read an HF T5EncoderModel dir (config.json + safetensors)."""
+    import json
+
+    from localai_tpu.image.loader import _np, _open_dir
+
+    d = Path(d)
+    cfg = T5Config.from_hf(json.loads((d / "config.json").read_text()))
+    tensors = _open_dir(d)
+    pre = "encoder.block.{i}.layer."
+
+    def stack(fmt: str, transpose: bool = True) -> np.ndarray:
+        mats = []
+        for i in range(cfg.num_layers):
+            a = _np(tensors, fmt.format(i=i))
+            mats.append(a.T if transpose else a)
+        return np.stack(mats)
+
+    params = {
+        "embed": _np(tensors, "shared.weight"),
+        "rel_embed": _np(
+            tensors,
+            "encoder.block.0.layer.0.SelfAttention"
+            ".relative_attention_bias.weight",
+        ),
+        "final_ln": _np(tensors, "encoder.final_layer_norm.weight"),
+        "layers": {
+            "ln1": stack(pre + "0.layer_norm.weight", False),
+            "wq": stack(pre + "0.SelfAttention.q.weight"),
+            "wk": stack(pre + "0.SelfAttention.k.weight"),
+            "wv": stack(pre + "0.SelfAttention.v.weight"),
+            "wo": stack(pre + "0.SelfAttention.o.weight"),
+            "ln2": stack(pre + "1.layer_norm.weight", False),
+            "wi0": stack(pre + "1.DenseReluDense.wi_0.weight"),
+            "wi1": stack(pre + "1.DenseReluDense.wi_1.weight"),
+            "wo2": stack(pre + "1.DenseReluDense.wo.weight"),
+        },
+    }
+    return cfg, params

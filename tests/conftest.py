@@ -25,7 +25,8 @@ import pytest  # noqa: E402
 # Re-measure when adding heavy suites; pyproject registers the marker.
 SLOW_MODULES = {
     "test_api", "test_audio", "test_cli", "test_controlnet", "test_engine",
-    "test_hf_api", "test_image", "test_llama_torch", "test_lora",
+    "test_flux", "test_hf_api", "test_image", "test_llama_torch",
+    "test_lora",
     "test_mamba", "test_mesh_attn", "test_moe",
     "test_multihost", "test_musicgen", "test_ops", "test_prefix",
     "test_promptcache", "test_quant", "test_reranker", "test_ring",

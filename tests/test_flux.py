@@ -1,0 +1,397 @@
+"""FLUX-class DiT verification (VERDICT r4 #6).
+
+The MMDiT forward is checked against an INDEPENDENT torch implementation
+written here from the diffusers FluxTransformer2DModel semantics, driven
+off the same diffusers-named state dict that the repo loader consumes —
+one fixture checkpoint verifies both the tensor-name mapping and the math.
+The T5 encoder is checked against transformers' real T5EncoderModel.
+diffusers itself is not installed in this environment (zero egress).
+"""
+
+import json
+import math
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+from localai_tpu.image import mmdit  # noqa: E402
+
+
+CFG = dict(in_channels=16, num_layers=2, num_single_layers=2,
+           attention_head_dim=8, num_attention_heads=3,
+           joint_attention_dim=24, pooled_projection_dim=20,
+           guidance_embeds=True, axes_dims_rope=(2, 4, 2))
+
+
+def _state_dict(cfg, seed=0):
+    """Random diffusers-named FluxTransformer2DModel state dict (torch)."""
+    g = torch.Generator().manual_seed(seed)
+    D = cfg["attention_head_dim"] * cfg["num_attention_heads"]
+    F = 4 * D
+    sd = {}
+
+    def lin(name, i, o):
+        sd[f"{name}.weight"] = torch.randn(o, i, generator=g) * 0.05
+        sd[f"{name}.bias"] = torch.randn(o, generator=g) * 0.02
+
+    lin("x_embedder", cfg["in_channels"], D)
+    lin("context_embedder", cfg["joint_attention_dim"], D)
+    for stem, i in (("timestep_embedder", 256),
+                    ("guidance_embedder", 256),
+                    ("text_embedder", cfg["pooled_projection_dim"])):
+        lin(f"time_text_embed.{stem}.linear_1", i, D)
+        lin(f"time_text_embed.{stem}.linear_2", D, D)
+    lin("norm_out.linear", D, 2 * D)
+    lin("proj_out", D, cfg["in_channels"])
+    for i in range(cfg["num_layers"]):
+        B = f"transformer_blocks.{i}"
+        lin(f"{B}.norm1.linear", D, 6 * D)
+        lin(f"{B}.norm1_context.linear", D, 6 * D)
+        for n in ("to_q", "to_k", "to_v", "to_out.0",
+                  "add_q_proj", "add_k_proj", "add_v_proj", "to_add_out"):
+            lin(f"{B}.attn.{n}", D, D)
+        for n in ("norm_q", "norm_k", "norm_added_q", "norm_added_k"):
+            sd[f"{B}.attn.{n}.weight"] = \
+                1 + torch.randn(cfg["attention_head_dim"], generator=g) * 0.1
+        lin(f"{B}.ff.net.0.proj", D, F)
+        lin(f"{B}.ff.net.2", F, D)
+        lin(f"{B}.ff_context.net.0.proj", D, F)
+        lin(f"{B}.ff_context.net.2", F, D)
+    for i in range(cfg["num_single_layers"]):
+        B = f"single_transformer_blocks.{i}"
+        lin(f"{B}.norm.linear", D, 3 * D)
+        for n in ("to_q", "to_k", "to_v"):
+            lin(f"{B}.attn.{n}", D, D)
+        for n in ("norm_q", "norm_k"):
+            sd[f"{B}.attn.{n}.weight"] = \
+                1 + torch.randn(cfg["attention_head_dim"], generator=g) * 0.1
+        lin(f"{B}.proj_mlp", D, F)
+        lin(f"{B}.proj_out", D + F, D)
+    return sd
+
+
+# -- independent torch reference (diffusers FluxTransformer2DModel math) ----
+
+def _t_emb(t, dim=256):
+    half = dim // 2
+    freqs = torch.exp(-math.log(10000.0) * torch.arange(half) / half)
+    args = t[:, None].float() * freqs[None]
+    return torch.cat([args.cos(), args.sin()], dim=-1)
+
+
+def _mlp2(sd, p, x):
+    x = torch.nn.functional.silu(x @ sd[f"{p}.linear_1.weight"].T
+                                 + sd[f"{p}.linear_1.bias"])
+    return x @ sd[f"{p}.linear_2.weight"].T + sd[f"{p}.linear_2.bias"]
+
+
+def _ln(x):
+    return torch.nn.functional.layer_norm(x, x.shape[-1:], eps=1e-6)
+
+
+def _rms(x, w):
+    v = (x.float() ** 2).mean(-1, keepdim=True)
+    return x * torch.rsqrt(v + 1e-6) * w
+
+
+def _rope(cfg, ids):
+    cos_p, sin_p = [], []
+    for ax, dim in enumerate(cfg["axes_dims_rope"]):
+        freqs = 1.0 / (10000.0 ** (torch.arange(0, dim, 2).float() / dim))
+        ang = ids[:, ax].float()[:, None] * freqs[None]
+        cos_p.append(ang.cos().repeat_interleave(2, dim=-1))
+        sin_p.append(ang.sin().repeat_interleave(2, dim=-1))
+    return torch.cat(cos_p, -1), torch.cat(sin_p, -1)
+
+
+def _apply_rope_t(x, cos, sin):
+    xr = x.reshape(*x.shape[:-1], -1, 2)
+    rot = torch.stack([-xr[..., 1], xr[..., 0]], dim=-1).reshape(x.shape)
+    return x * cos + rot * sin
+
+
+def _attn(q, k, v):
+    hd = q.shape[-1]
+    s = torch.einsum("bhqd,bhkd->bhqk", q, k) / math.sqrt(hd)
+    return torch.einsum("bhqk,bhkd->bhqd", s.softmax(-1), v)
+
+
+def _heads(x, H):
+    B, N, _ = x.shape
+    return x.reshape(B, N, H, -1).permute(0, 2, 1, 3)
+
+
+def _unheads(x):
+    B, H, N, hd = x.shape
+    return x.permute(0, 2, 1, 3).reshape(B, N, H * hd)
+
+
+def _qkv(sd, p, x, H, qn, kn):
+    q = _heads(x @ sd[f"{p}.to_q.weight"].T + sd[f"{p}.to_q.bias"], H)
+    k = _heads(x @ sd[f"{p}.to_k.weight"].T + sd[f"{p}.to_k.bias"], H)
+    v = _heads(x @ sd[f"{p}.to_v.weight"].T + sd[f"{p}.to_v.bias"], H)
+    return _rms(q, sd[qn]), _rms(k, sd[kn]), v
+
+
+def torch_flux_forward(cfg, sd, img, txt, pooled, t, img_ids, txt_ids,
+                       guidance):
+    H = cfg["num_attention_heads"]
+    Ntxt = txt.shape[1]
+    temb = _mlp2(sd, "time_text_embed.timestep_embedder", _t_emb(t * 1000))
+    temb = temb + _mlp2(sd, "time_text_embed.guidance_embedder",
+                        _t_emb(guidance * 1000))
+    temb = temb + _mlp2(sd, "time_text_embed.text_embedder", pooled)
+    semb = torch.nn.functional.silu(temb)
+
+    x = img @ sd["x_embedder.weight"].T + sd["x_embedder.bias"]
+    c = txt @ sd["context_embedder.weight"].T + sd["context_embedder.bias"]
+    cos, sin = _rope(cfg, torch.cat([txt_ids, img_ids], dim=0))
+
+    for i in range(cfg["num_layers"]):
+        B = f"transformer_blocks.{i}"
+        mx = (semb @ sd[f"{B}.norm1.linear.weight"].T
+              + sd[f"{B}.norm1.linear.bias"])[:, None]
+        mc = (semb @ sd[f"{B}.norm1_context.linear.weight"].T
+              + sd[f"{B}.norm1_context.linear.bias"])[:, None]
+        shx, scx, gx, shmx, scmx, gmx = mx.chunk(6, dim=-1)
+        shc, scc, gc, shmc, scmc, gmc = mc.chunk(6, dim=-1)
+        xn = _ln(x) * (1 + scx) + shx
+        cn = _ln(c) * (1 + scc) + shc
+        qx, kx, vx = _qkv(sd, f"{B}.attn", xn, H,
+                          f"{B}.attn.norm_q.weight",
+                          f"{B}.attn.norm_k.weight")
+        qc = _heads(cn @ sd[f"{B}.attn.add_q_proj.weight"].T
+                    + sd[f"{B}.attn.add_q_proj.bias"], H)
+        kc = _heads(cn @ sd[f"{B}.attn.add_k_proj.weight"].T
+                    + sd[f"{B}.attn.add_k_proj.bias"], H)
+        vc = _heads(cn @ sd[f"{B}.attn.add_v_proj.weight"].T
+                    + sd[f"{B}.attn.add_v_proj.bias"], H)
+        qc = _rms(qc, sd[f"{B}.attn.norm_added_q.weight"])
+        kc = _rms(kc, sd[f"{B}.attn.norm_added_k.weight"])
+        q = _apply_rope_t(torch.cat([qc, qx], dim=2), cos, sin)
+        k = _apply_rope_t(torch.cat([kc, kx], dim=2), cos, sin)
+        att = _unheads(_attn(q, k, torch.cat([vc, vx], dim=2)))
+        ac, ax_ = att[:, :Ntxt], att[:, Ntxt:]
+        x = x + gx * (ax_ @ sd[f"{B}.attn.to_out.0.weight"].T
+                      + sd[f"{B}.attn.to_out.0.bias"])
+        xm = _ln(x) * (1 + scmx) + shmx
+        h1 = torch.nn.functional.gelu(
+            xm @ sd[f"{B}.ff.net.0.proj.weight"].T
+            + sd[f"{B}.ff.net.0.proj.bias"], approximate="tanh")
+        x = x + gmx * (h1 @ sd[f"{B}.ff.net.2.weight"].T
+                       + sd[f"{B}.ff.net.2.bias"])
+        c = c + gc * (ac @ sd[f"{B}.attn.to_add_out.weight"].T
+                      + sd[f"{B}.attn.to_add_out.bias"])
+        cm = _ln(c) * (1 + scmc) + shmc
+        h2 = torch.nn.functional.gelu(
+            cm @ sd[f"{B}.ff_context.net.0.proj.weight"].T
+            + sd[f"{B}.ff_context.net.0.proj.bias"], approximate="tanh")
+        c = c + gmc * (h2 @ sd[f"{B}.ff_context.net.2.weight"].T
+                       + sd[f"{B}.ff_context.net.2.bias"])
+
+    s = torch.cat([c, x], dim=1)
+    for i in range(cfg["num_single_layers"]):
+        B = f"single_transformer_blocks.{i}"
+        m = (semb @ sd[f"{B}.norm.linear.weight"].T
+             + sd[f"{B}.norm.linear.bias"])[:, None]
+        sh, sc, gt = m.chunk(3, dim=-1)
+        sn = _ln(s) * (1 + sc) + sh
+        q, k, v = _qkv(sd, f"{B}.attn", sn, H,
+                       f"{B}.attn.norm_q.weight", f"{B}.attn.norm_k.weight")
+        att = _unheads(_attn(_apply_rope_t(q, cos, sin),
+                             _apply_rope_t(k, cos, sin), v))
+        mlp = torch.nn.functional.gelu(
+            sn @ sd[f"{B}.proj_mlp.weight"].T + sd[f"{B}.proj_mlp.bias"],
+            approximate="tanh")
+        s = s + gt * (torch.cat([att, mlp], dim=-1)
+                      @ sd[f"{B}.proj_out.weight"].T
+                      + sd[f"{B}.proj_out.bias"])
+    x = s[:, Ntxt:]
+    om = (semb @ sd["norm_out.linear.weight"].T
+          + sd["norm_out.linear.bias"])[:, None]
+    scale, shift = om.chunk(2, dim=-1)
+    x = _ln(x) * (1 + scale) + shift
+    return x @ sd["proj_out.weight"].T + sd["proj_out.bias"]
+
+
+def _write_transformer(sd, d, cfg):
+    from safetensors.torch import save_file
+
+    d.mkdir(parents=True, exist_ok=True)
+    save_file(sd, d / "diffusion_pytorch_model.safetensors")
+    (d / "config.json").write_text(json.dumps(cfg))
+
+
+def test_mmdit_matches_torch_reference(tmp_path):
+    """Fixture state dict → repo loader → mmdit.forward vs the independent
+    torch implementation above."""
+    import jax.numpy as jnp
+
+    from localai_tpu.image.flux import _load_transformer
+
+    sd = _state_dict(CFG)
+    td = tmp_path / "transformer"
+    _write_transformer(sd, td, CFG)
+    cfg = mmdit.FluxConfig.from_hf(CFG)
+    params = _load_transformer(td, cfg)
+
+    rng = np.random.default_rng(0)
+    B, Ni, Nt = 2, 6, 4
+    img = rng.normal(size=(B, Ni, CFG["in_channels"])).astype(np.float32)
+    txt = rng.normal(size=(B, Nt, CFG["joint_attention_dim"])) \
+        .astype(np.float32)
+    pooled = rng.normal(size=(B, CFG["pooled_projection_dim"])) \
+        .astype(np.float32)
+    ids = np.zeros((Ni, 3), np.float32)
+    ids[:, 1] = np.arange(Ni) // 3
+    ids[:, 2] = np.arange(Ni) % 3
+    t = np.asarray([1.0, 0.5], np.float32)
+    guid = np.asarray([3.5, 3.5], np.float32)
+
+    ours = np.asarray(mmdit.forward(
+        cfg, params, jnp.asarray(img), jnp.asarray(txt),
+        jnp.asarray(pooled), jnp.asarray(t), jnp.asarray(ids),
+        jnp.zeros((Nt, 3)), guidance=jnp.asarray(guid),
+    ))
+    with torch.no_grad():
+        ref = torch_flux_forward(
+            CFG, sd, torch.tensor(img), torch.tensor(txt),
+            torch.tensor(pooled), torch.tensor(t), torch.tensor(ids),
+            torch.zeros(Nt, 3), torch.tensor(guid),
+        ).numpy()
+    np.testing.assert_allclose(ours, ref, atol=3e-4, rtol=3e-4)
+
+
+def test_t5_encoder_matches_transformers(tmp_path):
+    from transformers import T5Config as HFT5Config
+    from transformers import T5EncoderModel
+
+    from localai_tpu.image import t5
+
+    torch.manual_seed(0)
+    hf = HFT5Config(
+        vocab_size=99, d_model=32, d_kv=8, d_ff=64, num_layers=2,
+        num_heads=4, relative_attention_num_buckets=8,
+        relative_attention_max_distance=16, feed_forward_proj="gated-gelu",
+    )
+    m = T5EncoderModel(hf).eval()
+    d = tmp_path / "t5"
+    m.save_pretrained(d, safe_serialization=True)
+    cfg, params = t5.load_hf_t5(d)
+
+    import jax.numpy as jnp
+
+    ids = [3, 9, 1, 42, 7, 0, 0, 0]
+    ours = np.asarray(t5.encode(cfg, params, jnp.asarray([ids], jnp.int32)))
+    with torch.no_grad():
+        ref = m(torch.tensor([ids]),
+                attention_mask=torch.ones(1, 8, dtype=torch.long)
+                ).last_hidden_state.numpy()
+    np.testing.assert_allclose(ours, ref, atol=3e-4, rtol=3e-4)
+
+
+def test_flux_debug_pipeline_generates():
+    from localai_tpu.image import resolve_image_model
+
+    p = resolve_image_model("debug:flux-tiny")
+    r = p.generate("a lighthouse at dusk", width=64, height=64,
+                   steps=2, seed=11)
+    assert r.image.shape == (64, 64, 3) and r.image.dtype == np.uint8
+    r2 = p.generate("a lighthouse at dusk", width=64, height=64,
+                    steps=2, seed=11)
+    np.testing.assert_array_equal(r.image, r2.image)
+
+
+def test_flow_sigmas_schedule():
+    s = mmdit.flow_sigmas(4, 256)
+    assert s[0] == pytest.approx(1.0) and s[-1] == 0.0
+    assert np.all(np.diff(s) < 0)
+    # higher resolution shifts sigmas up (more time at high noise)
+    s_hi = mmdit.flow_sigmas(4, 4096)
+    assert np.all(s_hi[1:-1] > s[1:-1])
+
+
+def test_flux_layout_loader_end_to_end(tmp_path):
+    """Full FLUX directory layout (transformer/ vae/ text_encoder/ CLIP +
+    text_encoder_2/ T5) resolves through resolve_image_model and
+    generates."""
+    import shutil
+
+    from transformers import T5Config as HFT5Config
+    from transformers import T5EncoderModel
+
+    from test_image import _write_diffusers_fixture
+
+    from localai_tpu.image import resolve_image_model
+
+    root = tmp_path / "flux-ckpt"
+    _write_diffusers_fixture(root)           # supplies vae/ + text_encoder/
+    shutil.rmtree(root / "unet")             # flux has no unet
+
+    fcfg = dict(CFG)
+    fcfg["joint_attention_dim"] = 32         # match the tiny T5 below
+    fcfg["pooled_projection_dim"] = 64       # CLIP hidden of the fixture
+    fcfg["in_channels"] = 16                 # 4 latent ch x 2x2 patch
+    _write_transformer(_state_dict(fcfg), root / "transformer", fcfg)
+
+    torch.manual_seed(2)
+    t5m = T5EncoderModel(HFT5Config(
+        vocab_size=99, d_model=32, d_kv=8, d_ff=64, num_layers=2,
+        num_heads=4, relative_attention_num_buckets=8,
+        relative_attention_max_distance=16, feed_forward_proj="gated-gelu",
+    )).eval()
+    t5m.save_pretrained(root / "text_encoder_2", safe_serialization=True)
+    (root / "model_index.json").write_text(
+        json.dumps({"_class_name": "FluxPipeline"}))
+
+    # vae config gains flux-style shift/scale factors
+    vae_cfg = json.loads((root / "vae" / "config.json").read_text())
+    vae_cfg.update({"shift_factor": 0.1, "scaling_factor": 0.36})
+    (root / "vae" / "config.json").write_text(json.dumps(vae_cfg))
+
+    p = resolve_image_model(str(root))
+    assert type(p).__name__ == "FluxPipeline"
+    assert p.vae_shift == 0.1 and p.vae_scale == 0.36
+    r = p.generate("tiny prompt", width=64, height=64, steps=2, seed=3)
+    assert r.image.shape == (64, 64, 3) and r.image.dtype == np.uint8
+
+
+def test_flux_loader_honors_scheduler_shift(tmp_path):
+    """A schnell-style scheduler_config (use_dynamic_shifting=false,
+    shift=1.0) must disable the dev dynamic shift."""
+    import shutil
+
+    from transformers import T5Config as HFT5Config
+    from transformers import T5EncoderModel
+
+    from test_image import _write_diffusers_fixture
+
+    from localai_tpu.image import resolve_image_model
+
+    root = tmp_path / "flux-s"
+    _write_diffusers_fixture(root)
+    shutil.rmtree(root / "unet")
+    fcfg = dict(CFG)
+    fcfg.update(joint_attention_dim=32, pooled_projection_dim=64,
+                in_channels=16)
+    _write_transformer(_state_dict(fcfg), root / "transformer", fcfg)
+    torch.manual_seed(2)
+    T5EncoderModel(HFT5Config(
+        vocab_size=99, d_model=32, d_kv=8, d_ff=64, num_layers=2,
+        num_heads=4, relative_attention_num_buckets=8,
+        relative_attention_max_distance=16, feed_forward_proj="gated-gelu",
+    )).eval().save_pretrained(root / "text_encoder_2",
+                              safe_serialization=True)
+    (root / "scheduler").mkdir()
+    (root / "scheduler" / "scheduler_config.json").write_text(json.dumps(
+        {"use_dynamic_shifting": False, "shift": 1.0}))
+
+    p = resolve_image_model(str(root))
+    assert p.dynamic_shift is False and p.shift == 1.0
+    s = mmdit.flow_sigmas(4, 1024, dynamic=False, shift=1.0)
+    np.testing.assert_allclose(s, [1.0, 0.75, 0.5, 0.25, 0.0], atol=1e-6)
+    # a dev-style shift=3 static schedule bends the sigmas upward
+    s3 = mmdit.flow_sigmas(4, 1024, dynamic=False, shift=3.0)
+    assert np.all(s3[1:-1] > s[1:-1])
