@@ -177,6 +177,12 @@ class EngineConfig(BaseModel):
     # tokens per window; the target verifies them in one batched forward.
     draft_model: Optional[str] = None
     n_draft: int = 4
+    # Self-extend / group attention (parity: llama.cpp grp_attn_n/grp_attn_w,
+    # grpc-server.cpp:210-211): grp_attn_n>1 serves up to
+    # max_position_embeddings * grp_attn_n context via grouped positions —
+    # see engine/selfextend.py for the TPU formulation.
+    grp_attn_n: int = 1
+    grp_attn_w: int = 512
 
 
 class DiffusionConfig(BaseModel):

@@ -82,11 +82,26 @@ class ModelRunner:
         mesh: Optional[jax.sharding.Mesh] = None,
         attn_impl: str = "auto",
         sp_threshold: int = 1024,
+        ga_n: int = 1,
+        ga_w: int = 512,
     ):
         from localai_tpu import ops
 
         self.cfg = cfg
         self.params = params
+        # self-extend / group attention (parity: llama.cpp ga_n/ga_w slot
+        # options — see engine.selfextend). ga_n>1 serves past the trained
+        # context by merging neighbor + grouped attention scores; the KV
+        # cache stays UNroped in this mode, so it forces the XLA attend
+        # (the Pallas kernels assume pre-roped K).
+        if ga_n > 1 and ga_w % ga_n:
+            raise ValueError(f"ga_w ({ga_w}) must be a multiple of "
+                             f"ga_n ({ga_n})")
+        self.ga_n, self.ga_w = ga_n, ga_w
+        if ga_n > 1:
+            attn_impl = "xla"
+            log.info("self-extend active (ga_n=%d ga_w=%d): XLA attention, "
+                     "unroped KV cache", ga_n, ga_w)
         self.attn_impl, self._attn_interpret = ops.resolve_attn_impl(attn_impl)
         if mesh is not None and self.attn_impl == "pallas":
             # under a mesh the flash kernels run per-device via shard_map:
@@ -125,6 +140,13 @@ class ModelRunner:
         self.rope = mdl.rope_table(
             cfg, self.max_ctx, freq_base=rope_freq_base, freq_scale=rope_freq_scale
         )
+        if self.ga_n > 1:
+            from localai_tpu.engine import selfextend as se
+
+            # forward() sees an identity table (q/k written unroped); the
+            # self-extend attend applies the real rotations per score set
+            self._se_rope = self.rope
+            self.rope = se.identity_rope(self.rope)
         kv_sharding = None
         if mesh is not None:
             from jax.sharding import NamedSharding
@@ -204,6 +226,9 @@ class ModelRunner:
             # expert-parallel MoE prefill stays on the GSPMD path — the
             # manual ring shard_map doesn't slice router weights per shard
             and (cfg.num_experts == 0 or mesh.shape.get("expert", 1) == 1)
+            # self-extend keeps the cache unroped; the ring prefill writes
+            # roped K, so the two modes are mutually exclusive
+            and ga_n == 1
         )
         self.sp_threshold = sp_threshold
         self.last_prefill_path = ""
@@ -262,6 +287,9 @@ class ModelRunner:
                     out = kernel(q[:, 0], keys, values, pos)
                 return out[:, None]
 
+        if attn is None:
+            attn = self._se_attn(
+                pos[:, None], jnp.arange(self.max_ctx, dtype=jnp.int32))
         mask = kvc.decode_mask(cfg, pos, self.max_ctx)
         write = kvc.decode_write(pos, raw=raw_kv)
         hidden, new_stack = mdl.forward(
@@ -330,7 +358,8 @@ class ModelRunner:
                     tokens, length, slot, *, bucket: int, embeds=None):
         cfg = self.cfg
         positions = jnp.arange(bucket, dtype=jnp.int32)[None, :]
-        attn = self._prefill_attn(length)
+        attn = self._prefill_attn(length) or self._se_attn(
+            positions, positions[0])
         mask = kvc.prefill_mask(cfg, bucket, length)
         write = kvc.prefill_write(slot, jnp.zeros((), jnp.int32))
         hidden, new_stack = mdl.forward(
@@ -386,11 +415,13 @@ class ModelRunner:
         program launch."""
         cfg = self.cfg
         positions = offset + jnp.arange(bucket, dtype=jnp.int32)[None, :]
+        attn = self._se_attn(
+            positions, jnp.arange(self.max_ctx, dtype=jnp.int32))
         mask = kvc.resume_mask(cfg, bucket, offset, self.max_ctx)
         write = kvc.resume_write(slot, offset)
         hidden, new_stack = mdl.forward(
             cfg, params, tokens, positions, write, kv.stacked(), mask,
-            self.rope,
+            self.rope, attn=attn,
         )
         last_h = jax.lax.dynamic_index_in_dim(hidden[0], length - 1,
                                               keepdims=True)
@@ -479,9 +510,11 @@ class ModelRunner:
         positions = jnp.arange(bucket, dtype=jnp.int32)[None, :]
         mask = kvc.prefill_mask(cfg, bucket, length)
         write = kvc.prefill_write(jnp.int32(0), jnp.zeros((), jnp.int32))
+        attn = self._prefill_attn(length) or self._se_attn(
+            positions, positions[0])
         hidden, _ = mdl.forward(
             cfg, params, tokens, positions, write, kv, mask, self.rope,
-            attn=self._prefill_attn(length),
+            attn=attn,
         )
         valid = (jnp.arange(bucket) < length)[None, :, None]
         # pool in f32: a bf16 sum over thousands of positions loses the
@@ -489,6 +522,18 @@ class ModelRunner:
         summed = jnp.sum((hidden * valid).astype(jnp.float32), axis=1)
         pooled = summed / jnp.maximum(length, 1).astype(jnp.float32)
         return pooled[0]
+
+    def _se_attn(self, qpos, kpos):
+        """Self-extend attend for the XLA paths (None when ga_n == 1) —
+        the single construction point for all four call sites."""
+        if self.ga_n <= 1:
+            return None
+        from localai_tpu.engine import selfextend as se
+
+        return se.build_attend(
+            self.cfg, self._se_rope, self.ga_n, self.ga_w,
+            qpos=qpos, kpos=kpos,
+        )
 
     def _prefill_attn(self, length):
         """Pallas flash attention for the prefill/embed paths (None = XLA)."""
@@ -782,7 +827,10 @@ class ModelRunner:
         overwrite the cache — callers may hand it to another thread and
         materialize it there (pack_prefix) without stalling the engine."""
         p = n if n is not None else self.slot_position(slot)
-        out: dict = {"kv_dtype": str(self.kv_dtype)}
+        out: dict = {"kv_dtype": str(self.kv_dtype),
+                     # self-extend caches store UNroped K — a roped-cache
+                     # runner must never load these rows (and vice versa)
+                     "kv_rope": "raw" if self.ga_n > 1 else "roped"}
         out["k"] = self.kv.k[:, slot, :, :p]
         out["v"] = self.kv.v[:, slot, :, :p]
         if self.kv.quantized:
@@ -795,7 +843,8 @@ class ModelRunner:
         """Materialize a snapshot_prefix result as npz-serializable numpy.
         bfloat16 rows are stored as uint16 bit-views (numpy's npz format
         has no native bfloat16); scaled-int8 caches keep their scales."""
-        out: dict = {"kv_dtype": np.asarray(snapshot["kv_dtype"])}
+        out: dict = {"kv_dtype": np.asarray(snapshot["kv_dtype"]),
+                     "kv_rope": np.asarray(snapshot.get("kv_rope", "roped"))}
         for name in ("k", "v", "k_scale", "v_scale"):
             if name not in snapshot:
                 continue
@@ -817,6 +866,9 @@ class ModelRunner:
         False on any mismatch (dtype, shape, context) — callers fall back
         to a full prefill."""
         if str(arrays.get("kv_dtype")) != str(self.kv_dtype):
+            return False
+        want_rope = "raw" if self.ga_n > 1 else "roped"
+        if str(arrays.get("kv_rope", "roped")) != want_rope:
             return False
         if n > self.max_ctx - 1:
             return False

@@ -1,0 +1,80 @@
+"""Self-extend / group attention: serving beyond the trained context.
+
+Parity: llama.cpp's ga_n/ga_w slot options (/root/reference/backend/cpp/
+llama/grpc-server.cpp:210-211,528-539,1870-1895) — there implemented by
+periodically REWRITING cached KV positions (seq_add/seq_div + K-shift
+re-rotation). That design is hostile to XLA (in-place cache surgery,
+data-dependent loop); the TPU redesign keeps the cache UNroped in
+self-extend mode and computes BOTH attention score sets per step —
+neighbor (exact relative positions) and grouped (positions floor-divided
+by ga_n, the SelfExtend formulation, arXiv:2401.01325) — merging them by
+relative distance inside one fused program. No cache rewrites, no extra
+dispatches; the cost is a second QK^T over the same cache bytes already
+in registers.
+
+Positions: for query position p and key position j
+  neighbor score  : rope(p) · rope(j)         used where  p - j <  ga_w
+  grouped score   : rope(p//g + ga_w - ga_w//g) · rope(j//g)   otherwise
+The +ga_w - ga_w//g query shift keeps the two branches continuous at the
+window boundary (the paper's w_n - w_n//g offset).
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from localai_tpu.models import llama as mdl
+from localai_tpu.models.llama import LlamaConfig
+
+
+def identity_rope(rope) -> tuple[jax.Array, jax.Array]:
+    """A (cos=1, sin=0) table shaped like ``rope`` — models.llama.forward
+    then leaves q/k UNrotated, and the self-extend attend applies all
+    rotations itself."""
+    cos, sin = rope
+    return jnp.ones_like(cos), jnp.zeros_like(sin)
+
+
+def build_attend(cfg: LlamaConfig, rope, ga_n: int, ga_w: int,
+                 qpos: jax.Array, kpos: jax.Array):
+    """attend(q, keys, values, mask) for the XLA engine paths.
+
+    q [S, T, Hq, hd] and keys/values [S, Hkv, C, hd] arrive UNroped
+    (identity_rope upstream). qpos [S, T] / kpos [C] are absolute
+    positions; mask [S, T, C] bool is the normal causal/validity mask.
+    """
+    cos_t, sin_t = rope
+    shift = ga_w - ga_w // ga_n
+
+    def rope_q(x, pos):                       # x [S, T, Hq, hd], pos [S, T]
+        return mdl.apply_rope(
+            x, cos_t[pos][:, :, None, :], sin_t[pos][:, :, None, :])
+
+    def rope_k(keys, pos):                    # keys [S, Hkv, C, hd], pos [C]
+        return mdl.apply_rope(
+            keys, cos_t[pos][None, None, :, :], sin_t[pos][None, None, :, :])
+
+    def attend(q, keys, values, mask):
+        S, T = q.shape[0], q.shape[1]
+        Hkv, g, hd = cfg.num_kv_heads, cfg.q_per_kv, cfg.hd
+        limit = cos_t.shape[0] - 1
+
+        def scores(qr, kr):
+            qg = qr.reshape(S, T, Hkv, g, hd)
+            return jnp.einsum("stkgh,sklh->skgtl", qg, kr) / math.sqrt(hd)
+
+        s_n = scores(rope_q(q, qpos), rope_k(keys, kpos))
+        q_g = jnp.minimum(qpos // ga_n + shift, limit)
+        s_g = scores(rope_q(q, q_g), rope_k(keys, kpos // ga_n))
+        dist = qpos[:, :, None] - kpos[None, None, :]        # [S, T, C]
+        s = jnp.where(dist[:, None, None] < ga_w, s_n, s_g)
+        s = s.astype(jnp.float32)
+        s = jnp.where(mask[:, None, None], s, -1e30)
+        probs = jax.nn.softmax(s, axis=-1).astype(values.dtype)
+        out = jnp.einsum("skgtl,sklh->stkgh", probs, values)
+        return out.reshape(S, T, cfg.num_heads, hd)
+
+    return attend

@@ -305,6 +305,13 @@ def build_spec_decoder(target: ModelRunner, draft_ref: str, *,
                        model_path="models", gamma: int = 4,
                        dtype: str = "bfloat16") -> SpecDecoder:
     """Resolve ``draft_ref`` and couple it to ``target`` (manager entry)."""
+    if getattr(target, "ga_n", 1) > 1:
+        # self-extend targets carry an UNroped KV cache + identity rope
+        # table; the verify forward here would compute position-blind
+        # attention — reject rather than emit garbage
+        raise ValueError(
+            "speculative decoding is not supported with self-extend "
+            "(grp_attn_n > 1)")
     from localai_tpu.models.registry import resolve_model
 
     draft = resolve_model(draft_ref, model_path=model_path, dtype=dtype)

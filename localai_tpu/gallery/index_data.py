@@ -301,6 +301,9 @@ _ENTRIES: list[GalleryModel] = [
                           "tokenizer_config.json"],
             "tokenizer_2": ["spiece.model", "tokenizer.json",
                             "tokenizer_config.json"],
+            # schnell declares use_dynamic_shifting=false + shift=1.0 —
+            # without this file the loader would apply dev's dynamic shift
+            "scheduler": ["scheduler_config.json"],
         }.items() for f in _hf_files(
             "black-forest-labs/FLUX.1-schnell",
             [f"{sub}/{n}" for n in names])] + _hf_files(

@@ -335,7 +335,9 @@ def build_runner(mcfg: ModelConfig, app: AppConfig) -> tuple[Any, ModelRunner]:
 
         params = shd.shard_params(params, model.cfg, mesh)
     ctx = mcfg.context_size or app.context_size
-    ctx = min(ctx, model.cfg.max_position_embeddings)
+    # self-extend lifts the trained-context ceiling by the group factor
+    # (llama.cpp: n_ctx >= n_ctx_train * ga_n, grpc-server.cpp:535)
+    ctx = min(ctx, model.cfg.max_position_embeddings * max(eng.grp_attn_n, 1))
     runner = ModelRunner(
         model.cfg,
         params,
@@ -349,6 +351,8 @@ def build_runner(mcfg: ModelConfig, app: AppConfig) -> tuple[Any, ModelRunner]:
         mesh=mesh,
         sp_threshold=eng.sp_prefill_threshold,
         attn_impl=eng.attn_impl,
+        ga_n=eng.grp_attn_n,
+        ga_w=eng.grp_attn_w,
     )
     return model, runner
 
@@ -381,6 +385,12 @@ def build_serving_model(mcfg: ModelConfig, app: AppConfig) -> ServingModel:
         log.warning(
             "%s: draft_model is not supported with multi-host command "
             "mirroring yet; serving without speculative decoding", mcfg.name
+        )
+    elif eng.draft_model and eng.grp_attn_n > 1:
+        log.warning(
+            "%s: draft_model is not supported with self-extend "
+            "(grp_attn_n>1); serving without speculative decoding",
+            mcfg.name,
         )
     elif eng.draft_model:
         from localai_tpu.engine.speculative import build_spec_decoder
