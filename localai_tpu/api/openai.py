@@ -142,10 +142,13 @@ async def chat(request: web.Request) -> web.StreamResponse:
         if isinstance(e, MediaError):
             raise web.HTTPBadRequest(text=str(e)) from e
         raise
-    if cfg.template.use_tokenizer_template:
+    if cfg.template.use_tokenizer_template or cfg.template.chat_template:
         from localai_tpu.templates.chat import apply_tokenizer_template
 
-        prompt = apply_tokenizer_template(sm.tokenizer, messages)
+        prompt = apply_tokenizer_template(
+            sm.tokenizer, messages,
+            chat_template=cfg.template.chat_template,
+        )
     else:
         prompt = build_chat_prompt(
             sm.templates, cfg, messages,

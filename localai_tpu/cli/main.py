@@ -165,11 +165,20 @@ def build_parser() -> argparse.ArgumentParser:
                     choices=["bfloat16", "float32", "float16"])
 
     exp = sub.add_parser(
-        "explorer", help="dashboard over a federation router's nodes")
+        "explorer", help="multi-network discovery dashboard over "
+                         "federation routers (dial-test + eviction)")
     exp.add_argument("--address", default="0.0.0.0")
     exp.add_argument("--port", type=int, default=8085)
     exp.add_argument("--router", required=True,
-                     help="federation router base URL")
+                     help="federation router base URL (more can be "
+                          "registered at runtime via POST /api/networks)")
+    exp.add_argument("--db", default="",
+                     help="JSON file persisting the tracked-network list")
+    exp.add_argument("--interval", type=float, default=50.0,
+                     help="seconds between dial-test sweeps")
+    exp.add_argument("--failure-threshold", type=int, default=3,
+                     help="consecutive failures before a network is "
+                          "evicted from the database")
 
     fed = sub.add_parser(
         "federated", help="run a federation router over instances")
@@ -501,7 +510,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     if cmd == "explorer":
         from localai_tpu.federation.explorer import serve_explorer
 
-        serve_explorer(args.router, args.address, args.port)
+        serve_explorer(args.router, args.address, args.port,
+                       db_path=args.db or None, interval=args.interval,
+                       failure_threshold=args.failure_threshold)
         return 0
 
     if cmd == "federated":

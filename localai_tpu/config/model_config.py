@@ -94,6 +94,10 @@ class TemplateConfig(BaseModel):
     functions: Optional[str] = None
     multimodal: Optional[str] = None
     use_tokenizer_template: bool = False
+    # raw Jinja chat template (messages/add_generation_prompt), overriding
+    # the tokenizer's own — filled by the family guesser
+    # (config.guesser.guess_chat_defaults) for template-less configs
+    chat_template: Optional[str] = None
     join_chat_messages_by_character: Optional[str] = None
 
 

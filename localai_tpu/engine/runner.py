@@ -102,30 +102,19 @@ class ModelRunner:
             attn_impl = "xla"
             log.info("self-extend active (ga_n=%d ga_w=%d): XLA attention, "
                      "unroped KV cache", ga_n, ga_w)
-        self.attn_impl, self._attn_interpret = ops.resolve_attn_impl(attn_impl)
-        if mesh is not None and self.attn_impl == "pallas":
-            # under a mesh the flash kernels run per-device via shard_map:
-            # slots split on 'data', heads on 'model'. That requires the
-            # head groups to split evenly — otherwise kv heads replicate
-            # (see parallel.sharding.kv_spec) and the kernel's GQA grouping
-            # would misalign, so those configs keep the XLA path.
-            tp = mesh.shape["model"]
-            if cfg.num_heads % tp or cfg.num_kv_heads % tp:
-                log.info(
-                    "attention: heads (%d q / %d kv) not divisible by "
-                    "tensor_parallel %d; using XLA under mesh",
-                    cfg.num_heads, cfg.num_kv_heads, tp,
-                )
-                self.attn_impl = "xla"
-        if (self.attn_impl == "pallas" and not self._attn_interpret
-                and (cfg.hd % 128 or (max_ctx or cfg.max_position_embeddings) % 128)):
-            # Mosaic lane tiling is 128-wide; unaligned head_dim/ctx (tiny
-            # debug models, hd-64 families) take the XLA path on real TPU
-            log.info(
-                "attention: head_dim=%d ctx=%s not 128-aligned; using XLA",
-                cfg.hd, max_ctx,
-            )
-            self.attn_impl = "xla"
+        # the full decision (auto-resolve + every fallback gate) lives in
+        # ops.select_attn_impl so tests can assert which path a given
+        # (model, mesh) lands on at hardware shapes
+        self.attn_impl, self._attn_interpret, why = ops.select_attn_impl(
+            attn_impl,
+            num_heads=cfg.num_heads,
+            num_kv_heads=cfg.num_kv_heads,
+            head_dim=cfg.hd,
+            max_ctx=max_ctx or cfg.max_position_embeddings,
+            tp=mesh.shape["model"] if mesh is not None else 1,
+        )
+        if why:
+            log.info("attention: %s; using XLA", why)
         # int8 KV rides the same flash decode kernel: per-position scales
         # fuse into the online-softmax loop (ops.attention), so the default
         # quantized config is both length-aware (block-skip past each slot's

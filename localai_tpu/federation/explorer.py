@@ -1,10 +1,15 @@
-"""Explorer: a dashboard over a federation router's node registry.
+"""Explorer: a multi-network discovery dashboard over federation routers.
 
-Parity: /root/reference/core/explorer/ + core/http/views/explorer.html —
-the reference's explorer crawls community p2p networks into a discovery
-database and serves a dashboard; without a p2p overlay, the TPU-native
-explorer points at a federation router (the node registry IS the network)
-and renders its nodes with live health/traffic numbers.
+Parity: /root/reference/core/explorer/ (discovery.go:16-30 + database.go +
+core/http/views/explorer.html) — the reference keeps a token DATABASE of
+community p2p networks, dial-tests each one on an interval, counts
+failures, and deletes networks after ``failure_threshold`` consecutive
+errors. Without a p2p overlay, the TPU-native unit of a "network" is a
+federation ROUTER URL (its node registry IS the network): the explorer
+persists a JSON database of routers, a background monitor polls each
+router's ``/federated/nodes`` (the dial test), snapshots the cluster
+data, and evicts routers that keep failing — the same lifecycle,
+HTTP-native.
 """
 
 from __future__ import annotations
@@ -12,7 +17,11 @@ from __future__ import annotations
 import html
 import json
 import logging
+import threading
+import time
 import urllib.request
+from pathlib import Path
+from typing import Optional
 
 from aiohttp import web
 
@@ -25,64 +34,287 @@ def fetch_nodes(router: str, timeout: float = 5.0) -> dict:
         return json.loads(resp.read())
 
 
-async def _fetch_nodes_async(request: web.Request) -> dict:
-    import asyncio
+class ExplorerDB:
+    """Persistent router database (parity: explorer.Database — token list
+    + per-entry failure bookkeeping, JSON on disk, thread-safe)."""
 
-    # urllib blocks (up to its 5s timeout); keep it off the event loop so
-    # a slow router can't freeze the dashboard for other viewers
-    return await asyncio.get_running_loop().run_in_executor(
-        None, fetch_nodes, request.app["router_url"]
-    )
+    def __init__(self, path: Optional[str | Path] = None):
+        self.path = Path(path) if path else None
+        self._lock = threading.Lock()
+        self._entries: dict[str, dict] = {}
+        if self.path and self.path.exists():
+            try:
+                self._entries = json.loads(self.path.read_text())
+            except (OSError, ValueError):
+                log.warning("explorer db %s unreadable; starting empty",
+                            self.path)
 
+    def _persist(self) -> None:
+        if self.path is None:
+            return
+        tmp = self.path.with_suffix(".tmp")
+        tmp.write_text(json.dumps(self._entries))
+        tmp.replace(self.path)
+
+    def add(self, url: str, name: str = "") -> None:
+        url = url.rstrip("/")
+        with self._lock:
+            self._entries.setdefault(url, {
+                "name": name or url, "failures": 0, "added_at": time.time(),
+            })
+            if name:
+                self._entries[url]["name"] = name
+            self._persist()
+
+    def remove(self, url: str) -> bool:
+        with self._lock:
+            gone = self._entries.pop(url.rstrip("/"), None) is not None
+            self._persist()
+            return gone
+
+    def routers(self) -> list[str]:
+        with self._lock:
+            return list(self._entries)
+
+    def entries(self) -> dict[str, dict]:
+        with self._lock:
+            return {k: dict(v) for k, v in self._entries.items()}
+
+    def mark_ok(self, url: str) -> None:
+        with self._lock:
+            if url in self._entries:
+                self._entries[url]["failures"] = 0
+                self._persist()
+
+    def mark_failed(self, url: str, threshold: int) -> bool:
+        """Increment the failure count; evict (and return True) at the
+        threshold — discovery.go failedToken/deleteToken semantics."""
+        with self._lock:
+            e = self._entries.get(url)
+            if e is None:
+                return False
+            e["failures"] = int(e.get("failures", 0)) + 1
+            if e["failures"] >= threshold:
+                del self._entries[url]
+                self._persist()
+                log.info("explorer: evicting %s after %d failures",
+                         url, threshold)
+                return True
+            self._persist()
+            return False
+
+
+class DiscoveryMonitor:
+    """Background dial-tester (parity: explorer.DiscoveryServer
+    runBackground — sequential per-network connect with a deadline,
+    failure-count eviction, snapshot of cluster data)."""
+
+    def __init__(self, db: ExplorerDB, *, interval: float = 50.0,
+                 failure_threshold: int = 3, timeout: float = 5.0):
+        self.db = db
+        self.interval = interval
+        self.failure_threshold = failure_threshold
+        self.timeout = timeout
+        self._lock = threading.Lock()
+        self._state: dict[str, dict] = {}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def poll_once(self, only: Optional[set] = None,
+                  count_failures: bool = True) -> None:
+        """One dial-test sweep (the testable unit).
+
+        ``only`` restricts the sweep to a subset of routers (dashboard
+        first-render warm-up); ``count_failures=False`` updates the state
+        snapshot without advancing eviction counters — the 'consecutive
+        failures' contract counts background sweeps, not page loads."""
+        for url in self.db.routers():
+            if only is not None and url not in only:
+                continue
+            try:
+                data = fetch_nodes(url, timeout=self.timeout)
+                nodes = data.get("nodes", [])
+                with self._lock:
+                    self._state[url] = {
+                        "ok": True,
+                        "nodes": nodes,
+                        "online": sum(1 for n in nodes if n.get("online")),
+                        "checked_at": time.time(),
+                    }
+                self.db.mark_ok(url)
+            except Exception as e:  # noqa: BLE001 — the dial test failing
+                evicted = (count_failures and self.db.mark_failed(
+                    url, self.failure_threshold))
+                with self._lock:
+                    if evicted:
+                        self._state.pop(url, None)
+                    else:
+                        self._state[url] = {
+                            "ok": False, "error": str(e), "nodes": [],
+                            "online": 0, "checked_at": time.time(),
+                        }
+
+    def state(self) -> dict[str, dict]:
+        with self._lock:
+            return {k: dict(v) for k, v in self._state.items()}
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+
+        def run():
+            while not self._stop.is_set():
+                self.poll_once()
+                self._stop.wait(self.interval)
+
+        self._thread = threading.Thread(target=run, daemon=True,
+                                        name="explorer-monitor")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+
+# -- HTTP app ---------------------------------------------------------------
 
 async def _index(request: web.Request) -> web.Response:
-    router = request.app["router_url"]
-    try:
-        data = await _fetch_nodes_async(request)
-        err = ""
-    except Exception as e:  # noqa: BLE001 — router down renders as such
-        data = {"nodes": []}
-        err = str(e)
-    rows = "".join(
-        f"<tr><td>{html.escape(n['id'])}</td>"
-        f"<td>{'🟢 online' if n['online'] else '🔴 offline'}</td>"
-        f"<td>{n['requests_served']}</td></tr>"
-        for n in data.get("nodes", [])
-    )
+    import asyncio
+
+    mon: DiscoveryMonitor = request.app["monitor"]
+    entries = mon.db.entries()
+    state = mon.state()
+    missing = {url for url in entries if url not in state}
+    if missing:
+        # first render (or a freshly registered network): dial-test the
+        # missing ones now so the dashboard never shows a blank page —
+        # without advancing eviction counters (page loads are not sweeps)
+        await asyncio.get_running_loop().run_in_executor(
+            None, lambda: mon.poll_once(only=missing, count_failures=False))
+        entries = mon.db.entries()
+        state = mon.state()
+    sections = []
+    for url, meta in sorted(entries.items()):
+        st = state.get(url, {})
+        rows = "".join(
+            f"<tr><td>{html.escape(str(n.get('id', '?')))}</td>"
+            f"<td>{'🟢 online' if n.get('online') else '🔴 offline'}</td>"
+            f"<td>{n.get('requests_served', 0)}</td></tr>"
+            for n in st.get("nodes", [])
+        )
+        status = ("not checked yet" if not st else
+                  f"{st.get('online', 0)}/{len(st.get('nodes', []))} online"
+                  if st.get("ok") else
+                  f"unreachable ({html.escape(str(st.get('error', '')))}), "
+                  f"failures {meta.get('failures', 0)}"
+                  f"/{mon.failure_threshold}")
+        sections.append(
+            f"<h3>{html.escape(meta.get('name', url))}</h3>"
+            f"<p><code>{html.escape(url)}</code> — {status}</p>"
+            f"<table><tr><th>Node</th><th>Status</th><th>Requests</th></tr>"
+            f"{rows or '<tr><td colspan=3>no nodes</td></tr>'}</table>"
+        )
     doc = f"""<!doctype html><html><head><meta charset="utf-8">
-<meta http-equiv="refresh" content="5">
+<meta http-equiv="refresh" content="10">
 <title>LocalAI-TPU explorer</title>
 <style>body{{font:15px system-ui;background:#0f1217;color:#e6e9ee;
 margin:2rem auto;max-width:760px}}td,th{{padding:.4rem .6rem;text-align:
 left;border-bottom:1px solid #2a3240}}table{{width:100%;border-collapse:
-collapse}}.err{{color:#d9923b}}</style></head><body>
+collapse}}code{{color:#8fd0ff}}</style></head><body>
 <h2>Federation explorer</h2>
-<p>router: <code>{html.escape(router)}</code>
-{f'<span class="err">({html.escape(err)})</span>' if err else ''}</p>
-<table><tr><th>Node</th><th>Status</th><th>Requests served</th></tr>
-{rows or '<tr><td colspan=3>no nodes registered</td></tr>'}</table>
-<p style="color:#8b95a5">auto-refreshes every 5s</p>
+<p style="color:#8b95a5">{len(entries)} network(s) tracked; dial-tested
+every {int(request.app['monitor'].interval)}s; evicted after
+{request.app['monitor'].failure_threshold} consecutive failures.
+Register: <code>POST /api/networks {{"url": "http://router:8080"}}</code></p>
+{''.join(sections) or '<p>no networks registered</p>'}
 </body></html>"""
     return web.Response(text=doc, content_type="text/html")
 
 
-async def _api(request: web.Request) -> web.Response:
+async def _api_networks(request: web.Request) -> web.Response:
+    mon: DiscoveryMonitor = request.app["monitor"]
+    entries = mon.db.entries()
+    state = mon.state()
+    return web.json_response({
+        "networks": [
+            {"url": url, **meta, **state.get(url, {})}
+            for url, meta in entries.items()
+        ]
+    })
+
+
+async def _api_add_network(request: web.Request) -> web.Response:
+    mon: DiscoveryMonitor = request.app["monitor"]
     try:
-        return web.json_response(await _fetch_nodes_async(request))
+        body = await request.json()
+    except ValueError:
+        raise web.HTTPBadRequest(text="body must be JSON")
+    url = str(body.get("url", "")).strip()
+    if not url.startswith(("http://", "https://")):
+        raise web.HTTPBadRequest(text="url must be http(s)")
+    mon.db.add(url, name=str(body.get("name", "")))
+    return web.json_response({"ok": True, "tracked": len(mon.db.routers())})
+
+
+async def _api_del_network(request: web.Request) -> web.Response:
+    mon: DiscoveryMonitor = request.app["monitor"]
+    url = request.query.get("url", "")
+    if not mon.db.remove(url):
+        raise web.HTTPNotFound(text="network not tracked")
+    return web.json_response({"ok": True})
+
+
+async def _api_nodes(request: web.Request) -> web.Response:
+    """Back-compat single-router view (the round-4 explorer surface)."""
+    import asyncio
+
+    router = request.app["router_url"]
+    try:
+        data = await asyncio.get_running_loop().run_in_executor(
+            None, fetch_nodes, router)
+        return web.json_response(data)
     except Exception as e:  # noqa: BLE001
         return web.json_response({"error": str(e)}, status=502)
 
 
-def create_explorer_app(router: str) -> web.Application:
+def create_explorer_app(router: str = "", *, db_path: Optional[str] = None,
+                        interval: float = 50.0, failure_threshold: int = 3,
+                        start_monitor: bool = False) -> web.Application:
+    db = ExplorerDB(db_path)
+    if router:
+        db.add(router)
+    monitor = DiscoveryMonitor(db, interval=interval,
+                               failure_threshold=failure_threshold)
     app = web.Application()
     app["router_url"] = router
+    app["monitor"] = monitor
     app.router.add_get("/", _index)
-    app.router.add_get("/api/nodes", _api)
+    app.router.add_get("/api/networks", _api_networks)
+    app.router.add_post("/api/networks", _api_add_network)
+    app.router.add_delete("/api/networks", _api_del_network)
+    app.router.add_get("/api/nodes", _api_nodes)
+    if start_monitor:
+        async def _on_start(_app):
+            monitor.start()
+
+        async def _on_stop(_app):
+            monitor.stop()
+
+        app.on_startup.append(_on_start)
+        app.on_cleanup.append(_on_stop)
     return app
 
 
 def serve_explorer(router: str, address: str = "0.0.0.0",
-                   port: int = 8085) -> None:
-    log.info("explorer on %s:%d over router %s", address, port, router)
-    web.run_app(create_explorer_app(router), host=address, port=port,
-                print=None, access_log=None)
+                   port: int = 8085, *, db_path: Optional[str] = None,
+                   interval: float = 50.0, failure_threshold: int = 3) -> None:
+    log.info("explorer on %s:%d over router %s (db=%s)",
+             address, port, router, db_path or "<memory>")
+    web.run_app(
+        create_explorer_app(router, db_path=db_path, interval=interval,
+                            failure_threshold=failure_threshold,
+                            start_monitor=True),
+        host=address, port=port, print=None, access_log=None,
+    )

@@ -95,9 +95,12 @@ def autodetect_config(cfg, model_path: str | Path) -> None:
     """Fill ModelConfig.backend for a bare `model:` YAML so usecase
     guessing and endpoint routing land on the right engine (parity:
     guesser.go run at config load)."""
-    if cfg.backend:
-        return
-    detected = detect_backend(cfg.model or cfg.name, model_path)
-    if detected:
-        log.info("model %s: detected %s checkpoint", cfg.name, detected)
-        cfg.backend = detected
+    if not cfg.backend:
+        detected = detect_backend(cfg.model or cfg.name, model_path)
+        if detected:
+            log.info("model %s: detected %s checkpoint", cfg.name, detected)
+            cfg.backend = detected
+    if not cfg.backend:  # LLM engine: guess chat defaults by family
+        from localai_tpu.config.guesser import guess_chat_defaults
+
+        guess_chat_defaults(cfg, model_path)

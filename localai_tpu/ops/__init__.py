@@ -24,16 +24,47 @@ __all__ = [
 ]
 
 
-def resolve_attn_impl(requested: str = "auto") -> tuple[str, bool]:
+def resolve_attn_impl(requested: str = "auto",
+                      backend: str | None = None) -> tuple[str, bool]:
     """Returns (impl, interpret) with impl in {"xla", "pallas"}."""
+    backend = backend or jax.default_backend()
     impl = requested
     if impl in ("auto", ""):
         # env only overrides the default, never an explicit per-runner choice
         impl = os.environ.get("LOCALAI_ATTN_IMPL", "") or "auto"
     if impl in ("auto", ""):
-        impl = "pallas" if jax.default_backend() == "tpu" else "xla"
+        impl = "pallas" if backend == "tpu" else "xla"
     if impl == "pallas_interpret":
         return "pallas", True
     if impl not in ("pallas", "xla"):
         raise ValueError(f"unknown attention impl {impl!r}")
-    return impl, impl == "pallas" and jax.default_backend() != "tpu"
+    return impl, impl == "pallas" and backend != "tpu"
+
+
+def select_attn_impl(requested: str, *, num_heads: int, num_kv_heads: int,
+                     head_dim: int, max_ctx: int, tp: int = 1,
+                     backend: str | None = None) -> tuple[str, bool, str]:
+    """The FULL engine attention-impl decision — resolve_attn_impl plus
+    every fallback gate ModelRunner applies, as one pure function so CI can
+    assert which path a given (model, mesh) lands on at hardware shapes
+    (VERDICT r4 #9: a silent Pallas→XLA fallback regression must fail a
+    test, not just slow the bench).
+
+    Returns (impl, interpret, reason) — reason is "" when no fallback
+    fired, else a human-readable explanation.
+    """
+    impl, interpret = resolve_attn_impl(requested, backend)
+    if impl == "pallas" and tp > 1 and (num_heads % tp or num_kv_heads % tp):
+        # under a mesh the flash kernels run per-device via shard_map
+        # (slots on 'data', heads on 'model') — head groups must split
+        # evenly or the kernel's GQA grouping would misalign
+        return "xla", False, (
+            f"heads ({num_heads} q / {num_kv_heads} kv) not divisible by "
+            f"tensor_parallel {tp}")
+    if impl == "pallas" and not interpret and (head_dim % 128
+                                               or max_ctx % 128):
+        # Mosaic lane tiling is 128-wide; unaligned head_dim/ctx (tiny
+        # debug models, hd-64 families) take the XLA path on real TPU
+        return "xla", False, (
+            f"head_dim={head_dim} ctx={max_ctx} not 128-aligned")
+    return impl, interpret, ""

@@ -268,6 +268,12 @@ def gguf_to_hf_config(meta: dict) -> dict:
             g("attention.layer_norm_rms_epsilon", 1e-5)),
         "tie_word_embeddings": False,
     }
+    # special token ids: stopping + the family guesser
+    # (config.guesser.identify_family keys on them, as guesser.go does)
+    for hf_key, gg_key in (("bos_token_id", "tokenizer.ggml.bos_token_id"),
+                           ("eos_token_id", "tokenizer.ggml.eos_token_id")):
+        if gg_key in meta:
+            cfg[hf_key] = int(meta[gg_key])
     # Mixtral-class MoE ({arch}.expert_count / expert_used_count)
     ec = g("expert_count")
     if ec:
@@ -326,6 +332,10 @@ def _hf_name(name: str) -> str | None:
             "attn_norm.weight": "input_layernorm.weight",
             "ffn_norm.weight": "post_attention_layernorm.weight",
             "ffn_gate_inp.weight": "block_sparse_moe.gate.weight",
+            # qwen2-family qkv biases
+            "attn_q.bias": "self_attn.q_proj.bias",
+            "attn_k.bias": "self_attn.k_proj.bias",
+            "attn_v.bias": "self_attn.v_proj.bias",
         }
         if rest in mapping:
             return f"model.layers.{idx}.{mapping[rest]}"
@@ -377,10 +387,13 @@ def convert_gguf(src: str | Path, out_dir: str | Path,
             skipped.append(name)
             continue
         w = gg.load_tensor(name)
-        if name.endswith("attn_q.weight"):
-            w = _unpermute(w, heads)
-        elif name.endswith("attn_k.weight"):
-            w = _unpermute(w, kv_heads)
+        # llama.cpp's HF→GGUF convert permutes q/k rows ONLY for the
+        # llama/mistral architectures; qwen2-class GGUFs store HF order
+        if hf.get("model_type") in ("llama", "mistral"):
+            if name.endswith("attn_q.weight"):
+                w = _unpermute(w, heads)
+            elif name.endswith("attn_k.weight"):
+                w = _unpermute(w, kv_heads)
         tensors[hf_name] = np.ascontiguousarray(w.astype(np_dtype))
     if skipped:
         log.info("convert: skipped %d non-llama tensors (%s...)",
@@ -404,4 +417,11 @@ def convert_gguf(src: str | Path, out_dir: str | Path,
                           "unk_token": toks[0]},
                 "added_tokens": [],
             }, f)
+    # carry the source's chat template so serving formats prompts the way
+    # the model was trained (template-less sources fall to the family
+    # guesser at config load — config/guesser.py)
+    chat_tmpl = gg.metadata.get("tokenizer.chat_template")
+    if chat_tmpl:
+        with open(out_dir / "tokenizer_config.json", "w") as f:
+            json.dump({"chat_template": chat_tmpl}, f)
     return out_dir

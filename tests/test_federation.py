@@ -238,3 +238,64 @@ def test_explorer_renders_router_nodes():
     finally:
         explorer.stop()
         router.stop()
+
+
+def test_explorer_multi_network_db_and_eviction(tmp_path):
+    """VERDICT r4 #10: multi-router token database + dial-test monitor with
+    failure-count eviction (parity: core/explorer/discovery.go:16-30)."""
+    from localai_tpu.federation.explorer import DiscoveryMonitor, ExplorerDB
+
+    db = ExplorerDB(tmp_path / "networks.json")
+    db.add("http://127.0.0.1:1", name="dead")
+    mon = DiscoveryMonitor(db, interval=3600, failure_threshold=3,
+                           timeout=0.3)
+
+    fed = FederatedServer(["live:9993"], health_interval=60)
+    router = _AppThread(fed.create_app())
+    try:
+        db.add(f"http://{router.addr}", name="live-net")
+        mon.poll_once()
+        st = mon.state()
+        assert st[f"http://{router.addr}"]["ok"]
+        assert len(st[f"http://{router.addr}"]["nodes"]) == 1
+        assert db.entries()["http://127.0.0.1:1"]["failures"] == 1
+        # two more failed sweeps evict the dead network
+        mon.poll_once()
+        mon.poll_once()
+        assert "http://127.0.0.1:1" not in db.routers()
+        assert f"http://{router.addr}" in db.routers()
+        # persistence survives a restart
+        db2 = ExplorerDB(tmp_path / "networks.json")
+        assert db2.routers() == [f"http://{router.addr}"]
+    finally:
+        router.stop()
+
+
+def test_explorer_network_registration_api(tmp_path):
+    from localai_tpu.federation.explorer import create_explorer_app
+
+    fed = FederatedServer(["apinode:9994"], health_interval=60)
+    router = _AppThread(fed.create_app())
+    explorer = _AppThread(create_explorer_app(
+        db_path=str(tmp_path / "db.json"), interval=3600))
+    try:
+        with httpx.Client(timeout=10.0) as c:
+            base = f"http://{explorer.addr}"
+            r = c.post(f"{base}/api/networks",
+                       json={"url": f"http://{router.addr}",
+                             "name": "test-net"})
+            assert r.status_code == 200
+            assert c.post(f"{base}/api/networks",
+                          json={"url": "ftp://nope"}).status_code == 400
+            # dashboard dial-tests on first render and shows the nodes
+            page = c.get(f"{base}/")
+            assert "test-net" in page.text and "apinode:9994" in page.text
+            nets = c.get(f"{base}/api/networks").json()["networks"]
+            assert len(nets) == 1 and nets[0]["ok"]
+            assert c.delete(
+                f"{base}/api/networks",
+                params={"url": f"http://{router.addr}"}).status_code == 200
+            assert c.get(f"{base}/api/networks").json()["networks"] == []
+    finally:
+        explorer.stop()
+        router.stop()
