@@ -38,87 +38,133 @@ def merge_request(mcfg: ModelConfig, req: OpenAIRequest) -> ModelConfig:
     return cfg
 
 
+@dataclasses.dataclass
+class MMContent:
+    """Encoded multimodal conditioning: per-item patch embeddings plus the
+    row spans videos occupy (a video = several sampled frames)."""
+
+    embeds: Any                                  # [n_rows, n_patches, D]
+    video_groups: list[tuple[int, int]]          # [vid-N] → (start, count)
+
+
 def prepare_multimodal(
     sm: ServingModel, cfg: ModelConfig, req: OpenAIRequest
-) -> tuple[list[dict], Optional[Any]]:
-    """Multipart message content → text with [img-N] placeholders (global
-    running IDs) + encoded image embeddings.
+) -> tuple[list[dict], Optional[MMContent]]:
+    """Multipart message content → text with [img-N]/[vid-N] placeholders
+    (global running IDs) + encoded image/video-frame embeddings.
 
     Parity: the reference's per-message image collection + multimodal
     templating (/root/reference/core/http/endpoints/openai/chat.go:296-441,
-    pkg/templates/multimodal.go); the CLIP encode happens here instead of
-    inside the C++ worker (grpc-server.cpp:1397-1424).
-    Returns (message dicts for templating, embeds [n_img, n_patches, D] or
-    None when the request has no images or the model has no vision tower).
+    pkg/templates/multimodal.go) and the vLLM backend's image+video
+    multimodal path (backend/python/vllm/backend.py); the CLIP encode
+    happens here instead of inside the worker (grpc-server.cpp:1397-1424).
+    Videos decode to uniformly-sampled frames (utils.media), each encoded
+    like an image and injected as consecutive patch blocks.
+    Returns (message dicts for templating, MMContent or None when the
+    request has no media or the model has no vision tower).
     """
     from localai_tpu.templates.chat import multimodal_placeholders
 
     messages: list[dict] = []
     refs: list[str] = []
+    vid_refs: list[str] = []
     for m in req.messages:
         d = m.model_dump(exclude_none=True)
         imgs = m.media_parts("image")
-        if imgs:
+        vids = m.media_parts("video")
+        if imgs or vids:
             d["content"] = multimodal_placeholders(
                 cfg.template.multimodal or "",
                 m.text_content(),
                 n_images=len(imgs),
+                n_video=len(vids),
                 first_image_id=len(refs),
+                first_video_id=len(vid_refs),
             )
             refs.extend(imgs)
+            vid_refs.extend(vids)
         messages.append(d)
-    if not refs:
+    if not refs and not vid_refs:
         return messages, None
     if sm.vision is None:
         log.warning(
-            "model %s received %d image(s) but has no vision tower "
-            "(set mmproj or use a llava checkpoint); serving text-only",
-            sm.name, len(refs),
+            "model %s received %d image(s)/%d video(s) but has no vision "
+            "tower (set mmproj or use a llava checkpoint); serving "
+            "text-only", sm.name, len(refs), len(vid_refs),
         )
         return messages, None
     from concurrent.futures import ThreadPoolExecutor
 
-    from localai_tpu.utils.media import fetch_image
+    from localai_tpu.utils.media import fetch_image, fetch_video_frames
 
-    # fetch concurrently: latency bounds to the slowest single image, not
+    # fetch concurrently: latency bounds to the slowest single item, not
     # the sum over refs (remote URLs each carry a 30s timeout)
-    with ThreadPoolExecutor(max_workers=min(8, len(refs))) as pool:
-        images = list(pool.map(fetch_image, refs))
-    return messages, sm.vision.encode(images)
+    with ThreadPoolExecutor(max_workers=min(8, len(refs) + len(vid_refs))) \
+            as pool:
+        img_it = pool.map(fetch_image, refs)
+        vid_it = pool.map(fetch_video_frames, vid_refs)
+        images = list(img_it)
+        frame_lists = list(vid_it)
+    video_groups: list[tuple[int, int]] = []
+    start = len(images)
+    frames: list = []
+    for fl in frame_lists:
+        video_groups.append((start, len(fl)))
+        frames.extend(fl)
+        start += len(fl)
+    return messages, MMContent(
+        embeds=sm.vision.encode(images + frames),
+        video_groups=video_groups,
+    )
 
 
 def expand_image_placeholders(
-    sm: ServingModel, prompt: str, embeds: Any
+    sm: ServingModel, prompt: str, mm: Any
 ) -> tuple[list[int], Optional[Any], Optional[Any]]:
-    """Tokenize a prompt with [img-N] placeholders: each placeholder becomes
-    n_patches image-token ids, and the matching embedding rows + positions
-    are returned for scatter-injection at prefill (ModelRunner._prefill_mm).
+    """Tokenize a prompt with [img-N]/[vid-N] placeholders: each image
+    placeholder becomes n_patches image-token ids (a video: n_frames x
+    n_patches), and the matching embedding rows + positions are returned
+    for scatter-injection at prefill (ModelRunner._prefill_mm).
 
     The TPU-shaped version of llama.cpp's interleaved text/image batch
     build (grpc-server.cpp:1397-1424): one token stream, one scatter."""
     import numpy as np
 
-    segs = re.split(r"\[img-(\d+)\]", prompt)
+    if isinstance(mm, MMContent):
+        embeds, video_groups = mm.embeds, mm.video_groups
+    else:  # raw [n, patches, D] array (image-only callers/tests)
+        embeds, video_groups = mm, []
+    n_images = embeds.shape[0] - sum(c for _, c in video_groups)
+
+    segs = re.split(r"\[(img|vid)-(\d+)\]", prompt)
     tokens = sm.tokenizer.encode(segs[0], add_bos=True)
     rows, poss = [], []
     n_patches = embeds.shape[1]
-    for i in range(1, len(segs), 2):
-        idx = int(segs[i])
-        if 0 <= idx < embeds.shape[0]:
-            start = len(tokens)
-            tokens.extend([sm.image_token_id] * n_patches)
-            poss.extend(range(start, start + n_patches))
-            rows.append(embeds[idx])
-        tail = segs[i + 1]
+
+    def inject(row_start: int, count: int):
+        start = len(tokens)
+        tokens.extend([sm.image_token_id] * (n_patches * count))
+        poss.extend(range(start, start + n_patches * count))
+        rows.append(embeds[row_start: row_start + count].reshape(
+            count * n_patches, -1))
+
+    for i in range(1, len(segs), 3):
+        kind, idx = segs[i], int(segs[i + 1])
+        if kind == "img" and 0 <= idx < n_images:
+            inject(idx, 1)
+        elif kind == "vid" and 0 <= idx < len(video_groups):
+            inject(*video_groups[idx])
+        tail = segs[i + 2]
         if tail:
             tokens.extend(sm.tokenizer.encode(tail, add_bos=False))
-    if len(rows) < embeds.shape[0]:
-        # a custom template.multimodal without the {{.Images}} loop eats the
+    injected = sum(r.shape[0] // n_patches for r in rows)
+    if injected < embeds.shape[0]:
+        # a custom template.multimodal without the media loops eats the
         # placeholders — surface it instead of silently serving text-only
         log.warning(
-            "%d of %d encoded image(s) had no [img-N] placeholder in the "
-            "rendered prompt (check template.multimodal)",
-            embeds.shape[0] - len(rows), embeds.shape[0],
+            "%d of %d encoded media item(s) had no [img-N]/[vid-N] "
+            "placeholder in the rendered prompt (check template.multimodal)",
+            embeds.shape[0] - injected, embeds.shape[0],
         )
     if not rows:
         return tokens, None, None

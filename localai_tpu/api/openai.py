@@ -142,7 +142,11 @@ async def chat(request: web.Request) -> web.StreamResponse:
         if isinstance(e, MediaError):
             raise web.HTTPBadRequest(text=str(e)) from e
         raise
-    if cfg.template.use_tokenizer_template or cfg.template.chat_template:
+    # guessed/explicit chat_template covers plain chat only: tool requests
+    # stay on build_chat_prompt, which renders function schemas and
+    # tool-call/tool-result turns the family templates don't model
+    if cfg.template.use_tokenizer_template or (
+            cfg.template.chat_template and tctx is None):
         from localai_tpu.templates.chat import apply_tokenizer_template
 
         prompt = apply_tokenizer_template(

@@ -169,7 +169,7 @@ def guess_chat_defaults(cfg, model_path: str | Path) -> None:
                 own = json.loads(tok_cfg.read_text()).get("chat_template")
             except ValueError:
                 own = None
-            if own:
+            if own and isinstance(own, str):
                 # the checkpoint knows its own format — carry the STRING
                 # (converted-GGUF tokenizers are raw tokenizers.Tokenizer
                 # objects with no apply_chat_template, so a bare

@@ -52,9 +52,14 @@ class ExplorerDB:
     def _persist(self) -> None:
         if self.path is None:
             return
-        tmp = self.path.with_suffix(".tmp")
-        tmp.write_text(json.dumps(self._entries))
-        tmp.replace(self.path)
+        try:
+            tmp = self.path.with_suffix(".tmp")
+            tmp.write_text(json.dumps(self._entries))
+            tmp.replace(self.path)
+        except OSError as e:
+            # a full/removed disk must not kill the monitor thread — the
+            # in-memory db keeps working, persistence resumes when possible
+            log.warning("explorer db persist failed: %s", e)
 
     def add(self, url: str, name: str = "") -> None:
         url = url.rstrip("/")
@@ -157,6 +162,12 @@ class DiscoveryMonitor:
     def state(self) -> dict[str, dict]:
         with self._lock:
             return {k: dict(v) for k, v in self._state.items()}
+
+    def forget(self, url: str) -> None:
+        """Drop a network's snapshot (on DELETE — a re-added network must
+        dial-test fresh, not resurface stale data)."""
+        with self._lock:
+            self._state.pop(url.rstrip("/"), None)
 
     def start(self) -> None:
         if self._thread is not None:
@@ -263,6 +274,7 @@ async def _api_del_network(request: web.Request) -> web.Response:
     url = request.query.get("url", "")
     if not mon.db.remove(url):
         raise web.HTTPNotFound(text="network not tracked")
+    mon.forget(url)
     return web.json_response({"ok": True})
 
 

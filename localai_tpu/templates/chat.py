@@ -219,6 +219,7 @@ def multimodal_placeholders(
     n_audio: int = 0,
     n_video: int = 0,
     first_image_id: int = 0,
+    first_video_id: int = 0,
 ) -> str:
     """Parity: TemplateMultiModal (/root/reference/pkg/templates/
     multimodal.go) — inject [img-N]/[audio-N]/[vid-N] placeholders.
@@ -238,7 +239,7 @@ def multimodal_placeholders(
         Text=text,
         Images=[{"ID": first_image_id + i} for i in range(n_images)],
         Audio=[{"ID": i} for i in range(n_audio)],
-        Video=[{"ID": i} for i in range(n_video)],
+        Video=[{"ID": first_video_id + i} for i in range(n_video)],
     )
 
 

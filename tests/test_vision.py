@@ -399,3 +399,90 @@ def test_chat_with_bad_image_is_400(vision_client):
     }
     r = vision_client.post("/v1/chat/completions", json=body)
     assert r.status_code == 400
+
+
+# -- video input ------------------------------------------------------------
+
+
+def _gif_bytes(n_frames: int = 6, size: int = 32) -> bytes:
+    from PIL import Image
+
+    frames = [
+        Image.fromarray(
+            (np.random.RandomState(i).rand(size, size, 3) * 255
+             ).astype(np.uint8))
+        for i in range(n_frames)
+    ]
+    buf = io.BytesIO()
+    frames[0].save(buf, format="GIF", save_all=True,
+                   append_images=frames[1:], duration=50, loop=0)
+    return buf.getvalue()
+
+
+def test_decode_video_frames_samples_uniformly():
+    from localai_tpu.utils.media import decode_video_frames
+
+    frames = decode_video_frames(_gif_bytes(10), max_frames=4)
+    assert len(frames) == 4
+    assert frames[0].shape == (32, 32, 3)
+    # fewer frames than the cap: all of them
+    assert len(decode_video_frames(_gif_bytes(3), max_frames=8)) == 3
+    # single-frame media degrades to one frame
+    assert len(decode_video_frames(_png_bytes(), max_frames=8)) == 1
+
+
+def test_decode_video_rejects_unknown_container():
+    from localai_tpu.utils.media import MediaError, decode_video_frames
+
+    with pytest.raises(MediaError, match="cannot decode video"):
+        decode_video_frames(b"\x00\x00\x00\x18ftypmp42not-a-real-mp4")
+
+
+def test_video_part_expands_to_frame_embeddings(small, tower):
+    """A video_url part renders a [vid-N] placeholder whose span injects
+    every sampled frame's patch embeddings (parity: vLLM backend video
+    multimodal path)."""
+    from localai_tpu.api.inference import (
+        build_gen_request,
+        prepare_multimodal,
+    )
+    from localai_tpu.api.schema import OpenAIRequest
+    from localai_tpu.config.model_config import ModelConfig
+
+    gif = "data:image/gif;base64," + base64.b64encode(
+        _gif_bytes(6)).decode()
+    png = base64.b64encode(_png_bytes()).decode()
+
+    class SM:
+        name = "t"
+        tokenizer = small.tokenizer
+        vision = tower
+        image_token_id = 7
+
+    req = OpenAIRequest(model="t", messages=[
+        {"role": "user", "content": [
+            {"type": "text", "text": "compare"},
+            {"type": "image_url", "image_url": {"url": png}},
+            {"type": "video_url", "video_url": {"url": gif}},
+        ]},
+    ])
+    cfg = ModelConfig(name="t")
+    messages, mm = prepare_multimodal(SM(), cfg, req)
+    assert "[img-0]" in messages[0]["content"]
+    assert "[vid-0]" in messages[0]["content"]
+    assert mm.video_groups == [(1, 6)]          # rows 1..6 after the image
+    assert mm.embeds.shape[0] == 7              # 1 image + 6 frames
+
+    from localai_tpu.templates.chat import multimodal_placeholders
+
+    prompt = multimodal_placeholders(
+        cfg.template.multimodal or "", "compare",
+        n_images=1, n_video=1)
+    from localai_tpu.api.inference import expand_image_placeholders
+
+    tokens, flat, pos = expand_image_placeholders(SM(), prompt, mm)
+    n = tower.n_patches
+    assert flat.shape == (7 * n, small.cfg.hidden_size)
+    assert len(pos) == 7 * n
+    toks = np.asarray(tokens)
+    assert (toks[pos] == 7).all()
