@@ -1,0 +1,162 @@
+"""Micro-benchmarks for the decode roofline investigation (VERDICT r4 #2).
+
+Isolates where the gap between measured decode tok/s and the
+weight-bandwidth bound goes:
+
+  * quant-matmul variants at decode shapes — bf16, w8 (dequant-in-matmul),
+    w8a8 (native int8 MXU dot), w4 — measuring effective HBM bandwidth.
+    If w8 materializes a bf16 weight copy (the docstring'd suspect in
+    models/quant.py), its GB/s will read ~1/3 of bf16's instead of ~2x.
+  * forward-only vs forward+sampling decode step (sampling overhead).
+  * KV-cache attention read cost vs context length.
+
+Run on the real chip: `python bench_micro.py` (JSON lines to stdout).
+Not driver-facing — bench.py remains the one-line contract.
+"""
+
+import json
+import time
+
+import numpy as np
+
+
+def _timeit(fn, *args, n=20, warmup=3):
+    import jax
+
+    for _ in range(warmup):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(n):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / n
+
+
+def bench_quant_matmuls(M=8, K=4096, N=14336, steps=64):
+    """One decode-shaped matmul per variant, looped inside jit so dispatch
+    amortizes; reports effective weight-read bandwidth."""
+    import jax
+    import jax.numpy as jnp
+
+    from localai_tpu.models import quant as qnt
+
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(M, K)), jnp.bfloat16)
+    w_f = rng.normal(size=(K, N)).astype(np.float32) * 0.02
+    variants = {
+        "bf16": (jnp.asarray(w_f, jnp.bfloat16), 2),
+        "w8": (qnt.quantize_tensor(w_f, axis=0), 1),
+        "w8a8": (qnt.QuantizedTensor(
+            q=qnt.quantize_tensor(w_f, axis=0).q,
+            scale=qnt.quantize_tensor(w_f, axis=0).scale,
+            axis=0, mode="w8a8"), 1),
+        "w4": (qnt.quantize_tensor4(w_f, axis=0), 0.5),
+    }
+    if jax.default_backend() == "tpu":
+        from localai_tpu.ops import qmatmul
+
+        w8 = variants["w8"][0]
+
+        def kernel_mm(h):
+            return qmatmul.w8_matmul(h, w8.q, w8.scale)
+
+        variants["w8_pallas"] = (kernel_mm, 1)
+    out = {}
+    for name, (w, bytes_per) in variants.items():
+        if callable(w) and not hasattr(w, "shape"):
+            def make_k(f):
+                def body(x):
+                    def step(h, _):
+                        y = f(h)
+                        return h + y[:, :K].astype(h.dtype) * 1e-6, None
+                    h, _ = jax.lax.scan(step, x, None, length=steps)
+                    return h
+                return jax.jit(body)
+
+            dt = _timeit(make_k(w), x) / steps
+            gb = K * N * bytes_per / 1e9
+            out[name] = {"ms_per_matmul": round(dt * 1e3, 4),
+                         "weight_gb": round(gb, 3),
+                         "eff_gbps": round(gb / dt, 1)}
+            continue
+
+        def make(w):
+            def body(x):
+                def step(h, _):
+                    y = qnt.matmul(h, w)
+                    # feed a slice back so the loop isn't dead-code-elim'd
+                    return h + y[:, :K].astype(h.dtype) * 1e-6, None
+                h, _ = jax.lax.scan(step, x, None, length=steps)
+                return h
+            return jax.jit(body)
+
+        f = make(w)
+        dt = _timeit(f, x) / steps
+        gb = K * N * bytes_per / 1e9
+        out[name] = {"ms_per_matmul": round(dt * 1e3, 4),
+                     "weight_gb": round(gb, 3),
+                     "eff_gbps": round(gb / dt, 1)}
+    return out
+
+
+def bench_step_breakdown(preset="1b", quant="int8", multi=32):
+    """Full decode step vs forward-only (sampling cost) on the engine."""
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+
+    from localai_tpu.engine import kvcache as kvc
+    from localai_tpu.engine.runner import ModelRunner
+    from localai_tpu.models import llama as mdl
+    from localai_tpu.models.registry import (
+        DEBUG_PRESETS,
+        synthetic_quantized_params,
+    )
+
+    cfg = dataclasses.replace(DEBUG_PRESETS[preset], dtype="bfloat16")
+    params = synthetic_quantized_params(cfg, quant)
+    runner = ModelRunner(cfg, params, num_slots=8, max_ctx=1024,
+                         prefill_buckets=[128], kv_dtype="int8")
+    prompt = list(range(1, 101))
+    for _ in range(8):
+        runner.admit(runner.acquire_slot(), prompt, temperature=0.0)
+
+    full = _timeit(lambda: runner.step_n(multi), n=5) / multi
+
+    # forward-only: same shapes, no sampling/top_k/counts
+    @jax.jit
+    def fwd_only(params, kv, state):
+        pos = state.positions
+        mask = kvc.decode_mask(cfg, pos, runner.max_ctx)
+        write = kvc.decode_write(pos, raw=False)
+        hidden, _ = mdl.forward(
+            cfg, params, state.tokens[:, None], pos[:, None],
+            write, kv.stacked(), mask, runner.rope)
+        return mdl.logits_from_hidden(cfg, params, hidden[:, 0])
+
+    f_dt = _timeit(lambda: fwd_only(runner.params, runner.kv, runner.state),
+                   n=10)
+    return {
+        "full_step_ms": round(full * 1e3, 3),
+        "forward_logits_ms": round(f_dt * 1e3, 3),
+        "sampling_overhead_ms": round((full - f_dt) * 1e3, 3),
+        "tok_s_at_bs8": round(8 / full, 1),
+    }
+
+
+def main():
+    import jax
+
+    print(json.dumps({"backend": jax.default_backend(),
+                      "devices": len(jax.devices())}))
+    print(json.dumps({"quant_matmul_8b_ffn":
+                      bench_quant_matmuls(M=8, K=4096, N=14336)}))
+    print(json.dumps({"quant_matmul_lm_head":
+                      bench_quant_matmuls(M=8, K=2048, N=128256, steps=16)}))
+    print(json.dumps({"step_breakdown_1b_int8": bench_step_breakdown()}))
+
+
+if __name__ == "__main__":
+    main()

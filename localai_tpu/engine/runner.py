@@ -140,8 +140,12 @@ class ModelRunner:
         if mesh is not None:
             from jax.sharding import NamedSharding
 
+            from localai_tpu.models import quant as qnt
             from localai_tpu.parallel import sharding as shd
 
+            # the Pallas w8 matmul has no partitioning rule — GSPMD would
+            # all-gather sharded weights into it every step
+            qnt.block_w8_kernel("runner built over a device mesh")
             shd.slots_per_data_shard(num_slots, mesh)  # divisibility check
             kv_sharding = NamedSharding(mesh, shd.kv_spec(cfg, mesh))
         self.kv = kvc.init_cache(

@@ -139,6 +139,8 @@ class DiscoveryMonitor:
             try:
                 data = fetch_nodes(url, timeout=self.timeout)
                 nodes = data.get("nodes", [])
+                if url not in self.db.routers():
+                    continue  # removed (DELETE) while the dial was in flight
                 with self._lock:
                     self._state[url] = {
                         "ok": True,
@@ -151,7 +153,7 @@ class DiscoveryMonitor:
                 evicted = (count_failures and self.db.mark_failed(
                     url, self.failure_threshold))
                 with self._lock:
-                    if evicted:
+                    if evicted or url not in self.db.routers():
                         self._state.pop(url, None)
                     else:
                         self._state[url] = {

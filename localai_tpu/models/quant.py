@@ -24,6 +24,7 @@ NamedSharding placement in parallel.sharding both work unchanged.
 from __future__ import annotations
 
 import dataclasses
+import os
 from functools import partial
 from typing import Any
 
@@ -31,6 +32,37 @@ import jax
 import jax.numpy as jnp
 
 PyTree = Any
+
+
+# process-wide kernel block: the Pallas call carries no partitioning rule,
+# so under a multi-device mesh GSPMD would replicate (all-gather) the full
+# weight per step — any meshed ModelRunner turns the kernel off
+_W8_KERNEL_BLOCKED = False
+
+
+def block_w8_kernel(reason: str = "") -> None:
+    global _W8_KERNEL_BLOCKED
+    if not _W8_KERNEL_BLOCKED and os.environ.get("LOCALAI_W8_KERNEL"):
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "LOCALAI_W8_KERNEL disabled: %s", reason or "meshed serving")
+    _W8_KERNEL_BLOCKED = True
+
+
+def _w8_kernel_mode() -> str:
+    """'' (off) | 'tpu' | 'interpret' — the Pallas dequant-matmul opt-in
+    (ops.qmatmul; LOCALAI_W8_KERNEL=1 enables on TPU, =interpret for CPU
+    tests; any other value is off). Read per call: tests flip it at
+    runtime."""
+    if _W8_KERNEL_BLOCKED:
+        return ""
+    v = os.environ.get("LOCALAI_W8_KERNEL", "").strip().lower()
+    if v in ("1", "tpu"):
+        return "tpu"
+    if v == "interpret":
+        return "interpret"
+    return ""
 
 
 @partial(
@@ -184,6 +216,15 @@ def matmul(x: jax.Array, w) -> jax.Array:
         xq, xs = _quant_activations(x)
         acc = _int8_dot(xq, w.q, transpose_w=False).astype(jnp.float32)
         return (acc * xs[..., None] * w.scale).astype(x.dtype)
+    mode = _w8_kernel_mode()
+    if mode:
+        from localai_tpu.ops import qmatmul
+
+        if qmatmul.eligible(x.shape, w.q, w.scale, transpose_w=False):
+            x2 = x.reshape(-1, x.shape[-1])
+            y = qmatmul.w8_matmul(x2, w.q, w.scale,
+                                  interpret=mode == "interpret")
+            return y.reshape(*x.shape[:-1], y.shape[-1])
     return (x @ w.q.astype(x.dtype)) * w.scale.astype(x.dtype)
 
 
@@ -199,6 +240,15 @@ def matmul_t(x: jax.Array, w) -> jax.Array:
         xq, xs = _quant_activations(x)
         acc = _int8_dot(xq, w.q, transpose_w=True).astype(jnp.float32)
         return (acc * xs[..., None] * w.scale).astype(x.dtype)
+    mode = _w8_kernel_mode()
+    if mode:
+        from localai_tpu.ops import qmatmul
+
+        if qmatmul.eligible(x.shape, w.q, w.scale, transpose_w=True):
+            x2 = x.reshape(-1, x.shape[-1])
+            y = qmatmul.w8_matmul(x2, w.q, w.scale, transpose_w=True,
+                                  interpret=mode == "interpret")
+            return y.reshape(*x.shape[:-1], y.shape[-1])
     return (x @ w.q.T.astype(x.dtype)) * w.scale.astype(x.dtype)
 
 

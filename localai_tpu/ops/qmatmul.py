@@ -1,0 +1,119 @@
+"""Pallas TPU kernel: int8-weight matmul with in-kernel dequant.
+
+The decode hot loop is weight-HBM-bound: every step streams every weight
+once. The XLA 'w8' path (models.quant.matmul: ``(x @ q.astype(bf16)) *
+scale``) leaves XLA free to materialize the casted bf16 weight as its own
+fusion — when it does, the weight bytes cross HBM ~3x (read int8, write
+bf16, read bf16) and int8 serving loses its entire bandwidth advantage
+(the r4 roofline-gap suspect, VERDICT #2). This kernel removes the
+ambiguity: int8 blocks stream HBM→VMEM once, the cast to the activation
+dtype happens in-register, the MXU runs the bf16 dot, and the
+per-output-channel scale lands in the accumulator epilogue.
+
+Layout: grid (N/bn, K/bk) with K minor (sequential accumulation into a
+f32 VMEM scratch); weight blocks (bk, bn) int8 respect Mosaic's (32, 128)
+int8 tiling; M pads to the bf16 sublane (16). ``transpose_w=True`` serves
+the tied-embedding lm_head (x @ W.T with per-row scales) by swapping the
+block index map and contracting on the weight block's minor axis — the
+int8 table is still read in its native row-major layout.
+
+Enabled from models.quant.matmul via LOCALAI_W8_KERNEL=1 (opt-in until
+hardware measurement picks the default; bench_micro.py measures both).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _kernel(x_ref, w_ref, s_ref, o_ref, acc_ref, *, n_k: int,
+            transpose_w: bool):
+    k = pl.program_id(1)
+
+    @pl.when(k == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    x = x_ref[...]
+    w = w_ref[...].astype(x.dtype)
+    if transpose_w:
+        # w block [bn, bk]: contract x's K with the block's minor axis
+        acc_ref[...] += jax.lax.dot_general(
+            x, w, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+    else:
+        acc_ref[...] += jnp.dot(x, w, preferred_element_type=jnp.float32)
+
+    @pl.when(k == n_k - 1)
+    def _done():
+        scale = s_ref[...].astype(jnp.float32)[None, :]
+        o_ref[...] = (acc_ref[...] * scale).astype(o_ref.dtype)
+
+
+def _pick(total: int, target: int, quantum: int) -> int:
+    b = min(total, target)
+    b -= b % quantum
+    while b > quantum and total % b:
+        b -= quantum
+    return b if b and total % b == 0 else total
+
+
+@functools.partial(jax.jit, static_argnames=("transpose_w", "interpret"))
+def w8_matmul(x: jax.Array, q: jax.Array, scale: jax.Array, *,
+              transpose_w: bool = False,
+              interpret: bool = False) -> jax.Array:
+    """x [M, K] (bf16/f32) x int8 weight → [M, N] in x.dtype.
+
+    ``transpose_w=False``: q [K, N], scale [N] (per output column).
+    ``transpose_w=True``:  q [N, K], scale [N] (per row — the tied
+    lm_head table), computing x @ q.T.
+    """
+    M, K = x.shape
+    N = q.shape[1] if not transpose_w else q.shape[0]
+    # pad M to the bf16 sublane so tiny decode batches stay Mosaic-legal
+    Mp = max(16, ((M + 15) // 16) * 16)
+    if Mp != M:
+        x = jnp.pad(x, ((0, Mp - M), (0, 0)))
+    bk = _pick(K, 512, 128)
+    bn = _pick(N, 512, 128)
+    n_k, n_n = K // bk, N // bn
+
+    if transpose_w:
+        w_spec = pl.BlockSpec((bn, bk), lambda n, k: (n, k))
+    else:
+        w_spec = pl.BlockSpec((bk, bn), lambda n, k: (k, n))
+
+    out = pl.pallas_call(
+        functools.partial(_kernel, n_k=n_k, transpose_w=transpose_w),
+        grid=(n_n, n_k),
+        in_specs=[
+            pl.BlockSpec((Mp, bk), lambda n, k: (0, k)),
+            w_spec,
+            pl.BlockSpec((bn,), lambda n, k: (n,)),
+        ],
+        out_specs=pl.BlockSpec((Mp, bn), lambda n, k: (0, n)),
+        out_shape=jax.ShapeDtypeStruct((Mp, N), x.dtype),
+        scratch_shapes=[pltpu.VMEM((Mp, bn), jnp.float32)],
+        interpret=interpret,
+    )(x, q, scale)
+    return out[:M]
+
+
+def eligible(x_shape: tuple, q: jax.Array, scale: jax.Array,
+             transpose_w: bool) -> bool:
+    """Shape gates: 2-D int8 weight, 128-aligned dims, 1-D scale, small M
+    (decode/small-batch — prefill matmuls are compute-bound and stay XLA)."""
+    if q.ndim != 2 or scale.ndim != 1 or q.dtype != jnp.int8:
+        return False
+    K = q.shape[1] if transpose_w else q.shape[0]
+    N = q.shape[0] if transpose_w else q.shape[1]
+    M = 1
+    for d in x_shape[:-1]:
+        M *= d
+    return (x_shape[-1] == K and K % 128 == 0 and N % 128 == 0
+            and M <= 256)

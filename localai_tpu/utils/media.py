@@ -26,9 +26,12 @@ class MediaError(ValueError):
     """Raised when an image reference cannot be fetched or decoded."""
 
 
-def fetch_image_bytes(ref: str, *, timeout: float = 30.0) -> bytes:
-    """image_url string → raw encoded bytes (base64.go:18-60 semantics:
-    http(s) fetch, data-URI strip, or raw base64 decode)."""
+def fetch_image_bytes(ref: str, *, timeout: float = 30.0,
+                      kind: str = "image") -> bytes:
+    """image/video_url string → raw encoded bytes (base64.go:18-60
+    semantics: http(s) fetch, data-URI strip, or raw base64 decode).
+    ``kind`` only flavors error messages so a bad video_url doesn't 400
+    with wording about images."""
     ref = ref.strip()
     m = _DATA_URI.match(ref)
     if m:
@@ -44,16 +47,16 @@ def fetch_image_bytes(ref: str, *, timeout: float = 30.0) -> bytes:
             with urllib.request.urlopen(req, timeout=timeout) as resp:
                 data = resp.read(MAX_IMAGE_BYTES + 1)
         except Exception as e:  # noqa: BLE001 — network errors → request error
-            raise MediaError(f"failed to fetch image URL: {e}") from e
+            raise MediaError(f"failed to fetch {kind} URL: {e}") from e
         if len(data) > MAX_IMAGE_BYTES:
-            raise MediaError("image exceeds size limit")
+            raise MediaError(f"{kind} exceeds size limit")
         return data
     # raw base64 (no scheme, no data: header)
     try:
         return base64.b64decode(ref, validate=True)
     except (binascii.Error, ValueError) as e:
         raise MediaError(
-            "image_url is neither an http(s) URL, data URI, nor base64"
+            f"{kind}_url is neither an http(s) URL, data URI, nor base64"
         ) from e
 
 
@@ -109,4 +112,5 @@ def fetch_video_frames(ref: str, *, timeout: float = 30.0,
                        max_frames: int = 8) -> list[np.ndarray]:
     """video_url string → sampled RGB frames (same ref forms as images)."""
     return decode_video_frames(
-        fetch_image_bytes(ref, timeout=timeout), max_frames=max_frames)
+        fetch_image_bytes(ref, timeout=timeout, kind="video"),
+        max_frames=max_frames)

@@ -1,0 +1,93 @@
+"""Pallas int8-dequant matmul kernel (ops.qmatmul) — exactness vs the XLA
+w8 path and engine-level equivalence under the env opt-in."""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from localai_tpu.models import quant as qnt
+from localai_tpu.ops import qmatmul
+
+
+@pytest.fixture()
+def w8_kernel_env():
+    os.environ["LOCALAI_W8_KERNEL"] = "interpret"
+    # a meshed runner anywhere earlier in the process flips the global
+    # block; this test must exercise the kernel for real
+    prior = qnt._W8_KERNEL_BLOCKED
+    qnt._W8_KERNEL_BLOCKED = False
+    yield
+    qnt._W8_KERNEL_BLOCKED = prior
+    os.environ.pop("LOCALAI_W8_KERNEL", None)
+
+
+@pytest.mark.parametrize("M,K,N", [(8, 256, 384), (1, 128, 128),
+                                   (16, 512, 256)])
+def test_matches_xla_w8(M, K, N):
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(M, K)), jnp.float32)
+    w = rng.normal(size=(K, N)).astype(np.float32) * 0.02
+    qt = qnt.quantize_tensor(w, axis=0)
+    ref = np.asarray(qnt.matmul(x, qt))
+    out = np.asarray(qmatmul.w8_matmul(x, qt.q, qt.scale, interpret=True))
+    np.testing.assert_allclose(out, ref, atol=1e-4, rtol=1e-4)
+
+
+def test_matches_xla_w8_transposed():
+    """The tied-embedding lm_head orientation: x @ table.T, per-row scale."""
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.normal(size=(8, 256)), jnp.float32)
+    tbl = rng.normal(size=(384, 256)).astype(np.float32) * 0.02
+    qt = qnt.quantize_tensor(tbl, axis=1)
+    ref = np.asarray(qnt.matmul_t(x, qt))
+    out = np.asarray(qmatmul.w8_matmul(x, qt.q, qt.scale,
+                                       transpose_w=True, interpret=True))
+    np.testing.assert_allclose(out, ref, atol=1e-4, rtol=1e-4)
+
+
+def test_eligibility_gates():
+    rng = np.random.default_rng(2)
+    q = jnp.asarray(rng.integers(-127, 127, (256, 384)), jnp.int8)
+    s = jnp.ones(384, jnp.float32)
+    assert qmatmul.eligible((8, 256), q, s, False)
+    assert not qmatmul.eligible((8, 100), q, s, False)      # K mismatch
+    assert not qmatmul.eligible((512, 256), q, s, False)    # prefill-sized M
+    q_odd = jnp.asarray(rng.integers(-127, 127, (250, 384)), jnp.int8)
+    assert not qmatmul.eligible((8, 250), q_odd, s, False)  # unaligned K
+    s2 = jnp.ones((2, 384), jnp.float32)
+    assert not qmatmul.eligible((8, 256), q, s2, False)     # grouped scale
+
+
+def test_engine_greedy_identical_under_kernel(w8_kernel_env):
+    """int8 serving with the kernel enabled produces the same greedy
+    stream as the XLA w8 path (kernel-aligned dims: D/N multiples of 128)."""
+    import dataclasses
+
+    from localai_tpu.engine.runner import ModelRunner
+    from localai_tpu.models import llama as mdl
+    from localai_tpu.models.llama import LlamaConfig
+
+    cfg = LlamaConfig(vocab_size=512, hidden_size=128,
+                      intermediate_size=256, num_layers=2, num_heads=2,
+                      num_kv_heads=2, max_position_embeddings=256,
+                      tie_word_embeddings=True, dtype="float32")
+    params = mdl.init_params(jax.random.key(0), cfg)
+    q = qnt.quantize_params(params)
+    prompt = list(range(1, 30))
+
+    def greedy():
+        r = ModelRunner(dataclasses.replace(cfg, dtype="float32"), q,
+                        num_slots=2, max_ctx=128, prefill_buckets=[32],
+                        kv_dtype="float32")
+        s = r.acquire_slot()
+        return [r.admit(s, prompt, temperature=0.0)] + \
+            [int(r.step()[s]) for _ in range(6)]
+
+    with_kernel = greedy()
+    os.environ["LOCALAI_W8_KERNEL"] = ""
+    without = greedy()
+    assert with_kernel == without
