@@ -102,6 +102,30 @@ class ModelRunner:
             attn_impl = "xla"
             log.info("self-extend active (ga_n=%d ga_w=%d): XLA attention, "
                      "unroped KV cache", ga_n, ga_w)
+        # pipeline (layer-sharded) parallelism: HBM capacity scaling over
+        # the 'pipe' axis (parallel.pipeline — llama.cpp layer-split-mode
+        # parity). v1 runs pipe alone and keeps the XLA attend.
+        self.pp_enabled = (mesh is not None
+                           and mesh.shape.get("pipe", 1) > 1)
+        if self.pp_enabled:
+            n_pipe = mesh.shape["pipe"]
+            busy = [ax for ax in ("data", "model", "seq", "expert")
+                    if mesh.shape.get(ax, 1) > 1]
+            if busy:
+                raise ValueError(
+                    f"pipeline parallelism composes with no other axis "
+                    f"yet; mesh also shards {busy}")
+            if cfg.num_layers % n_pipe:
+                raise ValueError(
+                    f"num_layers {cfg.num_layers} not divisible by "
+                    f"pipe={n_pipe}")
+            if ga_n > 1:
+                raise ValueError(
+                    "self-extend is not supported with pipeline "
+                    "parallelism")
+            attn_impl = "xla"
+            log.info("pipeline parallelism: %d stages x %d layers",
+                     n_pipe, cfg.num_layers // n_pipe)
         # the full decision (auto-resolve + every fallback gate) lives in
         # ops.select_attn_impl so tests can assert which path a given
         # (model, mesh) lands on at hardware shapes
@@ -285,9 +309,9 @@ class ModelRunner:
                 pos[:, None], jnp.arange(self.max_ctx, dtype=jnp.int32))
         mask = kvc.decode_mask(cfg, pos, self.max_ctx)
         write = kvc.decode_write(pos, raw=raw_kv)
-        hidden, new_stack = mdl.forward(
-            cfg, params, state.tokens[:, None], pos[:, None],
-            write, kv.stacked(), mask, self.rope, attn=attn,
+        hidden, new_stack = self._forward(
+            params, state.tokens[:, None], pos[:, None],
+            write, kv.stacked(), mask, attn=attn,
         )
         logits = mdl.logits_from_hidden(cfg, params, hidden[:, 0])
         tokens, keys = smp.sample(
@@ -355,8 +379,8 @@ class ModelRunner:
             positions, positions[0])
         mask = kvc.prefill_mask(cfg, bucket, length)
         write = kvc.prefill_write(slot, jnp.zeros((), jnp.int32))
-        hidden, new_stack = mdl.forward(
-            cfg, params, tokens, positions, write, kv.stacked(), mask, self.rope,
+        hidden, new_stack = self._forward(
+            params, tokens, positions, write, kv.stacked(), mask,
             attn=attn, embeds=embeds,
         )
         last_h = jax.lax.dynamic_index_in_dim(hidden[0], length - 1, keepdims=True)
@@ -412,9 +436,8 @@ class ModelRunner:
             positions, jnp.arange(self.max_ctx, dtype=jnp.int32))
         mask = kvc.resume_mask(cfg, bucket, offset, self.max_ctx)
         write = kvc.resume_write(slot, offset)
-        hidden, new_stack = mdl.forward(
-            cfg, params, tokens, positions, write, kv.stacked(), mask,
-            self.rope, attn=attn,
+        hidden, new_stack = self._forward(
+            params, tokens, positions, write, kv.stacked(), mask, attn=attn,
         )
         last_h = jax.lax.dynamic_index_in_dim(hidden[0], length - 1,
                                               keepdims=True)
@@ -505,9 +528,8 @@ class ModelRunner:
         write = kvc.prefill_write(jnp.int32(0), jnp.zeros((), jnp.int32))
         attn = self._prefill_attn(length) or self._se_attn(
             positions, positions[0])
-        hidden, _ = mdl.forward(
-            cfg, params, tokens, positions, write, kv, mask, self.rope,
-            attn=attn,
+        hidden, _ = self._forward(
+            params, tokens, positions, write, kv, mask, attn=attn,
         )
         valid = (jnp.arange(bucket) < length)[None, :, None]
         # pool in f32: a bf16 sum over thousands of positions loses the
@@ -526,6 +548,24 @@ class ModelRunner:
         return se.build_attend(
             self.cfg, self._se_rope, self.ga_n, self.ga_w,
             qpos=qpos, kpos=kpos,
+        )
+
+    def _forward(self, params, tokens, positions, write, stack, mask,
+                 attn=None, embeds=None):
+        """models.llama.forward, or the pipeline-parallel stage chain
+        when the mesh has a 'pipe' axis (layer-sharded capacity scaling —
+        parallel.pipeline; attn overrides don't apply there: pp forces the
+        XLA attend and gates self-extend/Pallas off at init)."""
+        if self.pp_enabled:
+            from localai_tpu.parallel import pipeline as pp
+
+            return pp.pp_forward(
+                self.cfg, params, tokens, positions, write, stack, mask,
+                self.rope, self.mesh, embeds=embeds,
+            )
+        return mdl.forward(
+            self.cfg, params, tokens, positions, write, stack, mask,
+            self.rope, attn=attn, embeds=embeds,
         )
 
     def _prefill_attn(self, length):

@@ -305,6 +305,13 @@ def build_spec_decoder(target: ModelRunner, draft_ref: str, *,
                        model_path="models", gamma: int = 4,
                        dtype: str = "bfloat16") -> SpecDecoder:
     """Resolve ``draft_ref`` and couple it to ``target`` (manager entry)."""
+    if getattr(target, "pp_enabled", False):
+        # the verify forward here calls mdl.forward directly — it would
+        # GSPMD over pipe-sharded stacked weights, all-gathering the full
+        # weight set per window (defeating capacity mode)
+        raise ValueError(
+            "speculative decoding is not supported with pipeline "
+            "parallelism")
     if getattr(target, "ga_n", 1) > 1:
         # self-extend targets carry an UNroped KV cache + identity rope
         # table; the verify forward here would compute position-blind

@@ -304,20 +304,38 @@ def build_runner(mcfg: ModelConfig, app: AppConfig) -> tuple[Any, ModelRunner]:
     mesh = None
     want_tp = max(1, shard.tensor_parallel_size)
     want_sp = max(1, shard.sequence_parallel_size)
+    want_ep = max(1, shard.expert_parallel_size)
+    want_pp = max(1, shard.pipeline_parallel_size)
     want_dp = shard.data_parallel_size  # 0 = auto
-    if (want_tp > 1 or want_sp > 1 or want_dp not in (0, 1)
-            or app.mesh_shape):
+    if (want_tp > 1 or want_sp > 1 or want_ep > 1 or want_pp > 1
+            or want_dp not in (0, 1) or app.mesh_shape):
         from localai_tpu.parallel.mesh import MeshPlan, build_mesh
 
         if app.mesh_shape:
             mesh = build_mesh(MeshPlan(**app.mesh_shape))
+        elif want_pp > 1:
+            import jax
+
+            if want_tp > 1 or want_sp > 1 or want_ep > 1 \
+                    or want_dp not in (0, 1):
+                # fail loudly: silently dropping the other knobs would
+                # serve an unsharded layout the user didn't configure
+                raise ValueError(
+                    "pipeline_parallel_size composes with no other "
+                    "sharding axis yet; unset tensor/sequence/expert/"
+                    "data_parallel_size")
+            # pipeline capacity mode runs the 'pipe' axis alone — claim
+            # exactly pp devices
+            mesh = build_mesh(MeshPlan(pipe=want_pp),
+                              devices=jax.devices()[:want_pp])
         else:
             import jax
 
             nd = len(jax.devices())
-            dp = want_dp or max(1, nd // (want_tp * want_sp))
+            dp = want_dp or max(1, nd // (want_tp * want_sp * want_ep))
             mesh = build_mesh(
-                MeshPlan(data=dp, seq=want_sp, model=want_tp)
+                MeshPlan(data=dp, seq=want_sp, expert=want_ep,
+                         model=want_tp)
             )
 
     model = resolve_model(
@@ -331,9 +349,15 @@ def build_runner(mcfg: ModelConfig, app: AppConfig) -> tuple[Any, ModelRunner]:
 
         params = quantize_params(params, eng.quantization)
     if mesh is not None:
-        from localai_tpu.parallel import sharding as shd
+        if mesh.shape.get("pipe", 1) > 1:
+            # layer-sharded capacity mode (parallel.pipeline)
+            from localai_tpu.parallel.pipeline import shard_params_pp
 
-        params = shd.shard_params(params, model.cfg, mesh)
+            params = shard_params_pp(params, model.cfg, mesh)
+        else:
+            from localai_tpu.parallel import sharding as shd
+
+            params = shd.shard_params(params, model.cfg, mesh)
     ctx = mcfg.context_size or app.context_size
     # self-extend lifts the trained-context ceiling by the group factor
     # (llama.cpp: n_ctx >= n_ctx_train * ga_n, grpc-server.cpp:535)
@@ -391,6 +415,11 @@ def build_serving_model(mcfg: ModelConfig, app: AppConfig) -> ServingModel:
             "%s: draft_model is not supported with self-extend "
             "(grp_attn_n>1); serving without speculative decoding",
             mcfg.name,
+        )
+    elif eng.draft_model and getattr(runner, "pp_enabled", False):
+        log.warning(
+            "%s: draft_model is not supported with pipeline parallelism; "
+            "serving without speculative decoding", mcfg.name,
         )
     elif eng.draft_model:
         from localai_tpu.engine.speculative import build_spec_decoder

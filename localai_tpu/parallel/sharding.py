@@ -110,7 +110,8 @@ def param_specs(
 
 
 def kv_spec(cfg: LlamaConfig, mesh: Mesh) -> P:
-    """KV cache [L, S, Hkv, C, hd]: slots on 'data', kv heads on 'model'.
+    """KV cache [L, S, Hkv, C, hd]: layers on 'pipe' (pipeline capacity
+    mode), slots on 'data', kv heads on 'model'.
 
     When tp does not divide the kv-head count (deep-GQA models on wide
     meshes), the kv heads are replicated instead — attention q-heads stay
@@ -123,7 +124,9 @@ def kv_spec(cfg: LlamaConfig, mesh: Mesh) -> P:
             "kv heads (%d) not divisible by tensor_parallel (%d); "
             "replicating KV cache", cfg.num_kv_heads, tp,
         )
-    return P(None, "data", heads, None, None)
+    layers = ("pipe" if mesh.shape.get("pipe", 1) > 1
+              and cfg.num_layers % mesh.shape["pipe"] == 0 else None)
+    return P(layers, "data", heads, None, None)
 
 
 def state_specs(mesh: Mesh) -> dict:

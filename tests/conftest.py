@@ -29,7 +29,8 @@ SLOW_MODULES = {
     "test_lora",
     "test_mamba", "test_mesh_attn", "test_moe",
     "test_multihost", "test_musicgen", "test_ops", "test_prefix",
-    "test_promptcache", "test_quant", "test_reranker", "test_ring",
+    "test_pipeline", "test_promptcache", "test_quant", "test_reranker",
+    "test_ring",
     "test_rwkv", "test_sdxl", "test_selfextend", "test_sharding",
     "test_speculative",
     "test_vision", "test_vits", "test_voice_clone", "test_worker",
