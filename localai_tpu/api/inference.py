@@ -263,6 +263,11 @@ class ToolContext:
     constraint: Any = None
 
 
+class ToolGrammarError(ValueError):
+    """tool_choice='required' whose grammar can't be built — a client
+    error (the endpoint maps it to 400)."""
+
+
 def prepare_tools(
     sm: ServingModel, cfg: ModelConfig, req: OpenAIRequest
 ) -> Optional[ToolContext]:
@@ -277,16 +282,30 @@ def prepare_tools(
     from localai_tpu import functions as fx
 
     fn_cfg = cfg.function
-    funcs = fx.inject_no_action(functions, fn_cfg)
+    if req.tool_choice == "required" or req.function_call == "required":
+        # OpenAI semantics: the model MUST call some tool — skip the
+        # no-action escape hatch so the grammar only admits real calls
+        funcs = list(functions)
+    else:
+        funcs = fx.inject_no_action(functions, fn_cfg)
     choice = req.tool_choice_name()
     if choice:
         funcs = fx.select_function(funcs, choice)
+    required = (req.tool_choice == "required"
+                or req.function_call == "required")
     constraint = None
     try:
         constraint, _built = fx.build_tool_constraint(
             funcs, fn_cfg, sm.tokenizer
         )
-    except Exception as e:  # noqa: BLE001 — bad schema ≠ failed request
+    except Exception as e:  # noqa: BLE001 — bad schema ≠ failed request...
+        if required:
+            # ...EXCEPT under tool_choice="required": without the grammar
+            # the "must call a tool" contract can't be honored — reject
+            # rather than silently return prose
+            raise ToolGrammarError(
+                f"tool_choice='required' but the tool grammar could not "
+                f"be built: {e}") from e
         log.warning("tool grammar build failed (%s); decoding unconstrained", e)
     return ToolContext(
         functions=funcs,

@@ -125,7 +125,10 @@ async def chat(request: web.Request) -> web.StreamResponse:
     sm, base_cfg = await _serving(request, req, Usecase.CHAT)
     cfg = inf.merge_request(base_cfg, req)
 
-    tctx = await _in_executor(request, inf.prepare_tools, sm, cfg, req)
+    try:
+        tctx = await _in_executor(request, inf.prepare_tools, sm, cfg, req)
+    except inf.ToolGrammarError as e:
+        raise web.HTTPBadRequest(text=str(e)) from e
     rf_constraint = None
     if tctx is None:
         rf_constraint = await _in_executor(

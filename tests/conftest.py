@@ -24,7 +24,8 @@ import pytest  # noqa: E402
 #   python -m pytest -q                   (everything)
 # Re-measure when adding heavy suites; pyproject registers the marker.
 SLOW_MODULES = {
-    "test_api", "test_audio", "test_cli", "test_controlnet", "test_engine",
+    "test_aio", "test_api", "test_audio", "test_cli", "test_controlnet",
+    "test_engine",
     "test_flux", "test_hf_api", "test_image", "test_llama_torch",
     "test_lora",
     "test_mamba", "test_mesh_attn", "test_moe",
