@@ -255,8 +255,96 @@ def decode_logits(cfg: WhisperConfig, params: PyTree, tokens: jax.Array,
     return last @ params["embed"].T
 
 
+def _cached_step(cfg: WhisperConfig, params: PyTree, token, t,
+                 sa_k, sa_v, ca_k, ca_v):
+    """One KV-cached decoder step at position ``t``.
+
+    sa_k/sa_v [L, Tmax, D] — projected self-attn keys/values per layer;
+    ca_k/ca_v [L, Tenc, D] — cross-attn projections precomputed once per
+    chunk (the encoder output is fixed). Returns (logits [V], sa_k, sa_v).
+    """
+    H, hd, D = cfg.n_heads, cfg.hd, cfg.d_model
+    Tmax = sa_k.shape[1]
+    x = params["embed"][token] + params["pos"][t]          # [D]
+    idx = jnp.arange(Tmax)
+
+    def layer(x, inputs):
+        lp, sak_l, sav_l, cak_l, cav_l = inputs
+        h = _ln(x, lp["sa_ln"], lp["sa_ln_b"])
+        q = (h @ lp["sa_wq"] + lp["sa_bq"]).reshape(H, hd)
+        k_t = h @ lp["sa_wk"]
+        v_t = h @ lp["sa_wv"] + lp["sa_bv"]
+        keys = sak_l.at[t].set(k_t).reshape(Tmax, H, hd)
+        vals = sav_l.at[t].set(v_t).reshape(Tmax, H, hd)
+        s = jnp.einsum("hd,khd->hk", q, keys) / math.sqrt(hd)
+        s = jnp.where(idx[None, :] <= t, s.astype(jnp.float32), -1e30)
+        p = jax.nn.softmax(s, axis=-1).astype(vals.dtype)
+        a = jnp.einsum("hk,khd->hd", p, vals).reshape(D)
+        x = x + a @ lp["sa_wo"] + lp["sa_bo"]
+
+        h2 = _ln(x, lp["ca_ln"], lp["ca_ln_b"])
+        q2 = (h2 @ lp["ca_wq"] + lp["ca_bq"]).reshape(H, hd)
+        kk = cak_l.reshape(-1, H, hd)
+        vv = cav_l.reshape(-1, H, hd)
+        s2 = jnp.einsum("hd,khd->hk", q2, kk) / math.sqrt(hd)
+        p2 = jax.nn.softmax(s2.astype(jnp.float32), axis=-1).astype(vv.dtype)
+        c = jnp.einsum("hk,khd->hd", p2, vv).reshape(D)
+        x = x + c @ lp["ca_wo"] + lp["ca_bo"]
+
+        m = _ln(x, lp["ln2"], lp["ln2_b"])
+        x = x + (jax.nn.gelu(m @ lp["fc1"] + lp["b1"]) @ lp["fc2"]
+                 + lp["b2"])
+        return x, (k_t, v_t)
+
+    x, (krows, vrows) = lax.scan(
+        layer, x, (params["dec"], sa_k, sa_v, ca_k, ca_v))
+    sa_k = sa_k.at[:, t].set(krows)
+    sa_v = sa_v.at[:, t].set(vrows)
+    x = _ln(x, params["dec_ln"], params["dec_ln_b"])
+    return x @ params["embed"].T, sa_k, sa_v
+
+
+def decode_greedy(cfg: WhisperConfig, params: PyTree, prompt_buf, n_prompt,
+                  enc, limit):
+    """Whole-chunk greedy decode as ONE program: prompt prefill + generate
+    until <eot>, KV-cached (self-attn cache + cross-attn K/V precompute).
+
+    The per-token host loop this replaces re-ran the FULL decoder over the
+    padded buffer every step — O(T²) compute per token and one dispatch
+    (tunnel RTT) per token. Returns (buf [Tmax], n_total) with generated
+    ids at buf[n_prompt:n_total] (eot excluded)."""
+    Ld, D = cfg.n_dec_layers, cfg.d_model
+    Tmax = cfg.max_target_positions
+    ca_k = jnp.einsum("td,lde->lte", enc, params["dec"]["ca_wk"])
+    ca_v = (jnp.einsum("td,lde->lte", enc, params["dec"]["ca_wv"])
+            + params["dec"]["ca_bv"][:, None])
+    sa_k = jnp.zeros((Ld, Tmax, D), enc.dtype)
+    sa_v = jnp.zeros((Ld, Tmax, D), enc.dtype)
+
+    def cond(c):
+        t, buf, sak, sav, done, n_gen = c
+        return (~done) & (n_gen < limit) & (t < Tmax - 1)
+
+    def body(c):
+        t, buf, sak, sav, done, n_gen = c
+        logits, sak, sav = _cached_step(
+            cfg, params, buf[t], t, sak, sav, ca_k, ca_v)
+        nxt = jnp.argmax(logits).astype(jnp.int32)
+        is_gen = t + 1 >= n_prompt
+        write = is_gen & (nxt != cfg.eot)
+        buf = jnp.where(write, buf.at[t + 1].set(nxt), buf)
+        done = is_gen & (nxt == cfg.eot)
+        return t + 1, buf, sak, sav, done, n_gen + write.astype(jnp.int32)
+
+    _, buf, _, _, _, n_gen = lax.while_loop(
+        cond, body, (jnp.int32(0), prompt_buf, sa_k, sa_v,
+                     jnp.bool_(False), jnp.int32(0)))
+    return buf, n_prompt + n_gen
+
+
 class WhisperModel:
-    """Loaded whisper engine: jitted encode + single-program greedy loop."""
+    """Loaded whisper engine: jitted encode + ONE-dispatch KV-cached
+    greedy decode per chunk (decode_greedy)."""
 
     def __init__(self, cfg: WhisperConfig, params: PyTree, tokenizer=None):
         self.cfg = cfg
@@ -264,10 +352,9 @@ class WhisperModel:
         self.tokenizer = tokenizer
         self.filters = jnp.asarray(melmod.mel_filterbank(cfg.n_mels))
         self._encode = jax.jit(lambda p, m: encode(cfg, p, m))
-        self._step = jax.jit(
-            lambda p, toks, ln, enc: jnp.argmax(
-                decode_logits(cfg, p, toks, ln, enc)
-            ).astype(jnp.int32)
+        self._greedy = jax.jit(
+            lambda p, buf, n, enc, lim: decode_greedy(
+                cfg, p, buf, n, enc, lim)
         )
 
     def transcribe_chunk(self, audio: np.ndarray, *,
@@ -284,20 +371,14 @@ class WhisperModel:
                   cfg.token_notimestamps]
         buf = np.zeros(cfg.max_target_positions, np.int32)
         buf[:len(prompt)] = prompt
-        toks = jnp.asarray(buf)
-        n = len(prompt)
-        out: list[int] = []
         limit = min(max_tokens or cfg.max_target_positions,
                     cfg.max_target_positions - len(prompt))
-        for _ in range(limit):
-            nxt = int(self._step(self.params, toks, jnp.int32(n), enc))
-            if nxt == cfg.eot:
-                break
-            if nxt < cfg.sot and nxt < cfg.eot:
-                out.append(nxt)
-            toks = toks.at[n].set(nxt)
-            n += 1
-        return out
+        out_buf, n_total = self._greedy(
+            self.params, jnp.asarray(buf), jnp.int32(len(prompt)), enc,
+            jnp.int32(limit),
+        )
+        ids = np.asarray(out_buf)[len(prompt): int(n_total)]
+        return [int(t) for t in ids if t < cfg.eot and t < cfg.sot]
 
     def transcribe(self, audio: np.ndarray, *,
                    language: Optional[str] = None,

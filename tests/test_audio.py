@@ -228,3 +228,48 @@ def test_audio_models_under_lifecycle_management(tmp_path):
         assert "w" not in srv.state.manager.loaded_names()
     finally:
         srv.stop()
+
+
+def test_whisper_cached_greedy_matches_stepwise():
+    """The ONE-dispatch KV-cached greedy decode (decode_greedy) must
+    produce exactly the tokens of the naive full-recompute step loop it
+    replaced (same argmax chain, cross-attn KV precomputed)."""
+    import jax.numpy as jnp
+
+    m = wh.debug_model()
+    cfg = m.cfg
+    rng = np.random.default_rng(4)
+    from localai_tpu.audio import mel as melmod
+
+    # full chunk length: log_mel frames CHUNK_SAMPLES — shorter input
+    # would read clamped out-of-bounds garbage frames
+    audio = np.zeros(melmod.CHUNK_SAMPLES, np.float32)
+    audio[:16000] = (rng.normal(size=16000) * 0.2).astype(np.float32)
+    mel_arr = melmod.log_mel(jnp.asarray(audio), m.filters,
+                             n_mels=cfg.n_mels)
+    enc = m._encode(m.params, mel_arr)
+
+    prompt = [cfg.sot, wh.language_token(cfg, None), cfg.token_transcribe,
+              cfg.token_notimestamps]
+    limit = 12
+
+    # reference: naive loop over decode_logits
+    buf = np.zeros(cfg.max_target_positions, np.int32)
+    buf[:len(prompt)] = prompt
+    toks = jnp.asarray(buf)
+    n = len(prompt)
+    ref = []
+    for _ in range(limit):
+        nxt = int(jnp.argmax(wh.decode_logits(
+            cfg, m.params, toks, jnp.int32(n), enc)))
+        if nxt == cfg.eot:
+            break
+        ref.append(nxt)
+        toks = toks.at[n].set(nxt)
+        n += 1
+
+    out_buf, n_total = wh.decode_greedy(
+        cfg, m.params, jnp.asarray(buf), jnp.int32(len(prompt)), enc,
+        jnp.int32(limit))
+    got = list(np.asarray(out_buf)[len(prompt): int(n_total)])
+    assert got == ref
