@@ -57,11 +57,16 @@ def bench_quant_matmuls(M=8, K=4096, N=14336, steps=64):
         from localai_tpu.ops import qmatmul
 
         w8 = variants["w8"][0]
+        w4 = variants["w4"][0]
 
         def kernel_mm(h):
             return qmatmul.w8_matmul(h, w8.q, w8.scale)
 
+        def kernel_mm4(h):
+            return qmatmul.w4_matmul(h, w4.q, w4.scale)
+
         variants["w8_pallas"] = (kernel_mm, 1)
+        variants["w4_pallas"] = (kernel_mm4, 0.5)
     out = {}
     for name, (w, bytes_per) in variants.items():
         if callable(w) and not hasattr(w, "shape"):

@@ -206,6 +206,15 @@ def matmul(x: jax.Array, w) -> jax.Array:
     if not isinstance(w, QuantizedTensor):
         return x @ w
     if w.mode == "w4":
+        mode = _w8_kernel_mode()
+        if mode:
+            from localai_tpu.ops import qmatmul
+
+            if qmatmul.w4_eligible(x.shape, w.q, w.scale):
+                x2 = x.reshape(-1, x.shape[-1])
+                y = qmatmul.w4_matmul(x2, w.q, w.scale,
+                                      interpret=mode == "interpret")
+                return y.reshape(*x.shape[:-1], y.shape[-1])
         K, N = w.q.shape[-2], w.q.shape[-1]
         gc = w.scale.shape[-2]
         wg = w.q.reshape(gc, K // gc, N).astype(x.dtype)

@@ -104,6 +104,83 @@ def w8_matmul(x: jax.Array, q: jax.Array, scale: jax.Array, *,
     return out[:M]
 
 
+def _w4_kernel(x_ref, q_ref, s_ref, o_ref, acc_ref, *, n_k: int,
+               group: int):
+    k = pl.program_id(1)
+
+    @pl.when(k == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    x = x_ref[...]                                # [M, bk]
+    w = q_ref[...].astype(x.dtype)                # [bk, bn]
+    s = s_ref[...].astype(jnp.float32)            # [bk//group, bn]
+    gc = w.shape[0] // group
+    # per-group scaled partial dots: y = sum_g (x_g @ w_g) * s_g — the
+    # group count per block is small and static (e.g. 512/128 = 4)
+    for gi in range(gc):
+        part = jnp.dot(
+            x[:, gi * group:(gi + 1) * group],
+            w[gi * group:(gi + 1) * group],
+            preferred_element_type=jnp.float32,
+        )
+        acc_ref[...] += part * s[gi][None, :]
+
+    @pl.when(k == n_k - 1)
+    def _done():
+        o_ref[...] = acc_ref[...].astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def w4_matmul(x: jax.Array, q: jax.Array, scale: jax.Array, *,
+              interpret: bool = False) -> jax.Array:
+    """x [M, K] x group-wise int4 weight q [K, N] (scale [K/group, N]) →
+    [M, N]. The int4 blocks stream HBM packed (two nibbles per byte),
+    dequantizing per group in-register — int8's bandwidth halved again.
+    The group size derives from the q/scale shapes (single source of
+    truth for every caller)."""
+    M, K = x.shape
+    N = q.shape[1]
+    group = K // scale.shape[0]
+    Mp = max(16, ((M + 15) // 16) * 16)
+    if Mp != M:
+        x = jnp.pad(x, ((0, Mp - M), (0, 0)))
+    bk = _pick(K, 512, group)
+    bn = _pick(N, 512, 128)
+    n_k, n_n = K // bk, N // bn
+
+    out = pl.pallas_call(
+        functools.partial(_w4_kernel, n_k=n_k, group=group),
+        grid=(n_n, n_k),
+        in_specs=[
+            pl.BlockSpec((Mp, bk), lambda n, k: (0, k)),
+            pl.BlockSpec((bk, bn), lambda n, k: (k, n)),
+            pl.BlockSpec((bk // group, bn), lambda n, k: (k, n)),
+        ],
+        out_specs=pl.BlockSpec((Mp, bn), lambda n, k: (0, n)),
+        out_shape=jax.ShapeDtypeStruct((Mp, N), x.dtype),
+        scratch_shapes=[pltpu.VMEM((Mp, bn), jnp.float32)],
+        interpret=interpret,
+    )(x, q, scale)
+    return out[:M]
+
+
+def w4_eligible(x_shape: tuple, q: jax.Array, scale: jax.Array) -> bool:
+    """Gates for the grouped-int4 kernel: 2-D int4 weight, 2-D scale whose
+    group size is 128-aligned and divides the K block, decode-sized M."""
+    if q.ndim != 2 or scale.ndim != 2:
+        return False
+    K, N = q.shape
+    if scale.shape[1] != N or K % scale.shape[0]:
+        return False
+    group = K // scale.shape[0]
+    M = 1
+    for d in x_shape[:-1]:
+        M *= d
+    return (x_shape[-1] == K and group % 128 == 0 and K % 128 == 0
+            and N % 128 == 0 and M <= 256)
+
+
 def eligible(x_shape: tuple, q: jax.Array, scale: jax.Array,
              transpose_w: bool) -> bool:
     """Shape gates: 2-D int8 weight, 128-aligned dims, 1-D scale, small M

@@ -91,3 +91,59 @@ def test_engine_greedy_identical_under_kernel(w8_kernel_env):
     os.environ["LOCALAI_W8_KERNEL"] = ""
     without = greedy()
     assert with_kernel == without
+
+
+def test_w4_matches_xla(w8_kernel_env):
+    rng = np.random.default_rng(3)
+    x = jnp.asarray(rng.normal(size=(8, 256)), jnp.float32)
+    w = rng.normal(size=(256, 384)).astype(np.float32) * 0.02
+    qt = qnt.quantize_tensor4(w, axis=0, group=128)
+    os.environ["LOCALAI_W8_KERNEL"] = ""
+    ref = np.asarray(qnt.matmul(x, qt))
+    out = np.asarray(qmatmul.w4_matmul(x, qt.q, qt.scale,
+                                       interpret=True))
+    np.testing.assert_allclose(out, ref, atol=1e-4, rtol=1e-4)
+    # env-gated routing through qnt.matmul
+    os.environ["LOCALAI_W8_KERNEL"] = "interpret"
+    out2 = np.asarray(qnt.matmul(x, qt))
+    np.testing.assert_allclose(out2, ref, atol=1e-4, rtol=1e-4)
+
+
+def test_w4_eligibility():
+    rng = np.random.default_rng(4)
+    q = jnp.asarray(rng.integers(-7, 7, (256, 384)), jnp.int4)
+    s = jnp.ones((2, 384), jnp.float32)       # group 128
+    assert qmatmul.w4_eligible((8, 256), q, s)
+    s_fine = jnp.ones((8, 384), jnp.float32)  # group 32: not 128-aligned
+    assert not qmatmul.w4_eligible((8, 256), q, s_fine)
+    assert not qmatmul.w4_eligible((512, 256), q, s)  # prefill-sized M
+
+
+def test_engine_greedy_identical_under_w4_kernel(w8_kernel_env):
+    """int4 serving with the kernel enabled matches the XLA w4 path."""
+    import dataclasses
+
+    from localai_tpu.engine.runner import ModelRunner
+    from localai_tpu.models import llama as mdl
+    from localai_tpu.models.llama import LlamaConfig
+
+    cfg = LlamaConfig(vocab_size=512, hidden_size=128,
+                      intermediate_size=256, num_layers=2, num_heads=2,
+                      num_kv_heads=2, max_position_embeddings=256,
+                      tie_word_embeddings=True, dtype="float32")
+    params = mdl.init_params(jax.random.key(2), cfg)
+    q = qnt.quantize_params(params, "int4", group=128)
+    prompt = list(range(1, 30))
+
+    def greedy():
+        r = ModelRunner(dataclasses.replace(cfg, dtype="float32"), q,
+                        num_slots=2, max_ctx=128, prefill_buckets=[32],
+                        kv_dtype="float32")
+        s = r.acquire_slot()
+        return [r.admit(s, prompt, temperature=0.0)] + \
+            [int(r.step()[s]) for _ in range(6)]
+
+    with_kernel = greedy()
+    os.environ["LOCALAI_W8_KERNEL"] = ""
+    without = greedy()
+    assert with_kernel == without
