@@ -51,7 +51,9 @@ def bench_quant_matmuls(M=8, K=4096, N=14336, steps=64):
             q=qnt.quantize_tensor(w_f, axis=0).q,
             scale=qnt.quantize_tensor(w_f, axis=0).scale,
             axis=0, mode="w8a8"), 1),
-        "w4": (qnt.quantize_tensor4(w_f, axis=0), 0.5),
+        # w4 traffic includes the group-wise f32 scales: 0.5 B/weight for
+        # the nibbles + 4 B per `group` weights of scale rows
+        "w4": (qnt.quantize_tensor4(w_f, axis=0), 0.5 + 4.0 / 128),
     }
     if jax.default_backend() == "tpu":
         from localai_tpu.ops import qmatmul
@@ -66,7 +68,7 @@ def bench_quant_matmuls(M=8, K=4096, N=14336, steps=64):
             return qmatmul.w4_matmul(h, w4.q, w4.scale)
 
         variants["w8_pallas"] = (kernel_mm, 1)
-        variants["w4_pallas"] = (kernel_mm4, 0.5)
+        variants["w4_pallas"] = (kernel_mm4, 0.5 + 4.0 / 128)
     out = {}
     for name, (w, bytes_per) in variants.items():
         if callable(w) and not hasattr(w, "shape"):

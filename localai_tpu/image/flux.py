@@ -72,6 +72,7 @@ class FluxPipeline:
         self._encode = jax.jit(self._encode_fn)
         self._velocity = jax.jit(self._velocity_fn)
         self._decode = jax.jit(self._decode_fn, static_argnames=("h", "w"))
+        self._encode_img = jax.jit(self._encode_img_fn)
 
     # -- jitted programs -------------------------------------------------
 
@@ -89,6 +90,22 @@ class FluxPipeline:
             img_ids, txt_ids,
             guidance=jnp.full((latents.shape[0],), guidance, jnp.float32),
         )
+
+    def _encode_img_fn(self, img):
+        """img [1, H, W, 3] in [-1, 1] → packed model-space latent tokens
+        [1, (h/2)(w/2), 4*Cz] — the exact inverse of _decode_fn's unpack.
+
+        vae.encode returns z_raw * vae_cfg.scaling_factor (the SD
+        convention baked into the vae module); FLUX model space is
+        (z_raw - shift) * vae_scale — derive z_raw explicitly so the two
+        scale sources can never silently diverge."""
+        z_raw = (vae_mod.encode(self.vae_cfg, self.vae_params, img)
+                 / self.vae_cfg.scaling_factor)
+        zm = (z_raw - self.vae_shift) * self.vae_scale  # [1, h, w, Cz]
+        _, h, w, cz = zm.shape
+        x = zm.reshape(1, h // 2, 2, w // 2, 2, cz)
+        x = x.transpose(0, 1, 3, 5, 2, 4)              # (B,h2,w2,C,ph,pw)
+        return x.reshape(1, (h // 2) * (w // 2), 4 * cz)
 
     def _decode_fn(self, packed, *, h: int, w: int):
         """packed [1, (h/2)(w/2), 4*Cz] → image uint8 [H, W, 3]
@@ -136,6 +153,8 @@ class FluxPipeline:
         cfg_scale: Optional[float] = None,   # mapped to distilled guidance
         seed: Optional[int] = None,
         scheduler: str = "",                 # FLUX always rectified-flow
+        init_image=None,                     # [H, W, 3] uint8 (img2img)
+        strength: float = 0.75,
         **_,
     ) -> GenerationResult:
         del negative_prompt, scheduler
@@ -164,7 +183,20 @@ class FluxPipeline:
 
         sigmas = mmdit.flow_sigmas(
             steps, n_img, dynamic=self.dynamic_shift, shift=self.shift)
-        for i in range(steps):
+        i0 = 0
+        if init_image is not None:
+            # rectified-flow img2img (diffusers FluxImg2ImgPipeline
+            # scale_noise): start at x = (1-sigma)*z0 + sigma*noise and run
+            # the remaining int(steps*strength) steps
+            run = max(1, min(steps, int(steps * strength)))
+            i0 = steps - run
+            img = jnp.asarray(init_image, jnp.float32) / 127.5 - 1.0
+            img = jax.image.resize(img[None], (1, height, width, 3),
+                                   "linear")
+            z0 = self._encode_img(img)
+            s0 = float(sigmas[i0])
+            x = (1.0 - s0) * z0 + s0 * x
+        for i in range(i0, steps):
             v = self._velocity(x, txt, pooled, float(sigmas[i]),
                                float(guidance), img_ids, txt_ids)
             x = x + (float(sigmas[i + 1]) - float(sigmas[i])) * v

