@@ -9,7 +9,6 @@ the wav path, and non-wav containers raise a clear error.
 from __future__ import annotations
 
 import io
-import struct
 import wave
 
 import numpy as np

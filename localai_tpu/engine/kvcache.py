@@ -75,7 +75,13 @@ def init_cache(
 
     def zeros(shp, d, shd):
         if shd is not None:
-            return jax.jit(lambda: jnp.zeros(shp, d), out_shardings=shd)()
+            # one-shot jit is the idiom for allocating directly into a
+            # sharded layout (device_put of a host zeros array would
+            # materialize the full cache on one device first); init-time
+            # only, so the throwaway compile cache is fine
+            return jax.jit(  # jaxlint: disable=jit-in-loop
+                lambda: jnp.zeros(shp, d), out_shardings=shd
+            )()
         return jnp.zeros(shp, d)
 
     scale_sharding = None

@@ -32,6 +32,7 @@ from localai_tpu.engine import sampling as smp
 from localai_tpu.engine.kvcache import KVCache
 from localai_tpu.models import llama as mdl
 from localai_tpu.models.llama import LlamaConfig
+from localai_tpu.utils.jaxcompat import shard_map
 
 log = logging.getLogger(__name__)
 
@@ -205,6 +206,9 @@ class ModelRunner:
                 self.rope, NamedSharding(mesh, P())
             )
         self._free_slots = list(range(num_slots))
+        # host mirror of which slots are serving: admit()/release() are the
+        # only transitions, so liveness queries never touch the device
+        self._active_slots: set[int] = set()
 
         self.kv_dtype = kv_dtype
         self._decode = jax.jit(self._decode_fn, donate_argnums=(1, 2))
@@ -288,7 +292,7 @@ class ModelRunner:
                 if raw_kv:
                     in_specs += [P("data", "model", None),
                                  P("data", "model", None)]
-                kernel = jax.shard_map(
+                kernel = shard_map(
                     kernel,
                     mesh=self.mesh,
                     in_specs=tuple(in_specs),
@@ -585,7 +589,7 @@ class ModelRunner:
 
             # single-sequence prefill: only the head dim shards ('model');
             # each device runs flash attention over its head group
-            kernel = jax.shard_map(
+            kernel = shard_map(
                 kernel,
                 mesh=self.mesh,
                 in_specs=(P(None, "model", None), P("model", None, None),
@@ -642,12 +646,17 @@ class ModelRunner:
         mm_positions: Optional[np.ndarray] = None,  # [n_mm] prompt positions
         resident: Optional[list[int]] = None,       # slot's previous tokens
                                                     # (enables prefix reuse)
+        valid_n: Optional[int] = None,              # slot's KV frontier, from
+                                                    # a batched slot_positions()
+                                                    # read (None → read it here)
     ) -> int:
         """Prefill a prompt into a slot; returns the first sampled token.
 
         When ``resident`` is given and shares a long-enough prefix with the
         prompt, the prefix KV is kept and only the tail is prefilled
-        (parity: llama.cpp common_part slot reuse, grpc-server.cpp:67-74)."""
+        (parity: llama.cpp common_part slot reuse, grpc-server.cpp:67-74).
+        Callers that already hold a slot_positions() snapshot pass
+        ``valid_n`` so admission stays a single device sync."""
         if not prompt:
             prompt = [0]
         n = len(prompt)
@@ -657,7 +666,7 @@ class ModelRunner:
             raise ValueError(f"prompt ({n} tokens) exceeds context {self.max_ctx}")
         lcp = 0
         if resident and mm_embeds is None:
-            lcp = self.reusable_prefix(slot, resident, prompt)
+            lcp = self.reusable_prefix(slot, resident, prompt, valid_n)
         self.last_prefix_reused = lcp
         self.total_prefix_reused += lcp
         tail = prompt[lcp:]
@@ -730,7 +739,10 @@ class ModelRunner:
                 jnp.asarray(padded), jnp.int32(n), jnp.int32(slot),
                 bucket=bucket,
             )
-        return int(tok)
+        self._active_slots.add(slot)
+        # the first sampled token seeds the host-side stream state; this
+        # one admit-time sync is the prefill/decode handoff point
+        return int(tok)  # jaxlint: disable=host-sync-in-hot-path
 
     def reusable_prefix(self, slot: int, resident: Optional[list[int]],
                         prompt: list[int],
@@ -772,11 +784,15 @@ class ModelRunner:
         return None
 
     def step(self) -> np.ndarray:
-        """One decode iteration over all slots; returns sampled tokens [S]."""
+        """One decode iteration over all slots; returns sampled tokens [S].
+
+        Synchronous by contract — the blocking host read IS the API
+        (constraint gating needs the token before the next dispatch);
+        pipelined callers use step_async()."""
         self.kv, self.state, tokens = self._decode(
             self.params, self.kv, self.state
         )
-        return np.asarray(tokens)
+        return np.asarray(tokens)  # jaxlint: disable=host-sync-in-hot-path
 
     def step_async(self) -> jax.Array:
         """Like step() but returns the device array without synchronizing —
@@ -787,11 +803,13 @@ class ModelRunner:
         return tokens
 
     def step_n(self, n: int) -> np.ndarray:
-        """n decode iterations in one dispatch; returns tokens [n, S]."""
+        """n decode iterations in one dispatch; returns tokens [n, S].
+        Synchronous by contract — see step(); hot callers use
+        step_n_async()."""
         self.kv, self.state, tokens = self._decode_n(
             self.params, self.kv, self.state, n=n
         )
-        return np.asarray(tokens)
+        return np.asarray(tokens)  # jaxlint: disable=host-sync-in-hot-path
 
     def step_n_async(self, n: int) -> jax.Array:
         """Like step_n() but returns the [n, S] device array without
@@ -808,7 +826,9 @@ class ModelRunner:
             self.params, self.kv, self.state,
             jnp.asarray(freeze, jnp.bool_), n=n,
         )
-        return np.asarray(tokens)
+        # synchronous by contract: the frozen slots' constraint masks need
+        # the sampled token on the host before the next dispatch
+        return np.asarray(tokens)  # jaxlint: disable=host-sync-in-hot-path
 
     def embed(self, prompt: list[int]) -> np.ndarray:
         """[D] float32 embedding of a token sequence (bucketed like prefill)."""
@@ -840,15 +860,28 @@ class ModelRunner:
         self.state = dataclasses.replace(
             self.state, active=self.state.active.at[slot].set(False)
         )
+        self._active_slots.discard(slot)
         if slot not in self._free_slots:
             self._free_slots.append(slot)
 
     @property
     def any_active(self) -> bool:
-        return bool(np.asarray(self.state.active).any())
+        # host mirror — admit()/release() are the only transitions, so no
+        # device round-trip (and no stall behind in-flight decodes)
+        return bool(self._active_slots)
+
+    def slot_positions(self) -> np.ndarray:
+        """Every slot's KV frontier in ONE [S] transfer. The scheduler's
+        admit path ranks ALL free slots by reusable prefix; per-slot
+        int() reads would multiply the device sync by the candidate
+        count."""
+        # single batched admit-time read — the one deliberate sync here
+        return np.asarray(  # jaxlint: disable=host-sync-in-hot-path
+            self.state.positions
+        )
 
     def slot_position(self, slot: int) -> int:
-        return int(self.state.positions[slot])
+        return int(self.slot_positions()[slot])
 
     # -- prompt-cache persistence (engine.promptcache) -------------------
 
@@ -936,6 +969,7 @@ class ModelRunner:
             positions=self.state.positions.at[slot].set(n),
             active=self.state.active.at[slot].set(False),
         )
+        self._active_slots.discard(slot)
         return True
 
 

@@ -335,7 +335,10 @@ class Scheduler:
 
         def drain_one() -> None:
             toks, seq, k, pipelined, t_issue, fresh = inflight.popleft()
-            rows = np.asarray(toks)
+            # the designed drain point: copy_to_host_async started this
+            # D2H at dispatch time, so materializing here overlaps with
+            # the next dispatch already running on device
+            rows = np.asarray(toks)  # jaxlint: disable=host-sync-in-hot-path
             now = time.monotonic()
             if k == 0 and self.spec is not None:  # speculative window
                 self.spec.observe_window(rows)
@@ -545,20 +548,24 @@ class Scheduler:
             # prefer the free slot whose resident tokens share the longest
             # prefix with this prompt (KV prefix-cache reuse); the loop
             # guard guarantees a free slot exists (slot lists are mutated
-            # only on this thread)
+            # only on this thread). One batched [S] positions read serves
+            # the whole ranking + admit — free slots' frontiers are frozen
+            # until we prefill them, so the snapshot stays valid.
+            positions = self._engine.slot_positions()
             slot = self._engine.acquire_slot(
-                self._best_slot(handle.request.prompt)
+                self._best_slot(handle.request.prompt, positions)
             )
             assert slot is not None
             try:
-                self._start(slot, handle)
+                self._start(slot, handle, positions)
                 admitted = True
             except Exception as e:  # noqa: BLE001 — bad request ≠ dead engine
                 log.warning("admit failed: %s", e)
                 handle._finish("error")
                 self._engine.release(slot)
 
-    def _start(self, slot: int, handle: GenHandle) -> None:
+    def _start(self, slot: int, handle: GenHandle,
+               positions: Optional[np.ndarray] = None) -> None:
         req = handle.request
         base = self._padded_vocab_ban()
         if req.logit_bias:
@@ -577,9 +584,13 @@ class Scheduler:
             req.constraint.allowed_mask() if req.constraint is not None else None
         )
         resident = self._resident.get(slot)
+        if positions is None:
+            positions = self._engine.slot_positions()
+        valid_n = int(positions[slot])
         if self.prompt_cache is not None and req.mm_embeds is None:
             mem_lcp = (
-                self._engine.reusable_prefix(slot, resident, req.prompt)
+                self._engine.reusable_prefix(slot, resident, req.prompt,
+                                             valid_n=valid_n)
                 if resident else 0
             )
             hit = self.prompt_cache.lookup(req.prompt)
@@ -595,10 +606,12 @@ class Scheduler:
             if (disk_lcp > mem_lcp
                     and self.runner.load_prefix(slot, hit.arrays, hit.n)):
                 resident = hit.tokens
+                valid_n = hit.n  # load_prefix moved the slot's frontier
         first = self._engine.admit(
             slot,
             req.prompt,
             resident=resident,
+            valid_n=valid_n,
             temperature=req.temperature,
             top_k=req.top_k,
             top_p=req.top_p,
@@ -632,16 +645,23 @@ class Scheduler:
             self.total_prompt_tokens += handle.prompt_tokens
         self._consume(slot, ctx, int(first))
 
-    def _best_slot(self, prompt: list[int]) -> Optional[int]:
+    def _best_slot(self, prompt: list[int],
+                   positions: Optional[np.ndarray] = None) -> Optional[int]:
         """Free slot with the longest reusable token prefix (None → FIFO).
         Uses the runner's own feasibility gates so the ranking can't pick a
-        slot whose reuse collapses to zero at admit time."""
+        slot whose reuse collapses to zero at admit time. ``positions`` is
+        the batched slot_positions() snapshot — passing valid_n explicitly
+        keeps this loop free of per-candidate device syncs."""
+        if positions is None:
+            positions = self._engine.slot_positions()
         best, best_lcp = None, 0
         for s in self._engine.free_slots():
             r = self._resident.get(s)
             if not r:
                 continue
-            lcp = self._engine.reusable_prefix(s, r, prompt)
+            lcp = self._engine.reusable_prefix(
+                s, r, prompt, valid_n=int(positions[s])
+            )
             if lcp > best_lcp:
                 best, best_lcp = s, lcp
         return best

@@ -31,7 +31,7 @@ from __future__ import annotations
 
 import dataclasses
 import logging
-from typing import Any, Optional
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -221,7 +221,11 @@ class SpecDecoder:
         return emitted
 
     def step_spec(self) -> np.ndarray:
-        rows = np.asarray(self.step_spec_async())
+        # synchronous by contract (telemetry + tests); the scheduler's hot
+        # path uses step_spec_async + copy_to_host_async
+        rows = np.asarray(  # jaxlint: disable=host-sync-in-hot-path
+            self.step_spec_async()
+        )
         self.observe_window(rows)
         return rows
 
@@ -264,8 +268,9 @@ class SpecDecoder:
         self.draft.state = dataclasses.replace(
             st,
             tokens=st.tokens.at[slot].set(jnp.int32(resident[-1])),
+            # device-side copy of the target's frontier — no host sync
             positions=st.positions.at[slot].set(
-                self.target.slot_position(slot)
+                self.target.state.positions[slot]
             ),
         )
 
@@ -288,6 +293,9 @@ class SpecDecoder:
     def reusable_prefix(self, slot: int, resident, prompt,
                         valid_n=None) -> int:
         return self.target.reusable_prefix(slot, resident, prompt, valid_n)
+
+    def slot_positions(self) -> np.ndarray:
+        return self.target.slot_positions()
 
     def slot_position(self, slot: int) -> int:
         return self.target.slot_position(slot)

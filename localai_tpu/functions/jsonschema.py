@@ -16,7 +16,6 @@ practical tool arguments.
 from __future__ import annotations
 
 import json
-import re as _re
 from typing import Any, Optional
 
 # Single optional whitespace between tokens: keeps the DFA small while

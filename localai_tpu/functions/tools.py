@@ -10,7 +10,7 @@ BNF text.
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Optional
+from typing import Any
 
 from localai_tpu.config.model_config import FunctionsConfig
 from localai_tpu.functions.jsonschema import (

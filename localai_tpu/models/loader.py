@@ -12,13 +12,14 @@ from __future__ import annotations
 import json
 import logging
 from pathlib import Path
-from typing import Any, Iterator, Optional
+from typing import Any, Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from localai_tpu.models.llama import LlamaConfig, param_shapes
+from localai_tpu.utils import jaxcompat
 
 log = logging.getLogger(__name__)
 
@@ -153,7 +154,7 @@ def load_llama_params(
         else:
             cfg = LlamaConfig(**{**cfg.__dict__, "tie_word_embeddings": True})
 
-    placed = jax.tree.map_with_path(lambda p, a: put(p, a), params)
+    placed = jaxcompat.tree_map_with_path(lambda p, a: put(p, a), params)
     _check_shapes(cfg, placed)
     return cfg, placed
 

@@ -20,6 +20,7 @@ from typing import Any, Optional
 import jax
 
 from localai_tpu.models.llama import LlamaConfig, init_params
+from localai_tpu.utils import jaxcompat
 from localai_tpu.utils.tokenizer import ByteTokenizer, Tokenizer, load_tokenizer
 
 # Synthetic presets: shapes only, random weights. "llama3-8b" matches
@@ -80,7 +81,7 @@ def resolve_model(
         cfg = dataclasses.replace(DEBUG_PRESETS[name], dtype=dtype)
         params = init_params(jax.random.key(seed), cfg)
         if shard_fn is not None:
-            params = jax.tree.map_with_path(shard_fn, params)
+            params = jaxcompat.tree_map_with_path(shard_fn, params)
         return LoadedModel(cfg, params, ByteTokenizer(), ref)
 
     for cand in (Path(ref), Path(model_path) / ref):

@@ -19,7 +19,7 @@ Axis conventions (sizes of 1 are legal and collapse at trace time):
 from __future__ import annotations
 
 import math
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Optional, Sequence
 
 import jax

@@ -32,7 +32,7 @@ from localai_tpu.models import llama as mdl
 from localai_tpu.models import quant as qnt
 from localai_tpu.models.llama import LlamaConfig
 
-shard_map = jax.shard_map
+from localai_tpu.utils.jaxcompat import shard_map
 
 
 def _pipe_spec(ndim: int) -> P:

@@ -32,11 +32,10 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
-shard_map = jax.shard_map
-
 from localai_tpu.models import llama as mdl
 from localai_tpu.models import quant as qnt
 from localai_tpu.models.llama import LlamaConfig
+from localai_tpu.utils.jaxcompat import shard_map
 
 _NEG_INF = -1e30
 

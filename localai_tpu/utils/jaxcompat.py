@@ -1,0 +1,39 @@
+"""Version portability for JAX APIs that moved between releases.
+
+The serving stack tracks recent JAX, but the CPU CI / dev images often
+lag: ``jax.shard_map`` only exists as a top-level API from 0.6, while
+earlier releases ship it as ``jax.experimental.shard_map.shard_map``
+with ``check_rep`` instead of ``check_vma``. Every call site imports
+from here instead of hard-coding one spelling (the same bug class as
+the ``jax_num_cpu_devices`` conftest breakage — see tools/jaxlint rule
+``unknown-jax-config`` for the config-option flavor).
+"""
+
+from __future__ import annotations
+
+import jax
+
+__all__ = ["shard_map", "tree_map_with_path"]
+
+# jax.tree.map_with_path only exists from ~0.5; the tree_util spelling
+# works on every release this repo supports.
+tree_map_with_path = jax.tree_util.tree_map_with_path
+
+
+if hasattr(jax, "shard_map"):
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma=False):
+        return jax.shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=check_vma,
+        )
+
+else:
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma=False):
+        # pre-0.6 spelling: replication checking is ``check_rep``
+        return _shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_rep=check_vma,
+        )

@@ -14,7 +14,19 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+
+if hasattr(jax.config, "jax_num_cpu_devices"):
+    # JAX >= 0.5: first-class virtual CPU device count.
+    jax.config.update("jax_num_cpu_devices", 8)
+else:
+    # Older JAX: the XLA flag is read at backend initialization (first
+    # device use), not at import, so setting it here still works even
+    # though jax is already imported — as long as no test ran yet.
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
 
 import pytest  # noqa: E402
 

@@ -10,10 +10,9 @@ import base64
 import io
 import json
 
+import httpx
 import numpy as np
 import pytest
-
-import httpx
 
 from tests.test_api import _ServerThread, make_state
 

@@ -5,11 +5,11 @@ boot-time reload in app.go:152-154)."""
 
 import httpx
 import pytest
-from test_api import TINY_YAML, _ServerThread
 
 from localai_tpu.api.server import AppState
 from localai_tpu.config.app_config import AppConfig
 from localai_tpu.config.loader import ConfigLoader
+from test_api import TINY_YAML, _ServerThread
 
 
 def _make_state(root) -> AppState:

@@ -191,7 +191,6 @@ def test_audio_models_under_lifecycle_management(tmp_path):
     """Whisper/VITS models load through the ModelManager: they appear in
     loaded_names, expose metrics, and evict like every other model (the
     round-2 image-cache criticism, applied to audio)."""
-    import json
 
     import httpx
     from test_api import _ServerThread, make_state

@@ -5,11 +5,10 @@ import dataclasses
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 import pytest
 
-from localai_tpu.engine.runner import ModelRunner
 from localai_tpu.engine import sampling as smp
+from localai_tpu.engine.runner import ModelRunner
 from localai_tpu.models.registry import resolve_model
 
 

@@ -10,7 +10,7 @@ import httpx
 import pytest
 from aiohttp import web
 
-from localai_tpu.federation import FederatedNode, FederatedServer, announce
+from localai_tpu.federation import FederatedServer, announce
 
 
 class _AppThread:

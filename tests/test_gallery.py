@@ -232,7 +232,7 @@ def test_gallery_http_api(fixture_gallery, tmp_models_dir):
 
 def test_shipped_index_families_and_resolution(tmp_path):
     from localai_tpu.gallery import available_models, resolve_ref
-    from localai_tpu.gallery.index_data import SHIPPED_MODELS, shipped_index
+    from localai_tpu.gallery.index_data import shipped_index
 
     models = shipped_index()
     assert len(models) >= 30

@@ -12,7 +12,6 @@ import struct
 from pathlib import Path
 
 import numpy as np
-import pytest
 
 from localai_tpu.utils import gguf as G
 

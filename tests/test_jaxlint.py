@@ -1,0 +1,419 @@
+"""tools.jaxlint: every rule gets a must-flag fixture, a near-miss that
+must stay silent, plus suppression and baseline round-trips and the CLI
+self-check this repo's CI runs."""
+
+import subprocess
+import sys
+import textwrap
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[1]
+if str(REPO) not in sys.path:
+    sys.path.insert(0, str(REPO))
+
+from tools.jaxlint import Baseline, lint_paths  # noqa: E402
+
+ENGINE_MOD = "localai_tpu/engine/mod.py"
+
+
+def lint_snippet(tmp_path, code, relpath=ENGINE_MOD):
+    f = tmp_path / relpath
+    f.parent.mkdir(parents=True, exist_ok=True)
+    f.write_text(textwrap.dedent(code))
+    return lint_paths([str(tmp_path)])
+
+
+def rules_of(findings):
+    return [f.rule for f in findings]
+
+
+# -- host-sync-in-hot-path -------------------------------------------------
+
+HOT_SYNC = """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    def decode_step(state, xs):
+        for x in xs:
+            v = state.tokens.item()
+            w = int(jnp.sum(x))
+            h = np.asarray(x)
+            g = jax.device_get(x)
+        return v, w, h, g
+"""
+
+
+def test_host_sync_flags_in_hot_loop(tmp_path):
+    found = lint_snippet(tmp_path, HOT_SYNC)
+    assert rules_of(found) == ["host-sync-in-hot-path"] * 4
+
+
+def test_host_sync_ignores_cold_files(tmp_path):
+    # byte-identical code outside engine//worker-serving: silent
+    found = lint_snippet(tmp_path, HOT_SYNC, "localai_tpu/api/mod.py")
+    assert found == []
+
+
+def test_host_sync_near_misses(tmp_path):
+    found = lint_snippet(tmp_path, """
+        import jax.numpy as jnp
+        import numpy as np
+
+        def decode_step(prompt, x):
+            n = int(len(prompt))       # len() is host-side already
+            m = int("42")              # literal
+            d = jnp.asarray(x)         # device put, not a sync
+            return n, m, d
+
+        def admit(prompt):
+            return np.asarray(prompt)  # not a hot function, not a loop
+    """)
+    assert found == []
+
+
+def test_host_sync_on_serving_state_anywhere_in_file(tmp_path):
+    # direct materialization of self.state/self.kv flags even outside
+    # loops/step functions — these arrays are donated and in flight
+    found = lint_snippet(tmp_path, """
+        import numpy as np
+
+        class Runner:
+            def frontier(self, slot):
+                return int(self.state.positions[slot])
+
+            def cache_rows(self):
+                return np.asarray(self.kv.k)
+    """)
+    assert rules_of(found) == ["host-sync-in-hot-path"] * 2
+
+
+# -- jit-in-loop -----------------------------------------------------------
+
+def test_jit_in_loop_flags(tmp_path):
+    found = lint_snippet(tmp_path, """
+        import jax
+
+        def serve(xs, fn):
+            out = []
+            for x in xs:
+                f = jax.jit(fn)          # fresh cache per iteration
+                out.append(f(x))
+            return out
+
+        def once(f, x):
+            return jax.jit(f)(x)         # immediately invoked
+    """)
+    assert rules_of(found) == ["jit-in-loop"] * 2
+
+
+def test_jit_at_init_is_fine(tmp_path):
+    found = lint_snippet(tmp_path, """
+        import jax
+        from functools import partial
+
+        class Runner:
+            def __init__(self, fn):
+                self._decode = jax.jit(fn, donate_argnums=(1, 2))
+
+        @partial(jax.jit, static_argnames=("n",))
+        def step_n(x, n):
+            return x * n
+    """)
+    assert found == []
+
+
+# -- tracer-control-flow ---------------------------------------------------
+
+def test_tracer_control_flow_flags(tmp_path):
+    found = lint_snippet(tmp_path, """
+        import jax
+
+        @jax.jit
+        def f(x, y):
+            if x > 0:
+                return y
+            while y.any():
+                y = y - 1
+            return x
+    """)
+    assert rules_of(found) == ["tracer-control-flow"] * 2
+
+
+def test_tracer_control_flow_near_misses(tmp_path):
+    found = lint_snippet(tmp_path, """
+        import jax
+        from functools import partial
+
+        @jax.jit
+        def f(x, flag=None):
+            if x.ndim == 2:          # static under trace
+                x = x[None]
+            if flag is None:         # identity test is static
+                return x
+            if isinstance(x, tuple): # type test is static
+                return x[0]
+            return x
+
+        @partial(jax.jit, static_argnames=("k",))
+        def g(x, k):
+            if k > 3:                # static arg
+                return x
+            return -x
+
+        def not_jitted(x):
+            if x > 0:                # no @jit: plain Python is fine
+                return x
+            return -x
+    """)
+    assert found == []
+
+
+# -- rng-key-reuse ---------------------------------------------------------
+
+def test_rng_key_reuse_flags(tmp_path):
+    found = lint_snippet(tmp_path, """
+        import jax
+
+        def bad(key):
+            a = jax.random.normal(key)
+            b = jax.random.uniform(key)
+            return a + b
+
+        def bad_loop(key):
+            out = 0.0
+            for _ in range(4):
+                out = out + jax.random.normal(key)
+            return out
+
+        def bad_after_split(key):
+            k1, k2 = jax.random.split(key)
+            return jax.random.normal(key)
+    """)
+    assert rules_of(found) == ["rng-key-reuse"] * 3
+
+
+def test_rng_key_split_patterns_are_fine(tmp_path):
+    found = lint_snippet(tmp_path, """
+        import jax
+
+        def ok(key):
+            k1, k2 = jax.random.split(key)
+            a = jax.random.normal(k1)
+            b = jax.random.uniform(k2)
+            return a + b
+
+        def ok_carry(key):
+            total = 0.0
+            for _ in range(4):
+                key, sub = jax.random.split(key)
+                total = total + jax.random.normal(sub)
+            return total
+
+        def ok_vmap(keys):
+            return jax.vmap(lambda kk: jax.random.split(kk, 2))(keys)
+    """)
+    assert found == []
+
+
+# -- unknown-jax-config ----------------------------------------------------
+
+def test_unknown_jax_config_flags_bogus_options(tmp_path):
+    # an option no JAX release has; a valid option must stay silent
+    found = lint_snippet(tmp_path, """
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_definitely_not_an_option", 8)
+    """, "tests/conftest.py")
+    assert rules_of(found) == ["unknown-jax-config"]
+    assert "jax_definitely_not_an_option" in found[0].message
+
+
+def test_unknown_jax_config_tracks_the_installed_jax(tmp_path):
+    # the exact line that once made the whole suite die at conftest
+    # import: flagged exactly when the RUNNING JAX rejects it (that is
+    # the rule's contract — newer JAX accepts the option, so the same
+    # line is then legitimately silent)
+    import jax
+
+    found = lint_snippet(tmp_path, """
+        import jax
+
+        jax.config.update("jax_num_cpu_devices", 8)
+    """, "tests/conftest.py")
+    if hasattr(jax.config, "jax_num_cpu_devices"):
+        assert found == []
+    else:
+        assert rules_of(found) == ["unknown-jax-config"]
+        assert "jax_num_cpu_devices" in found[0].message
+
+
+def test_unknown_jax_config_capability_guard_is_fine(tmp_path):
+    found = lint_snippet(tmp_path, """
+        import jax
+
+        if hasattr(jax.config, "jax_num_cpu_devices"):
+            jax.config.update("jax_num_cpu_devices", 8)
+
+        if not hasattr(jax.config, "jax_num_cpu_devices"):
+            pass
+        else:
+            jax.config.update("jax_num_cpu_devices", 8)
+    """, "tests/conftest.py")
+    assert found == []
+
+
+def test_unknown_jax_config_wrong_branch_still_flags(tmp_path):
+    # the update sits exactly where the capability probe FAILED
+    found = lint_snippet(tmp_path, """
+        import jax
+
+        if hasattr(jax.config, "jax_definitely_not_an_option"):
+            pass
+        else:
+            jax.config.update("jax_definitely_not_an_option", 8)
+    """, "tests/conftest.py")
+    assert rules_of(found) == ["unknown-jax-config"]
+
+
+# -- suppressions ----------------------------------------------------------
+
+def test_inline_suppression(tmp_path):
+    found = lint_snippet(tmp_path, """
+        import numpy as np
+
+        def decode_step(tokens):
+            a = np.asarray(tokens)  # jaxlint: disable=host-sync-in-hot-path
+            b = np.asarray(tokens)  # jaxlint: disable=all
+            c = np.asarray(tokens)  # jaxlint: disable=jit-in-loop
+            return a, b, c
+    """)
+    # wrong rule id on line c does not suppress
+    assert len(found) == 1
+    assert found[0].line == 7
+
+
+# -- baseline --------------------------------------------------------------
+
+def test_baseline_round_trip(tmp_path):
+    found = lint_snippet(tmp_path, HOT_SYNC)
+    assert len(found) == 4
+    baseline = Baseline.from_findings(found)
+
+    # unchanged findings are fully absorbed
+    new, stale = baseline.filter(found)
+    assert new == [] and stale == []
+
+    # a NEW violation surfaces even with the baseline in place; shifted
+    # line numbers alone don't (keys are file/rule/text, not line)
+    f = tmp_path / ENGINE_MOD
+    f.write_text("import jax\n\n\n" + f.read_text().replace(
+        "return v, w, h, g",
+        "return v, w, h, g, state.active.item()",
+    ))
+    found2 = lint_paths([str(tmp_path)])
+    new, stale = baseline.filter(found2)
+    assert [n.text for n in new] == ["return v, w, h, g, state.active.item()"]
+
+    # fixing a finding leaves a stale entry (reported, not fatal)
+    f.write_text("import jax\n")
+    new, stale = baseline.filter(lint_paths([str(tmp_path)]))
+    assert new == [] and len(stale) == 4
+
+
+def test_baseline_file_round_trip(tmp_path):
+    found = lint_snippet(tmp_path, HOT_SYNC)
+    path = tmp_path / "baseline.json"
+    Baseline.from_findings(found).write(path)
+    loaded = Baseline.load(path)
+    new, stale = loaded.filter(found)
+    assert new == [] and stale == []
+
+
+def test_lint_paths_with_dotdot_and_absolute_paths(tmp_path):
+    lint_snippet(tmp_path, HOT_SYNC)
+    # '..' in the target must not trip the hidden-dir filter into
+    # silently scanning zero files
+    dotted = tmp_path / "sub" / ".." / "localai_tpu"
+    (tmp_path / "sub").mkdir()
+    assert len(lint_paths([str(dotted)])) == 4
+    assert len(lint_paths([str(tmp_path / "localai_tpu")])) == 4
+
+
+def test_finding_paths_are_cwd_relative(tmp_path, monkeypatch):
+    # absolute CLI paths must produce the same baseline keys as
+    # relative ones, or baselined findings resurface as new
+    lint_snippet(tmp_path, HOT_SYNC)
+    monkeypatch.chdir(tmp_path)
+    rel = lint_paths(["localai_tpu"])
+    ab = lint_paths([str(tmp_path / "localai_tpu")])
+    assert [f.file for f in ab] == [f.file for f in rel]
+    assert all(f.file.startswith("localai_tpu/") for f in ab)
+    new, stale = Baseline.from_findings(rel).filter(ab)
+    assert new == [] and stale == []
+
+
+# -- CLI / self-check ------------------------------------------------------
+
+def run_cli(args, cwd):
+    return subprocess.run(
+        [sys.executable, "-m", "tools.jaxlint", *args],
+        cwd=cwd, capture_output=True, text=True,
+        env={"PYTHONPATH": str(REPO), "PATH": "/usr/bin:/bin",
+             "JAX_PLATFORMS": "cpu"},
+    )
+
+
+def test_cli_self_check_is_clean():
+    """The CI gate: the repo lints clean against its own baseline."""
+    res = run_cli(["localai_tpu", "tests"], cwd=REPO)
+    assert res.returncode == 0, res.stdout + res.stderr
+
+
+def test_cli_fails_on_regression(tmp_path):
+    bad = tmp_path / "localai_tpu" / "engine" / "bad.py"
+    bad.parent.mkdir(parents=True)
+    bad.write_text(
+        "import jax\n"
+        'jax.config.update("jax_definitely_not_an_option", 8)\n'
+    )
+    res = run_cli(["--no-baseline", "localai_tpu"], cwd=tmp_path)
+    assert res.returncode == 1
+    assert "unknown-jax-config" in res.stdout
+
+    # --write-baseline accepts it; the next run is green
+    res = run_cli(["--write-baseline", "localai_tpu"], cwd=tmp_path)
+    assert res.returncode == 0
+    res = run_cli(["localai_tpu"], cwd=tmp_path)
+    assert res.returncode == 0, res.stdout + res.stderr
+
+
+def test_parse_errors_cannot_be_baselined(tmp_path):
+    bad = tmp_path / "localai_tpu" / "engine" / "broken.py"
+    bad.parent.mkdir(parents=True)
+    bad.write_text("def oops(:\n")
+    found = lint_paths([str(tmp_path)])
+    assert rules_of(found) == ["parse-error"]
+
+    # from_findings drops it; filter never absorbs it
+    new, _ = Baseline.from_findings(found).filter(found)
+    assert rules_of(new) == ["parse-error"]
+
+    # --write-baseline refuses to launder it: still exits 1, and the
+    # next plain run still fails
+    res = run_cli(["--write-baseline", "localai_tpu"], cwd=tmp_path)
+    assert res.returncode == 1
+    res = run_cli(["--baseline", "tools/jaxlint/baseline.json",
+                   "localai_tpu"], cwd=tmp_path)
+    assert res.returncode == 1
+    assert "parse-error" in res.stdout
+
+
+def test_cli_list_rules():
+    res = run_cli(["--list-rules"], cwd=REPO)
+    assert res.returncode == 0
+    for rule in ("host-sync-in-hot-path", "jit-in-loop",
+                 "tracer-control-flow", "rng-key-reuse",
+                 "unknown-jax-config"):
+        assert rule in res.stdout

@@ -2,12 +2,10 @@
 /root/reference/backend/python/diffusers/backend.py:300-381 — kohya and
 diffusers/peft safetensors layouts folded into base weights at load)."""
 
-import json
 
 import numpy as np
 import pytest
 from safetensors.numpy import save_file
-from test_image import _write_diffusers_fixture
 
 from localai_tpu.image.loader import load_diffusers_pipeline, load_unet
 from localai_tpu.image.lora import (
@@ -15,6 +13,7 @@ from localai_tpu.image.lora import (
     read_lora_file,
     unet_sites,
 )
+from test_image import _write_diffusers_fixture
 
 
 def _kohya_lora(path, modules, r=4, alpha=2.0, seed=0):

@@ -7,7 +7,6 @@ and long prompts route through sp_prefill_forward into the slot cache.
 """
 
 import jax
-import numpy as np
 import pytest
 from jax.sharding import NamedSharding, PartitionSpec as P
 

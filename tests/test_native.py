@@ -3,7 +3,6 @@ parity with the Python fallback, graceful degradation without a
 compiler."""
 
 import numpy as np
-import pytest
 
 from localai_tpu.functions import constraint as cst
 from localai_tpu.functions.constraint import TokenTrie, cached_dfa

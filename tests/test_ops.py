@@ -4,16 +4,15 @@ Run in interpreter mode on CPU (real Mosaic compilation happens on TPU);
 numerical agreement with models.llama._grouped_attn is the contract.
 """
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
 
+from localai_tpu.engine import kvcache as kvc
+from localai_tpu.engine.runner import ModelRunner
 from localai_tpu.models import llama as mdl
 from localai_tpu.models.llama import LlamaConfig
 from localai_tpu.models.registry import resolve_model
-from localai_tpu.engine import kvcache as kvc
-from localai_tpu.engine.runner import ModelRunner
 from localai_tpu.ops import attention as ops_attn
 
 

@@ -4,7 +4,6 @@ weak #6: the axis finally has a consumer, verified against the
 single-device engine."""
 
 import jax
-import numpy as np
 import pytest
 
 from localai_tpu.engine.runner import ModelRunner

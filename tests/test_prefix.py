@@ -2,7 +2,6 @@
 /root/reference/backend/cpp/llama/grpc-server.cpp:67-74 + slot
 cache_tokens; prompt-cache config backend_config.go:120-122)."""
 
-import numpy as np
 import pytest
 
 from localai_tpu.engine.runner import ModelRunner

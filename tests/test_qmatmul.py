@@ -3,11 +3,10 @@ w8 path and engine-level equivalence under the env opt-in."""
 
 import os
 
-import numpy as np
-import pytest
-
 import jax
 import jax.numpy as jnp
+import numpy as np
+import pytest
 
 from localai_tpu.models import quant as qnt
 from localai_tpu.ops import qmatmul

@@ -17,7 +17,6 @@ import pytest
 from localai_tpu.models.reranker import (
     BertConfig,
     CrossEncoder,
-    init_params,
     forward,
     resolve_reranker,
 )

@@ -5,7 +5,7 @@ strategy SURVEY.md §4 calls for, absent in the reference)."""
 import jax
 import numpy as np
 import pytest
-from jax.sharding import NamedSharding, PartitionSpec as P
+from jax.sharding import PartitionSpec as P
 
 from localai_tpu.engine.runner import ModelRunner
 from localai_tpu.engine.scheduler import GenRequest, Scheduler

@@ -84,8 +84,6 @@ def test_spec_positions_and_state_advance(small, tiny):
 
 def test_spec_int8_kv(small, tiny):
     """Spec verify writes through the scaled-int8 KV path."""
-    from localai_tpu.models.quant import quantize_params
-
     spec = SpecDecoder(
         _mk(small, kv_dtype="int8"),
         _mk(tiny, kv_dtype="int8"),

@@ -14,12 +14,7 @@ import numpy as np
 import pytest
 
 from localai_tpu.models.registry import resolve_model
-from localai_tpu.models.vision import (
-    VisionConfig,
-    VisionTower,
-    init_params,
-    resolve_vision_tower,
-)
+from localai_tpu.models.vision import resolve_vision_tower
 
 
 def _png_bytes(seed: int = 0, size: int = 40) -> bytes:
@@ -442,10 +437,7 @@ def test_video_part_expands_to_frame_embeddings(small, tower):
     """A video_url part renders a [vid-N] placeholder whose span injects
     every sampled frame's patch embeddings (parity: vLLM backend video
     multimodal path)."""
-    from localai_tpu.api.inference import (
-        build_gen_request,
-        prepare_multimodal,
-    )
+    from localai_tpu.api.inference import prepare_multimodal
     from localai_tpu.api.schema import OpenAIRequest
     from localai_tpu.config.model_config import ModelConfig
 

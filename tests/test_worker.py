@@ -14,7 +14,7 @@ import pytest
 
 from localai_tpu.worker import WorkerClient, WorkerPool, Watchdog
 from localai_tpu.worker import backend_pb2 as pb
-from localai_tpu.worker.server import BackendServicer, serve_worker
+from localai_tpu.worker.server import serve_worker
 
 TINY_YAML = """\
 name: tiny

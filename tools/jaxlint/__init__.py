@@ -1,0 +1,27 @@
+"""jaxlint — JAX-aware static analysis for the localai_tpu serving stack.
+
+Generic linters see Python; they don't see XLA. The failure modes that
+actually take this stack down are JAX-shaped: a host sync hidden in a
+decode loop, a ``jax.jit`` that re-traces per call, Python control flow
+branching on a tracer, a PRNG key consumed twice, or a ``jax.config``
+option that the installed JAX no longer accepts (the bug that once made
+the whole test suite fail at conftest import). jaxlint is a small
+AST-rule engine that encodes those failure modes as checkable rules.
+
+Usage::
+
+    python -m tools.jaxlint localai_tpu tests
+    python -m tools.jaxlint --list-rules
+    python -m tools.jaxlint --write-baseline localai_tpu tests
+
+Findings print as ``file:line:col: rule-id message``. Suppress a single
+line with ``# jaxlint: disable=<rule-id>`` (comma-separated ids, or
+``all``). Pre-existing findings live in ``tools/jaxlint/baseline.json``
+so CI only fails on NEW findings; regenerate it with
+``--write-baseline`` after an intentional change.
+"""
+
+from tools.jaxlint.core import Baseline, Finding, lint_paths
+from tools.jaxlint.rules import ALL_RULES
+
+__all__ = ["ALL_RULES", "Baseline", "Finding", "lint_paths"]

@@ -1,0 +1,81 @@
+"""CLI: ``python -m tools.jaxlint [paths...]``.
+
+Exit status: 0 when every finding is covered by the baseline (or there
+are none), 1 when new findings (or parse errors) exist. Run with
+``--write-baseline`` after an intentional change to re-accept the
+current findings.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+from tools.jaxlint.core import Baseline, lint_paths
+from tools.jaxlint.rules import ALL_RULES
+
+DEFAULT_BASELINE = Path("tools/jaxlint/baseline.json")
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.jaxlint",
+        description="JAX-aware static analysis (host syncs, re-jits, "
+                    "tracer control flow, PRNG reuse, config drift).",
+    )
+    ap.add_argument("paths", nargs="*", default=["."],
+                    help="files or directories to lint (default: .)")
+    ap.add_argument("--baseline", type=Path, default=None,
+                    help=f"baseline file (default: {DEFAULT_BASELINE} "
+                         f"when it exists)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report every finding, ignoring any baseline")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="accept current findings into the baseline file")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print rule ids and one-line docs, then exit")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rule in ALL_RULES:
+            print(f"{rule.id}: {rule.doc}")
+        return 0
+
+    findings = lint_paths(args.paths)
+
+    baseline_path = args.baseline or DEFAULT_BASELINE
+    if args.write_baseline:
+        parse_errors = [f for f in findings if f.rule == "parse-error"]
+        baseline_path.parent.mkdir(parents=True, exist_ok=True)
+        Baseline.from_findings(findings).write(baseline_path)
+        print(f"jaxlint: wrote {len(findings) - len(parse_errors)} "
+              f"finding(s) to {baseline_path}")
+        for f in parse_errors:
+            print(f.render())
+        if parse_errors:
+            print("jaxlint: parse errors cannot be baselined — fix them",
+                  file=sys.stderr)
+            return 1
+        return 0
+
+    stale: list[tuple] = []
+    if not args.no_baseline and baseline_path.is_file():
+        new, stale = Baseline.load(baseline_path).filter(findings)
+        suppressed = len(findings) - len(new)
+    else:
+        new, suppressed = findings, 0
+
+    for f in new:
+        print(f.render())
+    if stale:
+        print(f"jaxlint: note: {len(stale)} stale baseline entr"
+              f"{'y' if len(stale) == 1 else 'ies'} (fixed findings) — "
+              f"consider --write-baseline", file=sys.stderr)
+    tail = f" ({suppressed} baselined)" if suppressed else ""
+    print(f"jaxlint: {len(new)} finding(s){tail}", file=sys.stderr)
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,256 @@
+"""jaxlint engine: AST module model, findings, suppressions, baseline.
+
+Rules are plain objects with an ``id``, a ``doc`` string, and a
+``check(module)`` generator yielding :class:`Finding`. The engine owns
+everything rule-agnostic: file discovery, parsing, the parent map,
+inline-suppression filtering, and the baseline protocol.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import re
+from pathlib import Path, PurePosixPath
+from typing import Iterable, Iterator, Optional, Protocol
+
+SUPPRESS_RE = re.compile(r"#\s*jaxlint:\s*disable=([A-Za-z0-9_\-, ]+)")
+
+_SCOPES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+_LOOPS = (ast.For, ast.AsyncFor, ast.While)
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One rule violation, addressable as ``file:line:col: rule-id``."""
+
+    file: str       # posix-style path as scanned (baseline key component)
+    line: int       # 1-based
+    col: int        # 0-based
+    rule: str
+    message: str
+    text: str       # stripped source line (line-number-stable baseline key)
+
+    def render(self) -> str:
+        return f"{self.file}:{self.line}:{self.col}: {self.rule} {self.message}"
+
+
+class Rule(Protocol):
+    id: str
+    doc: str
+
+    def check(self, module: "Module") -> Iterator[Finding]: ...
+
+
+class Module:
+    """Parsed file + the shared indexes every rule needs."""
+
+    def __init__(self, path: str, source: str):
+        self.path = normalize_path(path)
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=path)
+        self.parents: dict[ast.AST, ast.AST] = {}
+        for node in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(node):
+                self.parents[child] = node
+        # alias map for names imported from jax: {"jit": "jax.jit", ...}
+        self.jax_aliases: dict[str, str] = {}
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.ImportFrom) and node.module and (
+                node.module == "jax" or node.module.startswith("jax.")
+            ):
+                for a in node.names:
+                    self.jax_aliases[a.asname or a.name] = (
+                        f"{node.module}.{a.name}"
+                    )
+            elif isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.name == "jax" or a.name.startswith("jax."):
+                        self.jax_aliases[a.asname or a.name] = a.name
+
+    # -- navigation helpers ----------------------------------------------
+
+    def ancestors(self, node: ast.AST) -> Iterator[ast.AST]:
+        cur = self.parents.get(node)
+        while cur is not None:
+            yield cur
+            cur = self.parents.get(cur)
+
+    def enclosing_function(self, node: ast.AST) -> Optional[ast.AST]:
+        for anc in self.ancestors(node):
+            if isinstance(anc, _SCOPES):
+                return anc
+        return None
+
+    def in_loop(self, node: ast.AST) -> bool:
+        """Inside a for/while, not crossing a function boundary (a nested
+        def's hotness is judged by its own name, not its definition site)."""
+        for anc in self.ancestors(node):
+            if isinstance(anc, _SCOPES):
+                return False
+            if isinstance(anc, _LOOPS):
+                return True
+        return False
+
+    def dotted(self, node: ast.AST) -> Optional[str]:
+        """'jax.random.normal'-style name for a Name/Attribute chain,
+        with jax import aliases resolved at the root (``from jax import
+        jit`` makes bare ``jit`` resolve to ``jax.jit``)."""
+        parts: list[str] = []
+        cur = node
+        while isinstance(cur, ast.Attribute):
+            parts.append(cur.attr)
+            cur = cur.value
+        if not isinstance(cur, ast.Name):
+            return None
+        root = self.jax_aliases.get(cur.id, cur.id)
+        parts.append(root)
+        return ".".join(reversed(parts))
+
+    def line_text(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1].strip()
+        return ""
+
+    def finding(self, node: ast.AST, rule: str, message: str) -> Finding:
+        return Finding(
+            file=self.path,
+            line=node.lineno,
+            col=node.col_offset,
+            rule=rule,
+            message=message,
+            text=self.line_text(node.lineno),
+        )
+
+    # -- suppressions ----------------------------------------------------
+
+    def suppressed(self, finding: Finding) -> bool:
+        m = SUPPRESS_RE.search(self.line_text(finding.line))
+        if not m:
+            return False
+        ids = {part.strip() for part in m.group(1).split(",")}
+        return "all" in ids or finding.rule in ids
+
+
+class Baseline:
+    """Checked-in record of accepted pre-existing findings.
+
+    Entries are keyed on (file, rule, stripped source text) with a
+    count, NOT on line numbers — unrelated edits that shift lines don't
+    invalidate the baseline, while any change to a flagged line itself
+    surfaces the finding again.
+    """
+
+    def __init__(self, entries: Optional[dict[tuple, int]] = None):
+        self.entries = entries or {}
+
+    @staticmethod
+    def key(f: Finding) -> tuple:
+        return (f.file, f.rule, f.text)
+
+    @classmethod
+    def load(cls, path: Path) -> "Baseline":
+        data = json.loads(Path(path).read_text())
+        entries: dict[tuple, int] = {}
+        for e in data.get("entries", []):
+            k = (e["file"], e["rule"], e["text"])
+            entries[k] = entries.get(k, 0) + int(e.get("count", 1))
+        return cls(entries)
+
+    @classmethod
+    def from_findings(cls, findings: Iterable[Finding]) -> "Baseline":
+        entries: dict[tuple, int] = {}
+        for f in findings:
+            if f.rule == "parse-error":
+                continue  # never accept an unscannable file as baseline
+            k = cls.key(f)
+            entries[k] = entries.get(k, 0) + 1
+        return cls(entries)
+
+    def write(self, path: Path) -> None:
+        entries = [
+            {"file": f, "rule": r, "text": t, "count": c}
+            for (f, r, t), c in sorted(self.entries.items())
+        ]
+        Path(path).write_text(
+            json.dumps({"version": 1, "entries": entries}, indent=2)
+            + "\n"
+        )
+
+    def filter(
+        self, findings: list[Finding]
+    ) -> tuple[list[Finding], list[tuple]]:
+        """(new findings not covered by the baseline, stale entries)."""
+        budget = dict(self.entries)
+        new: list[Finding] = []
+        for f in findings:
+            k = self.key(f)
+            # parse errors are never absorbable: a file the linter can't
+            # scan must fail the run even if an old baseline has the key
+            if f.rule != "parse-error" and budget.get(k, 0) > 0:
+                budget[k] -= 1
+            else:
+                new.append(f)
+        stale = [k for k, c in budget.items() if c > 0]
+        return new, stale
+
+
+def normalize_path(path: str) -> str:
+    """Posix path relative to cwd when possible — so findings (and the
+    baseline keys derived from them) are stable whether the CLI was
+    invoked with relative or absolute paths."""
+    p = Path(path)
+    try:
+        p = p.resolve().relative_to(Path.cwd())
+    except ValueError:
+        pass  # outside cwd: keep as given
+    return str(PurePosixPath(p.as_posix()))
+
+
+def iter_py_files(paths: Iterable[str]) -> Iterator[Path]:
+    for p in paths:
+        # resolve '.'/'..' segments up front so the hidden-dir filter
+        # below never discards a legitimate parent-relative target
+        path = Path(p).resolve()
+        if path.is_dir():
+            for f in sorted(path.rglob("*.py")):
+                if any(part.startswith(".") or part == "__pycache__"
+                       for part in f.parts[len(path.parts):]):
+                    continue
+                yield f
+        elif path.suffix == ".py":
+            yield path
+
+
+def lint_file(path: Path, rules: Iterable[Rule]) -> list[Finding]:
+    try:
+        source = path.read_text()
+        module = Module(str(path), source)
+    except (SyntaxError, UnicodeDecodeError) as e:
+        line = getattr(e, "lineno", 1) or 1
+        return [Finding(
+            file=normalize_path(str(path)), line=line, col=0,
+            rule="parse-error", message=f"could not parse: {e}", text="",
+        )]
+    out: list[Finding] = []
+    for rule in rules:
+        for f in rule.check(module):
+            if not module.suppressed(f):
+                out.append(f)
+    out.sort(key=lambda f: (f.file, f.line, f.col, f.rule))
+    return out
+
+
+def lint_paths(
+    paths: Iterable[str], rules: Optional[Iterable[Rule]] = None
+) -> list[Finding]:
+    if rules is None:
+        from tools.jaxlint.rules import ALL_RULES
+        rules = ALL_RULES
+    rules = list(rules)
+    findings: list[Finding] = []
+    for f in iter_py_files(paths):
+        findings.extend(lint_file(f, rules))
+    return findings

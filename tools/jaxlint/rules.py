@@ -1,0 +1,513 @@
+"""The JAX-specific rule set.
+
+Each rule is a small class with ``id``, ``doc`` and a ``check(module)``
+generator. Rules are deliberately heuristic — they optimize for the
+failure modes this serving stack has actually hit, and anything
+intentional is one inline ``# jaxlint: disable=<rule>`` away.
+"""
+
+from __future__ import annotations
+
+import ast
+import difflib
+import re
+from typing import Iterator, Optional
+
+from tools.jaxlint.core import _LOOPS, _SCOPES, Finding, Module
+
+
+# ---------------------------------------------------------------------------
+# host-sync-in-hot-path
+# ---------------------------------------------------------------------------
+
+class HostSyncInHotPath:
+    """Device→host synchronization inside the serving hot path.
+
+    Every ``.item()``, ``int()``/``float()`` on an array,
+    ``np.asarray``/``np.array`` on a device value, or
+    ``jax.device_get`` blocks the host until the device queue drains —
+    inside a decode/step loop that de-pipelines the whole engine. Hot
+    scope is any loop body or step/decode/drain/consume/run-named
+    function in the engine and worker-serving modules, plus any direct
+    host materialization of the device-resident serving state
+    (``self.state`` / ``self.kv``) anywhere in those files.
+    """
+
+    id = "host-sync-in-hot-path"
+    doc = ("device→host sync (.item(), int()/float() on arrays, "
+           "np.asarray, jax.device_get) inside an engine decode/step "
+           "hot path")
+
+    HOT_FILES = (
+        re.compile(r"(^|/)localai_tpu/engine/[^/]+\.py$"),
+        re.compile(r"(^|/)localai_tpu/worker/serving\.py$"),
+    )
+    HOT_FUNC = re.compile(r"(^|_)(step|decode|drain|consume|run|spec)(_|$|\d)")
+    NP_SYNCS = {"np.asarray", "np.array", "numpy.asarray", "numpy.array"}
+    # attribute chains rooting in device-resident serving state
+    STATE_ROOT = re.compile(r"^self\.([A-Za-z_]+\.)?(state|kv)\b")
+
+    def check(self, module: Module) -> Iterator[Finding]:
+        if not any(p.search(module.path) for p in self.HOT_FILES):
+            return
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            hit = self._classify(module, node)
+            if hit is None:
+                continue
+            what, arg = hit
+            if self._hot_scope(module, node) or self._on_state(module, arg):
+                yield module.finding(
+                    node, self.id,
+                    f"{what} forces a device→host sync in a decode/step "
+                    f"hot path; keep the value on device or use the "
+                    f"async/batched host APIs",
+                )
+
+    def _classify(self, module, node):
+        """(description, sync-argument-or-None) for sync-shaped calls."""
+        func = node.func
+        if (isinstance(func, ast.Attribute) and func.attr == "item"
+                and not node.args and not node.keywords):
+            return f"`{ast.unparse(node)}`", func.value
+        name = module.dotted(func)
+        if name in self.NP_SYNCS or name == "jax.device_get":
+            return (f"`{name}(...)`",
+                    node.args[0] if node.args else None)
+        if (isinstance(func, ast.Name) and func.id in ("int", "float")
+                and len(node.args) == 1 and not node.keywords
+                and not isinstance(node.args[0], ast.Constant)):
+            arg = node.args[0]
+            if self._arraylike(module, node, arg):
+                return f"`{func.id}()` on an array", arg
+            return None
+        return None
+
+    def _hot_scope(self, module, node) -> bool:
+        fn = module.enclosing_function(node)
+        fn_name = getattr(fn, "name", "")
+        return bool(self.HOT_FUNC.search(fn_name)) or module.in_loop(node)
+
+    def _on_state(self, module, arg) -> bool:
+        if arg is None:
+            return False
+        try:
+            return bool(self.STATE_ROOT.match(ast.unparse(arg)))
+        except Exception:
+            return False
+
+    def _arraylike(self, module, node, arg) -> bool:
+        """Heuristic: the int()/float() argument is device-resident —
+        rooted in serving state, textually a jax/jnp expression, or a
+        local assigned from one inside the same function."""
+        src = ast.unparse(arg)
+        if self.STATE_ROOT.match(src) or re.search(r"\b(jnp|jax)\.", src):
+            return True
+        root = arg
+        while isinstance(root, (ast.Subscript, ast.Attribute)):
+            root = root.value
+        if not isinstance(root, ast.Name):
+            return False
+        fn = module.enclosing_function(node)
+        if fn is None or isinstance(fn, ast.Lambda):
+            return False
+        for n in ast.walk(fn):
+            if isinstance(n, ast.Assign) and any(
+                isinstance(t, ast.Name) and t.id == root.id
+                or isinstance(t, ast.Tuple) and any(
+                    isinstance(e, ast.Name) and e.id == root.id
+                    for e in t.elts)
+                for t in n.targets
+            ):
+                vsrc = ast.unparse(n.value)
+                if (re.search(r"\b(jnp|jax)\.", vsrc)
+                        or self.STATE_ROOT.match(vsrc)):
+                    return True
+        return False
+
+
+# ---------------------------------------------------------------------------
+# jit-in-loop
+# ---------------------------------------------------------------------------
+
+class JitInLoop:
+    """``jax.jit``/``pjit`` invoked per iteration or per call.
+
+    ``jax.jit`` returns a NEW compiled-function wrapper whose cache dies
+    with it; constructing one inside a loop (or immediately invoking it,
+    ``jax.jit(f)(x)``) re-traces and re-compiles on every pass instead
+    of once. Hoist the ``jit`` to definition/init time.
+    """
+
+    id = "jit-in-loop"
+    doc = ("jax.jit/pjit constructed inside a loop or immediately "
+           "invoked — a fresh compile cache per call")
+
+    JIT_NAMES = {"jax.jit", "jax.pjit", "jax.experimental.pjit.pjit"}
+
+    def check(self, module: Module) -> Iterator[Finding]:
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = module.dotted(node.func)
+            if name not in self.JIT_NAMES:
+                continue
+            parent = module.parents.get(node)
+            if isinstance(parent, ast.Call) and parent.func is node:
+                yield module.finding(
+                    node, self.id,
+                    f"`{name}(...)` is immediately invoked — the "
+                    f"compiled function (and its cache) is discarded "
+                    f"after one call; hoist the jit out",
+                )
+            elif module.in_loop(node):
+                yield module.finding(
+                    node, self.id,
+                    f"`{name}(...)` inside a loop builds a fresh "
+                    f"compile cache every iteration; jit once outside "
+                    f"the loop",
+                )
+
+
+# ---------------------------------------------------------------------------
+# tracer-control-flow
+# ---------------------------------------------------------------------------
+
+class TracerControlFlow:
+    """Python ``if``/``while`` on traced array values inside ``@jit``.
+
+    Under trace, array-valued conditions raise ConcretizationTypeError
+    at best and silently bake in one branch at worst. Shape/dtype/ndim
+    checks, ``is None`` tests, ``isinstance``/``len`` and
+    ``static_argnames`` parameters are static and fine; anything else
+    needs ``jnp.where``/``lax.cond``/``lax.while_loop``.
+    """
+
+    id = "tracer-control-flow"
+    doc = ("Python if/while branching on a traced array value inside a "
+           "@jit-decorated function")
+
+    STATIC_ATTRS = {"shape", "ndim", "dtype", "size"}
+    STATIC_CALLS = {"isinstance", "len"}
+
+    def check(self, module: Module) -> Iterator[Finding]:
+        for fn in ast.walk(module.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            statics = self._jit_statics(module, fn)
+            if statics is None:
+                continue
+            args = fn.args
+            params = [a.arg for a in (
+                args.posonlyargs + args.args + args.kwonlyargs)]
+            traced = {p for p in params if p not in statics
+                      and p not in ("self", "cls")}
+            if not traced:
+                continue
+            for node in ast.walk(fn):
+                if isinstance(node, (ast.If, ast.While)):
+                    bad = self._traced_name_in_test(module, node.test, traced)
+                    if bad:
+                        kind = "if" if isinstance(node, ast.If) else "while"
+                        yield module.finding(
+                            node, self.id,
+                            f"`{kind}` branches on traced argument "
+                            f"'{bad}' inside a @jit function; use "
+                            f"jnp.where / lax.cond / lax.while_loop (or "
+                            f"mark it static)",
+                        )
+
+    def _jit_statics(self, module, fn) -> Optional[set]:
+        """static_argnames set when decorated with jit, else None."""
+        for dec in fn.decorator_list:
+            target = dec.func if isinstance(dec, ast.Call) else dec
+            name = module.dotted(target)
+            if name in JitInLoop.JIT_NAMES:
+                return self._statics_from_call(
+                    dec if isinstance(dec, ast.Call) else None)
+            if (isinstance(dec, ast.Call)
+                    and name in ("partial", "functools.partial")
+                    and dec.args
+                    and module.dotted(dec.args[0]) in JitInLoop.JIT_NAMES):
+                return self._statics_from_call(dec)
+        return None
+
+    def _statics_from_call(self, call: Optional[ast.Call]) -> set:
+        statics: set = set()
+        if call is None:
+            return statics
+        for kw in call.keywords:
+            if kw.arg == "static_argnames":
+                for n in ast.walk(kw.value):
+                    if isinstance(n, ast.Constant) and isinstance(n.value, str):
+                        statics.add(n.value)
+        return statics
+
+    def _traced_name_in_test(self, module, test, traced) -> Optional[str]:
+        for n in ast.walk(test):
+            if not (isinstance(n, ast.Name) and n.id in traced):
+                continue
+            parent = module.parents.get(n)
+            if (isinstance(parent, ast.Attribute)
+                    and parent.attr in self.STATIC_ATTRS):
+                continue
+            if (isinstance(parent, ast.Call)
+                    and module.dotted(parent.func) in self.STATIC_CALLS):
+                continue
+            if isinstance(parent, ast.Compare) and all(
+                isinstance(op, (ast.Is, ast.IsNot)) for op in parent.ops
+            ):
+                continue
+            return n.id
+        return None
+
+
+# ---------------------------------------------------------------------------
+# rng-key-reuse
+# ---------------------------------------------------------------------------
+
+class RngKeyReuse:
+    """The same PRNG key consumed by multiple ``jax.random.*`` calls.
+
+    JAX keys are consume-once: feeding one key to two sampling calls
+    (or to a sample after a ``split``) yields correlated randomness.
+    Every consumption must be followed by ``split`` before the next.
+    """
+
+    id = "rng-key-reuse"
+    doc = ("a PRNG key fed to multiple jax.random.* calls without an "
+           "intervening split/reassignment")
+
+    NON_CONSUMING = {"key", "PRNGKey", "key_data", "wrap_key_data",
+                     "key_impl", "default_prng_impl"}
+
+    def check(self, module: Module) -> Iterator[Finding]:
+        scopes = [module.tree] + [
+            n for n in ast.walk(module.tree) if isinstance(n, _SCOPES)
+        ]
+        for scope in scopes:
+            yield from self._check_scope(module, scope)
+
+    # -- per-scope linear analysis ---------------------------------------
+
+    def _check_scope(self, module, scope) -> Iterator[Finding]:
+        events: list[tuple] = []   # ("use"|"def", name, node)
+        if isinstance(scope, ast.Lambda):
+            self._uses(module, scope, scope.body, events)
+        else:
+            self._scan_stmts(module, scope, scope.body, events)
+
+        consumed: dict[str, ast.AST] = {}
+        defs_in_scope = [
+            (name, node) for kind, name, node in events if kind == "def"
+        ]
+        findings = []
+        for kind, name, node in events:
+            if kind == "def":
+                consumed.pop(name, None)
+                continue
+            first = consumed.get(name)
+            if first is not None:
+                findings.append(module.finding(
+                    node, self.id,
+                    f"PRNG key '{name}' was already consumed at line "
+                    f"{first.lineno}; split it and use a fresh subkey",
+                ))
+            else:
+                consumed[name] = node
+                loop = self._innermost_loop(module, node, scope)
+                if loop is not None and not self._defined_in(
+                        defs_in_scope, name, loop):
+                    findings.append(module.finding(
+                        node, self.id,
+                        f"PRNG key '{name}' is consumed every loop "
+                        f"iteration but never re-split inside the loop",
+                    ))
+        yield from findings
+
+    def _innermost_loop(self, module, node, scope):
+        for anc in module.ancestors(node):
+            if anc is scope or isinstance(anc, _SCOPES):
+                return None
+            if isinstance(anc, _LOOPS):
+                return anc
+        return None
+
+    @staticmethod
+    def _defined_in(defs, name, loop) -> bool:
+        inside = {id(n) for n in ast.walk(loop)}
+        return any(n == name and id(dnode) in inside for n, dnode in defs)
+
+    def _scan_stmts(self, module, scope, stmts, events) -> None:
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue  # nested scopes are analyzed separately
+            if isinstance(stmt, ast.Assign):
+                self._uses(module, scope, stmt.value, events)
+                for t in stmt.targets:
+                    self._defs(t, events)
+            elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+                if stmt.value is not None:
+                    self._uses(module, scope, stmt.value, events)
+                self._defs(stmt.target, events)
+            elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+                self._uses(module, scope, stmt.iter, events)
+                self._defs(stmt.target, events)
+                self._scan_stmts(module, scope, stmt.body + stmt.orelse,
+                                 events)
+            elif isinstance(stmt, ast.While):
+                self._uses(module, scope, stmt.test, events)
+                self._scan_stmts(module, scope, stmt.body + stmt.orelse,
+                                 events)
+            elif isinstance(stmt, ast.If):
+                self._uses(module, scope, stmt.test, events)
+                self._scan_stmts(module, scope, stmt.body + stmt.orelse,
+                                 events)
+            elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+                for item in stmt.items:
+                    self._uses(module, scope, item.context_expr, events)
+                    if item.optional_vars is not None:
+                        self._defs(item.optional_vars, events)
+                self._scan_stmts(module, scope, stmt.body, events)
+            elif isinstance(stmt, ast.Try):
+                self._scan_stmts(module, scope, stmt.body, events)
+                for h in stmt.handlers:
+                    self._scan_stmts(module, scope, h.body, events)
+                self._scan_stmts(module, scope, stmt.orelse + stmt.finalbody,
+                                 events)
+            else:
+                for v in ast.iter_child_nodes(stmt):
+                    if isinstance(v, ast.expr):
+                        self._uses(module, scope, v, events)
+
+    def _uses(self, module, scope, expr, events) -> None:
+        for n in ast.walk(expr):
+            if isinstance(n, ast.NamedExpr):
+                self._defs(n.target, events)
+            if not isinstance(n, ast.Call):
+                continue
+            name = module.dotted(n.func) or ""
+            if not name.startswith("jax.random."):
+                continue
+            fn = name.rsplit(".", 1)[1]
+            if fn in self.NON_CONSUMING or not n.args:
+                continue
+            key = n.args[0]
+            if isinstance(key, ast.Name):
+                # a key fed from inside a nested lambda belongs to that
+                # lambda's scope, not this one
+                if module.enclosing_function(n) is not self._scope_fn(scope):
+                    continue
+                events.append(("use", key.id, n))
+
+    @staticmethod
+    def _scope_fn(scope):
+        return scope if isinstance(scope, _SCOPES) else None
+
+    def _defs(self, target, events) -> None:
+        for n in ast.walk(target):
+            if isinstance(n, ast.Name):
+                events.append(("def", n.id, n))
+
+
+# ---------------------------------------------------------------------------
+# unknown-jax-config
+# ---------------------------------------------------------------------------
+
+class UnknownJaxConfig:
+    """``jax.config.update`` with an option the installed JAX rejects.
+
+    Config options come and go between JAX releases
+    (``jax_num_cpu_devices`` once killed this repo's whole test suite
+    at conftest import). Option names are validated against the JAX
+    actually installed; version-dependent options are fine when guarded
+    by a ``hasattr(jax.config, "<option>")`` capability check.
+    """
+
+    id = "unknown-jax-config"
+    doc = ("jax.config.update(name, ...) with an option name the "
+           "installed JAX does not recognize")
+
+    UPDATE_NAMES = {"jax.config.update", "jax.config.config.update"}
+
+    def __init__(self):
+        self._valid: Optional[set] = None
+        self._probed = False
+
+    def valid_options(self) -> Optional[set]:
+        if not self._probed:
+            self._probed = True
+            try:
+                import jax
+
+                holders = getattr(jax.config, "_value_holders", None)
+                if holders:
+                    self._valid = set(holders)
+                else:
+                    self._valid = {
+                        n for n in dir(jax.config) if n.startswith("jax_")
+                    }
+            except Exception:
+                self._valid = None  # no JAX installed: rule inert
+        return self._valid
+
+    def check(self, module: Module) -> Iterator[Finding]:
+        valid = self.valid_options()
+        if not valid:
+            return
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if module.dotted(node.func) not in self.UPDATE_NAMES:
+                continue
+            if not (node.args and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)):
+                continue
+            name = node.args[0].value
+            if name in valid or self._capability_guarded(module, node, name):
+                continue
+            hint = ""
+            close = difflib.get_close_matches(name, valid, n=1)
+            if close:
+                hint = f" (did you mean '{close[0]}'?)"
+            yield module.finding(
+                node, self.id,
+                f"config option '{name}' is not recognized by the "
+                f"installed JAX{hint}; guard it with "
+                f"hasattr(jax.config, '{name}') or drop it",
+            )
+
+    def _capability_guarded(self, module, node, name) -> bool:
+        """True when an enclosing if-test probes for the option by name
+        (hasattr / membership) AND the update sits in the branch where
+        the probe succeeded — an update in the else of a hasattr check
+        runs exactly where the option is invalid."""
+        child = node
+        for anc in module.ancestors(node):
+            if isinstance(anc, ast.If):
+                try:
+                    src = ast.unparse(anc.test)
+                except Exception:
+                    child = anc
+                    continue
+                if name in src and ("hasattr" in src or " in " in src):
+                    in_body = any(
+                        child is n or any(child is d for d in ast.walk(n))
+                        for n in anc.body
+                    )
+                    negated = src.lstrip().startswith("not ")
+                    if in_body != negated:
+                        return True
+            child = anc
+        return False
+
+
+ALL_RULES = [
+    HostSyncInHotPath(),
+    JitInLoop(),
+    TracerControlFlow(),
+    RngKeyReuse(),
+    UnknownJaxConfig(),
+]
