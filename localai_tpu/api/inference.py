@@ -181,6 +181,18 @@ def correlation_id(request: Any) -> str:
         return ""
 
 
+def trace_id(request: Any) -> str:
+    """The trace id the obs middleware stamped on this HTTP request — the
+    engine's lifecycle spans group under the same id, so
+    /debug/timeline/{id} shows the HTTP span and every generation it
+    spawned together. Empty when the middleware isn't installed (direct
+    handler tests)."""
+    try:
+        return request.get("trace_id", "")
+    except (AttributeError, TypeError):
+        return ""
+
+
 def build_gen_request(
     sm: ServingModel,
     cfg: ModelConfig,
@@ -191,6 +203,7 @@ def build_gen_request(
     seed_offset: int = 0,
     mm_embeds: Any = None,
     correlation_id: str = "",
+    trace_id: str = "",
 ) -> GenRequest:
     p = cfg.parameters
     mm_flat = mm_pos = None
@@ -227,6 +240,7 @@ def build_gen_request(
         ignore_eos=req.ignore_eos,
         constraint=constraint,
         correlation_id=correlation_id or req.user or "",
+        trace_id=trace_id or correlation_id,
         stream=bool(req.stream),
         mm_embeds=mm_flat,
         mm_positions=mm_pos,

@@ -64,14 +64,14 @@ async def tokenize(request: web.Request) -> web.Response:
 
 
 async def metrics(request: web.Request) -> web.Response:
-    # refresh token/slot series from live engine state at scrape time
-    # (counters are monotone: scheduler totals only grow)
+    # refresh token/slot/engine series from live engine state at scrape
+    # time (counters are monotone: scheduler totals only grow; gauges are
+    # point-in-time) — the decode loop itself never touches the registry
+    from localai_tpu.obs.metrics import update_engine_gauges
+
     for name, m in _state(request).manager.metrics().items():
-        REGISTRY.tokens_prompt.set_total(m["total_prompt_tokens"], model=name)
-        REGISTRY.tokens_generated.set_total(
-            m["total_generated_tokens"], model=name
-        )
-        REGISTRY.active_slots.set(len(m["active_slots"]), model=name)
+        if isinstance(m, dict):
+            update_engine_gauges(name, m)
     return web.Response(
         text=REGISTRY.render(),
         content_type="text/plain",

@@ -1,133 +1,16 @@
-"""Minimal OpenMetrics/Prometheus registry.
+"""Compatibility shim: the metric registry moved to ``localai_tpu.obs``
+(the observability subsystem owns telemetry; the API layer only scrapes
+it). Import from ``localai_tpu.obs.metrics`` in new code."""
 
-Parity: the reference's OTel meter + Prometheus exporter with one
-``api_call`` histogram labeled by method/path
-(/root/reference/core/services/metrics.go:13-45, recorded by middleware
-app.go:117-122, scraped at GET /metrics routes/localai.go:45). No
-prometheus_client in this image, so the text exposition is hand-rolled —
-it is a stable, tiny format.
-"""
+from localai_tpu.obs.metrics import (  # noqa: F401
+    REGISTRY,
+    Counter,
+    Gauge,
+    Histogram,
+    Registry,
+    escape_label_value,
+    update_engine_gauges,
+)
 
-from __future__ import annotations
-
-import threading
-from typing import Iterable
-
-
-_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
-            30.0, 60.0)
-
-
-class Histogram:
-    def __init__(self, name: str, help_text: str,
-                 buckets: Iterable[float] = _BUCKETS):
-        self.name = name
-        self.help = help_text
-        self.buckets = tuple(sorted(buckets))
-        self._series: dict[tuple, list] = {}
-        self._lock = threading.Lock()
-
-    def observe(self, value: float, **labels: str) -> None:
-        key = tuple(sorted(labels.items()))
-        with self._lock:
-            s = self._series.get(key)
-            if s is None:
-                s = [[0] * (len(self.buckets) + 1), 0.0, 0]  # counts, sum, n
-                self._series[key] = s
-            counts, _, _ = s
-            for i, ub in enumerate(self.buckets):
-                if value <= ub:
-                    counts[i] += 1
-                    break
-            else:
-                counts[-1] += 1
-            s[1] += value
-            s[2] += 1
-
-    def render(self) -> str:
-        lines = [f"# HELP {self.name} {self.help}",
-                 f"# TYPE {self.name} histogram"]
-        with self._lock:
-            for key, (counts, total, n) in sorted(self._series.items()):
-                base = ",".join(f'{k}="{v}"' for k, v in key)
-                cum = 0
-                for i, ub in enumerate(self.buckets):
-                    cum += counts[i]
-                    lbl = f"{base},le=\"{ub}\"" if base else f'le="{ub}"'
-                    lines.append(f"{self.name}_bucket{{{lbl}}} {cum}")
-                cum += counts[-1]
-                lbl = f"{base},le=\"+Inf\"" if base else 'le="+Inf"'
-                lines.append(f"{self.name}_bucket{{{lbl}}} {cum}")
-                suffix = f"{{{base}}}" if base else ""
-                lines.append(f"{self.name}_sum{suffix} {total}")
-                lines.append(f"{self.name}_count{suffix} {n}")
-        return "\n".join(lines)
-
-
-class Counter:
-    def __init__(self, name: str, help_text: str):
-        self.name = name
-        self.help = help_text
-        self._series: dict[tuple, float] = {}
-        self._lock = threading.Lock()
-
-    def inc(self, value: float = 1.0, **labels: str) -> None:
-        key = tuple(sorted(labels.items()))
-        with self._lock:
-            self._series[key] = self._series.get(key, 0.0) + value
-
-    def set_total(self, value: float, **labels: str) -> None:
-        """Sync the series to an externally tracked monotone total."""
-        key = tuple(sorted(labels.items()))
-        with self._lock:
-            self._series[key] = max(self._series.get(key, 0.0), value)
-
-    def render(self) -> str:
-        lines = [f"# HELP {self.name} {self.help}",
-                 f"# TYPE {self.name} counter"]
-        with self._lock:
-            for key, val in sorted(self._series.items()):
-                base = ",".join(f'{k}="{v}"' for k, v in key)
-                suffix = f"{{{base}}}" if base else ""
-                lines.append(f"{self.name}{suffix} {val}")
-        return "\n".join(lines)
-
-
-class Gauge(Counter):
-    def set(self, value: float, **labels: str) -> None:
-        key = tuple(sorted(labels.items()))
-        with self._lock:
-            self._series[key] = value
-
-    def render(self) -> str:
-        return super().render().replace(" counter", " gauge", 1)
-
-
-class Registry:
-    """The process-wide metric set."""
-
-    def __init__(self) -> None:
-        self.api_call = Histogram(
-            "localai_api_call_seconds", "API call duration by method/path"
-        )
-        self.tokens_generated = Counter(
-            "localai_tokens_generated_total", "Completion tokens emitted"
-        )
-        self.tokens_prompt = Counter(
-            "localai_prompt_tokens_total", "Prompt tokens processed"
-        )
-        self.active_slots = Gauge(
-            "localai_active_slots", "Occupied decode slots per model"
-        )
-
-    def render(self) -> str:
-        parts = [
-            self.api_call.render(),
-            self.tokens_generated.render(),
-            self.tokens_prompt.render(),
-            self.active_slots.render(),
-        ]
-        return "\n".join(parts) + "\n"
-
-
-REGISTRY = Registry()
+__all__ = ["REGISTRY", "Counter", "Gauge", "Histogram", "Registry",
+           "escape_label_value", "update_engine_gauges"]

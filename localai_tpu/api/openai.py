@@ -19,6 +19,7 @@ from localai_tpu.api.streams import (
     SSE_DONE,
     SSE_HEADERS,
     aiter_handle,
+    mark_first_write,
     sse_event,
 )
 from localai_tpu.config.model_config import Usecase
@@ -164,13 +165,16 @@ async def chat(request: web.Request) -> web.StreamResponse:
             grammar_active=tctx is not None and tctx.constraint is not None,
         )
     rid = sc.new_id("chatcmpl")
-    # trace id: client header, else the request id (parity: chat.go:164-169)
+    # correlation id: client header, else the request id (chat.go:164-169);
+    # trace id: the obs middleware's, so engine spans group under the HTTP
+    # span at /debug/timeline/{trace_id}
     cid = inf.correlation_id(request) or rid
+    tid = inf.trace_id(request) or cid
 
     constraint = tctx.constraint if tctx else rf_constraint
     gr = inf.build_gen_request(
         sm, cfg, req, prompt, constraint=constraint, mm_embeds=mm_embeds,
-        correlation_id=cid,
+        correlation_id=cid, trace_id=tid,
     )
 
     async def extra_choice_request(i: int):
@@ -185,7 +189,7 @@ async def chat(request: web.Request) -> web.StreamResponse:
                 request, inf.response_format_constraint, sm, req)
         return inf.build_gen_request(
             sm, cfg, req, prompt, constraint=c, seed_offset=i,
-            mm_embeds=mm_embeds, correlation_id=cid,
+            mm_embeds=mm_embeds, correlation_id=cid, trace_id=tid,
         )
 
     if req.stream:
@@ -232,14 +236,26 @@ async def chat(request: web.Request) -> web.StreamResponse:
     ), headers={"X-Correlation-ID": cid})
 
 
+def _sse_headers(request, cid: str) -> dict:
+    """SSE headers + tracing echo. Streaming responses send headers at
+    prepare(), before the outer trace middleware could add X-Trace-ID —
+    so the echo must be baked in here or a generated trace id would be
+    undiscoverable for exactly the latency-sensitive streaming case."""
+    headers = dict(SSE_HEADERS)
+    if cid:
+        headers["X-Correlation-ID"] = cid
+    tid = inf.trace_id(request)
+    if tid:
+        headers["X-Trace-ID"] = tid
+    return headers
+
+
 async def _chat_stream(request, req, sm, cfg, gr, rid, tctx, *, cid=""
                        ) -> web.StreamResponse:
     """SSE streaming. Plain chat streams deltas as they decode; with tools
     the text must be parsed whole, so deltas buffer and the final frames
     carry tool_calls (parity: chat.go:107-154,463-508)."""
-    headers = dict(SSE_HEADERS)
-    if cid:
-        headers["X-Correlation-ID"] = cid
+    headers = _sse_headers(request, cid)
     resp = web.StreamResponse(headers=headers)
     await resp.prepare(request)
     await resp.write(sse_event(sc.chat_chunk(
@@ -261,6 +277,7 @@ async def _chat_stream(request, req, sm, cfg, gr, rid, tctx, *, cid=""
                 await resp.write(sse_event(sc.chat_chunk(
                     rid, req.model, {"content": item.delta}
                 )))
+                mark_first_write(handle)
     except BaseException:
         # client went away mid-stream — free the decode slot immediately
         handle.cancel()
@@ -293,9 +310,7 @@ async def _chat_stream_n(request, req, sm, grs, rid, cid
     the batching engine, interleaved on the one SSE stream by index."""
     import asyncio
 
-    headers = dict(SSE_HEADERS)
-    headers["X-Correlation-ID"] = cid
-    resp = web.StreamResponse(headers=headers)
+    resp = web.StreamResponse(headers=_sse_headers(request, cid))
     await resp.prepare(request)
     handles = [sm.scheduler.submit(gr) for gr in grs]
     write_lock = asyncio.Lock()
@@ -316,6 +331,7 @@ async def _chat_stream_n(request, req, sm, grs, rid, cid
                         rid, req.model, {"content": item.delta},
                         index=idx,
                     )))
+                mark_first_write(handle)
         async with write_lock:
             await resp.write(sse_event(sc.chat_chunk(
                 rid, req.model, {}, finish_reason=finish, index=idx,
@@ -356,6 +372,7 @@ async def completions(request: web.Request) -> web.StreamResponse:
     cfg = inf.merge_request(base_cfg, req)
     rid = sc.new_id("cmpl")
     cid = inf.correlation_id(request) or rid
+    tid = inf.trace_id(request) or cid
 
     prompts: list[str]
     if isinstance(req.prompt, list):
@@ -368,7 +385,7 @@ async def completions(request: web.Request) -> web.StreamResponse:
 
     if req.stream:
         return await _completions_stream(
-            request, req, sm, cfg, templated, rid, cid
+            request, req, sm, cfg, templated, rid, cid, tid
         )
 
     choices = []
@@ -379,7 +396,8 @@ async def completions(request: web.Request) -> web.StreamResponse:
         n = max(1, req.n or 1)
         handles = [
             sm.scheduler.submit(inf.build_gen_request(
-                sm, cfg, req, prompt, seed_offset=i, correlation_id=cid))
+                sm, cfg, req, prompt, seed_offset=i, correlation_id=cid,
+                trace_id=tid))
             for i in range(n)
         ]
         await _await_handles(request, handles)
@@ -398,23 +416,22 @@ async def completions(request: web.Request) -> web.StreamResponse:
     ), headers={"X-Correlation-ID": cid})
 
 
-async def _completions_stream(request, req, sm, cfg, templated, rid, cid
-                              ) -> web.StreamResponse:
+async def _completions_stream(request, req, sm, cfg, templated, rid, cid,
+                              tid="") -> web.StreamResponse:
     """SSE streaming over EVERY prompt in the list × n choices — each
     choice index streams concurrently through the continuous-batching
     engine (a list prompt must not silently degrade to its first element,
     and stream/non-stream modes must agree on choice indexing)."""
     import asyncio
 
-    headers = dict(SSE_HEADERS)
-    headers["X-Correlation-ID"] = cid
-    resp = web.StreamResponse(headers=headers)
+    resp = web.StreamResponse(headers=_sse_headers(request, cid))
     await resp.prepare(request)
     n = max(1, req.n or 1)
     # choice index p*n + i — identical to the non-stream loop below
     handles = [
         sm.scheduler.submit(inf.build_gen_request(
-            sm, cfg, req, prompt, seed_offset=i, correlation_id=cid))
+            sm, cfg, req, prompt, seed_offset=i, correlation_id=cid,
+            trace_id=tid))
         for prompt in templated
         for i in range(n)
     ]
@@ -435,6 +452,7 @@ async def _completions_stream(request, req, sm, cfg, templated, rid, cid
                         sc.usage(handle.prompt_tokens,
                                  handle.completion_tokens),
                     )))
+                mark_first_write(handle)
         async with write_lock:
             await resp.write(sse_event(sc.completion_response(
                 rid, req.model, [{"index": idx, "text": "",
@@ -468,6 +486,7 @@ async def edits(request: web.Request) -> web.Response:
     cfg = inf.merge_request(base_cfg, req)
     rid = sc.new_id("edit")
     cid = inf.correlation_id(request) or rid
+    tid = inf.trace_id(request) or cid
     inputs: list[str]
     if isinstance(req.prompt, list):
         inputs = [str(p) for p in req.prompt] or [""]
@@ -479,7 +498,7 @@ async def edits(request: web.Request) -> web.Response:
         prompt = build_edit_prompt(sm.templates, cfg, text_in,
                                    req.instruction)
         h = sm.scheduler.submit(inf.build_gen_request(
-            sm, cfg, req, prompt, correlation_id=cid))
+            sm, cfg, req, prompt, correlation_id=cid, trace_id=tid))
         await _await_handles(request, [h])
         ptotal += h.prompt_tokens
         ctotal += h.completion_tokens

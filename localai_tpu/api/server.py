@@ -25,10 +25,19 @@ from localai_tpu.api.schema import error_body
 from localai_tpu.config.app_config import AppConfig
 from localai_tpu.config.loader import ConfigLoader
 from localai_tpu.models.manager import ModelManager
+from localai_tpu.obs import trace as obs_trace
 
 log = logging.getLogger(__name__)
 
 STATE_KEY = web.AppKey("state", object)
+# per-request trace id, set by trace_middleware (a plain str key: aiohttp
+# Requests are MutableMappings; handlers read it via request.get())
+TRACE_KEY = "trace_id"
+# observability/probe endpoints whose HTTP spans are pure scrape noise:
+# they still get a trace id, but are not recorded into the trace store
+# (a 15s Prometheus scrape would otherwise dominate the http ring)
+TRACE_SKIP = {"/metrics", "/healthz", "/readyz", "/v1/traces"}
+TRACE_SKIP_PREFIXES = ("/debug/timeline/",)
 
 # paths reachable without an API key (parity: auth exemption filter,
 # core/http/middleware/auth.go:17+)
@@ -141,20 +150,59 @@ async def error_middleware(request: web.Request, handler):
         )
 
 
+def _canonical_path(request: web.Request) -> str:
+    # the matched route pattern, not the raw URL — raw paths are
+    # attacker-controlled and would grow the registry without bound
+    resource = getattr(request.match_info.route, "resource", None)
+    return getattr(resource, "canonical", None) or "(unmatched)"
+
+
 @web.middleware
 async def metrics_middleware(request: web.Request, handler):
-    t0 = time.perf_counter()
+    t0 = time.monotonic()
     try:
         return await handler(request)
     finally:
-        # label by matched route pattern, not the raw URL — raw paths are
-        # attacker-controlled and would grow the registry without bound
-        resource = getattr(request.match_info.route, "resource", None)
-        canonical = getattr(resource, "canonical", None) or "(unmatched)"
         REGISTRY.api_call.observe(
-            time.perf_counter() - t0,
-            method=request.method, path=canonical,
+            time.monotonic() - t0,
+            method=request.method, path=_canonical_path(request),
         )
+
+
+@web.middleware
+async def trace_middleware(request: web.Request, handler):
+    """Tag every request with a trace id (client-supplied X-Trace-ID /
+    X-Correlation-ID, else generated) and record its HTTP span into the
+    trace store — the root the engine's request spans group under."""
+    tid = (request.headers.get("X-Trace-ID")
+           or request.headers.get("X-Correlation-ID")
+           or obs_trace.new_trace_id())
+    request[TRACE_KEY] = tid
+    t0 = time.monotonic()
+    status = 500
+    try:
+        resp = await handler(request)
+        status = resp.status
+        if not resp.prepared:  # streaming handlers already sent headers
+            resp.headers["X-Trace-ID"] = tid
+        return resp
+    except web.HTTPException as e:
+        status = e.status
+        raise
+    finally:
+        if (request.path not in TRACE_SKIP
+                and not request.path.startswith(TRACE_SKIP_PREFIXES)):
+            tr = obs_trace.RequestTrace(
+                tid, f"http-{id(request):x}", kind="http",
+                method=request.method, path=_canonical_path(request),
+                status=status,
+            )
+            tr.t0 = t0
+            span = tr.begin("http", method=request.method,
+                            path=_canonical_path(request), status=status)
+            span.t0 = t0  # the span covers the whole handler, not just now
+            tr.end("http")
+            obs_trace.STORE.record(tr)
 
 
 @web.middleware
@@ -217,7 +265,7 @@ async def welcome(request: web.Request) -> web.Response:
 def create_app(state: Optional[AppState] = None) -> web.Application:
     state = state or AppState()
     app = web.Application(middlewares=[
-        cors_middleware, error_middleware, auth_middleware,
+        trace_middleware, cors_middleware, error_middleware, auth_middleware,
         metrics_middleware,
     ], client_max_size=64 * 1024 * 1024)
     app[STATE_KEY] = state
@@ -242,8 +290,10 @@ def create_app(state: Optional[AppState] = None) -> web.Application:
 
         app.add_routes(ui_routes.routes())
     from localai_tpu.api import openapi as openapi_routes
+    from localai_tpu.api import traces as traces_routes
 
     app.add_routes(openapi_routes.routes())
+    app.add_routes(traces_routes.routes())
 
     async def on_cleanup(_app):
         state.shutdown()

@@ -36,6 +36,18 @@ async def aiter_handle(handle: GenHandle) -> AsyncIterator[StreamItem]:
             return
 
 
+def mark_first_write(handle: GenHandle) -> None:
+    """Record the first-token SSE write on the request's trace: the
+    client-observable TTFT (engine first-token + queue/bridge latency).
+    Idempotent — writers call it after EVERY content frame and only the
+    first call records, so no per-loop first-flags are needed."""
+    tr = getattr(handle, "trace", None)
+    if tr is None or getattr(handle, "_first_write_marked", False):
+        return
+    handle._first_write_marked = True
+    tr.event("first_sse_write")
+
+
 def sse_event(payload: Any) -> bytes:
     """One `data: {json}` SSE frame (chat.go:463-508 wire shape)."""
     return b"data: " + json.dumps(

@@ -1,0 +1,70 @@
+"""Tracing endpoints: recent span trees + per-request timelines.
+
+``GET /v1/traces`` — newest-first span trees from the ring-buffer trace
+store (``?limit=N``, ``?kind=request|http``, ``?model=name``).
+
+``GET /debug/timeline/{request_id}`` — every trace matching one trace id
+or engine request id (the HTTP span plus each engine request it spawned,
+e.g. n>1 fan-out), merged into one flat, time-ordered timeline with
+offsets relative to the earliest span — the "where did my latency go"
+view for a single request.
+"""
+
+from __future__ import annotations
+
+from aiohttp import web
+
+from localai_tpu.obs.trace import STORE, mono_to_wall
+
+
+async def list_traces(request: web.Request) -> web.Response:
+    try:
+        limit = max(1, min(int(request.query.get("limit", 50)), 500))
+    except ValueError:
+        raise web.HTTPBadRequest(text="limit must be an integer")
+    kind = request.query.get("kind") or None
+    model = request.query.get("model") or None
+    traces = STORE.recent(limit=limit, kind=kind)
+    if model:
+        traces = [t for t in traces if t.model == model]
+    return web.json_response({
+        "object": "list",
+        "traces": [t.to_dict() for t in traces],
+    })
+
+
+async def timeline(request: web.Request) -> web.Response:
+    rid = request.match_info["request_id"]
+    hits = STORE.find(rid)
+    if not hits:
+        raise web.HTTPNotFound(
+            text=f"no trace recorded for {rid!r} (traces are kept in a "
+                 f"bounded ring; see /v1/traces for what is retained)"
+        )
+    origin = min(t.t0 for t in hits)
+    events = []
+    for t in hits:
+        for s in t.spans():
+            events.append({
+                "source": t.request_id,
+                "kind": t.kind,
+                "name": s.name,
+                "offset_ms": round((s.t0 - origin) * 1e3, 3),
+                "duration_ms": (None if s.t1 is None
+                                else round((s.t1 - s.t0) * 1e3, 3)),
+                "attrs": dict(s.attrs),
+            })
+    events.sort(key=lambda e: e["offset_ms"])
+    return web.json_response({
+        "request_id": rid,
+        "start_unix": round(mono_to_wall(origin), 6),
+        "traces": [t.to_dict() for t in hits],
+        "timeline": events,
+    })
+
+
+def routes() -> list[web.RouteDef]:
+    return [
+        web.get("/v1/traces", list_traces),
+        web.get("/debug/timeline/{request_id}", timeline),
+    ]

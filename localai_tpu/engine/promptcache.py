@@ -59,10 +59,11 @@ class PromptKVCache:
         self._lock = threading.Lock()
         self._index: dict[str, list[int]] = {}
         self._load_index()
-        # telemetry
+        # telemetry (scraped through Scheduler.metrics → /metrics)
         self.hits = 0
         self.misses = 0
         self.stores = 0
+        self.hit_tokens = 0  # KV rows handed back by successful lookups
 
     # -- index ------------------------------------------------------------
 
@@ -124,6 +125,7 @@ class PromptKVCache:
         except OSError:
             pass
         self.hits += 1
+        self.hit_tokens += n
         return CacheHit(tokens=list(best_tokens), arrays=arrays, n=n)
 
     def store(self, tokens: list[int], arrays: dict) -> None:
@@ -174,4 +176,5 @@ class PromptKVCache:
             "hits": self.hits,
             "misses": self.misses,
             "stores": self.stores,
+            "hit_tokens": self.hit_tokens,
         }

@@ -30,6 +30,7 @@ import numpy as np
 from localai_tpu.engine import kvcache as kvc
 from localai_tpu.engine import sampling as smp
 from localai_tpu.engine.kvcache import KVCache
+from localai_tpu.obs import compile as obs_compile
 from localai_tpu.models import llama as mdl
 from localai_tpu.models.llama import LlamaConfig
 from localai_tpu.utils.jaxcompat import shard_map
@@ -211,25 +212,32 @@ class ModelRunner:
         self._active_slots: set[int] = set()
 
         self.kv_dtype = kv_dtype
-        self._decode = jax.jit(self._decode_fn, donate_argnums=(1, 2))
-        self._decode_n = jax.jit(
-            self._decode_n_fn, static_argnames=("n",), donate_argnums=(1, 2)
+        # every jit entry point is wrapped by obs.compile.watch: the first
+        # dispatch of each program shape compiles synchronously, so its
+        # wall time lands in the localai_xla_compile_* series (the
+        # jax.monitoring listener supplements this where available)
+        obs_compile.install()
+        self._decode = obs_compile.watch(
+            jax.jit(self._decode_fn, donate_argnums=(1, 2)), "decode"
         )
-        self._decode_frozen_n = jax.jit(
+        self._decode_n = obs_compile.watch(jax.jit(
+            self._decode_n_fn, static_argnames=("n",), donate_argnums=(1, 2)
+        ), "decode_n")
+        self._decode_frozen_n = obs_compile.watch(jax.jit(
             self._decode_frozen_n_fn, static_argnames=("n",),
             donate_argnums=(1, 2),
-        )
-        self._prefill = jax.jit(
+        ), "decode_frozen_n")
+        self._prefill = obs_compile.watch(jax.jit(
             self._prefill_fn, static_argnames=("bucket",), donate_argnums=(1, 2)
-        )
-        self._prefill_mm = jax.jit(
+        ), "prefill")
+        self._prefill_mm = obs_compile.watch(jax.jit(
             self._prefill_mm_fn, static_argnames=("bucket",),
             donate_argnums=(1, 2),
-        )
-        self._prefill_resume = jax.jit(
+        ), "prefill_mm")
+        self._prefill_resume = obs_compile.watch(jax.jit(
             self._prefill_resume_fn, static_argnames=("bucket",),
             donate_argnums=(1, 2),
-        )
+        ), "prefill_resume")
         # sequence-parallel prefill: long prompts chunk over the 'seq' mesh
         # axis and run ring attention (parallel.ring) straight into the
         # slot cache. Composes with TP: weights stay 'model'-sharded
@@ -253,11 +261,13 @@ class ModelRunner:
         )
         self.sp_threshold = sp_threshold
         self.last_prefill_path = ""
-        self._prefill_sp = jax.jit(
+        self._prefill_sp = obs_compile.watch(jax.jit(
             self._prefill_sp_fn, static_argnames=("bucket",),
             donate_argnums=(1, 2),
+        ), "prefill_sp")
+        self._embed = obs_compile.watch(
+            jax.jit(self._embed_fn, static_argnames=("bucket",)), "embed"
         )
-        self._embed = jax.jit(self._embed_fn, static_argnames=("bucket",))
         # KV prefix reuse (parity: common_part, grpc-server.cpp:67-74):
         # suffix prefill only pays off past a minimum shared prefix
         self.prefix_reuse_min = 16

@@ -34,6 +34,7 @@ import numpy as np
 
 from localai_tpu.engine.runner import ModelRunner
 from localai_tpu.engine.stream import IncrementalDetokenizer, StopChecker
+from localai_tpu.obs.engine import EngineTelemetry
 
 log = logging.getLogger(__name__)
 
@@ -71,6 +72,10 @@ class GenRequest:
     ignore_eos: bool = False
     constraint: Optional[TokenConstraint] = None
     correlation_id: str = ""
+    # tracing: groups this request's lifecycle spans with the HTTP span
+    # that spawned it (obs subsystem); crosses the worker RPC boundary as
+    # gRPC metadata (worker.rpc.trace_metadata)
+    trace_id: str = ""
     # an SSE client is attached: the scheduler bounds delivery lag by
     # shrinking the per-dispatch step count while this request is active
     stream: bool = False
@@ -110,6 +115,8 @@ class GenHandle:
         self.t_submit = time.monotonic()
         self.t_first_token: Optional[float] = None
         self.t_done: Optional[float] = None
+        # lifecycle trace (obs.RequestTrace), attached by the scheduler
+        self.trace = None
 
     # engine-thread side -------------------------------------------------
     def _emit(self, delta: str, token_id: Optional[int]) -> None:
@@ -181,9 +188,13 @@ class Scheduler:
                  multi_step: int = 16, stream_latency_target: float = 0.1,
                  spec: Optional[Any] = None,
                  prompt_cache: Optional[Any] = None,
-                 prompt_cache_all: bool = False):
+                 prompt_cache_all: bool = False,
+                 telemetry: Optional[EngineTelemetry] = None):
         self.runner = runner
         self.tokenizer = tokenizer
+        # request-lifecycle spans + engine histograms (obs subsystem); the
+        # manager names it after the model, tests may inject their own
+        self.telemetry = telemetry or EngineTelemetry()
         # speculative decoding (engine.speculative.SpecDecoder): when set and
         # no grammar constraint is active, dispatches run draft+verify
         # windows instead of plain multi-step decode. Slot lifecycle ops
@@ -241,6 +252,7 @@ class Scheduler:
         # lifetime metrics (GetMetrics parity)
         self.total_prompt_tokens = 0
         self.total_generated_tokens = 0
+        self.total_preemptions = 0  # cancelled / engine-error slot exits
         self._thread = threading.Thread(
             target=self._run, name="engine", daemon=True
         )
@@ -250,6 +262,7 @@ class Scheduler:
 
     def submit(self, req: GenRequest) -> GenHandle:
         handle = GenHandle(req, next(self._ids))
+        handle.trace = self.telemetry.queued(handle)
         self._pending.put(handle)
         self._wake.set()
         return handle
@@ -264,6 +277,8 @@ class Scheduler:
     def metrics(self) -> dict:
         """Live engine metrics (parity: GetMetrics RPC,
         grpc-server.cpp:2434-2457)."""
+        num_slots = self.runner.num_slots
+        max_ctx = self.runner.max_ctx
         with self._lock:
             active = [
                 {
@@ -275,15 +290,31 @@ class Scheduler:
                 }
                 for s, c in self._slots.items()
             ]
+            # KV rows in use, from the host-side token record (no device
+            # read): each active slot holds prompt + generated rows
+            kv_rows = sum(
+                min(c.handle.prompt_tokens + c.generated, max_ctx)
+                for c in self._slots.values()
+            )
         return {
             "active_slots": active,
-            "num_slots": self.runner.num_slots,
+            "num_slots": num_slots,
+            "occupancy": len(active) / num_slots if num_slots else 0.0,
+            "kv_utilization": (
+                kv_rows / (num_slots * max_ctx) if num_slots else 0.0
+            ),
             "queue_depth": self._pending.qsize(),
             "total_prompt_tokens": self.total_prompt_tokens,
             "total_generated_tokens": self.total_generated_tokens,
             "prefix_tokens_reused": self.runner.total_prefix_reused,
             "last_dispatch_steps": self.last_dispatch_steps,
+            "dispatches": self._dispatch_seq,
+            "preemptions": self.total_preemptions,
             "step_time_ema": self._step_ema,
+            **(
+                {"prompt_cache": self.prompt_cache.stats()}
+                if self.prompt_cache is not None else {}
+            ),
             **(
                 {"spec_acceptance_rate": self.spec.acceptance_rate,
                  "spec_windows": self.spec.total_windows}
@@ -459,10 +490,14 @@ class Scheduler:
                 log.exception("decode step failed; failing active requests")
                 inflight.clear()
                 with self._lock:
-                    for slot, ctx in list(self._slots.items()):
-                        ctx.handle._finish("error")
-                        self._engine.release(slot)
+                    failed = list(self._slots.items())
                     self._slots.clear()
+                    self.total_preemptions += len(failed)
+                for slot, ctx in failed:
+                    self._engine.release(slot)
+                    self.telemetry.finished(ctx.handle.trace, ctx.handle,
+                                            "error")
+                    ctx.handle._finish("error")
 
     def _spec_usable(self) -> bool:
         """Speculative windows require: a spec decoder, every active slot
@@ -543,6 +578,10 @@ class Scheduler:
             except queue.Empty:
                 return admitted
             if handle.cancelled:
+                # abandoned while still queued: not a slot exit, so it is
+                # not a preemption — only requests_total records it
+                self.telemetry.finished(handle.trace, handle, "cancelled",
+                                        preempted=False)
                 handle._finish("cancelled")
                 continue
             # prefer the free slot whose resident tokens share the longest
@@ -561,12 +600,19 @@ class Scheduler:
                 admitted = True
             except Exception as e:  # noqa: BLE001 — bad request ≠ dead engine
                 log.warning("admit failed: %s", e)
-                handle._finish("error")
                 self._engine.release(slot)
+                with self._lock:
+                    self.total_preemptions += 1
+                self.telemetry.finished(handle.trace, handle, "error")
+                handle._finish("error")
 
     def _start(self, slot: int, handle: GenHandle,
                positions: Optional[np.ndarray] = None) -> None:
         req = handle.request
+        self.telemetry.admitted(
+            handle.trace, slot=slot,
+            queue_wait=time.monotonic() - handle.t_submit,
+        )
         base = self._padded_vocab_ban()
         if req.logit_bias:
             if base is None:
@@ -623,6 +669,11 @@ class Scheduler:
             bias_row=self._compose_bias(base, mask),
             mm_embeds=req.mm_embeds,
             mm_positions=req.mm_positions,
+        )
+        self.telemetry.prefill_done(
+            handle.trace,
+            path=self.runner.last_prefill_path,
+            prefix_reused=self._engine.last_prefix_reused,
         )
         # multimodal KV mixes injected embeddings with token ids, so the
         # token record alone can't prove prefix equality — never reuse it.
@@ -787,6 +838,8 @@ class Scheduler:
         with self._lock:
             self._slots.pop(slot, None)
             self.total_generated_tokens += ctx.handle.completion_tokens
+            if reason in ("cancelled", "error"):
+                self.total_preemptions += 1
         if (self.prompt_cache is not None
                 and not self.prompt_cache.read_only
                 and reason in ("stop", "length")):
@@ -807,4 +860,7 @@ class Scheduler:
                     except Exception as e:  # noqa: BLE001 — cache ≠ serving
                         log.warning("prompt-cache snapshot failed: %s", e)
         self._engine.release(slot)
+        # retire the trace BEFORE _finish unblocks the client: a traces
+        # query racing the response must not see a half-annotated trace
+        self.telemetry.finished(ctx.handle.trace, ctx.handle, reason)
         ctx.handle._finish(reason)

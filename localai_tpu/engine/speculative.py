@@ -119,7 +119,12 @@ class SpecDecoder:
         self.total_windows = 0
         self.total_eligible = 0   # active slot-windows × (gamma+1)
         self.last_prefix_reused = 0
-        self._spec = jax.jit(self._spec_fn, donate_argnums=(1, 2, 4, 5))
+        from localai_tpu.obs import compile as obs_compile
+
+        self._spec = obs_compile.watch(
+            jax.jit(self._spec_fn, donate_argnums=(1, 2, 4, 5)),
+            "spec_window",
+        )
 
     # -- jitted program ---------------------------------------------------
 
@@ -307,6 +312,16 @@ class SpecDecoder:
         if not self.total_eligible:
             return 0.0
         return self.total_emitted / self.total_eligible
+
+    def stats(self) -> dict:
+        """Window telemetry snapshot (obs /metrics + GetMetrics surface)."""
+        return {
+            "gamma": self.gamma,
+            "windows": self.total_windows,
+            "emitted": self.total_emitted,
+            "eligible": self.total_eligible,
+            "acceptance_rate": self.acceptance_rate,
+        }
 
 
 def build_spec_decoder(target: ModelRunner, draft_ref: str, *,

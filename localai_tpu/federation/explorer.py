@@ -136,8 +136,13 @@ class DiscoveryMonitor:
         for url in self.db.routers():
             if only is not None and url not in only:
                 continue
+            # intervals/durations come from the monotonic clock (immune to
+            # wall-clock steps); checked_at stays time.time() — it is a
+            # display timestamp, not a duration source
+            t0 = time.monotonic()
             try:
                 data = fetch_nodes(url, timeout=self.timeout)
+                dial = time.monotonic() - t0
                 nodes = data.get("nodes", [])
                 if url not in self.db.routers():
                     continue  # removed (DELETE) while the dial was in flight
@@ -147,9 +152,12 @@ class DiscoveryMonitor:
                         "nodes": nodes,
                         "online": sum(1 for n in nodes if n.get("online")),
                         "checked_at": time.time(),
+                        "checked_mono": time.monotonic(),
+                        "dial_seconds": round(dial, 3),
                     }
                 self.db.mark_ok(url)
             except Exception as e:  # noqa: BLE001 — the dial test failing
+                dial = time.monotonic() - t0
                 evicted = (count_failures and self.db.mark_failed(
                     url, self.failure_threshold))
                 with self._lock:
@@ -159,11 +167,23 @@ class DiscoveryMonitor:
                         self._state[url] = {
                             "ok": False, "error": str(e), "nodes": [],
                             "online": 0, "checked_at": time.time(),
+                            "checked_mono": time.monotonic(),
+                            "dial_seconds": round(dial, 3),
                         }
 
     def state(self) -> dict[str, dict]:
+        now = time.monotonic()
+        out: dict[str, dict] = {}
         with self._lock:
-            return {k: dict(v) for k, v in self._state.items()}
+            for url, snap in self._state.items():
+                d = dict(snap)
+                # snapshot age from the monotonic pair (wall checked_at is
+                # for display only and can step backwards under NTP)
+                mono = d.pop("checked_mono", None)
+                if mono is not None:
+                    d["age_seconds"] = round(now - mono, 1)
+                out[url] = d
+        return out
 
     def forget(self, url: str) -> None:
         """Drop a network's snapshot (on DELETE — a re-added network must

@@ -457,6 +457,8 @@ def build_serving_model(mcfg: ModelConfig, app: AppConfig) -> ServingModel:
             "ro, " if mcfg.prompt_cache_ro else "",
             "prompt+generation" if mcfg.prompt_cache_all else "prompt only",
         )
+    from localai_tpu.obs import EngineTelemetry
+
     scheduler = Scheduler(
         runner,
         model.tokenizer,
@@ -467,6 +469,7 @@ def build_serving_model(mcfg: ModelConfig, app: AppConfig) -> ServingModel:
         spec=spec,
         prompt_cache=prompt_cache,
         prompt_cache_all=mcfg.prompt_cache_all,
+        telemetry=EngineTelemetry(model=mcfg.name),
     )
     # vision tower: explicit mmproj ref, or auto from a llava checkpoint dir
     vision = None

@@ -1,0 +1,54 @@
+"""Observability subsystem: request tracing + engine telemetry.
+
+Three cooperating pieces (PR: request-level tracing and engine telemetry):
+
+  * ``obs.metrics`` — the process-wide OpenMetrics registry (moved here
+    from ``api.metrics``, which remains as a compatibility shim) extended
+    with engine series: TTFT/TPOT/queue-wait histograms, batch occupancy,
+    KV-slot utilization, prompt/prefix-cache hit rates, speculative accept
+    rate, XLA compile count/seconds.
+  * ``obs.trace`` — a lock-protected span recorder with a bounded
+    ring-buffer trace store. All timestamps are ``time.monotonic()`` taken
+    on the host; nothing here ever touches a device array, so
+    instrumentation adds zero device syncs to the step loop.
+  * ``obs.engine`` — ``EngineTelemetry``, the scheduler-facing facade that
+    turns request lifecycle events (queued → admitted → prefill → decode →
+    drained) into spans + histogram observations.
+
+HTTP surface: ``GET /v1/traces`` and ``GET /debug/timeline/{request_id}``
+(``api.traces``), fed by the trace-id middleware in ``api.server``.
+"""
+
+from localai_tpu.obs.engine import EngineTelemetry
+from localai_tpu.obs.metrics import (
+    REGISTRY,
+    Counter,
+    Gauge,
+    Histogram,
+    Registry,
+    escape_label_value,
+    update_engine_gauges,
+)
+from localai_tpu.obs.trace import (
+    STORE,
+    RequestTrace,
+    Span,
+    TraceStore,
+    new_trace_id,
+)
+
+__all__ = [
+    "REGISTRY",
+    "STORE",
+    "Counter",
+    "EngineTelemetry",
+    "Gauge",
+    "Histogram",
+    "Registry",
+    "RequestTrace",
+    "Span",
+    "TraceStore",
+    "escape_label_value",
+    "new_trace_id",
+    "update_engine_gauges",
+]

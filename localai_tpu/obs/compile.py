@@ -1,0 +1,94 @@
+"""XLA compile-time telemetry.
+
+Two capture paths, matching what this jax build actually exposes:
+
+  * :func:`watch` wraps a jitted entry point (``engine/runner.py`` wraps
+    all of its programs). jax compiles synchronously on the first dispatch
+    of each static-argument shape while *execution* is async, so the wall
+    time of that first call is trace+lower+compile to within one program
+    execution — the same reasoning the scheduler uses to exclude fresh
+    shapes from its step-time EMA. Later dispatches of a seen shape pass
+    straight through with one set lookup of overhead.
+  * :func:`install` registers a ``jax.monitoring`` duration listener for
+    compilation events. On this jax version only the persistent
+    compilation cache emits them, so the listener is a supplement; newer
+    versions emit real backend-compile durations and will land in the same
+    series. Gated: a jax without ``jax.monitoring`` just skips it.
+
+Both feed ``localai_xla_compile_total`` / ``localai_xla_compile_seconds_total``.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import weakref
+from typing import Any, Callable, Optional
+
+from localai_tpu.obs.metrics import REGISTRY, Registry
+
+_install_lock = threading.Lock()
+_installed = False
+# every registry that ever asked for compile events: ONE jax.monitoring
+# listener fans out to all of them (jax offers registration but no
+# deregistration, so per-registry listeners would leak). Weak refs keep
+# short-lived test registries collectable.
+_registries: "weakref.WeakSet[Registry]" = weakref.WeakSet()
+
+
+def watch(fn: Callable, program: str,
+          registry: Optional[Registry] = None) -> Callable:
+    """Wrap a jitted callable: the first call per static-kwargs shape is
+    timed and recorded as a compilation of ``program``."""
+    reg = registry or REGISTRY
+    seen: set = set()
+    lock = threading.Lock()
+
+    def wrapped(*args: Any, **kwargs: Any) -> Any:
+        # program identity = static kwargs + argument shapes (array args
+        # with a new shape retrace even when the statics repeat — e.g. the
+        # multimodal prefill keyed by embedding row count)
+        key = (tuple(getattr(a, "shape", None) for a in args)
+               + tuple(sorted(kwargs.items())))
+        with lock:
+            fresh = key not in seen
+            if fresh:
+                seen.add(key)
+        if not fresh:
+            return fn(*args, **kwargs)
+        t0 = time.monotonic()
+        out = fn(*args, **kwargs)
+        reg.compile_count.inc(program=program)
+        reg.compile_seconds.inc(time.monotonic() - t0, program=program)
+        return out
+
+    wrapped.__name__ = getattr(fn, "__name__", program)
+    return wrapped
+
+
+def install(registry: Optional[Registry] = None) -> bool:
+    """Register ``registry`` (default: the process-wide one) to receive
+    jax.monitoring compile events; the single listener is installed on
+    first call. Returns True when the listener is live."""
+    global _installed
+    with _install_lock:
+        _registries.add(registry or REGISTRY)
+        if _installed:
+            return True
+        try:
+            from jax import monitoring
+        except ImportError:
+            return False
+
+        def _on_duration(event: str, duration: float, **_kw: Any) -> None:
+            if "compil" in event:
+                for reg in list(_registries):
+                    reg.compile_count.inc(program=event)
+                    reg.compile_seconds.inc(duration, program=event)
+
+        try:
+            monitoring.register_event_duration_secs_listener(_on_duration)
+        except Exception:  # noqa: BLE001 — telemetry must never break serving
+            return False
+        _installed = True
+        return True

@@ -17,6 +17,7 @@ from typing import Any, Callable, Iterator, Optional
 import grpc
 
 from localai_tpu.worker import backend_pb2 as pb
+from localai_tpu.worker import rpc
 from localai_tpu.worker.rpc import BackendStub
 
 
@@ -52,10 +53,11 @@ class WorkerClient:
         if self._op_lock is not None:
             self._op_lock.release()
 
-    def _call(self, fn: Callable, request, timeout: Optional[float] = None):
+    def _call(self, fn: Callable, request, timeout: Optional[float] = None,
+              metadata: Optional[tuple] = None):
         self._enter()
         try:
-            return fn(request, timeout=timeout)
+            return fn(request, timeout=timeout, metadata=metadata)
         finally:
             self._exit()
 
@@ -77,14 +79,20 @@ class WorkerClient:
         ), timeout)
 
     def predict(self, opts: pb.PredictOptions,
-                timeout: float = 600.0) -> pb.Reply:
-        return self._call(self._stub.Predict, opts, timeout)
+                timeout: float = 600.0,
+                trace_id: str = "") -> pb.Reply:
+        return self._call(self._stub.Predict, opts, timeout,
+                          metadata=rpc.trace_metadata(trace_id) or None)
 
     def predict_stream(self, opts: pb.PredictOptions,
-                       timeout: float = 600.0) -> Iterator[pb.Reply]:
+                       timeout: float = 600.0,
+                       trace_id: str = "") -> Iterator[pb.Reply]:
         self._enter()
         try:
-            yield from self._stub.PredictStream(opts, timeout=timeout)
+            yield from self._stub.PredictStream(
+                opts, timeout=timeout,
+                metadata=rpc.trace_metadata(trace_id) or None,
+            )
         finally:
             self._exit()
 

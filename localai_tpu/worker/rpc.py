@@ -18,6 +18,31 @@ from localai_tpu.worker import backend_pb2 as pb
 
 SERVICE = "localai_tpu.Backend"
 
+# span propagation across the worker boundary (obs subsystem): the API
+# tier sends its trace id as gRPC metadata; the worker stamps it onto the
+# GenRequest so both processes record spans under ONE trace id. Metadata
+# (not a proto field) keeps the wire contract backward-compatible with
+# third-party workers that never read it.
+TRACE_ID_METADATA_KEY = "x-localai-trace-id"
+
+
+def trace_metadata(trace_id: str) -> tuple:
+    """Per-call gRPC metadata carrying ``trace_id`` ('' → no metadata)."""
+    if not trace_id:
+        return ()
+    return ((TRACE_ID_METADATA_KEY, trace_id),)
+
+
+def trace_id_from_context(context: Any) -> str:
+    """Read the propagated trace id out of a servicer context."""
+    try:
+        for key, value in context.invocation_metadata():
+            if key == TRACE_ID_METADATA_KEY:
+                return value
+    except Exception:  # noqa: BLE001 — tracing must never fail an RPC
+        pass
+    return ""
+
 # name → (is_server_streaming, request type, response type)
 METHODS: dict[str, tuple[bool, Any, Any]] = {
     "Health": (False, pb.HealthMessage, pb.Reply),

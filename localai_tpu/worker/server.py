@@ -103,7 +103,16 @@ class BackendServicer:
                    context) -> pb.MetricsResponse:
         if self._sm is None:
             return pb.MetricsResponse(json="{}")
-        return pb.MetricsResponse(json=json.dumps(self._sm.scheduler.metrics()))
+        payload = self._sm.scheduler.metrics()
+        # the worker process has no HTTP surface, so its engine span trees
+        # (recorded under trace ids propagated over the RPC metadata) ride
+        # the metrics JSON — the API tier surfaces them at /backend/metrics
+        from localai_tpu.obs.trace import STORE
+
+        payload["recent_traces"] = [
+            t.to_dict() for t in STORE.recent(limit=20, kind="request")
+        ]
+        return pb.MetricsResponse(json=json.dumps(payload))
 
     # -- inference -------------------------------------------------------
 
@@ -115,7 +124,7 @@ class BackendServicer:
             )
         return self._sm
 
-    def _gen_request(self, req: pb.PredictOptions, sm):
+    def _gen_request(self, req: pb.PredictOptions, sm, trace_id: str = ""):
         from localai_tpu.engine.scheduler import GenRequest
 
         if req.tokens:
@@ -155,12 +164,16 @@ class BackendServicer:
             ignore_eos=req.ignore_eos,
             constraint=constraint,
             correlation_id=req.correlation_id,
+            # propagated from the API tier over gRPC metadata: the worker's
+            # engine spans record under the same trace id (obs subsystem)
+            trace_id=trace_id or req.correlation_id,
             stream=req.stream,
         )
 
     def Predict(self, request: pb.PredictOptions, context) -> pb.Reply:
         sm = self._require_model(context)
-        handle = sm.scheduler.submit(self._gen_request(request, sm))
+        handle = sm.scheduler.submit(self._gen_request(
+            request, sm, trace_id=rpc.trace_id_from_context(context)))
         try:
             handle.result(timeout=600.0)
         finally:
@@ -177,7 +190,8 @@ class BackendServicer:
     def PredictStream(self, request: pb.PredictOptions,
                       context) -> Iterator[pb.Reply]:
         sm = self._require_model(context)
-        handle = sm.scheduler.submit(self._gen_request(request, sm))
+        handle = sm.scheduler.submit(self._gen_request(
+            request, sm, trace_id=rpc.trace_id_from_context(context)))
         try:
             for item in handle:
                 if item.finish_reason is not None:

@@ -26,6 +26,7 @@ from typing import Any, Optional
 from localai_tpu.config.app_config import AppConfig
 from localai_tpu.config.model_config import ModelConfig
 from localai_tpu.engine.scheduler import GenHandle, GenRequest
+from localai_tpu.obs import EngineTelemetry
 from localai_tpu.worker import backend_pb2 as pb
 from localai_tpu.worker.client import WorkerClient
 
@@ -91,6 +92,9 @@ class WorkerScheduler:
         self._ids = itertools.count()
         self._inflight = 0
         self._lock = threading.Lock()
+        # API-side view of the worker's requests: queued → rpc spans here,
+        # engine-phase spans in the worker process under the same trace id
+        self.telemetry = EngineTelemetry(model=owner.name)
 
     @property
     def busy(self) -> bool:
@@ -99,9 +103,11 @@ class WorkerScheduler:
 
     def submit(self, gr: GenRequest) -> GenHandle:
         handle = WorkerGenHandle(gr, next(self._ids))
+        handle.trace = self.telemetry.queued(handle)
         if gr.mm_embeds is not None:
             # image embeddings don't cross the proto yet; fail loudly
             # rather than silently serving text-only
+            self.telemetry.finished(handle.trace, handle, "error")
             handle._finish("error")
             log.error("worker-backed models do not support multimodal input")
             return handle
@@ -117,11 +123,18 @@ class WorkerScheduler:
         return handle
 
     def _run(self, handle: WorkerGenHandle) -> None:
+        tr = handle.trace
         try:
             client = self._owner.client()
             opts = predict_options(handle.request)
+            req = handle.request
+            if tr is not None:
+                tr.end("queued")
+                tr.begin("rpc", worker=client.address)
             finish = "stop"
-            for reply in client.predict_stream(opts, timeout=600.0):
+            for reply in client.predict_stream(
+                    opts, timeout=600.0,
+                    trace_id=req.trace_id or req.correlation_id):
                 if handle.cancelled:
                     finish = "cancelled"
                     break
@@ -132,11 +145,16 @@ class WorkerScheduler:
                         handle.prompt_tokens = reply.prompt_tokens
                     break
                 if reply.message:
+                    if tr is not None and handle.t_first_token is None:
+                        tr.event("first_delta")
                     handle._emit(reply.message.decode("utf-8", "replace"),
                                  None)
+            # trace retires before _finish unblocks the awaiting handler
+            self.telemetry.finished(tr, handle, finish)
             handle._finish(finish)
         except Exception as e:  # noqa: BLE001 — worker crash ≠ API crash
             log.warning("worker request %d failed: %s", handle.id, e)
+            self.telemetry.finished(tr, handle, "error")
             handle._finish("error")
         finally:
             with self._lock:

@@ -347,6 +347,80 @@ def test_metrics_token_series(client):
     assert 'path="/v1/chat/completions"' in body
 
 
+def test_metrics_engine_series(client):
+    """/metrics carries the obs engine series after a generation: batch
+    occupancy, cache-hit-rate family, speculative family, compile time."""
+    client.post("/v1/chat/completions", json={
+        "model": "tiny",
+        "messages": [{"role": "user", "content": "occupancy"}],
+        "max_tokens": 4,
+    })
+    body = client.get("/metrics").text
+    assert 'localai_batch_occupancy{model="tiny"}' in body
+    assert 'localai_kv_slot_utilization{model="tiny"}' in body
+    assert 'localai_ttft_seconds_count{model="tiny"}' in body
+    assert 'localai_queue_wait_seconds_count{model="tiny"}' in body
+    assert 'localai_requests_total{' in body
+    assert 'localai_decode_dispatches_total{model="tiny"}' in body
+    # compile time recorded by the runner's watched jit entry points
+    assert 'localai_xla_compile_seconds_total{program="prefill"}' in body
+    # family names present even with no series yet (scrape stability)
+    assert "# TYPE localai_prompt_cache_hit_rate gauge" in body
+    assert "# TYPE localai_speculative_accept_rate gauge" in body
+
+
+def test_traces_endpoint_returns_span_tree(client):
+    r = client.post("/v1/chat/completions", json={
+        "model": "tiny",
+        "messages": [{"role": "user", "content": "trace tree"}],
+        "max_tokens": 6,
+    }, headers={"X-Trace-ID": "trace-span-tree"})
+    assert r.status_code == 200
+    assert r.headers.get("X-Trace-ID") == "trace-span-tree"
+    data = client.get("/v1/traces", params={"limit": 100}).json()
+    mine = [t for t in data["traces"] if t["trace_id"] == "trace-span-tree"]
+    kinds = {t["kind"] for t in mine}
+    assert "request" in kinds and "http" in kinds
+    engine = next(t for t in mine if t["kind"] == "request")
+    names = [c["name"] for c in engine["children"]]
+    for phase in ("queued", "prefill", "decode"):
+        assert phase in names
+    assert engine["attrs"]["ttft_ms"] is not None
+    assert engine["attrs"]["tpot_ms"] is not None
+    assert engine["attrs"]["finish_reason"] in ("stop", "length")
+
+
+def test_debug_timeline_merges_http_and_engine(client):
+    client.post("/v1/chat/completions", json={
+        "model": "tiny",
+        "messages": [{"role": "user", "content": "timeline"}],
+        "max_tokens": 4,
+    }, headers={"X-Trace-ID": "trace-timeline-1"})
+    r = client.get("/debug/timeline/trace-timeline-1")
+    assert r.status_code == 200
+    body = r.json()
+    sources = {e["kind"] for e in body["timeline"]}
+    assert sources == {"http", "request"}
+    offsets = [e["offset_ms"] for e in body["timeline"]]
+    assert offsets == sorted(offsets) and offsets[0] == 0.0
+    # unknown ids 404 rather than returning an empty timeline
+    assert client.get("/debug/timeline/never-seen").status_code == 404
+
+
+def test_streaming_first_token_event_recorded(client):
+    with client.stream("POST", "/v1/chat/completions", json={
+        "model": "tiny",
+        "messages": [{"role": "user", "content": "first token"}],
+        "max_tokens": 6,
+        "stream": True,
+    }, headers={"X-Trace-ID": "trace-sse-first"}) as r:
+        assert r.status_code == 200
+        for _line in r.iter_lines():
+            pass
+    body = client.get("/debug/timeline/trace-sse-first").json()
+    assert any(e["name"] == "first_sse_write" for e in body["timeline"])
+
+
 def test_auth_enforced(tmp_path):
     models = tmp_path / "models"
     models.mkdir()

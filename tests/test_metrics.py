@@ -1,0 +1,142 @@
+"""/metrics exposition tests: histogram bucket math, label escaping,
+gauge typing, and the scrape-time engine-gauge refresh (obs.metrics)."""
+
+import math
+import re
+
+from localai_tpu.obs.metrics import (
+    Counter,
+    Gauge,
+    Histogram,
+    Registry,
+    escape_label_value,
+    update_engine_gauges,
+)
+
+
+def _series(rendered: str, name: str) -> dict[str, float]:
+    """name{labels} value → {labels-or-'': value} for one metric family."""
+    out = {}
+    for line in rendered.splitlines():
+        if line.startswith("#"):
+            continue
+        m = re.match(rf"^{re.escape(name)}(?:\{{(.*)\}})? (.+)$", line)
+        if m:
+            out[m.group(1) or ""] = float(m.group(2))
+    return out
+
+
+def test_histogram_buckets_cumulative_and_inf_equals_count():
+    h = Histogram("t_hist", "help", buckets=(0.1, 1.0, 10.0))
+    for v in (0.05, 0.5, 0.5, 5.0, 50.0):
+        h.observe(v, path="/x")
+    text = h.render()
+    buckets = _series(text, "t_hist_bucket")
+    # cumulative: each bucket includes everything below it
+    assert buckets['path="/x",le="0.1"'] == 1
+    assert buckets['path="/x",le="1.0"'] == 3
+    assert buckets['path="/x",le="10.0"'] == 4
+    assert buckets['path="/x",le="+Inf"'] == 5
+    counts = _series(text, "t_hist_count")
+    sums = _series(text, "t_hist_sum")
+    assert buckets['path="/x",le="+Inf"'] == counts['path="/x"']
+    assert math.isclose(sums['path="/x"'], 0.05 + 0.5 + 0.5 + 5.0 + 50.0)
+
+
+def test_histogram_cumulative_never_decreases():
+    h = Histogram("mono_hist", "help")
+    for v in (0.001, 0.02, 0.3, 4.0, 70.0, 70.0):
+        h.observe(v)
+    vals = [v for line in h.render().splitlines()
+            if (m := re.match(r"^mono_hist_bucket\{le=\"[^\"]+\"\} (\d+)$",
+                              line))
+            for v in [int(m.group(1))]]
+    assert vals == sorted(vals) and vals[-1] == 6
+
+
+def test_label_escaping_round_trips():
+    # a label value with all three hazardous characters must render as
+    # valid exposition and decode back to the original
+    nasty = 'pa"th\\with\nnewline'
+    escaped = escape_label_value(nasty)
+    assert "\n" not in escaped
+
+    # single-pass decoder (what a scraper does) proves no information loss
+    def decode(s):
+        out, i = [], 0
+        while i < len(s):
+            if s[i] == "\\" and i + 1 < len(s):
+                out.append({"n": "\n", '"': '"', "\\": "\\"}[s[i + 1]])
+                i += 2
+            else:
+                out.append(s[i])
+                i += 1
+        return "".join(out)
+
+    assert decode(escaped) == nasty
+
+    c = Counter("t_counter", "help")
+    c.inc(path=nasty)
+    lines = [ln for ln in c.render().splitlines() if not ln.startswith("#")]
+    assert len(lines) == 1  # a raw newline would have split the sample
+    assert escaped in lines[0]
+
+
+def test_gauge_renders_gauge_type_and_set_overwrites():
+    g = Gauge("t_gauge", "a counter of gauges")  # 'counter' in help text
+    g.set(3.0, model="m")
+    g.set(1.5, model="m")
+    text = g.render()
+    assert "# TYPE t_gauge gauge" in text
+    assert _series(text, "t_gauge") == {'model="m"': 1.5}
+
+
+def test_counter_set_total_is_monotone():
+    c = Counter("t_total", "help")
+    c.set_total(5.0, model="m")
+    c.set_total(3.0, model="m")  # stale snapshot must not regress
+    assert _series(c.render(), "t_total") == {'model="m"': 5.0}
+
+
+def test_update_engine_gauges_from_scheduler_dict():
+    reg = Registry()
+    update_engine_gauges("tiny", {
+        "active_slots": [{"slot": 0}, {"slot": 1}],
+        "num_slots": 4,
+        "occupancy": 0.5,
+        "kv_utilization": 0.25,
+        "queue_depth": 3,
+        "total_prompt_tokens": 100,
+        "total_generated_tokens": 40,
+        "prefix_tokens_reused": 7,
+        "dispatches": 12,
+        "preemptions": 1,
+        "prompt_cache": {"hits": 3, "misses": 1, "hit_tokens": 96},
+        "spec_acceptance_rate": 0.8,
+        "spec_windows": 5,
+    }, registry=reg)
+    text = reg.render()
+    assert 'localai_batch_occupancy{model="tiny"} 0.5' in text
+    assert 'localai_kv_slot_utilization{model="tiny"} 0.25' in text
+    assert 'localai_prompt_cache_hit_rate{model="tiny"} 0.75' in text
+    assert 'localai_speculative_accept_rate{model="tiny"} 0.8' in text
+    assert 'localai_queue_depth{model="tiny"} 3' in text
+    # preemptions are event-sourced by EngineTelemetry only — the scrape
+    # path must NOT sync them (double-count); see obs/engine.finished
+    assert 'localai_preemptions_total{model="tiny"}' not in text
+    # an unreachable worker's error dict must not clobber anything
+    update_engine_gauges("tiny", {"error": "connection refused"},
+                         registry=reg)
+    assert 'localai_batch_occupancy{model="tiny"} 0.5' in reg.render()
+
+
+def test_registry_render_includes_engine_families_when_empty():
+    # series-less families still expose HELP/TYPE (scrapers and the CI
+    # smoke assert on family names before any traffic)
+    text = Registry().render()
+    for family in ("localai_ttft_seconds", "localai_tpot_seconds",
+                   "localai_queue_wait_seconds", "localai_batch_occupancy",
+                   "localai_prompt_cache_hit_rate",
+                   "localai_speculative_accept_rate",
+                   "localai_xla_compile_seconds_total"):
+        assert f"# TYPE {family} " in text

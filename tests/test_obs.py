@@ -1,0 +1,181 @@
+"""obs subsystem tests: span recorder + ring-buffer store, engine
+telemetry through a real Scheduler run on the tiny debug model, and the
+compile-watch wrapper."""
+
+import pytest
+
+from localai_tpu.engine.runner import ModelRunner
+from localai_tpu.engine.scheduler import GenRequest, Scheduler
+from localai_tpu.models.registry import resolve_model
+from localai_tpu.obs import (
+    EngineTelemetry,
+    Registry,
+    RequestTrace,
+    TraceStore,
+)
+from localai_tpu.obs import compile as obs_compile
+from localai_tpu.utils.tokenizer import ByteTokenizer
+
+# -- trace store -------------------------------------------------------------
+
+
+def test_span_tree_shape():
+    tr = RequestTrace("tid-1", "rid-1", model="m", prompt_tokens=5)
+    tr.begin("queued")
+    tr.end("queued")
+    tr.begin("decode")
+    tr.event("admitted", slot=2)
+    tr.end("decode", tokens=7)
+    d = tr.to_dict()
+    assert d["trace_id"] == "tid-1" and d["model"] == "m"
+    names = [c["name"] for c in d["children"]]
+    assert names == ["queued", "decode", "admitted"]
+    by_name = {c["name"]: c for c in d["children"]}
+    assert by_name["queued"]["duration_ms"] is not None
+    assert by_name["admitted"]["duration_ms"] == 0.0  # point event
+    assert by_name["decode"]["attrs"]["tokens"] == 7
+
+
+def test_end_without_begin_is_noop():
+    tr = RequestTrace("t", "r")
+    assert tr.end("never-started") is None
+    assert tr.to_dict()["children"] == []
+
+
+def test_store_ring_is_bounded_and_newest_first():
+    store = TraceStore(capacity=3)
+    for i in range(5):
+        tr = RequestTrace(f"t{i}", f"r{i}")
+        store.start(tr)
+        store.finish(tr)
+    recent = store.recent()
+    assert [t.trace_id for t in recent] == ["t4", "t3", "t2"]
+    assert store.find("t0") == []        # evicted by the ring
+    assert store.find("t4")[0].finished
+
+
+def test_store_find_matches_trace_or_request_id():
+    store = TraceStore()
+    a = RequestTrace("shared-tid", "req-a")
+    b = RequestTrace("shared-tid", "req-b")
+    for t in (a, b):
+        store.start(t)
+        store.finish(t)
+    assert len(store.find("shared-tid")) == 2
+    assert [t.request_id for t in store.find("req-b")] == ["req-b"]
+
+
+def test_active_traces_visible_before_finish():
+    store = TraceStore()
+    tr = RequestTrace("t-active", "r-active")
+    store.start(tr)
+    assert not store.recent()[0].finished
+    store.finish(tr)
+    assert store.recent()[0].finished
+
+
+# -- engine telemetry through a real scheduler run ---------------------------
+
+
+@pytest.fixture(scope="module")
+def obs_sched():
+    tiny = resolve_model("debug:tiny", dtype="float32")
+    runner = ModelRunner(
+        tiny.cfg, tiny.params, num_slots=2, max_ctx=96,
+        prefill_buckets=[16, 32], kv_dtype="float32",
+    )
+    store = TraceStore()
+    reg = Registry()
+    telemetry = EngineTelemetry(model="tiny", registry=reg, store=store)
+    s = Scheduler(runner, ByteTokenizer(), telemetry=telemetry)
+    yield s, store, reg
+    s.shutdown()
+
+
+def test_request_trace_has_lifecycle_phases_and_latencies(obs_sched):
+    sched, store, reg = obs_sched
+    tok = ByteTokenizer()
+    h = sched.generate(GenRequest(
+        prompt=tok.encode("trace me"), max_new_tokens=8, temperature=0.0,
+        trace_id="trace-test-1",
+    ))
+    assert h.finish_reason in ("stop", "length")
+    traces = store.find("trace-test-1")
+    assert len(traces) == 1
+    d = traces[0].to_dict()
+    names = [c["name"] for c in d["children"]]
+    for phase in ("queued", "prefill", "decode", "admitted", "drained"):
+        assert phase in names, f"missing {phase} in {names}"
+    assert d["finished"]
+    assert d["attrs"]["ttft_ms"] is not None
+    assert d["attrs"]["tpot_ms"] is not None
+    assert d["attrs"]["completion_tokens"] == h.completion_tokens
+    by_name = {c["name"]: c for c in d["children"]}
+    assert by_name["prefill"]["attrs"]["path"] == "full"
+    # histograms observed once
+    text = reg.render()
+    assert 'localai_ttft_seconds_count{model="tiny"} 1' in text
+    assert 'localai_requests_total' in text
+
+
+def test_cancelled_request_counts_as_preemption(obs_sched):
+    sched, store, reg = obs_sched
+    tok = ByteTokenizer()
+    h = sched.submit(GenRequest(
+        prompt=tok.encode("cancel"), max_new_tokens=400, temperature=0.0,
+        ignore_eos=True, trace_id="trace-cancel",
+    ))
+    # wait until it is actually decoding in a slot — a cancel while still
+    # queued is deliberately NOT a preemption (no slot was churned)
+    for _item in h:
+        break
+    h.cancel()
+    h.result(timeout=60)
+    assert h.finish_reason == "cancelled"
+    tr = store.find("trace-cancel")[0]
+    assert tr.finished
+    assert tr.to_dict()["attrs"]["finish_reason"] == "cancelled"
+    assert ('localai_preemptions_total{model="tiny",reason="cancelled"}'
+            in reg.render())
+    assert sched.metrics()["preemptions"] >= 1
+
+
+def test_scheduler_metrics_expose_engine_gauges(obs_sched):
+    sched, _store, _reg = obs_sched
+    m = sched.metrics()
+    assert 0.0 <= m["occupancy"] <= 1.0
+    assert 0.0 <= m["kv_utilization"] <= 1.0
+    assert m["dispatches"] >= 0
+    assert "preemptions" in m
+
+
+def test_runner_records_compile_time(obs_sched):
+    # the fixture scheduler has prefilled + decoded at least once, so the
+    # watch()-wrapped jit entries must have recorded first-call compiles
+    # (the runner wraps with the process-wide registry)
+    from localai_tpu.obs import REGISTRY
+
+    text = REGISTRY.render()
+    assert 'localai_xla_compile_total{program="prefill"}' in text
+    assert 'localai_xla_compile_seconds_total{program="prefill"}' in text
+    assert 'program="decode' in text  # decode or decode_n, per multi_step
+
+
+# -- compile watch in isolation ---------------------------------------------
+
+
+def test_watch_records_once_per_shape():
+    reg = Registry()
+    calls = []
+
+    def fake_jit(x, *, bucket):
+        calls.append((x, bucket))
+        return x
+
+    watched = obs_compile.watch(fake_jit, "prog", registry=reg)
+    watched(1, bucket=16)
+    watched(2, bucket=16)  # seen shape — not a compile
+    watched(3, bucket=32)  # new static arg — compile
+    text = reg.render()
+    assert 'localai_xla_compile_total{program="prog"} 2' in text
+    assert len(calls) == 3

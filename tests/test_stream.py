@@ -1,0 +1,56 @@
+"""engine/stream.py stop-sequence handling: holdback of stop strings split
+across token boundaries, and flush() emitting the held tail exactly once."""
+
+from localai_tpu.engine.stream import StopChecker
+
+
+def test_stop_split_across_token_boundaries_is_withheld():
+    sc = StopChecker(["STOP"])
+    emitted = sc.push("hello ST")  # "ST" could begin "STOP" — held back
+    assert emitted == "hello "
+    emitted += sc.push("OP ignored tail")
+    assert sc.stopped == "STOP"
+    assert emitted == "hello "          # the stop text itself never leaks
+    assert sc.flush() == ""             # after a hit there is no tail
+
+
+def test_three_way_split_stop():
+    sc = StopChecker(["<|end|>"])
+    out = sc.push("abc<|") + sc.push("en") + sc.push("d|>xyz")
+    assert out == "abc"
+    assert sc.stopped == "<|end|>"
+
+
+def test_flush_emits_held_tail_exactly_once():
+    sc = StopChecker(["STOP"])
+    out = sc.push("partial ST")        # "ST" held back as a possible prefix
+    assert out == "partial "
+    assert sc.flush() == "ST"          # no stop hit → the tail is real text
+    assert sc.flush() == ""            # second flush must not re-emit
+
+
+def test_false_prefix_released_when_disproven():
+    sc = StopChecker(["STOP"])
+    out = sc.push("S") + sc.push("T") + sc.push("ART")
+    # "START" disproves the "ST" prefix; everything must come through,
+    # except a suffix that could still begin a new stop ("T" here is not
+    # a prefix of STOP, so nothing is held)
+    out += sc.flush()
+    assert out == "START"
+    assert sc.stopped is None
+
+
+def test_multiple_stops_hold_longest_candidate():
+    sc = StopChecker(["\n\n", "###"])
+    out = sc.push("text##")
+    assert out == "text"               # "##" could begin "###"
+    out += sc.push("#")
+    assert sc.stopped == "###"
+    assert out == "text"
+
+
+def test_no_stops_passthrough():
+    sc = StopChecker([])
+    assert sc.push("anything at all") == "anything at all"
+    assert sc.flush() == ""
+    assert sc.stopped is None
