@@ -35,6 +35,24 @@ is the physical ceiling for int8 (int4 raises it to ~4).
 SECONDARY metric: the rounds-1-3 1B-class config (800 tok/s baseline proxy,
 same constant as before). Round-3 reference points, same chip (2026-07-30):
 int8 1246 tok/s (XLA decode, pre-Pallas-int8), bf16 1180.
+
+STALL FORENSICS (round 6, obs subsystem): the r3/r4/r5 failure mode is a
+dead axon tunnel that hangs a dispatch silently. Every phase now runs under
+the obs.watchdog stall detector (no heartbeat for BENCH_STALL_S, default
+90 s → the phase is abandoned, its thread left parked, and the run moves
+on) and the device is liveness-probed (obs.device.probe_device, a tiny jit
+round-trip joined with a timeout) before the first phase and after any
+stall. Extra output fields:
+
+  "device_health": {"ok", "seconds", "error", "device"} — the LAST probe
+      result (after-stall probes overwrite the boot probe, so a dead
+      tunnel shows up here, not just as a missing number);
+  "stall_phase":   the phase label ("bench:<preset>:<quant>") whose
+      dispatch heartbeat went silent past BENCH_STALL_S;
+  "stall_age_s":   seconds of silence when the watchdog tripped.
+
+A failed boot probe skips all device phases and reports value 0.0 with the
+probe error in "note" — seconds spent, not the 1320 s budget.
 """
 
 import json
@@ -49,8 +67,20 @@ BASELINES = {
 }
 
 
+def _apply_platform() -> None:
+    """Smoke runs: sitecustomize presets JAX_PLATFORMS=axon before any env
+    override can land, so route via jax.config (honored until the backend
+    initializes — same trick as tests/conftest.py). Idempotent; must run
+    before the FIRST jax dispatch (including the device probe)."""
+    if os.environ.get("BENCH_PLATFORM"):
+        import jax
+
+        jax.config.update("jax_platforms", os.environ["BENCH_PLATFORM"])
+
+
 def run_decode_bench(preset: str, quant: str, steps: int, multi: int,
-                     depth: int, num_slots: int = 8, max_ctx: int = 1024):
+                     depth: int, num_slots: int = 8, max_ctx: int = 1024,
+                     watchdog=None, channel: str = "bench"):
     """Prefill 8 slots, then timed pipelined multi-step decode.
 
     Returns aggregate decode tok/s. The pipelined loop is the scheduler's
@@ -58,17 +88,22 @@ def run_decode_bench(preset: str, quant: str, steps: int, multi: int,
     one compiled lax.scan program (amortizing dispatch/tunnel RTT);
     `depth` dispatches stay in flight with async D2H copies, so neither the
     device nor the host round-trip sits on the critical path.
+
+    ``watchdog``/``channel``: each milestone (weights ready, runner built,
+    every admit, every drained dispatch) heartbeats the stall watchdog —
+    the hang point of a dead tunnel is whichever blocking call stopped the
+    pulses, and the caller abandons the phase instead of the budget.
     """
     from collections import deque
 
     import jax
 
-    if os.environ.get("BENCH_PLATFORM"):
-        # smoke runs: sitecustomize presets JAX_PLATFORMS=axon before any
-        # env override can land, so route via jax.config (honored until the
-        # backend initializes — same trick as tests/conftest.py)
-        jax.config.update("jax_platforms", os.environ["BENCH_PLATFORM"])
+    _apply_platform()
     import numpy as np
+
+    def pulse() -> None:
+        if watchdog is not None:
+            watchdog.pulse(channel)
 
     from localai_tpu.engine.runner import ModelRunner
     from localai_tpu.models.registry import (
@@ -88,21 +123,25 @@ def run_decode_bench(preset: str, quant: str, steps: int, multi: int,
         model = resolve_model(f"debug:{preset}", dtype="bfloat16")
         cfg, params = model.cfg, model.params
     jax.block_until_ready(jax.tree.leaves(params)[0])
+    pulse()
 
     runner = ModelRunner(
         cfg, params, num_slots=num_slots, max_ctx=max_ctx,
         prefill_buckets=[128], kv_dtype=kv_dtype,
     )
+    pulse()
 
     prompt = list(range(1, 101))  # 100-token synthetic prompt
     for _ in range(num_slots):
         slot = runner.acquire_slot()
         runner.admit(slot, prompt, temperature=0.0)
+        pulse()
 
     # warmup (compile + first dispatches)
     runner.step_n(multi)
     runner.step_n(multi)
     jax.block_until_ready(runner.state.tokens)
+    pulse()
 
     dispatches = max(1, steps // multi)
     t0 = time.perf_counter()
@@ -116,8 +155,10 @@ def run_decode_bench(preset: str, quant: str, steps: int, multi: int,
         q.append(toks)
         if len(q) >= depth:
             np.asarray(q.popleft())
+            pulse()
     while q:
         np.asarray(q.popleft())
+        pulse()
     dt = time.perf_counter() - t0
     return dispatches * multi * num_slots / dt
 
@@ -128,10 +169,31 @@ class _Board:
     def __init__(self):
         self.lock = threading.Lock()
         self.result = None       # current best primary line (dict)
+        self.extras = {}         # forensics merged at flush (device_health,
+                                 # stall_phase, ...) — never the metric keys
         self.printed = False
+        # thread idents of ABANDONED stalled phases: if the tunnel comes
+        # back minutes later and the parked thread finishes, its timing
+        # includes the hang — a poisoned number that must never reach the
+        # board (it could replace a good primary via the promote branch)
+        self.dead_threads: set = set()
+
+    def abandon_current_thread_of(self, ident: int) -> None:
+        with self.lock:
+            self.dead_threads.add(ident)
+
+    def thread_dead(self) -> bool:
+        with self.lock:
+            return threading.get_ident() in self.dead_threads
+
+    def annotate(self, key: str, value) -> None:
+        with self.lock:
+            self.extras[key] = value
 
     def offer(self, result: dict, primary: bool) -> None:
         with self.lock:
+            if threading.get_ident() in self.dead_threads:
+                return  # a stalled phase's late result is not a measurement
             if self.result is None:
                 self.result = result
             elif primary and self.result.get("value"):
@@ -151,16 +213,18 @@ class _Board:
             if self.printed:
                 return
             self.printed = True
-            out = self.result or {
+            out = dict(self.result or {
                 "metric": "decode_throughput", "value": 0.0, "unit": "tok/s",
                 "vs_baseline": 0.0, "note": "no phase completed in budget",
-            }
+            })
+            out.update(self.extras)
             sys.stdout.write(json.dumps(out) + "\n")
             sys.stdout.flush()
 
 
 def _measure(board: _Board, preset: str, quant: str, steps: int, multi: int,
-             depth: int, primary: bool) -> None:
+             depth: int, primary: bool, watchdog=None,
+             channel: str = "bench") -> None:
     short = "llama8b" if "8b" in preset else "llama1b" if "1b" in preset \
         else preset
     base = BASELINES.get(short, 800.0)
@@ -170,7 +234,8 @@ def _measure(board: _Board, preset: str, quant: str, steps: int, multi: int,
     # comparisons never silently mix the two
     w8k = "_w8k" if os.environ.get("LOCALAI_W8_KERNEL") else ""
     try:
-        tok_s = run_decode_bench(preset, quant, steps, multi, depth)
+        tok_s = run_decode_bench(preset, quant, steps, multi, depth,
+                                 watchdog=watchdog, channel=channel)
         board.offer({
             "metric": f"decode_throughput_{short}_bs8_{quant}{w8k}",
             "value": round(tok_s, 2),
@@ -187,7 +252,7 @@ def _measure(board: _Board, preset: str, quant: str, steps: int, multi: int,
             "vs_baseline": 0.0,
             "note": note,
         }, primary and board.result is None)
-        if primary:
+        if primary and not board.thread_dead():
             # a crashed north-star phase must stay diagnosable no matter
             # which line ends up printing — annotate it under its own key
             with board.lock:
@@ -212,6 +277,15 @@ def main() -> None:
     # tunnel — 480 leaves margin for a slow compile without risking the board
     min_8b = float(os.environ.get("BENCH_8B_MIN_S", "480"))
     deadline = time.monotonic() + budget
+
+    stall_s = float(os.environ.get("BENCH_STALL_S", "90"))
+    probe_timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT_S", "30"))
+    # obs.watchdog/device import no jax at module level — safe pre-init
+    from localai_tpu.obs.device import probe_device
+    from localai_tpu.obs.watchdog import Watchdog
+
+    wd = Watchdog(deadline=stall_s, poll_interval=max(1.0, stall_s / 8))
+    wd.start()
 
     board = _Board()
     phases: list[tuple] = []
@@ -243,16 +317,67 @@ def main() -> None:
             return
         os.environ["LOCALAI_W8_KERNEL"] = "1"
         try:
-            t_on = run_decode_bench("1b", "int8", steps, multi, depth)
+            t_on = run_decode_bench("1b", "int8", steps, multi, depth,
+                                    watchdog=wd, channel="bench:w8probe")
         except Exception:  # noqa: BLE001 — probe failure → stay off
             t_on = 0.0
+        if board.thread_dead():
+            # this probe stalled and was abandoned: its timing includes the
+            # hang, and the kernel it was validating must stay OFF
+            os.environ.pop("LOCALAI_W8_KERNEL", None)
+            return
         if t_on > base_line["value"] * 1.03:
             with board.lock:
                 board.result["w8_kernel_tok_s"] = round(t_on, 2)
         else:
             os.environ.pop("LOCALAI_W8_KERNEL", None)
 
+    def guarded(label: str, fn) -> bool:
+        """Run one phase in its own daemon thread under watchdog channel
+        ``label``. Returns False on stall or budget exhaustion — the hung
+        thread is ABANDONED (left parked on its dead dispatch; daemon, so
+        it cannot keep the process alive past the hard exit) and its
+        channel left armed so the forensic trace stands."""
+        done = threading.Event()
+
+        def run():
+            try:
+                fn()
+            finally:
+                done.set()
+
+        wd.arm(label)
+        t = threading.Thread(target=run, daemon=True,
+                             name=f"bench-{label}")
+        t.start()
+        while not done.wait(1.0):
+            if wd.stalled(label):
+                st = wd.status().get(label, {})
+                board.annotate("stall_phase", label)
+                board.annotate(
+                    "stall_age_s",
+                    st.get("last_progress_age_seconds", stall_s))
+                board.abandon_current_thread_of(t.ident)
+                return False
+            if time.monotonic() >= deadline:
+                board.abandon_current_thread_of(t.ident)
+                return False
+        wd.disarm(label)
+        return True
+
     def work():
+        _apply_platform()  # must precede the first jax use (the probe)
+        probe = probe_device(timeout=probe_timeout)
+        board.annotate("device_health", probe.to_dict())
+        if not probe.ok:
+            # dead tunnel detected in seconds: report it instead of
+            # burning the budget discovering it one hung phase at a time
+            board.offer({
+                "metric": "decode_throughput", "value": 0.0,
+                "unit": "tok/s", "vs_baseline": 0.0,
+                "note": f"device probe failed: {probe.error}",
+            }, primary=True)
+            return
         has_8b = any("8b" in p for p, _, _ in phases)
         for p, q, primary in phases:
             remaining = deadline - time.monotonic()
@@ -260,9 +385,29 @@ def main() -> None:
                 return
             if "8b" in p and remaining < min_8b:
                 return  # can't fit the 8B phase — the 1B line stands
-            _measure(board, p, q, steps, multi, depth, primary)
+            label = f"bench:{p}:{q}"
+            ok = guarded(label, lambda p=p, q=q, primary=primary: _measure(
+                board, p, q, steps, multi, depth, primary,
+                watchdog=wd, channel=label))
+            if not ok:
+                # the phase skipped forward; ask the device whether there
+                # is any point continuing (a recovered transient keeps the
+                # remaining phases; a dead tunnel ends the run now)
+                after = probe_device(timeout=min(probe_timeout, 15.0))
+                board.annotate("device_health", after.to_dict())
+                if not after.ok:
+                    return
+                continue
             if p == "1b" and q == "int8" and has_8b and quant == "int8":
-                probe_w8_kernel()
+                if not guarded("bench:w8probe", probe_w8_kernel):
+                    # a stalled probe must not leave the unvalidated
+                    # kernel force-enabled for the 8B phase, and a dead
+                    # tunnel should end the run here, not one stall later
+                    os.environ.pop("LOCALAI_W8_KERNEL", None)
+                    after = probe_device(timeout=min(probe_timeout, 15.0))
+                    board.annotate("device_health", after.to_dict())
+                    if not after.ok:
+                        return
 
     t = threading.Thread(target=work, daemon=True)
     t.start()

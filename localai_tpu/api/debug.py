@@ -1,0 +1,120 @@
+"""Introspection endpoints: device health, program costs, thread stacks.
+
+``GET /debug/devices`` — per-device liveness + memory: a timeout-guarded
+jit probe (``?probe=0`` skips the device dispatch, ``?probe_timeout=S``
+bounds it), ``memory_stats()`` where the backend has an allocator, a
+live-array HBM census attributed to KV cache vs weights vs other, and the
+stall watchdog's channel table. The "is my TPU actually alive and what is
+eating its HBM" view.
+
+``GET /debug/programs`` — the compiled-program cost catalog: per watched
+jit entry, XLA ``cost_analysis``/``memory_analysis`` (FLOPs, bytes
+accessed, temp/output sizes) joined with the scheduler's measured
+per-dispatch latency into achieved GFLOP/s, GB/s, and fractions of the
+device roofline — the direct answer to "where does the decode bandwidth
+go". The first call lazily re-lowers each program from its recorded
+abstract signature (``?harvest=0`` lists without compiling).
+
+``GET /debug/stacks`` — every live thread's stack, on demand (the same
+payload the watchdog dumps on a stall, for when an operator wants it
+BEFORE the deadline).
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+from aiohttp import web
+
+from localai_tpu.obs import compile as obs_compile
+from localai_tpu.obs import device as obs_device
+from localai_tpu.obs import watchdog as obs_watchdog
+
+
+def _state(request: web.Request):
+    from localai_tpu.api.server import STATE_KEY
+
+    return request.app[STATE_KEY]
+
+
+def _runners(state) -> list:
+    out = []
+    for sm in state.manager.loaded_snapshot().values():
+        runner = getattr(sm, "runner", None)
+        if runner is not None:
+            out.append(runner)
+    return out
+
+
+async def devices(request: web.Request) -> web.Response:
+    state = _state(request)
+    want_probe = request.query.get("probe", "1") != "0"
+    try:
+        probe_timeout = float(request.query.get("probe_timeout", 5.0))
+    except ValueError:
+        raise web.HTTPBadRequest(text="probe_timeout must be a number")
+    if not probe_timeout > 0:  # rejects 0, negatives, and NaN
+        raise web.HTTPBadRequest(text="probe_timeout must be positive")
+    # hard cap: the probe join blocks one shared api-wait executor thread;
+    # an unbounded (or inf) timeout against a wedged device would let a
+    # key holder pin the pool one request at a time
+    probe_timeout = min(probe_timeout, 120.0)
+    loop = asyncio.get_running_loop()
+
+    def build() -> dict:
+        runners = _runners(state)
+        report: dict = {
+            "devices": obs_device.device_memory(),
+            "census": obs_device.hbm_census(
+                obs_device.known_arrays(runners)),
+            "watchdog": obs_watchdog.WATCHDOG.status(),
+            "roofline": obs_device.roofline(),
+        }
+        if want_probe:
+            # the probe itself is timeout-guarded; a wedged device costs
+            # this handler probe_timeout seconds, not forever
+            report["probe"] = obs_device.probe_device(
+                timeout=probe_timeout).to_dict()
+        return report
+
+    return web.json_response(
+        await loop.run_in_executor(state.executor, build))
+
+
+async def programs(request: web.Request) -> web.Response:
+    state = _state(request)
+    harvest = request.query.get("harvest", "1") != "0"
+    loop = asyncio.get_running_loop()
+
+    def build() -> dict:
+        # feed the catalog the live schedulers' measured step EMAs so a
+        # report right after boot still joins a latency (the drain-time
+        # note_latency feed is authoritative once traffic flows)
+        for sm in state.manager.loaded_snapshot().values():
+            sched = getattr(sm, "scheduler", None)
+            ema = getattr(sched, "_step_ema", None)
+            steps = getattr(sched, "last_dispatch_steps", 0)
+            if ema and steps:
+                prog = "decode" if steps == 1 else "decode_n"
+                obs_compile.note_latency(prog, ema * steps, steps=steps)
+        rl = obs_device.roofline()
+        return {
+            "roofline": rl,
+            "programs": obs_compile.CATALOG.report(
+                roofline=rl, harvest=harvest),
+        }
+
+    return web.json_response(
+        await loop.run_in_executor(state.executor, build))
+
+
+async def stacks(request: web.Request) -> web.Response:
+    return web.json_response({"threads": obs_watchdog.dump_stacks()})
+
+
+def routes() -> list[web.RouteDef]:
+    return [
+        web.get("/debug/devices", devices),
+        web.get("/debug/programs", programs),
+        web.get("/debug/stacks", stacks),
+    ]

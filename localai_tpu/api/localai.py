@@ -67,11 +67,23 @@ async def metrics(request: web.Request) -> web.Response:
     # refresh token/slot/engine series from live engine state at scrape
     # time (counters are monotone: scheduler totals only grow; gauges are
     # point-in-time) — the decode loop itself never touches the registry
+    from localai_tpu.obs.device import update_device_gauges
     from localai_tpu.obs.metrics import update_engine_gauges
 
-    for name, m in _state(request).manager.metrics().items():
+    state = _state(request)
+    for name, m in state.manager.metrics().items():
         if isinstance(m, dict):
             update_engine_gauges(name, m)
+    # device health at scrape time is host metadata only (memory_stats +
+    # live-array census) — never a device dispatch: a scrape must not
+    # queue work behind a wedged tunnel (the probe lives in /debug/devices)
+    runners = [
+        r for r in (
+            getattr(sm, "runner", None)
+            for sm in state.manager.loaded_snapshot().values()
+        ) if r is not None
+    ]
+    update_device_gauges(runners)
     return web.Response(
         text=REGISTRY.render(),
         content_type="text/plain",

@@ -10,6 +10,7 @@ streaming maps directly onto StreamResponse).
 
 from __future__ import annotations
 
+import contextvars
 import logging
 import secrets
 import time
@@ -25,6 +26,7 @@ from localai_tpu.api.schema import error_body
 from localai_tpu.config.app_config import AppConfig
 from localai_tpu.config.loader import ConfigLoader
 from localai_tpu.models.manager import ModelManager
+from localai_tpu.obs import logging as obs_logging
 from localai_tpu.obs import trace as obs_trace
 
 log = logging.getLogger(__name__)
@@ -36,7 +38,8 @@ TRACE_KEY = "trace_id"
 # observability/probe endpoints whose HTTP spans are pure scrape noise:
 # they still get a trace id, but are not recorded into the trace store
 # (a 15s Prometheus scrape would otherwise dominate the http ring)
-TRACE_SKIP = {"/metrics", "/healthz", "/readyz", "/v1/traces"}
+TRACE_SKIP = {"/metrics", "/healthz", "/readyz", "/v1/traces",
+              "/debug/devices", "/debug/programs", "/debug/stacks"}
 TRACE_SKIP_PREFIXES = ("/debug/timeline/",)
 
 # paths reachable without an API key (parity: auth exemption filter,
@@ -48,6 +51,18 @@ AUTH_EXEMPT = {"/", "/healthz", "/readyz", "/version", "/swagger",
 # UI documents are key-free to GET (they hold no data; their JS calls the
 # protected JSON APIs with the key the operator enters in the page header)
 from localai_tpu.api.ui import UI_EXACT, UI_PREFIXES  # noqa: E402
+
+
+class ContextExecutor(ThreadPoolExecutor):
+    """ThreadPoolExecutor that copies the caller's contextvars into the
+    worker thread. ``loop.run_in_executor`` does NOT do this, so without
+    it every log line from a blocking engine wait (lazy model load, the
+    generation join) would lose the request's bound trace id
+    (obs.logging) and break the JSON-log ↔ trace join."""
+
+    def submit(self, fn, /, *args, **kwargs):
+        ctx = contextvars.copy_context()
+        return super().submit(lambda: ctx.run(fn, *args, **kwargs))
 
 
 class AppState:
@@ -70,8 +85,9 @@ class AppState:
         from localai_tpu.stores import StoreRegistry
 
         self.stores = StoreRegistry()
-        # blocking engine waits run here, off the event loop
-        self.executor = ThreadPoolExecutor(
+        # blocking engine waits run here, off the event loop (contextvar-
+        # propagating: executor-side log lines keep the request trace id)
+        self.executor = ContextExecutor(
             max_workers=32, thread_name_prefix="api-wait"
         )
         # dynamic config: api_keys.json / external_backends.json hot-reload
@@ -178,6 +194,10 @@ async def trace_middleware(request: web.Request, handler):
            or request.headers.get("X-Correlation-ID")
            or obs_trace.new_trace_id())
     request[TRACE_KEY] = tid
+    # bind for structured logging: every log line emitted from this
+    # request's context (handlers run as one asyncio task; contextvars
+    # isolate concurrent requests) carries the trace id in JSON mode
+    log_token = obs_logging.bind_trace_id(tid)
     t0 = time.monotonic()
     status = 500
     try:
@@ -190,6 +210,7 @@ async def trace_middleware(request: web.Request, handler):
         status = e.status
         raise
     finally:
+        obs_logging.unbind_trace_id(log_token)
         if (request.path not in TRACE_SKIP
                 and not request.path.startswith(TRACE_SKIP_PREFIXES)):
             tr = obs_trace.RequestTrace(
@@ -289,11 +310,13 @@ def create_app(state: Optional[AppState] = None) -> web.Application:
         from localai_tpu.api import ui as ui_routes
 
         app.add_routes(ui_routes.routes())
+    from localai_tpu.api import debug as debug_routes
     from localai_tpu.api import openapi as openapi_routes
     from localai_tpu.api import traces as traces_routes
 
     app.add_routes(openapi_routes.routes())
     app.add_routes(traces_routes.routes())
+    app.add_routes(debug_routes.routes())
 
     async def on_cleanup(_app):
         state.shutdown()

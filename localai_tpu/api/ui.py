@@ -587,12 +587,43 @@ refresh();
     return _page("Swarm", body, script)
 
 
+def _norm_router(url: str):
+    """(scheme, host, port, path) canonical form for allowlist comparison:
+    scheme/host lowercased, default ports made explicit, trailing slash
+    dropped — so ``HTTP://Router:80/`` and ``http://router`` compare equal
+    (ADVICE r5 #3: exact-string comparison rejected benign variants of the
+    configured router). None for anything that is not plain http(s) or
+    carries userinfo."""
+    from urllib.parse import urlsplit
+
+    try:
+        parts = urlsplit(url)
+    except ValueError:
+        return None
+    scheme = (parts.scheme or "").lower()
+    if scheme not in ("http", "https"):
+        return None
+    if parts.username is not None or parts.password is not None:
+        return None
+    try:
+        port = parts.port
+    except ValueError:
+        return None
+    host = (parts.hostname or "").lower()
+    return (scheme, host, port or (443 if scheme == "https" else 80),
+            parts.path.rstrip("/"))
+
+
 async def swarm_nodes(request: web.Request) -> web.Response:
     """GET /swarm/nodes?router=URL — server-side registry fetch.
 
-    The target is restricted to an allowlist (the configured federation
-    router plus loopback) so an API-key holder can't use the server as an
-    internal-network probe (ADVICE r4)."""
+    The target is restricted to the configured allowlist
+    (federated_router / swarm_routers, compared in canonical
+    scheme/host/port form) so an API-key holder can't use the server as an
+    internal-network probe (ADVICE r4). The only exemption is loopback AT
+    THIS SERVER'S OWN PORT — the colocated-router case — not loopback at
+    large, which would let a key holder sweep every local service's ports
+    (ADVICE r5 #3)."""
     from localai_tpu.federation.explorer import fetch_nodes
 
     router = request.query.get("router", "http://127.0.0.1:8080")
@@ -602,25 +633,22 @@ async def swarm_nodes(request: web.Request) -> web.Response:
         # a query/fragment would neutralize the appended /federated/nodes
         # suffix and turn the proxy into a generic URL fetcher
         raise web.HTTPBadRequest(text="router URL must not carry a query")
-    from urllib.parse import urlsplit
-
-    try:
-        parts = urlsplit(router)
-    except ValueError:
-        raise web.HTTPBadRequest(text="malformed router URL")
-    if parts.username is not None or parts.password is not None:
+    target = _norm_router(router)
+    if target is None:
         # userinfo would desynchronize any naive host check from where
-        # urlopen actually connects
-        raise web.HTTPBadRequest(text="router URL must not carry userinfo")
+        # urlopen actually connects; same for malformed URLs
+        raise web.HTTPBadRequest(
+            text="malformed router URL (no userinfo, http(s) only)")
     cfg = getattr(_state(request), "config", None)
     allowed = {
-        r.strip().rstrip("/") for r in (
+        _norm_router(r.strip()) for r in (
             getattr(cfg, "federated_router", ""),
             getattr(cfg, "swarm_routers", "") or "",
         ) for r in r.split(",") if r.strip()
-    }
-    if router.rstrip("/") not in allowed and parts.hostname not in (
-            "127.0.0.1", "localhost", "::1"):
+    } - {None}
+    own_port = target[1] in ("127.0.0.1", "localhost", "::1") and (
+        target[2] == getattr(cfg, "port", None))
+    if target not in allowed and not own_port:
         raise web.HTTPForbidden(
             text="router not in the configured allowlist "
                  "(federated_router / swarm_routers)")

@@ -38,6 +38,11 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument("--log-level", default=_env_default("log_level", "info"),
                    choices=["error", "warn", "info", "debug", "trace"])
+    p.add_argument("--log-format",
+                   default=_env_default("log_format", "text"),
+                   choices=["text", "json"],
+                   help="json = one structured object per line, with the "
+                        "request trace id bound by the API middleware")
     sub = p.add_subparsers(dest="command")
 
     run = sub.add_parser("run", help="start the API server (default)")
@@ -293,10 +298,10 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     level = {"error": logging.ERROR, "warn": logging.WARNING,
              "info": logging.INFO, "debug": logging.DEBUG,
              "trace": logging.DEBUG}[args.log_level]
-    logging.basicConfig(
-        level=level,
-        format="%(asctime)s %(levelname).1s %(name)s: %(message)s",
-    )
+    # obs.logging imports no jax — safe before the backend initializes
+    from localai_tpu.obs import logging as obs_logging
+
+    obs_logging.setup(args.log_format, level)
 
     cmd = args.command or "run"
     if cmd == "version":

@@ -30,9 +30,10 @@ import numpy as np
 from localai_tpu.engine import kvcache as kvc
 from localai_tpu.engine import sampling as smp
 from localai_tpu.engine.kvcache import KVCache
-from localai_tpu.obs import compile as obs_compile
 from localai_tpu.models import llama as mdl
 from localai_tpu.models.llama import LlamaConfig
+from localai_tpu.obs import compile as obs_compile
+from localai_tpu.obs import watchdog as obs_watchdog
 from localai_tpu.utils.jaxcompat import shard_map
 
 log = logging.getLogger(__name__)
@@ -91,6 +92,10 @@ class ModelRunner:
 
         self.cfg = cfg
         self.params = params
+        # stall watchdog guarding this runner's blocking device round-trips
+        # (the scheduler rebinds it to its own instance when injected); the
+        # process-wide default is unstarted until a Scheduler starts it
+        self.watchdog = obs_watchdog.WATCHDOG
         # self-extend / group attention (parity: llama.cpp ga_n/ga_w slot
         # options — see engine.selfextend). ga_n>1 serves past the trained
         # context by merging neighbor + grouped attention scores; the KV
@@ -170,8 +175,11 @@ class ModelRunner:
             from localai_tpu.parallel import sharding as shd
 
             # the Pallas w8 matmul has no partitioning rule — GSPMD would
-            # all-gather sharded weights into it every step
-            qnt.block_w8_kernel("runner built over a device mesh")
+            # all-gather sharded weights into it every step. The block is
+            # carried by THIS runner's tensors (kernel_ok metadata), so a
+            # single-device runner built later keeps the kernel opt-in.
+            self.params = params = qnt.block_w8_kernel_params(
+                params, "runner built over a device mesh")
             shd.slots_per_data_shard(num_slots, mesh)  # divisibility check
             kv_sharding = NamedSharding(mesh, shd.kv_spec(cfg, mesh))
         self.kv = kvc.init_cache(
@@ -751,8 +759,10 @@ class ModelRunner:
             )
         self._active_slots.add(slot)
         # the first sampled token seeds the host-side stream state; this
-        # one admit-time sync is the prefill/decode handoff point
-        return int(tok)  # jaxlint: disable=host-sync-in-hot-path
+        # one admit-time sync is the prefill/decode handoff point (guarded:
+        # a dead tunnel would otherwise hang here silently forever)
+        with self.watchdog.guard("device"):
+            return int(tok)  # jaxlint: disable=host-sync-in-hot-path
 
     def reusable_prefix(self, slot: int, resident: Optional[list[int]],
                         prompt: list[int],
@@ -802,7 +812,8 @@ class ModelRunner:
         self.kv, self.state, tokens = self._decode(
             self.params, self.kv, self.state
         )
-        return np.asarray(tokens)  # jaxlint: disable=host-sync-in-hot-path
+        with self.watchdog.guard("device"):
+            return np.asarray(tokens)  # jaxlint: disable=host-sync-in-hot-path
 
     def step_async(self) -> jax.Array:
         """Like step() but returns the device array without synchronizing —
@@ -819,7 +830,8 @@ class ModelRunner:
         self.kv, self.state, tokens = self._decode_n(
             self.params, self.kv, self.state, n=n
         )
-        return np.asarray(tokens)  # jaxlint: disable=host-sync-in-hot-path
+        with self.watchdog.guard("device"):
+            return np.asarray(tokens)  # jaxlint: disable=host-sync-in-hot-path
 
     def step_n_async(self, n: int) -> jax.Array:
         """Like step_n() but returns the [n, S] device array without
@@ -838,7 +850,8 @@ class ModelRunner:
         )
         # synchronous by contract: the frozen slots' constraint masks need
         # the sampled token on the host before the next dispatch
-        return np.asarray(tokens)  # jaxlint: disable=host-sync-in-hot-path
+        with self.watchdog.guard("device"):
+            return np.asarray(tokens)  # jaxlint: disable=host-sync-in-hot-path
 
     def embed(self, prompt: list[int]) -> np.ndarray:
         """[D] float32 embedding of a token sequence (bucketed like prefill)."""
@@ -886,9 +899,10 @@ class ModelRunner:
         int() reads would multiply the device sync by the candidate
         count."""
         # single batched admit-time read — the one deliberate sync here
-        return np.asarray(  # jaxlint: disable=host-sync-in-hot-path
-            self.state.positions
-        )
+        with self.watchdog.guard("device"):
+            return np.asarray(  # jaxlint: disable=host-sync-in-hot-path
+                self.state.positions
+            )
 
     def slot_position(self, slot: int) -> int:
         return int(self.slot_positions()[slot])

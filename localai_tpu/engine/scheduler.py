@@ -34,6 +34,8 @@ import numpy as np
 
 from localai_tpu.engine.runner import ModelRunner
 from localai_tpu.engine.stream import IncrementalDetokenizer, StopChecker
+from localai_tpu.obs import compile as obs_compile
+from localai_tpu.obs import watchdog as obs_watchdog
 from localai_tpu.obs.engine import EngineTelemetry
 
 log = logging.getLogger(__name__)
@@ -189,12 +191,23 @@ class Scheduler:
                  spec: Optional[Any] = None,
                  prompt_cache: Optional[Any] = None,
                  prompt_cache_all: bool = False,
-                 telemetry: Optional[EngineTelemetry] = None):
+                 telemetry: Optional[EngineTelemetry] = None,
+                 watchdog: Optional[obs_watchdog.Watchdog] = None):
         self.runner = runner
         self.tokenizer = tokenizer
         # request-lifecycle spans + engine histograms (obs subsystem); the
         # manager names it after the model, tests may inject their own
         self.telemetry = telemetry or EngineTelemetry()
+        # stall watchdog: every blocking device round-trip this engine
+        # makes (drain here, syncs inside the runner) is heartbeat-guarded;
+        # no progress past the deadline → engine_stalled gauge + a
+        # thread-stack forensic span (obs.watchdog). The runner shares the
+        # instance so "device" and "engine" channels trip together.
+        self.watchdog = watchdog or obs_watchdog.WATCHDOG
+        runner.watchdog = self.watchdog
+        self._wd_channel = (f"engine:{self.telemetry.model}"
+                            if self.telemetry.model else "engine")
+        self.watchdog.start()
         # speculative decoding (engine.speculative.SpecDecoder): when set and
         # no grammar constraint is active, dispatches run draft+verify
         # windows instead of plain multi-step decode. Slot lifecycle ops
@@ -368,8 +381,11 @@ class Scheduler:
             toks, seq, k, pipelined, t_issue, fresh = inflight.popleft()
             # the designed drain point: copy_to_host_async started this
             # D2H at dispatch time, so materializing here overlaps with
-            # the next dispatch already running on device
-            rows = np.asarray(toks)  # jaxlint: disable=host-sync-in-hot-path
+            # the next dispatch already running on device. Watchdog-guarded:
+            # a dead tunnel parks this exact line forever, and the stall
+            # forensics must say so.
+            with self.watchdog.guard(self._wd_channel):
+                rows = np.asarray(toks)  # jaxlint: disable=host-sync-in-hot-path
             now = time.monotonic()
             if k == 0 and self.spec is not None:  # speculative window
                 self.spec.observe_window(rows)
@@ -381,9 +397,14 @@ class Scheduler:
             # dispatch of a new program shape is skipped — it pays compile.
             if not fresh and k > 0:
                 if pipelined and self._last_drain_t is not None:
-                    self._observe_step_time((now - self._last_drain_t) / k)
+                    dt = now - self._last_drain_t
                 else:
-                    self._observe_step_time((now - t_issue) / k)
+                    dt = now - t_issue
+                self._observe_step_time(dt / k)
+                # measured per-dispatch latency feeds the compiled-program
+                # cost catalog (achieved-vs-roofline at /debug/programs)
+                obs_compile.note_latency(
+                    "decode_n" if k > 1 else "decode", dt, steps=k)
             self._last_drain_t = now
             if rows.ndim == 1:
                 rows = rows[None]
@@ -426,7 +447,9 @@ class Scheduler:
                         t0 = time.monotonic()
                         rows = self.runner.step()[None]
                         if not fresh:
-                            self._observe_step_time(time.monotonic() - t0)
+                            dt = time.monotonic() - t0
+                            self._observe_step_time(dt)
+                            obs_compile.note_latency("decode", dt, steps=1)
                         self.last_dispatch_steps = 1
                         self._process_rows(rows, self._dispatch_seq)
                     else:
@@ -436,9 +459,10 @@ class Scheduler:
                         t0 = time.monotonic()
                         rows = self.runner.step_frozen_n(freeze, steps)
                         if not fresh:
-                            self._observe_step_time(
-                                (time.monotonic() - t0) / steps
-                            )
+                            dt = time.monotonic() - t0
+                            self._observe_step_time(dt / steps)
+                            obs_compile.note_latency(
+                                "decode_frozen_n", dt, steps=steps)
                         self.last_dispatch_steps = steps
                         self._process_rows(
                             rows, self._dispatch_seq, frozen=constrained

@@ -129,47 +129,85 @@ class DiscoveryMonitor:
                   count_failures: bool = True) -> None:
         """One dial-test sweep (the testable unit).
 
-        ``only`` restricts the sweep to a subset of routers (dashboard
-        first-render warm-up); ``count_failures=False`` updates the state
-        snapshot without advancing eviction counters — the 'consecutive
-        failures' contract counts background sweeps, not page loads."""
+        ``only`` restricts the sweep to a subset of routers;
+        ``count_failures=False`` updates the state snapshot without
+        advancing eviction counters — the 'consecutive failures' contract
+        counts background sweeps, not page loads."""
         for url in self.db.routers():
             if only is not None and url not in only:
                 continue
-            # intervals/durations come from the monotonic clock (immune to
-            # wall-clock steps); checked_at stays time.time() — it is a
-            # display timestamp, not a duration source
-            t0 = time.monotonic()
-            try:
-                data = fetch_nodes(url, timeout=self.timeout)
-                dial = time.monotonic() - t0
-                nodes = data.get("nodes", [])
-                if url not in self.db.routers():
-                    continue  # removed (DELETE) while the dial was in flight
-                with self._lock:
+            self._dial_one(url, count_failures)
+
+    def _dial_one(self, url: str, count_failures: bool = True,
+                  timeout: Optional[float] = None) -> None:
+        """Dial-test ONE router and fold the result into the snapshot."""
+        # intervals/durations come from the monotonic clock (immune to
+        # wall-clock steps); checked_at stays time.time() — it is a
+        # display timestamp, not a duration source
+        t0 = time.monotonic()
+        try:
+            data = fetch_nodes(url, timeout=timeout or self.timeout)
+            dial = time.monotonic() - t0
+            nodes = data.get("nodes", [])
+            if url not in self.db.routers():
+                return  # removed (DELETE) while the dial was in flight
+            with self._lock:
+                self._state[url] = {
+                    "ok": True,
+                    "nodes": nodes,
+                    "online": sum(1 for n in nodes if n.get("online")),
+                    "checked_at": time.time(),
+                    "checked_mono": time.monotonic(),
+                    "dial_seconds": round(dial, 3),
+                }
+            self.db.mark_ok(url)
+        except Exception as e:  # noqa: BLE001 — the dial test failing
+            dial = time.monotonic() - t0
+            evicted = (count_failures and self.db.mark_failed(
+                url, self.failure_threshold))
+            with self._lock:
+                if evicted or url not in self.db.routers():
+                    self._state.pop(url, None)
+                else:
                     self._state[url] = {
-                        "ok": True,
-                        "nodes": nodes,
-                        "online": sum(1 for n in nodes if n.get("online")),
-                        "checked_at": time.time(),
+                        "ok": False, "error": str(e), "nodes": [],
+                        "online": 0, "checked_at": time.time(),
                         "checked_mono": time.monotonic(),
                         "dial_seconds": round(dial, 3),
                     }
-                self.db.mark_ok(url)
-            except Exception as e:  # noqa: BLE001 — the dial test failing
-                dial = time.monotonic() - t0
-                evicted = (count_failures and self.db.mark_failed(
-                    url, self.failure_threshold))
-                with self._lock:
-                    if evicted or url not in self.db.routers():
-                        self._state.pop(url, None)
-                    else:
-                        self._state[url] = {
-                            "ok": False, "error": str(e), "nodes": [],
-                            "online": 0, "checked_at": time.time(),
-                            "checked_mono": time.monotonic(),
-                            "dial_seconds": round(dial, 3),
-                        }
+
+    def warmup(self, urls: set, *, deadline: float = 2.0,
+               count_failures: bool = False) -> None:
+        """Concurrent dial-test of ``urls`` bounded by ONE overall deadline
+        (ADVICE r5 #2: the first-render warm-up used to dial unchecked
+        routers sequentially at 5 s each inside the page request; several
+        dead routers meant a dashboard stuck for tens of seconds while the
+        10 s meta-refresh stacked further sweeps).
+
+        Routers that answer within ``deadline`` render immediately; the
+        rest stay "not checked yet" — their dials keep running on pool
+        threads (bounded by the per-dial timeout) and fold into the
+        snapshot for the next refresh."""
+        urls = {u for u in urls if u in self.db.routers()}
+        if not urls:
+            return
+        from concurrent.futures import ThreadPoolExecutor, wait
+
+        pool = ThreadPoolExecutor(
+            max_workers=min(8, len(urls)),
+            thread_name_prefix="explorer-warmup",
+        )
+        # each dial keeps the monitor's FULL timeout — clamping it to the
+        # page deadline would mark a slow-but-alive router failed; the
+        # deadline only bounds how long the page waits
+        futures = [
+            pool.submit(self._dial_one, u, count_failures, self.timeout)
+            for u in urls
+        ]
+        wait(futures, timeout=deadline)
+        # never join the stragglers — that would re-serialize the page;
+        # they finish on pool threads and fold in for the next refresh
+        pool.shutdown(wait=False)
 
     def state(self) -> dict[str, dict]:
         now = time.monotonic()
@@ -222,10 +260,13 @@ async def _index(request: web.Request) -> web.Response:
     missing = {url for url in entries if url not in state}
     if missing:
         # first render (or a freshly registered network): dial-test the
-        # missing ones now so the dashboard never shows a blank page —
+        # missing ones CONCURRENTLY under one short deadline so the page
+        # renders in ~2 s no matter how many routers are dead (stragglers
+        # show "not checked yet" and fill in on the next refresh) —
         # without advancing eviction counters (page loads are not sweeps)
         await asyncio.get_running_loop().run_in_executor(
-            None, lambda: mon.poll_once(only=missing, count_failures=False))
+            None, lambda: mon.warmup(missing, deadline=2.0,
+                                     count_failures=False))
         entries = mon.db.entries()
         state = mon.state()
     sections = []

@@ -544,6 +544,13 @@ class ModelManager:
         with self._lock:
             return sorted(self._models)
 
+    def loaded_snapshot(self) -> dict[str, Any]:
+        """Point-in-time view of the loaded models (never triggers a load)
+        — the /debug/devices HBM census walks in-process runners through
+        this."""
+        with self._lock:
+            return dict(self._models)
+
     def is_loaded(self, name: str) -> bool:
         with self._lock:
             return name in self._models

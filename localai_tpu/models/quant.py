@@ -34,29 +34,39 @@ import jax.numpy as jnp
 PyTree = Any
 
 
-# process-wide kernel block: the Pallas call carries no partitioning rule,
-# so under a multi-device mesh GSPMD would replicate (all-gather) the full
-# weight per step — any meshed ModelRunner turns the kernel off
-_W8_KERNEL_BLOCKED = False
+def block_w8_kernel_params(params: PyTree, reason: str = "") -> PyTree:
+    """Mark every QuantizedTensor in ``params`` kernel-blocked.
 
-
-def block_w8_kernel(reason: str = "") -> None:
-    global _W8_KERNEL_BLOCKED
-    if not _W8_KERNEL_BLOCKED and os.environ.get("LOCALAI_W8_KERNEL"):
+    The Pallas call carries no partitioning rule, so under a multi-device
+    mesh GSPMD would replicate (all-gather) the full weight per step — a
+    meshed ModelRunner blocks the kernel for ITS OWN weights at init. The
+    block rides the tensors (``kernel_ok`` pytree metadata), not process
+    state: a single-device runner built later — a draft model, a second
+    served model — keeps the opt-in kernel (ADVICE r5 #1 replaced the old
+    one-way process-global latch with this)."""
+    if os.environ.get("LOCALAI_W8_KERNEL"):
         import logging
 
         logging.getLogger(__name__).warning(
-            "LOCALAI_W8_KERNEL disabled: %s", reason or "meshed serving")
-    _W8_KERNEL_BLOCKED = True
+            "LOCALAI_W8_KERNEL disabled for these weights: %s",
+            reason or "meshed serving")
+
+    def mark(leaf):
+        if isinstance(leaf, QuantizedTensor) and leaf.kernel_ok:
+            return dataclasses.replace(leaf, kernel_ok=False)
+        return leaf
+
+    return jax.tree.map(
+        mark, params, is_leaf=lambda x: isinstance(x, QuantizedTensor)
+    )
 
 
 def _w8_kernel_mode() -> str:
     """'' (off) | 'tpu' | 'interpret' — the Pallas dequant-matmul opt-in
     (ops.qmatmul; LOCALAI_W8_KERNEL=1 enables on TPU, =interpret for CPU
     tests; any other value is off). Read per call: tests flip it at
-    runtime."""
-    if _W8_KERNEL_BLOCKED:
-        return ""
+    runtime. Per-tensor blocking (meshed weights) is carried by
+    ``QuantizedTensor.kernel_ok``, checked at the matmul call sites."""
     v = os.environ.get("LOCALAI_W8_KERNEL", "").strip().lower()
     if v in ("1", "tpu"):
         return "tpu"
@@ -68,7 +78,7 @@ def _w8_kernel_mode() -> str:
 @partial(
     jax.tree_util.register_dataclass,
     data_fields=("q", "scale"),
-    meta_fields=("axis", "mode"),
+    meta_fields=("axis", "mode", "kernel_ok"),
 )
 @dataclasses.dataclass
 class QuantizedTensor:
@@ -100,6 +110,10 @@ class QuantizedTensor:
     scale: jax.Array
     axis: int
     mode: str = "w8"
+    # False when these weights live on a runner whose mesh makes the
+    # Pallas kernel a pessimization (see block_w8_kernel_params) — static
+    # pytree metadata, so the block scopes to the runner, not the process
+    kernel_ok: bool = True
 
     @property
     def shape(self) -> tuple[int, ...]:
@@ -206,7 +220,7 @@ def matmul(x: jax.Array, w) -> jax.Array:
     if not isinstance(w, QuantizedTensor):
         return x @ w
     if w.mode == "w4":
-        mode = _w8_kernel_mode()
+        mode = _w8_kernel_mode() if w.kernel_ok else ""
         if mode:
             from localai_tpu.ops import qmatmul
 
@@ -225,7 +239,7 @@ def matmul(x: jax.Array, w) -> jax.Array:
         xq, xs = _quant_activations(x)
         acc = _int8_dot(xq, w.q, transpose_w=False).astype(jnp.float32)
         return (acc * xs[..., None] * w.scale).astype(x.dtype)
-    mode = _w8_kernel_mode()
+    mode = _w8_kernel_mode() if w.kernel_ok else ""
     if mode:
         from localai_tpu.ops import qmatmul
 
@@ -249,7 +263,7 @@ def matmul_t(x: jax.Array, w) -> jax.Array:
         xq, xs = _quant_activations(x)
         acc = _int8_dot(xq, w.q, transpose_w=True).astype(jnp.float32)
         return (acc * xs[..., None] * w.scale).astype(x.dtype)
-    mode = _w8_kernel_mode()
+    mode = _w8_kernel_mode() if w.kernel_ok else ""
     if mode:
         from localai_tpu.ops import qmatmul
 

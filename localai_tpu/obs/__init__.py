@@ -1,12 +1,12 @@
-"""Observability subsystem: request tracing + engine telemetry.
+"""Observability subsystem: tracing, telemetry, and introspection.
 
-Three cooperating pieces (PR: request-level tracing and engine telemetry):
+Cooperating pieces:
 
   * ``obs.metrics`` — the process-wide OpenMetrics registry (moved here
     from ``api.metrics``, which remains as a compatibility shim) extended
     with engine series: TTFT/TPOT/queue-wait histograms, batch occupancy,
     KV-slot utilization, prompt/prefix-cache hit rates, speculative accept
-    rate, XLA compile count/seconds.
+    rate, XLA compile count/seconds, stall + device-health gauges.
   * ``obs.trace`` — a lock-protected span recorder with a bounded
     ring-buffer trace store. All timestamps are ``time.monotonic()`` taken
     on the host; nothing here ever touches a device array, so
@@ -14,9 +14,23 @@ Three cooperating pieces (PR: request-level tracing and engine telemetry):
   * ``obs.engine`` — ``EngineTelemetry``, the scheduler-facing facade that
     turns request lifecycle events (queued → admitted → prefill → decode →
     drained) into spans + histogram observations.
+  * ``obs.watchdog`` — dispatch-heartbeat stall detection around every
+    blocking device round-trip, with thread-stack forensic spans dumped
+    into the trace store on a trip (``kind="stall"`` at ``/v1/traces``).
+  * ``obs.device`` — timeout-guarded device liveness probe, per-device
+    ``memory_stats()`` gauges, and a live-array HBM census (KV cache vs
+    weights vs other) behind ``GET /debug/devices``.
+  * ``obs.compile`` — XLA compile telemetry plus the compiled-program cost
+    catalog (``cost_analysis``/``memory_analysis`` joined with measured
+    dispatch latency into achieved-vs-roofline fractions) behind
+    ``GET /debug/programs``.
+  * ``obs.logging`` — structured JSON log formatter with the request
+    trace id bound via contextvar by the API middleware.
 
-HTTP surface: ``GET /v1/traces`` and ``GET /debug/timeline/{request_id}``
-(``api.traces``), fed by the trace-id middleware in ``api.server``.
+HTTP surface: ``GET /v1/traces``, ``GET /debug/timeline/{request_id}``
+(``api.traces``), ``GET /debug/devices``, ``GET /debug/programs``,
+``GET /debug/stacks`` (``api.debug``), fed by the trace-id middleware in
+``api.server``.
 """
 
 from localai_tpu.obs.engine import EngineTelemetry
@@ -36,10 +50,12 @@ from localai_tpu.obs.trace import (
     TraceStore,
     new_trace_id,
 )
+from localai_tpu.obs.watchdog import WATCHDOG, StallEvent, Watchdog
 
 __all__ = [
     "REGISTRY",
     "STORE",
+    "WATCHDOG",
     "Counter",
     "EngineTelemetry",
     "Gauge",
@@ -47,7 +63,9 @@ __all__ = [
     "Registry",
     "RequestTrace",
     "Span",
+    "StallEvent",
     "TraceStore",
+    "Watchdog",
     "escape_label_value",
     "new_trace_id",
     "update_engine_gauges",

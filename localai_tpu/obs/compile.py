@@ -1,6 +1,6 @@
-"""XLA compile-time telemetry.
+"""XLA compile-time telemetry + the compiled-program cost observatory.
 
-Two capture paths, matching what this jax build actually exposes:
+Capture paths, matching what this jax build actually exposes:
 
   * :func:`watch` wraps a jitted entry point (``engine/runner.py`` wraps
     all of its programs). jax compiles synchronously on the first dispatch
@@ -8,7 +8,7 @@ Two capture paths, matching what this jax build actually exposes:
     time of that first call is trace+lower+compile to within one program
     execution — the same reasoning the scheduler uses to exclude fresh
     shapes from its step-time EMA. Later dispatches of a seen shape pass
-    straight through with one set lookup of overhead.
+    straight through with one set lookup + counter bump of overhead.
   * :func:`install` registers a ``jax.monitoring`` duration listener for
     compilation events. On this jax version only the persistent
     compilation cache emits them, so the listener is a supplement; newer
@@ -16,10 +16,23 @@ Two capture paths, matching what this jax build actually exposes:
     series. Gated: a jax without ``jax.monitoring`` just skips it.
 
 Both feed ``localai_xla_compile_total`` / ``localai_xla_compile_seconds_total``.
+
+**Cost observatory** (``GET /debug/programs``): every watched program+shape
+lands in the process-wide :data:`CATALOG` as its abstract signature
+(``ShapeDtypeStruct`` leaves — no buffers pinned, donated args included).
+``cost_analysis()``/``memory_analysis()`` are harvested LAZILY on the first
+catalog report, by re-lowering from the stored avals: re-compiling at first
+dispatch would double every compile on the serving path, so the observatory
+pays that price only when an operator actually asks "where does the
+bandwidth go". The scheduler feeds measured per-dispatch latency via
+:func:`note_latency`; the report divides bytes-accessed and FLOPs by it and
+by the device roofline (obs.device) into achieved fractions — the direct
+answer to bench_micro's decode-bandwidth question.
 """
 
 from __future__ import annotations
 
+import itertools
 import threading
 import time
 import weakref
@@ -36,30 +49,223 @@ _installed = False
 _registries: "weakref.WeakSet[Registry]" = weakref.WeakSet()
 
 
+def _avalize(x: Any) -> Any:
+    """Array → ShapeDtypeStruct (identity for non-arrays): the lowering
+    signature the catalog stores instead of live buffers — holding real
+    args would pin donated HBM and model params past unload."""
+    if hasattr(x, "shape") and hasattr(x, "dtype") and hasattr(x, "ndim"):
+        import jax
+
+        return jax.ShapeDtypeStruct(x.shape, x.dtype)
+    return x
+
+
+class ProgramEntry:
+    """One (program, shape-key): signature + counters + lazy cost."""
+
+    def __init__(self, program: str, key: tuple, fn: Callable,
+                 avals: tuple, statics: dict, compile_seconds: float):
+        self.program = program
+        self.key = key
+        try:
+            self.fn_ref = weakref.ref(fn)
+        except TypeError:  # unweakrefable callables: better pinned than lost
+            self.fn_ref = lambda fn=fn: fn
+        self.avals = avals
+        self.statics = statics
+        self.compile_seconds = compile_seconds
+        self.dispatches = 0
+        self.cost: Optional[dict] = None       # lazily harvested, cached
+        self.cost_error: str = ""
+
+
+def _normalize_cost(analysis: Any) -> dict:
+    """cost_analysis() returns a dict or a per-computation list of dicts
+    depending on backend/version; fold to one {flops, bytes_accessed}."""
+    if analysis is None:
+        return {}
+    entries = analysis if isinstance(analysis, (list, tuple)) else [analysis]
+    flops = 0.0
+    byts = 0.0
+    for e in entries:
+        if not isinstance(e, dict):
+            continue
+        flops += float(e.get("flops", 0.0) or 0.0)
+        byts += float(e.get("bytes accessed", 0.0) or 0.0)
+    return {"flops": flops, "bytes_accessed": byts}
+
+
+class ProgramCatalog:
+    """Process-wide compiled-program registry behind /debug/programs.
+
+    Entries are keyed (program, watch-instance, shape-key): two loaded
+    models both watch a "decode" program whose top-level args are pytrees
+    (identical shape keys), and without the per-``watch()`` instance id
+    the second model's entries would overwrite the first's. The latency
+    EMA stays keyed (program, steps) — the scheduler feeding it does not
+    know instances, so with several models loaded it blends their decode
+    latencies (single-model serving, the v1 deployment, is exact)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._entries: dict[tuple, ProgramEntry] = {}
+        # measured seconds per DISPATCH, EMA, keyed (program, steps)
+        self._latency: dict[tuple, float] = {}
+
+    def record(self, program: str, key: tuple, fn: Callable,
+               args: tuple, kwargs: dict, compile_seconds: float) -> None:
+        try:
+            import jax
+
+            avals = jax.tree.map(_avalize, args)
+        except Exception:  # noqa: BLE001 — the catalog is best-effort
+            avals = None
+        entry = ProgramEntry(program, key, fn, avals, dict(kwargs),
+                             compile_seconds)
+        with self._lock:
+            entry.dispatches = 1
+            self._entries[(program, key)] = entry
+
+    def dispatched(self, program: str, key: tuple) -> None:
+        with self._lock:
+            e = self._entries.get((program, key))
+            if e is not None:
+                e.dispatches += 1
+
+    def note_latency(self, program: str, seconds: float, *,
+                     steps: int = 1) -> None:
+        """Fold one measured per-dispatch wall time into the (program,
+        steps) EMA — called by the scheduler at its drain points, never on
+        the dispatch path."""
+        if seconds <= 0:
+            return
+        k = (program, int(steps))
+        with self._lock:
+            prev = self._latency.get(k)
+            self._latency[k] = (seconds if prev is None
+                                else 0.8 * prev + 0.2 * seconds)
+
+    def _harvest(self, entry: ProgramEntry) -> None:
+        """Lower+compile from the stored avals and cache the analysis.
+        This is the one deliberately expensive call in the subsystem —
+        report()-time only, guarded, and cached per entry."""
+        fn = entry.fn_ref()
+        if fn is None:
+            entry.cost_error = "program no longer live (model unloaded)"
+            return
+        if entry.avals is None:
+            entry.cost_error = "signature capture failed"
+            return
+        try:
+            compiled = fn.lower(*entry.avals, **entry.statics).compile()
+            cost = _normalize_cost(compiled.cost_analysis())
+            try:
+                mem = compiled.memory_analysis()
+                cost.update(
+                    argument_bytes=getattr(mem, "argument_size_in_bytes", None),
+                    output_bytes=getattr(mem, "output_size_in_bytes", None),
+                    temp_bytes=getattr(mem, "temp_size_in_bytes", None),
+                    generated_code_bytes=getattr(
+                        mem, "generated_code_size_in_bytes", None),
+                )
+            except Exception:  # noqa: BLE001 — memory stats are optional
+                pass
+            entry.cost = cost
+        except Exception as e:  # noqa: BLE001 — a meshed program may not
+            # re-lower from bare avals (sharding was on the buffers)
+            entry.cost_error = f"{type(e).__name__}: {e}"
+
+    def report(self, *, roofline: Optional[dict] = None,
+               harvest: bool = True) -> list[dict]:
+        """Catalog view joined with measured latency and the roofline.
+        ``harvest=False`` skips lazy compilation (cheap listing)."""
+        with self._lock:
+            entries = list(self._entries.values())
+            latency = dict(self._latency)
+        peak_gbps = (roofline or {}).get("peak_gbps")
+        peak_tflops = (roofline or {}).get("peak_tflops")
+        out = []
+        for e in entries:
+            if harvest and e.cost is None and not e.cost_error:
+                self._harvest(e)
+            steps = int(e.statics.get("n", 1) or 1)
+            lat = latency.get((e.program, steps))
+            row: dict = {
+                "program": e.program,
+                # which watch() wrapper (≈ which runner) this entry is —
+                # two loaded models both have a "decode"
+                "instance": e.key[0] if e.key else 0,
+                "statics": {k: v for k, v in e.statics.items()},
+                "first_dispatch_seconds": round(e.compile_seconds, 4),
+                "dispatches": e.dispatches,
+                "dispatch_seconds_ema": (None if lat is None
+                                         else round(lat, 6)),
+            }
+            if e.cost:
+                row.update(e.cost)
+                flops = e.cost.get("flops") or 0.0
+                byts = e.cost.get("bytes_accessed") or 0.0
+                if lat:
+                    row["achieved_gflops"] = round(flops / lat / 1e9, 3)
+                    row["achieved_gbps"] = round(byts / lat / 1e9, 3)
+                    if peak_tflops:
+                        row["flops_fraction"] = round(
+                            flops / lat / (peak_tflops * 1e12), 4)
+                    if peak_gbps:
+                        row["bandwidth_fraction"] = round(
+                            byts / lat / (peak_gbps * 1e9), 4)
+            elif e.cost_error:
+                row["cost_error"] = e.cost_error
+            out.append(row)
+        out.sort(key=lambda r: (r["program"], r["instance"],
+                                str(r["statics"])))
+        return out
+
+
+CATALOG = ProgramCatalog()
+
+
+def note_latency(program: str, seconds: float, *, steps: int = 1) -> None:
+    CATALOG.note_latency(program, seconds, steps=steps)
+
+
+# one id per watch() wrapper: it disambiguates catalog entries when two
+# runners (two loaded models) watch same-named programs whose top-level
+# args are pytrees and therefore produce identical shape keys
+_WATCH_SEQ = itertools.count(1)
+
+
 def watch(fn: Callable, program: str,
           registry: Optional[Registry] = None) -> Callable:
     """Wrap a jitted callable: the first call per static-kwargs shape is
-    timed and recorded as a compilation of ``program``."""
+    timed and recorded as a compilation of ``program`` (and catalogued for
+    the cost observatory); later calls bump the dispatch counter."""
     reg = registry or REGISTRY
     seen: set = set()
     lock = threading.Lock()
+    wid = next(_WATCH_SEQ)
 
     def wrapped(*args: Any, **kwargs: Any) -> Any:
-        # program identity = static kwargs + argument shapes (array args
-        # with a new shape retrace even when the statics repeat — e.g. the
-        # multimodal prefill keyed by embedding row count)
-        key = (tuple(getattr(a, "shape", None) for a in args)
+        # program identity = watch instance + static kwargs + argument
+        # shapes (array args with a new shape retrace even when the
+        # statics repeat — e.g. the multimodal prefill keyed by embedding
+        # row count)
+        key = ((wid,)
+               + tuple(getattr(a, "shape", None) for a in args)
                + tuple(sorted(kwargs.items())))
         with lock:
             fresh = key not in seen
             if fresh:
                 seen.add(key)
         if not fresh:
+            CATALOG.dispatched(program, key)
             return fn(*args, **kwargs)
         t0 = time.monotonic()
         out = fn(*args, **kwargs)
+        dt = time.monotonic() - t0
         reg.compile_count.inc(program=program)
-        reg.compile_seconds.inc(time.monotonic() - t0, program=program)
+        reg.compile_seconds.inc(dt, program=program)
+        CATALOG.record(program, key, fn, args, kwargs, dt)
         return out
 
     wrapped.__name__ = getattr(fn, "__name__", program)

@@ -216,6 +216,44 @@ class Registry:
             "localai_xla_compile_seconds_total",
             "Wall seconds spent tracing+compiling XLA programs",
         )
+        # -- stall forensics + device health (obs.watchdog / obs.device) --
+        self.engine_stalled = Gauge(
+            "localai_engine_stalled",
+            "1 while a guarded device round-trip has made no progress past "
+            "the watchdog deadline (per channel)",
+        )
+        self.last_progress_age = Gauge(
+            "localai_last_progress_age_seconds",
+            "Seconds since the last heartbeat on an armed watchdog channel",
+        )
+        self.stalls = Counter(
+            "localai_stalls_total",
+            "Watchdog trips (stack-dump forensic spans recorded)",
+        )
+        self.device_ok = Gauge(
+            "localai_device_ok",
+            "1 when the last timeout-guarded device liveness probe succeeded",
+        )
+        self.device_probe_seconds = Gauge(
+            "localai_device_probe_seconds",
+            "Round-trip wall seconds of the last device liveness probe",
+        )
+        self.hbm_bytes_in_use = Gauge(
+            "localai_hbm_bytes_in_use",
+            "Device memory in use per device (memory_stats)",
+        )
+        self.hbm_peak_bytes = Gauge(
+            "localai_hbm_peak_bytes_in_use",
+            "Peak device memory in use per device (memory_stats)",
+        )
+        self.hbm_bytes_limit = Gauge(
+            "localai_hbm_bytes_limit",
+            "Device memory capacity per device (memory_stats)",
+        )
+        self.hbm_live_bytes = Gauge(
+            "localai_hbm_live_bytes",
+            "Live jax array bytes by category (kv_cache/weights/other)",
+        )
 
     def _all(self) -> list:
         return [v for v in self.__dict__.values()

@@ -166,9 +166,12 @@ def w4_matmul(x: jax.Array, q: jax.Array, scale: jax.Array, *,
 
 
 def w4_eligible(x_shape: tuple, q: jax.Array, scale: jax.Array) -> bool:
-    """Gates for the grouped-int4 kernel: 2-D int4 weight, 2-D scale whose
-    group size is 128-aligned and divides the K block, decode-sized M."""
-    if q.ndim != 2 or scale.ndim != 2:
+    """Gates for the grouped-int4 kernel: 2-D NATIVE-int4 weight, 2-D scale
+    whose group size is 128-aligned and divides the K block, decode-sized
+    M. The dtype gate mirrors ``eligible``'s int8 check: a mode='w4'
+    tensor stored as int8 (e.g. an imported GGUF q4 kept unpacked) has
+    different Mosaic tiling and must take the XLA path."""
+    if q.ndim != 2 or scale.ndim != 2 or q.dtype != jnp.int4:
         return False
     K, N = q.shape
     if scale.shape[1] != N or K % scale.shape[0]:

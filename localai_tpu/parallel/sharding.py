@@ -157,8 +157,12 @@ def expand_quantized_spec(spec_leaf: P, arr: Any, mesh: Mesh) -> Any:
             quantized_spec(spec_leaf, arr.axis, grouped=arr.mode == "w4"),
             arr.scale.shape, mesh,
         )
+        # carry ALL metadata from the source tensor: tree.map pairs this
+        # spec tree with the param tree, and aux data (axis, mode,
+        # kernel_ok) is part of treedef equality
         return QuantizedTensor(
-            q=spec_leaf, scale=s_spec, axis=arr.axis, mode=arr.mode)
+            q=spec_leaf, scale=s_spec, axis=arr.axis, mode=arr.mode,
+            kernel_ok=arr.kernel_ok)
     return spec_leaf
 
 

@@ -27,6 +27,7 @@ from localai_tpu.config.app_config import AppConfig
 from localai_tpu.config.model_config import ModelConfig
 from localai_tpu.engine.scheduler import GenHandle, GenRequest
 from localai_tpu.obs import EngineTelemetry
+from localai_tpu.obs import watchdog as obs_watchdog
 from localai_tpu.worker import backend_pb2 as pb
 from localai_tpu.worker.client import WorkerClient
 
@@ -95,6 +96,12 @@ class WorkerScheduler:
         # API-side view of the worker's requests: queued → rpc spans here,
         # engine-phase spans in the worker process under the same trace id
         self.telemetry = EngineTelemetry(model=owner.name)
+        # the RPC stream is a device round-trip once removed: a wedged
+        # worker (or its tunnel) stops the reply stream, and the watchdog
+        # must see that silence like any other stall
+        self.watchdog = obs_watchdog.WATCHDOG
+        self._wd_channel = f"rpc:{owner.name}"
+        self.watchdog.start()
 
     @property
     def busy(self) -> bool:
@@ -124,6 +131,10 @@ class WorkerScheduler:
 
     def _run(self, handle: WorkerGenHandle) -> None:
         tr = handle.trace
+        # armed across the whole RPC, pulsed per reply: a worker that stops
+        # streaming (dead process, dead tunnel) trips the stall watchdog
+        # even though grpc's own 600 s deadline is nowhere near
+        self.watchdog.arm(self._wd_channel)
         try:
             client = self._owner.client()
             opts = predict_options(handle.request)
@@ -135,6 +146,7 @@ class WorkerScheduler:
             for reply in client.predict_stream(
                     opts, timeout=600.0,
                     trace_id=req.trace_id or req.correlation_id):
+                self.watchdog.pulse(self._wd_channel)
                 if handle.cancelled:
                     finish = "cancelled"
                     break
@@ -157,6 +169,7 @@ class WorkerScheduler:
             self.telemetry.finished(tr, handle, "error")
             handle._finish("error")
         finally:
+            self.watchdog.disarm(self._wd_channel)
             with self._lock:
                 self._inflight -= 1
 

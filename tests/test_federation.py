@@ -299,3 +299,50 @@ def test_explorer_network_registration_api(tmp_path):
     finally:
         explorer.stop()
         router.stop()
+
+
+def test_explorer_warmup_is_concurrent_and_deadline_bounded(tmp_path):
+    """ADVICE r5 #2: the first-render warm-up dials unchecked routers
+    concurrently under ONE overall deadline instead of 5 s sequential
+    timeouts per dead router — several dead networks must not stall the
+    dashboard for tens of seconds."""
+    import time as _time
+
+    from localai_tpu.federation.explorer import DiscoveryMonitor, ExplorerDB
+
+    db = ExplorerDB(tmp_path / "warm.json")
+    # RFC 5737 TEST-NET addresses: connects hang until the dial timeout
+    dead = [f"http://192.0.2.{i}:9" for i in range(1, 5)]
+    for u in dead:
+        db.add(u)
+    mon = DiscoveryMonitor(db, interval=3600, failure_threshold=3,
+                           timeout=5.0)
+    t0 = _time.monotonic()
+    mon.warmup(set(dead), deadline=1.0, count_failures=False)
+    elapsed = _time.monotonic() - t0
+    # sequential dials would be ~4 × min(5, deadline); concurrent ones are
+    # bounded by the single deadline (generous margin for slow CI)
+    assert elapsed < 3.0, f"warmup took {elapsed:.1f}s — not concurrent"
+    # page-load warm-ups never advance eviction counters
+    for u in dead:
+        assert db.entries()[u]["failures"] == 0
+    assert u in db.routers()
+
+
+def test_explorer_warmup_fills_state_for_live_router(tmp_path):
+    from localai_tpu.federation.explorer import DiscoveryMonitor, ExplorerDB
+
+    fed = FederatedServer(["warm:9995"], health_interval=60)
+    router = _AppThread(fed.create_app())
+    try:
+        db = ExplorerDB(tmp_path / "warm2.json")
+        url = f"http://{router.addr}"
+        db.add(url, name="warm-net")
+        mon = DiscoveryMonitor(db, interval=3600, failure_threshold=3,
+                               timeout=5.0)
+        assert mon.state() == {}
+        mon.warmup({url}, deadline=3.0)
+        st = mon.state()
+        assert st[url]["ok"] and len(st[url]["nodes"]) == 1
+    finally:
+        router.stop()

@@ -179,3 +179,19 @@ def test_watch_records_once_per_shape():
     text = reg.render()
     assert 'localai_xla_compile_total{program="prog"} 2' in text
     assert len(calls) == 3
+
+
+def test_scheduler_wires_watchdog_into_runner(obs_sched):
+    sched, _store, _reg = obs_sched
+    # one watchdog instance guards both the scheduler drain ("engine:*")
+    # and the runner's blocking syncs ("device")
+    assert sched.runner.watchdog is sched.watchdog
+    tok = ByteTokenizer()
+    h = sched.generate(GenRequest(
+        prompt=tok.encode("watchdog"), max_new_tokens=4, temperature=0.0,
+    ))
+    assert h.finish_reason in ("stop", "length")
+    status = sched.watchdog.status()
+    assert "device" in status            # runner syncs heartbeat here
+    assert not sched.watchdog.stalled()  # healthy engine: nothing stalled
+    assert status["device"]["armed"] == 0  # nothing in flight now

@@ -14,13 +14,11 @@ from localai_tpu.ops import qmatmul
 
 @pytest.fixture()
 def w8_kernel_env():
+    # the kernel block is per-tensor now (QuantizedTensor.kernel_ok, set by
+    # meshed runners on THEIR params) — a meshed runner elsewhere in the
+    # process can no longer disable the kernel for this test's tensors
     os.environ["LOCALAI_W8_KERNEL"] = "interpret"
-    # a meshed runner anywhere earlier in the process flips the global
-    # block; this test must exercise the kernel for real
-    prior = qnt._W8_KERNEL_BLOCKED
-    qnt._W8_KERNEL_BLOCKED = False
     yield
-    qnt._W8_KERNEL_BLOCKED = prior
     os.environ.pop("LOCALAI_W8_KERNEL", None)
 
 
@@ -146,3 +144,16 @@ def test_engine_greedy_identical_under_w4_kernel(w8_kernel_env):
     os.environ["LOCALAI_W8_KERNEL"] = ""
     without = greedy()
     assert with_kernel == without
+
+
+def test_w4_eligibility_requires_native_int4_dtype():
+    """ADVICE r5 #4: a mode='w4' tensor stored as int8 (e.g. an imported
+    GGUF q4 kept unpacked) must not take the int4 kernel — its Mosaic
+    tiling assumptions differ. Mirrors eligible()'s int8 gate."""
+    rng = np.random.default_rng(5)
+    vals = rng.integers(-7, 7, (256, 384))
+    s = jnp.ones((2, 384), jnp.float32)  # group 128
+    assert qmatmul.w4_eligible((8, 256), jnp.asarray(vals, jnp.int4), s)
+    assert not qmatmul.w4_eligible((8, 256), jnp.asarray(vals, jnp.int8), s)
+    assert not qmatmul.w4_eligible(
+        (8, 256), jnp.asarray(vals, jnp.float32), s)

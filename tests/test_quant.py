@@ -302,3 +302,64 @@ def test_int4_under_mesh(small):
     first = r.admit(s, list(range(1, 40)), temperature=0.0)
     seq = [first] + [int(r.step()[s]) for _ in range(4)]
     assert all(0 <= t < small.cfg.vocab_size for t in seq)
+
+
+def test_kernel_block_is_per_tensor_not_process_global(monkeypatch):
+    """ADVICE r5 #1: a meshed runner blocks the Pallas kernel for ITS OWN
+    weights only — tensors quantized afterwards keep the env opt-in."""
+    import jax.numpy as jnp
+
+    from localai_tpu.models import quant as qnt
+    from localai_tpu.ops import qmatmul
+
+    monkeypatch.setenv("LOCALAI_W8_KERNEL", "interpret")
+    calls = []
+    real = qmatmul.w8_matmul
+
+    def spy(*args, **kwargs):
+        calls.append(1)
+        return real(*args, **kwargs)
+
+    monkeypatch.setattr(qmatmul, "w8_matmul", spy)
+    rng = np.random.default_rng(7)
+    w = rng.normal(size=(128, 128)).astype(np.float32) * 0.02
+    x = jnp.asarray(rng.normal(size=(4, 128)), jnp.float32)
+    qt = quantize_tensor(w, axis=0)
+    blocked = qnt.block_w8_kernel_params({"w": qt}, "meshed runner")["w"]
+    assert not blocked.kernel_ok and qt.kernel_ok
+
+    ref = np.asarray(qnt.matmul(x, blocked))      # blocked → XLA path
+    assert calls == []
+    out = np.asarray(qnt.matmul(x, qt))           # fresh tensor → kernel
+    assert calls, "unblocked tensor did not take the Pallas kernel"
+    np.testing.assert_allclose(out, ref, atol=1e-4, rtol=1e-4)
+
+
+def test_meshed_runner_blocks_only_its_own_params(small):
+    import jax
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    from localai_tpu.models.quant import QuantizedTensor
+    from localai_tpu.parallel import sharding as shd
+    from localai_tpu.parallel.mesh import MeshPlan, build_mesh
+
+    mesh = build_mesh(MeshPlan(data=2, model=4))
+    qp = shd.shard_params(quantize_params(small.params, "int8"),
+                          small.cfg, mesh)
+    meshed = ModelRunner(small.cfg, qp, num_slots=4, max_ctx=256,
+                         prefill_buckets=[64], mesh=mesh, kv_dtype="int8")
+    leaves = jax.tree.leaves(
+        meshed.params,
+        is_leaf=lambda x: isinstance(x, QuantizedTensor))
+    qts = [l for l in leaves if isinstance(l, QuantizedTensor)]
+    assert qts and all(not t.kernel_ok for t in qts)
+    # a LATER single-device runner keeps the kernel opt-in on its weights
+    single = ModelRunner(small.cfg, quantize_params(small.params, "int8"),
+                         num_slots=2, max_ctx=256, prefill_buckets=[64],
+                         kv_dtype="int8")
+    leaves = jax.tree.leaves(
+        single.params,
+        is_leaf=lambda x: isinstance(x, QuantizedTensor))
+    assert all(t.kernel_ok for t in leaves
+               if isinstance(t, QuantizedTensor))

@@ -144,19 +144,25 @@ def test_swarm_nodes_proxy(server):
     t.start()
     assert started.wait(15)
     try:
+        # allowlist the live router (as a trailing-slash variant: the
+        # comparison is normalized scheme/host/port, not exact-string) plus
+        # one dead router for the 502 path
+        port = port_box["port"]
+        server.state.config.swarm_routers = (
+            f"HTTP://127.0.0.1:{port}/,http://127.0.0.1:9")
         with httpx.Client(base_url=server.base, timeout=30.0) as c:
             r = c.get("/swarm/nodes",
-                      params={"router": f"http://127.0.0.1:{port_box['port']}"})
+                      params={"router": f"http://127.0.0.1:{port}"})
             assert r.status_code == 200
             data = r.json()
             assert len(data["nodes"]) == 1
             assert data["nodes"][0]["address"] == "http://127.0.0.1:9"
-            # bad router URL is rejected, unreachable router is a 502
+            # bad router URL rejected; allowlisted-but-dead router is a 502
             assert c.get("/swarm/nodes",
                          params={"router": "ftp://x"}).status_code == 400
             assert c.get(
                 "/swarm/nodes",
-                params={"router": "http://127.0.0.1:1"},
+                params={"router": "http://127.0.0.1:9"},
             ).status_code == 502
             # non-loopback, non-configured routers are refused: the proxy
             # must not double as an internal-network probe
@@ -164,6 +170,17 @@ def test_swarm_nodes_proxy(server):
                 "/swarm/nodes",
                 params={"router": "http://10.99.0.1:8500"},
             ).status_code == 403
+            # loopback is NOT a blanket exemption: only the server's own
+            # port (colocated router) is allowed, so a key holder cannot
+            # port-sweep 127.0.0.1 through the proxy (ADVICE r5 #3)
+            assert c.get(
+                "/swarm/nodes",
+                params={"router": "http://127.0.0.1:1"},
+            ).status_code == 403
+            assert c.get(
+                "/swarm/nodes",
+                params={"router": f"http://localhost:{server.state.config.port}"},
+            ).status_code in (200, 502)  # own port: allowed (may be dead)
             # userinfo must not smuggle a loopback-looking host past the
             # allowlist (urlopen would connect to 10.99.0.1)
             assert c.get(
@@ -171,6 +188,9 @@ def test_swarm_nodes_proxy(server):
                 params={"router": "http://127.0.0.1:x@10.99.0.1:8500"},
             ).status_code == 400
     finally:
+        # restore the shared module-scoped fixture even when an assert
+        # above fails — a leaked allowlist would cascade into later tests
+        server.state.config.swarm_routers = ""
         fut = asyncio.run_coroutine_threadsafe(
             port_box["runner"].cleanup(), loop)
         fut.result(10)

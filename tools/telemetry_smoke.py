@@ -6,7 +6,11 @@ generations through the continuous-batching scheduler, then:
   1. asserts the engine series appear in the /metrics exposition
      (batch occupancy, KV utilization, TTFT/TPOT/queue-wait histograms,
      compile time) — a regression here means the subsystem went blind;
-  2. writes a TTFT/TPOT summary JSON (``--out``) that CI uploads as a
+  2. asserts the round-6 introspection surfaces: the device liveness probe
+     + HBM census render their gauges, and a SIMULATED stall (a blocking
+     callable under a short-deadline watchdog) trips ``engine_stalled``,
+     records a thread-stack forensic span, and clears on recovery;
+  3. writes a TTFT/TPOT summary JSON (``--out``) that CI uploads as a
      build artifact — the seed of the serving-latency bench trajectory
      (BENCH_*.json tracks throughput; this tracks latency per PR).
 
@@ -38,6 +42,62 @@ REQUIRED_FAMILIES = (
     "# TYPE localai_speculative_accept_rate gauge",
     "# TYPE localai_prefix_tokens_reused_total counter",
 )
+# device-health + stall series the smoke provokes explicitly (probe +
+# census + a simulated stall) before checking the exposition
+REQUIRED_INTROSPECTION = (
+    "localai_device_ok 1",
+    "localai_device_probe_seconds",
+    'localai_hbm_live_bytes{category="kv_cache"}',
+    'localai_hbm_live_bytes{category="weights"}',
+    'localai_engine_stalled{channel="smoke-stall"} 0',
+    'localai_stalls_total{channel="smoke-stall"} 1',
+)
+
+
+def check_introspection(runner, registry, store) -> list[str]:
+    """Probe the device, census its HBM, and simulate one stall →
+    returns the list of failures (empty = healthy)."""
+    import threading
+
+    from localai_tpu.obs import Watchdog
+    from localai_tpu.obs import device as obs_device
+
+    problems: list[str] = []
+    probe = obs_device.probe_device(timeout=60.0, registry=registry)
+    if not probe.ok:
+        problems.append(f"device probe failed: {probe.error}")
+    obs_device.update_device_gauges([runner], registry=registry)
+
+    wd = Watchdog(deadline=0.1, registry=registry, store=store,
+                  poll_interval=0.02)
+    wd.start()
+    release = threading.Event()
+    tripped = threading.Event()
+    wd.on_stall(lambda e: e.kind == "stall" and tripped.set())
+
+    def hung():
+        with wd.guard("smoke-stall"):
+            release.wait(10.0)
+
+    t = threading.Thread(target=hung, daemon=True)
+    t.start()
+    if not tripped.wait(5.0):
+        problems.append("simulated stall did not trip the watchdog")
+    release.set()
+    t.join(5.0)
+    deadline = time.monotonic() + 3.0
+    while wd.stalled("smoke-stall") and time.monotonic() < deadline:
+        time.sleep(0.02)
+    if wd.stalled("smoke-stall"):
+        problems.append("stall did not clear on recovery")
+    wd.stop()
+    forensic = [tr for tr in store.recent(limit=20, kind="stall")
+                if tr.attrs.get("channel") == "smoke-stall"]
+    if not forensic:
+        problems.append("no forensic stall span recorded")
+    elif not any("stack" in s.attrs for s in forensic[0].spans()):
+        problems.append("forensic span carries no thread stacks")
+    return problems
 
 
 def main(argv=None) -> int:
@@ -79,16 +139,20 @@ def main(argv=None) -> int:
             h.result(timeout=300)
         # scrape-time refresh, exactly what GET /metrics does
         update_engine_gauges("smoke", sched.metrics())
+        problems = check_introspection(runner, REGISTRY, store)
     finally:
         sched.shutdown()
 
     exposition = REGISTRY.render()
-    missing = [s for s in REQUIRED_SERIES + REQUIRED_FAMILIES
+    missing = [s for s in (REQUIRED_SERIES + REQUIRED_FAMILIES
+                           + REQUIRED_INTROSPECTION)
                if s not in exposition]
-    if missing:
+    if missing or problems:
         print("FAIL: missing engine telemetry in /metrics exposition:")
         for s in missing:
             print(f"  - {s}")
+        for p in problems:
+            print(f"  - {p}")
         return 1
 
     traces = [t.to_dict() for t in store.recent(limit=args.requests * 2)
