@@ -53,6 +53,16 @@ stall. Extra output fields:
 
 A failed boot probe skips all device phases and reports value 0.0 with the
 probe error in "note" — seconds spent, not the 1320 s budget.
+
+FLIGHT RECORDER (round 7, obs.flight): every phase feeds a per-dispatch
+ring, so a stalled or budget-expired round reports MEASURED progress
+instead of a bare 0.0 (the BENCH r5 gap). Extra output fields:
+
+  "step_ms_p50"/"step_ms_p99": windowed per-token step-time percentiles
+      over the phase's drained dispatches (successful phases carry them
+      inline in their metric line too);
+  "partial_tokens": tokens the abandoned phase had decoded before its
+      heartbeat went silent — 0 means it never reached the timed loop.
 """
 
 import json
@@ -80,7 +90,7 @@ def _apply_platform() -> None:
 
 def run_decode_bench(preset: str, quant: str, steps: int, multi: int,
                      depth: int, num_slots: int = 8, max_ctx: int = 1024,
-                     watchdog=None, channel: str = "bench"):
+                     watchdog=None, channel: str = "bench", flight=None):
     """Prefill 8 slots, then timed pipelined multi-step decode.
 
     Returns aggregate decode tok/s. The pipelined loop is the scheduler's
@@ -93,6 +103,11 @@ def run_decode_bench(preset: str, quant: str, steps: int, multi: int,
     every admit, every drained dispatch) heartbeats the stall watchdog —
     the hang point of a dead tunnel is whichever blocking call stopped the
     pulses, and the caller abandons the phase instead of the budget.
+
+    ``flight``: an obs.flight.FlightRecorder fed one record per drained
+    dispatch in the timed loop. The caller reads it after a stall for
+    partial progress (the ring is shared host memory, readable even while
+    the abandoned thread stays parked on its dead dispatch).
     """
     from collections import deque
 
@@ -143,8 +158,24 @@ def run_decode_bench(preset: str, quant: str, steps: int, multi: int,
     jax.block_until_ready(runner.state.tokens)
     pulse()
 
+    def note_drain(last_t: float) -> float:
+        """One drained dispatch: heartbeat + flight record (the ring is
+        what survives an abandoned phase — see module docstring)."""
+        now = time.monotonic()
+        if flight is not None:
+            flight.record(
+                program="decode_n", steps=multi,
+                dispatch_ms=(now - last_t) * 1e3,
+                occupancy=1.0, queue_depth=0,
+                kv_utilization=min(1.0, (100 + steps) / max_ctx),
+                tokens=multi * num_slots,
+            )
+        pulse()
+        return now
+
     dispatches = max(1, steps // multi)
     t0 = time.perf_counter()
+    last_t = time.monotonic()
     q: deque = deque()
     for _ in range(dispatches):
         toks = runner.step_n_async(multi)
@@ -155,10 +186,10 @@ def run_decode_bench(preset: str, quant: str, steps: int, multi: int,
         q.append(toks)
         if len(q) >= depth:
             np.asarray(q.popleft())
-            pulse()
+            last_t = note_drain(last_t)
     while q:
         np.asarray(q.popleft())
-        pulse()
+        last_t = note_drain(last_t)
     dt = time.perf_counter() - t0
     return dispatches * multi * num_slots / dt
 
@@ -170,7 +201,11 @@ class _Board:
         self.lock = threading.Lock()
         self.result = None       # current best primary line (dict)
         self.extras = {}         # forensics merged at flush (device_health,
-                                 # stall_phase, ...) — never the metric keys
+                                 # stall_phase, partial step timings...);
+                                 # the result line always wins a key clash,
+                                 # so a stalled phase's partial percentiles
+                                 # can never mask a successful phase's
+                                 # measured ones
         self.printed = False
         # thread idents of ABANDONED stalled phases: if the tunnel comes
         # back minutes later and the parked thread finishes, its timing
@@ -213,18 +248,18 @@ class _Board:
             if self.printed:
                 return
             self.printed = True
-            out = dict(self.result or {
+            out = dict(self.extras)
+            out.update(self.result or {
                 "metric": "decode_throughput", "value": 0.0, "unit": "tok/s",
                 "vs_baseline": 0.0, "note": "no phase completed in budget",
             })
-            out.update(self.extras)
             sys.stdout.write(json.dumps(out) + "\n")
             sys.stdout.flush()
 
 
 def _measure(board: _Board, preset: str, quant: str, steps: int, multi: int,
              depth: int, primary: bool, watchdog=None,
-             channel: str = "bench") -> None:
+             channel: str = "bench", flight=None) -> None:
     short = "llama8b" if "8b" in preset else "llama1b" if "1b" in preset \
         else preset
     base = BASELINES.get(short, 800.0)
@@ -235,14 +270,21 @@ def _measure(board: _Board, preset: str, quant: str, steps: int, multi: int,
     w8k = "_w8k" if os.environ.get("LOCALAI_W8_KERNEL") else ""
     try:
         tok_s = run_decode_bench(preset, quant, steps, multi, depth,
-                                 watchdog=watchdog, channel=channel)
-        board.offer({
+                                 watchdog=watchdog, channel=channel,
+                                 flight=flight)
+        line = {
             "metric": f"decode_throughput_{short}_bs8_{quant}{w8k}",
             "value": round(tok_s, 2),
             "unit": "tok/s",
             "vs_baseline": round(tok_s / base, 4),
             "phase_s": round(time.monotonic() - t0, 1),
-        }, primary)
+        }
+        if flight is not None:
+            pct = flight.percentiles()
+            if pct["step_ms_p50"] is not None:
+                line["step_ms_p50"] = pct["step_ms_p50"]
+                line["step_ms_p99"] = pct["step_ms_p99"]
+        board.offer(line, primary)
     except Exception as e:  # noqa: BLE001 — keep a number on the board
         note = f"{type(e).__name__}: {e}"[:300]
         board.offer({
@@ -280,8 +322,10 @@ def main() -> None:
 
     stall_s = float(os.environ.get("BENCH_STALL_S", "90"))
     probe_timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT_S", "30"))
-    # obs.watchdog/device import no jax at module level — safe pre-init
+    # obs.watchdog/device/flight import no jax at module level — safe
+    # pre-init
     from localai_tpu.obs.device import probe_device
+    from localai_tpu.obs.flight import FlightRecorder
     from localai_tpu.obs.watchdog import Watchdog
 
     wd = Watchdog(deadline=stall_s, poll_interval=max(1.0, stall_s / 8))
@@ -386,10 +430,20 @@ def main() -> None:
             if "8b" in p and remaining < min_8b:
                 return  # can't fit the 8B phase — the 1B line stands
             label = f"bench:{p}:{q}"
-            ok = guarded(label, lambda p=p, q=q, primary=primary: _measure(
+            # per-phase flight ring: on a stall the abandoned thread's
+            # measured progress is still readable from here (partial
+            # tokens + step-time percentiles instead of a bare 0.0)
+            flight = FlightRecorder(512)
+            ok = guarded(label, lambda p=p, q=q, primary=primary,
+                         flight=flight: _measure(
                 board, p, q, steps, multi, depth, primary,
-                watchdog=wd, channel=label))
+                watchdog=wd, channel=label, flight=flight))
             if not ok:
+                board.annotate("partial_tokens", flight.total_tokens)
+                pct = flight.percentiles()
+                if pct["step_ms_p50"] is not None:
+                    board.annotate("step_ms_p50", pct["step_ms_p50"])
+                    board.annotate("step_ms_p99", pct["step_ms_p99"])
                 # the phase skipped forward; ask the device whether there
                 # is any point continuing (a recovered transient keeps the
                 # remaining phases; a dead tunnel ends the run now)

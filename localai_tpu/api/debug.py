@@ -18,11 +18,21 @@ abstract signature (``?harvest=0`` lists without compiling).
 ``GET /debug/stacks`` — every live thread's stack, on demand (the same
 payload the watchdog dumps on a stall, for when an operator wants it
 BEFORE the deadline).
+
+``GET /debug/flight`` — the engine flight recorder: per-model rings of
+per-dispatch records (step times, occupancy, queue depth, KV utilization,
+tokens, preemptions, speculative acceptance) with windowed step-time
+percentiles. ``?since=<monotonic ts>`` returns only records newer than
+the given timestamp (pollers pass the ``ts`` of the last record they
+saw); ``?limit=N`` bounds the newest records returned. The "what was the
+engine doing for the last N seconds" view — reading it never touches a
+device.
 """
 
 from __future__ import annotations
 
 import asyncio
+import time
 
 from aiohttp import web
 
@@ -112,9 +122,41 @@ async def stacks(request: web.Request) -> web.Response:
     return web.json_response({"threads": obs_watchdog.dump_stacks()})
 
 
+async def flight(request: web.Request) -> web.Response:
+    state = _state(request)
+    try:
+        since = float(request.query.get("since", 0.0))
+    except ValueError:
+        raise web.HTTPBadRequest(
+            text="since must be a number (a record's monotonic ts)")
+    try:
+        limit = int(request.query.get("limit", 256))
+    except ValueError:
+        raise web.HTTPBadRequest(text="limit must be an integer")
+    limit = max(1, min(limit, 4096))
+    models = {}
+    for name, sm in state.manager.loaded_snapshot().items():
+        rec = getattr(getattr(sm, "scheduler", None), "flight", None)
+        if rec is None:
+            continue  # worker-backed / non-LLM serving models have no ring
+        models[name] = {
+            "records": rec.snapshot(since=since, limit=limit),
+            "percentiles": rec.percentiles(),
+            "dispatches": rec.count,
+            "tokens_total": rec.total_tokens,
+            "capacity": rec.capacity,
+        }
+    return web.json_response({
+        # the clock records are stamped with, so pollers can window
+        "now_monotonic": round(time.monotonic(), 6),
+        "models": models,
+    })
+
+
 def routes() -> list[web.RouteDef]:
     return [
         web.get("/debug/devices", devices),
         web.get("/debug/programs", programs),
         web.get("/debug/stacks", stacks),
+        web.get("/debug/flight", flight),
     ]

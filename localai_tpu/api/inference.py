@@ -171,6 +171,29 @@ def expand_image_placeholders(
     return tokens, np.concatenate(rows, 0), np.asarray(poss, np.int32)
 
 
+def shed_check(model: str, scheduler: Any = None) -> None:
+    """SLO burn-rate admission control (obs.slo): when the observatory
+    says this model is out of its error budget on BOTH the fast and slow
+    windows, refuse new generation work with 429 + ``Retry-After`` rather
+    than queueing it into a latency spiral. Recovery is automatic — shed
+    requests never become SLO events, so the fast window drains and the
+    next check admits again. No-op with no targets configured."""
+    from aiohttp import web
+
+    from localai_tpu.obs import slo as obs_slo
+
+    if not obs_slo.SLO.should_shed(model):
+        return
+    retry = obs_slo.SLO.shed(model)
+    if scheduler is not None:
+        scheduler.note_shed()
+    raise web.HTTPTooManyRequests(
+        text=f"model {model!r} is shedding load (SLO burn rate over "
+             f"threshold); retry after {retry}s",
+        headers={"Retry-After": str(retry)},
+    )
+
+
 def correlation_id(request: Any) -> str:
     """X-Correlation-ID request header, for tracing a request through the
     scheduler/worker tier (parity: chat.go:164-169 — header, else the

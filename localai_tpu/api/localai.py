@@ -8,8 +8,10 @@ welcome/health).
 
 from __future__ import annotations
 
+import asyncio
 import logging
 import threading
+import time
 
 from aiohttp import web
 
@@ -84,11 +86,25 @@ async def metrics(request: web.Request) -> web.Response:
         ) if r is not None
     ]
     update_device_gauges(runners)
+    # SLO observatory: burn-rate + shedding gauges refresh at scrape time
+    # too (host-side window scans only — never a device dispatch)
+    from localai_tpu.obs import slo as obs_slo
+
+    obs_slo.SLO.export_gauges()
     return web.Response(
         text=REGISTRY.render(),
         content_type="text/plain",
         charset="utf-8",
     )
+
+
+async def slo_report(_request: web.Request) -> web.Response:
+    """GET /v1/slo — the SLO observatory: per-model sliding-window
+    (1m/5m/30m) TTFT/TPOT/e2e/queue-wait percentiles, burn rates against
+    the configured p95 targets, and load-shedding state (obs.slo)."""
+    from localai_tpu.obs import slo as obs_slo
+
+    return web.json_response(obs_slo.SLO.report())
 
 
 async def system(request: web.Request) -> web.Response:
@@ -150,11 +166,19 @@ async def backend_trace(request: web.Request) -> web.Response:
     traces show per-program device time, fusion layout, and HBM traffic —
     the ground truth for kernel/serving optimization. API-key-protected;
     one capture at a time; ``dir`` must stay under generated assets."""
-    import asyncio
-    import time as _time
-
-    body = await request.json() if request.can_read_body else {}
-    seconds = float(body.get("seconds", 3.0))
+    if request.can_read_body:
+        try:
+            body = await request.json()
+        except Exception:  # malformed body is a client error, not a 500
+            raise web.HTTPBadRequest(text="invalid JSON body")
+        if not isinstance(body, dict):
+            raise web.HTTPBadRequest(text="body must be a JSON object")
+    else:
+        body = {}
+    try:
+        seconds = float(body.get("seconds", 3.0))
+    except (TypeError, ValueError):
+        raise web.HTTPBadRequest(text="seconds must be a number")
     if not 0.1 <= seconds <= 60.0:
         raise web.HTTPBadRequest(text="seconds must be in [0.1, 60]")
     from localai_tpu.utils.paths import verify_path
@@ -172,9 +196,9 @@ async def backend_trace(request: web.Request) -> web.Response:
         if not _trace_lock.acquire(blocking=False):
             raise RuntimeError("a trace capture is already running")
         try:
-            path = str(out / _time.strftime("trace-%Y%m%d-%H%M%S"))
+            path = str(out / time.strftime("trace-%Y%m%d-%H%M%S"))
             jax.profiler.start_trace(path)
-            _time.sleep(seconds)
+            time.sleep(seconds)
             jax.profiler.stop_trace()
             return path
         finally:
@@ -194,6 +218,7 @@ def routes() -> list[web.RouteDef]:
         web.get("/readyz", readyz),
         web.get("/version", version),
         web.get("/metrics", metrics),
+        web.get("/v1/slo", slo_report),
         web.get("/system", system),
         web.post("/v1/tokenize", tokenize),
         web.post("/tokenize", tokenize),

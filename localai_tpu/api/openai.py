@@ -124,6 +124,9 @@ async def _await_handles(request: web.Request, handles, timeout: float = 600.0):
 async def chat(request: web.Request) -> web.StreamResponse:
     req = await _read_request(request)
     sm, base_cfg = await _serving(request, req, Usecase.CHAT)
+    # SLO burn-rate admission control: shed BEFORE any prompt build or
+    # constraint compile — a 429 must cost the overloaded engine nothing
+    inf.shed_check(req.model, sm.scheduler)
     cfg = inf.merge_request(base_cfg, req)
 
     try:
@@ -369,6 +372,7 @@ async def _chat_stream_n(request, req, sm, grs, rid, cid
 async def completions(request: web.Request) -> web.StreamResponse:
     req = await _read_request(request)
     sm, base_cfg = await _serving(request, req, Usecase.COMPLETION)
+    inf.shed_check(req.model, sm.scheduler)
     cfg = inf.merge_request(base_cfg, req)
     rid = sc.new_id("cmpl")
     cid = inf.correlation_id(request) or rid
@@ -483,6 +487,7 @@ async def _completions_stream(request, req, sm, cfg, templated, rid, cid,
 async def edits(request: web.Request) -> web.Response:
     req = await _read_request(request)
     sm, base_cfg = await _serving(request, req, Usecase.EDIT)
+    inf.shed_check(req.model, sm.scheduler)
     cfg = inf.merge_request(base_cfg, req)
     rid = sc.new_id("edit")
     cid = inf.correlation_id(request) or rid

@@ -38,8 +38,9 @@ TRACE_KEY = "trace_id"
 # observability/probe endpoints whose HTTP spans are pure scrape noise:
 # they still get a trace id, but are not recorded into the trace store
 # (a 15s Prometheus scrape would otherwise dominate the http ring)
-TRACE_SKIP = {"/metrics", "/healthz", "/readyz", "/v1/traces",
-              "/debug/devices", "/debug/programs", "/debug/stacks"}
+TRACE_SKIP = {"/metrics", "/healthz", "/readyz", "/v1/traces", "/v1/slo",
+              "/debug/devices", "/debug/programs", "/debug/stacks",
+              "/debug/flight"}
 TRACE_SKIP_PREFIXES = ("/debug/timeline/",)
 
 # paths reachable without an API key (parity: auth exemption filter,
@@ -77,6 +78,16 @@ class AppState:
         self.config = app_config or AppConfig()
         self.loader = loader or ConfigLoader(self.config.model_path)
         self.manager = manager or ModelManager(self.config, self.loader)
+        # SLO observatory targets from app config (env-overridable via
+        # LOCALAI_SLO_* through AppConfig.from_env; all-zero = shedding
+        # disabled). Wired here so every server entry path — serve(),
+        # tests, embedded — configures the process-wide tracker once.
+        from localai_tpu.obs import slo as obs_slo
+
+        obs_slo.SLO.configure(
+            targets=obs_slo.targets_from_config(self.config),
+            burn_threshold=self.config.slo_burn_threshold,
+        )
         self.galleries: list[Gallery] = [
             Gallery(name=g.get("name", ""), url=g.get("url", ""))
             for g in self.config.galleries
@@ -152,9 +163,17 @@ async def error_middleware(request: web.Request, handler):
     except web.HTTPException as e:
         if e.status >= 400:
             msg = e.text or e.reason or "error"
-            return web.json_response(
+            resp = web.json_response(
                 error_body(msg, code=e.status), status=e.status
             )
+            # the JSON re-wrap must not strip semantic headers the
+            # handler set on the exception (Retry-After on a shed 429,
+            # Allow on a 405, ...) — only the body-describing ones are
+            # superseded by the JSON wrapper
+            for k, v in e.headers.items():
+                if k.lower() not in ("content-type", "content-length"):
+                    resp.headers[k] = v
+            return resp
         raise
     except Exception as e:  # noqa: BLE001 — recover middleware parity
         log.exception("unhandled error on %s %s", request.method,

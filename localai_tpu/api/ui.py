@@ -94,6 +94,7 @@ def _page(title: str, body: str, script: str = "") -> web.Response:
     <a href="/text2image/">Image</a>
     <a href="/tts/">TTS</a>
     <a href="/swarm">Swarm</a>
+    <a href="/slo">SLO</a>
   </nav>
   <input id="apikey" placeholder="API key (if set)"
          onchange="saveKey(this)" size="18">
@@ -661,6 +662,96 @@ async def swarm_nodes(request: web.Request) -> web.Response:
 
 
 # ---------------------------------------------------------------------------
+# SLO observatory + flight recorder
+
+
+async def slo_page(request: web.Request) -> web.Response:
+    """GET /slo — live serving-health panel over the JSON APIs: per-model
+    sliding-window latency percentiles + burn rates (/v1/slo) and the
+    engine flight recorder's dispatch timeline (/debug/flight). Pure
+    read-side polling; the page holds no data of its own."""
+    body = """
+<div class="card">
+  <div class="row"><h2 style="flex:1">SLO observatory</h2>
+    <span id="shed" class="badge">…</span></div>
+  <div id="slo" class="dim">loading…</div>
+</div>
+<div class="card">
+  <h2>Flight recorder</h2>
+  <div id="flight" class="dim">loading…</div>
+</div>"""
+    script = """
+function fmt(v, d) {
+  return (v === null || v === undefined) ? '—' : Number(v).toFixed(d ?? 1);
+}
+function table(out, headers, rows) {  // textContent only: API data is
+  out.textContent = '';               // untrusted for innerHTML
+  const t = document.createElement('table');
+  const hr = t.insertRow();
+  headers.forEach(h => {
+    const th = document.createElement('th');
+    th.textContent = h; hr.appendChild(th);
+  });
+  rows.forEach(r => {
+    const tr = t.insertRow();
+    r.forEach(v => tr.insertCell().textContent = v);
+  });
+  out.appendChild(t);
+  if (!rows.length) out.textContent = 'no data yet';
+}
+async function refresh() {
+  try {
+    const s = await (await fetch('/v1/slo', {headers: authHeaders()})).json();
+    const models = s.models || {};
+    const shedding = Object.values(models).some(m => m.shedding);
+    const badge = document.getElementById('shed');
+    badge.textContent = shedding ? 'SHEDDING' : 'healthy';
+    badge.className = 'badge' + (shedding ? '' : ' loaded');
+    const rows = [];
+    for (const [name, m] of Object.entries(models)) {
+      for (const [w, a] of Object.entries(m.windows || {})) {
+        rows.push([name, w, a.count,
+                   fmt(a.ttft_ms && a.ttft_ms.p95),
+                   fmt(a.tpot_ms && a.tpot_ms.p95, 2),
+                   fmt(a.e2e_ms && a.e2e_ms.p95),
+                   fmt(a.burn_rate, 2),
+                   m.shedding ? 'shedding (' + m.shed_total + ' shed)'
+                              : 'ok']);
+      }
+    }
+    table(document.getElementById('slo'),
+          ['model', 'window', 'n', 'ttft p95 ms', 'tpot p95 ms',
+           'e2e p95 ms', 'burn', 'state'], rows);
+  } catch (e) {
+    document.getElementById('slo').textContent = 'error: ' + e.message;
+  }
+  try {
+    const f = await (await fetch('/debug/flight?limit=64',
+                                 {headers: authHeaders()})).json();
+    const rows = [];
+    for (const [name, m] of Object.entries(f.models || {})) {
+      const last = m.records[m.records.length - 1] || {};
+      rows.push([name, m.dispatches, m.tokens_total,
+                 fmt(m.percentiles.step_ms_p50, 2),
+                 fmt(m.percentiles.step_ms_p99, 2),
+                 fmt(last.occupancy, 2),
+                 last.queue_depth ?? '—',
+                 fmt(last.kv_utilization, 2)]);
+    }
+    table(document.getElementById('flight'),
+          ['model', 'dispatches', 'tokens', 'step p50 ms', 'step p99 ms',
+           'occupancy', 'queue', 'kv util'], rows);
+  } catch (e) {
+    document.getElementById('flight').textContent = 'error: ' + e.message;
+  }
+}
+refresh();
+setInterval(refresh, 2000);
+"""
+    return _page("SLO", body, script)
+
+
+# ---------------------------------------------------------------------------
 # wiring
 
 
@@ -670,7 +761,7 @@ UI_PREFIXES = ("/browse", "/chat/", "/text2image/", "/tts/", "/talk/")
 # exact-match key-free pages (prefix matching would also exempt JSON
 # sub-routes like /swarm/nodes, which must stay API-key-protected — that
 # endpoint performs server-side fetches of the operator-named router)
-UI_EXACT = ("/swarm",)
+UI_EXACT = ("/swarm", "/slo")
 
 
 def wants_html(request: web.Request) -> bool:
@@ -690,4 +781,5 @@ def routes() -> list[web.RouteDef]:
         web.get("/talk/{model}", talk_page),
         web.get("/swarm", swarm_page),
         web.get("/swarm/nodes", swarm_nodes),
+        web.get("/slo", slo_page),
     ]

@@ -71,6 +71,21 @@ def build_parser() -> argparse.ArgumentParser:
                      help="mesh shape, e.g. data=2,model=4 (default: auto)")
     run.add_argument("--platform", default=_env_default("platform", None),
                      help="force JAX platform (cpu for tests)")
+    # SLO observatory targets (obs.slo): p95 latency bounds in ms; when
+    # the error-budget burn rate exceeds --slo-burn-threshold on both the
+    # 1m and 5m windows, new generation work is shed with 429+Retry-After
+    run.add_argument("--slo-ttft-p95-ms", type=float, default=None,
+                     help="p95 time-to-first-token target in ms "
+                          "(0/unset = no target)")
+    run.add_argument("--slo-tpot-p95-ms", type=float, default=None,
+                     help="p95 per-output-token latency target in ms")
+    run.add_argument("--slo-e2e-p95-ms", type=float, default=None,
+                     help="p95 end-to-end request latency target in ms")
+    run.add_argument("--slo-queue-p95-ms", type=float, default=None,
+                     help="p95 queue-wait target in ms")
+    run.add_argument("--slo-burn-threshold", type=float, default=None,
+                     help="error-budget burn rate that triggers load "
+                          "shedding (default 2.0)")
 
     models = sub.add_parser("models", help="model management")
     models_sub = models.add_subparsers(dest="models_command")
@@ -335,6 +350,12 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             watchdog_busy_timeout=args.watchdog_busy_timeout,
             mesh_shape=_parse_mesh(args.mesh),
             platform=args.platform,
+            # None = flag not given → LOCALAI_SLO_* env (from_env) stands
+            slo_ttft_p95_ms=args.slo_ttft_p95_ms,
+            slo_tpot_p95_ms=args.slo_tpot_p95_ms,
+            slo_e2e_p95_ms=args.slo_e2e_p95_ms,
+            slo_queue_p95_ms=args.slo_queue_p95_ms,
+            slo_burn_threshold=args.slo_burn_threshold,
         )
         serve(cfg)
         return 0

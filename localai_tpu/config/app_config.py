@@ -77,6 +77,18 @@ class AppConfig:
     log_level: str = "info"
     metrics: bool = True
 
+    # SLO observatory + burn-rate load shedding (obs.slo): p95 latency
+    # targets in milliseconds, 0 = target disabled. Env-overridable like
+    # every field (LOCALAI_SLO_TTFT_P95_MS, ...); CLI: --slo-*-p95-ms.
+    # When fast (1m) AND slow (5m) error-budget burn rates exceed
+    # slo_burn_threshold, new generation work is refused with 429 +
+    # Retry-After until the fast window recovers.
+    slo_ttft_p95_ms: float = 0.0
+    slo_tpot_p95_ms: float = 0.0
+    slo_e2e_p95_ms: float = 0.0
+    slo_queue_p95_ms: float = 0.0
+    slo_burn_threshold: float = 2.0
+
     # TPU-specific
     mesh_shape: Optional[dict[str, int]] = None   # None = auto from devices
     platform: Optional[str] = None                # force jax platform (tests: cpu)

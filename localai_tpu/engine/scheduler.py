@@ -35,6 +35,7 @@ import numpy as np
 from localai_tpu.engine.runner import ModelRunner
 from localai_tpu.engine.stream import IncrementalDetokenizer, StopChecker
 from localai_tpu.obs import compile as obs_compile
+from localai_tpu.obs import flight as obs_flight
 from localai_tpu.obs import watchdog as obs_watchdog
 from localai_tpu.obs.engine import EngineTelemetry
 
@@ -192,7 +193,8 @@ class Scheduler:
                  prompt_cache: Optional[Any] = None,
                  prompt_cache_all: bool = False,
                  telemetry: Optional[EngineTelemetry] = None,
-                 watchdog: Optional[obs_watchdog.Watchdog] = None):
+                 watchdog: Optional[obs_watchdog.Watchdog] = None,
+                 flight: Optional[obs_flight.FlightRecorder] = None):
         self.runner = runner
         self.tokenizer = tokenizer
         # request-lifecycle spans + engine histograms (obs subsystem); the
@@ -208,6 +210,18 @@ class Scheduler:
         self._wd_channel = (f"engine:{self.telemetry.model}"
                             if self.telemetry.model else "engine")
         self.watchdog.start()
+        # flight recorder: one per-dispatch record from every drain, all
+        # host mirrors this thread already holds (zero device syncs, no
+        # per-record allocation — the ring is preallocated numpy columns).
+        # Windowed step-time percentiles come from here; snapshots ride
+        # every stall dump via the watchdog context provider below.
+        self.flight = (flight if flight is not None
+                       else obs_flight.FlightRecorder())
+        self._tokens_emitted = 0      # host-side token counter (_consume)
+        self._flight_mark = 0         # emitted count at the last record
+        self.watchdog.add_context(
+            f"flight:{self._wd_channel}", self._flight_forensics
+        )
         # speculative decoding (engine.speculative.SpecDecoder): when set and
         # no grammar constraint is active, dispatches run draft+verify
         # windows instead of plain multi-step decode. Slot lifecycle ops
@@ -266,6 +280,10 @@ class Scheduler:
         self.total_prompt_tokens = 0
         self.total_generated_tokens = 0
         self.total_preemptions = 0  # cancelled / engine-error slot exits
+        # requests refused by SLO admission control (API-level 429s); a
+        # mirror for the JSON metrics surface — the registry counter is
+        # owned by obs.slo (single-writer rule, see update_engine_gauges)
+        self.shed_total = 0
         self._thread = threading.Thread(
             target=self._run, name="engine", daemon=True
         )
@@ -287,11 +305,25 @@ class Scheduler:
     def busy(self) -> bool:
         return bool(self._slots) or not self._pending.empty()
 
+    def note_shed(self) -> None:
+        """Record one SLO admission-control rejection against this engine
+        (called by the API tier when it 429s a request for this model)."""
+        with self._lock:
+            self.shed_total += 1
+
     def metrics(self) -> dict:
         """Live engine metrics (parity: GetMetrics RPC,
-        grpc-server.cpp:2434-2457)."""
+        grpc-server.cpp:2434-2457).
+
+        ``step_time_ema`` is SECONDS PER DECODED TOKEN (per-token, not
+        per-dispatch — a k-step dispatch contributes dt/k), the lifetime
+        smoothed estimate that drives the adaptive streaming dispatch
+        size. ``step_ms_p50``/``step_ms_p99`` are its windowed
+        counterparts in milliseconds, computed from the flight ring's
+        resident dispatches (compile-bearing first dispatches excluded);
+        None until a post-compile dispatch lands."""
         num_slots = self.runner.num_slots
-        max_ctx = self.runner.max_ctx
+        pct = self.flight.percentiles()
         with self._lock:
             active = [
                 {
@@ -303,19 +335,12 @@ class Scheduler:
                 }
                 for s, c in self._slots.items()
             ]
-            # KV rows in use, from the host-side token record (no device
-            # read): each active slot holds prompt + generated rows
-            kv_rows = sum(
-                min(c.handle.prompt_tokens + c.generated, max_ctx)
-                for c in self._slots.values()
-            )
+            kv_utilization = self._kv_utilization()
         return {
             "active_slots": active,
             "num_slots": num_slots,
             "occupancy": len(active) / num_slots if num_slots else 0.0,
-            "kv_utilization": (
-                kv_rows / (num_slots * max_ctx) if num_slots else 0.0
-            ),
+            "kv_utilization": kv_utilization,
             "queue_depth": self._pending.qsize(),
             "total_prompt_tokens": self.total_prompt_tokens,
             "total_generated_tokens": self.total_generated_tokens,
@@ -323,7 +348,10 @@ class Scheduler:
             "last_dispatch_steps": self.last_dispatch_steps,
             "dispatches": self._dispatch_seq,
             "preemptions": self.total_preemptions,
-            "step_time_ema": self._step_ema,
+            "shed_total": self.shed_total,
+            "step_time_ema": self._step_ema,  # seconds per decoded token
+            "step_ms_p50": pct["step_ms_p50"],
+            "step_ms_p99": pct["step_ms_p99"],
             **(
                 {"prompt_cache": self.prompt_cache.stats()}
                 if self.prompt_cache is not None else {}
@@ -334,6 +362,21 @@ class Scheduler:
                 if self.spec is not None else {}
             ),
         }
+
+    def _kv_utilization(self) -> float:
+        """Fraction of KV rows holding live context, from the host-side
+        token record (no device read): each active slot holds prompt +
+        generated rows. Caller must own ``_slots`` — hold ``_lock`` or be
+        the engine thread (the only mutator)."""
+        num_slots = self.runner.num_slots
+        max_ctx = self.runner.max_ctx
+        if not num_slots:
+            return 0.0
+        kv_rows = sum(
+            min(c.handle.prompt_tokens + c.generated, max_ctx)
+            for c in self._slots.values()
+        )
+        return kv_rows / (num_slots * max_ctx)
 
     def _pc_writer(self) -> None:
         """Writer loop: materialize KV snapshots and persist them."""
@@ -349,9 +392,44 @@ class Scheduler:
             except Exception as e:  # noqa: BLE001 — cache ≠ serving
                 log.warning("prompt-cache store failed: %s", e)
 
+    def _flight_record(self, program: str, steps: int, dt: float,
+                       fresh: bool) -> None:
+        """One flight-ring record at a drain point. Everything here is a
+        host mirror this (engine) thread already owns — ``_slots`` is only
+        mutated on this thread, token counts come from ``_consume`` — so
+        the cost is a handful of scalar reads plus one in-place ring row
+        write. Called AFTER ``_process_rows`` so occupancy/tokens reflect
+        end-of-dispatch state."""
+        emitted = self._tokens_emitted
+        num_slots = self.runner.num_slots
+        self.flight.record(
+            program=program,
+            steps=steps,
+            dispatch_ms=dt * 1e3,
+            occupancy=len(self._slots) / num_slots if num_slots else 0.0,
+            queue_depth=self._pending.qsize(),
+            kv_utilization=self._kv_utilization(),
+            tokens=emitted - self._flight_mark,
+            preemptions=self.total_preemptions,
+            spec_accept=(self.spec.acceptance_rate
+                         if self.spec is not None else None),
+            compile=fresh,
+        )
+        self._flight_mark = emitted
+
+    def _flight_forensics(self) -> dict:
+        """Watchdog context provider: the last-N engine timeline attached
+        to every ``kind="stall"`` forensic trace (host-only, cheap)."""
+        return {
+            "channel": self._wd_channel,
+            "records": self.flight.snapshot(limit=32),
+            **self.flight.percentiles(),
+        }
+
     def shutdown(self, timeout: float = 10.0) -> None:
         self._stopping = True
         self._wake.set()
+        self.watchdog.remove_context(f"flight:{self._wd_channel}")
         self._thread.join(timeout)
         if self._pc_thread is not None:
             self._pc_queue.put(None)  # flush: writer drains FIFO first
@@ -395,11 +473,11 @@ class Scheduler:
             # its k tokens; otherwise (pipeline_depth=1, or a draining
             # pipeline) issue→drain wall time is the estimate. The first
             # dispatch of a new program shape is skipped — it pays compile.
+            if pipelined and self._last_drain_t is not None:
+                dt = now - self._last_drain_t
+            else:
+                dt = now - t_issue
             if not fresh and k > 0:
-                if pipelined and self._last_drain_t is not None:
-                    dt = now - self._last_drain_t
-                else:
-                    dt = now - t_issue
                 self._observe_step_time(dt / k)
                 # measured per-dispatch latency feeds the compiled-program
                 # cost catalog (achieved-vs-roofline at /debug/programs)
@@ -409,6 +487,13 @@ class Scheduler:
             if rows.ndim == 1:
                 rows = rows[None]
             self._process_rows(rows, seq)
+            # flight ring: spec windows record as steps=0 (variable token
+            # yield — excluded from step-time percentiles, their tokens
+            # still counted); compile-bearing dispatches are flagged
+            self._flight_record(
+                "spec" if k == 0 else ("decode_n" if k > 1 else "decode"),
+                k, dt, fresh,
+            )
 
         while not self._stopping:
             admitted = self._admit_pending()
@@ -446,20 +531,21 @@ class Scheduler:
                         fresh = self._fresh_shape(1)
                         t0 = time.monotonic()
                         rows = self.runner.step()[None]
+                        dt = time.monotonic() - t0
                         if not fresh:
-                            dt = time.monotonic() - t0
                             self._observe_step_time(dt)
                             obs_compile.note_latency("decode", dt, steps=1)
                         self.last_dispatch_steps = 1
                         self._process_rows(rows, self._dispatch_seq)
+                        self._flight_record("decode", 1, dt, fresh)
                     else:
                         freeze = np.zeros(self.runner.num_slots, bool)
                         freeze[list(constrained)] = True
                         fresh = self._fresh_shape(("frozen", steps))
                         t0 = time.monotonic()
                         rows = self.runner.step_frozen_n(freeze, steps)
+                        dt = time.monotonic() - t0
                         if not fresh:
-                            dt = time.monotonic() - t0
                             self._observe_step_time(dt / steps)
                             obs_compile.note_latency(
                                 "decode_frozen_n", dt, steps=steps)
@@ -467,6 +553,8 @@ class Scheduler:
                         self._process_rows(
                             rows, self._dispatch_seq, frozen=constrained
                         )
+                        self._flight_record(
+                            "decode_frozen_n", steps, dt, fresh)
                     self._last_drain_t = None  # sync path: drain clock stale
                 elif (self.spec is not None and self._spec_dirty
                         and inflight):
@@ -826,6 +914,7 @@ class Scheduler:
             return
 
         ctx.generated += 1
+        self._tokens_emitted += 1  # flight-ring per-dispatch token delta
         delta = ctx.detok.push(token_id)
         safe = ctx.stopper.push(delta)
         handle._emit(safe, token_id)

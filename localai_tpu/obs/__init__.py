@@ -26,6 +26,16 @@ Cooperating pieces:
     ``GET /debug/programs``.
   * ``obs.logging`` — structured JSON log formatter with the request
     trace id bound via contextvar by the API middleware.
+  * ``obs.flight`` — the engine flight recorder: a lock-light fixed-size
+    ring of per-dispatch records (step times, occupancy, queue depth, KV
+    utilization, tokens, preemptions, spec acceptance) fed from the
+    scheduler drain loop using host mirrors only, with windowed step-time
+    percentiles (``GET /debug/flight``; snapshots ride every stall dump).
+  * ``obs.slo`` — the SLO observatory: sliding-window TTFT/TPOT/e2e/
+    queue-wait percentiles per model (1m/5m/30m), p95 targets from env/
+    config, multi-window burn rates, and burn-rate admission control
+    (429 + ``Retry-After`` with automatic recovery) behind
+    ``GET /v1/slo`` and ``localai_overload_shedding``.
 
 HTTP surface: ``GET /v1/traces``, ``GET /debug/timeline/{request_id}``
 (``api.traces``), ``GET /debug/devices``, ``GET /debug/programs``,
@@ -34,6 +44,7 @@ HTTP surface: ``GET /v1/traces``, ``GET /debug/timeline/{request_id}``
 """
 
 from localai_tpu.obs.engine import EngineTelemetry
+from localai_tpu.obs.flight import FlightRecorder
 from localai_tpu.obs.metrics import (
     REGISTRY,
     Counter,
@@ -43,6 +54,7 @@ from localai_tpu.obs.metrics import (
     escape_label_value,
     update_engine_gauges,
 )
+from localai_tpu.obs.slo import SLO, SLOTracker
 from localai_tpu.obs.trace import (
     STORE,
     RequestTrace,
@@ -54,14 +66,17 @@ from localai_tpu.obs.watchdog import WATCHDOG, StallEvent, Watchdog
 
 __all__ = [
     "REGISTRY",
+    "SLO",
     "STORE",
     "WATCHDOG",
     "Counter",
     "EngineTelemetry",
+    "FlightRecorder",
     "Gauge",
     "Histogram",
     "Registry",
     "RequestTrace",
+    "SLOTracker",
     "Span",
     "StallEvent",
     "TraceStore",

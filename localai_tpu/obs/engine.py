@@ -16,20 +16,27 @@ import time
 from typing import Any, Optional
 
 from localai_tpu.obs import compile as obs_compile
+from localai_tpu.obs import slo as obs_slo
 from localai_tpu.obs.metrics import REGISTRY, Registry
 from localai_tpu.obs.trace import STORE, RequestTrace, TraceStore
 
 # finish reasons that mean the request left its slot early
 PREEMPT_REASONS = ("cancelled", "error")
+# finish reasons the SLO observatory counts: natural completions plus
+# backend errors (a cancel is a client action, not a serving outcome;
+# shed requests never reach a slot at all)
+SLO_REASONS = ("stop", "length", "error")
 
 
 class EngineTelemetry:
     def __init__(self, model: str = "", *,
                  registry: Optional[Registry] = None,
-                 store: Optional[TraceStore] = None):
+                 store: Optional[TraceStore] = None,
+                 slo: Optional[obs_slo.SLOTracker] = None):
         self.model = model
         self.registry = registry or REGISTRY
         self.store = store or STORE
+        self.slo = slo or obs_slo.SLO
         # supplement the first-dispatch compile timing the runner records
         obs_compile.install(self.registry)
 
@@ -56,6 +63,9 @@ class EngineTelemetry:
         tr.end("queued", seconds=round(queue_wait, 6))
         tr.event("admitted", slot=slot)
         tr.begin("prefill", slot=slot)
+        # stashed for finished(): the SLO observatory wants queue wait on
+        # the same completion event as the latency metrics
+        tr.annotate(queue_wait_ms=round(queue_wait * 1e3, 3))
         self.registry.queue_wait.observe(queue_wait, model=self.model)
 
     def prefill_done(self, tr: Optional[RequestTrace], *, path: str = "",
@@ -104,4 +114,14 @@ class EngineTelemetry:
             preempted = reason in PREEMPT_REASONS
         if preempted:
             self.registry.preemptions.inc(model=self.model, reason=reason)
+        if reason in SLO_REASONS:
+            t_end = handle.t_done or time.monotonic()
+            self.slo.observe(
+                self.model or "engine",
+                ttft_ms=None if ttft is None else ttft * 1e3,
+                tpot_ms=None if tpot is None else tpot * 1e3,
+                e2e_ms=(t_end - handle.t_submit) * 1e3,
+                queue_ms=tr.attrs.get("queue_wait_ms"),
+                error=(reason == "error"),
+            )
         self.store.finish(tr)

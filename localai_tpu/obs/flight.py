@@ -1,0 +1,196 @@
+"""Engine flight recorder: a fixed-size ring of per-dispatch records.
+
+The failure mode this exists for (BENCH r5): the engine stalls or a bench
+round expires and the only artifact is ``decode_throughput 0.0`` — no
+record of what the engine was doing for the preceding seconds, how far it
+got, or what step times looked like right before the silence. Production
+continuous-batching stacks (Orca, OSDI '22) treat the per-iteration
+timeline as the primary debugging artifact; this is that timeline.
+
+Design constraints (same contract as :mod:`obs.trace`):
+
+  * **Zero device syncs.** Every field is a host-side mirror the scheduler
+    already holds (slot dict sizes, queue depth, token counters, monotonic
+    clocks). Nothing here ever touches a jax array.
+  * **Lock-light, allocation-light.** The ring is column-major over
+    preallocated numpy arrays; :meth:`record` writes one row in place
+    under a short lock — no per-dispatch list/dict/object allocation, so
+    feeding it from the drain loop costs a few scalar stores.
+  * **Windowed percentiles from the ring.** Per-token step time
+    (``dispatch_ms / steps``) percentiles (p50/p90/p99) are computed on
+    demand from the resident rows, excluding compile-bearing first
+    dispatches (``compile=True``) and speculative windows (``steps=0`` —
+    their token yield is variable), so the numbers answer "what is decode
+    doing NOW", which the lifetime EMA cannot.
+
+One instance per Scheduler (``Scheduler.flight``); bench phases build
+their own. Surfaced at ``GET /debug/flight`` and attached to every stall
+forensic trace via the watchdog's context providers.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Optional
+
+import numpy as np
+
+from localai_tpu.obs.trace import mono_to_wall
+
+
+def _default_capacity() -> int:
+    try:
+        return max(1, int(os.environ.get("LOCALAI_FLIGHT_CAPACITY", "512")))
+    except ValueError:
+        return 512
+
+
+class FlightRecorder:
+    """Column-major ring of per-dispatch engine records."""
+
+    def __init__(self, capacity: Optional[int] = None):
+        self.capacity = int(capacity) if capacity else _default_capacity()
+        n = self.capacity
+        self._lock = threading.Lock()
+        self._ts = np.zeros(n)
+        self._steps = np.zeros(n, np.int64)
+        self._dispatch_ms = np.zeros(n)
+        self._occupancy = np.zeros(n)
+        self._queue_depth = np.zeros(n, np.int64)
+        self._kv_utilization = np.zeros(n)
+        self._tokens = np.zeros(n, np.int64)
+        self._preemptions = np.zeros(n, np.int64)
+        self._spec_accept = np.full(n, np.nan)
+        self._compile = np.zeros(n, bool)
+        self._program: list[str] = [""] * n
+        self._n = 0                # records ever written (ring head = n % cap)
+        self.total_tokens = 0      # cumulative, survives wraparound
+
+    # -- hot path (engine thread) -----------------------------------------
+
+    def record(self, *, program: str, steps: int, dispatch_ms: float,
+               occupancy: float, queue_depth: int, kv_utilization: float,
+               tokens: int, preemptions: int = 0,
+               spec_accept: Optional[float] = None,
+               compile: bool = False, ts: Optional[float] = None) -> None:
+        """Append one dispatch record (host scalars only)."""
+        now = time.monotonic() if ts is None else ts
+        with self._lock:
+            i = self._n % self.capacity
+            self._ts[i] = now
+            self._steps[i] = steps
+            self._dispatch_ms[i] = dispatch_ms
+            self._occupancy[i] = occupancy
+            self._queue_depth[i] = queue_depth
+            self._kv_utilization[i] = kv_utilization
+            self._tokens[i] = tokens
+            self._preemptions[i] = preemptions
+            self._spec_accept[i] = (np.nan if spec_accept is None
+                                    else spec_accept)
+            self._compile[i] = compile
+            self._program[i] = program
+            self._n += 1
+            self.total_tokens += int(tokens)
+
+    # -- read side ---------------------------------------------------------
+
+    @property
+    def count(self) -> int:
+        """Records ever written (resident rows = min(count, capacity))."""
+        return self._n
+
+    def _order(self) -> np.ndarray:
+        """Resident row indices, oldest → newest (caller holds the lock)."""
+        if self._n <= self.capacity:
+            return np.arange(self._n)
+        head = self._n % self.capacity
+        return np.concatenate([np.arange(head, self.capacity),
+                               np.arange(head)])
+
+    def snapshot(self, since: float = 0.0,
+                 limit: Optional[int] = None) -> list[dict]:
+        """Resident records oldest → newest as JSON-able dicts.
+
+        ``since`` filters on the record's monotonic timestamp (pollers pass
+        the ``ts`` of the last record they saw); ``limit`` keeps the newest
+        N after filtering.
+        """
+        # copy the selected rows under the lock, format after releasing
+        # it: building (up to capacity) dicts must not block the engine
+        # thread's per-dispatch record() behind a scrape
+        with self._lock:
+            order = self._order()
+            if since:
+                order = order[self._ts[order] > since]
+            if limit is not None and len(order) > limit:
+                order = order[-limit:]
+            cols = {
+                "ts": self._ts[order].tolist(),
+                "steps": self._steps[order].tolist(),
+                "ms": self._dispatch_ms[order].tolist(),
+                "occ": self._occupancy[order].tolist(),
+                "queue": self._queue_depth[order].tolist(),
+                "kv": self._kv_utilization[order].tolist(),
+                "tokens": self._tokens[order].tolist(),
+                "preempt": self._preemptions[order].tolist(),
+                "acc": self._spec_accept[order].tolist(),
+                "compile": self._compile[order].tolist(),
+                "program": [self._program[i] for i in order],
+            }
+        out = []
+        for j in range(len(cols["ts"])):
+            steps = cols["steps"][j]
+            ms = cols["ms"][j]
+            acc = cols["acc"][j]
+            out.append({
+                # ts stays unrounded: pollers feed it back as ?since=
+                # and a rounded-up value would exclude its own record
+                "ts": cols["ts"][j],
+                "ts_unix": round(mono_to_wall(cols["ts"][j]), 6),
+                "program": cols["program"][j],
+                "steps": steps,
+                "dispatch_ms": round(ms, 3),
+                "step_ms": (round(ms / steps, 4) if steps > 0 else None),
+                "occupancy": round(cols["occ"][j], 4),
+                "queue_depth": cols["queue"][j],
+                "kv_utilization": round(cols["kv"][j], 4),
+                "tokens": cols["tokens"][j],
+                "preemptions": cols["preempt"][j],
+                "spec_accept": (None if np.isnan(acc) else round(acc, 4)),
+                "compile": cols["compile"][j],
+            })
+        return out
+
+    def percentiles(self, window_s: Optional[float] = None,
+                    now: Optional[float] = None) -> dict:
+        """Per-token step-time percentiles over the ring.
+
+        The default window is the RING — the last ``capacity`` dispatches,
+        however old (an idle engine keeps reporting its most recent
+        activity rather than going blank); pass ``window_s`` to restrict
+        to recent wall time. Compile-bearing first dispatches and
+        speculative windows are excluded (see module docstring). Returns
+        ``step_ms_p50/p90/p99`` (None when no eligible sample) plus the
+        sample count.
+        """
+        with self._lock:
+            order = self._order()
+            mask = (self._steps[order] > 0) & ~self._compile[order]
+            if window_s is not None:
+                cutoff = (time.monotonic() if now is None else now) - window_s
+                mask &= self._ts[order] >= cutoff
+            rows = order[mask]
+            per_step = (self._dispatch_ms[rows]
+                        / np.maximum(self._steps[rows], 1))
+        if len(per_step) == 0:
+            return {"step_ms_p50": None, "step_ms_p90": None,
+                    "step_ms_p99": None, "samples": 0}
+        p50, p90, p99 = np.percentile(per_step, (50, 90, 99))
+        return {
+            "step_ms_p50": round(float(p50), 4),
+            "step_ms_p90": round(float(p90), 4),
+            "step_ms_p99": round(float(p99), 4),
+            "samples": int(len(per_step)),
+        }

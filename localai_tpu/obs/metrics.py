@@ -216,6 +216,29 @@ class Registry:
             "localai_xla_compile_seconds_total",
             "Wall seconds spent tracing+compiling XLA programs",
         )
+        # -- flight recorder + SLO observatory (obs.flight / obs.slo) -----
+        self.step_time_ms = Gauge(
+            "localai_step_time_ms",
+            "Per-token decode step time over the flight ring's resident "
+            "dispatches — the last N, not a time window, so an idle "
+            "engine reports its most recent activity (quantile label: "
+            "p50/p99)",
+        )
+        self.slo_burn_rate = Gauge(
+            "localai_slo_burn_rate",
+            "Error-budget burn rate per model and window "
+            "(1.0 = burning exactly the error budget)",
+        )
+        self.overload_shedding = Gauge(
+            "localai_overload_shedding",
+            "1 while new generation work for the model is refused (429) "
+            "by SLO burn-rate admission control",
+        )
+        self.requests_shed = Counter(
+            "localai_requests_shed_total",
+            "Generation requests refused with 429 by SLO burn-rate "
+            "admission control",
+        )
         # -- stall forensics + device health (obs.watchdog / obs.device) --
         self.engine_stalled = Gauge(
             "localai_engine_stalled",
@@ -302,6 +325,12 @@ def update_engine_gauges(name: str, m: dict,
     if "spec_acceptance_rate" in m:
         reg.spec_accept_rate.set(m["spec_acceptance_rate"], model=name)
         reg.spec_windows.set_total(m.get("spec_windows", 0), model=name)
+    # windowed step-time percentiles from the flight ring (the EMA's
+    # windowed counterpart; absent until a post-compile dispatch lands)
+    for q in ("p50", "p99"):
+        v = m.get(f"step_ms_{q}")
+        if v is not None:
+            reg.step_time_ms.set(v, model=name, quantile=q)
 
 
 REGISTRY = Registry()

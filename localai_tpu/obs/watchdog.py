@@ -109,6 +109,11 @@ class Watchdog:
         self._gauge_lock = threading.Lock()
         self._channels: dict[str, _Channel] = {}
         self._callbacks: list[Callable[[StallEvent], None]] = []
+        # forensic context providers: name -> zero-arg callable returning a
+        # JSON-able dict attached to every stall dump (e.g. the scheduler's
+        # flight-ring snapshot, so a stall trace carries the engine
+        # timeline that preceded the silence)
+        self._contexts: dict[str, Callable[[], dict]] = {}
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -171,6 +176,20 @@ class Watchdog:
         must never kill the thing they observe."""
         with self._lock:
             self._callbacks.append(cb)
+
+    def add_context(self, name: str, fn: Callable[[], dict]) -> None:
+        """Register a forensic context provider: ``fn()`` returns a
+        JSON-able dict recorded as a ``context`` event (attr ``source`` =
+        ``name``) on every stall trace. Providers must be host-only and
+        cheap; exceptions are swallowed per provider."""
+        with self._lock:
+            self._contexts[name] = fn
+
+    def remove_context(self, name: str) -> None:
+        """Unregister a provider (schedulers remove theirs at shutdown so
+        a dead engine's closure is not kept alive by the watchdog)."""
+        with self._lock:
+            self._contexts.pop(name, None)
 
     def stalled(self, channel: Optional[str] = None) -> bool:
         with self._lock:
@@ -248,6 +267,16 @@ class Watchdog:
             for s in stacks:
                 tr.event("thread", **s)
             tr.annotate(threads=len(stacks))
+            # attach registered forensic contexts (flight snapshots etc.):
+            # the stall dump should answer "what was the engine doing for
+            # the last N dispatches", not just "where is it parked now"
+            with self._lock:
+                contexts = list(self._contexts.items())
+            for name, fn in contexts:
+                try:
+                    tr.event("context", source=name, **fn())
+                except Exception:  # noqa: BLE001 — one provider ≠ the dump
+                    tr.event("context", source=name, error="provider failed")
             self.store.record(tr)
         except Exception:  # noqa: BLE001 — forensics must not throw
             trace_id = ""

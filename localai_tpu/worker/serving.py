@@ -102,6 +102,9 @@ class WorkerScheduler:
         self.watchdog = obs_watchdog.WATCHDOG
         self._wd_channel = f"rpc:{owner.name}"
         self.watchdog.start()
+        # SLO admission-control rejections happen at the API tier, so the
+        # counter lives here (the worker process never sees shed requests)
+        self.shed_total = 0
 
     @property
     def busy(self) -> bool:
@@ -173,11 +176,18 @@ class WorkerScheduler:
             with self._lock:
                 self._inflight -= 1
 
+    def note_shed(self) -> None:
+        """Record one API-level SLO admission rejection for this model."""
+        with self._lock:
+            self.shed_total += 1
+
     def metrics(self) -> dict:
         try:
-            return self._owner.client().metrics()
+            m = self._owner.client().metrics()
         except Exception as e:  # noqa: BLE001
             return {"error": str(e)}
+        m["shed_total"] = self.shed_total  # API-tier counter, not the RPC's
+        return m
 
     def shutdown(self, timeout: float = 10.0) -> None:
         self._owner.close()

@@ -534,7 +534,8 @@ def test_chat_streaming_n_choices(client):
 
 
 def test_backend_trace_capture(tmp_path):
-    """POST /backend/trace captures a jax profiler trace to disk."""
+    """POST /backend/trace captures a jax profiler trace to disk; bad
+    input is a client error (400), a concurrent capture a conflict (409)."""
     state = make_state(tmp_path, write_tiny=True)
     srv = _ServerThread(state)
     try:
@@ -552,6 +553,24 @@ def test_backend_trace_capture(tmp_path):
             assert c.post("/backend/trace",
                           json={"seconds": 0.2, "dir": "../../x"}
                           ).status_code == 400
+            # malformed JSON body → 400, not an unhandled 500
+            r = c.post("/backend/trace", content=b"{not json",
+                       headers={"Content-Type": "application/json"})
+            assert r.status_code == 400
+            assert c.post("/backend/trace",
+                          json=[1, 2]).status_code == 400
+            assert c.post("/backend/trace",
+                          json={"seconds": "soon"}).status_code == 400
+            # one capture at a time: a held capture lock → 409 Conflict
+            from localai_tpu.api import localai as localai_routes
+
+            assert localai_routes._trace_lock.acquire(timeout=5)
+            try:
+                r = c.post("/backend/trace", json={"seconds": 0.2})
+                assert r.status_code == 409
+                assert "already running" in r.json()["error"]["message"]
+            finally:
+                localai_routes._trace_lock.release()
     finally:
         srv.stop()
 
@@ -609,8 +628,12 @@ def test_debug_programs_reports_cost_and_roofline_fraction(client):
                 if p.get("bandwidth_fraction") is not None]
     assert withfrac, "no decode entry joined with a measured latency"
     assert withfrac[0]["bandwidth_fraction"] >= 0
-    prefill = [p for p in programs if p["program"] == "prefill"]
-    assert prefill and prefill[0].get("flops", 0) > 0
+    # filter to live instances: the backend-shutdown test earlier in this
+    # module unloads/reloads the model, leaving dead catalog entries
+    # (cost_error="program no longer live") next to the live ones
+    prefill = [p for p in programs
+               if p["program"] == "prefill" and p.get("flops")]
+    assert prefill and prefill[0]["flops"] > 0
 
 
 def test_debug_stacks_lists_threads(client):
@@ -679,6 +702,124 @@ def test_metrics_exposes_device_health_series(client):
     assert "# TYPE localai_hbm_live_bytes gauge" in text
     assert 'localai_hbm_live_bytes{category="kv_cache"}' in text
     assert "# TYPE localai_engine_stalled gauge" in text
+
+
+# -- flight recorder + SLO observatory (obs round 7) -------------------------
+
+
+def test_debug_flight_reports_dispatch_records(client):
+    r = client.post("/v1/chat/completions", json={
+        "model": "tiny",
+        "messages": [{"role": "user", "content": "flight record"}],
+        "max_tokens": 24,
+    })
+    assert r.status_code == 200
+    data = client.get("/debug/flight").json()
+    assert "tiny" in data["models"]
+    ring = data["models"]["tiny"]
+    assert ring["records"], "flight ring empty after a generation"
+    rec = ring["records"][-1]
+    for key in ("ts", "ts_unix", "program", "steps", "dispatch_ms",
+                "occupancy", "queue_depth", "kv_utilization", "tokens",
+                "preemptions", "compile"):
+        assert key in rec
+    assert ring["dispatches"] >= len(ring["records"])
+    assert ring["tokens_total"] > 0
+    assert ring["capacity"] > 0
+    assert "step_ms_p50" in ring["percentiles"]
+    # ?since= windows the poll: everything before "now" filters out
+    later = client.get("/debug/flight",
+                       params={"since": data["now_monotonic"] + 100}).json()
+    assert later["models"].get("tiny", {}).get("records") == []
+    mid = rec["ts"] - 1e-9
+    newer = client.get("/debug/flight", params={"since": mid}).json()
+    assert newer["models"]["tiny"]["records"]
+    assert client.get("/debug/flight",
+                      params={"since": "soon"}).status_code == 400
+    assert client.get("/debug/flight",
+                      params={"limit": "many"}).status_code == 400
+
+
+def test_v1_slo_reports_windows(client):
+    client.post("/v1/chat/completions", json={
+        "model": "tiny",
+        "messages": [{"role": "user", "content": "slo window"}],
+        "max_tokens": 4,
+    })
+    data = client.get("/v1/slo").json()
+    assert data["windows"] == ["1m", "5m", "30m"]
+    assert "targets" in data and "burn_threshold" in data
+    tiny = data["models"]["tiny"]
+    assert tiny["shedding"] is False
+    agg = tiny["windows"]["1m"]
+    assert agg["count"] >= 1
+    assert agg["ttft_ms"] is not None and agg["ttft_ms"]["p95"] > 0
+    assert agg["e2e_ms"]["p95"] >= agg["ttft_ms"]["p50"]
+
+
+def test_overload_sheds_with_429_and_recovers(client):
+    """Acceptance: a simulated overload (impossible TTFT target) flips
+    localai_overload_shedding, 429s new generation work with Retry-After,
+    counts the shed at /metrics and in the scheduler's metrics dict, and
+    admits again once the observatory recovers."""
+    from localai_tpu.obs import slo as obs_slo
+
+    SLO = obs_slo.SLO
+    saved = dict(targets=dict(SLO.targets), burn_threshold=SLO.burn_threshold,
+                 recover_burn=SLO.recover_burn, min_events=SLO.min_events)
+    SLO.reset()
+    SLO.configure(targets={"ttft_ms": 1e-6}, burn_threshold=1.0,
+                  recover_burn=1.0, min_events=2)
+    try:
+        # two completions violate the impossible target → both windows hot
+        for i in range(2):
+            r = client.post("/v1/chat/completions", json={
+                "model": "tiny",
+                "messages": [{"role": "user", "content": f"burn {i}"}],
+                "max_tokens": 2,
+            })
+            assert r.status_code == 200
+        r = client.post("/v1/chat/completions", json={
+            "model": "tiny",
+            "messages": [{"role": "user", "content": "shed me"}],
+            "max_tokens": 2,
+        })
+        assert r.status_code == 429
+        assert r.headers.get("Retry-After") == str(SLO.retry_after_s)
+        assert "shedding load" in r.json()["error"]["message"]
+        # streaming completions shed identically (same admission hook)
+        r = client.post("/v1/completions", json={
+            "model": "tiny", "prompt": "shed", "max_tokens": 2,
+        })
+        assert r.status_code == 429
+        text = client.get("/metrics").text
+        assert 'localai_overload_shedding{model="tiny"} 1' in text
+        assert 'localai_requests_shed_total{model="tiny"} 2' in text
+        assert 'localai_slo_burn_rate{model="tiny",window="1m"}' in text
+        # the scheduler's JSON mirror counted both refusals
+        em = client.get("/backend/metrics").json()
+        assert em["tiny"]["shed_total"] == 2
+        assert client.get("/v1/slo").json()["models"]["tiny"]["shedding"]
+        # recovery: clear the objectives (operator action) → admitted again
+        SLO.configure(targets={})
+        r = client.post("/v1/chat/completions", json={
+            "model": "tiny",
+            "messages": [{"role": "user", "content": "recovered"}],
+            "max_tokens": 2,
+        })
+        assert r.status_code == 200
+        assert ('localai_overload_shedding{model="tiny"} 0'
+                in client.get("/metrics").text)
+    finally:
+        SLO.configure(**saved)
+        SLO.reset()
+
+
+def test_slo_ui_page_served(client):
+    r = client.get("/slo", headers={"Accept": "text/html"})
+    assert r.status_code == 200
+    assert "SLO observatory" in r.text
+    assert "Flight recorder" in r.text
 
 
 def test_debug_devices_probe_timeout_validated(client):

@@ -149,6 +149,61 @@ def test_scheduler_metrics_expose_engine_gauges(obs_sched):
     assert "preemptions" in m
 
 
+def test_scheduler_feeds_flight_ring(obs_sched):
+    """Every drain writes one flight record from host mirrors; the
+    windowed step-time percentiles surface in metrics() next to the EMA
+    (which is per-token seconds), and note_shed feeds shed_total."""
+    sched, _store, _reg = obs_sched
+    tok = ByteTokenizer()
+    # enough tokens for post-compile dispatches, so percentiles exist
+    h = sched.generate(GenRequest(
+        prompt=tok.encode("flight me"), max_new_tokens=40, temperature=0.0,
+        ignore_eos=True,
+    ))
+    assert h.completion_tokens > 16
+    assert sched.flight.count > 0
+    rec = sched.flight.snapshot(limit=1)[-1]
+    for key in ("ts", "program", "steps", "dispatch_ms", "occupancy",
+                "queue_depth", "kv_utilization", "tokens", "preemptions"):
+        assert key in rec
+    assert rec["program"].startswith(("decode", "spec"))
+    m = sched.metrics()
+    assert m["step_ms_p50"] is not None and m["step_ms_p50"] > 0
+    assert m["step_ms_p99"] >= m["step_ms_p50"]
+    if m["step_time_ema"] is not None:  # per-token SECONDS vs windowed ms
+        assert m["step_time_ema"] * 1e3 == pytest.approx(
+            m["step_ms_p50"], rel=50.0)
+    before = m["shed_total"]
+    sched.note_shed()
+    assert sched.metrics()["shed_total"] == before + 1
+    # the ring's token accounting matches the engine's lifetime counter
+    assert sched.flight.total_tokens <= sched.total_generated_tokens + (
+        sum(len(c.handle.token_ids) for c in sched._slots.values()))
+
+
+def test_scheduler_registers_flight_forensics(obs_sched):
+    """The watchdog carries this engine's flight snapshot provider, so a
+    stall dump includes the preceding dispatch timeline."""
+    sched, _store, _reg = obs_sched
+    key = f"flight:{sched._wd_channel}"
+    assert key in sched.watchdog._contexts
+    payload = sched.watchdog._contexts[key]()
+    assert payload["channel"] == sched._wd_channel
+    assert isinstance(payload["records"], list)
+    assert "step_ms_p50" in payload
+
+
+def test_update_engine_gauges_exports_step_time(obs_sched):
+    from localai_tpu.obs import Registry, update_engine_gauges
+
+    sched, _store, _reg = obs_sched
+    reg = Registry()
+    update_engine_gauges("tiny", sched.metrics(), registry=reg)
+    text = reg.render()
+    assert 'localai_step_time_ms{model="tiny",quantile="p50"}' in text
+    assert 'localai_step_time_ms{model="tiny",quantile="p99"}' in text
+
+
 def test_runner_records_compile_time(obs_sched):
     # the fixture scheduler has prefilled + decoded at least once, so the
     # watch()-wrapped jit entries must have recorded first-call compiles

@@ -95,6 +95,56 @@ def test_guard_context_manager_and_background_thread(wd_parts):
     assert not wd.stalled("device")
 
 
+def test_stall_dump_includes_flight_snapshot(wd_parts):
+    """The round-7 forensic upgrade: a registered flight-ring context
+    provider attaches the preceding engine timeline to every stall dump,
+    and a broken provider degrades to an error marker instead of killing
+    the dump."""
+    from localai_tpu.obs import FlightRecorder
+
+    wd, _reg, store = wd_parts
+    fl = FlightRecorder(8)
+    fl.record(program="decode_n", steps=4, dispatch_ms=8.0, occupancy=0.5,
+              queue_depth=2, kv_utilization=0.25, tokens=16)
+    fl.record(program="decode_n", steps=4, dispatch_ms=12.0, occupancy=0.5,
+              queue_depth=3, kv_utilization=0.3, tokens=16)
+    wd.add_context("flight:engine", lambda: {
+        "records": fl.snapshot(limit=32), **fl.percentiles()})
+    wd.add_context("broken", lambda: 1 / 0)
+    try:
+        wd.arm("engine")
+        time.sleep(0.12)
+        trips = wd.check()
+        assert [e.kind for e in trips] == ["stall"]
+        stall = [t for t in store.recent() if t.kind == "stall"][0]
+        ctx = {s.attrs.get("source"): s for s in stall.spans()
+               if s.name == "context"}
+        assert set(ctx) == {"flight:engine", "broken"}
+        flight = ctx["flight:engine"].attrs
+        assert [r["queue_depth"] for r in flight["records"]] == [2, 3]
+        assert flight["step_ms_p50"] == pytest.approx(2.5)
+        assert flight["samples"] == 2
+        assert ctx["broken"].attrs["error"] == "provider failed"
+        # the stack half of the dump still stands next to the contexts
+        assert any(s.name == "thread" for s in stall.spans())
+    finally:
+        wd.disarm("engine")
+        wd.remove_context("flight:engine")
+        wd.remove_context("broken")
+
+
+def test_remove_context_stops_attaching(wd_parts):
+    wd, _reg, store = wd_parts
+    wd.add_context("gone", lambda: {"x": 1})
+    wd.remove_context("gone")
+    wd.arm("engine")
+    time.sleep(0.12)
+    wd.check()
+    wd.disarm("engine")
+    stall = [t for t in store.recent() if t.kind == "stall"][0]
+    assert not [s for s in stall.spans() if s.name == "context"]
+
+
 def test_check_refreshes_progress_age_gauge(wd_parts):
     wd, reg, _store = wd_parts
     wd.arm("rpc")

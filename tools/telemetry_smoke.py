@@ -10,11 +10,20 @@ generations through the continuous-batching scheduler, then:
      + HBM census render their gauges, and a SIMULATED stall (a blocking
      callable under a short-deadline watchdog) trips ``engine_stalled``,
      records a thread-stack forensic span, and clears on recovery;
-  3. writes a TTFT/TPOT summary JSON (``--out``) that CI uploads as a
+  3. asserts the round-7 SLO observatory + flight recorder: the synthetic
+     load leaves a non-empty flight ring with computable step-time
+     percentiles, the SLO burn-rate/shedding gauges render, and a
+     simulated overload (tight targets against a scratch tracker) trips
+     shedding, counts a shed request, then recovers as the fast window
+     slides past the burst;
+  4. writes a TTFT/TPOT summary JSON (``--out``) that CI uploads as a
      build artifact — the seed of the serving-latency bench trajectory
-     (BENCH_*.json tracks throughput; this tracks latency per PR).
+     (BENCH_*.json tracks throughput; this tracks latency per PR) — and
+     the flight-ring snapshot (``--flight-out``) so every CI run carries
+     the engine timeline it measured.
 
 Usage:  python -m tools.telemetry_smoke [--out telemetry_summary.json]
+                                        [--flight-out flight_snapshot.json]
 """
 
 from __future__ import annotations
@@ -51,6 +60,18 @@ REQUIRED_INTROSPECTION = (
     'localai_hbm_live_bytes{category="weights"}',
     'localai_engine_stalled{channel="smoke-stall"} 0',
     'localai_stalls_total{channel="smoke-stall"} 1',
+)
+# SLO observatory + flight recorder series (round 7): windowed step-time
+# percentiles from the ring, burn-rate gauges from the real run, and the
+# simulated-overload lifecycle (shed → counted → recovered)
+REQUIRED_SLO = (
+    'localai_step_time_ms{model="smoke",quantile="p50"}',
+    'localai_step_time_ms{model="smoke",quantile="p99"}',
+    'localai_slo_burn_rate{model="smoke",window="1m"}',
+    'localai_slo_burn_rate{model="smoke",window="5m"}',
+    'localai_overload_shedding{model="smoke"} 0',
+    'localai_overload_shedding{model="smoke-overload"} 0',
+    'localai_requests_shed_total{model="smoke-overload"} 1',
 )
 
 
@@ -100,11 +121,40 @@ def check_introspection(runner, registry, store) -> list[str]:
     return problems
 
 
+def check_slo_overload(registry) -> list[str]:
+    """Simulated overload: a scratch tracker with tight targets sheds,
+    counts the refusal, then recovers once the fast window drains —
+    the full load-shedding lifecycle without waiting a real minute
+    (injected clock)."""
+    from localai_tpu.obs.slo import SLOTracker
+
+    problems: list[str] = []
+    t = {"now": 1000.0}
+    slo = SLOTracker(registry=registry, clock=lambda: t["now"],
+                     targets={"ttft_ms": 0.001}, burn_threshold=1.0,
+                     recover_burn=1.0, min_events=3)
+    for _ in range(4):
+        slo.observe("smoke-overload", ttft_ms=50.0, e2e_ms=80.0)
+    if not slo.should_shed("smoke-overload"):
+        problems.append("simulated overload did not trip shedding")
+    if 'localai_overload_shedding{model="smoke-overload"} 1' \
+            not in registry.render():
+        problems.append("shedding gauge not set during overload")
+    slo.shed("smoke-overload")  # what the API's 429 path records
+    t["now"] += 120.0           # the fast window slides past the burst
+    if slo.should_shed("smoke-overload"):
+        problems.append("shedding did not recover after the window slid")
+    return problems
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("--out", default="telemetry_summary.json")
+    parser.add_argument("--flight-out", default="flight_snapshot.json")
     parser.add_argument("--requests", type=int, default=4)
-    parser.add_argument("--max-tokens", type=int, default=12)
+    # two dispatch-rounds past the compile-bearing first one, so the
+    # flight ring has post-compile samples and step_ms percentiles exist
+    parser.add_argument("--max-tokens", type=int, default=40)
     args = parser.parse_args(argv)
 
     from localai_tpu.engine.runner import ModelRunner
@@ -112,6 +162,7 @@ def main(argv=None) -> int:
     from localai_tpu.models.registry import resolve_model
     from localai_tpu.obs import REGISTRY, EngineTelemetry, TraceStore
     from localai_tpu.obs.metrics import update_engine_gauges
+    from localai_tpu.obs.slo import SLOTracker
     from localai_tpu.utils.tokenizer import ByteTokenizer
 
     t_boot = time.monotonic()
@@ -121,9 +172,12 @@ def main(argv=None) -> int:
         prefill_buckets=[16, 32], kv_dtype="float32",
     )
     store = TraceStore()
+    # a dedicated observatory (no env targets) so the smoke is hermetic;
+    # it still writes the shared REGISTRY the exposition check reads
+    slo = SLOTracker(registry=REGISTRY, targets={})
     sched = Scheduler(
         runner, ByteTokenizer(),
-        telemetry=EngineTelemetry(model="smoke", store=store),
+        telemetry=EngineTelemetry(model="smoke", store=store, slo=slo),
     )
     tok = ByteTokenizer()
     try:
@@ -138,14 +192,30 @@ def main(argv=None) -> int:
         for h in handles:
             h.result(timeout=300)
         # scrape-time refresh, exactly what GET /metrics does
-        update_engine_gauges("smoke", sched.metrics())
+        engine_metrics = sched.metrics()
+        update_engine_gauges("smoke", engine_metrics)
+        slo.export_gauges()
         problems = check_introspection(runner, REGISTRY, store)
+        problems += check_slo_overload(REGISTRY)
+        flight_pct = sched.flight.percentiles()
+        flight_snapshot = {
+            "model": "smoke",
+            "dispatches": sched.flight.count,
+            "tokens_total": sched.flight.total_tokens,
+            "percentiles": flight_pct,
+            "records": sched.flight.snapshot(),
+        }
+        if sched.flight.count == 0:
+            problems.append("flight ring is empty after synthetic load")
+        if flight_pct["step_ms_p50"] is None:
+            problems.append(
+                "flight ring has no post-compile step-time samples")
     finally:
         sched.shutdown()
 
     exposition = REGISTRY.render()
     missing = [s for s in (REQUIRED_SERIES + REQUIRED_FAMILIES
-                           + REQUIRED_INTROSPECTION)
+                           + REQUIRED_INTROSPECTION + REQUIRED_SLO)
                if s not in exposition]
     if missing or problems:
         print("FAIL: missing engine telemetry in /metrics exposition:")
@@ -185,15 +255,21 @@ def main(argv=None) -> int:
             t["attrs"].get("tokens_per_second") for t in traces
         ],
         "engine": {
-            k: v for k, v in sched.metrics().items() if k != "active_slots"
+            k: v for k, v in engine_metrics.items() if k != "active_slots"
         },
     }
     with open(args.out, "w") as f:
         json.dump(summary, f, indent=2, sort_keys=True)
-    print(f"OK: engine telemetry present; summary → {args.out}")
+    with open(args.flight_out, "w") as f:
+        json.dump(flight_snapshot, f, indent=2, sort_keys=True)
+    print(f"OK: engine telemetry present; summary → {args.out}, "
+          f"flight ring → {args.flight_out}")
     print(f"    ttft mean {summary['ttft']['mean_ms']}ms  "
           f"tpot mean {summary['tpot']['mean_ms']}ms  "
-          f"over {len(ttfts)} requests")
+          f"over {len(ttfts)} requests; "
+          f"step p50 {flight_pct['step_ms_p50']}ms "
+          f"p99 {flight_pct['step_ms_p99']}ms "
+          f"over {flight_pct['samples']} dispatches")
     return 0
 
 
