@@ -3,30 +3,38 @@
 Parity: /root/reference/core/http/endpoints/openai/assistant.go (assistant
 CRUD + assistant-file attachments, persisted as ``assistants.json`` /
 ``assistantsFile.json`` in the configs dir) and files.go (multipart upload
-into the upload dir, metadata in ``uploadedFiles.json``), reloaded at boot
-by app.go:152-154. The reference keeps these in package-level globals; here
-they live in an AssistantStore owned by AppState, with a lock and atomic
-saves."""
+into the upload dir). The reference keeps these in package-level globals;
+here they live in an AssistantStore owned by AppState, with a lock and
+atomic saves.
+
+File persistence itself (``uploadedFiles.json`` + content under the
+upload dir) moved to the unified :class:`localai_tpu.batch.store.
+FileRegistry` — ``/v1/files`` is ONE registry with a ``purpose`` field
+(``assistants`` | ``batch`` | ``batch_output``) shared by assistants
+attachments, batch-job inputs, and batch result downloads. The
+AssistantStore delegates to a shared instance."""
 
 from __future__ import annotations
 
-import json
 import logging
 import threading
 import time
-from pathlib import Path
 from typing import Any, Optional
 
 from aiohttp import web
 
 from localai_tpu.api.schema import error_body
-from localai_tpu.utils.paths import verify_path
+from localai_tpu.batch.store import (
+    FileRegistry,
+    _atomic_save,
+    _id_num,
+    _load,
+)
 
 log = logging.getLogger(__name__)
 
 ASSISTANTS_FILE = "assistants.json"
 ASSISTANT_FILES_FILE = "assistantsFile.json"
-UPLOADED_FILES_FILE = "uploadedFiles.json"
 
 # request-shape limits (assistant.go:29-36)
 MAX_INSTRUCTIONS = 32768
@@ -38,12 +46,17 @@ TOOL_TYPES = {"code_interpreter", "retrieval", "function"}
 
 
 class AssistantStore:
-    """Assistants, assistant-file attachments, and uploaded-file metadata,
-    persisted as JSON and reloaded at construction (boot)."""
+    """Assistants and assistant-file attachments, persisted as JSON and
+    reloaded at construction (boot). Uploaded-file metadata lives in the
+    shared :class:`FileRegistry` (``registry``)."""
 
-    def __init__(self, configs_dir: str | Path, upload_dir: str | Path):
+    def __init__(self, configs_dir, upload_dir,
+                 registry: Optional[FileRegistry] = None):
+        from pathlib import Path
+
         self.configs_dir = Path(configs_dir)
-        self.upload_dir = Path(upload_dir)
+        self.registry = registry or FileRegistry(upload_dir)
+        self.upload_dir = self.registry.upload_dir
         self._lock = threading.Lock()
         self.assistants: list[dict] = self._load(
             self.configs_dir / ASSISTANTS_FILE
@@ -51,35 +64,25 @@ class AssistantStore:
         self.assistant_files: list[dict] = self._load(
             self.configs_dir / ASSISTANT_FILES_FILE
         )
-        self.files: list[dict] = self._load(
-            self.upload_dir / UPLOADED_FILES_FILE
-        )
-        # id counters continue past the largest persisted id, so restarts
+        # id counter continues past the largest persisted id, so restarts
         # never mint colliding ids (the reference restarts from 0 and WOULD
-        # collide — assistant.go:124; deliberate divergence)
+        # collide — assistant.go:124; deliberate divergence). File ids are
+        # minted by the registry.
         self._next_id = 1 + max(
-            [_id_num(a["id"], "asst_") for a in self.assistants]
-            + [_id_num(f["id"], "file-") for f in self.files]
-            + [_id_num(af["id"], "file-") for af in self.assistant_files]
-            + [0]
+            [_id_num(a["id"], "asst_") for a in self.assistants] + [0]
         )
 
-    @staticmethod
-    def _load(path: Path) -> list[dict]:
-        try:
-            data = json.loads(path.read_text())
-            return data if isinstance(data, list) else []
-        except FileNotFoundError:
-            return []
-        except (OSError, ValueError) as e:
-            log.warning("cannot load %s: %s", path, e)
-            return []
+    @property
+    def files(self) -> list[dict]:
+        """The unified registry's metadata list (read-side compat)."""
+        return self.registry.files
 
-    def _save(self, path: Path, data: list[dict]) -> None:
-        path.parent.mkdir(parents=True, exist_ok=True)
-        tmp = path.with_suffix(".tmp")
-        tmp.write_text(json.dumps(data, indent=2))
-        tmp.replace(path)
+    # JSON persistence shares the batch store's helpers (one copy of the
+    # load / atomic tmp+rename save / id-suffix-parse logic)
+    _load = staticmethod(_load)
+
+    def _save(self, path, data: list[dict]) -> None:
+        _atomic_save(path, data)
 
     def save_assistants(self) -> None:
         self._save(self.configs_dir / ASSISTANTS_FILE, self.assistants)
@@ -87,9 +90,6 @@ class AssistantStore:
     def save_assistant_files(self) -> None:
         self._save(self.configs_dir / ASSISTANT_FILES_FILE,
                    self.assistant_files)
-
-    def save_files(self) -> None:
-        self._save(self.upload_dir / UPLOADED_FILES_FILE, self.files)
 
     def next_id(self) -> int:
         with self._lock:
@@ -103,14 +103,7 @@ class AssistantStore:
         return next((a for a in self.assistants if a["id"] == aid), None)
 
     def file(self, fid: str) -> Optional[dict]:
-        return next((f for f in self.files if f["id"] == fid), None)
-
-
-def _id_num(s: str, prefix: str) -> int:
-    try:
-        return int(s.removeprefix(prefix))
-    except ValueError:
-        return 0
+        return self.registry.get(fid)
 
 
 def _store(request: web.Request) -> AssistantStore:
@@ -357,11 +350,16 @@ async def delete_assistant_file(request: web.Request) -> web.Response:
 # /v1/files
 
 
+def _registry(request: web.Request) -> FileRegistry:
+    from localai_tpu.api.server import STATE_KEY
+
+    return request.app[STATE_KEY].files
+
+
 async def upload_file(request: web.Request) -> web.Response:
     from localai_tpu.api.server import STATE_KEY
 
     state = request.app[STATE_KEY]
-    store = _store(request)
     reader = await request.multipart()
     filename = None
     content = None
@@ -381,42 +379,21 @@ async def upload_file(request: web.Request) -> web.Response:
         return _bad(
             f"File size {len(content)} exceeds upload limit {limit}"
         )
-    # sanitize: basename only, traversal-guarded under the upload dir
-    safe_name = Path(filename).name
     try:
-        save_path = verify_path(safe_name, store.upload_dir)
-    except ValueError:
-        return _bad("invalid filename")
-    if save_path.exists():
-        return _bad("File already exists")
-    store.upload_dir.mkdir(parents=True, exist_ok=True)
-    save_path.write_bytes(content)
-    f = {
-        "id": f"file-{store.next_id()}",
-        "object": "file",
-        "bytes": len(content),
-        "created_at": int(time.time()),
-        "filename": safe_name,
-        "purpose": purpose,
-    }
-    with store._lock:
-        store.files.append(f)
-        store.save_files()
+        f = _registry(request).register_bytes(filename, content, purpose)
+    except ValueError as e:
+        return _bad(str(e))
     return web.json_response(f)
 
 
 async def list_files(request: web.Request) -> web.Response:
-    store = _store(request)
-    purpose = request.query.get("purpose", "")
-    data = [f for f in store.files
-            if not purpose or f.get("purpose") == purpose]
+    data = _registry(request).list(request.query.get("purpose", ""))
     return web.json_response({"object": "list", "data": data})
 
 
 def _file_or_404(request: web.Request) -> tuple[Optional[dict], Any]:
-    store = _store(request)
     fid = request.match_info["file_id"]
-    f = store.file(fid)
+    f = _registry(request).get(fid)
     if f is None:
         return None, _not_found(f"unable to find file id {fid}")
     return f, None
@@ -431,9 +408,8 @@ async def get_file_content(request: web.Request) -> web.Response:
     f, err = _file_or_404(request)
     if f is None:
         return err
-    store = _store(request)
     try:
-        path = verify_path(f["filename"], store.upload_dir)
+        path = _registry(request).content_path(f["id"])
         return web.Response(body=path.read_bytes())
     except (OSError, ValueError) as e:
         return web.json_response(error_body(str(e), code=500), status=500)
@@ -443,19 +419,7 @@ async def delete_file(request: web.Request) -> web.Response:
     f, err = _file_or_404(request)
     if f is None:
         return err
-    store = _store(request)
-    with store._lock:
-        try:
-            verify_path(f["filename"], store.upload_dir).unlink()
-        except FileNotFoundError:
-            pass  # metadata cleanup proceeds (files.go:158-162)
-        except (OSError, ValueError) as e:
-            return web.json_response(
-                error_body(f"Unable to delete file: {e}", code=500),
-                status=500,
-            )
-        store.files = [x for x in store.files if x["id"] != f["id"]]
-        store.save_files()
+    _registry(request).delete(f["id"])
     return web.json_response({
         "id": f["id"], "object": "file", "deleted": True,
     })

@@ -1,0 +1,113 @@
+"""OpenAI Batch API endpoints over the offline batch subsystem.
+
+``POST /v1/batches`` creates a job from an uploaded JSONL file
+(``/v1/files`` with ``purpose="batch"`` — the files routes live in
+``api/assistants.py`` over the unified FileRegistry), ``GET
+/v1/batches`` / ``GET /v1/batches/{id}`` read job state incl. progress
+counts, and ``POST /v1/batches/{id}/cancel`` stops a job (in-flight
+lines are abandoned; durable results are kept). Completed jobs carry
+``output_file_id``/``error_file_id`` downloadable at
+``GET /v1/files/{id}/content``.
+
+Execution happens in the background :class:`~localai_tpu.batch.
+executor.BatchExecutor` at the scheduler's batch priority — creating a
+job costs the serving path nothing.
+"""
+
+from __future__ import annotations
+
+import logging
+
+from aiohttp import web
+
+from localai_tpu.api.schema import error_body
+from localai_tpu.batch.executor import SUPPORTED_URLS
+
+log = logging.getLogger(__name__)
+
+
+def _state(request: web.Request):
+    from localai_tpu.api.server import STATE_KEY
+
+    return request.app[STATE_KEY]
+
+
+def _bad(msg: str) -> web.Response:
+    return web.json_response(error_body(msg, code=400), status=400)
+
+
+def _not_found(msg: str) -> web.Response:
+    return web.json_response(error_body(msg, code=404), status=404)
+
+
+async def create_batch(request: web.Request) -> web.Response:
+    state = _state(request)
+    try:
+        body = await request.json()
+    except Exception:
+        return _bad("Cannot parse JSON")
+    if not isinstance(body, dict):
+        return _bad("body must be a JSON object")
+    endpoint = body.get("endpoint") or ""
+    if endpoint not in SUPPORTED_URLS:
+        return _bad(f"endpoint must be one of {list(SUPPORTED_URLS)}")
+    fid = body.get("input_file_id") or ""
+    f = state.files.get(fid)
+    if f is None:
+        return _not_found(f"input file {fid!r} not found")
+    if f.get("purpose") != "batch":
+        return _bad(
+            f"input file {fid!r} has purpose {f.get('purpose')!r}; "
+            "upload it with purpose=batch")
+    metadata = body.get("metadata")
+    if metadata is not None and not isinstance(metadata, dict):
+        return _bad("metadata must be an object")
+    job = state.batches.create(
+        endpoint=endpoint,
+        input_file_id=fid,
+        completion_window=str(body.get("completion_window") or "24h"),
+        metadata=metadata,
+    )
+    state.batches.export_gauges()
+    svc = state.batch_service  # lazily starts the executor thread
+    svc.wake()
+    return web.json_response(job)
+
+
+async def list_batches(request: web.Request) -> web.Response:
+    jobs = _state(request).batches.list()
+    jobs.sort(key=lambda j: j.get("created_at", 0), reverse=True)
+    try:
+        limit = int(request.query.get("limit", "20"))
+    except ValueError:
+        return _bad("Invalid limit query value")
+    if limit < 1:
+        return _bad("limit must be >= 1")
+    return web.json_response({"object": "list", "data": jobs[:limit]})
+
+
+async def get_batch(request: web.Request) -> web.Response:
+    job = _state(request).batches.get(request.match_info["batch_id"])
+    if job is None:
+        return _not_found("Unable to find batch")
+    return web.json_response(job)
+
+
+async def cancel_batch(request: web.Request) -> web.Response:
+    state = _state(request)
+    job = state.batches.cancel(request.match_info["batch_id"])
+    if job is None:
+        return _not_found("Unable to find batch")
+    state.batches.export_gauges()
+    return web.json_response(job)
+
+
+def routes() -> list[web.RouteDef]:
+    # /v1 only (no unversioned aliases): the bare GET /batches path is the
+    # web UI's job panel, and the Batch API has no pre-/v1 legacy clients
+    return [
+        web.post("/v1/batches", create_batch),
+        web.get("/v1/batches", list_batches),
+        web.get("/v1/batches/{batch_id}", get_batch),
+        web.post("/v1/batches/{batch_id}/cancel", cancel_batch),
+    ]

@@ -171,6 +171,24 @@ def expand_image_placeholders(
     return tokens, np.concatenate(rows, 0), np.asarray(poss, np.int32)
 
 
+def request_deadline_s(cfg: Any = None) -> float:
+    """The per-request generation deadline in seconds: AppConfig's
+    ``request_deadline_s`` when a config is at hand, else the
+    ``LOCALAI_REQUEST_DEADLINE_S`` environment override, else 600.
+    Deadline expiry CANCELS the generation (the decode slot frees instead
+    of generating into the void — see :func:`run_choices` and the API
+    tier's ``_await_handles``)."""
+    import os
+
+    v = getattr(cfg, "request_deadline_s", None) if cfg is not None else None
+    if v is None:
+        try:
+            v = float(os.environ.get("LOCALAI_REQUEST_DEADLINE_S", ""))
+        except ValueError:
+            v = None
+    return float(v) if v and v > 0 else 600.0
+
+
 def shed_check(model: str, scheduler: Any = None) -> None:
     """SLO burn-rate admission control (obs.slo): when the observatory
     says this model is out of its error budget on BOTH the fast and slow
@@ -227,6 +245,7 @@ def build_gen_request(
     mm_embeds: Any = None,
     correlation_id: str = "",
     trace_id: str = "",
+    priority: int = 0,
 ) -> GenRequest:
     p = cfg.parameters
     mm_flat = mm_pos = None
@@ -267,6 +286,7 @@ def build_gen_request(
         stream=bool(req.stream),
         mm_embeds=mm_flat,
         mm_positions=mm_pos,
+        priority=priority,
     )
 
 
@@ -418,11 +438,21 @@ def run_choices(
     prompt: str,
     *,
     constraint_factory=None,
-    timeout: float = 600.0,
+    timeout: Optional[float] = None,
 ) -> list[GenHandle]:
     """Submit n parallel generations and wait (parity: ComputeChoices loop,
     inference.go:11 — but concurrent via the continuous-batching engine
-    rather than sequential)."""
+    rather than sequential).
+
+    ``timeout=None`` resolves the deadline from the environment/default
+    only (:func:`request_deadline_s` with no config — this helper has no
+    AppConfig at hand); callers holding an AppConfig should pass
+    ``timeout=request_deadline_s(app_config)`` explicitly, as the API
+    tier's ``_await_handles`` does. On expiry every handle is CANCELLED —
+    the decode slots free on the next engine step — before the
+    TimeoutError propagates."""
+    if timeout is None:
+        timeout = request_deadline_s()
     n = max(1, req.n or 1)
     handles = []
     for i in range(n):
@@ -431,6 +461,11 @@ def run_choices(
             sm, cfg, req, prompt, constraint=constraint, seed_offset=i
         )
         handles.append(sm.scheduler.submit(gr))
-    for h in handles:
-        h.result(timeout)
+    try:
+        for h in handles:
+            h.result(timeout)
+    except TimeoutError:
+        for h in handles:
+            h.cancel()
+        raise
     return handles

@@ -48,6 +48,12 @@ async def rerank(request: web.Request) -> web.Response:
 
     req = sc.OpenAIRequest(model=body.get("model") or "")
     req.model = _default_model(request, req.model)
+    # SLO admission control: rerank scores ride the same engine capacity
+    # as generation — refuse under overload with the same preserved
+    # Retry-After instead of queueing into a latency spiral
+    from localai_tpu.api import inference as inf
+
+    inf.shed_check(req.model)
     state = _state(request)
     mcfg = state.loader.get(req.model)
     if mcfg is not None and state.manager.is_reranker(mcfg):

@@ -91,6 +91,13 @@ async def metrics(request: web.Request) -> web.Response:
     from localai_tpu.obs import slo as obs_slo
 
     obs_slo.SLO.export_gauges()
+    # offline batch subsystem: job-state gauge + lane-paused flag refresh
+    # at scrape time (host-side JSON reads only)
+    state.batches.export_gauges()
+    svc = state._batch_service
+    REGISTRY.batch_lane_paused.set(
+        1 if (svc is not None and svc.paused) else 0
+    )
     return web.Response(
         text=REGISTRY.render(),
         content_type="text/plain",

@@ -98,17 +98,29 @@ async def _in_executor(request: web.Request, fn, *args):
     )
 
 
-async def _await_handles(request: web.Request, handles, timeout: float = 600.0):
+async def _await_handles(request: web.Request, handles,
+                         timeout: Optional[float] = None):
     """Wait for generations, cancelling them all if the client goes away
     (otherwise orphaned work would hold decode slots to max_tokens).
+    ``timeout=None`` resolves the configurable per-request deadline
+    (AppConfig.request_deadline_s / LOCALAI_REQUEST_DEADLINE_S); expiry
+    cancels every handle — the slots free on the next engine step — and
+    surfaces 504, not an orphaned generation.
     A handle that finished with reason "error" and produced nothing is a
     backend failure — surface 502, not a successful empty completion."""
+    if timeout is None:
+        timeout = inf.request_deadline_s(_state(request).config)
     try:
         for h in handles:
             await _in_executor(request, h.result, timeout)
-    except BaseException:
+    except BaseException as e:
         for h in handles:
             h.cancel()
+        if isinstance(e, TimeoutError):
+            raise web.HTTPGatewayTimeout(
+                text=f"generation exceeded the {timeout:.0f}s request "
+                     "deadline and was cancelled"
+            ) from e
         raise
     for h in handles:
         if h.finish_reason == "error" and not h.text:
@@ -524,6 +536,11 @@ async def edits(request: web.Request) -> web.Response:
 
 async def embeddings(request: web.Request) -> web.Response:
     req = await _read_request(request)
+    # SLO admission control covers embeddings too (they ride the same
+    # engine/executor capacity as generation); checked before any model
+    # load so a 429 costs the overloaded process nothing. Retry-After
+    # survives the error middleware's JSON re-wrap.
+    inf.shed_check(req.model)
 
     inputs: list[Any]
     if req.input is None:

@@ -111,13 +111,49 @@ class AppState:
         self.watcher = ConfigWatcher(self.config.config_path)
         attach_standard_handlers(self.watcher, self)
         self.watcher.start()
-        # assistants/files persistence, reloaded at boot (parity:
-        # app.go:152-154 LoadConfig of assistants.json/uploadedFiles.json)
+        # unified /v1/files registry + assistants persistence, reloaded at
+        # boot (parity: app.go:152-154 LoadConfig of assistants.json/
+        # uploadedFiles.json) — one FileRegistry serves assistants
+        # attachments, batch inputs, and batch result downloads
         from localai_tpu.api.assistants import AssistantStore
+        from localai_tpu.batch import BatchStore, FileRegistry
 
+        self.files = FileRegistry(self.config.upload_path)
         self.assistants = AssistantStore(
-            self.config.config_path, self.config.upload_path
+            self.config.config_path, self.config.upload_path,
+            registry=self.files,
         )
+        # offline batch subsystem: durable job store now, executor thread
+        # lazily (batch_service) — but jobs that survived a restart resume
+        # without waiting for an API call
+        self.batches = BatchStore(
+            self.config.upload_path, self.files,
+            expiry_h=self.config.batch_expiry_h,
+        )
+        self._batch_service = None
+        if self.batches.runnable() is not None:
+            self.batch_service.wake()
+
+    @property
+    def batch_service(self):
+        """Lazily started batch executor (the background-lane drain
+        thread); first access starts it."""
+        if self._batch_service is None:
+            from localai_tpu.batch import BatchExecutor
+
+            def serving_for(name: str):
+                mcfg = self.loader.get(name)
+                if mcfg is None:
+                    raise ValueError(f"model {name!r} not found")
+                return self.manager.get(name), mcfg
+
+            self._batch_service = BatchExecutor(
+                self.batches, serving_for,
+                concurrency=self.config.batch_concurrency,
+                deadline_s=self.config.request_deadline_s,
+            )
+            self._batch_service.start()
+        return self._batch_service
 
     @property
     def gallery_service(self):
@@ -149,6 +185,10 @@ class AppState:
 
     def shutdown(self) -> None:
         self.watcher.stop()
+        if self._batch_service is not None:
+            # stop BEFORE the engines go down: an in_progress job stays
+            # durable and resumes from its output file on next boot
+            self._batch_service.stop()
         self.manager.shutdown_all()
         if self._gallery_service is not None:
             self._gallery_service.shutdown()
@@ -311,6 +351,7 @@ def create_app(state: Optional[AppState] = None) -> web.Application:
     app[STATE_KEY] = state
     from localai_tpu.api import assistants as assistant_routes
     from localai_tpu.api import audio as audio_routes
+    from localai_tpu.api import batches as batch_routes
     from localai_tpu.api import gallery as gallery_routes
     from localai_tpu.api import images as image_routes
     from localai_tpu.api import jina as jina_routes
@@ -325,6 +366,7 @@ def create_app(state: Optional[AppState] = None) -> web.Application:
     app.add_routes(audio_routes.routes())
     app.add_routes(image_routes.routes())
     app.add_routes(assistant_routes.routes())
+    app.add_routes(batch_routes.routes())
     if not state.config.disable_webui:
         from localai_tpu.api import ui as ui_routes
 

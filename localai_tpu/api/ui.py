@@ -95,6 +95,7 @@ def _page(title: str, body: str, script: str = "") -> web.Response:
     <a href="/tts/">TTS</a>
     <a href="/swarm">Swarm</a>
     <a href="/slo">SLO</a>
+    <a href="/batches">Batches</a>
   </nav>
   <input id="apikey" placeholder="API key (if set)"
          onchange="saveKey(this)" size="18">
@@ -752,6 +753,71 @@ setInterval(refresh, 2000);
 
 
 # ---------------------------------------------------------------------------
+# offline batch jobs
+
+
+async def batches_page(request: web.Request) -> web.Response:
+    """GET /batches — offline batch-job panel over GET /v1/batches: job
+    list with live progress counts and lifecycle state. Read-side polling
+    only (job creation goes through the JSON API with an uploaded file)."""
+    body = """
+<div class="card">
+  <div class="row"><h2 style="flex:1">Batch jobs</h2>
+    <span id="lane" class="badge">…</span></div>
+  <div id="jobs" class="dim">loading…</div>
+  <p class="dim">Submit jobs with <code>POST /v1/files</code>
+  (purpose=batch) + <code>POST /v1/batches</code>; download results from
+  <code>/v1/files/{output_file_id}/content</code>.</p>
+</div>"""
+    script = """
+function table(out, headers, rows) {  // textContent only: API data is
+  out.textContent = '';               // untrusted for innerHTML
+  const t = document.createElement('table');
+  const hr = t.insertRow();
+  headers.forEach(h => {
+    const th = document.createElement('th');
+    th.textContent = h; hr.appendChild(th);
+  });
+  rows.forEach(r => {
+    const tr = t.insertRow();
+    r.forEach(v => tr.insertCell().textContent = v);
+  });
+  out.appendChild(t);
+  if (!rows.length) out.textContent = 'no batch jobs yet';
+}
+async function refresh() {
+  try {
+    const d = await (await fetch('/v1/batches',
+                                 {headers: authHeaders()})).json();
+    const jobs = d.data || [];
+    const active = jobs.some(j => j.status === 'in_progress');
+    const badge = document.getElementById('lane');
+    badge.textContent = active ? 'RUNNING' : 'idle';
+    badge.className = 'badge' + (active ? ' loaded' : '');
+    const rows = jobs.map(j => {
+      const c = j.request_counts || {};
+      const done = (c.completed || 0) + (c.failed || 0);
+      const pct = c.total ? Math.round(100 * done / c.total) : 0;
+      return [j.id, j.endpoint, j.status,
+              done + '/' + (c.total || 0) + ' (' + pct + '%)',
+              c.failed || 0,
+              j.output_file_id || '—',
+              new Date((j.created_at || 0) * 1000).toLocaleString()];
+    });
+    table(document.getElementById('jobs'),
+          ['id', 'endpoint', 'status', 'progress', 'failed',
+           'output file', 'created'], rows);
+  } catch (e) {
+    document.getElementById('jobs').textContent = 'error: ' + e.message;
+  }
+}
+refresh();
+setInterval(refresh, 2000);
+"""
+    return _page("Batches", body, script)
+
+
+# ---------------------------------------------------------------------------
 # wiring
 
 
@@ -761,7 +827,7 @@ UI_PREFIXES = ("/browse", "/chat/", "/text2image/", "/tts/", "/talk/")
 # exact-match key-free pages (prefix matching would also exempt JSON
 # sub-routes like /swarm/nodes, which must stay API-key-protected — that
 # endpoint performs server-side fetches of the operator-named router)
-UI_EXACT = ("/swarm", "/slo")
+UI_EXACT = ("/swarm", "/slo", "/batches")
 
 
 def wants_html(request: web.Request) -> bool:
@@ -782,4 +848,5 @@ def routes() -> list[web.RouteDef]:
         web.get("/swarm", swarm_page),
         web.get("/swarm/nodes", swarm_nodes),
         web.get("/slo", slo_page),
+        web.get("/batches", batches_page),
     ]

@@ -1,0 +1,14 @@
+"""Offline batch-inference subsystem (OpenAI Batch API shape).
+
+``store`` holds the durable halves — the unified ``/v1/files``
+:class:`~localai_tpu.batch.store.FileRegistry` and the crash-safe
+:class:`~localai_tpu.batch.store.BatchStore` job records —
+``executor`` drains jobs through the engine scheduler's background
+priority lane (``engine.scheduler.PRIORITY_BATCH``), and
+``api.batches`` exposes the HTTP surface.
+"""
+
+from localai_tpu.batch.executor import BatchExecutor
+from localai_tpu.batch.store import BatchStore, FileRegistry
+
+__all__ = ["BatchExecutor", "BatchStore", "FileRegistry"]

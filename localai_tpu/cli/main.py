@@ -86,6 +86,17 @@ def build_parser() -> argparse.ArgumentParser:
     run.add_argument("--slo-burn-threshold", type=float, default=None,
                      help="error-budget burn rate that triggers load "
                           "shedding (default 2.0)")
+    run.add_argument("--request-deadline-s", type=float, default=None,
+                     help="per-request generation deadline in seconds; "
+                          "expiry cancels the generation and frees its "
+                          "decode slot (default 600)")
+    # offline batch subsystem (localai_tpu.batch): background-lane knobs
+    run.add_argument("--batch-concurrency", type=int, default=None,
+                     help="max in-flight batch lines on the scheduler's "
+                          "background lane (default 2)")
+    run.add_argument("--batch-expiry-h", type=float, default=None,
+                     help="hours before a non-terminal batch job expires "
+                          "(default 24)")
 
     models = sub.add_parser("models", help="model management")
     models_sub = models.add_subparsers(dest="models_command")
@@ -356,6 +367,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             slo_e2e_p95_ms=args.slo_e2e_p95_ms,
             slo_queue_p95_ms=args.slo_queue_p95_ms,
             slo_burn_threshold=args.slo_burn_threshold,
+            request_deadline_s=args.request_deadline_s,
+            batch_concurrency=args.batch_concurrency,
+            batch_expiry_h=args.batch_expiry_h,
         )
         serve(cfg)
         return 0

@@ -89,6 +89,20 @@ class AppConfig:
     slo_queue_p95_ms: float = 0.0
     slo_burn_threshold: float = 2.0
 
+    # per-request wall-clock deadline for synchronous generation waits
+    # (LOCALAI_REQUEST_DEADLINE_S / --request-deadline-s): expiry CANCELS
+    # the generation so the decode slot frees instead of generating into
+    # the void, and the client gets 504
+    request_deadline_s: float = 600.0
+
+    # offline batch subsystem (localai_tpu.batch): max in-flight batch
+    # lines the executor keeps submitted on the scheduler's background
+    # lane, and how long a non-terminal job may live before it expires
+    # (LOCALAI_BATCH_CONCURRENCY / LOCALAI_BATCH_EXPIRY_H; CLI
+    # --batch-concurrency / --batch-expiry-h)
+    batch_concurrency: int = 2
+    batch_expiry_h: float = 24.0
+
     # TPU-specific
     mesh_shape: Optional[dict[str, int]] = None   # None = auto from devices
     platform: Optional[str] = None                # force jax platform (tests: cpu)

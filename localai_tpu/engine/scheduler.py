@@ -42,6 +42,14 @@ from localai_tpu.obs.engine import EngineTelemetry
 log = logging.getLogger(__name__)
 
 
+# admission lanes: interactive requests (API traffic with a client
+# waiting) are admitted strictly before background batch work — a batch
+# line only fills a slot when no interactive request is queued, so
+# offline jobs soak idle capacity without touching interactive TTFT.
+PRIORITY_INTERACTIVE = 0
+PRIORITY_BATCH = 1
+
+
 class TokenConstraint(Protocol):
     """Grammar/JSON-schema constraint driven by the scheduler.
 
@@ -86,6 +94,9 @@ class GenRequest:
     # placeholder token positions [n_mm] during prefill (see ModelRunner)
     mm_embeds: Optional[Any] = None
     mm_positions: Optional[Any] = None
+    # admission lane: PRIORITY_BATCH requests queue on the background lane
+    # and are admitted only when the interactive lane is empty
+    priority: int = PRIORITY_INTERACTIVE
 
 
 class StreamItem:
@@ -120,6 +131,9 @@ class GenHandle:
         self.t_done: Optional[float] = None
         # lifecycle trace (obs.RequestTrace), attached by the scheduler
         self.trace = None
+        # global admission order (engine thread stamps it in _start):
+        # lane-ordering tests and forensics read it; None until admitted
+        self.admit_index: Optional[int] = None
 
     # engine-thread side -------------------------------------------------
     def _emit(self, delta: str, token_id: Optional[int]) -> None:
@@ -267,7 +281,11 @@ class Scheduler:
         # folded into the per-token EMA (one multi-second compile sample
         # would pin the adaptive size at 1 for a long recovery)
         self._seen_shapes: set = set()
+        # two-lane admission: interactive requests drain strictly before
+        # the background batch lane (see _next_pending)
         self._pending: "queue.Queue[GenHandle]" = queue.Queue()
+        self._pending_batch: "queue.Queue[GenHandle]" = queue.Queue()
+        self._admit_seq = 0
         self._slots: dict[int, _SlotCtx] = {}
         self._ids = itertools.count()
         self._wake = threading.Event()
@@ -294,7 +312,9 @@ class Scheduler:
     def submit(self, req: GenRequest) -> GenHandle:
         handle = GenHandle(req, next(self._ids))
         handle.trace = self.telemetry.queued(handle)
-        self._pending.put(handle)
+        lane = (self._pending_batch if req.priority >= PRIORITY_BATCH
+                else self._pending)
+        lane.put(handle)
         self._wake.set()
         return handle
 
@@ -303,7 +323,8 @@ class Scheduler:
 
     @property
     def busy(self) -> bool:
-        return bool(self._slots) or not self._pending.empty()
+        return (bool(self._slots) or not self._pending.empty()
+                or not self._pending_batch.empty())
 
     def note_shed(self) -> None:
         """Record one SLO admission-control rejection against this engine
@@ -336,12 +357,18 @@ class Scheduler:
                 for s, c in self._slots.items()
             ]
             kv_utilization = self._kv_utilization()
+            batch_slots = sum(
+                1 for c in self._slots.values()
+                if c.handle.request.priority >= PRIORITY_BATCH
+            )
         return {
             "active_slots": active,
             "num_slots": num_slots,
             "occupancy": len(active) / num_slots if num_slots else 0.0,
             "kv_utilization": kv_utilization,
             "queue_depth": self._pending.qsize(),
+            "batch_queue_depth": self._pending_batch.qsize(),
+            "batch_slots": batch_slots,
             "total_prompt_tokens": self.total_prompt_tokens,
             "total_generated_tokens": self.total_generated_tokens,
             "prefix_tokens_reused": self.runner.total_prefix_reused,
@@ -402,11 +429,16 @@ class Scheduler:
         end-of-dispatch state."""
         emitted = self._tokens_emitted
         num_slots = self.runner.num_slots
+        batch_slots = sum(
+            1 for c in self._slots.values()
+            if c.handle.request.priority >= PRIORITY_BATCH
+        )
         self.flight.record(
             program=program,
             steps=steps,
             dispatch_ms=dt * 1e3,
             occupancy=len(self._slots) / num_slots if num_slots else 0.0,
+            batch_slots=batch_slots,
             queue_depth=self._pending.qsize(),
             kv_utilization=self._kv_utilization(),
             tokens=emitted - self._flight_mark,
@@ -682,12 +714,25 @@ class Scheduler:
             p *= 2
         return p
 
+    def _next_pending(self) -> Optional[GenHandle]:
+        """Two-lane admission pop: the interactive lane drains strictly
+        first; a batch request is handed out only when the interactive
+        queue depth is zero at this instant — so background work is
+        invisible to interactive queue wait by construction."""
+        try:
+            return self._pending.get_nowait()
+        except queue.Empty:
+            pass
+        try:
+            return self._pending_batch.get_nowait()
+        except queue.Empty:
+            return None
+
     def _admit_pending(self) -> bool:
         admitted = False
         while self._engine.free_slots():
-            try:
-                handle = self._pending.get_nowait()
-            except queue.Empty:
+            handle = self._next_pending()
+            if handle is None:
                 return admitted
             if handle.cancelled:
                 # abandoned while still queued: not a slot exit, so it is
@@ -721,9 +766,12 @@ class Scheduler:
     def _start(self, slot: int, handle: GenHandle,
                positions: Optional[np.ndarray] = None) -> None:
         req = handle.request
+        handle.admit_index = self._admit_seq  # engine thread is sole writer
+        self._admit_seq += 1
         self.telemetry.admitted(
             handle.trace, slot=slot,
             queue_wait=time.monotonic() - handle.t_submit,
+            background=req.priority >= PRIORITY_BATCH,
         )
         base = self._padded_vocab_ban()
         if req.logit_bias:

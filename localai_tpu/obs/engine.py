@@ -57,7 +57,11 @@ class EngineTelemetry:
         return tr
 
     def admitted(self, tr: Optional[RequestTrace], *, slot: int,
-                 queue_wait: float) -> None:
+                 queue_wait: float, background: bool = False) -> None:
+        """``background`` marks a batch-lane request: it waits in the
+        queue BY DESIGN (only admitted when the interactive lane is
+        empty), so its queue wait must not pollute the interactive
+        latency histogram — traces still record it."""
         if tr is None:
             return
         tr.end("queued", seconds=round(queue_wait, 6))
@@ -66,7 +70,8 @@ class EngineTelemetry:
         # stashed for finished(): the SLO observatory wants queue wait on
         # the same completion event as the latency metrics
         tr.annotate(queue_wait_ms=round(queue_wait * 1e3, 3))
-        self.registry.queue_wait.observe(queue_wait, model=self.model)
+        if not background:
+            self.registry.queue_wait.observe(queue_wait, model=self.model)
 
     def prefill_done(self, tr: Optional[RequestTrace], *, path: str = "",
                      prefix_reused: int = 0) -> None:
@@ -84,9 +89,20 @@ class EngineTelemetry:
         ``preempted`` marks a request that left a decode SLOT before
         natural completion; defaults from the reason, but a request
         cancelled while still queued passes False — queue abandonment is
-        not slot churn."""
+        not slot churn.
+
+        Background batch-lane requests (``request.priority > 0``) never
+        become SLO events and stay out of the TTFT/TPOT histograms: a
+        batch line queues behind ALL interactive work by design, so its
+        latencies are meaningless against interactive targets — and
+        counting them would let an offline job trip shedding of the
+        interactive traffic the lane exists to protect (the executor
+        would then pause on the shedding its own lines caused). Requests/
+        preemptions counters and traces still record them."""
         if tr is None:
             return
+        background = getattr(getattr(handle, "request", None),
+                             "priority", 0) > 0
         n = handle.completion_tokens
         ttft = tpot = None
         if handle.t_first_token is not None:
@@ -103,9 +119,9 @@ class EngineTelemetry:
             tpot_ms=None if tpot is None else round(tpot * 1e3, 3),
             tokens_per_second=round(handle.tokens_per_second, 3),
         )
-        if ttft is not None:
+        if ttft is not None and not background:
             self.registry.ttft.observe(ttft, model=self.model)
-        if tpot is not None:
+        if tpot is not None and not background:
             self.registry.tpot.observe(tpot, model=self.model)
         self.registry.requests.inc(model=self.model, finish_reason=reason)
         # sole writer of the preemptions family (the scheduler's
@@ -114,7 +130,7 @@ class EngineTelemetry:
             preempted = reason in PREEMPT_REASONS
         if preempted:
             self.registry.preemptions.inc(model=self.model, reason=reason)
-        if reason in SLO_REASONS:
+        if reason in SLO_REASONS and not background:
             t_end = handle.t_done or time.monotonic()
             self.slo.observe(
                 self.model or "engine",

@@ -58,6 +58,7 @@ class FlightRecorder:
         self._steps = np.zeros(n, np.int64)
         self._dispatch_ms = np.zeros(n)
         self._occupancy = np.zeros(n)
+        self._batch_slots = np.zeros(n, np.int64)
         self._queue_depth = np.zeros(n, np.int64)
         self._kv_utilization = np.zeros(n)
         self._tokens = np.zeros(n, np.int64)
@@ -74,8 +75,13 @@ class FlightRecorder:
                occupancy: float, queue_depth: int, kv_utilization: float,
                tokens: int, preemptions: int = 0,
                spec_accept: Optional[float] = None,
-               compile: bool = False, ts: Optional[float] = None) -> None:
-        """Append one dispatch record (host scalars only)."""
+               compile: bool = False, ts: Optional[float] = None,
+               batch_slots: int = 0) -> None:
+        """Append one dispatch record (host scalars only).
+
+        ``batch_slots`` tags the record with the lane mix: how many of the
+        occupied slots were background batch-lane requests at drain time
+        (0 = pure interactive dispatch)."""
         now = time.monotonic() if ts is None else ts
         with self._lock:
             i = self._n % self.capacity
@@ -83,6 +89,7 @@ class FlightRecorder:
             self._steps[i] = steps
             self._dispatch_ms[i] = dispatch_ms
             self._occupancy[i] = occupancy
+            self._batch_slots[i] = batch_slots
             self._queue_depth[i] = queue_depth
             self._kv_utilization[i] = kv_utilization
             self._tokens[i] = tokens
@@ -131,6 +138,7 @@ class FlightRecorder:
                 "steps": self._steps[order].tolist(),
                 "ms": self._dispatch_ms[order].tolist(),
                 "occ": self._occupancy[order].tolist(),
+                "batch": self._batch_slots[order].tolist(),
                 "queue": self._queue_depth[order].tolist(),
                 "kv": self._kv_utilization[order].tolist(),
                 "tokens": self._tokens[order].tolist(),
@@ -154,6 +162,7 @@ class FlightRecorder:
                 "dispatch_ms": round(ms, 3),
                 "step_ms": (round(ms / steps, 4) if steps > 0 else None),
                 "occupancy": round(cols["occ"][j], 4),
+                "batch_slots": cols["batch"][j],
                 "queue_depth": cols["queue"][j],
                 "kv_utilization": round(cols["kv"][j], 4),
                 "tokens": cols["tokens"][j],

@@ -239,6 +239,26 @@ class Registry:
             "Generation requests refused with 429 by SLO burn-rate "
             "admission control",
         )
+        # -- offline batch subsystem (localai_tpu.batch) -------------------
+        self.batch_jobs = Gauge(
+            "localai_batch_jobs",
+            "Batch jobs by lifecycle state "
+            "(validating/in_progress/completed/failed/cancelled/expired)",
+        )
+        self.batch_lines = Counter(
+            "localai_batch_lines_total",
+            "Batch input lines drained by result (completed/failed)",
+        )
+        self.batch_lane_paused = Gauge(
+            "localai_batch_lane_paused",
+            "1 while the background batch lane is paused because the SLO "
+            "observatory reports overload shedding (in-flight lines are "
+            "requeued, never failed)",
+        )
+        self.batch_queue_depth = Gauge(
+            "localai_batch_queue_depth",
+            "Requests waiting in the scheduler's background batch lane",
+        )
         # -- stall forensics + device health (obs.watchdog / obs.device) --
         self.engine_stalled = Gauge(
             "localai_engine_stalled",
@@ -311,6 +331,8 @@ def update_engine_gauges(name: str, m: dict,
     if occupancy is not None:
         reg.batch_occupancy.set(occupancy, model=name)
     reg.queue_depth.set(m.get("queue_depth", 0), model=name)
+    if "batch_queue_depth" in m:
+        reg.batch_queue_depth.set(m["batch_queue_depth"], model=name)
     if "kv_utilization" in m:
         reg.kv_utilization.set(m["kv_utilization"], model=name)
     reg.decode_dispatches.set_total(m.get("dispatches", 0), model=name)

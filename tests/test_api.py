@@ -75,13 +75,21 @@ class _ServerThread:
 
 
 def make_state(models_dir, *, write_tiny: bool = False) -> AppState:
-    """AppState over a models dir (shared with test_gallery)."""
+    """AppState over a models dir (shared with test_gallery). Upload and
+    config dirs live NEXT TO the models dir (a tmp path) — the durable
+    file/batch registries must never leak into the repo working dir."""
     from pathlib import Path
 
     models_dir = Path(models_dir)
     if write_tiny:
         (models_dir / "tiny.yaml").write_text(TINY_YAML)
-    cfg = AppConfig(model_path=str(models_dir))
+    cfg = AppConfig(
+        model_path=str(models_dir),
+        # sibling dirs named after the (unique) tmp models dir, so states
+        # built from different tmp paths never share durable registries
+        upload_path=str(models_dir) + "_uploads",
+        config_path=str(models_dir) + "_conf",
+    )
     loader = ConfigLoader(models_dir)
     loader.load_from_path(context_size=cfg.context_size)
     return AppState(cfg, loader)
@@ -830,3 +838,146 @@ def test_debug_devices_probe_timeout_validated(client):
                           params={"probe_timeout": bad}).status_code == 400
     assert client.get("/debug/devices",
                       params={"probe_timeout": "inf"}).status_code == 200
+
+
+# ---------------------------------------------------------------------------
+# offline batch API (localai_tpu.batch)
+
+
+def _upload_batch_file(client, lines, name="batch_input.jsonl"):
+    payload = ("\n".join(json.dumps(l) for l in lines) + "\n").encode()
+    r = client.post("/v1/files", files={"file": (name, payload)},
+                    data={"purpose": "batch"})
+    assert r.status_code == 200, r.text
+    return r.json()
+
+
+def test_batch_api_end_to_end(client):
+    """Acceptance: a job submitted via /v1/files + /v1/batches runs to
+    completed with a downloadable per-line output file, while a concurrent
+    interactive request keeps being served."""
+    import time as _time
+
+    f = _upload_batch_file(client, [
+        {"custom_id": f"req-{i}", "method": "POST",
+         "url": "/v1/chat/completions",
+         "body": {"model": "tiny", "max_tokens": 4, "temperature": 0.0,
+                  "messages": [{"role": "user",
+                                "content": f"batch line {i}"}]}}
+        for i in range(5)
+    ])
+    assert f["purpose"] == "batch"
+    r = client.post("/v1/batches", json={
+        "endpoint": "/v1/chat/completions",
+        "input_file_id": f["id"],
+        "metadata": {"suite": "test_api"},
+    })
+    assert r.status_code == 200, r.text
+    job = r.json()
+    assert job["object"] == "batch" and job["status"] == "validating"
+    # a concurrent interactive request is admitted ahead of pending batch
+    # lines (the lane policy) — and must simply succeed here
+    r = client.post("/v1/chat/completions", json={
+        "model": "tiny",
+        "messages": [{"role": "user", "content": "interactive wins"}],
+        "max_tokens": 4,
+    })
+    assert r.status_code == 200
+    deadline = _time.monotonic() + 120
+    while _time.monotonic() < deadline:
+        job = client.get(f"/v1/batches/{job['id']}").json()
+        if job["status"] in ("completed", "failed", "cancelled", "expired"):
+            break
+        _time.sleep(0.2)
+    assert job["status"] == "completed", job
+    assert job["request_counts"] == {"total": 5, "completed": 5,
+                                     "failed": 0}
+    # listed, and the per-line output downloads through the file registry
+    listed = client.get("/v1/batches").json()
+    assert job["id"] in [j["id"] for j in listed["data"]]
+    out = client.get(f"/v1/files/{job['output_file_id']}/content")
+    assert out.status_code == 200
+    records = [json.loads(l) for l in out.text.splitlines()]
+    assert {rec["custom_id"] for rec in records} == {f"req-{i}"
+                                                     for i in range(5)}
+    for rec in records:
+        assert rec["response"]["status_code"] == 200
+        body = rec["response"]["body"]
+        assert body["choices"][0]["message"]["content"] is not None
+    meta = client.get(f"/v1/files/{job['output_file_id']}").json()
+    assert meta["purpose"] == "batch_output"
+    # batch series render at /metrics; the lane is not paused
+    text = client.get("/metrics").text
+    assert 'localai_batch_jobs{state="completed"} 1' in text
+    assert 'localai_batch_lane_paused 0' in text
+    # cancel on a terminal job is a no-op, unknown id is 404
+    r = client.post(f"/v1/batches/{job['id']}/cancel")
+    assert r.status_code == 200 and r.json()["status"] == "completed"
+    assert client.post("/v1/batches/batch_999/cancel").status_code == 404
+
+
+def test_batch_create_validation(client):
+    r = client.post("/v1/batches", json={"endpoint": "/v1/images",
+                                         "input_file_id": "file-1"})
+    assert r.status_code == 400
+    r = client.post("/v1/batches", json={
+        "endpoint": "/v1/chat/completions", "input_file_id": "file-999"})
+    assert r.status_code == 404
+    # a file uploaded for assistants cannot seed a batch job
+    payload = b'{"custom_id": "a"}\n'
+    f = client.post("/v1/files",
+                    files={"file": ("not_batch.jsonl", payload)},
+                    data={"purpose": "assistants"}).json()
+    r = client.post("/v1/batches", json={
+        "endpoint": "/v1/chat/completions", "input_file_id": f["id"]})
+    assert r.status_code == 400
+    assert "purpose" in r.json()["error"]["message"]
+    assert client.get("/v1/batches/batch_999").status_code == 404
+    # list limit must be a positive integer (limit=-1 would silently
+    # drop the newest job)
+    assert client.get("/v1/batches",
+                      params={"limit": "-1"}).status_code == 400
+    assert client.get("/v1/batches",
+                      params={"limit": "x"}).status_code == 400
+
+
+def test_batches_ui_page_served(client):
+    r = client.get("/batches", headers={"Accept": "text/html"})
+    assert r.status_code == 200
+    assert "Batch jobs" in r.text
+
+
+def test_embeddings_and_rerank_shed_under_overload(client):
+    """Satellite: the SLO admission hook covers embeddings and rerank too,
+    with the same preserved Retry-After header."""
+    from localai_tpu.obs import slo as obs_slo
+
+    SLO = obs_slo.SLO
+    saved = dict(targets=dict(SLO.targets),
+                 burn_threshold=SLO.burn_threshold,
+                 recover_burn=SLO.recover_burn, min_events=SLO.min_events)
+    SLO.reset()
+    SLO.configure(targets={"ttft_ms": 1e-6}, burn_threshold=1.0,
+                  recover_burn=1.0, min_events=2)
+    try:
+        for i in range(2):  # violate the impossible target → both windows
+            assert client.post("/v1/chat/completions", json={
+                "model": "tiny",
+                "messages": [{"role": "user", "content": f"burn {i}"}],
+                "max_tokens": 2,
+            }).status_code == 200
+        r = client.post("/v1/embeddings", json={
+            "model": "tiny", "input": "refuse me"})
+        assert r.status_code == 429
+        assert r.headers.get("Retry-After") == str(SLO.retry_after_s)
+        r = client.post("/v1/rerank", json={
+            "model": "tiny", "query": "q", "documents": ["a", "b"]})
+        assert r.status_code == 429
+        assert r.headers.get("Retry-After") == str(SLO.retry_after_s)
+        # recovery readmits both endpoints
+        SLO.configure(targets={})
+        assert client.post("/v1/embeddings", json={
+            "model": "tiny", "input": "ok now"}).status_code == 200
+    finally:
+        SLO.configure(**saved)
+        SLO.reset()

@@ -1,0 +1,638 @@
+"""Offline batch subsystem tests: the unified file registry, the durable
+job store's state machine, and the executor's end-to-end drain through
+the scheduler's background lane — on the tiny debug model (no downloads;
+SURVEY.md §4 fixture strategy)."""
+
+import json
+import time
+from types import SimpleNamespace
+
+import pytest
+
+from localai_tpu.batch.executor import BatchExecutor, parse_line
+from localai_tpu.batch.store import BatchStore, FileRegistry
+from localai_tpu.config.model_config import ModelConfig
+from localai_tpu.engine.runner import ModelRunner
+from localai_tpu.engine.scheduler import Scheduler
+from localai_tpu.models.registry import resolve_model
+from localai_tpu.obs.metrics import Registry
+from localai_tpu.obs.slo import SLOTracker
+from localai_tpu.obs.trace import TraceStore
+from localai_tpu.utils.tokenizer import ByteTokenizer
+
+
+@pytest.fixture(scope="module")
+def sched():
+    tiny = resolve_model("debug:tiny", dtype="float32")
+    runner = ModelRunner(
+        tiny.cfg, tiny.params, num_slots=4, max_ctx=96,
+        prefill_buckets=[16, 32], kv_dtype="float32",
+    )
+    s = Scheduler(runner, ByteTokenizer())
+    yield s
+    s.shutdown()
+
+
+@pytest.fixture()
+def upload_dir(tmp_path):
+    d = tmp_path / "uploads"
+    d.mkdir()
+    return d
+
+
+def make_serving(sched, tmp_path):
+    """The (ServingModel, ModelConfig) pair the executor resolves per
+    model name — the shape the API tier's AppState provides."""
+    from localai_tpu.templates.cache import TemplateCache
+
+    sm = SimpleNamespace(
+        tokenizer=ByteTokenizer(),
+        scheduler=sched,
+        templates=TemplateCache(str(tmp_path)),
+    )
+    mcfg = ModelConfig(name="tiny")
+    return lambda name: (sm, mcfg)
+
+
+def write_input(registry, n=5, model="tiny", endpoint="/v1/chat/completions",
+                max_tokens=4, extra_lines=()):
+    lines = []
+    for i in range(n):
+        if endpoint == "/v1/chat/completions":
+            body = {"model": model, "max_tokens": max_tokens,
+                    "temperature": 0.0,
+                    "messages": [{"role": "user", "content": f"line {i}"}]}
+        else:
+            body = {"model": model, "max_tokens": max_tokens,
+                    "temperature": 0.0, "prompt": f"line {i}"}
+        lines.append(json.dumps({
+            "custom_id": f"req-{i}", "method": "POST", "url": endpoint,
+            "body": body,
+        }))
+    lines.extend(extra_lines)
+    return registry.register_bytes(
+        "input.jsonl", ("\n".join(lines) + "\n").encode(), "batch"
+    )
+
+
+def wait_for(pred, timeout=60.0, interval=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return False
+
+
+# ---------------------------------------------------------------------------
+# FileRegistry (the unified /v1/files store)
+
+
+def test_file_registry_purpose_and_roundtrip(upload_dir):
+    reg = FileRegistry(upload_dir)
+    f = reg.register_bytes("a.jsonl", b"hello", "batch")
+    g = reg.register_bytes("b.txt", b"notes", "assistants")
+    assert f["purpose"] == "batch" and f["bytes"] == 5
+    assert {x["id"] for x in reg.list()} == {f["id"], g["id"]}
+    assert [x["id"] for x in reg.list("batch")] == [f["id"]]
+    assert reg.content_path(f["id"]).read_bytes() == b"hello"
+    # duplicate filename refused; traversal-guarded basename only
+    with pytest.raises(ValueError):
+        reg.register_bytes("a.jsonl", b"x", "batch")
+    evil = reg.register_bytes("../../evil.txt", b"x", "batch")
+    assert evil["filename"] == "evil.txt"
+    assert reg.delete(f["id"]) is True
+    assert reg.get(f["id"]) is None
+    assert not (upload_dir / "a.jsonl").exists()
+
+
+def test_file_registry_ids_survive_reload(upload_dir):
+    reg = FileRegistry(upload_dir)
+    f1 = reg.register_bytes("one.txt", b"1", "assistants")
+    reg2 = FileRegistry(upload_dir)  # reload from disk
+    f2 = reg2.register_bytes("two.txt", b"2", "assistants")
+    assert f2["id"] != f1["id"]
+    assert reg2.get(f1["id"])["filename"] == "one.txt"
+
+
+def test_assistant_store_shares_registry(upload_dir, tmp_path):
+    from localai_tpu.api.assistants import AssistantStore
+
+    reg = FileRegistry(upload_dir)
+    f = reg.register_bytes("shared.txt", b"x", "assistants")
+    store = AssistantStore(tmp_path / "configs", upload_dir, registry=reg)
+    assert store.file(f["id"]) == f
+    assert store.files is reg.files
+
+
+# ---------------------------------------------------------------------------
+# BatchStore state machine + durability
+
+
+def test_batch_store_transitions(upload_dir):
+    reg = FileRegistry(upload_dir)
+    store = BatchStore(upload_dir, reg)
+    job = store.create(endpoint="/v1/chat/completions",
+                       input_file_id="file-1")
+    assert job["status"] == "validating"
+    with pytest.raises(ValueError):
+        store.transition(job["id"], "completed")  # must pass in_progress
+    store.transition(job["id"], "in_progress")
+    assert store.get(job["id"])["in_progress_at"] is not None
+    store.transition(job["id"], "completed")
+    with pytest.raises(ValueError):
+        store.transition(job["id"], "in_progress")  # terminal is terminal
+    # terminal cancel is a no-op, unknown is None
+    assert store.cancel(job["id"])["status"] == "completed"
+    assert store.cancel("batch_999") is None
+
+
+def test_batch_store_reload_and_done_set(upload_dir):
+    reg = FileRegistry(upload_dir)
+    store = BatchStore(upload_dir, reg)
+    job = store.create(endpoint="/v1/completions", input_file_id="file-1")
+    store.transition(job["id"], "in_progress")
+    store.append_line(store.output_path(job),
+                      {"custom_id": "req-0", "response": {}})
+    store.append_line(store.error_path(job),
+                      {"custom_id": "req-1", "error": {}})
+    # reload from disk: state + the durable done-set survive
+    store2 = BatchStore(upload_dir, reg)
+    j2 = store2.get(job["id"])
+    assert j2["status"] == "in_progress"
+    assert store2.done_custom_ids(j2) == {"req-0", "req-1"}
+    j3 = store2.create(endpoint="/v1/completions", input_file_id="file-1")
+    assert j3["id"] != job["id"]  # id counter continues past persisted
+
+
+def test_batch_store_expiry(upload_dir):
+    reg = FileRegistry(upload_dir)
+    store = BatchStore(upload_dir, reg, expiry_h=1.0)
+    job = store.create(endpoint="/v1/completions", input_file_id="f")
+    assert store.expire_due(now=time.time() + 3599) == []
+    expired = store.expire_due(now=time.time() + 3700)
+    assert [j["id"] for j in expired] == [job["id"]]
+    assert store.get(job["id"])["status"] == "expired"
+    assert store.runnable() is None
+
+
+# ---------------------------------------------------------------------------
+# line validation
+
+
+def test_parse_line_errors():
+    seen = set()
+    ok = json.dumps({"custom_id": "a", "url": "/v1/completions",
+                     "body": {"model": "m", "prompt": "x"}})
+    cid, req, _body = parse_line(ok, 1, "/v1/completions", seen)
+    assert cid == "a" and req.model == "m" and req.stream is False
+    seen.add("a")
+    for bad, msg in [
+        ("not json", "invalid JSON"),
+        (json.dumps(["list"]), "not a JSON object"),
+        (json.dumps({"body": {}}), "custom_id is required"),
+        (json.dumps({"custom_id": "a", "body": {}}), "duplicate"),
+        (json.dumps({"custom_id": "b", "method": "GET", "body": {}}),
+         "method must be POST"),
+        (json.dumps({"custom_id": "b", "url": "/v1/nope", "body": {}}),
+         "does not match"),
+        (json.dumps({"custom_id": "b", "url": "/v1/completions",
+                     "body": []}), "body must be"),
+        (json.dumps({"custom_id": "b", "url": "/v1/completions",
+                     "body": {"prompt": ["a", "b"]}}), "list prompts"),
+    ]:
+        with pytest.raises(ValueError, match=msg):
+            parse_line(bad, 2, "/v1/completions", seen)
+
+
+# ---------------------------------------------------------------------------
+# executor end-to-end (real engine, background lane)
+
+
+def run_executor(store, sched, tmp_path, **kw):
+    ex = BatchExecutor(
+        store, make_serving(sched, tmp_path),
+        poll_s=0.02,
+        registry=kw.pop("registry", Registry()),
+        slo=kw.pop("slo", SLOTracker(registry=Registry(), targets={})),
+        trace_store=kw.pop("trace_store", TraceStore()),
+        **kw,
+    )
+    ex.start()
+    return ex
+
+
+def test_batch_job_runs_to_completed(sched, upload_dir, tmp_path):
+    reg = FileRegistry(upload_dir)
+    store = BatchStore(upload_dir, reg)
+    f = write_input(reg, n=5)
+    job = store.create(endpoint="/v1/chat/completions",
+                       input_file_id=f["id"])
+    metrics = Registry()
+    traces = TraceStore()
+    ex = run_executor(store, sched, tmp_path, registry=metrics,
+                      trace_store=traces)
+    try:
+        assert wait_for(
+            lambda: store.get(job["id"])["status"] == "completed")
+    finally:
+        ex.stop()
+    job = store.get(job["id"])
+    assert job["request_counts"] == {"total": 5, "completed": 5,
+                                     "failed": 0}
+    # per-line output file registered for download (purpose=batch_output)
+    out_file = reg.get(job["output_file_id"])
+    assert out_file["purpose"] == "batch_output"
+    records = [json.loads(l) for l in
+               reg.content_path(out_file["id"]).read_text().splitlines()]
+    assert {r["custom_id"] for r in records} == {f"req-{i}"
+                                                for i in range(5)}
+    for r in records:
+        body = r["response"]["body"]
+        assert r["response"]["status_code"] == 200
+        assert body["object"] == "chat.completion"
+        assert body["choices"][0]["message"]["role"] == "assistant"
+        assert body["usage"]["prompt_tokens"] > 0
+    # metrics: lines counted, jobs gauge at the terminal state
+    text = metrics.render()
+    assert 'localai_batch_lines_total{result="completed"} 5' in text
+    assert 'localai_batch_jobs{state="completed"} 1' in text
+    assert "localai_batch_lane_paused 0" in text
+    # per-job trace recorded with validate/run spans
+    tr = [t for t in traces.recent(limit=10, kind="batch")]
+    assert tr and tr[0].attrs["status"] == "completed"
+    assert {s.name for s in tr[0].spans()} >= {"validate", "run"}
+
+
+def test_batch_invalid_lines_become_error_records(sched, upload_dir,
+                                                  tmp_path):
+    reg = FileRegistry(upload_dir)
+    store = BatchStore(upload_dir, reg)
+    f = write_input(reg, n=2, endpoint="/v1/completions", extra_lines=[
+        "not json at all",
+        json.dumps({"method": "POST", "url": "/v1/completions",
+                    "body": {"prompt": "no custom id"}}),
+        json.dumps({"custom_id": "wrong-url", "method": "POST",
+                    "url": "/v1/chat/completions",
+                    "body": {"prompt": "mismatched endpoint"}}),
+    ])
+    job = store.create(endpoint="/v1/completions", input_file_id=f["id"])
+    ex = run_executor(store, sched, tmp_path)
+    try:
+        assert wait_for(
+            lambda: store.get(job["id"])["status"] == "completed")
+    finally:
+        ex.stop()
+    job = store.get(job["id"])
+    assert job["request_counts"] == {"total": 5, "completed": 2,
+                                     "failed": 3}
+    errs = [json.loads(l) for l in
+            store.error_path(job).read_text().splitlines()]
+    assert len(errs) == 3
+    assert all(e["error"]["code"] == "400" for e in errs)
+    # a line that declared a custom_id keeps it in its error record, so
+    # clients can reconcile failures against the ids they submitted
+    assert "wrong-url" in {e["custom_id"] for e in errs}
+    err_file = reg.get(job["error_file_id"])
+    assert err_file["purpose"] == "batch_output"
+
+
+def test_batch_all_invalid_fails(sched, upload_dir, tmp_path):
+    reg = FileRegistry(upload_dir)
+    store = BatchStore(upload_dir, reg)
+    f = reg.register_bytes("bad.jsonl", b"nope\nstill nope\n", "batch")
+    job = store.create(endpoint="/v1/completions", input_file_id=f["id"])
+    ex = run_executor(store, sched, tmp_path)
+    try:
+        assert wait_for(lambda: store.get(job["id"])["status"] == "failed")
+    finally:
+        ex.stop()
+    assert store.get(job["id"])["request_counts"]["failed"] == 2
+
+
+def test_batch_crash_resume_continues_from_durable_lines(sched, upload_dir,
+                                                         tmp_path):
+    """Kill mid-job, reload, job continues from the last durable line:
+    lines already in the output file are NOT re-run, the rest complete,
+    and no custom_id appears twice."""
+    reg = FileRegistry(upload_dir)
+    store = BatchStore(upload_dir, reg)
+    f = write_input(reg, n=5)
+    job = store.create(endpoint="/v1/chat/completions",
+                       input_file_id=f["id"])
+    # simulate the pre-crash session: the job went in_progress and two
+    # lines landed durably in the output file before the process died
+    store.transition(job["id"], "in_progress")
+    for i in range(2):
+        store.append_line(store.output_path(job), {
+            "id": f"pre-crash-{i}", "custom_id": f"req-{i}",
+            "response": {"status_code": 200, "body": {}}, "error": None,
+        })
+    # fresh store (reload from disk) + fresh executor = restarted process
+    store2 = BatchStore(upload_dir, FileRegistry(upload_dir))
+    assert store2.get(job["id"])["status"] == "in_progress"
+    ex = run_executor(store2, sched, tmp_path)
+    try:
+        assert wait_for(
+            lambda: store2.get(job["id"])["status"] == "completed")
+    finally:
+        ex.stop()
+    job = store2.get(job["id"])
+    records = [json.loads(l) for l in
+               store2.output_path(job).read_text().splitlines()]
+    cids = [r["custom_id"] for r in records]
+    assert sorted(cids) == [f"req-{i}" for i in range(5)]
+    assert len(set(cids)) == 5  # no duplicates: resume skipped done lines
+    # the pre-crash records were preserved verbatim, not overwritten
+    assert [r["id"] for r in records[:2]] == ["pre-crash-0", "pre-crash-1"]
+    assert job["request_counts"]["completed"] == 5
+
+
+def test_batch_cancel_stops_job(sched, upload_dir, tmp_path):
+    reg = FileRegistry(upload_dir)
+    store = BatchStore(upload_dir, reg)
+    f = write_input(reg, n=50, max_tokens=64)
+    job = store.create(endpoint="/v1/chat/completions",
+                       input_file_id=f["id"])
+    ex = run_executor(store, sched, tmp_path, concurrency=1)
+    try:
+        assert wait_for(
+            lambda: store.get(job["id"])["status"] == "in_progress")
+        store.cancel(job["id"])
+        assert wait_for(lambda: not ex.store.runnable())
+    finally:
+        ex.stop()
+    job = store.get(job["id"])
+    assert job["status"] == "cancelled"
+    assert job["cancelled_at"] is not None
+    # whatever completed before the cancel stays durable; nothing more runs
+    done = len(store.done_custom_ids(job))
+    time.sleep(0.3)
+    assert len(store.done_custom_ids(job)) == done
+
+
+def test_file_registry_rejects_reserved_names(upload_dir):
+    reg = FileRegistry(upload_dir)
+    with pytest.raises(ValueError, match="reserved"):
+        reg.register_bytes("uploadedFiles.json", b"[]", "batch")
+    with pytest.raises(ValueError, match="reserved"):
+        reg.register_bytes("batch_jobs", b"x", "batch")
+
+
+def test_upload_cannot_poison_batch_output(sched, upload_dir, tmp_path):
+    """Job artifacts live under batch_jobs/ where the basename-only
+    upload path cannot reach: a crafted upload named like a job's output
+    file must not pre-seed the done-set or become the downloadable
+    result."""
+    reg = FileRegistry(upload_dir)
+    store = BatchStore(upload_dir, reg)
+    # forged "output" claiming every line already done
+    forged = "\n".join(json.dumps({"custom_id": f"req-{i}",
+                                   "response": {"status_code": 200,
+                                                "body": {"forged": True}}})
+                       for i in range(3))
+    reg.register_bytes("batch_1_output.jsonl", forged.encode(), "batch")
+    f = write_input(reg, n=3)
+    job = store.create(endpoint="/v1/chat/completions",
+                       input_file_id=f["id"])
+    assert job["id"] == "batch_1"
+    ex = run_executor(store, sched, tmp_path)
+    try:
+        assert wait_for(
+            lambda: store.get(job["id"])["status"] == "completed")
+    finally:
+        ex.stop()
+    job = store.get(job["id"])
+    assert job["request_counts"]["completed"] == 3  # really ran
+    recs = [json.loads(l) for l in
+            reg.content_path(job["output_file_id"]).read_text().splitlines()]
+    assert all("forged" not in r["response"]["body"] for r in recs)
+
+
+def test_synthetic_error_id_does_not_shadow_real_custom_id(sched,
+                                                           upload_dir,
+                                                           tmp_path):
+    """An invalid line's made-up line-N id must not block a REAL
+    custom_id that spells 'line-N' — and error line numbers refer to
+    PHYSICAL file lines (blank lines count)."""
+    reg = FileRegistry(upload_dir)
+    store = BatchStore(upload_dir, reg)
+    content = "\n".join([
+        "",                # physical line 1: blank
+        "not json",        # physical line 2: invalid → synthetic line-2
+        json.dumps({"custom_id": "line-2", "method": "POST",
+                    "url": "/v1/completions",
+                    "body": {"model": "tiny", "max_tokens": 4,
+                             "temperature": 0.0, "prompt": "really run"}}),
+    ])
+    f = reg.register_bytes("shadow.jsonl", (content + "\n").encode(),
+                           "batch")
+    job = store.create(endpoint="/v1/completions", input_file_id=f["id"])
+    ex = run_executor(store, sched, tmp_path)
+    try:
+        assert wait_for(
+            lambda: store.get(job["id"])["status"] == "completed")
+    finally:
+        ex.stop()
+    job = store.get(job["id"])
+    assert job["request_counts"] == {"total": 2, "completed": 1,
+                                     "failed": 1}
+    outs = [json.loads(l) for l in
+            store.output_path(job).read_text().splitlines()]
+    assert [r["custom_id"] for r in outs] == ["line-2"]  # really ran
+    errs = [json.loads(l) for l in
+            store.error_path(job).read_text().splitlines()]
+    assert errs[0]["custom_id"] == "line-2"  # physical line number
+    assert errs[0]["synthetic_id"] is True
+
+
+def test_batch_duplicate_custom_id_runs_first_occurrence(sched, upload_dir,
+                                                         tmp_path):
+    """A duplicate custom_id fails only the DUPLICATE line: its error
+    record carries a synthetic id, so the valid first occurrence is not
+    poisoned out of the pending set via the done-set."""
+    reg = FileRegistry(upload_dir)
+    store = BatchStore(upload_dir, reg)
+    f = write_input(reg, n=2, endpoint="/v1/completions", extra_lines=[
+        json.dumps({"custom_id": "req-0", "method": "POST",
+                    "url": "/v1/completions",
+                    "body": {"prompt": "duplicate id"}}),
+    ])
+    job = store.create(endpoint="/v1/completions", input_file_id=f["id"])
+    ex = run_executor(store, sched, tmp_path)
+    try:
+        assert wait_for(
+            lambda: store.get(job["id"])["status"] == "completed")
+    finally:
+        ex.stop()
+    job = store.get(job["id"])
+    assert job["request_counts"] == {"total": 3, "completed": 2,
+                                     "failed": 1}
+    outs = [json.loads(l) for l in
+            store.output_path(job).read_text().splitlines()]
+    # the valid req-0 line really ran (exactly once)
+    assert sorted(r["custom_id"] for r in outs) == ["req-0", "req-1"]
+    errs = [json.loads(l) for l in
+            store.error_path(job).read_text().splitlines()]
+    assert len(errs) == 1 and errs[0]["custom_id"] != "req-0"
+
+
+def test_batch_line_deadline_records_timeout(sched, upload_dir, tmp_path):
+    """A line that outlives the per-line deadline is cancelled and
+    recorded as a 504 error — a wedged generation must not pin the
+    executor (and the rest of the job still completes)."""
+    reg = FileRegistry(upload_dir)
+    store = BatchStore(upload_dir, reg)
+    lines = [json.dumps({
+        "custom_id": "slow", "method": "POST",
+        "url": "/v1/chat/completions",
+        "body": {"model": "tiny", "max_tokens": 2048, "temperature": 0.0,
+                 "ignore_eos": True,
+                 "messages": [{"role": "user", "content": "decode forever"}]},
+    })]
+    f = reg.register_bytes("slow.jsonl", ("\n".join(lines) + "\n").encode(),
+                           "batch")
+    job = store.create(endpoint="/v1/chat/completions",
+                       input_file_id=f["id"])
+    # far below one generation's wall time (≥ tens of ms for ~80 tokens)
+    ex = run_executor(store, sched, tmp_path, deadline_s=0.01)
+    try:
+        assert wait_for(
+            lambda: store.get(job["id"])["status"] == "completed",
+            timeout=30)
+    finally:
+        ex.stop()
+    job = store.get(job["id"])
+    assert job["request_counts"] == {"total": 1, "completed": 0,
+                                     "failed": 1}
+    errs = [json.loads(l) for l in
+            store.error_path(job).read_text().splitlines()]
+    assert errs[0]["custom_id"] == "slow"
+    assert errs[0]["error"]["code"] == "504"
+    assert wait_for(lambda: not sched.busy, timeout=30)  # slot freed
+
+
+# ---------------------------------------------------------------------------
+# SLO isolation: batch-lane requests never count against interactive SLOs
+
+
+def test_background_requests_never_become_slo_events():
+    """The lane's core invariant, telemetry side: a batch-lane completion
+    must not become an SLO event or land in the interactive TTFT/TPOT/
+    queue-wait histograms — its queue wait is unbounded BY DESIGN, and
+    counting it would let an offline job shed the interactive traffic
+    the lane exists to protect."""
+    from localai_tpu.engine.scheduler import GenHandle, GenRequest
+    from localai_tpu.obs.engine import EngineTelemetry
+
+    reg = Registry()
+    tracker = SLOTracker(registry=reg, targets={"ttft_ms": 1.0})
+    tel = EngineTelemetry(model="m", registry=reg, store=TraceStore(),
+                          slo=tracker)
+
+    def finish_one(priority):
+        h = GenHandle(GenRequest(prompt=[1, 2], priority=priority), 0)
+        tr = tel.queued(h)
+        tel.admitted(tr, slot=0, queue_wait=99.0,
+                     background=priority > 0)
+        tel.prefill_done(tr)
+        h._emit("x", 5)
+        h._emit("y", 6)
+        tel.finished(tr, h, "stop")
+        h._finish("stop")
+
+    from localai_tpu.engine.scheduler import PRIORITY_BATCH as PB
+
+    finish_one(PB)
+    assert tracker.windows("m")["1m"]["count"] == 0  # no SLO event
+    text = reg.render()
+    assert 'localai_ttft_seconds_count{model="m"}' not in text
+    assert 'localai_queue_wait_seconds_count{model="m"}' not in text
+    assert 'localai_requests_total{finish_reason="stop",model="m"} 1' \
+        in text  # still counted as a finished request
+    # an interactive completion DOES feed both
+    finish_one(0)
+    assert tracker.windows("m")["1m"]["count"] == 1
+    text = reg.render()
+    assert 'localai_ttft_seconds_count{model="m"} 1' in text
+    assert 'localai_queue_wait_seconds_count{model="m"} 1' in text
+
+
+# ---------------------------------------------------------------------------
+# configurable request deadline (satellite)
+
+
+def test_request_deadline_resolution(monkeypatch):
+    from localai_tpu.api import inference as inf
+    from localai_tpu.config.app_config import AppConfig
+
+    monkeypatch.delenv("LOCALAI_REQUEST_DEADLINE_S", raising=False)
+    assert inf.request_deadline_s() == 600.0
+    assert inf.request_deadline_s(AppConfig(request_deadline_s=5.0)) == 5.0
+    monkeypatch.setenv("LOCALAI_REQUEST_DEADLINE_S", "7.5")
+    assert inf.request_deadline_s() == 7.5
+    # zero/garbage falls back to the default, not "no deadline"
+    monkeypatch.setenv("LOCALAI_REQUEST_DEADLINE_S", "0")
+    assert inf.request_deadline_s() == 600.0
+
+
+def test_run_choices_deadline_cancels_generation(sched, tmp_path):
+    """Deadline expiry must CANCEL the GenHandle so the decode slot frees
+    instead of generating into the void to max_tokens."""
+    from localai_tpu.api import inference as inf
+    from localai_tpu.api import schema as sc
+
+    sm, cfg = make_serving(sched, tmp_path)("tiny")
+    req = sc.OpenAIRequest(model="tiny", prompt="hold", max_tokens=2048,
+                           temperature=0.0, ignore_eos=True)
+    cfg = inf.merge_request(cfg, req)
+    # timeout far below even one prefill dispatch, so the generation
+    # cannot finish first on a fast machine (warm compiled shapes)
+    with pytest.raises(TimeoutError):
+        inf.run_choices(sm, cfg, req, "hold this slot", timeout=0.001)
+    # the cancelled request leaves its slot on the next engine step —
+    # far sooner than the 2048-token run it was asked for
+    assert wait_for(lambda: not sched.busy, timeout=30)
+
+
+def test_batch_lane_pauses_under_shedding_and_recovers(sched, upload_dir,
+                                                       tmp_path):
+    """Forced shed→recover cycle: while the SLO observatory sheds the
+    model, the batch lane pauses ENTIRELY (gauge=1, in-flight lines
+    requeued — never failed); once the fast window slides past the burst
+    the lane resumes and the job completes with zero failures."""
+    reg = FileRegistry(upload_dir)
+    store = BatchStore(upload_dir, reg)
+    f = write_input(reg, n=4)
+    job = store.create(endpoint="/v1/chat/completions",
+                       input_file_id=f["id"])
+    t = {"now": 1000.0}
+    slo = SLOTracker(registry=Registry(), clock=lambda: t["now"],
+                     targets={"ttft_ms": 0.001}, burn_threshold=1.0,
+                     recover_burn=1.0, min_events=3)
+    for _ in range(4):  # trip shedding for the job's model
+        slo.observe("tiny", ttft_ms=50.0, e2e_ms=80.0)
+    assert slo.shedding("tiny")
+    metrics = Registry()
+    ex = run_executor(store, sched, tmp_path, slo=slo, registry=metrics)
+    try:
+        assert wait_for(lambda: ex.paused, timeout=30)
+        assert "localai_batch_lane_paused 1" in metrics.render()
+        # paused means paused: no output lines land while shedding
+        n_before = len(store.done_custom_ids(store.get(job["id"])))
+        time.sleep(0.3)
+        assert len(store.done_custom_ids(store.get(job["id"]))) == n_before
+        assert store.get(job["id"])["status"] == "in_progress"
+        # recovery: the fast window slides past the violation burst
+        t["now"] += 120.0
+        assert wait_for(
+            lambda: store.get(job["id"])["status"] == "completed")
+    finally:
+        ex.stop()
+    job = store.get(job["id"])
+    # requeued, never failed: every line completed exactly once
+    assert job["request_counts"] == {"total": 4, "completed": 4,
+                                     "failed": 0}
+    assert "localai_batch_lane_paused 0" in metrics.render()
+    text = metrics.render()
+    assert 'localai_batch_lines_total{result="completed"} 4' in text

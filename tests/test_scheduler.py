@@ -5,7 +5,11 @@ import numpy as np
 import pytest
 
 from localai_tpu.engine.runner import ModelRunner
-from localai_tpu.engine.scheduler import GenRequest, Scheduler
+from localai_tpu.engine.scheduler import (
+    PRIORITY_BATCH,
+    GenRequest,
+    Scheduler,
+)
 from localai_tpu.engine.stream import IncrementalDetokenizer, StopChecker
 from localai_tpu.models.registry import resolve_model
 from localai_tpu.utils.tokenizer import ByteTokenizer
@@ -302,6 +306,80 @@ def test_constrained_generation_valid_json(sched):
     obj = json.loads(h.text)
     assert obj["name"] == "answer"
     assert "message" in obj["arguments"]
+
+
+# ---------------------------------------------------------------------------
+# two-lane admission (interactive vs background batch)
+
+
+def _wait(pred, timeout=60.0):
+    import time as _time
+
+    deadline = _time.monotonic() + timeout
+    while _time.monotonic() < deadline:
+        if pred():
+            return True
+        _time.sleep(0.01)
+    return False
+
+
+def test_batch_priority_request_completes(sched):
+    # long enough to span several 16-step dispatches, so at least one
+    # drain records the slot while the batch request still occupies it
+    h = sched.generate(_req("background", max_new_tokens=48,
+                            temperature=0.0, ignore_eos=True,
+                            priority=PRIORITY_BATCH))
+    assert h.finish_reason in ("length", "stop")
+    assert h.completion_tokens > 0
+    # the lane is tagged through to the flight ring
+    assert any(r["batch_slots"] > 0 for r in sched.flight.snapshot())
+
+
+def test_interactive_admitted_before_batch_under_full_queue(sched):
+    """Admit ordering: with every slot occupied and both lanes queued,
+    freed slots go to EVERY waiting interactive request before any batch
+    line — batch work only fills slots when interactive queue depth is
+    zero."""
+    hold = [
+        sched.submit(_req(f"hold {i}", max_new_tokens=500, temperature=0.0))
+        for i in range(4)
+    ]
+    assert _wait(lambda: len(sched.metrics()["active_slots"]) == 4)
+    # queue batch FIRST, interactive second — FIFO would admit the batch
+    # lines first, the lane policy must not
+    batch = [
+        sched.submit(_req(f"batch {i}", max_new_tokens=4, temperature=0.0,
+                          priority=PRIORITY_BATCH))
+        for i in range(3)
+    ]
+    inter = [
+        sched.submit(_req(f"inter {i}", max_new_tokens=4, temperature=0.0))
+        for i in range(2)
+    ]
+    m = sched.metrics()
+    assert m["batch_queue_depth"] >= 1  # lanes are accounted separately
+    for h in hold:
+        h.cancel()
+    for h in inter + batch + hold:
+        h.result(60)
+    assert all(h.admit_index is not None for h in inter + batch)
+    assert max(h.admit_index for h in inter) < \
+        min(h.admit_index for h in batch)
+
+
+def test_busy_covers_batch_lane(sched):
+    assert not sched.busy
+    h = sched.submit(_req("lane busy", max_new_tokens=4, temperature=0.0,
+                          priority=PRIORITY_BATCH))
+    assert sched.busy  # queued on the batch lane counts as busy
+    h.result(60)
+    assert _wait(lambda: not sched.busy)
+
+
+def test_metrics_report_batch_lane_fields(sched):
+    assert _wait(lambda: not sched.busy)
+    m = sched.metrics()
+    assert m["batch_queue_depth"] == 0 and m["batch_slots"] == 0
 
 
 # ---------------------------------------------------------------------------

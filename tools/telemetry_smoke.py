@@ -16,7 +16,14 @@ generations through the continuous-batching scheduler, then:
      simulated overload (tight targets against a scratch tracker) trips
      shedding, counts a shed request, then recovers as the fast window
      slides past the burst;
-  4. writes a TTFT/TPOT summary JSON (``--out``) that CI uploads as a
+  4. asserts the round-8 offline batch subsystem end-to-end: a 5-line
+     JSONL job submitted through the FileRegistry + BatchStore runs to
+     terminal ``completed`` through the scheduler's BACKGROUND lane
+     (every line at ``PRIORITY_BATCH``), the ``localai_batch_jobs`` /
+     ``localai_batch_lines_total`` / ``localai_batch_lane_paused``
+     series render, and the per-line result file is written
+     (``--batch-out`` — CI uploads it as a build artifact);
+  5. writes a TTFT/TPOT summary JSON (``--out``) that CI uploads as a
      build artifact — the seed of the serving-latency bench trajectory
      (BENCH_*.json tracks throughput; this tracks latency per PR) — and
      the flight-ring snapshot (``--flight-out``) so every CI run carries
@@ -24,6 +31,7 @@ generations through the continuous-batching scheduler, then:
 
 Usage:  python -m tools.telemetry_smoke [--out telemetry_summary.json]
                                         [--flight-out flight_snapshot.json]
+                                        [--batch-out batch_result.jsonl]
 """
 
 from __future__ import annotations
@@ -72,6 +80,15 @@ REQUIRED_SLO = (
     'localai_overload_shedding{model="smoke"} 0',
     'localai_overload_shedding{model="smoke-overload"} 0',
     'localai_requests_shed_total{model="smoke-overload"} 1',
+)
+# offline batch subsystem series (round 8): the 5-line job the smoke
+# submits through the background lane must land every line and leave the
+# lane un-paused
+REQUIRED_BATCH = (
+    'localai_batch_jobs{state="completed"} 1',
+    'localai_batch_jobs{state="failed"} 0',
+    'localai_batch_lines_total{result="completed"} 5',
+    "localai_batch_lane_paused 0",
 )
 
 
@@ -147,10 +164,77 @@ def check_slo_overload(registry) -> list[str]:
     return problems
 
 
+def check_batch(sched, registry, batch_out: str) -> list[str]:
+    """Submit a 5-line batch job end-to-end through the background lane:
+    file upload → job create → executor drain → terminal ``completed`` →
+    per-line result file copied to ``batch_out`` (the CI artifact)."""
+    import json as jsonlib
+    import shutil
+    import tempfile
+    from pathlib import Path
+    from types import SimpleNamespace
+
+    from localai_tpu.batch import BatchExecutor, BatchStore, FileRegistry
+    from localai_tpu.config.model_config import ModelConfig
+    from localai_tpu.obs.slo import SLOTracker
+    from localai_tpu.templates.cache import TemplateCache
+    from localai_tpu.utils.tokenizer import ByteTokenizer
+
+    problems: list[str] = []
+    with tempfile.TemporaryDirectory() as tmp:
+        reg = FileRegistry(Path(tmp) / "uploads")
+        store = BatchStore(reg.upload_dir, reg)
+        lines = "\n".join(jsonlib.dumps({
+            "custom_id": f"smoke-{i}", "method": "POST",
+            "url": "/v1/chat/completions",
+            "body": {"model": "smoke", "max_tokens": 8, "temperature": 0.0,
+                     "messages": [{"role": "user",
+                                   "content": f"batch smoke line {i}"}]},
+        }) for i in range(5))
+        f = reg.register_bytes("smoke_input.jsonl",
+                               (lines + "\n").encode(), "batch")
+        job = store.create(endpoint="/v1/chat/completions",
+                           input_file_id=f["id"])
+        sm = SimpleNamespace(tokenizer=ByteTokenizer(), scheduler=sched,
+                             templates=TemplateCache(tmp))
+        mcfg = ModelConfig(name="smoke")
+        ex = BatchExecutor(
+            store, lambda name: (sm, mcfg), poll_s=0.02,
+            registry=registry,
+            slo=SLOTracker(registry=registry, targets={}),
+        )
+        ex.start()
+        deadline = time.monotonic() + 300
+        while (store.get(job["id"])["status"]
+               not in ("completed", "failed", "cancelled", "expired")
+               and time.monotonic() < deadline):
+            time.sleep(0.05)
+        ex.stop()
+        job = store.get(job["id"])
+        if job["status"] != "completed":
+            problems.append(
+                f"batch job ended {job['status']!r}, not completed "
+                f"({job['request_counts']})")
+            return problems
+        if job["request_counts"]["completed"] != 5:
+            problems.append(
+                f"batch counts wrong: {job['request_counts']}")
+        out_path = reg.content_path(job["output_file_id"])
+        records = [jsonlib.loads(l)
+                   for l in out_path.read_text().splitlines()]
+        if {r["custom_id"] for r in records} != {f"smoke-{i}"
+                                                for i in range(5)}:
+            problems.append("batch output file misses custom_ids")
+        store.export_gauges(registry)
+        shutil.copy(out_path, batch_out)
+    return problems
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("--out", default="telemetry_summary.json")
     parser.add_argument("--flight-out", default="flight_snapshot.json")
+    parser.add_argument("--batch-out", default="batch_result.jsonl")
     parser.add_argument("--requests", type=int, default=4)
     # two dispatch-rounds past the compile-bearing first one, so the
     # flight ring has post-compile samples and step_ms percentiles exist
@@ -197,6 +281,7 @@ def main(argv=None) -> int:
         slo.export_gauges()
         problems = check_introspection(runner, REGISTRY, store)
         problems += check_slo_overload(REGISTRY)
+        problems += check_batch(sched, REGISTRY, args.batch_out)
         flight_pct = sched.flight.percentiles()
         flight_snapshot = {
             "model": "smoke",
@@ -215,7 +300,8 @@ def main(argv=None) -> int:
 
     exposition = REGISTRY.render()
     missing = [s for s in (REQUIRED_SERIES + REQUIRED_FAMILIES
-                           + REQUIRED_INTROSPECTION + REQUIRED_SLO)
+                           + REQUIRED_INTROSPECTION + REQUIRED_SLO
+                           + REQUIRED_BATCH)
                if s not in exposition]
     if missing or problems:
         print("FAIL: missing engine telemetry in /metrics exposition:")
@@ -263,7 +349,8 @@ def main(argv=None) -> int:
     with open(args.flight_out, "w") as f:
         json.dump(flight_snapshot, f, indent=2, sort_keys=True)
     print(f"OK: engine telemetry present; summary → {args.out}, "
-          f"flight ring → {args.flight_out}")
+          f"flight ring → {args.flight_out}, "
+          f"batch result → {args.batch_out}")
     print(f"    ttft mean {summary['ttft']['mean_ms']}ms  "
           f"tpot mean {summary['tpot']['mean_ms']}ms  "
           f"over {len(ttfts)} requests; "
