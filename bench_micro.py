@@ -107,7 +107,7 @@ def bench_quant_matmuls(M=8, K=4096, N=14336, steps=64):
     return out
 
 
-def bench_step_breakdown(preset="1b", quant="int8", multi=32):
+def bench_step_breakdown(preset="1b", quant="int8", multi=32, paged=False):
     """Full decode step vs forward-only (sampling cost) on the engine."""
     import dataclasses
 
@@ -125,7 +125,8 @@ def bench_step_breakdown(preset="1b", quant="int8", multi=32):
     cfg = dataclasses.replace(DEBUG_PRESETS[preset], dtype="bfloat16")
     params = synthetic_quantized_params(cfg, quant)
     runner = ModelRunner(cfg, params, num_slots=8, max_ctx=1024,
-                         prefill_buckets=[128], kv_dtype="int8")
+                         prefill_buckets=[128], kv_dtype="int8",
+                         paged=paged)
     prompt = list(range(1, 101))
     for _ in range(8):
         runner.admit(runner.acquire_slot(), prompt, temperature=0.0)
@@ -153,6 +154,51 @@ def bench_step_breakdown(preset="1b", quant="int8", multi=32):
     }
 
 
+def machine_index(n=512, steps=24, repeats=3):
+    """Effective GFLOP/s of a fixed jitted matmul loop — the machine-speed
+    normalizer for tools/perf_smoke.py, so a decode-throughput baseline
+    committed from one box transfers to a differently-sized CI runner.
+    Best-of-``repeats``: a capability measure must not be dragged down by
+    a noisy neighbor stealing one measurement window."""
+    import jax
+    import jax.numpy as jnp
+
+    x = jnp.ones((n, n), jnp.float32)
+
+    @jax.jit
+    def body(x):
+        def step(h, _):
+            return jnp.tanh(h @ x) * 0.5, None
+        h, _ = jax.lax.scan(step, x, None, length=steps)
+        return h
+
+    dt = min(_timeit(body, x, n=5) for _ in range(repeats))
+    return 2 * n * n * n * steps / dt / 1e9
+
+
+def decode_smoke(paged: bool, preset: str = "tiny", num_slots: int = 4,
+                 max_ctx: int = 512, multi: int = 16, repeats: int = 5):
+    """Steady-state batched decode tok/s of a debug preset — the CI perf
+    smoke measurement. Best-of-``repeats`` (fastest sample): shared
+    runners have multi-x contention spikes, and one clean window measures
+    the code's capability; a median would gate on the neighbors."""
+    from localai_tpu.engine.runner import ModelRunner
+    from localai_tpu.models.registry import resolve_model
+
+    model = resolve_model(f"debug:{preset}", dtype="float32")
+    runner = ModelRunner(model.cfg, model.params, num_slots=num_slots,
+                         max_ctx=max_ctx, prefill_buckets=[128],
+                         kv_dtype="float32", paged=paged)
+    prompt = list(range(1, 65))
+    for _ in range(num_slots):
+        runner.admit(runner.acquire_slot(), prompt, temperature=0.0)
+    best = 0.0
+    for _ in range(repeats):
+        dt = _timeit(lambda: runner.step_n(multi), n=3, warmup=1)
+        best = max(best, multi * num_slots / dt)
+    return best
+
+
 def main():
     import jax
 
@@ -163,6 +209,8 @@ def main():
     print(json.dumps({"quant_matmul_lm_head":
                       bench_quant_matmuls(M=8, K=2048, N=128256, steps=16)}))
     print(json.dumps({"step_breakdown_1b_int8": bench_step_breakdown()}))
+    print(json.dumps({"step_breakdown_1b_int8_paged":
+                      bench_step_breakdown(paged=True)}))
 
 
 if __name__ == "__main__":

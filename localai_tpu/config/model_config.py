@@ -187,6 +187,17 @@ class EngineConfig(BaseModel):
     # see engine/selfextend.py for the TPU formulation.
     grp_attn_n: int = 1
     grp_attn_w: int = 512
+    # Paged KV cache (vLLM-style block pool + chunked prefill;
+    # engine/paged.py). None = auto: ON for single-device serving without
+    # draft/self-extend/multi-host, OFF otherwise. kv_num_blocks sizes the
+    # pool (None = the contiguous footprint: max_slots * ceil(ctx/block));
+    # smaller pools overcommit HBM — admission then waits for free blocks.
+    kv_paged: Optional[bool] = None
+    kv_block_tokens: Optional[int] = None   # tokens per block (default 64
+                                            # via LOCALAI_KV_BLOCK_TOKENS)
+    kv_num_blocks: Optional[int] = None
+    prefill_chunk: Optional[int] = None     # chunked-prefill dispatch size
+                                            # (tokens; default 512)
 
 
 class DiffusionConfig(BaseModel):

@@ -24,6 +24,7 @@ from jax import lax
 
 from localai_tpu.models.llama import LlamaConfig
 from localai_tpu.models.quant import quantize_lastdim as _quant_chunk
+from localai_tpu.ops.attention import gather_block_scales, gather_blocks
 
 
 @jax.tree_util.register_dataclass
@@ -101,6 +102,159 @@ def init_cache(
     return KVCache(k=zeros(shape, dt, sharding), v=zeros(shape, dt, sharding))
 
 
+
+
+# ---------------------------------------------------------------------------
+# paged layout (vLLM-style block pool; host bookkeeping in engine.paged)
+# ---------------------------------------------------------------------------
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class PagedKVCache:
+    """k, v: [L, N, Hkv, bt, hd] — one pool of N physical blocks of bt
+    tokens each, shared by all slots through per-slot block tables
+    ([S, max_blocks] i32, engine.paged.BlockAllocator). Block 0 is the
+    trash block (garbage-write target for inactive slots). int8 caches
+    carry f32 scales [L, N, Hkv, bt], same scaled-int8 scheme as KVCache."""
+
+    k: jax.Array
+    v: jax.Array
+    k_scale: Optional[jax.Array] = None
+    v_scale: Optional[jax.Array] = None
+
+    @property
+    def num_blocks(self) -> int:
+        return self.k.shape[1]
+
+    @property
+    def block_tokens(self) -> int:
+        return self.k.shape[3]
+
+    @property
+    def quantized(self) -> bool:
+        return self.k_scale is not None
+
+    def stacked(self):
+        if self.k_scale is None:
+            return (self.k, self.v)
+        return (self.k, self.v, self.k_scale, self.v_scale)
+
+    @staticmethod
+    def from_stacked(t) -> "PagedKVCache":
+        return PagedKVCache(*t)
+
+
+def init_paged_cache(
+    cfg: LlamaConfig,
+    num_blocks: int,
+    block_tokens: int,
+    dtype: str = "bfloat16",
+) -> PagedKVCache:
+    shape = (cfg.num_layers, num_blocks, cfg.num_kv_heads, block_tokens,
+             cfg.hd)
+    dt = jnp.dtype(dtype)
+    if dt == jnp.int8:
+        return PagedKVCache(
+            k=jnp.zeros(shape, dt),
+            v=jnp.zeros(shape, dt),
+            k_scale=jnp.zeros(shape[:4], jnp.float32),
+            v_scale=jnp.zeros(shape[:4], jnp.float32),
+        )
+    return PagedKVCache(k=jnp.zeros(shape, dt), v=jnp.zeros(shape, dt))
+
+
+def paged_decode_write(tables: jax.Array, positions: jax.Array,
+                       raw: bool = False):
+    """KV write policy for batched single-token decode over a block pool.
+
+    tables: [S, MB] i32 block tables, positions: [S]. Writes k/v_new
+    [S, 1, H, hd] at pool[tables[s, pos//bt], :, pos%bt]. Released slots'
+    table rows are all-zeros, so their (static-shape-mandated) garbage
+    writes land in the trash block.
+
+    ``raw=False`` exposes the gathered logical context [S, H, MB*bt, hd]
+    for the XLA attend; ``raw=True`` passes the pool through untouched for
+    the Pallas paged kernel (which walks the tables itself)."""
+
+    def write(layer_kv, k_new, v_new):
+        dt = k_new.dtype
+        bt = layer_kv[0].shape[2]
+        s = jnp.arange(tables.shape[0])
+        blk = tables[s, positions // bt]          # [S]
+        off = positions % bt
+        if len(layer_kv) == 4:  # scaled int8 pool
+            k_layer, v_layer, ks_layer, vs_layer = layer_kv
+            kq, ks = _quant_chunk(k_new[:, 0])    # [S, H, hd], [S, H]
+            vq, vs = _quant_chunk(v_new[:, 0])
+            new_k = k_layer.at[blk, :, off].set(kq)
+            new_v = v_layer.at[blk, :, off].set(vq)
+            new_ks = ks_layer.at[blk, :, off].set(ks)
+            new_vs = vs_layer.at[blk, :, off].set(vs)
+            new_kv = (new_k, new_v, new_ks, new_vs)
+            if raw:
+                return new_kv, (new_k, new_ks), (new_v, new_vs)
+            keys = (gather_blocks(new_k, tables).astype(dt)
+                    * gather_block_scales(new_ks, tables)[..., None].astype(dt))
+            values = (gather_blocks(new_v, tables).astype(dt)
+                      * gather_block_scales(new_vs, tables)[..., None].astype(dt))
+            return new_kv, keys, values
+        k_layer, v_layer = layer_kv               # [N, H, bt, hd]
+        kdt = k_layer.dtype
+        new_k = k_layer.at[blk, :, off].set(k_new[:, 0].astype(kdt))
+        new_v = v_layer.at[blk, :, off].set(v_new[:, 0].astype(kdt))
+        if raw:
+            return (new_k, new_v), new_k, new_v
+        return ((new_k, new_v), gather_blocks(new_k, tables).astype(dt),
+                gather_blocks(new_v, tables).astype(dt))
+
+    return write
+
+
+def paged_prefill_write(table_row: jax.Array, offset: jax.Array,
+                        length: jax.Array):
+    """KV write policy for one chunked-prefill dispatch into a block table.
+
+    table_row: [MB] i32, offset: absolute start position of this chunk,
+    length: real (unpadded) tokens in the chunk. Token t of the chunk
+    lands at pool[table_row[(offset+t)//bt], :, (offset+t)%bt]; padding
+    rows (t >= length) are redirected to the trash block so a padded
+    bucket can never clobber the sequence's own reserved blocks. Exposes
+    the gathered FULL logical context [1, H, MB*bt, hd] so chunk tokens
+    attend over the kept prefix + earlier chunks (resume-style)."""
+
+    def write(layer_kv, k_new, v_new):  # k_new [1, T, H, hd]
+        dt = k_new.dtype
+        bt = layer_kv[0].shape[2]
+        MB = table_row.shape[0]
+        T = k_new.shape[1]
+        t = jnp.arange(T)
+        pos = offset + t
+        valid = t < length
+        blk = jnp.where(valid, table_row[jnp.minimum(pos // bt, MB - 1)], 0)
+        off = pos % bt
+        row = table_row[None]                     # [1, MB]
+        if len(layer_kv) == 4:  # scaled int8 pool
+            k_layer, v_layer, ks_layer, vs_layer = layer_kv
+            kq, ks = _quant_chunk(k_new[0])       # [T, H, hd], [T, H]
+            vq, vs = _quant_chunk(v_new[0])
+            new_k = k_layer.at[blk, :, off].set(kq)
+            new_v = v_layer.at[blk, :, off].set(vq)
+            new_ks = ks_layer.at[blk, :, off].set(ks)
+            new_vs = vs_layer.at[blk, :, off].set(vs)
+            keys = (gather_blocks(new_k, row).astype(dt)
+                    * gather_block_scales(new_ks, row)[..., None].astype(dt))
+            values = (gather_blocks(new_v, row).astype(dt)
+                      * gather_block_scales(new_vs, row)[..., None].astype(dt))
+            return (new_k, new_v, new_ks, new_vs), keys, values
+        k_layer, v_layer = layer_kv
+        kdt = k_layer.dtype
+        new_k = k_layer.at[blk, :, off].set(k_new[0].astype(kdt))
+        new_v = v_layer.at[blk, :, off].set(v_new[0].astype(kdt))
+        return ((new_k, new_v), gather_blocks(new_k, row).astype(dt),
+                gather_blocks(new_v, row).astype(dt))
+
+    return write
 
 
 def decode_write(positions: jax.Array, raw: bool = False):

@@ -1,0 +1,280 @@
+"""Host-side block allocator for the paged KV cache.
+
+vLLM-style PagedAttention bookkeeping (Kwon et al., SOSP 2023) adapted to
+this engine's static-shape XLA model: HBM holds one block pool
+``[L, num_blocks, Hkv, block_tokens, hd]`` (engine.kvcache.PagedKVCache) and
+every slot owns a *block table* — a [max_blocks] i32 row mapping logical
+context blocks to physical pool blocks. All allocation state (free list,
+refcounts, prefix-sharing pool) lives here on the host; the device only
+ever sees the tables as a small [S, max_blocks] i32 array.
+
+Design points:
+
+  * **Reservation, not preemption.** A sequence is admitted only when the
+    pool can cover its worst case (``min(prompt + max_new, max_ctx)``
+    tokens), so a mid-decode dispatch can never run out of blocks — there
+    is no preemption/recompute path to get wrong. Capacity overcommit
+    comes from ``max_new_tokens`` being far below ``max_ctx`` for real
+    traffic, and from prefix sharing.
+  * **Whole-block prefix sharing.** When a finished admission's prompt is
+    registered, each *full* block of the prompt is keyed by a running hash
+    of the tokens it covers and kept in a pool (refcounted). A later
+    prompt sharing the same leading blocks maps them into its table
+    read-only and computes only the tail — chunked prefill then starts at
+    a block boundary. Writes never touch a shared block: a sequence's
+    write frontier always lies past its shared prefix.
+  * **Block 0 is the trash block.** The decode program writes a KV row for
+    every slot each step, active or not (static shapes). Released slots'
+    device table rows are reset to all-zeros so those garbage writes land
+    in a reserved scratch block that no table maps for real data.
+
+All mutation happens on the scheduler's engine thread; the lock only
+guards the read side (metrics scrapes from API threads).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import os
+import threading
+from collections import OrderedDict
+from typing import Optional
+
+import numpy as np
+
+
+def block_tokens_default() -> int:
+    """Tokens per KV block (``LOCALAI_KV_BLOCK_TOKENS``, default 64)."""
+    try:
+        v = int(os.environ.get("LOCALAI_KV_BLOCK_TOKENS", "64"))
+    except ValueError:
+        return 64
+    return max(8, v)
+
+
+@dataclasses.dataclass
+class BlockStats:
+    total: int          # allocatable blocks (pool minus the trash block)
+    free: int           # immediately free
+    cached: int         # prefix-pool blocks reclaimable on demand
+    used: int           # referenced by at least one live sequence
+    high_watermark: int  # max concurrently-used blocks since init
+
+    @property
+    def available(self) -> int:
+        return self.free + self.cached
+
+    @property
+    def utilization(self) -> float:
+        return self.used / self.total if self.total else 0.0
+
+
+class BlockAllocator:
+    """Free list + per-sequence block tables + refcounted prefix pool."""
+
+    def __init__(self, num_blocks: int, block_tokens: int,
+                 max_blocks_per_seq: int):
+        if num_blocks < 2:
+            raise ValueError("need at least 2 blocks (one is the trash block)")
+        self.num_blocks = num_blocks
+        self.block_tokens = block_tokens
+        self.max_blocks_per_seq = max_blocks_per_seq
+        self._lock = threading.Lock()
+        # block 0 reserved: the garbage-write target for inactive slots
+        self._free: list[int] = list(range(num_blocks - 1, 0, -1))
+        self._ref = np.zeros(num_blocks, np.int32)
+        self._ref[0] = 1  # trash never allocated
+        # seq (slot) -> list of physical block ids in logical order
+        self.tables: dict[int, list[int]] = {}
+        # how many leading blocks of each table are shared (read-only)
+        self.shared_blocks: dict[int, int] = {}
+        # prefix pool: chain-hash of covered tokens -> block id, LRU order
+        self._prefix: "OrderedDict[str, int]" = OrderedDict()
+        self._block_key: dict[int, str] = {}
+        self._watermark = 0
+        # lifetime counters (telemetry)
+        self.shared_tokens_total = 0
+        self.evictions_total = 0
+
+    # -- sizing -----------------------------------------------------------
+
+    def blocks_for(self, tokens: int) -> int:
+        return max(1, -(-tokens // self.block_tokens))
+
+    def _reclaimable(self) -> int:
+        """Prefix-pool blocks held only by the pool (evictable). Caller
+        holds the lock."""
+        return sum(1 for b in self._prefix.values() if self._ref[b] == 1)
+
+    # -- prefix sharing ---------------------------------------------------
+
+    @staticmethod
+    def _chain(tokens: list[int], nb: int, bt: int) -> list[str]:
+        """Running hash per full block: key i covers tokens[:(i+1)*bt]."""
+        keys = []
+        h = hashlib.sha1()
+        for i in range(nb):
+            # host token lists only — no device array ever enters here
+            h.update(np.asarray(  # jaxlint: disable=host-sync-in-hot-path
+                tokens[i * bt:(i + 1) * bt], np.int64).tobytes())
+            keys.append(h.hexdigest())
+        return keys
+
+    def match_prefix(self, prompt: Optional[list[int]]) -> list[int]:
+        """Physical block ids of the longest pool-cached full-block prefix
+        of ``prompt``. Never covers the final prompt token (its logits must
+        be recomputed to seed sampling), so at most (n-1)//bt blocks."""
+        if not prompt:
+            return []
+        bt = self.block_tokens
+        nb = (len(prompt) - 1) // bt
+        if nb <= 0:
+            return []
+        out: list[int] = []
+        with self._lock:
+            for key in self._chain(prompt, nb, bt):
+                bid = self._prefix.get(key)
+                if bid is None:
+                    break
+                out.append(bid)
+        return out
+
+    def register_prefix(self, seq: int, prompt: list[int]) -> int:
+        """Insert ``seq``'s full prompt blocks into the prefix pool (each
+        gains a pool reference). Call only after the blocks' contents have
+        been dispatched to the device. Returns blocks registered."""
+        table = self.tables.get(seq)
+        if table is None or not prompt:
+            return 0
+        bt = self.block_tokens
+        nb = min((len(prompt) - 1) // bt, len(table))
+        added = 0
+        with self._lock:
+            for i, key in enumerate(self._chain(prompt, nb, bt)):
+                if key in self._prefix:
+                    self._prefix.move_to_end(key)
+                    continue
+                bid = table[i]
+                if bid in self._block_key:  # already caches another chain
+                    continue
+                self._prefix[key] = bid
+                self._block_key[bid] = key
+                self._ref[bid] += 1
+                added += 1
+        return added
+
+    def _evict_one(self) -> Optional[int]:
+        """Drop the LRU pool-only block; returns its id. Caller holds the
+        lock."""
+        victim = next((k for k, b in self._prefix.items()
+                       if self._ref[b] == 1), None)
+        if victim is None:
+            return None
+        bid = self._prefix.pop(victim)
+        del self._block_key[bid]
+        self._ref[bid] = 0
+        self.evictions_total += 1
+        return bid
+
+    # -- allocate / release ----------------------------------------------
+
+    def allocate(self, seq: int, tokens: int,
+                 prompt: Optional[list[int]] = None) -> Optional[int]:
+        """Build ``seq``'s block table covering ``tokens`` rows, sharing
+        pool-cached prompt prefix blocks where possible. Returns the
+        shared-token count, or None when the pool cannot cover the
+        reservation (the caller queues the request). ``seq`` must not
+        already hold a table."""
+        assert seq not in self.tables, f"seq {seq} already has a table"
+        nb = self.blocks_for(tokens)
+        shared = self.match_prefix(prompt) if prompt else []
+        shared = shared[: max(0, nb - 1)]  # at least one writable block
+        with self._lock:
+            # reference the shared blocks FIRST: a pool-only shared block
+            # (ref==1) would otherwise be an eligible LRU eviction victim
+            # in the fresh loop below and end up in the table twice —
+            # once read-only, once writable
+            for bid in shared:
+                self._ref[bid] += 1
+                key = self._block_key.get(bid)
+                if key is not None:
+                    self._prefix.move_to_end(key)
+            need = nb - len(shared)
+            if need > len(self._free) + self._reclaimable():
+                for bid in shared:  # roll the reservation back
+                    self._ref[bid] -= 1
+                return None
+            fresh: list[int] = []
+            for _ in range(need):
+                if not self._free:
+                    evicted = self._evict_one()
+                    assert evicted is not None
+                    self._free.append(evicted)
+                fresh.append(self._free.pop())
+            for bid in fresh:
+                self._ref[bid] = 1
+            self.tables[seq] = shared + fresh
+            self.shared_blocks[seq] = len(shared)
+            used = self.num_blocks - 1 - len(self._free) - self._reclaimable()
+            self._watermark = max(self._watermark, used)
+        n_shared = len(shared) * self.block_tokens
+        self.shared_tokens_total += n_shared
+        return n_shared
+
+    def extend(self, seq: int, tokens: int) -> bool:
+        """Grow ``seq``'s existing table to cover ``tokens`` rows (used when
+        an admission resumes past disk-loaded rows). False on exhaustion."""
+        table = self.tables.get(seq)
+        if table is None:
+            return False
+        need = self.blocks_for(tokens) - len(table)
+        if need <= 0:
+            return True
+        with self._lock:
+            if need > len(self._free) + self._reclaimable():
+                return False
+            for _ in range(need):
+                if not self._free:
+                    evicted = self._evict_one()
+                    assert evicted is not None
+                    self._free.append(evicted)
+                bid = self._free.pop()
+                self._ref[bid] = 1
+                table.append(bid)
+            used = self.num_blocks - 1 - len(self._free) - self._reclaimable()
+            self._watermark = max(self._watermark, used)
+        return True
+
+    def release(self, seq: int) -> None:
+        table = self.tables.pop(seq, None)
+        self.shared_blocks.pop(seq, None)
+        if table is None:
+            return
+        with self._lock:
+            for bid in table:
+                self._ref[bid] -= 1
+                if self._ref[bid] == 0:
+                    self._free.append(bid)
+
+    # -- views ------------------------------------------------------------
+
+    def table_row(self, seq: int) -> np.ndarray:
+        """[max_blocks_per_seq] i32 device-shaped table row (trash-padded)."""
+        row = np.zeros(self.max_blocks_per_seq, np.int32)
+        t = self.tables.get(seq, [])
+        row[: len(t)] = t[: self.max_blocks_per_seq]
+        return row
+
+    def stats(self) -> BlockStats:
+        with self._lock:
+            free = len(self._free)
+            cached = self._reclaimable()
+            total = self.num_blocks - 1
+            return BlockStats(
+                total=total,
+                free=free,
+                cached=cached,
+                used=total - free - cached,
+                high_watermark=self._watermark,
+            )

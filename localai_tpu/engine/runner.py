@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import dataclasses
 import logging
+import os
 from functools import partial
 from typing import Any, Optional
 
@@ -28,6 +29,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from localai_tpu.engine import kvcache as kvc
+from localai_tpu.engine import paged as pgd
 from localai_tpu.engine import sampling as smp
 from localai_tpu.engine.kvcache import KVCache
 from localai_tpu.models import llama as mdl
@@ -37,6 +39,15 @@ from localai_tpu.obs import watchdog as obs_watchdog
 from localai_tpu.utils.jaxcompat import shard_map
 
 log = logging.getLogger(__name__)
+
+
+def _prompt_counts_row(vocab_size: int, prompt) -> np.ndarray:
+    """[V] i32 bincount of the FULL prompt for resume-style prefills (the
+    in-program count would only see the tail chunk)."""
+    crow = np.zeros(vocab_size, np.int32)
+    ids = np.asarray(prompt, np.int64)
+    np.add.at(crow, ids[(ids >= 0) & (ids < vocab_size)], 1)
+    return crow
 
 
 @jax.tree_util.register_dataclass
@@ -87,6 +98,10 @@ class ModelRunner:
         sp_threshold: int = 1024,
         ga_n: int = 1,
         ga_w: int = 512,
+        paged: Any = "auto",
+        kv_block_tokens: Optional[int] = None,
+        kv_num_blocks: Optional[int] = None,
+        prefill_chunk: Optional[int] = None,
     ):
         from localai_tpu import ops
 
@@ -167,6 +182,64 @@ class ModelRunner:
             # self-extend attend applies the real rotations per score set
             self._se_rope = self.rope
             self.rope = se.identity_rope(self.rope)
+        # paged KV cache (vLLM-style block pool + tables, engine.paged).
+        # Incompatible modes keep the slot-contiguous layout: a mesh (the
+        # sharded cache spec and ring/pp paths assume slot rows), and
+        # self-extend (unroped cache + grouped rescoring assume row slices).
+        incompat = []
+        if mesh is not None:
+            incompat.append("device mesh")
+        if ga_n > 1:
+            incompat.append("self-extend")
+        if paged in ("auto", None):
+            # bare runners (tests, tools) default contiguous; the serving
+            # manager and bench enable paged whenever compatible — flip
+            # globally with LOCALAI_KV_PAGED=1
+            want_paged = os.environ.get("LOCALAI_KV_PAGED", "0") == "1"
+            self.paged = want_paged and not incompat
+        else:
+            self.paged = bool(paged)
+            if self.paged and incompat:
+                raise ValueError(
+                    f"paged KV cache is incompatible with {incompat}")
+        if self.paged:
+            self.block_tokens = int(
+                kv_block_tokens or pgd.block_tokens_default())
+            self.max_blocks = -(-self.max_ctx // self.block_tokens)
+            self.ctx_pad = self.max_blocks * self.block_tokens
+            # default pool = the contiguous layout's HBM footprint (every
+            # slot can still reach max_ctx), plus the trash block; shrink
+            # via LOCALAI_KV_BLOCKS for real overcommit
+            default_blocks = num_slots * self.max_blocks + 1
+            env_blocks = os.environ.get("LOCALAI_KV_BLOCKS", "")
+            num_blocks = int(kv_num_blocks or env_blocks or default_blocks)
+            self.allocator = pgd.BlockAllocator(
+                num_blocks, self.block_tokens, self.max_blocks)
+            chunk_env = os.environ.get("LOCALAI_PREFILL_CHUNK_TOKENS", "512")
+            self.prefill_chunk = max(
+                self.block_tokens,
+                int(prefill_chunk or chunk_env or 512))
+            (self.paged_attn_impl, self._paged_attn_interpret,
+             paged_why) = ops.select_paged_attn_impl(
+                attn_impl,
+                num_heads=cfg.num_heads,
+                num_kv_heads=cfg.num_kv_heads,
+                head_dim=cfg.hd,
+                block_tokens=self.block_tokens,
+            )
+            if paged_why:
+                log.info("paged attention: %s; using gather+XLA", paged_why)
+            self.block_tables = jnp.zeros(
+                (num_slots, self.max_blocks), jnp.int32)
+            # one device-resident zeros row reused by every non-final
+            # chunk dispatch (whose sample=False program ignores counts —
+            # no per-chunk [V] host alloc + H2D copy)
+            self._zero_counts = jnp.zeros(cfg.vocab_size, jnp.int32)
+            # disk prompt-cache rows loaded into a slot's fresh blocks
+            # (the only slot-resident reuse that survives release)
+            self._loaded_rows: dict[int, int] = {}
+        else:
+            self.allocator = None
         kv_sharding = None
         if mesh is not None:
             from jax.sharding import NamedSharding
@@ -182,9 +255,14 @@ class ModelRunner:
                 params, "runner built over a device mesh")
             shd.slots_per_data_shard(num_slots, mesh)  # divisibility check
             kv_sharding = NamedSharding(mesh, shd.kv_spec(cfg, mesh))
-        self.kv = kvc.init_cache(
-            cfg, num_slots, self.max_ctx, kv_dtype, sharding=kv_sharding
-        )
+        if self.paged:
+            self.kv = kvc.init_paged_cache(
+                cfg, self.allocator.num_blocks, self.block_tokens, kv_dtype
+            )
+        else:
+            self.kv = kvc.init_cache(
+                cfg, num_slots, self.max_ctx, kv_dtype, sharding=kv_sharding
+            )
         self.state = DecodeState.init(num_slots, cfg.vocab_size, seed)
         if mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
@@ -246,6 +324,30 @@ class ModelRunner:
             self._prefill_resume_fn, static_argnames=("bucket",),
             donate_argnums=(1, 2),
         ), "prefill_resume")
+        if self.paged:
+            # paged variants keep the contiguous programs' obs labels so
+            # the cost observatory's per-program series stay comparable
+            # across layouts; the chunked prefill gets its own label.
+            self._decode_paged = obs_compile.watch(
+                jax.jit(self._decode_paged_fn, donate_argnums=(1, 2)),
+                "decode")
+            self._decode_paged_n = obs_compile.watch(jax.jit(
+                self._decode_paged_n_fn, static_argnames=("n",),
+                donate_argnums=(1, 2),
+            ), "decode_n")
+            self._decode_paged_frozen_n = obs_compile.watch(jax.jit(
+                self._decode_paged_frozen_n_fn, static_argnames=("n",),
+                donate_argnums=(1, 2),
+            ), "decode_frozen_n")
+            self._prefill_paged = obs_compile.watch(jax.jit(
+                self._prefill_paged_fn,
+                static_argnames=("bucket", "sample"),
+                donate_argnums=(1, 2),
+            ), "prefill_chunk")
+            self._prefill_paged_mm = obs_compile.watch(jax.jit(
+                self._prefill_paged_mm_fn, static_argnames=("bucket",),
+                donate_argnums=(1, 2),
+            ), "prefill_mm")
         # sequence-parallel prefill: long prompts chunk over the 'seq' mesh
         # axis and run ring attention (parallel.ring) straight into the
         # slot cache. Composes with TP: weights stay 'model'-sharded
@@ -335,7 +437,14 @@ class ModelRunner:
             params, state.tokens[:, None], pos[:, None],
             write, kv.stacked(), mask, attn=attn,
         )
-        logits = mdl.logits_from_hidden(cfg, params, hidden[:, 0])
+        new_state, tokens = self._decode_tail(params, state, hidden)
+        return KVCache.from_stacked(new_stack), new_state, tokens
+
+    def _decode_tail(self, params, state: DecodeState, hidden):
+        """Sampling + per-slot state advance shared by the contiguous and
+        paged decode programs (KV-layout-independent)."""
+        pos = state.positions
+        logits = mdl.logits_from_hidden(self.cfg, params, hidden[:, 0])
         tokens, keys = smp.sample(
             logits, state.params, state.counts, state.keys, state.bias
         )
@@ -350,7 +459,7 @@ class ModelRunner:
         new_state = dataclasses.replace(
             state, tokens=tokens, positions=positions, keys=keys, counts=counts
         )
-        return KVCache.from_stacked(new_stack), new_state, tokens
+        return new_state, tokens
 
     def _decode_n_fn(self, params, kv: KVCache, state: DecodeState, *, n: int):
         """n decode steps in ONE dispatch via lax.scan — amortizes host→device
@@ -533,6 +642,132 @@ class ModelRunner:
         )
         return new_kv, new_state, tok[0]
 
+    # -- paged programs (block-pool KV; engine.paged / kvcache.Paged*) ---
+
+    def _decode_paged_fn(self, params, kv: kvc.PagedKVCache,
+                         state: DecodeState, tables):
+        """Batched single-token decode over the block pool. ``tables``
+        [S, MB] i32 is the device mirror of the allocator's block tables
+        (not donated — it changes only at admit/release)."""
+        cfg = self.cfg
+        pos = state.positions
+        raw = self.paged_attn_impl == "pallas"
+        attn = None
+        if raw:
+            from localai_tpu import ops
+
+            kernel = partial(
+                ops.paged_decode_attention,
+                sliding_window=cfg.sliding_window,
+                interpret=self._paged_attn_interpret,
+            )
+
+            def attn(q, keys, values, _mask):  # q [S,1,Hq,hd]; keys = pool
+                if kv.quantized:  # (int8 pool, f32 scales) — fused dequant
+                    out = kernel(q[:, 0], keys[0], values[0], tables, pos,
+                                 keys[1], values[1])
+                else:
+                    out = kernel(q[:, 0], keys, values, tables, pos)
+                return out[:, None]
+
+        mask = kvc.decode_mask(cfg, pos, self.ctx_pad)
+        write = kvc.paged_decode_write(tables, pos, raw=raw)
+        hidden, new_stack = self._forward(
+            params, state.tokens[:, None], pos[:, None],
+            write, kv.stacked(), mask, attn=attn,
+        )
+        new_state, tokens = self._decode_tail(params, state, hidden)
+        return kvc.PagedKVCache.from_stacked(new_stack), new_state, tokens
+
+    def _decode_paged_n_fn(self, params, kv, state, tables, *, n: int):
+        """n paged decode steps in one dispatch (lax.scan) — the paged
+        twin of _decode_n_fn. The block tables are loop-invariant: every
+        admitted slot's table already covers its full reservation."""
+
+        def body(carry, _):
+            kv, state = carry
+            kv, state, tokens = self._decode_paged_fn(
+                params, kv, state, tables)
+            return (kv, state), tokens
+
+        (kv, state), tokens = jax.lax.scan(body, (kv, state), None, length=n)
+        return kv, state, tokens
+
+    def _decode_paged_frozen_n_fn(self, params, kv, state, tables, freeze,
+                                  *, n: int):
+        """Paged twin of _decode_frozen_n_fn (see its docstring)."""
+        full_active = state.active
+
+        def body(carry, i):
+            kv, st = carry
+            eff = jnp.where(i == 0, full_active, full_active & ~freeze)
+            kv, st, tokens = self._decode_paged_fn(
+                params, kv, dataclasses.replace(st, active=eff), tables
+            )
+            st = dataclasses.replace(st, active=full_active)
+            return (kv, st), tokens
+
+        (kv, state), tokens = jax.lax.scan(
+            body, (kv, state), jnp.arange(n), length=n
+        )
+        return kv, state, tokens
+
+    def _prefill_paged_fn(self, params, kv, state, tokens, length, offset,
+                          table_row, slot, counts_row, *, bucket: int,
+                          sample: bool, embeds=None):
+        """One chunked-prefill dispatch: write ``length`` real tokens of the
+        chunk at absolute positions [offset, offset+length) through the
+        slot's block table, attending resume-style over the gathered prefix
+        + chunk. Non-final chunks (``sample=False``) leave the decode state
+        untouched; the final chunk samples the first token and arms the
+        slot exactly like the contiguous prefill paths."""
+        cfg = self.cfg
+        positions = offset + jnp.arange(bucket, dtype=jnp.int32)[None, :]
+        mask = kvc.resume_mask(cfg, bucket, offset, self.ctx_pad)
+        write = kvc.paged_prefill_write(table_row, offset, length)
+        hidden, new_stack = self._forward(
+            params, tokens, positions, write, kv.stacked(), mask,
+            embeds=embeds,
+        )
+        new_kv = kvc.PagedKVCache.from_stacked(new_stack)
+        if not sample:
+            return new_kv, state, jnp.zeros((), jnp.int32)
+        last_h = jax.lax.dynamic_index_in_dim(hidden[0], length - 1,
+                                              keepdims=True)
+        logits = mdl.logits_from_hidden(cfg, params, last_h)  # [1, V]
+        counts = state.counts.at[slot].set(counts_row)
+        slot_params = jax.tree.map(lambda a: a[slot][None], state.params)
+        tok, new_key = smp.sample(
+            logits, slot_params, counts[slot][None],
+            state.keys[slot][None], state.bias[slot][None],
+        )
+        new_state = dataclasses.replace(
+            state,
+            tokens=state.tokens.at[slot].set(tok[0]),
+            positions=state.positions.at[slot].set(offset + length),
+            active=state.active.at[slot].set(True),
+            keys=state.keys.at[slot].set(new_key[0]),
+            counts=counts,
+        )
+        return new_kv, new_state, tok[0]
+
+    def _prefill_paged_mm_fn(self, params, kv, state, tokens, length,
+                             table_row, slot, mm_embeds, mm_positions,
+                             counts_row, *, bucket: int):
+        """Multimodal paged prefill: single-dispatch (never chunked — the
+        scattered image embeddings must ride one program, mirroring
+        _prefill_mm_fn), offset 0, always samples."""
+        from localai_tpu.models import quant as qnt
+
+        dtype = jnp.dtype(self.cfg.dtype)
+        x = qnt.embed_rows(params["embed"], tokens, dtype)  # [1, bucket, D]
+        x = x.at[0, mm_positions].set(mm_embeds.astype(dtype))
+        return self._prefill_paged_fn(
+            params, kv, state, tokens, length, jnp.zeros((), jnp.int32),
+            table_row, slot, counts_row, bucket=bucket, sample=True,
+            embeds=x,
+        )
+
     def _embed_fn(self, params, tokens, length, *, bucket: int):
         """Mean-pooled final hidden state over the real tokens — the LLM
         embeddings path (parity: llama.cpp embeddings mode behind the
@@ -667,6 +902,9 @@ class ModelRunner:
         valid_n: Optional[int] = None,              # slot's KV frontier, from
                                                     # a batched slot_positions()
                                                     # read (None → read it here)
+        reserve_tokens: Optional[int] = None,       # paged mode: worst-case
+                                                    # rows (prompt + max_new)
+                                                    # to reserve; None → max_ctx
     ) -> int:
         """Prefill a prompt into a slot; returns the first sampled token.
 
@@ -682,6 +920,28 @@ class ModelRunner:
             # context-exhaustion policy parity (grpc-server.cpp:1573-1592):
             # reject rather than silently shift context.
             raise ValueError(f"prompt ({n} tokens) exceeds context {self.max_ctx}")
+        if self.paged:
+            adm = self.begin_admit(
+                slot, prompt,
+                reserve_tokens=reserve_tokens,
+                resident=resident, valid_n=valid_n,
+                mm_embeds=mm_embeds, mm_positions=mm_positions,
+                temperature=temperature, top_k=top_k, top_p=top_p,
+                min_p=min_p, repeat_penalty=repeat_penalty,
+                presence_penalty=presence_penalty,
+                frequency_penalty=frequency_penalty,
+                seed=seed, logit_bias=logit_bias, bias_row=bias_row,
+            )
+            if adm is None:
+                raise RuntimeError(
+                    "KV block pool exhausted: cannot reserve "
+                    f"{len(prompt)} prompt tokens (direct admit has no "
+                    "queue; size the pool via LOCALAI_KV_BLOCKS or admit "
+                    "through the scheduler)")
+            while True:
+                tok = adm.step_chunk()
+                if tok is not None:
+                    return tok
         lcp = 0
         if resident and mm_embeds is None:
             lcp = self.reusable_prefix(slot, resident, prompt, valid_n)
@@ -692,33 +952,13 @@ class ModelRunner:
                   else self.bucket_for(n))
         padded = np.zeros((1, bucket), np.int32)
         padded[0, : len(tail)] = tail
-        self.state = dataclasses.replace(
-            self.state,
-            params=self.state.params.with_slot(
-                slot,
-                temperature=temperature,
-                top_k=top_k,
-                top_p=top_p,
-                min_p=min_p,
-                repeat_penalty=repeat_penalty,
-                presence_penalty=presence_penalty,
-                frequency_penalty=frequency_penalty,
-            ),
+        self._prepare_slot(
+            slot, temperature=temperature, top_k=top_k, top_p=top_p,
+            min_p=min_p, repeat_penalty=repeat_penalty,
+            presence_penalty=presence_penalty,
+            frequency_penalty=frequency_penalty,
+            seed=seed, logit_bias=logit_bias, bias_row=bias_row,
         )
-        if seed is not None:
-            self.state = dataclasses.replace(
-                self.state,
-                keys=self.state.keys.at[slot].set(jax.random.key(seed)),
-            )
-        if bias_row is not None:
-            row = np.asarray(bias_row, np.float32).copy()
-        else:
-            row = np.zeros(self.cfg.vocab_size, np.float32)
-        if logit_bias:
-            for tid, b in logit_bias.items():
-                if 0 <= int(tid) < self.cfg.vocab_size:
-                    row[int(tid)] += b
-        self.set_bias(slot, row)
         n_seq = self.mesh.shape.get("seq", 1) if self.mesh is not None else 1
         use_sp = (
             self.sp_enabled and not lcp and mm_embeds is None
@@ -733,9 +973,7 @@ class ModelRunner:
             )
         elif lcp:
             self.last_prefill_path = "resume"
-            crow = np.zeros(self.cfg.vocab_size, np.int32)
-            ids = np.asarray(prompt, np.int64)
-            np.add.at(crow, ids[(ids >= 0) & (ids < self.cfg.vocab_size)], 1)
+            crow = _prompt_counts_row(self.cfg.vocab_size, prompt)
             self.kv, self.state, tok = self._prefill_resume(
                 self.params, self.kv, self.state,
                 jnp.asarray(padded), jnp.int32(len(tail)), jnp.int32(lcp),
@@ -764,6 +1002,123 @@ class ModelRunner:
         with self.watchdog.guard("device"):
             return int(tok)  # jaxlint: disable=host-sync-in-hot-path
 
+    def _prepare_slot(self, slot: int, *, temperature=None, top_k=None,
+                      top_p=None, min_p=None, repeat_penalty=None,
+                      presence_penalty=None, frequency_penalty=None,
+                      seed=None, logit_bias=None, bias_row=None) -> None:
+        """Per-slot sampling params + PRNG seed + logit-bias row — the
+        admission preamble shared by the contiguous and paged paths."""
+        self.state = dataclasses.replace(
+            self.state,
+            params=self.state.params.with_slot(
+                slot,
+                temperature=temperature,
+                top_k=top_k,
+                top_p=top_p,
+                min_p=min_p,
+                repeat_penalty=repeat_penalty,
+                presence_penalty=presence_penalty,
+                frequency_penalty=frequency_penalty,
+            ),
+        )
+        if seed is not None:
+            self.state = dataclasses.replace(
+                self.state,
+                keys=self.state.keys.at[slot].set(jax.random.key(seed)),
+            )
+        if bias_row is not None:
+            row = np.asarray(bias_row, np.float32).copy()
+        else:
+            row = np.zeros(self.cfg.vocab_size, np.float32)
+        if logit_bias:
+            for tid, b in logit_bias.items():
+                if 0 <= int(tid) < self.cfg.vocab_size:
+                    row[int(tid)] += b
+        self.set_bias(slot, row)
+
+    # -- paged admission (chunked prefill; engine.paged) -----------------
+
+    def begin_admit(
+        self, slot: int, prompt: list[int], *,
+        reserve_tokens: Optional[int] = None,
+        resident: Optional[list[int]] = None,
+        valid_n: Optional[int] = None,
+        mm_embeds=None, mm_positions=None,
+        **sampling,
+    ) -> Optional["PagedAdmission"]:
+        """Start a chunked paged admission: reserve blocks (sharing pooled
+        prefix blocks where the prompt allows), arm the slot's sampling
+        state, and return a PagedAdmission whose ``step_chunk()`` the
+        caller drives — interleaving chunk dispatches with decode
+        dispatches so one long prompt never stalls other slots' TPOT.
+        Returns None when the pool cannot cover the reservation (the
+        scheduler keeps the request queued)."""
+        assert self.paged, "begin_admit requires a paged runner"
+        if not prompt:
+            prompt = [0]
+        n = len(prompt)
+        if n > self.max_ctx - 1:
+            raise ValueError(
+                f"prompt ({n} tokens) exceeds context {self.max_ctx}")
+        reserve = min(self.max_ctx, max(n + 1, reserve_tokens
+                                        or self.max_ctx))
+        if self.allocator.blocks_for(reserve) > self.allocator.num_blocks - 1:
+            # can NEVER fit, even with an empty pool (overcommitted
+            # LOCALAI_KV_BLOCKS): reject like the prompt-exceeds-context
+            # check — holding it would head-of-line block admission forever
+            raise ValueError(
+                f"reservation of {reserve} tokens "
+                f"({self.allocator.blocks_for(reserve)} blocks) exceeds the "
+                f"block pool ({self.allocator.num_blocks - 1} blocks); "
+                "lower max_new_tokens or raise LOCALAI_KV_BLOCKS")
+        mm = mm_embeds is not None and len(mm_embeds) > 0
+        lcp = 0
+        if resident and not mm and self._loaded_rows.get(slot):
+            # rows just loaded from the disk prompt cache (load_prefix) —
+            # the only slot-resident reuse paged mode has; pool sharing
+            # covers everything else
+            lcp = self.reusable_prefix(slot, resident, prompt, valid_n)
+        if lcp:
+            if not self.allocator.extend(slot, reserve):
+                self.allocator.release(slot)
+                self._loaded_rows.pop(slot, None)
+                return None
+            self.last_prefill_path = "paged_resume"
+        else:
+            if slot in self.allocator.tables:  # stale loaded rows
+                self.allocator.release(slot)
+            self._loaded_rows.pop(slot, None)
+            shared = self.allocator.allocate(
+                slot, reserve, prompt=None if mm else prompt)
+            if shared is None:
+                return None
+            lcp = shared
+            self.last_prefill_path = ("paged_mm" if mm
+                                      else "paged_shared" if shared
+                                      else "paged")
+        self.last_prefix_reused = lcp
+        self.total_prefix_reused += lcp
+        self._prepare_slot(slot, **sampling)
+        return PagedAdmission(self, slot, list(prompt), lcp,
+                              mm_embeds=mm_embeds,
+                              mm_positions=mm_positions)
+
+    def _install_table_row(self, slot: int) -> None:
+        self.block_tables = self.block_tables.at[slot].set(
+            jnp.asarray(self.allocator.table_row(slot)))
+
+    def _finish_paged_admit(self, slot: int, prompt: list[int],
+                            mm: bool) -> None:
+        """Final-chunk bookkeeping: expose the block table to the decode
+        programs, publish the prompt's full blocks to the prefix pool
+        (their contents are dispatched by now; token-keyed sharing is
+        meaningless for multimodal prompts), mark the slot live."""
+        self._install_table_row(slot)
+        if not mm:
+            self.allocator.register_prefix(slot, prompt)
+        self._loaded_rows.pop(slot, None)
+        self._active_slots.add(slot)
+
     def reusable_prefix(self, slot: int, resident: Optional[list[int]],
                         prompt: list[int],
                         valid_n: Optional[int] = None) -> int:
@@ -779,7 +1134,8 @@ class ModelRunner:
         if not resident or not prompt:
             return 0
         if valid_n is None:
-            valid_n = self.slot_position(slot)
+            valid_n = (self._loaded_rows.get(slot, 0) if self.paged
+                       else self.slot_position(slot))
         valid = resident[:valid_n]
         lcp = 0
         for a, b in zip(valid, prompt):
@@ -790,9 +1146,22 @@ class ModelRunner:
         lcp = min(lcp, len(prompt) - 1)
         if lcp < self.prefix_reuse_min:
             return 0
+        if self.paged:
+            # chunked writes redirect bucket overshoot to the trash block,
+            # so any in-context tail is feasible — no bucket-fit gate
+            return lcp
         if self._resume_bucket(len(prompt) - lcp, lcp) is None:
             return 0
         return lcp
+
+    def resident_rows(self, slot: int, default: int) -> int:
+        """KV rows of ``slot`` that are actually resident for prefix reuse.
+        Contiguous mode: the device frontier the caller already read
+        (``default``). Paged mode: blocks are freed at release, so only
+        rows just loaded from the disk prompt cache count."""
+        if not self.paged:
+            return default
+        return min(default, self._loaded_rows.get(slot, 0))
 
     def _resume_bucket(self, tail_len: int, offset: int) -> Optional[int]:
         """Smallest prefill bucket holding the tail that also fits in the
@@ -809,15 +1178,18 @@ class ModelRunner:
         Synchronous by contract — the blocking host read IS the API
         (constraint gating needs the token before the next dispatch);
         pipelined callers use step_async()."""
-        self.kv, self.state, tokens = self._decode(
-            self.params, self.kv, self.state
-        )
+        tokens = self.step_async()
         with self.watchdog.guard("device"):
             return np.asarray(tokens)  # jaxlint: disable=host-sync-in-hot-path
 
     def step_async(self) -> jax.Array:
         """Like step() but returns the device array without synchronizing —
         callers overlap the host read with the next dispatch."""
+        if self.paged:
+            self.kv, self.state, tokens = self._decode_paged(
+                self.params, self.kv, self.state, self.block_tables
+            )
+            return tokens
         self.kv, self.state, tokens = self._decode(
             self.params, self.kv, self.state
         )
@@ -827,15 +1199,18 @@ class ModelRunner:
         """n decode iterations in one dispatch; returns tokens [n, S].
         Synchronous by contract — see step(); hot callers use
         step_n_async()."""
-        self.kv, self.state, tokens = self._decode_n(
-            self.params, self.kv, self.state, n=n
-        )
+        tokens = self.step_n_async(n)
         with self.watchdog.guard("device"):
             return np.asarray(tokens)  # jaxlint: disable=host-sync-in-hot-path
 
     def step_n_async(self, n: int) -> jax.Array:
         """Like step_n() but returns the [n, S] device array without
         synchronizing — callers overlap the host read with later dispatches."""
+        if self.paged:
+            self.kv, self.state, tokens = self._decode_paged_n(
+                self.params, self.kv, self.state, self.block_tables, n=n
+            )
+            return tokens
         self.kv, self.state, tokens = self._decode_n(
             self.params, self.kv, self.state, n=n
         )
@@ -844,10 +1219,16 @@ class ModelRunner:
     def step_frozen_n(self, freeze: np.ndarray, n: int) -> np.ndarray:
         """n decode iterations where ``freeze``-masked slots advance only on
         the first; returns tokens [n, S] (rows 1+ stale for frozen slots)."""
-        self.kv, self.state, tokens = self._decode_frozen_n(
-            self.params, self.kv, self.state,
-            jnp.asarray(freeze, jnp.bool_), n=n,
-        )
+        if self.paged:
+            self.kv, self.state, tokens = self._decode_paged_frozen_n(
+                self.params, self.kv, self.state, self.block_tables,
+                jnp.asarray(freeze, jnp.bool_), n=n,
+            )
+        else:
+            self.kv, self.state, tokens = self._decode_frozen_n(
+                self.params, self.kv, self.state,
+                jnp.asarray(freeze, jnp.bool_), n=n,
+            )
         # synchronous by contract: the frozen slots' constraint masks need
         # the sampled token on the host before the next dispatch
         with self.watchdog.guard("device"):
@@ -883,6 +1264,19 @@ class ModelRunner:
         self.state = dataclasses.replace(
             self.state, active=self.state.active.at[slot].set(False)
         )
+        if self.paged:
+            # free the slot's blocks (prompt blocks registered in the
+            # prefix pool survive as reclaimable cache) and point the
+            # device table row at the trash block so the decode programs'
+            # static-shape garbage writes can't touch reallocated blocks
+            self.allocator.release(slot)
+            self._loaded_rows.pop(slot, None)
+            self.block_tables = self.block_tables.at[slot].set(
+                jnp.zeros(self.max_blocks, jnp.int32))
+            self.state = dataclasses.replace(
+                self.state,
+                positions=self.state.positions.at[slot].set(0),
+            )
         self._active_slots.discard(slot)
         if slot not in self._free_slots:
             self._free_slots.append(slot)
@@ -921,6 +1315,38 @@ class ModelRunner:
                      # self-extend caches store UNroped K — a roped-cache
                      # runner must never load these rows (and vice versa)
                      "kv_rope": "raw" if self.ga_n > 1 else "roped"}
+        if self.paged:
+            # gather the slot's blocks back into contiguous [L, H, p, ...]
+            # rows — the export format is layout-independent, so paged and
+            # contiguous runners can share one disk prompt cache
+            bt = self.block_tokens
+            table = self.allocator.tables.get(slot, [])
+            nb = min(max(1, -(-p // bt)), len(table)) if table else 0
+            if nb == 0:
+                p = 0
+                blocks = np.zeros(1, np.int64)
+            else:
+                p = min(p, nb * bt)
+                blocks = np.asarray(table[:nb], np.int64)
+
+            def rows(cache):  # [L, N, H, bt, hd] -> [L, H, p, hd]
+                g = cache[:, blocks]
+                L, _, H = g.shape[0], g.shape[1], g.shape[2]
+                return g.transpose(0, 2, 1, 3, 4).reshape(
+                    L, H, len(blocks) * bt, cache.shape[-1])[:, :, :p]
+
+            def srows(sc):    # [L, N, H, bt] -> [L, H, p]
+                g = sc[:, blocks]
+                L, H = g.shape[0], g.shape[2]
+                return g.transpose(0, 2, 1, 3).reshape(
+                    L, H, len(blocks) * bt)[:, :, :p]
+
+            out["k"] = rows(self.kv.k)
+            out["v"] = rows(self.kv.v)
+            if self.kv.quantized:
+                out["k_scale"] = srows(self.kv.k_scale)
+                out["v_scale"] = srows(self.kv.v_scale)
+            return out
         out["k"] = self.kv.k[:, slot, :, :p]
         out["v"] = self.kv.v[:, slot, :, :p]
         if self.kv.quantized:
@@ -975,6 +1401,8 @@ class ModelRunner:
         L, H, hd = self.cfg.num_layers, self.cfg.num_kv_heads, self.cfg.hd
         if k.shape != (L, H, n, hd) or v.shape != (L, H, n, hd):
             return False
+        if self.paged:
+            return self._load_prefix_paged(slot, arrays, n, k, v)
         kv = self.kv
         new = {
             "k": kv.k.at[:, slot, :, :n].set(jnp.asarray(k, kv.k.dtype)),
@@ -995,6 +1423,139 @@ class ModelRunner:
         )
         self._active_slots.discard(slot)
         return True
+
+    def _load_prefix_paged(self, slot: int, arrays: dict, n: int,
+                           k: np.ndarray, v: np.ndarray) -> bool:
+        """Paged load_prefix tail: scatter the exported contiguous rows
+        into freshly allocated blocks and mark them slot-resident
+        (``_loaded_rows``) so begin_admit can resume past them."""
+        kv = self.kv
+        if kv.quantized and ("k_scale" not in arrays
+                             or "v_scale" not in arrays):
+            return False
+        if slot in self.allocator.tables:
+            self.allocator.release(slot)
+        self._loaded_rows.pop(slot, None)
+        if self.allocator.allocate(slot, n) is None:
+            return False
+        bt = self.block_tokens
+        table = np.asarray(self.allocator.tables[slot], np.int64)
+        pos = np.arange(n)
+        blk = jnp.asarray(table[pos // bt], jnp.int32)
+        off = jnp.asarray(pos % bt, jnp.int32)
+        # advanced indices (blk, off) around the head slice broadcast to
+        # the FRONT: the set value is row-major [n, L, H, ...]
+        new = {
+            "k": kv.k.at[:, blk, :, off].set(
+                jnp.asarray(k, kv.k.dtype).transpose(2, 0, 1, 3)),
+            "v": kv.v.at[:, blk, :, off].set(
+                jnp.asarray(v, kv.v.dtype).transpose(2, 0, 1, 3)),
+        }
+        if kv.quantized:
+            new["k_scale"] = kv.k_scale.at[:, blk, :, off].set(
+                jnp.asarray(arrays["k_scale"],
+                            jnp.float32).transpose(2, 0, 1))
+            new["v_scale"] = kv.v_scale.at[:, blk, :, off].set(
+                jnp.asarray(arrays["v_scale"],
+                            jnp.float32).transpose(2, 0, 1))
+        self.kv = kvc.PagedKVCache(**new)
+        self._install_table_row(slot)
+        self._loaded_rows[slot] = n
+        self.state = dataclasses.replace(
+            self.state,
+            positions=self.state.positions.at[slot].set(n),
+            active=self.state.active.at[slot].set(False),
+        )
+        self._active_slots.discard(slot)
+        return True
+
+
+class PagedAdmission:
+    """One in-flight chunked paged admission (ModelRunner.begin_admit).
+
+    The scheduler drives ``step_chunk()`` from its engine loop,
+    interleaving chunk dispatches with decode dispatches; direct callers
+    (bench, tests) just loop it. Only the FINAL chunk samples — it
+    installs the slot's device block-table row, publishes prompt blocks
+    to the prefix pool, arms the slot, and returns the first token."""
+
+    def __init__(self, runner: ModelRunner, slot: int, prompt: list[int],
+                 start: int, mm_embeds=None, mm_positions=None):
+        self.runner = runner
+        self.slot = slot
+        self.prompt = prompt
+        self.pos = start                     # next position to prefill
+        self.prefix_reused = start           # shared/loaded rows (telemetry)
+        self.path = runner.last_prefill_path
+        self.mm = mm_embeds is not None and len(mm_embeds) > 0
+        self.mm_embeds = mm_embeds
+        self.mm_positions = mm_positions
+        self.first_token: Optional[int] = None
+        self.done = False
+
+    @property
+    def chunks_remaining(self) -> int:
+        if self.done:
+            return 0
+        if self.mm:
+            return 1
+        return max(1, -(-(len(self.prompt) - self.pos)
+                        // self.runner.prefill_chunk))
+
+    def _counts_row(self) -> np.ndarray:
+        return _prompt_counts_row(self.runner.cfg.vocab_size, self.prompt)
+
+    def step_chunk(self) -> Optional[int]:
+        """Dispatch the next prefill chunk; returns the first sampled
+        token once the admission is complete, else None."""
+        assert not self.done
+        r = self.runner
+        slot = self.slot
+        n = len(self.prompt)
+        table_row = jnp.asarray(r.allocator.table_row(slot))
+        if self.mm:
+            bucket = r.bucket_for(n)
+            padded = np.zeros((1, bucket), np.int32)
+            padded[0, :n] = self.prompt
+            r.kv, r.state, tok = r._prefill_paged_mm(
+                r.params, r.kv, r.state, jnp.asarray(padded), jnp.int32(n),
+                table_row, jnp.int32(slot),
+                jnp.asarray(self.mm_embeds, jnp.float32),
+                jnp.asarray(self.mm_positions, jnp.int32),
+                jnp.asarray(self._counts_row()), bucket=bucket,
+            )
+            self.pos = n
+            last = True
+        else:
+            rem = n - self.pos
+            take = min(rem, r.prefill_chunk)
+            last = take == rem
+            bucket = r.bucket_for(take)
+            padded = np.zeros((1, bucket), np.int32)
+            padded[0, :take] = self.prompt[self.pos:self.pos + take]
+            crow = (jnp.asarray(self._counts_row()) if last
+                    else r._zero_counts)  # sample=False ignores counts
+            r.kv, r.state, tok = r._prefill_paged(
+                r.params, r.kv, r.state, jnp.asarray(padded),
+                jnp.int32(take), jnp.int32(self.pos), table_row,
+                jnp.int32(slot), crow, bucket=bucket,
+                sample=last,
+            )
+            self.pos += take
+        if not last:
+            return None
+        self.done = True
+        r._finish_paged_admit(slot, self.prompt, mm=self.mm)
+        # the admit-time prefill/decode handoff sync, same as admit()
+        with r.watchdog.guard("device"):
+            self.first_token = int(tok)  # jaxlint: disable=host-sync-in-hot-path
+        return self.first_token
+
+    def abort(self) -> None:
+        """Abandon a part-way admission (client cancelled while chunks
+        were queued): frees the blocks and leaves the slot inactive."""
+        self.done = True
+        self.runner.release(self.slot)
 
 
 _ONE = np.asarray(1)

@@ -28,6 +28,7 @@ import logging
 import queue
 import threading
 import time
+from collections import deque
 from typing import Any, Optional, Protocol, Sequence
 
 import numpy as np
@@ -183,6 +184,19 @@ class GenHandle:
 
 
 @dataclasses.dataclass
+class _PendingPrefill:
+    """A chunked paged admission in flight: the engine loop dispatches one
+    chunk per iteration (interleaved with decode dispatches) until the
+    final chunk samples the first token and the slot goes live."""
+
+    slot: int
+    handle: GenHandle
+    adm: Any                 # engine.runner.PagedAdmission
+    base: Optional[np.ndarray]
+    mask_set: bool
+
+
+@dataclasses.dataclass
 class _SlotCtx:
     """Host-side state for one occupied slot."""
 
@@ -281,6 +295,17 @@ class Scheduler:
         # folded into the per-token EMA (one multi-second compile sample
         # would pin the adaptive size at 1 for a long recovery)
         self._seen_shapes: set = set()
+        # chunked prefill (paged runners): admissions queue their prompt
+        # chunks here and the engine loop interleaves ONE chunk per
+        # iteration with decode dispatches, so a long prompt never stalls
+        # other slots' TPOT. Spec engines keep the contiguous one-shot
+        # admit (SpecDecoder rejects paged runners at construction).
+        self._chunked = bool(getattr(runner, "paged", False)) and spec is None
+        self._prefills: "deque[_PendingPrefill]" = deque()
+        self.total_prefill_chunks = 0
+        # a request the paged block pool couldn't cover yet: admission is
+        # FIFO, so it parks here (not back in the queue) until blocks free
+        self._held: Optional[GenHandle] = None
         # two-lane admission: interactive requests drain strictly before
         # the background batch lane (see _next_pending)
         self._pending: "queue.Queue[GenHandle]" = queue.Queue()
@@ -323,7 +348,9 @@ class Scheduler:
 
     @property
     def busy(self) -> bool:
-        return (bool(self._slots) or not self._pending.empty()
+        return (bool(self._slots) or bool(self._prefills)
+                or self._held is not None
+                or not self._pending.empty()
                 or not self._pending_batch.empty())
 
     def note_shed(self) -> None:
@@ -361,11 +388,30 @@ class Scheduler:
                 1 for c in self._slots.values()
                 if c.handle.request.priority >= PRIORITY_BATCH
             )
+        paged_stats = {}
+        alloc = getattr(self.runner, "allocator", None)
+        if alloc is not None:
+            st = alloc.stats()
+            paged_stats = {
+                "kv_block_tokens": self.runner.block_tokens,
+                "kv_blocks_total": st.total,
+                # free = immediately free + reclaimable prefix-pool cache
+                "kv_blocks_free": st.free + st.cached,
+                "kv_blocks_used": st.used,
+                "kv_blocks_cached": st.cached,
+                "kv_block_watermark": st.high_watermark,
+                "kv_shared_tokens": alloc.shared_tokens_total,
+                "prefill_chunks": self.total_prefill_chunks,
+                "prefill_chunk_queue_depth": sum(
+                    p.adm.chunks_remaining for p in list(self._prefills)
+                ),
+            }
         return {
             "active_slots": active,
             "num_slots": num_slots,
             "occupancy": len(active) / num_slots if num_slots else 0.0,
             "kv_utilization": kv_utilization,
+            **paged_stats,
             "queue_depth": self._pending.qsize(),
             "batch_queue_depth": self._pending_batch.qsize(),
             "batch_slots": batch_slots,
@@ -391,10 +437,15 @@ class Scheduler:
         }
 
     def _kv_utilization(self) -> float:
-        """Fraction of KV rows holding live context, from the host-side
-        token record (no device read): each active slot holds prompt +
-        generated rows. Caller must own ``_slots`` — hold ``_lock`` or be
-        the engine thread (the only mutator)."""
+        """Fraction of KV capacity holding live context. Paged runners
+        report block-pool utilization (used / allocatable blocks — the
+        allocator's own accounting, reservation included); contiguous
+        runners keep the row-level estimate from the host token record.
+        Caller must own ``_slots`` — hold ``_lock`` or be the engine
+        thread (the only mutator)."""
+        alloc = getattr(self.runner, "allocator", None)
+        if alloc is not None:
+            return alloc.stats().utilization
         num_slots = self.runner.num_slots
         max_ctx = self.runner.max_ctx
         if not num_slots:
@@ -483,8 +534,6 @@ class Scheduler:
         # program the UNconstrained slots still ride the same dispatch for
         # multi_step tokens (one tool-call request no longer de-pipelines
         # the whole batch).
-        from collections import deque
-
         inflight: deque[tuple[Any, int, int, bool, float, bool]] = deque()
 
         def drain_one() -> None:
@@ -529,12 +578,19 @@ class Scheduler:
 
         while not self._stopping:
             admitted = self._admit_pending()
+            # chunked prefill: ONE chunk per loop iteration, so pending
+            # chunks and decode dispatches alternate — a long prompt
+            # spreads its prefill across the batch's decode cadence
+            # instead of stalling it
+            chunked = self._step_prefill_chunk()
             if not self._slots:
                 self._last_drain_t = None  # idle gap would pollute the EMA
                 if inflight:
                     drain_one()
                     continue
-                if not admitted:
+                if self._prefills:
+                    continue  # no decode work yet — keep chunking
+                if not admitted and not chunked:
                     self._wake.wait(timeout=0.05)
                     self._wake.clear()
                 continue
@@ -731,7 +787,19 @@ class Scheduler:
     def _admit_pending(self) -> bool:
         admitted = False
         while self._engine.free_slots():
-            handle = self._next_pending()
+            if self._held is not None:
+                if (not self._held.cancelled
+                        and not self._reservation_fits(self._held.request)):
+                    # still no room — skip the (vocab-row + cache-scan +
+                    # device-read) admission preamble entirely; this runs
+                    # every engine iteration while parked, exactly under
+                    # saturation. A cancelled parked request falls through
+                    # to the cancelled check below and is dropped now —
+                    # it must not keep head-of-line blocking admissions.
+                    return admitted
+                handle, self._held = self._held, None
+            else:
+                handle = self._next_pending()
             if handle is None:
                 return admitted
             if handle.cancelled:
@@ -741,6 +809,18 @@ class Scheduler:
                                         preempted=False)
                 handle._finish("cancelled")
                 continue
+            if not self._reservation_fits(handle.request):
+                # block pool can't cover the reservation yet: park the
+                # request BEFORE the admission preamble (bias row, prompt
+                # cache scan, slot_positions device read) so saturation
+                # costs host arithmetic only. Interactive requests hold
+                # their place (FIFO); a batch request goes back to its own
+                # lane so it can never block interactive admissions.
+                if handle.request.priority >= PRIORITY_BATCH:
+                    self._pending_batch.put(handle)
+                else:
+                    self._held = handle
+                return admitted
             # prefer the free slot whose resident tokens share the longest
             # prefix with this prompt (KV prefix-cache reuse); the loop
             # guard guarantees a free slot exists (slot lists are mutated
@@ -753,7 +833,19 @@ class Scheduler:
             )
             assert slot is not None
             try:
-                self._start(slot, handle, positions)
+                if not self._start(slot, handle, positions):
+                    # block pool can't cover the reservation yet: park the
+                    # request and stop admitting — finishing slots free
+                    # blocks and the loop retries. Interactive requests
+                    # hold their place (FIFO); a batch request goes back
+                    # to its own lane so it can never block interactive
+                    # admissions behind a full pool.
+                    self._engine.release(slot)
+                    if handle.request.priority >= PRIORITY_BATCH:
+                        self._pending_batch.put(handle)
+                    else:
+                        self._held = handle
+                    return admitted
                 admitted = True
             except Exception as e:  # noqa: BLE001 — bad request ≠ dead engine
                 log.warning("admit failed: %s", e)
@@ -764,15 +856,11 @@ class Scheduler:
                 handle._finish("error")
 
     def _start(self, slot: int, handle: GenHandle,
-               positions: Optional[np.ndarray] = None) -> None:
+               positions: Optional[np.ndarray] = None) -> bool:
+        """Admit ``handle`` into ``slot``. Returns False when a paged
+        runner's block pool can't cover the reservation right now — the
+        caller holds the request (nothing was dispatched or stamped)."""
         req = handle.request
-        handle.admit_index = self._admit_seq  # engine thread is sole writer
-        self._admit_seq += 1
-        self.telemetry.admitted(
-            handle.trace, slot=slot,
-            queue_wait=time.monotonic() - handle.t_submit,
-            background=req.priority >= PRIORITY_BATCH,
-        )
         base = self._padded_vocab_ban()
         if req.logit_bias:
             if base is None:
@@ -793,6 +881,11 @@ class Scheduler:
         if positions is None:
             positions = self._engine.slot_positions()
         valid_n = int(positions[slot])
+        rows = getattr(self._engine, "resident_rows", None)
+        if rows is not None:
+            # paged runners free a slot's blocks at release — only rows
+            # just loaded from the disk prompt cache stay reusable
+            valid_n = rows(slot, valid_n)
         if self.prompt_cache is not None and req.mm_embeds is None:
             mem_lcp = (
                 self._engine.reusable_prefix(slot, resident, req.prompt,
@@ -813,9 +906,7 @@ class Scheduler:
                     and self.runner.load_prefix(slot, hit.arrays, hit.n)):
                 resident = hit.tokens
                 valid_n = hit.n  # load_prefix moved the slot's frontier
-        first = self._engine.admit(
-            slot,
-            req.prompt,
+        sampling = dict(
             resident=resident,
             valid_n=valid_n,
             temperature=req.temperature,
@@ -830,11 +921,50 @@ class Scheduler:
             mm_embeds=req.mm_embeds,
             mm_positions=req.mm_positions,
         )
+        if self._chunked:
+            # reserve the worst case so decode can never run out of blocks
+            # mid-flight (preemption-free by construction)
+            reserve = (len(req.prompt) + req.max_new_tokens + 1
+                       if req.max_new_tokens
+                       else len(req.prompt) + self.default_max_tokens + 1)
+            adm = self._engine.begin_admit(
+                slot, req.prompt, reserve_tokens=reserve, **sampling)
+            if adm is None:
+                return False
+            handle.admit_index = self._admit_seq
+            self._admit_seq += 1
+            self.telemetry.admitted(
+                handle.trace, slot=slot,
+                queue_wait=time.monotonic() - handle.t_submit,
+                background=req.priority >= PRIORITY_BATCH,
+            )
+            self._prefills.append(_PendingPrefill(
+                slot=slot, handle=handle, adm=adm, base=base,
+                mask_set=mask is not None,
+            ))
+            return True
+        handle.admit_index = self._admit_seq  # engine thread is sole writer
+        self._admit_seq += 1
+        self.telemetry.admitted(
+            handle.trace, slot=slot,
+            queue_wait=time.monotonic() - handle.t_submit,
+            background=req.priority >= PRIORITY_BATCH,
+        )
+        first = self._engine.admit(slot, req.prompt, **sampling)
         self.telemetry.prefill_done(
             handle.trace,
             path=self.runner.last_prefill_path,
             prefix_reused=self._engine.last_prefix_reused,
         )
+        self._activate_slot(slot, handle, base, mask is not None, int(first))
+        return True
+
+    def _activate_slot(self, slot: int, handle: GenHandle,
+                       base: Optional[np.ndarray], mask_set: bool,
+                       first: int) -> None:
+        """Prefill finished (one-shot or final chunk): record the resident
+        tokens, install the live slot context, consume the first token."""
+        req = handle.request
         # multimodal KV mixes injected embeddings with token ids, so the
         # token record alone can't prove prefix equality — never reuse it.
         # Mirror the runner's empty-prompt normalization ([0]) so the
@@ -848,13 +978,62 @@ class Scheduler:
             detok=IncrementalDetokenizer(self.tokenizer.decode),
             stopper=StopChecker(req.stop),
             base_bias=base,
-            mask_set=mask is not None,
+            mask_set=mask_set,
             admit_seq=self._dispatch_seq,
         )
         with self._lock:
             self._slots[slot] = ctx
             self.total_prompt_tokens += handle.prompt_tokens
-        self._consume(slot, ctx, int(first))
+        self._consume(slot, ctx, first)
+
+    def _reservation_fits(self, req: GenRequest) -> bool:
+        """Host-arithmetic estimate of whether ``req``'s block reservation
+        could be allocated right now (pool availability + pool-shareable
+        prefix). Slightly optimistic — allocate() stays authoritative —
+        so a True merely permits an admission attempt."""
+        alloc = getattr(self.runner, "allocator", None)
+        if alloc is None or not self._chunked:
+            return True
+        reserve = min(
+            self.runner.max_ctx,
+            len(req.prompt) + (req.max_new_tokens
+                               or self.default_max_tokens) + 1,
+        )
+        need = alloc.blocks_for(reserve) - len(alloc.match_prefix(req.prompt))
+        return alloc.stats().available >= need
+
+    def _step_prefill_chunk(self) -> bool:
+        """Dispatch ONE pending prefill chunk (FIFO across admissions) and
+        finalize the admission on its final chunk. Returns True if a chunk
+        was dispatched. The flight record tags these dispatches as
+        ``prefill_chunk`` with steps=0, keeping them out of the decode
+        step-time percentiles while /debug/flight still shows them."""
+        if not self._prefills:
+            return False
+        pf = self._prefills[0]
+        if pf.handle.cancelled:
+            self._prefills.popleft()
+            pf.adm.abort()   # frees the blocks, slot returns to free list
+            with self._lock:
+                self.total_preemptions += 1
+            self.telemetry.finished(pf.handle.trace, pf.handle, "cancelled")
+            pf.handle._finish("cancelled")
+            return True
+        t0 = time.monotonic()
+        first = pf.adm.step_chunk()
+        dt = time.monotonic() - t0
+        self.total_prefill_chunks += 1
+        self._flight_record("prefill_chunk", 0, dt, False)
+        if first is None:
+            return True
+        self._prefills.popleft()
+        self.telemetry.prefill_done(
+            pf.handle.trace,
+            path=getattr(pf.adm, "path", "paged"),
+            prefix_reused=pf.adm.prefix_reused,
+        )
+        self._activate_slot(pf.slot, pf.handle, pf.base, pf.mask_set, first)
+        return True
 
     def _best_slot(self, prompt: list[int],
                    positions: Optional[np.ndarray] = None) -> Optional[int]:

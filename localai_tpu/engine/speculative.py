@@ -107,6 +107,10 @@ class SpecDecoder:
             )
         if draft.num_slots != target.num_slots:
             raise ValueError("draft and target must have equal slot counts")
+        if getattr(target, "paged", False) or getattr(draft, "paged", False):
+            raise ValueError(
+                "speculative decoding requires contiguous KV caches "
+                "(build the runners with paged=False)")
         self.target = target
         self.draft = draft
         self.gamma = int(gamma)
@@ -357,5 +361,7 @@ def build_spec_decoder(target: ModelRunner, draft_ref: str, *,
         prefill_buckets=list(target.buckets[:-1]) or None,
         kv_dtype=target.kv_dtype,
         mesh=target.mesh,
+        # spec windows run contiguous slot-row KV programs on both caches
+        paged=False,
     )
     return SpecDecoder(target, runner, gamma=gamma)

@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import dataclasses
 import logging
+import os
 import threading
 import time
 from typing import Any, Optional
@@ -362,6 +363,19 @@ def build_runner(mcfg: ModelConfig, app: AppConfig) -> tuple[Any, ModelRunner]:
     # self-extend lifts the trained-context ceiling by the group factor
     # (llama.cpp: n_ctx >= n_ctx_train * ga_n, grpc-server.cpp:535)
     ctx = min(ctx, model.cfg.max_position_embeddings * max(eng.grp_attn_n, 1))
+    # paged KV (block pool + chunked prefill): the serving default whenever
+    # the engine is a plain single-device runner — speculative decoding and
+    # multi-host mirroring still drive the contiguous layout, and the
+    # runner itself gates off mesh/self-extend. Explicit per-model config
+    # wins; otherwise the compatibility decision applies and
+    # LOCALAI_KV_PAGED=0 force-disables (=1 adds nothing here: auto
+    # already enables everything compatible, and overriding the
+    # draft/mirror exclusions would crash those engines at load).
+    paged = eng.kv_paged
+    if paged is None:
+        paged = (mesh is None and eng.grp_attn_n <= 1
+                 and not eng.draft_model and not app.mirror_port
+                 and os.environ.get("LOCALAI_KV_PAGED", "") != "0")
     runner = ModelRunner(
         model.cfg,
         params,
@@ -377,6 +391,10 @@ def build_runner(mcfg: ModelConfig, app: AppConfig) -> tuple[Any, ModelRunner]:
         attn_impl=eng.attn_impl,
         ga_n=eng.grp_attn_n,
         ga_w=eng.grp_attn_w,
+        paged=paged,
+        kv_block_tokens=eng.kv_block_tokens,
+        kv_num_blocks=eng.kv_num_blocks,
+        prefill_chunk=eng.prefill_chunk,
     )
     return model, runner
 

@@ -180,6 +180,31 @@ class Registry:
             "localai_kv_slot_utilization",
             "Fraction of KV-cache rows holding live context",
         )
+        # -- paged KV cache (engine/paged.py block pool) -------------------
+        self.kv_blocks_free = Gauge(
+            "localai_kv_blocks_free",
+            "Paged-KV blocks available for admission (immediately free + "
+            "reclaimable prefix-pool cache)",
+        )
+        self.kv_blocks_used = Gauge(
+            "localai_kv_blocks_used",
+            "Paged-KV blocks referenced by live sequences (reservations "
+            "included)",
+        )
+        self.kv_blocks_cached = Gauge(
+            "localai_kv_blocks_cached",
+            "Paged-KV blocks held only by the prefix-sharing pool "
+            "(evicted on demand)",
+        )
+        self.prefill_chunk_queue = Gauge(
+            "localai_prefill_chunk_queue_depth",
+            "Prompt chunks queued behind the chunked-prefill lane "
+            "(dispatched one per engine iteration, interleaved with decode)",
+        )
+        self.prefill_chunks = Counter(
+            "localai_prefill_chunks_total",
+            "Chunked-prefill dispatches issued by the engine thread",
+        )
         self.decode_dispatches = Counter(
             "localai_decode_dispatches_total",
             "Compiled decode programs dispatched by the engine thread",
@@ -335,6 +360,13 @@ def update_engine_gauges(name: str, m: dict,
         reg.batch_queue_depth.set(m["batch_queue_depth"], model=name)
     if "kv_utilization" in m:
         reg.kv_utilization.set(m["kv_utilization"], model=name)
+    if "kv_blocks_total" in m:  # paged KV engines only
+        reg.kv_blocks_free.set(m.get("kv_blocks_free", 0), model=name)
+        reg.kv_blocks_used.set(m.get("kv_blocks_used", 0), model=name)
+        reg.kv_blocks_cached.set(m.get("kv_blocks_cached", 0), model=name)
+        reg.prefill_chunk_queue.set(
+            m.get("prefill_chunk_queue_depth", 0), model=name)
+        reg.prefill_chunks.set_total(m.get("prefill_chunks", 0), model=name)
     reg.decode_dispatches.set_total(m.get("dispatches", 0), model=name)
     reg.prefix_reused.set_total(m.get("prefix_tokens_reused", 0), model=name)
     pc = m.get("prompt_cache")

@@ -15,12 +15,20 @@ import os
 
 import jax
 
-from localai_tpu.ops.attention import decode_attention, prefill_attention
+from localai_tpu.ops.attention import (
+    decode_attention,
+    paged_decode_attention,
+    paged_decode_attention_ref,
+    prefill_attention,
+)
 
 __all__ = [
     "decode_attention",
+    "paged_decode_attention",
+    "paged_decode_attention_ref",
     "prefill_attention",
     "resolve_attn_impl",
+    "select_paged_attn_impl",
 ]
 
 
@@ -67,4 +75,42 @@ def select_attn_impl(requested: str, *, num_heads: int, num_kv_heads: int,
         # debug models, hd-64 families) take the XLA path on real TPU
         return "xla", False, (
             f"head_dim={head_dim} ctx={max_ctx} not 128-aligned")
+    return impl, interpret, ""
+
+
+def select_paged_attn_impl(requested: str, *, num_heads: int,
+                           num_kv_heads: int, head_dim: int,
+                           block_tokens: int,
+                           backend: str | None = None
+                           ) -> tuple[str, bool, str]:
+    """Attention-impl decision for the PAGED decode path (the paged analogue
+    of ``select_attn_impl``). Returns (impl, interpret, reason).
+
+    The Pallas paged kernel DMAs one [block_tokens, head_dim] physical
+    block per online-softmax step, so on hardware it needs Mosaic-tileable
+    blocks: head_dim 128-aligned and block_tokens covering the dtype's
+    sublane minimum (32 covers int8, the narrowest pool dtype). The
+    ``gather + XLA`` fallback (ops.paged_decode_attention_ref wired through
+    the paged write policies) has no shape constraints and is the CPU/test
+    path. Override with ``LOCALAI_PAGED_ATTN_IMPL``.
+    """
+    backend = backend or jax.default_backend()
+    impl = requested
+    if impl in ("auto", ""):
+        impl = os.environ.get("LOCALAI_PAGED_ATTN_IMPL", "") or "auto"
+    if impl in ("auto", ""):
+        impl = "pallas" if backend == "tpu" else "xla"
+    if impl == "pallas_interpret":
+        return "pallas", True, ""
+    if impl not in ("pallas", "xla"):
+        raise ValueError(f"unknown paged attention impl {impl!r}")
+    interpret = impl == "pallas" and backend != "tpu"
+    if impl == "pallas" and not interpret:
+        if head_dim % 128 or block_tokens % 32:
+            return "xla", False, (
+                f"head_dim={head_dim} block_tokens={block_tokens} not "
+                f"Mosaic-tileable (need hd%128==0, bt%32==0)")
+        if num_heads % num_kv_heads:
+            return "xla", False, (
+                f"heads ({num_heads} q / {num_kv_heads} kv) not grouped")
     return impl, interpret, ""

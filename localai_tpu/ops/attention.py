@@ -63,7 +63,7 @@ def _pick_block_aligned(total: int, target: int) -> int:
 
 
 def _flash_loop(q, kv_slice, kbuf, vbuf, ksem, vsem, lo, nb, block_k,
-                mask_for_block, scales=None):
+                mask_for_block, scales=None, scale_dma=None):
     """Online-softmax loop over KV blocks [lo, nb) with double-buffered DMA.
 
     q: [rows, hd] f32 (pre-scaled). ``kv_slice(hbm_ref, i)`` yields the
@@ -77,20 +77,38 @@ def _flash_loop(q, kv_slice, kbuf, vbuf, ksem, vsem, lo, nb, block_k,
     the score matmul columns (q·(k·s) = (q·k)·s) and V scales over the
     probability columns (p@(v·s) = (p·s)@v), so both apply as [1, block_k]
     row multiplies on the VPU while the MXU matmuls stay int8-sourced.
+
+    ``scale_dma`` is the paged-kernel variant of ``scales``: scale rows
+    live per-block in HBM (pool layout, no per-head VMEM residency), so
+    they ride the same double-buffered DMA as K/V. A tuple
+    (ks_hbm(i), vs_hbm(i), ksbuf, vsbuf, kssem, vssem) — block i's [1,
+    block_k] HBM slices plus their [2, 1, block_k] scratch and semaphores.
+    Mutually exclusive with ``scales``.
     """
     k_hbm, v_hbm = kv_slice
     rows, hd = q.shape
-    quantized = scales is not None
-    if quantized:
+    if scales is not None:
         ks_block, vs_block = scales
+    if scale_dma is not None:
+        ks_hbm, vs_hbm, ksbuf, vsbuf, kssem, vssem = scale_dma
 
     def start(i, slot):
         pltpu.make_async_copy(k_hbm(i), kbuf.at[slot], ksem.at[slot]).start()
         pltpu.make_async_copy(v_hbm(i), vbuf.at[slot], vsem.at[slot]).start()
+        if scale_dma is not None:
+            pltpu.make_async_copy(
+                ks_hbm(i), ksbuf.at[slot], kssem.at[slot]).start()
+            pltpu.make_async_copy(
+                vs_hbm(i), vsbuf.at[slot], vssem.at[slot]).start()
 
     def wait(i, slot):
         pltpu.make_async_copy(k_hbm(i), kbuf.at[slot], ksem.at[slot]).wait()
         pltpu.make_async_copy(v_hbm(i), vbuf.at[slot], vsem.at[slot]).wait()
+        if scale_dma is not None:
+            pltpu.make_async_copy(
+                ks_hbm(i), ksbuf.at[slot], kssem.at[slot]).wait()
+            pltpu.make_async_copy(
+                vs_hbm(i), vsbuf.at[slot], vssem.at[slot]).wait()
 
     start(lo, 0)
 
@@ -106,8 +124,10 @@ def _flash_loop(q, kv_slice, kbuf, vbuf, ksem, vsem, lo, nb, block_k,
         k = kbuf[slot].astype(jnp.float32)
         v = vbuf[slot].astype(jnp.float32)
         s = q @ k.T  # [rows, block_k] — MXU
-        if quantized:
+        if scales is not None:
             s = s * ks_block(i)[None, :]
+        elif scale_dma is not None:
+            s = s * ksbuf[slot]
         s = jnp.where(mask_for_block(i), s, _NEG_INF)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)
@@ -115,8 +135,10 @@ def _flash_loop(q, kv_slice, kbuf, vbuf, ksem, vsem, lo, nb, block_k,
         # denominator sums the raw probabilities; V scales touch only the
         # weighted-value numerator
         l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
-        if quantized:
+        if scales is not None:
             p = p * vs_block(i)[None, :]
+        elif scale_dma is not None:
+            p = p * vsbuf[slot]
         acc_new = acc * alpha + p @ v
         return m_new, l_new, acc_new
 
@@ -326,3 +348,173 @@ def prefill_attention(
         interpret=interpret,
     )(jnp.reshape(length, (1,)).astype(jnp.int32), qg, k, v)
     return out.reshape(T, Hq, hd)
+
+
+# ---------------------------------------------------------------------------
+# paged decode: one token per slot over a block pool via block tables
+# ---------------------------------------------------------------------------
+
+
+def gather_blocks(cache: jax.Array, tables: jax.Array) -> jax.Array:
+    """[N, H, bt, hd] block pool + [S, MB] i32 tables -> [S, H, MB*bt, hd]
+    logical context rows — THE pool-gather used by the pure-lax paged
+    attention path and the paged KV write policies (engine.kvcache)."""
+    S, MB = tables.shape
+    _, H, bt, hd = cache.shape
+    g = cache[tables]                              # [S, MB, H, bt, hd]
+    return g.transpose(0, 2, 1, 3, 4).reshape(S, H, MB * bt, hd)
+
+
+def gather_block_scales(scales: jax.Array, tables: jax.Array) -> jax.Array:
+    """[N, H, bt] scale pool + [S, MB] tables -> [S, H, MB*bt]."""
+    S, MB = tables.shape
+    _, H, bt = scales.shape
+    g = scales[tables]                             # [S, MB, H, bt]
+    return g.transpose(0, 2, 1, 3).reshape(S, H, MB * bt)
+
+
+def _paged_decode_kernel(pos_ref, tbl_ref, q_ref, k_ref, v_ref, *rest,
+                         block_tokens: int, sm_scale: float,
+                         sliding_window: Optional[int], quantized: bool):
+    # k_ref/v_ref are the FULL [N, Hkv, bt, hd] block pool in HBM; the
+    # block walked at loop step i is tbl_ref[slot, i] (SMEM block table),
+    # so the DMA gathers physically-scattered blocks in logical order.
+    # Scale rows ([N, Hkv, bt] f32 for int8 pools) are per-block in HBM
+    # and ride the same double-buffered DMA (scale_dma in _flash_loop).
+    if quantized:
+        (ks_ref, vs_ref, o_ref, kbuf, vbuf, ksbuf, vsbuf,
+         ksem, vsem, kssem, vssem) = rest
+    else:
+        o_ref, kbuf, vbuf, ksem, vsem = rest
+    s_idx = pl.program_id(0)
+    h_idx = pl.program_id(1)
+    pos = pos_ref[s_idx]
+    q = q_ref[0, 0].astype(jnp.float32) * sm_scale  # [g, hd]
+    bt = block_tokens
+
+    nb = jnp.minimum(pos // bt + 1, tbl_ref.shape[1])
+    lo = jnp.int32(0)
+    if sliding_window is not None:
+        lo = jnp.maximum((pos - sliding_window + 1) // bt, 0)
+
+    def slice_of(ref):
+        return lambda i: ref.at[tbl_ref[s_idx, i], h_idx]
+
+    def mask_for_block(i):
+        idx = i * bt + lax.broadcasted_iota(jnp.int32, (1, bt), 1)
+        keep = idx <= pos
+        if sliding_window is not None:
+            keep &= idx > pos - sliding_window
+        return keep
+
+    def scale_slice_of(ref):
+        # keep the head axis as a size-1 slice so src/dst ranks match the
+        # [1, bt] scratch rows (and the DMA stays 2-D for Mosaic tiling)
+        return lambda i: ref.at[tbl_ref[s_idx, i], pl.ds(h_idx, 1)]
+
+    scale_dma = None
+    if quantized:
+        scale_dma = (scale_slice_of(ks_ref), scale_slice_of(vs_ref),
+                     ksbuf, vsbuf, kssem, vssem)
+    out = _flash_loop(q, (slice_of(k_ref), slice_of(v_ref)),
+                      kbuf, vbuf, ksem, vsem, lo, nb, bt, mask_for_block,
+                      scale_dma=scale_dma)
+    o_ref[0, 0] = out.astype(o_ref.dtype)
+
+
+def paged_decode_attention(
+    q: jax.Array,            # [S, Hq, hd]
+    k_cache: jax.Array,      # [N, Hkv, bt, hd] block pool
+    v_cache: jax.Array,      # [N, Hkv, bt, hd]
+    tables: jax.Array,       # [S, MB] i32 per-slot block tables
+    positions: jax.Array,    # [S] i32 — current token's KV write position
+    k_scale: Optional[jax.Array] = None,  # [N, Hkv, bt] f32 (scaled-int8)
+    v_scale: Optional[jax.Array] = None,
+    *,
+    sliding_window: Optional[int] = None,
+    interpret: bool = False,
+) -> jax.Array:
+    """Flash GQA decode attention over a paged block pool. Returns
+    [S, Hq, hd]. The kernel walks each slot's block table in SMEM and
+    DMAs one [bt, hd] physical block per online-softmax step — identical
+    math to ``decode_attention``, with the contiguous slot row replaced
+    by gather-over-block-table."""
+    S, Hq, hd = q.shape
+    Hkv, bt = k_cache.shape[1], k_cache.shape[2]
+    MB = tables.shape[1]
+    g = Hq // Hkv
+    qg = q.reshape(S, Hkv, g, hd)
+    quantized = k_scale is not None
+
+    kernel = functools.partial(
+        _paged_decode_kernel, block_tokens=bt, sm_scale=hd ** -0.5,
+        sliding_window=sliding_window, quantized=quantized,
+    )
+    in_specs = [
+        pl.BlockSpec((S,), lambda s, h: (0,), memory_space=pltpu.SMEM),
+        pl.BlockSpec((S, MB), lambda s, h: (0, 0), memory_space=pltpu.SMEM),
+        pl.BlockSpec((1, 1, g, hd), lambda s, h: (s, h, 0, 0)),
+        # the pool stays whole in HBM; blocks are gathered by table DMA
+        pl.BlockSpec(memory_space=pl.ANY),
+        pl.BlockSpec(memory_space=pl.ANY),
+    ]
+    args = [positions.astype(jnp.int32), tables.astype(jnp.int32), qg,
+            k_cache, v_cache]
+    scratch = [
+        pltpu.VMEM((2, bt, hd), k_cache.dtype),
+        pltpu.VMEM((2, bt, hd), v_cache.dtype),
+    ]
+    if quantized:
+        in_specs += [pl.BlockSpec(memory_space=pl.ANY),
+                     pl.BlockSpec(memory_space=pl.ANY)]
+        args += [k_scale, v_scale]
+        scratch += [pltpu.VMEM((2, 1, bt), jnp.float32),
+                    pltpu.VMEM((2, 1, bt), jnp.float32)]
+    scratch += [pltpu.SemaphoreType.DMA((2,))] * (4 if quantized else 2)
+    out = pl.pallas_call(
+        kernel,
+        grid=(S, Hkv),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, 1, g, hd), lambda s, h: (s, h, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((S, Hkv, g, hd), q.dtype),
+        scratch_shapes=scratch,
+        interpret=interpret,
+    )(*args)
+    return out.reshape(S, Hq, hd)
+
+
+def paged_decode_attention_ref(
+    q: jax.Array,            # [S, Hq, hd]
+    k_cache: jax.Array,      # [N, Hkv, bt, hd]
+    v_cache: jax.Array,
+    tables: jax.Array,       # [S, MB] i32
+    positions: jax.Array,    # [S]
+    k_scale: Optional[jax.Array] = None,
+    v_scale: Optional[jax.Array] = None,
+    *,
+    sliding_window: Optional[int] = None,
+) -> jax.Array:
+    """Pure-lax paged decode attention (gather + masked softmax): the CPU
+    fallback and the numerical reference the Pallas kernel is tested
+    against. Returns [S, Hq, hd]."""
+    S, Hq, hd = q.shape
+    Hkv, bt = k_cache.shape[1], k_cache.shape[2]
+    MB = tables.shape[1]
+    g = Hq // Hkv
+
+    keys = gather_blocks(k_cache, tables).astype(jnp.float32)
+    values = gather_blocks(v_cache, tables).astype(jnp.float32)
+    if k_scale is not None:
+        keys = keys * gather_block_scales(k_scale, tables)[..., None]
+        values = values * gather_block_scales(v_scale, tables)[..., None]
+    qg = q.reshape(S, Hkv, g, hd).astype(jnp.float32) * hd ** -0.5
+    scores = jnp.einsum("skgh,sklh->skgl", qg, keys)
+    idx = jnp.arange(MB * bt)[None, None, None, :]
+    pos = positions[:, None, None, None]
+    keep = idx <= pos
+    if sliding_window is not None:
+        keep &= idx > pos - sliding_window
+    scores = jnp.where(keep, scores, _NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("skgl,sklh->skgh", probs, values)
+    return out.reshape(S, Hq, hd).astype(q.dtype)

@@ -638,9 +638,12 @@ def test_debug_programs_reports_cost_and_roofline_fraction(client):
     assert withfrac[0]["bandwidth_fraction"] >= 0
     # filter to live instances: the backend-shutdown test earlier in this
     # module unloads/reloads the model, leaving dead catalog entries
-    # (cost_error="program no longer live") next to the live ones
+    # (cost_error="program no longer live") next to the live ones.
+    # Paged engines (the serving default) compile their prefill under the
+    # chunked-prefill label; contiguous engines under "prefill".
     prefill = [p for p in programs
-               if p["program"] == "prefill" and p.get("flops")]
+               if p["program"] in ("prefill", "prefill_chunk")
+               and p.get("flops")]
     assert prefill and prefill[0]["flops"] > 0
 
 

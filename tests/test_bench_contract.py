@@ -1,0 +1,64 @@
+"""Regression tests for bench.py's one-JSON-line contract.
+
+BENCH r03 crashed with rc=1 and NO metric line: the first eager device
+dispatch — a ``convert_element_type`` cast on the quantized/bf16 boundary
+inside synthetic weight generation — exploded on an unavailable backend
+before any guard existed, and the traceback escaped the process. The
+contract under test: **bench.py always exits 0 and always prints exactly
+one JSON line**, with the failure diagnosed in ``note``/``device_health``
+instead of a traceback. The quantized decode path itself (weight-gen →
+int8-KV runner → batched decode, the chain the r03 cast sat on) is pinned
+by an in-process CPU run.
+"""
+
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def test_bench_prints_one_json_line_on_dead_backend():
+    """The r03 failure shape: first dispatch raises on backend init."""
+    env = dict(os.environ)
+    env.update({
+        # an unavailable platform whose init fails fast (no GPU plugin
+        # here) — the same class of failure as r03's dead axon tunnel
+        "BENCH_PLATFORM": "cuda",
+        "JAX_PLATFORMS": "",
+        "BENCH_BUDGET_S": "90",
+        "BENCH_PROBE_TIMEOUT_S": "20",
+        "BENCH_STALL_S": "30",
+        "BENCH_COMPILE_CACHE": "0",
+        "BENCH_WEIGHT_CACHE": "0",
+    })
+    out = subprocess.run(
+        [sys.executable, "bench.py"], cwd=REPO, env=env,
+        capture_output=True, text=True, timeout=150,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    lines = [ln for ln in out.stdout.splitlines() if ln.strip()]
+    assert len(lines) == 1, out.stdout
+    row = json.loads(lines[0])
+    assert row["value"] == 0.0
+    assert row["unit"] == "tok/s"
+    # the probe must have diagnosed the dead backend, not burned budget
+    assert row.get("note"), row
+    health = row.get("device_health", {})
+    assert health.get("ok") is False, row
+
+
+def test_bench_quantized_decode_path_runs_on_cpu():
+    """The exact chain r03 died on — synthetic int8 weight generation into
+    a bf16-compute, int8-KV runner, then batched decode — must run clean
+    (dtype boundaries included) on the CPU backend."""
+    sys.path.insert(0, str(REPO))
+    import bench
+
+    tok_s = bench.run_decode_bench(
+        "tiny", "int8", steps=2, multi=1, depth=1,
+        num_slots=2, max_ctx=256,
+    )
+    assert tok_s > 0

@@ -1,0 +1,403 @@
+"""Paged KV cache tests: block allocator, prefix-block sharing, paged
+attention parity vs the contiguous path, chunked-prefill scheduling, and
+pool-exhaustion admission control. All on the CPU backend (the Pallas
+paged kernel runs in interpret mode)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from localai_tpu import ops
+from localai_tpu.engine.paged import BlockAllocator
+from localai_tpu.engine.runner import ModelRunner
+from localai_tpu.engine.scheduler import GenRequest, Scheduler
+from localai_tpu.models.registry import resolve_model
+from localai_tpu.obs.flight import FlightRecorder
+from localai_tpu.utils.tokenizer import ByteTokenizer
+
+
+# ---------------------------------------------------------------------------
+# BlockAllocator (host bookkeeping)
+# ---------------------------------------------------------------------------
+
+
+def test_allocator_alloc_free_accounting():
+    a = BlockAllocator(num_blocks=9, block_tokens=4, max_blocks_per_seq=8)
+    st = a.stats()
+    assert st.total == 8 and st.free == 8 and st.used == 0
+
+    assert a.allocate(0, tokens=10) == 0          # 3 blocks, no sharing
+    assert a.allocate(1, tokens=4) == 0           # 1 block
+    st = a.stats()
+    assert st.used == 4 and st.free == 4
+    assert len(a.tables[0]) == 3 and len(a.tables[1]) == 1
+    assert 0 not in a.tables[0] + a.tables[1]     # trash block never handed out
+
+    a.release(0)
+    a.release(1)
+    st = a.stats()
+    # no pool registration happened — everything returns to the free list
+    assert st.free == 8 and st.used == 0 and st.cached == 0
+
+    # interleaved alloc/free must never leak or double-free blocks
+    # (paging has no external fragmentation; accounting is the invariant)
+    rng = np.random.default_rng(0)
+    live = {}
+    for i in range(200):
+        if live and rng.random() < 0.5:
+            seq = rng.choice(list(live))
+            a.release(int(seq))
+            del live[seq]
+        else:
+            seq = 100 + i
+            if a.allocate(seq, tokens=int(rng.integers(1, 20))) is not None:
+                live[seq] = True
+    for seq in live:
+        a.release(int(seq))
+    st = a.stats()
+    assert st.free == 8 and st.used == 0
+
+
+def test_allocator_exhaustion_and_extend():
+    a = BlockAllocator(num_blocks=5, block_tokens=4, max_blocks_per_seq=4)
+    assert a.allocate(0, tokens=12) == 0          # 3 of 4 blocks
+    assert a.allocate(1, tokens=8) is None        # needs 2, only 1 free
+    assert a.allocate(1, tokens=4) == 0
+    assert not a.extend(0, tokens=16)             # no blocks left
+    a.release(1)
+    assert a.extend(0, tokens=16)
+    assert len(a.tables[0]) == 4
+
+
+def test_allocator_prefix_sharing_and_refcounts():
+    a = BlockAllocator(num_blocks=17, block_tokens=4, max_blocks_per_seq=8)
+    prompt = list(range(100, 111))                # 11 tokens → 2 full blocks
+    assert a.allocate(0, tokens=16, prompt=prompt) == 0
+    assert a.register_prefix(0, prompt) == 2
+    st = a.stats()
+    assert st.cached == 0                         # cached but still referenced
+    shared_blocks = a.tables[0][:2]
+
+    # a second sequence with the same prompt shares both full blocks
+    assert a.allocate(1, tokens=16, prompt=prompt) == 8
+    assert a.tables[1][:2] == shared_blocks
+    assert a.shared_blocks[1] == 2
+
+    # diverging prompt shares only the first block
+    div = prompt[:6] + [999, 998, 997, 996, 995]
+    assert a.allocate(2, tokens=16, prompt=div) == 4
+    assert a.tables[2][0] == shared_blocks[0]
+    assert a.tables[2][1] not in shared_blocks
+
+    a.release(0)
+    a.release(1)
+    a.release(2)
+    st = a.stats()
+    assert st.cached == 2                         # pool keeps the prefix
+    assert st.used == 0
+
+    # pool-cached blocks are reclaimed under pressure (LRU eviction)
+    assert a.allocate(3, tokens=16 * 4) == 0      # forces eviction
+    assert a.evictions_total >= 1
+
+
+def test_allocator_eviction_never_steals_matched_shared_block():
+    """A pool-only (ref==1) block matched as shared prefix for the very
+    allocation being built must not be picked as an LRU eviction victim —
+    it would land in the table twice (read-only AND writable)."""
+    a = BlockAllocator(num_blocks=6, block_tokens=4, max_blocks_per_seq=8)
+    pa = list(range(10, 18))                     # prompt A: 1 cacheable block
+    pb = list(range(50, 58))                     # prompt B: 1 cacheable block
+    a.allocate(0, tokens=8, prompt=pa)
+    a.register_prefix(0, pa)
+    a.allocate(1, tokens=8, prompt=pb)
+    a.register_prefix(1, pb)
+    blk_a = a.tables[0][0]
+    blk_b = a.tables[1][0]
+    a.release(0)
+    a.release(1)
+    st = a.stats()
+    assert st.cached == 2 and st.free == 3
+
+    # needs 5 blocks: 1 shared (A's cached block, LRU-oldest) + 4 fresh —
+    # only 3 free, so one eviction must fire and it must pick B's block
+    shared = a.allocate(2, tokens=20, prompt=pa)
+    assert shared == 4
+    table = a.tables[2]
+    assert table[0] == blk_a
+    assert table.count(blk_a) == 1, "shared block was also handed out fresh"
+    assert blk_b in table[1:]                    # B's block was the victim
+    assert a.evictions_total == 1
+    a.release(2)
+    st = a.stats()
+    assert st.used == 0 and st.free + st.cached == 5
+
+
+def test_allocator_never_shares_final_prompt_token_block():
+    a = BlockAllocator(num_blocks=9, block_tokens=4, max_blocks_per_seq=8)
+    prompt = list(range(8))                       # exactly 2 blocks
+    a.allocate(0, tokens=12, prompt=prompt)
+    a.register_prefix(0, prompt)
+    # (n-1)//bt = 1: the block holding the final token is never shared —
+    # its logits must be recomputed to seed sampling
+    assert a.match_prefix(prompt) == a.tables[0][:1]
+
+
+# ---------------------------------------------------------------------------
+# paged attention parity (the acceptance-criteria check)
+# ---------------------------------------------------------------------------
+
+
+def test_paged_attention_matches_contiguous_two_lengths():
+    """Two sequences at different lengths sharing one block pool: paged
+    decode attention (lax reference AND Pallas interpret kernel) must
+    match the contiguous flash/XLA path to <= 1e-2."""
+    rng = np.random.default_rng(7)
+    S, Hq, Hkv, hd, bt, MB = 2, 8, 4, 32, 16, 4
+    max_ctx = MB * bt
+    N = S * MB + 1
+    positions = jnp.asarray([13, 55], jnp.int32)   # different lengths
+
+    q = jnp.asarray(rng.normal(size=(S, Hq, hd)), jnp.float32)
+    pool_k = jnp.asarray(rng.normal(size=(N, Hkv, bt, hd)), jnp.float32)
+    pool_v = jnp.asarray(rng.normal(size=(N, Hkv, bt, hd)), jnp.float32)
+    # interleaved physical blocks: slot 0 and 1 alternate through the pool
+    tables = jnp.asarray([[1, 3, 5, 7], [2, 4, 6, 8]], jnp.int32)
+
+    # contiguous mirror of the same logical rows
+    contig_k = np.zeros((S, Hkv, max_ctx, hd), np.float32)
+    contig_v = np.zeros((S, Hkv, max_ctx, hd), np.float32)
+    for s in range(S):
+        for b in range(MB):
+            blk_k = np.asarray(pool_k[int(tables[s, b])])  # [H, bt, hd]
+            blk_v = np.asarray(pool_v[int(tables[s, b])])
+            contig_k[s, :, b * bt:(b + 1) * bt] = blk_k
+            contig_v[s, :, b * bt:(b + 1) * bt] = blk_v
+
+    ref_contig = ops.decode_attention(
+        q, jnp.asarray(contig_k), jnp.asarray(contig_v), positions,
+        interpret=True)
+    out_lax = ops.paged_decode_attention_ref(
+        q, pool_k, pool_v, tables, positions)
+    out_pallas = ops.paged_decode_attention(
+        q, pool_k, pool_v, tables, positions, interpret=True)
+    assert float(jnp.max(jnp.abs(out_lax - ref_contig))) <= 1e-2
+    assert float(jnp.max(jnp.abs(out_pallas - ref_contig))) <= 1e-2
+
+
+def test_paged_runner_matches_contiguous_greedy():
+    """End-to-end engine parity: same weights, two prompts of different
+    lengths sharing the paged pool — greedy decode must match the
+    contiguous runner token-for-token."""
+    tiny = resolve_model("debug:tiny", dtype="float32")
+    rc = ModelRunner(tiny.cfg, tiny.params, num_slots=4, max_ctx=96,
+                     prefill_buckets=[16, 32], kv_dtype="float32")
+    rp = ModelRunner(tiny.cfg, tiny.params, num_slots=4, max_ctx=96,
+                     prefill_buckets=[16, 32], kv_dtype="float32",
+                     paged=True, kv_block_tokens=16, prefill_chunk=16)
+    assert rp.paged
+    pa = list(b"the quick brown fox jumps over the dog")  # chunked: 3 chunks
+    pb = list(b"hi")
+    seqs = {}
+    for name, r in (("contig", rc), ("paged", rp)):
+        s1 = r.acquire_slot()
+        t1 = r.admit(s1, pa, temperature=0.0)
+        s2 = r.acquire_slot()
+        t2 = r.admit(s2, pb, temperature=0.0)
+        a, b = [t1], [t2]
+        for _ in range(8):
+            toks = r.step()
+            a.append(int(toks[s1]))
+            b.append(int(toks[s2]))
+        seqs[name] = (a, b)
+    assert seqs["paged"] == seqs["contig"]
+
+
+def test_paged_runner_pallas_kernel_matches_xla_end_to_end():
+    """The Pallas paged-decode kernel (interpret mode on CPU) wired
+    through the runner must reproduce the gather+XLA paged path."""
+    tiny = resolve_model("debug:tiny", dtype="float32")
+    outs = {}
+    for impl in ("xla", "pallas_interpret"):
+        r = ModelRunner(tiny.cfg, tiny.params, num_slots=2, max_ctx=64,
+                        prefill_buckets=[16], kv_dtype="float32",
+                        paged=True, kv_block_tokens=16, prefill_chunk=16,
+                        attn_impl=impl)
+        assert r.paged_attn_impl == ("pallas" if impl != "xla" else "xla")
+        s = r.acquire_slot()
+        t = r.admit(s, list(b"kernel parity"), temperature=0.0)
+        outs[impl] = [t] + [int(r.step()[s]) for _ in range(6)]
+    assert outs["pallas_interpret"] == outs["xla"]
+
+
+def test_paged_runner_int8_kv_matches_contiguous():
+    """Scaled-int8 pool: paged quantized decode must track the contiguous
+    quantized path (identical quantization grid → identical tokens)."""
+    tiny = resolve_model("debug:tiny", dtype="float32")
+    rc = ModelRunner(tiny.cfg, tiny.params, num_slots=2, max_ctx=64,
+                     prefill_buckets=[16], kv_dtype="int8")
+    rp = ModelRunner(tiny.cfg, tiny.params, num_slots=2, max_ctx=64,
+                     prefill_buckets=[16], kv_dtype="int8",
+                     paged=True, kv_block_tokens=16, prefill_chunk=16)
+    prompt = list(b"quantized kv")
+    outs = {}
+    for name, r in (("contig", rc), ("paged", rp)):
+        s = r.acquire_slot()
+        t = r.admit(s, prompt, temperature=0.0)
+        outs[name] = [t] + [int(r.step()[s]) for _ in range(6)]
+    assert outs["paged"] == outs["contig"]
+
+
+def test_paged_prefix_pool_reuse_preserves_output():
+    """Pool-shared prefix blocks must not change greedy output, and the
+    second admission must actually reuse blocks."""
+    tiny = resolve_model("debug:tiny", dtype="float32")
+    r = ModelRunner(tiny.cfg, tiny.params, num_slots=2, max_ctx=96,
+                    prefill_buckets=[16, 32], kv_dtype="float32",
+                    paged=True, kv_block_tokens=16, prefill_chunk=16)
+    prompt = list(b"shared system prompt here plus tail")
+    s = r.acquire_slot()
+    first = [r.admit(s, prompt, temperature=0.0)]
+    first += [int(r.step()[s]) for _ in range(5)]
+    r.release(s)
+    assert r.allocator.stats().cached > 0
+
+    s2 = r.acquire_slot()
+    second = [r.admit(s2, prompt, temperature=0.0)]
+    assert r.last_prefix_reused >= r.block_tokens
+    assert r.last_prefill_path == "paged_shared"
+    second += [int(r.step()[s2]) for _ in range(5)]
+    assert second == first
+
+
+# ---------------------------------------------------------------------------
+# chunked prefill scheduling + admission control
+# ---------------------------------------------------------------------------
+
+
+def _paged_sched(tiny, flight=None, **kw):
+    runner = ModelRunner(tiny.cfg, tiny.params, num_slots=2, max_ctx=96,
+                         prefill_buckets=[16, 32], kv_dtype="float32",
+                         paged=True, kv_block_tokens=16, prefill_chunk=16,
+                         **kw)
+    return Scheduler(runner, ByteTokenizer(), flight=flight)
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    return resolve_model("debug:tiny", dtype="float32")
+
+
+def test_chunked_prefill_interleaves_with_decode(tiny):
+    """A long prompt's chunks must not stall an active slot: decode
+    dispatches appear BETWEEN its prefill_chunk dispatches in the flight
+    timeline."""
+    flight = FlightRecorder(256)
+    s = _paged_sched(tiny, flight=flight)
+    try:
+        a = s.submit(GenRequest(prompt=list(b"warm"), max_new_tokens=48,
+                                temperature=0.0))
+        # wait until A is actively decoding
+        while a.completion_tokens < 2:
+            pass
+        long_prompt = list(b"x" * 80)              # 5 chunks of 16
+        b = s.submit(GenRequest(prompt=long_prompt, max_new_tokens=4,
+                                temperature=0.0))
+        a.result(timeout=60)
+        b.result(timeout=60)
+    finally:
+        s.shutdown()
+    progs = [rec["program"] for rec in flight.snapshot(limit=256)]
+    chunk_idx = [i for i, p in enumerate(progs) if p == "prefill_chunk"]
+    assert len(chunk_idx) >= 5, progs
+    interleaved = any(
+        any(p != "prefill_chunk" for p in progs[i + 1:j])
+        for i, j in zip(chunk_idx, chunk_idx[1:])
+    )
+    assert interleaved, progs
+    assert s.total_prefill_chunks >= 5
+
+
+def test_pool_exhaustion_holds_request_until_blocks_free(tiny):
+    """With a pool too small for two concurrent reservations, the second
+    request waits (held, not errored) and completes after the first frees
+    its blocks."""
+    # 7 allocatable blocks of 16 = 112 rows; each request reserves
+    # prompt + max_new + 1 capped at max_ctx (96 rows = 6 blocks)
+    s = _paged_sched(tiny, kv_num_blocks=8)
+    try:
+        a = s.submit(GenRequest(prompt=list(b"first request"),
+                                max_new_tokens=90, temperature=0.0))
+        b = s.submit(GenRequest(prompt=list(b"second request"),
+                                max_new_tokens=90, temperature=0.0))
+        ra = a.result(timeout=120)
+        rb = b.result(timeout=120)
+        assert ra.finish_reason is not None
+        assert rb.finish_reason is not None
+        assert a.admit_index < b.admit_index
+    finally:
+        s.shutdown()
+
+
+def test_paged_metrics_export_block_gauges(tiny):
+    s = _paged_sched(tiny)
+    try:
+        s.generate(GenRequest(prompt=list(b"metrics"), max_new_tokens=4,
+                              temperature=0.0), timeout=60)
+        m = s.metrics()
+        assert m["kv_block_tokens"] == 16
+        assert m["kv_blocks_total"] > 0
+        assert m["kv_blocks_free"] + m["kv_blocks_used"] == m["kv_blocks_total"]
+        assert m["prefill_chunks"] >= 1
+        assert "prefill_chunk_queue_depth" in m
+        assert 0.0 <= m["kv_utilization"] <= 1.0
+
+        from localai_tpu.obs import metrics as obs_metrics
+
+        reg = obs_metrics.Registry()
+        obs_metrics.update_engine_gauges("tiny", m, registry=reg)
+        text = reg.render()
+        assert 'localai_kv_blocks_free{model="tiny"}' in text
+        assert 'localai_kv_blocks_used{model="tiny"}' in text
+        assert 'localai_prefill_chunk_queue_depth{model="tiny"}' in text
+    finally:
+        s.shutdown()
+
+
+def test_disk_prefix_export_transfers_across_layouts(tiny):
+    """The disk prompt-cache export format is layout-independent: rows
+    exported from a paged pool load into a contiguous cache and vice
+    versa, and the resumed generation matches the original."""
+    def mk(paged):
+        kw = ({"kv_block_tokens": 16, "prefill_chunk": 16} if paged else {})
+        return ModelRunner(tiny.cfg, tiny.params, num_slots=2, max_ctx=96,
+                           prefill_buckets=[16, 32], kv_dtype="float32",
+                           paged=paged, **kw)
+
+    prompt = list(b"a long shared system prompt for the cache")
+    src = mk(True)
+    s = src.acquire_slot()
+    base = [src.admit(s, prompt, temperature=0.0)]
+    base += [int(src.step()[s]) for _ in range(5)]
+    arrays = src.export_prefix(s, len(prompt))
+
+    for paged in (True, False):
+        dst = mk(paged)
+        s2 = dst.acquire_slot()
+        assert dst.load_prefix(s2, arrays, len(prompt))
+        t = dst.admit(s2, prompt, temperature=0.0,
+                      resident=list(prompt), valid_n=len(prompt))
+        assert dst.last_prefix_reused == len(prompt) - 1
+        out = [t] + [int(dst.step()[s2]) for _ in range(5)]
+        assert out == base, (paged, out, base)
+
+
+def test_spec_decoder_rejects_paged_runner(tiny):
+    from localai_tpu.engine.speculative import SpecDecoder
+
+    rp = ModelRunner(tiny.cfg, tiny.params, num_slots=2, max_ctx=64,
+                     prefill_buckets=[16], kv_dtype="float32", paged=True)
+    rc = ModelRunner(tiny.cfg, tiny.params, num_slots=2, max_ctx=64,
+                     prefill_buckets=[16], kv_dtype="float32", paged=False)
+    with pytest.raises(ValueError, match="contiguous"):
+        SpecDecoder(rp, rc)

@@ -1,0 +1,124 @@
+"""CI perf smoke gate: fail the PR on a decode-throughput regression.
+
+The north-star bench (bench.py) needs real TPU hardware, so PRs used to
+land speed regressions blind (ROADMAP Open item 1). This gate runs the
+bench_micro decode measurement on the CI runner's CPU — contiguous AND
+paged KV layouts — and fails when either regresses more than
+``PERF_SMOKE_TOL`` (default 10%) against the committed floor in
+``BASELINE.json``'s ``perf_smoke`` entry.
+
+Raw tok/s numbers do not transfer between machines, so the committed
+floor is *normalized*: tok/s divided by a machine-speed index (a fixed
+jitted matmul loop's effective GFLOP/s, ``bench_micro.machine_index``)
+measured in the same process. The paged/contiguous *ratio* is additionally
+gated — it is machine-independent and catches a paged-path regression
+even if the normalization drifts.
+
+Usage:
+    python tools/perf_smoke.py              # gate (CI)
+    PERF_SMOKE_UPDATE=1 python tools/perf_smoke.py   # rewrite the floor
+
+Output: one JSON line with the measurements and verdicts; exit 1 on any
+gate failure.
+"""
+
+import json
+import os
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def _measure(tol: float) -> dict:
+    import bench_micro
+
+    idx = bench_micro.machine_index()
+    contig = bench_micro.decode_smoke(paged=False)
+    paged = bench_micro.decode_smoke(paged=True)
+    return {
+        "machine_gflops": round(idx, 2),
+        "decode_tok_s_contig": round(contig, 1),
+        "decode_tok_s_paged": round(paged, 1),
+        "normalized_contig": round(contig / idx, 4),
+        "normalized_paged": round(paged / idx, 4),
+        "paged_over_contig": round(paged / contig, 4),
+        "tolerance": tol,
+    }
+
+
+def main() -> int:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    tol = float(os.environ.get("PERF_SMOKE_TOL", "0.10"))
+    result = _measure(tol)
+
+    baseline_path = REPO / "BASELINE.json"
+    data = json.loads(baseline_path.read_text())
+    floor = data.get("perf_smoke")
+
+    if os.environ.get("PERF_SMOKE_UPDATE") == "1" or floor is None:
+        # record the floor 8% under the observed value: run-to-run noise on
+        # shared CI runners is ~5%, so gating the raw observation at 10%
+        # tolerance would flake — the discount keeps the effective gate at
+        # ~18% while the machine-independent paged/contig ratio still
+        # catches paged-path regressions tightly
+        headroom = 0.92
+        data["perf_smoke"] = {
+            "normalized_contig": round(result["normalized_contig"]
+                                       * headroom, 4),
+            "normalized_paged": round(result["normalized_paged"]
+                                      * headroom, 4),
+            "paged_over_contig_min": 0.70,
+            "note": ("decode tok/s per machine-index GFLOP/s "
+                     "(tools/perf_smoke.py), recorded with 8% noise "
+                     "headroom; refresh with PERF_SMOKE_UPDATE=1"),
+        }
+        if os.environ.get("PERF_SMOKE_UPDATE") == "1":
+            baseline_path.write_text(json.dumps(data, indent=2) + "\n")
+            result["updated_baseline"] = True
+        else:
+            result["no_baseline"] = True  # first run: record nothing, pass
+        print(json.dumps(result))
+        return 0
+
+    def gate(res: dict) -> list[str]:
+        failures = []
+        for key in ("normalized_contig", "normalized_paged"):
+            base = floor.get(key)
+            if base and res[key] < base * (1 - tol):
+                failures.append(
+                    f"{key} {res[key]:.4f} < floor {base:.4f} "
+                    f"(-{(1 - res[key] / base) * 100:.1f}%)")
+        ratio_min = floor.get("paged_over_contig_min", 0.75)
+        if res["paged_over_contig"] < ratio_min:
+            failures.append(
+                f"paged_over_contig {res['paged_over_contig']:.3f} "
+                f"< {ratio_min} (paged decode path regressed)")
+        return failures
+
+    failures = gate(result)
+    if failures:
+        # one full re-measurement before failing the PR: a contention
+        # spike that survived best-of-N rarely survives a second window
+        retry = _measure(tol)
+        retry_failures = gate(retry)
+        result = {**retry, "first_attempt": result,
+                  "retried_after_failure": failures}
+        failures = retry_failures
+    result["failures"] = failures
+    print(json.dumps(result))
+    if failures:
+        print("PERF SMOKE GATE FAILED:", "; ".join(failures),
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -51,8 +51,15 @@ REQUIRED_SERIES = (
     'localai_queue_wait_seconds_count{model="smoke"}',
     'localai_requests_total{',
     'localai_decode_dispatches_total{model="smoke"}',
-    'localai_xla_compile_total{program="prefill"}',
+    # the smoke engine runs the paged KV cache (the serving default), so
+    # prefill compiles under the chunked-prefill program label
+    'localai_xla_compile_total{program="prefill_chunk"}',
     'localai_xla_compile_seconds_total{program="decode',
+    # paged block-pool gauges (round 9)
+    'localai_kv_blocks_free{model="smoke"}',
+    'localai_kv_blocks_used{model="smoke"}',
+    'localai_prefill_chunk_queue_depth{model="smoke"}',
+    'localai_prefill_chunks_total{model="smoke"}',
 )
 REQUIRED_FAMILIES = (
     "# TYPE localai_prompt_cache_hit_rate gauge",
@@ -254,6 +261,9 @@ def main(argv=None) -> int:
     runner = ModelRunner(
         tiny.cfg, tiny.params, num_slots=4, max_ctx=96,
         prefill_buckets=[16, 32], kv_dtype="float32",
+        # the serving default: paged block pool + chunked prefill — the
+        # smoke must exercise (and assert) the block gauges end-to-end
+        paged=True, kv_block_tokens=16, prefill_chunk=16,
     )
     store = TraceStore()
     # a dedicated observatory (no env targets) so the smoke is hermetic;
