@@ -73,9 +73,22 @@ async def metrics(request: web.Request) -> web.Response:
     from localai_tpu.obs.metrics import update_engine_gauges
 
     state = _state(request)
-    for name, m in state.manager.metrics().items():
+    # a fleet-served model's metrics() pulls one stats RPC per replica —
+    # off the event loop, or a wedged replica freezes every endpoint for
+    # the duration of its RPC timeout (single-engine models are host-side
+    # reads and ride along unharmed)
+    loop = asyncio.get_running_loop()
+    engine_metrics = await loop.run_in_executor(None, state.manager.metrics)
+    for name, m in engine_metrics.items():
         if isinstance(m, dict):
             update_engine_gauges(name, m)
+    # fleet replica-state gauges refresh at scrape time too (host-side
+    # state reads only; the routed/transfer counters are event-driven)
+    for sm in state.manager.loaded_snapshot().values():
+        export = getattr(getattr(sm, "scheduler", None),
+                         "export_gauges", None)
+        if export is not None:
+            export()
     # device health at scrape time is host metadata only (memory_stats +
     # live-array census) — never a device dispatch: a scrape must not
     # queue work behind a wedged tunnel (the probe lives in /debug/devices)
@@ -112,6 +125,31 @@ async def slo_report(_request: web.Request) -> web.Response:
     from localai_tpu.obs import slo as obs_slo
 
     return web.json_response(obs_slo.SLO.report())
+
+
+async def fleet_status(request: web.Request) -> web.Response:
+    """GET /v1/fleet — the fleet observatory: per-model replica states,
+    dial health, routing counters (affinity/least_loaded/failover +
+    route-around), prefix-transfer stats, and per-replica shedding
+    (localai_tpu.fleet). Models served by a single engine are listed with
+    ``fleet: false`` so the panel shows the whole serving surface."""
+    state = _state(request)
+    loop = asyncio.get_running_loop()
+    out: dict[str, dict] = {}
+    for name, sm in state.manager.loaded_snapshot().items():
+        status_fn = getattr(sm, "fleet_status", None)
+        if status_fn is None:
+            out[name] = {"fleet": False}
+            continue
+        # the status pulls one metrics RPC per replica — off the loop
+        out[name] = {"fleet": True,
+                     **await loop.run_in_executor(None, status_fn)}
+    return web.json_response({
+        "configured_replicas": state.config.fleet_replicas,
+        "configured_prefill_replicas": state.config.fleet_prefill_replicas,
+        "backend": state.config.fleet_backend,
+        "models": out,
+    })
 
 
 async def system(request: web.Request) -> web.Response:
@@ -226,6 +264,7 @@ def routes() -> list[web.RouteDef]:
         web.get("/version", version),
         web.get("/metrics", metrics),
         web.get("/v1/slo", slo_report),
+        web.get("/v1/fleet", fleet_status),
         web.get("/system", system),
         web.post("/v1/tokenize", tokenize),
         web.post("/tokenize", tokenize),

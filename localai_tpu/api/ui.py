@@ -95,6 +95,7 @@ def _page(title: str, body: str, script: str = "") -> web.Response:
     <a href="/tts/">TTS</a>
     <a href="/swarm">Swarm</a>
     <a href="/slo">SLO</a>
+    <a href="/fleet">Fleet</a>
     <a href="/batches">Batches</a>
   </nav>
   <input id="apikey" placeholder="API key (if set)"
@@ -753,6 +754,88 @@ setInterval(refresh, 2000);
 
 
 # ---------------------------------------------------------------------------
+# fleet router
+
+
+async def fleet_page(request: web.Request) -> web.Response:
+    """GET /fleet — replica-fleet panel over GET /v1/fleet: per-replica
+    lifecycle state, dial health, routing mix (affinity / least-loaded /
+    failover + route-around), and disaggregated prefix-transfer stats.
+    Read-side polling only."""
+    body = """
+<div class="card">
+  <div class="row"><h2 style="flex:1">Fleet</h2>
+    <span id="fhealth" class="badge">…</span></div>
+  <div id="replicas" class="dim">loading…</div>
+</div>
+<div class="card">
+  <h2>Routing</h2>
+  <div id="routing" class="dim">loading…</div>
+  <p class="dim">Placement: prompt-prefix affinity (token-chain block hash
+  → consistent-hash ring) with least-loaded fallback; shed replicas are
+  routed around; a replica dying mid-request fails over.</p>
+</div>"""
+    script = """
+function table(out, headers, rows) {  // textContent only: API data is
+  out.textContent = '';               // untrusted for innerHTML
+  const t = document.createElement('table');
+  const hr = t.insertRow();
+  headers.forEach(h => {
+    const th = document.createElement('th');
+    th.textContent = h; hr.appendChild(th);
+  });
+  rows.forEach(r => {
+    const tr = t.insertRow();
+    r.forEach(v => tr.insertCell().textContent = v);
+  });
+  out.appendChild(t);
+  if (!rows.length) out.textContent = 'no fleet-served models';
+}
+async function refresh() {
+  try {
+    const d = await (await fetch('/v1/fleet',
+                                 {headers: authHeaders()})).json();
+    const models = d.models || {};
+    const reps = [], routing = [];
+    let dead = 0, healthy = 0;
+    for (const [name, m] of Object.entries(models)) {
+      if (!m.fleet) continue;
+      (m.replicas || []).forEach(r => {
+        if (r.state === 'healthy') healthy++; else dead++;
+        const shed = (m.shedding || {})[r.id];
+        reps.push([r.id, r.role, r.state + (shed ? ' (shedding)' : ''),
+                   r.inflight, r.dispatched, r.errors,
+                   r.dial_seconds === null ? '—' : r.dial_seconds + 's',
+                   r.checked_age_s === null ? '—' : r.checked_age_s + 's']);
+      });
+      const rt = (m.router || {}).routed || {};
+      routing.push([name, rt.affinity || 0, rt.least_loaded || 0,
+                    rt.failover || 0, (m.router || {}).routed_around || 0,
+                    m.respawns || 0, m.prefix_transfers || 0,
+                    m.prefix_transfer_bytes || 0, m.disagg_fallbacks || 0]);
+    }
+    const badge = document.getElementById('fhealth');
+    badge.textContent = dead ? (dead + ' degraded') :
+                        (healthy ? healthy + ' healthy' : 'no fleet');
+    badge.className = 'badge' + (dead ? '' : ' loaded');
+    table(document.getElementById('replicas'),
+          ['replica', 'role', 'state', 'inflight', 'dispatched', 'errors',
+           'dial', 'checked'], reps);
+    table(document.getElementById('routing'),
+          ['model', 'affinity', 'least-loaded', 'failover', 'routed around',
+           'respawns', 'prefix transfers', 'transfer bytes',
+           'disagg fallbacks'], routing);
+  } catch (e) {
+    document.getElementById('replicas').textContent = 'error: ' + e.message;
+  }
+}
+refresh();
+setInterval(refresh, 2000);
+"""
+    return _page("Fleet", body, script)
+
+
+# ---------------------------------------------------------------------------
 # offline batch jobs
 
 
@@ -827,7 +910,7 @@ UI_PREFIXES = ("/browse", "/chat/", "/text2image/", "/tts/", "/talk/")
 # exact-match key-free pages (prefix matching would also exempt JSON
 # sub-routes like /swarm/nodes, which must stay API-key-protected — that
 # endpoint performs server-side fetches of the operator-named router)
-UI_EXACT = ("/swarm", "/slo", "/batches")
+UI_EXACT = ("/swarm", "/slo", "/batches", "/fleet")
 
 
 def wants_html(request: web.Request) -> bool:
@@ -849,4 +932,5 @@ def routes() -> list[web.RouteDef]:
         web.get("/swarm/nodes", swarm_nodes),
         web.get("/slo", slo_page),
         web.get("/batches", batches_page),
+        web.get("/fleet", fleet_page),
     ]

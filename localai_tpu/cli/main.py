@@ -97,6 +97,21 @@ def build_parser() -> argparse.ArgumentParser:
     run.add_argument("--batch-expiry-h", type=float, default=None,
                      help="hours before a non-terminal batch job expires "
                           "(default 24)")
+    # fleet router (localai_tpu.fleet): multi-replica data-parallel serving
+    run.add_argument("--fleet-replicas", type=int, default=None,
+                     help="serve each LLM from N engine replicas behind "
+                          "one cache-aware router (0/1 = single engine)")
+    run.add_argument("--fleet-prefill-replicas", type=int, default=None,
+                     help="dedicated prefill replicas for disaggregated "
+                          "serving: long prompts prefill here and hand "
+                          "their KV prefix to a decode replica (default 0)")
+    run.add_argument("--fleet-backend", default=None,
+                     choices=["worker", "inprocess"],
+                     help="replica shape: spawned gRPC worker processes "
+                          "(default) or in-process engines")
+    run.add_argument("--fleet-disagg-threshold", type=int, default=None,
+                     help="prompt tokens at which a request takes the "
+                          "disaggregated prefill path (default 512)")
 
     models = sub.add_parser("models", help="model management")
     models_sub = models.add_subparsers(dest="models_command")
@@ -370,6 +385,10 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             request_deadline_s=args.request_deadline_s,
             batch_concurrency=args.batch_concurrency,
             batch_expiry_h=args.batch_expiry_h,
+            fleet_replicas=args.fleet_replicas,
+            fleet_prefill_replicas=args.fleet_prefill_replicas,
+            fleet_backend=args.fleet_backend,
+            fleet_disagg_threshold=args.fleet_disagg_threshold,
         )
         serve(cfg)
         return 0

@@ -103,6 +103,20 @@ class AppConfig:
     batch_concurrency: int = 2
     batch_expiry_h: float = 24.0
 
+    # fleet router (localai_tpu.fleet): serve each LLM from N data-parallel
+    # engine replicas behind one facade. 0/1 = single engine (today's
+    # behavior). Replicas default to spawned worker processes
+    # (fleet_backend=worker — crash isolation + device pinning via
+    # worker_env); fleet_backend=inprocess builds N in-process engines
+    # (CPU tests, CI smoke). fleet_prefill_replicas adds dedicated prefill
+    # replicas: prompts >= fleet_disagg_threshold tokens prefill there and
+    # hand their KV prefix to a decode replica over TransferPrefix.
+    # Env: LOCALAI_FLEET_REPLICAS etc.; CLI: --fleet-replicas etc.
+    fleet_replicas: int = 0
+    fleet_prefill_replicas: int = 0
+    fleet_backend: str = "worker"
+    fleet_disagg_threshold: int = 512
+
     # TPU-specific
     mesh_shape: Optional[dict[str, int]] = None   # None = auto from devices
     platform: Optional[str] = None                # force jax platform (tests: cpu)

@@ -346,6 +346,35 @@ class Scheduler:
     def generate(self, req: GenRequest, timeout: float = 600.0) -> GenHandle:
         return self.submit(req).result(timeout)
 
+    def attach_prompt_cache(self, prompt_cache: Any,
+                            *, layer: bool = False) -> None:
+        """Attach a prompt-KV cache after construction (fleet replicas get
+        an in-memory PrefixCache lazily, on first PrefillPrefix/
+        TransferPrefix use — see localai_tpu.fleet.prefix). No-op when a
+        cache is already wired — unless ``layer=True`` and the existing
+        cache lacks the store-signalling surface the disaggregation
+        export blocks on (``wait_for``): then the new cache FRONTS it
+        (``fallthrough``), so a configured disk prompt cache keeps
+        working while the fleet handoff gets its RAM tier. Starts the
+        off-thread writer for writable caches, exactly as __init__ would
+        have. Safe while the engine thread runs: its reads are a single
+        attribute load, and the new cache only affects admissions/
+        releases that start after the set."""
+        if prompt_cache is None:
+            return
+        if self.prompt_cache is not None:
+            if not layer or hasattr(self.prompt_cache, "wait_for"):
+                return
+            prompt_cache.fallthrough = self.prompt_cache
+            self.prompt_cache = prompt_cache
+        else:
+            self.prompt_cache = prompt_cache
+        if not self.prompt_cache.read_only and self._pc_thread is None:
+            self._pc_thread = threading.Thread(
+                target=self._pc_writer, name="prompt-cache", daemon=True
+            )
+            self._pc_thread.start()
+
     @property
     def busy(self) -> bool:
         return (bool(self._slots) or bool(self._prefills)

@@ -1,0 +1,34 @@
+"""Fleet router: multi-replica data-parallel serving for one model.
+
+Rebuilds the reference's federation/P2P load-balancing layer (one model
+spread over many worker instances) on the worker gRPC tier and the paged
+KV engine instead of libp2p:
+
+  * :mod:`localai_tpu.fleet.pool` — ReplicaPool: N engine replicas
+    (worker processes or in-process engines), explorer-style health
+    dials, respawn-on-death, per-replica stats pulled over RPC.
+  * :mod:`localai_tpu.fleet.router` — prompt-prefix-affinity placement
+    (token-chain block hash → consistent-hash ring) with least-loaded
+    fallback and per-replica burn-rate route-around.
+  * :mod:`localai_tpu.fleet.serving` — FleetServingModel/FleetScheduler:
+    the ServingModel-shaped facade the API tier serves through, with
+    retry-with-failover and the disaggregated prefill→decode handoff.
+  * :mod:`localai_tpu.fleet.prefix` — the in-memory prefix cache +
+    chunked npz wire format behind the TransferPrefix RPC.
+"""
+
+from localai_tpu.fleet.pool import ReplicaPool
+from localai_tpu.fleet.prefix import PrefixCache, assemble_chunks, pack_chunks
+from localai_tpu.fleet.router import Router, affinity_key
+from localai_tpu.fleet.serving import FleetScheduler, FleetServingModel
+
+__all__ = [
+    "FleetScheduler",
+    "FleetServingModel",
+    "PrefixCache",
+    "ReplicaPool",
+    "Router",
+    "affinity_key",
+    "assemble_chunks",
+    "pack_chunks",
+]

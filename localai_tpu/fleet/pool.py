@@ -1,0 +1,240 @@
+"""ReplicaPool: N live engine replicas for one model, kept alive.
+
+Lifecycle parity with the worker tier's single-process management
+(worker/process.py) scaled out: every replica is spawned at boot
+(concurrently — a cold fleet boots in one model-load, not N), a monitor
+thread dial-tests each replica on an interval (explorer-style: timing,
+consecutive-failure counting — federation/explorer.py), and a replica
+past the failure threshold (or whose process died) is marked ``dead``,
+taken out of routing, and respawned in the background; it rejoins the
+ring only after its respawn passes health + LoadModel again. Per-replica
+engine stats are pulled over the metrics RPC for /v1/fleet and the
+``localai_fleet_*`` gauges — the decode hot path never waits on a stats
+pull."""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Callable, Optional
+
+from localai_tpu.fleet.replica import DEAD, HEALTHY, RESPAWNING, BaseReplica
+
+log = logging.getLogger(__name__)
+
+
+class ReplicaPool:
+    def __init__(self, model: str,
+                 factory: Callable[[str, str], BaseReplica],
+                 *, replicas: int = 2, prefill_replicas: int = 0,
+                 health_interval: float = 5.0,
+                 failure_threshold: int = 3,
+                 dial_timeout: float = 2.0):
+        self.model = model
+        self.factory = factory
+        self.health_interval = health_interval
+        self.failure_threshold = failure_threshold
+        self.dial_timeout = dial_timeout
+        self.replicas: list[BaseReplica] = []
+        for i in range(replicas):
+            self.replicas.append(factory(f"{model}/r{i}", "decode"))
+        for i in range(prefill_replicas):
+            self.replicas.append(factory(f"{model}/p{i}", "prefill"))
+        self._lock = threading.Lock()
+        self._respawning: set[str] = set()
+        self.respawns = 0
+        self._stop = threading.Event()
+        self._monitor: Optional[threading.Thread] = None
+
+    # -- boot / teardown ---------------------------------------------------
+
+    def start(self) -> None:
+        """Spawn every replica concurrently (worker spawns take tens of
+        seconds; serialized boot would multiply that by N), then start the
+        health monitor. A replica that fails to boot is marked dead and
+        left to the monitor's respawn path — one bad replica must not
+        abort the fleet."""
+        errors: dict[str, Exception] = {}
+
+        def boot(r: BaseReplica) -> None:
+            try:
+                r.start()
+                r.dial(self.dial_timeout)
+            except Exception as e:  # noqa: BLE001
+                errors[r.id] = e
+                r.state = DEAD
+
+        threads = [threading.Thread(target=boot, args=(r,),
+                                    name=f"fleet-boot-{r.id}", daemon=True)
+                   for r in self.replicas]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        for rid, e in errors.items():
+            log.warning("fleet %s: replica %s failed to boot: %s",
+                        self.model, rid, e)
+        if not any(r.state == HEALTHY for r in self.replicas):
+            # reap whatever DID spawn — without a monitor nothing else
+            # will, and a retried load would stack orphaned workers
+            for r in self.replicas:
+                try:
+                    r.stop()
+                except Exception:  # noqa: BLE001 — teardown must finish
+                    log.exception("stopping replica %s failed", r.id)
+            raise RuntimeError(
+                f"fleet {self.model}: no replica came up "
+                f"({ {k: str(v) for k, v in errors.items()} })")
+        self._monitor = threading.Thread(
+            target=self._run_monitor, name=f"fleet-monitor-{self.model}",
+            daemon=True)
+        self._monitor.start()
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        if self._monitor is not None:
+            self._monitor.join(self.health_interval * 2)
+            self._monitor = None
+        for r in self.replicas:
+            try:
+                r.stop()
+            except Exception:  # noqa: BLE001 — teardown must finish
+                log.exception("stopping replica %s failed", r.id)
+
+    # -- routing surface ---------------------------------------------------
+
+    def healthy(self, role: str = "decode") -> list[BaseReplica]:
+        return [r for r in self.replicas
+                if r.state == HEALTHY and r.role == role]
+
+    def get(self, rid: str) -> Optional[BaseReplica]:
+        for r in self.replicas:
+            if r.id == rid:
+                return r
+        return None
+
+    def least_loaded(self, role: str = "prefill") -> Optional[BaseReplica]:
+        live = self.healthy(role)
+        return min(live, key=lambda r: r.load) if live else None
+
+    def note_failure(self, replica: BaseReplica) -> None:
+        """A request-level transport failure on ``replica`` (called by the
+        dispatch thread). A dead process is marked dead IMMEDIATELY —
+        subsequent requests route around it without waiting for the next
+        monitor sweep — and its respawn starts in the background."""
+        if replica.state != HEALTHY:
+            return
+        if not replica.process_alive() or not replica.dial(self.dial_timeout):
+            replica.failures = max(replica.failures, self.failure_threshold)
+            self._mark_dead(replica)
+
+    # -- monitor -----------------------------------------------------------
+
+    def _run_monitor(self) -> None:
+        while not self._stop.wait(self.health_interval):
+            self.poll_once()
+
+    def poll_once(self) -> None:
+        """One dial-test sweep (the testable unit)."""
+        for r in self.replicas:
+            if r.state == RESPAWNING or self._stop.is_set():
+                continue
+            if r.state == DEAD:
+                self._spawn_respawn(r)
+                continue
+            ok = r.process_alive() and r.dial(self.dial_timeout)
+            if not ok and r.failures >= self.failure_threshold:
+                self._mark_dead(r)
+            elif not ok and not r.process_alive():
+                # no process left to dial back to life — don't burn the
+                # remaining threshold sweeps on a corpse
+                r.failures = max(r.failures, self.failure_threshold)
+                self._mark_dead(r)
+
+    def _mark_dead(self, r: BaseReplica) -> None:
+        if r.state == DEAD:
+            return
+        log.warning("fleet %s: replica %s marked dead "
+                    "(%d consecutive dial failures)",
+                    self.model, r.id, r.failures)
+        r.state = DEAD
+        self._spawn_respawn(r)
+
+    def _spawn_respawn(self, r: BaseReplica) -> None:
+        with self._lock:
+            if r.id in self._respawning:
+                return
+            self._respawning.add(r.id)
+        r.state = RESPAWNING
+
+        def respawn() -> None:
+            try:
+                if self._stop.is_set():
+                    r.state = DEAD
+                    return
+                try:
+                    r.stop()
+                except Exception:  # noqa: BLE001
+                    pass
+                r.start()
+                if self._stop.is_set():
+                    # shutdown raced the spawn: its stop() sweep already
+                    # ran, so reap the worker we just brought up
+                    try:
+                        r.stop()
+                    except Exception:  # noqa: BLE001
+                        pass
+                    r.state = DEAD
+                    return
+                # rejoin routing only after a real dial passes (start()
+                # already health-gated the spawn; this records the timing
+                # and flips STARTING/RESPAWNING → HEALTHY)
+                if r.dial(self.dial_timeout):
+                    with self._lock:
+                        self.respawns += 1
+                    log.info("fleet %s: replica %s respawned",
+                             self.model, r.id)
+                else:
+                    r.state = DEAD
+            except Exception as e:  # noqa: BLE001
+                log.warning("fleet %s: respawn of %s failed: %s "
+                            "(retrying next sweep)", self.model, r.id, e)
+                r.state = DEAD
+            finally:
+                with self._lock:
+                    self._respawning.discard(r.id)
+
+        threading.Thread(target=respawn, name=f"fleet-respawn-{r.id}",
+                         daemon=True).start()
+
+    # -- observability -----------------------------------------------------
+
+    def states(self) -> dict[str, int]:
+        out: dict[str, int] = {}
+        for r in self.replicas:
+            out[r.state] = out.get(r.state, 0) + 1
+        return out
+
+    def snapshot(self, *, with_metrics: bool = False) -> dict:
+        reps = []
+        for r in self.replicas:
+            snap = r.snapshot()
+            if with_metrics and r.state == HEALTHY:
+                m = r.metrics()
+                snap["engine"] = {
+                    k: m.get(k) for k in (
+                        "occupancy", "queue_depth", "kv_utilization",
+                        "total_generated_tokens", "step_ms_p50",
+                        "step_ms_p99", "error",
+                    ) if k in m
+                }
+            reps.append(snap)
+        return {
+            "model": self.model,
+            "states": self.states(),
+            "respawns": self.respawns,
+            "health_interval_s": self.health_interval,
+            "failure_threshold": self.failure_threshold,
+            "replicas": reps,
+        }

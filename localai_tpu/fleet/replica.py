@@ -1,0 +1,326 @@
+"""One engine replica: lifecycle state, dial bookkeeping, dispatch surface.
+
+Two implementations behind one duck-typed surface:
+
+  * :class:`WorkerReplica` — a spawned gRPC worker process
+    (worker/process.py WorkerProcess + worker/client.py WorkerClient), the
+    production shape: crash isolation per replica, device pinning via the
+    spawn env, KV prefixes crossing the wire as PrefixChunk streams.
+  * :class:`InProcessReplica` — a full engine (build_serving_model) inside
+    this process: the CPU-testable shape the router/pool/disaggregation
+    tests and the CI telemetry smoke drive, with the same reply/chunk
+    schema (worker.server.gen_request_from_options decodes requests for
+    both, so the two kinds cannot drift).
+
+States: ``starting`` → ``healthy`` ⇄ ``dead`` → ``respawning`` →
+``healthy``. "Shedding" is not a stored state — it is derived per route
+from the fleet's per-replica SLO tracker (router.py)."""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+import time
+from typing import Any, Iterator, Optional
+
+log = logging.getLogger(__name__)
+
+STARTING = "starting"
+HEALTHY = "healthy"
+DEAD = "dead"
+RESPAWNING = "respawning"
+
+
+class _Reply:
+    """pb.Reply-shaped streaming element from an in-process replica."""
+
+    __slots__ = ("message", "tokens", "prompt_tokens", "finish_reason")
+
+    def __init__(self, message: bytes = b"", tokens: int = 0,
+                 prompt_tokens: int = 0, finish_reason: str = ""):
+        self.message = message
+        self.tokens = tokens
+        self.prompt_tokens = prompt_tokens
+        self.finish_reason = finish_reason
+
+
+class BaseReplica:
+    """Shared lifecycle/accounting; subclasses provide transport."""
+
+    def __init__(self, rid: str, role: str):
+        self.id = rid
+        self.role = role                  # "decode" | "prefill"
+        self.state = STARTING
+        self._lock = threading.Lock()
+        self.inflight = 0
+        self.dispatched = 0               # lifetime requests routed here
+        self.errors = 0                   # request-level failures
+        self.failures = 0                 # consecutive dial failures
+        self.dial_seconds: Optional[float] = None
+        self.checked_mono: Optional[float] = None
+        self.started_at = time.monotonic()
+
+    # -- accounting (router reads these for least-loaded) ------------------
+
+    def begin(self) -> None:
+        with self._lock:
+            self.inflight += 1
+            self.dispatched += 1
+
+    def done(self, *, error: bool = False) -> None:
+        with self._lock:
+            self.inflight -= 1
+            if error:
+                self.errors += 1
+
+    @property
+    def load(self) -> tuple[int, int]:
+        """Least-loaded sort key: (inflight, lifetime dispatched)."""
+        with self._lock:
+            return (self.inflight, self.dispatched)
+
+    # -- health dial (explorer-style: consecutive failures, dial timing) --
+
+    def dial(self, timeout: float = 2.0) -> bool:
+        t0 = time.monotonic()
+        try:
+            ok = self._dial(timeout)
+        except Exception:  # noqa: BLE001 — a dial failing IS the signal
+            ok = False
+        self.dial_seconds = round(time.monotonic() - t0, 4)
+        self.checked_mono = time.monotonic()
+        if ok:
+            self.failures = 0
+            if self.state in (STARTING, RESPAWNING):
+                self.state = HEALTHY
+        else:
+            self.failures += 1
+        return ok
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            inflight, dispatched = self.inflight, self.dispatched
+            errors = self.errors
+        return {
+            "id": self.id,
+            "role": self.role,
+            "state": self.state,
+            "inflight": inflight,
+            "dispatched": dispatched,
+            "errors": errors,
+            "dial_failures": self.failures,
+            "dial_seconds": self.dial_seconds,
+            "checked_age_s": (
+                round(time.monotonic() - self.checked_mono, 1)
+                if self.checked_mono is not None else None),
+            "age_s": round(time.monotonic() - self.started_at, 1),
+        }
+
+    # -- transport (subclass responsibility) -------------------------------
+
+    def start(self) -> None:
+        raise NotImplementedError
+
+    def _dial(self, timeout: float) -> bool:
+        raise NotImplementedError
+
+    def predict_stream(self, opts: Any, trace_id: str = "") -> Iterator:
+        raise NotImplementedError
+
+    def prefill_prefix(self, opts: Any, trace_id: str = "") -> Iterator:
+        raise NotImplementedError
+
+    def transfer_prefix(self, chunks: Iterator, trace_id: str = "") -> Any:
+        raise NotImplementedError
+
+    def metrics(self) -> dict:
+        raise NotImplementedError
+
+    def process_alive(self) -> bool:
+        """Cheap no-RPC liveness (worker: process poll)."""
+        raise NotImplementedError
+
+    def stop(self) -> None:
+        raise NotImplementedError
+
+
+class WorkerReplica(BaseReplica):
+    """A replica backed by its own spawned gRPC worker process."""
+
+    def __init__(self, rid: str, role: str, mcfg, app,
+                 *, env: Optional[dict] = None):
+        super().__init__(rid, role)
+        self.mcfg = mcfg
+        self.app = app
+        self._env = dict(env or {})
+        self._wp = None
+        self._client = None
+
+    def start(self) -> None:
+        from localai_tpu.worker.process import WorkerProcess
+
+        self._wp = WorkerProcess(self.id, env=self._env or None)
+        self._client = self._wp.start()
+        self._load_model()
+
+    def _load_model(self) -> None:
+        import yaml
+
+        doc = self.mcfg.model_dump(exclude_none=True, exclude_defaults=True)
+        doc["name"] = self.mcfg.name
+        doc["model"] = self.mcfg.model or self.mcfg.name
+        doc.pop("backend", None)  # the replica itself runs in-process
+        res = self._client.load_model(
+            config_yaml=yaml.safe_dump(doc),
+            model_path=str(self.app.model_path),
+        )
+        if not res.success:
+            raise RuntimeError(
+                f"replica {self.id} LoadModel failed: {res.message}")
+
+    def _dial(self, timeout: float) -> bool:
+        return self._client is not None and self._client.health(timeout)
+
+    def predict_stream(self, opts, trace_id: str = "") -> Iterator:
+        return self._client.predict_stream(opts, trace_id=trace_id)
+
+    def prefill_prefix(self, opts, trace_id: str = "") -> Iterator:
+        return self._client.prefill_prefix(opts, trace_id=trace_id)
+
+    def transfer_prefix(self, chunks, trace_id: str = ""):
+        from localai_tpu.worker import backend_pb2 as pb
+
+        def as_protos():
+            for c in chunks:
+                yield c if not isinstance(c, dict) else pb.PrefixChunk(**c)
+
+        return self._client.transfer_prefix(as_protos(), trace_id=trace_id)
+
+    def metrics(self) -> dict:
+        try:
+            # short deadline: this is the scrape/status path, and a wedged
+            # replica must cost seconds, not the full RPC default
+            return self._client.metrics(timeout=3.0)
+        except Exception as e:  # noqa: BLE001 — stats pull ≠ serving
+            return {"error": str(e)}
+
+    def process_alive(self) -> bool:
+        return self._wp is not None and self._wp.alive
+
+    def kill(self) -> None:
+        """SIGKILL the worker (tests / operator surface)."""
+        if self._wp is not None and self._wp.proc is not None:
+            self._wp.proc.kill()
+
+    def stop(self) -> None:
+        if self._wp is not None:
+            self._wp.stop()
+            self._wp = None
+            self._client = None
+
+
+class InProcessReplica(BaseReplica):
+    """A replica owning a full in-process engine (factory →
+    models.manager.ServingModel). The CPU-testable twin of WorkerReplica:
+    same opts/reply/chunk schema, no processes, no sockets."""
+
+    def __init__(self, rid: str, role: str, factory):
+        super().__init__(rid, role)
+        self._factory = factory
+        self.sm = None
+        self._killed = False
+
+    def start(self) -> None:
+        from localai_tpu.fleet.prefix import PrefixCache
+
+        self._killed = False
+        self.sm = self._factory()
+        # both halves of the disaggregated handoff run through this cache
+        # (export at release on prefill replicas, import at admission on
+        # decode replicas) — attach it up front; a configured disk cache
+        # is layered under it rather than replaced (layer=True)
+        self.sm.scheduler.attach_prompt_cache(PrefixCache(
+            min_prefix=getattr(self.sm.runner, "prefix_reuse_min", 16)),
+            layer=True)
+
+    def _cache(self):
+        return self.sm.scheduler.prompt_cache
+
+    def _dial(self, timeout: float) -> bool:
+        return (not self._killed and self.sm is not None
+                and self.sm.scheduler._thread.is_alive())
+
+    def predict_stream(self, opts, trace_id: str = "") -> Iterator:
+        from localai_tpu.worker.server import gen_request_from_options
+
+        if self._killed:
+            raise RuntimeError(f"replica {self.id} is dead")
+        sm = self.sm
+        gr = gen_request_from_options(opts, sm, trace_id=trace_id)
+        handle = sm.scheduler.submit(gr)
+        try:
+            while True:
+                try:
+                    # bounded wait so a kill() mid-stream surfaces as a
+                    # transport error (exactly like a SIGKILLed worker)
+                    # instead of parking on a queue the dead engine thread
+                    # will never feed again
+                    item = handle._q.get(timeout=0.25)
+                except queue.Empty:
+                    if self._killed:
+                        raise RuntimeError(
+                            f"replica {self.id} died mid-stream")
+                    continue
+                if self._killed:
+                    raise RuntimeError(f"replica {self.id} died mid-stream")
+                if item.finish_reason is not None:
+                    yield _Reply(b"", handle.completion_tokens,
+                                 handle.prompt_tokens, item.finish_reason)
+                    break
+                if item.delta:
+                    yield _Reply(item.delta.encode("utf-8"))
+        finally:
+            if handle.finish_reason is None:
+                handle.cancel()
+
+    def prefill_prefix(self, opts, trace_id: str = "") -> Iterator:
+        from localai_tpu.fleet.prefix import export_prefix, pack_chunks
+        from localai_tpu.worker.server import gen_request_from_options
+
+        if self._killed:
+            raise RuntimeError(f"replica {self.id} is dead")
+        sm = self.sm
+        gr = gen_request_from_options(opts, sm, trace_id=trace_id)
+        prompt, arrays = export_prefix(sm, gr, self._cache())
+        yield from pack_chunks(prompt, arrays)
+
+    def transfer_prefix(self, chunks, trace_id: str = ""):
+        from types import SimpleNamespace
+
+        from localai_tpu.fleet.prefix import import_prefix
+
+        if self._killed:
+            raise RuntimeError(f"replica {self.id} is dead")
+        n = import_prefix(self._cache(), chunks)
+        return SimpleNamespace(success=True, message=f"{n} rows")
+
+    def metrics(self) -> dict:
+        if self.sm is None:
+            return {"error": "not started"}
+        return self.sm.scheduler.metrics()
+
+    def process_alive(self) -> bool:
+        return self._dial(0.0)
+
+    def kill(self) -> None:
+        """Simulate a replica crash: in-flight streams raise, dials fail,
+        the engine thread stops (tests / failover drills)."""
+        self._killed = True
+        if self.sm is not None:
+            self.sm.scheduler.shutdown(timeout=2.0)
+
+    def stop(self) -> None:
+        if self.sm is not None:
+            self.sm.scheduler.shutdown()
+            self.sm = None

@@ -1,0 +1,401 @@
+"""FleetServingModel: one model served by N replicas behind one facade.
+
+The ServingModel-shaped surface the API tier already speaks (tokenizer/
+templates local, ``scheduler.submit`` → GenHandle) — but submit() routes
+each request across the replica fleet:
+
+  * placement: prompt-prefix affinity over a consistent-hash ring, with
+    least-loaded fallback and per-replica burn-rate route-around
+    (fleet/router.py);
+  * retry-with-failover: a replica dying mid-request is marked dead, and
+    the request re-dispatches to the next candidate as long as nothing
+    was streamed to the client yet (a half-streamed completion cannot be
+    transparently resumed — it finishes ``error`` and the API tier maps
+    that to a clean 5xx);
+  * disaggregation: long prompts prefill on a dedicated prefill replica,
+    whose packed KV prefix streams over TransferPrefix into the decode
+    replica's prefix cache — the decode replica's admission then
+    load_prefix-resumes, so long prompts never occupy decode slots for
+    prefill (DistServe/Mooncake shape on the paged-KV block transfer).
+
+Every request records lifecycle spans under its API trace id (queued →
+route → prefix_transfer? → rpc), with the replica-side engine spans
+grouping under the same id via the gRPC metadata propagation the worker
+tier already does."""
+
+from __future__ import annotations
+
+import itertools
+import logging
+import threading
+import time
+from typing import Optional
+
+from localai_tpu.config.app_config import AppConfig
+from localai_tpu.config.model_config import ModelConfig
+from localai_tpu.engine.scheduler import GenHandle, GenRequest
+from localai_tpu.fleet.pool import ReplicaPool
+from localai_tpu.fleet.router import FleetUnavailable, Router
+from localai_tpu.obs import EngineTelemetry
+from localai_tpu.obs import watchdog as obs_watchdog
+from localai_tpu.obs.metrics import REGISTRY
+from localai_tpu.obs.slo import SLOTracker, targets_from_config
+from localai_tpu.worker.serving import (WorkerGenHandle, consume_stream,
+                                        predict_options)
+
+log = logging.getLogger(__name__)
+
+
+class FleetScheduler:
+    """The scheduler-shaped surface of a replica fleet: submit() routes,
+    dispatches on a daemon thread, and fails over on replica death."""
+
+    def __init__(self, owner: "FleetServingModel", pool: ReplicaPool,
+                 router: Router, slo: SLOTracker,
+                 *, disagg_threshold: int = 512, max_failovers: int = 2):
+        self._owner = owner
+        self.pool = pool
+        self.router = router
+        self.slo = slo                      # per-REPLICA observatory
+        self.disagg_threshold = disagg_threshold
+        self.max_failovers = max_failovers
+        self._ids = itertools.count()
+        self._inflight = 0
+        self._lock = threading.Lock()
+        self.telemetry = EngineTelemetry(model=owner.name)
+        self.watchdog = obs_watchdog.WATCHDOG
+        self._wd_channel = f"fleet:{owner.name}"
+        self.watchdog.start()
+        self.shed_total = 0                 # API-tier SLO 429 mirror
+        self.failovers = 0
+        self.prefix_transfers = 0
+        self.prefix_transfer_bytes = 0
+        self.disagg_fallbacks = 0
+
+    @property
+    def busy(self) -> bool:
+        with self._lock:
+            return self._inflight > 0
+
+    def note_shed(self) -> None:
+        with self._lock:
+            self.shed_total += 1
+
+    def submit(self, gr: GenRequest) -> GenHandle:
+        handle = WorkerGenHandle(gr, next(self._ids))
+        handle.trace = self.telemetry.queued(handle)
+        if gr.mm_embeds is not None:
+            self.telemetry.finished(handle.trace, handle, "error")
+            handle._finish("error")
+            log.error("fleet-served models do not support multimodal input")
+            return handle
+        with self._lock:
+            self._inflight += 1
+        threading.Thread(
+            target=self._run, args=(handle,), daemon=True,
+            name=f"fleet-req-{handle.id}",
+        ).start()
+        return handle
+
+    # -- dispatch ----------------------------------------------------------
+
+    def _run(self, handle: WorkerGenHandle) -> None:
+        tr = handle.trace
+        req = handle.request
+        self.watchdog.arm(self._wd_channel)
+        try:
+            if tr is not None:
+                tr.end("queued")
+            exclude: set = set()
+            attempt = 0
+            while True:
+                try:
+                    if tr is not None:
+                        tr.begin("route")
+                    replica, reason = self.router.route(
+                        req.prompt, exclude=exclude,
+                        failover=attempt > 0)
+                    if tr is not None:
+                        tr.end("route", replica=replica.id, reason=reason)
+                except FleetUnavailable as e:
+                    if tr is not None:
+                        tr.end("route", error=str(e))
+                    log.error("fleet %s: %s", self._owner.name, e)
+                    self.telemetry.finished(tr, handle, "error")
+                    handle._finish("error")
+                    return
+                REGISTRY.fleet_routed.inc(
+                    model=self._owner.name, reason=reason)
+                # submit() already rejected multimodal requests, so every
+                # request here is plain-text and disagg-eligible by length
+                if (attempt == 0
+                        and len(req.prompt) >= self.disagg_threshold):
+                    self._disaggregate(req, replica, tr)
+                    if replica.state != "healthy":
+                        # the handoff exposed a dead decode replica —
+                        # re-route now instead of burning a dispatch on it
+                        exclude.add(replica.id)
+                        attempt += 1
+                        with self._lock:
+                            self.failovers += 1
+                        continue
+                t_dispatch = time.monotonic()
+                try:
+                    finish = self._dispatch(handle, replica, tr)
+                except Exception as e:  # noqa: BLE001 — replica ≠ fleet
+                    self.slo.observe(replica.id, error=True)
+                    self.pool.note_failure(replica)
+                    streamed = handle.t_first_token is not None
+                    log.warning(
+                        "fleet %s: replica %s failed request %d (%s); "
+                        "%s", self._owner.name, replica.id, handle.id, e,
+                        "failing (already streamed)" if streamed
+                        else "failing over" if attempt < self.max_failovers
+                        else "out of failover attempts")
+                    if not streamed and attempt < self.max_failovers:
+                        exclude.add(replica.id)
+                        attempt += 1
+                        with self._lock:
+                            self.failovers += 1
+                        continue
+                    self.telemetry.finished(tr, handle, "error")
+                    handle._finish("error")
+                    return
+                now = time.monotonic()
+                self.slo.observe(
+                    replica.id,
+                    ttft_ms=((handle.t_first_token - t_dispatch) * 1e3
+                             if handle.t_first_token is not None else None),
+                    e2e_ms=(now - t_dispatch) * 1e3,
+                    error=finish == "error",
+                )
+                self.telemetry.finished(tr, handle, finish)
+                handle._finish(finish)
+                return
+        finally:
+            self.watchdog.disarm(self._wd_channel)
+            with self._lock:
+                self._inflight -= 1
+
+    def _dispatch(self, handle: WorkerGenHandle, replica, tr) -> str:
+        """One streaming attempt against one replica. Raises on transport
+        failure (the caller decides whether failover is still safe)."""
+        req = handle.request
+        opts = predict_options(req)
+        replica.begin()
+        error = True
+        try:
+            if tr is not None:
+                tr.begin("rpc", replica=replica.id)
+            finish, got_final = consume_stream(
+                handle,
+                replica.predict_stream(
+                    opts, trace_id=req.trace_id or req.correlation_id),
+                watchdog=self.watchdog, channel=self._wd_channel, tr=tr)
+            if not got_final:
+                # the stream went away without a final usage reply — a
+                # dying replica, not a completed generation
+                raise RuntimeError(
+                    f"stream from {replica.id} ended without a final reply")
+            error = finish == "error"
+            return finish
+        finally:
+            if tr is not None:
+                tr.end("rpc")
+            replica.done(error=error)
+
+    def _disaggregate(self, req: GenRequest, decode, tr) -> bool:
+        """Prefill replica → TransferPrefix → decode replica's cache. Best
+        effort: any failure falls back to a plain dispatch (the decode
+        replica prefills itself, exactly as without disaggregation)."""
+        pre = self.pool.least_loaded("prefill")
+        if pre is None:
+            return False
+        opts = predict_options(req)
+        trace_id = req.trace_id or req.correlation_id
+        nbytes = 0
+        if tr is not None:
+            tr.begin("prefix_transfer", prefill=pre.id, decode=decode.id)
+        ok = False
+        # the export is materialized before the decode-side call so a
+        # failure is charged to the replica that actually failed: lazy
+        # relaying would surface a dying prefill iterator as a transfer
+        # RPC error on the decode side (and vice versa). The buffered
+        # chunks are the same arrays the prefill replica already holds in
+        # its prefix cache — one transient copy, bounded by the export.
+        blame = pre
+        try:
+            pre.begin()
+            pre_err = True
+            try:
+                chunks = []
+                for c in pre.prefill_prefix(opts, trace_id=trace_id):
+                    nbytes += len(
+                        c["data"] if isinstance(c, dict) else c.data)
+                    self.watchdog.pulse(self._wd_channel)
+                    chunks.append(c)
+                pre_err = False
+            finally:
+                pre.done(error=pre_err)
+            blame = decode
+            res = decode.transfer_prefix(iter(chunks), trace_id=trace_id)
+            ok = bool(getattr(res, "success", False))
+        except Exception as e:  # noqa: BLE001 — disagg is an optimization
+            log.warning(
+                "fleet %s: disaggregated prefill %s→%s failed on %s (%s); "
+                "falling back to direct dispatch",
+                self._owner.name, pre.id, decode.id, blame.id, e)
+            self.slo.observe(blame.id, error=True)
+            self.pool.note_failure(blame)
+        finally:
+            if tr is not None:
+                tr.end("prefix_transfer", ok=ok, bytes=nbytes)
+        if ok:
+            with self._lock:
+                self.prefix_transfers += 1
+                self.prefix_transfer_bytes += nbytes
+            REGISTRY.fleet_prefix_transfers.inc(model=self._owner.name)
+            REGISTRY.fleet_prefix_transfer_bytes.inc(
+                nbytes, model=self._owner.name)
+        else:
+            with self._lock:
+                self.disagg_fallbacks += 1
+        return ok
+
+    # -- observability / lifecycle ----------------------------------------
+
+    def metrics(self) -> dict:
+        """Aggregate engine metrics across healthy replicas (the shape
+        update_engine_gauges understands) + the fleet's own stats. Pulls
+        one stats RPC per replica — scrape-path only, never the dispatch
+        path."""
+        totals = {"total_prompt_tokens": 0, "total_generated_tokens": 0,
+                  "queue_depth": 0, "dispatches": 0, "preemptions": 0,
+                  "prefix_tokens_reused": 0}
+        occ = []
+        kvu = []
+        per_replica: dict[str, dict] = {}
+        for r in self.pool.replicas:
+            if r.state != "healthy":
+                per_replica[r.id] = {"state": r.state}
+                continue
+            m = r.metrics()
+            per_replica[r.id] = m
+            if "error" in m and len(m) == 1:
+                continue
+            for k in totals:
+                totals[k] += m.get(k, 0) or 0
+            if m.get("occupancy") is not None:
+                occ.append(m["occupancy"])
+            if m.get("kv_utilization") is not None:
+                kvu.append(m["kv_utilization"])
+        with self._lock:
+            fleet = {
+                "replicas": self.pool.states(),
+                "respawns": self.pool.respawns,
+                "failovers": self.failovers,
+                "prefix_transfers": self.prefix_transfers,
+                "prefix_transfer_bytes": self.prefix_transfer_bytes,
+                "disagg_fallbacks": self.disagg_fallbacks,
+                **self.router.snapshot(),
+            }
+            shed = self.shed_total
+        return {
+            **totals,
+            "occupancy": sum(occ) / len(occ) if occ else 0.0,
+            "kv_utilization": sum(kvu) / len(kvu) if kvu else 0.0,
+            "shed_total": shed,
+            "fleet": fleet,
+            "replica_metrics": per_replica,
+        }
+
+    def export_gauges(self) -> None:
+        """Scrape-time refresh of the fleet gauge family."""
+        states = self.pool.states()
+        for state in ("starting", "healthy", "dead", "respawning"):
+            REGISTRY.fleet_replicas.set(
+                states.get(state, 0), model=self._owner.name, state=state)
+
+    def shutdown(self, timeout: float = 10.0) -> None:
+        self.pool.shutdown()
+
+
+class FleetServingModel:
+    """ServingModel facade over a replica fleet (the multi-replica
+    counterpart of worker.serving.WorkerServingModel)."""
+
+    def __init__(self, mcfg: ModelConfig, app: AppConfig, factory,
+                 *, replicas: int, prefill_replicas: int = 0,
+                 disagg_threshold: Optional[int] = None):
+        from localai_tpu.models.registry import resolve_tokenizer
+        from localai_tpu.templates.cache import TemplateCache
+
+        self.name = mcfg.name
+        self.config = mcfg
+        self.app = app
+        self.tokenizer = resolve_tokenizer(
+            mcfg.model or mcfg.name, app.model_path)
+        self.templates = TemplateCache(app.model_path)
+        self.vision = None
+        self.image_token_id = 0
+        if mcfg.mmproj:
+            log.warning(
+                "model %s: mmproj is not supported on fleet-served models "
+                "yet; images will be ignored", mcfg.name)
+        # per-replica SLO observatory driving route-around: app-config
+        # latency targets when set; otherwise an error-rate-only objective
+        # (events are bad only on transport/engine errors, so a replica
+        # sheds from routing when >threshold× its error budget burns)
+        targets = targets_from_config(app) or {"e2e_ms": float("inf")}
+        self.slo = SLOTracker(targets=targets)
+        self.pool = ReplicaPool(
+            mcfg.name, factory,
+            replicas=replicas, prefill_replicas=prefill_replicas,
+        )
+        self.pool.start()
+        from localai_tpu.engine.paged import block_tokens_default
+
+        bt = mcfg.engine.kv_block_tokens or block_tokens_default()
+        self.router = Router(self.pool, self.slo, block_tokens=bt)
+        self.scheduler = FleetScheduler(
+            self, self.pool, self.router, self.slo,
+            disagg_threshold=(disagg_threshold
+                              if disagg_threshold is not None
+                              else app.fleet_disagg_threshold),
+        )
+        self.loaded_at = time.monotonic()
+        self.last_used = time.monotonic()
+
+    def touch(self) -> None:
+        self.last_used = time.monotonic()
+
+    @property
+    def busy(self) -> bool:
+        return self.scheduler.busy
+
+    def alive(self) -> bool:
+        """The fleet self-heals dead replicas; the facade only dies when
+        its monitor is gone (manager then rebuilds the whole fleet)."""
+        mon = self.pool._monitor
+        return mon is not None and mon.is_alive()
+
+    def engine_metrics(self) -> dict:
+        return self.scheduler.metrics()
+
+    def fleet_status(self) -> dict:
+        """The /v1/fleet payload for this model."""
+        return {
+            **self.pool.snapshot(with_metrics=True),
+            "router": self.router.snapshot(),
+            "disagg_threshold": self.scheduler.disagg_threshold,
+            "failovers": self.scheduler.failovers,
+            "prefix_transfers": self.scheduler.prefix_transfers,
+            "prefix_transfer_bytes": self.scheduler.prefix_transfer_bytes,
+            "disagg_fallbacks": self.scheduler.disagg_fallbacks,
+            "shedding": {
+                r.id: self.slo.shedding(r.id) for r in self.pool.replicas
+            },
+        }
+
+    def close(self) -> None:
+        self.pool.shutdown()

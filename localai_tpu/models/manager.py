@@ -693,10 +693,30 @@ class ModelManager:
             return sm
 
     def _load(self, mcfg: ModelConfig) -> Any:
+        # fleet tier: with --fleet-replicas N (N>1) an LLM is served from
+        # N data-parallel engine replicas behind one facade (cache-aware
+        # routing, failover, optional prefill/decode disaggregation —
+        # localai_tpu.fleet). Modality backends, externally managed
+        # workers, and embeddings/rerank-capable models keep their
+        # single-engine paths: the fleet facade only speaks the streaming
+        # generation protocol, and /v1/embeddings//v1/rerank need the
+        # in-process runner.embed surface.
+        ext = self.app.external_backends.get(mcfg.name)
+        if (self.app.fleet_replicas > 1 and not ext
+                and mcfg.backend in ("", "worker")):
+            from localai_tpu.config.model_config import Usecase
+
+            if (mcfg.has_usecase(Usecase.EMBEDDINGS)
+                    or mcfg.has_usecase(Usecase.RERANK)):
+                log.warning(
+                    "model %s: embeddings/rerank-capable models are not "
+                    "fleet-served; keeping the single-engine path",
+                    mcfg.name)
+            else:
+                return self._load_fleet(mcfg)
         # worker-tier routing: `backend: worker` spawns a gRPC worker
         # process (crash isolation, initializers.go:271-407);
         # external_backends route to an externally managed worker address
-        ext = self.app.external_backends.get(mcfg.name)
         if ext or mcfg.backend == "worker":
             from localai_tpu.worker.serving import WorkerServingModel
 
@@ -729,6 +749,38 @@ class ModelManager:
                     f"matching endpoint)"
                 ) from None
             raise
+
+    def _load_fleet(self, mcfg: ModelConfig) -> Any:
+        """Build a FleetServingModel: N engine replicas behind one facade
+        (localai_tpu.fleet). fleet_backend picks the replica shape —
+        ``worker`` (default) spawns one gRPC worker process per replica
+        (crash isolation; pin devices per replica via worker_env),
+        ``inprocess`` builds N engines in this process (CPU tests, CI
+        smoke, single-host experiments)."""
+        from localai_tpu.fleet import FleetServingModel
+        from localai_tpu.fleet.replica import InProcessReplica, WorkerReplica
+
+        app = self.app
+        if app.fleet_backend == "inprocess":
+            def factory(rid: str, role: str):
+                # each replica engine gets its own identity: under the
+                # shared name its telemetry/SLO events would double-count
+                # every request the fleet tier already records (worker
+                # replicas are naturally separate — their own process,
+                # their own registry)
+                rcfg = mcfg.model_copy(update={
+                    "name": rid, "model": mcfg.model or mcfg.name})
+                return InProcessReplica(
+                    rid, role, lambda: build_serving_model(rcfg, app))
+        else:
+            def factory(rid: str, role: str):
+                return WorkerReplica(rid, role, mcfg, app,
+                                     env=app.worker_env or None)
+        return FleetServingModel(
+            mcfg, app, factory,
+            replicas=app.fleet_replicas,
+            prefill_replicas=app.fleet_prefill_replicas,
+        )
 
     def _load_image(self, mcfg: ModelConfig) -> ImageServingModel:
         from localai_tpu.image import resolve_image_model
@@ -868,11 +920,13 @@ class ModelManager:
     # -- observability -----------------------------------------------------
 
     def metrics(self) -> dict:
+        # engine_metrics() runs OUTSIDE the manager lock: on fleet/worker
+        # models it pulls stats RPCs (bounded, but seconds when a replica
+        # is wedged) and holding _lock across those would stall every
+        # request's model resolution for the duration of a scrape
         with self._lock:
-            return {
-                name: sm.engine_metrics()
-                for name, sm in self._models.items()
-            }
+            models = list(self._models.items())
+        return {name: sm.engine_metrics() for name, sm in models}
 
     def monitor(self, name: str) -> dict:
         """Per-model status (parity: /backend/monitor via gopsutil,
@@ -880,16 +934,16 @@ class ModelManager:
         stats in-process)."""
         with self._lock:
             sm = self._models.get(name)
-            if sm is None:
-                return {"loaded": False, "name": name}
-            return {
-                "loaded": True,
-                "name": name,
-                "busy": sm.busy,
-                "age_seconds": time.monotonic() - sm.loaded_at,
-                "idle_seconds": time.monotonic() - sm.last_used,
-                **sm.engine_metrics(),
-            }
+        if sm is None:
+            return {"loaded": False, "name": name}
+        return {
+            "loaded": True,
+            "name": name,
+            "busy": sm.busy,
+            "age_seconds": time.monotonic() - sm.loaded_at,
+            "idle_seconds": time.monotonic() - sm.last_used,
+            **sm.engine_metrics(),
+        }
 
 
 class _Watchdog(threading.Thread):

@@ -284,6 +284,26 @@ class Registry:
             "localai_batch_queue_depth",
             "Requests waiting in the scheduler's background batch lane",
         )
+        # -- fleet router (localai_tpu.fleet) ------------------------------
+        self.fleet_replicas = Gauge(
+            "localai_fleet_replicas",
+            "Engine replicas per model by lifecycle state "
+            "(starting/healthy/dead/respawning)",
+        )
+        self.fleet_routed = Counter(
+            "localai_fleet_routed_total",
+            "Requests placed by the fleet router by reason "
+            "(affinity/least_loaded/failover)",
+        )
+        self.fleet_prefix_transfers = Counter(
+            "localai_fleet_prefix_transfers_total",
+            "Disaggregated prefill→decode KV-prefix handoffs completed",
+        )
+        self.fleet_prefix_transfer_bytes = Counter(
+            "localai_fleet_prefix_transfer_bytes_total",
+            "Packed KV-prefix bytes streamed between replicas over "
+            "TransferPrefix",
+        )
         # -- stall forensics + device health (obs.watchdog / obs.device) --
         self.engine_stalled = Gauge(
             "localai_engine_stalled",

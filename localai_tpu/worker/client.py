@@ -96,6 +96,27 @@ class WorkerClient:
         finally:
             self._exit()
 
+    def prefill_prefix(self, opts: pb.PredictOptions,
+                       timeout: float = 600.0,
+                       trace_id: str = "") -> Iterator[pb.PrefixChunk]:
+        """Run a prefill on this (prefill-role) replica and stream back its
+        packed KV-prefix chunks (fleet disaggregation)."""
+        self._enter()
+        try:
+            yield from self._stub.PrefillPrefix(
+                opts, timeout=timeout,
+                metadata=rpc.trace_metadata(trace_id) or None,
+            )
+        finally:
+            self._exit()
+
+    def transfer_prefix(self, chunks: Iterator[pb.PrefixChunk],
+                        timeout: float = 600.0,
+                        trace_id: str = "") -> pb.Result:
+        """Stream prefix chunks into this (decode-role) replica's cache."""
+        return self._call(self._stub.TransferPrefix, chunks, timeout,
+                          metadata=rpc.trace_metadata(trace_id) or None)
+
     def embedding(self, text: str = "", tokens: Optional[list[int]] = None,
                   timeout: float = 600.0) -> list[float]:
         res = self._call(self._stub.Embedding, pb.EmbeddingRequest(

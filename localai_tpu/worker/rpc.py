@@ -43,25 +43,39 @@ def trace_id_from_context(context: Any) -> str:
         pass
     return ""
 
-# name → (is_server_streaming, request type, response type)
-METHODS: dict[str, tuple[bool, Any, Any]] = {
-    "Health": (False, pb.HealthMessage, pb.Reply),
-    "LoadModel": (False, pb.ModelOptions, pb.Result),
-    "Predict": (False, pb.PredictOptions, pb.Reply),
-    "PredictStream": (True, pb.PredictOptions, pb.Reply),
-    "Embedding": (False, pb.EmbeddingRequest, pb.EmbeddingResult),
-    "TokenizeString": (False, pb.TokenizationRequest, pb.TokenizationResponse),
-    "Status": (False, pb.HealthMessage, pb.StatusResponse),
-    "GetMetrics": (False, pb.MetricsRequest, pb.MetricsResponse),
-    "TTS": (False, pb.TTSRequest, pb.AudioResult),
-    "SoundGeneration": (False, pb.SoundGenerationRequest, pb.AudioResult),
-    "AudioTranscription": (False, pb.TranscriptRequest, pb.TranscriptResult),
-    "GenerateImage": (False, pb.GenerateImageRequest, pb.ImageResult),
-    "Rerank": (False, pb.RerankRequest, pb.RerankResult),
-    "StoresSet": (False, pb.StoresSetOptions, pb.Result),
-    "StoresDelete": (False, pb.StoresDeleteOptions, pb.Result),
-    "StoresGet": (False, pb.StoresGetOptions, pb.StoresGetResult),
-    "StoresFind": (False, pb.StoresFindOptions, pb.StoresFindResult),
+# streaming kinds: which side of the RPC is a message stream
+UNARY = "unary"
+SERVER_STREAM = "server_stream"
+CLIENT_STREAM = "client_stream"
+
+# name → (kind, request type, response type)
+METHODS: dict[str, tuple[str, Any, Any]] = {
+    "Health": (UNARY, pb.HealthMessage, pb.Reply),
+    "LoadModel": (UNARY, pb.ModelOptions, pb.Result),
+    "Predict": (UNARY, pb.PredictOptions, pb.Reply),
+    "PredictStream": (SERVER_STREAM, pb.PredictOptions, pb.Reply),
+    "Embedding": (UNARY, pb.EmbeddingRequest, pb.EmbeddingResult),
+    "TokenizeString": (UNARY, pb.TokenizationRequest, pb.TokenizationResponse),
+    "Status": (UNARY, pb.HealthMessage, pb.StatusResponse),
+    "GetMetrics": (UNARY, pb.MetricsRequest, pb.MetricsResponse),
+    "TTS": (UNARY, pb.TTSRequest, pb.AudioResult),
+    "SoundGeneration": (UNARY, pb.SoundGenerationRequest, pb.AudioResult),
+    "AudioTranscription": (UNARY, pb.TranscriptRequest, pb.TranscriptResult),
+    "GenerateImage": (UNARY, pb.GenerateImageRequest, pb.ImageResult),
+    "Rerank": (UNARY, pb.RerankRequest, pb.RerankResult),
+    "StoresSet": (UNARY, pb.StoresSetOptions, pb.Result),
+    "StoresDelete": (UNARY, pb.StoresDeleteOptions, pb.Result),
+    "StoresGet": (UNARY, pb.StoresGetOptions, pb.StoresGetResult),
+    "StoresFind": (UNARY, pb.StoresFindOptions, pb.StoresFindResult),
+    # fleet disaggregation: prefill export out, prefix-block transfer in
+    "PrefillPrefix": (SERVER_STREAM, pb.PredictOptions, pb.PrefixChunk),
+    "TransferPrefix": (CLIENT_STREAM, pb.PrefixChunk, pb.Result),
+}
+
+_HANDLER_FACTORY = {
+    UNARY: grpc.unary_unary_rpc_method_handler,
+    SERVER_STREAM: grpc.unary_stream_rpc_method_handler,
+    CLIENT_STREAM: grpc.stream_unary_rpc_method_handler,
 }
 
 
@@ -70,15 +84,13 @@ def add_servicer(server: grpc.Server, servicer: Any) -> None:
     answer UNIMPLEMENTED (parity: base.Base unimplemented defaults,
     /root/reference/pkg/grpc/base/base.go:16-49)."""
     handlers: dict[str, grpc.RpcMethodHandler] = {}
-    for name, (streaming, req_t, resp_t) in METHODS.items():
+    for name, (kind, req_t, resp_t) in METHODS.items():
         fn = getattr(servicer, name, None)
         if fn is None:
             def fn(request, context, _n=name):  # noqa: ANN001
                 context.abort(grpc.StatusCode.UNIMPLEMENTED,
                               f"{_n} not implemented by this worker")
-        make = (grpc.unary_stream_rpc_method_handler if streaming
-                else grpc.unary_unary_rpc_method_handler)
-        handlers[name] = make(
+        handlers[name] = _HANDLER_FACTORY[kind](
             fn,
             request_deserializer=req_t.FromString,
             response_serializer=resp_t.SerializeToString,
@@ -92,11 +104,13 @@ class BackendStub:
     """Client stub: one callable per method, typed by METHODS."""
 
     def __init__(self, channel: grpc.Channel):
-        for name, (streaming, req_t, resp_t) in METHODS.items():
-            factory: Callable = (
-                channel.unary_stream if streaming else channel.unary_unary
-            )
-            setattr(self, name, factory(
+        factories: dict[str, Callable] = {
+            UNARY: channel.unary_unary,
+            SERVER_STREAM: channel.unary_stream,
+            CLIENT_STREAM: channel.stream_unary,
+        }
+        for name, (kind, req_t, resp_t) in METHODS.items():
+            setattr(self, name, factories[kind](
                 f"/{SERVICE}/{name}",
                 request_serializer=req_t.SerializeToString,
                 response_deserializer=resp_t.FromString,

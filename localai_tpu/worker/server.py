@@ -32,6 +32,56 @@ from localai_tpu.worker import rpc
 log = logging.getLogger(__name__)
 
 
+def gen_request_from_options(req: pb.PredictOptions, sm,
+                             trace_id: str = ""):
+    """PredictOptions → GenRequest against a ServingModel (the wire→engine
+    converter; inverse of worker.serving.predict_options). Shared by the
+    gRPC servicer and in-process fleet replicas, so both replica kinds
+    decode one request schema identically."""
+    from localai_tpu.engine.scheduler import GenRequest
+
+    if req.tokens:
+        prompt = list(req.tokens)
+    else:
+        prompt = sm.tokenizer.encode(req.prompt, add_bos=req.add_bos)
+    constraint = None
+    if req.constraint_schema:
+        from localai_tpu.functions.constraint import constraint_for_schema
+
+        constraint = constraint_for_schema(
+            json.loads(req.constraint_schema), sm.tokenizer
+        )
+    elif req.constraint_regex:
+        from localai_tpu.functions.constraint import constraint_for_regex
+
+        constraint = constraint_for_regex(req.constraint_regex, sm.tokenizer)
+
+    def opt(name):
+        return getattr(req, name) if req.HasField(name) else None
+
+    return GenRequest(
+        prompt=prompt,
+        max_new_tokens=req.max_tokens or 2048,
+        temperature=opt("temperature"),
+        top_k=opt("top_k"),
+        top_p=opt("top_p"),
+        min_p=opt("min_p"),
+        repeat_penalty=opt("repeat_penalty"),
+        presence_penalty=opt("presence_penalty"),
+        frequency_penalty=opt("frequency_penalty"),
+        seed=opt("seed"),
+        logit_bias=dict(req.logit_bias) or None,
+        stop=tuple(req.stop),
+        ignore_eos=req.ignore_eos,
+        constraint=constraint,
+        correlation_id=req.correlation_id,
+        # propagated from the API tier over gRPC metadata: the worker's
+        # engine spans record under the same trace id (obs subsystem)
+        trace_id=trace_id or req.correlation_id,
+        stream=req.stream,
+    )
+
+
 class BackendServicer:
     """LLM worker: Predict/PredictStream/Embedding + lifecycle RPCs.
 
@@ -125,50 +175,7 @@ class BackendServicer:
         return self._sm
 
     def _gen_request(self, req: pb.PredictOptions, sm, trace_id: str = ""):
-        from localai_tpu.engine.scheduler import GenRequest
-
-        if req.tokens:
-            prompt = list(req.tokens)
-        else:
-            prompt = sm.tokenizer.encode(req.prompt, add_bos=req.add_bos)
-        constraint = None
-        if req.constraint_schema:
-            from localai_tpu.functions.constraint import constraint_for_schema
-
-            constraint = constraint_for_schema(
-                json.loads(req.constraint_schema), sm.tokenizer
-            )
-        elif req.constraint_regex:
-            from localai_tpu.functions.constraint import constraint_for_regex
-
-            constraint = constraint_for_regex(
-                req.constraint_regex, sm.tokenizer
-            )
-
-        def opt(name):
-            return getattr(req, name) if req.HasField(name) else None
-
-        return GenRequest(
-            prompt=prompt,
-            max_new_tokens=req.max_tokens or 2048,
-            temperature=opt("temperature"),
-            top_k=opt("top_k"),
-            top_p=opt("top_p"),
-            min_p=opt("min_p"),
-            repeat_penalty=opt("repeat_penalty"),
-            presence_penalty=opt("presence_penalty"),
-            frequency_penalty=opt("frequency_penalty"),
-            seed=opt("seed"),
-            logit_bias=dict(req.logit_bias) or None,
-            stop=tuple(req.stop),
-            ignore_eos=req.ignore_eos,
-            constraint=constraint,
-            correlation_id=req.correlation_id,
-            # propagated from the API tier over gRPC metadata: the worker's
-            # engine spans record under the same trace id (obs subsystem)
-            trace_id=trace_id or req.correlation_id,
-            stream=req.stream,
-        )
+        return gen_request_from_options(req, sm, trace_id=trace_id)
 
     def Predict(self, request: pb.PredictOptions, context) -> pb.Reply:
         sm = self._require_model(context)
@@ -207,6 +214,64 @@ class BackendServicer:
         finally:
             if not context.is_active():
                 handle.cancel()
+
+    # -- fleet disaggregation (localai_tpu.fleet) ------------------------
+
+    def _fleet_cache(self, sm):
+        """The replica's in-memory prefix cache, attached lazily on first
+        PrefillPrefix/TransferPrefix use. A configured disk prompt cache
+        has the lookup/store surface but not the ``wait_for`` signalling
+        the export blocks on, so the RAM tier FRONTS it (stores forward,
+        missed lookups fall through — scheduler.attach_prompt_cache
+        layer=True) instead of replacing it."""
+        sched = sm.scheduler
+        if not hasattr(sched.prompt_cache, "wait_for"):
+            from localai_tpu.fleet.prefix import PrefixCache
+
+            with self._lock:
+                if not hasattr(sched.prompt_cache, "wait_for"):
+                    sched.attach_prompt_cache(PrefixCache(
+                        min_prefix=getattr(sm.runner, "prefix_reuse_min",
+                                           16)), layer=True)
+        return sched.prompt_cache
+
+    def PrefillPrefix(self, request: pb.PredictOptions,
+                      context) -> Iterator[pb.PrefixChunk]:
+        """Prefill-replica half of the disaggregated handoff: run the
+        prompt's prefill (one sampled token, then the slot frees), wait
+        for the scheduler's off-thread prefix export, and stream the
+        packed KV rows out in bounded chunks."""
+        from localai_tpu.fleet.prefix import (PrefixUnavailable,
+                                              export_prefix, pack_chunks)
+
+        sm = self._require_model(context)
+        cache = self._fleet_cache(sm)
+        gr = self._gen_request(request, sm,
+                               trace_id=rpc.trace_id_from_context(context))
+        try:
+            prompt, arrays = export_prefix(sm, gr, cache)
+        except ValueError as e:
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+        except PrefixUnavailable as e:
+            context.abort(grpc.StatusCode.FAILED_PRECONDITION, str(e))
+        except RuntimeError as e:
+            context.abort(grpc.StatusCode.INTERNAL, str(e))
+        for chunk in pack_chunks(prompt, arrays):
+            yield pb.PrefixChunk(**chunk)
+
+    def TransferPrefix(self, request_iterator, context) -> pb.Result:
+        """Decode-replica half: assemble the streamed chunks and seed the
+        prefix cache — the next PredictStream for this prompt
+        load_prefix-resumes past the transferred rows at admission."""
+        from localai_tpu.fleet.prefix import import_prefix
+
+        sm = self._require_model(context)
+        cache = self._fleet_cache(sm)
+        try:
+            n = import_prefix(cache, request_iterator)
+        except ValueError as e:
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+        return pb.Result(success=True, message=f"{n} rows")
 
     def Embedding(self, request: pb.EmbeddingRequest,
                   context) -> pb.EmbeddingResult:

@@ -42,17 +42,21 @@ _SAMPLING_FIELDS = (
 class WorkerGenHandle(GenHandle):
     """GenHandle fed from a PredictStream RPC instead of the engine thread.
     Token ids don't cross the wire, so completion counts come from the
-    final Reply's usage fields."""
+    final Reply's usage fields — and when the stream dies BEFORE that
+    final reply (worker killed mid-generation), from the count of
+    streamed deltas (each Reply carries >= 1 sampled token), so a failed
+    request never reports 0 tokens for work the engine actually did."""
 
     def __init__(self, req: GenRequest, rid: int):
         super().__init__(req, rid)
         self._completion_override: Optional[int] = None
+        self._streamed_deltas = 0
 
     @property
     def completion_tokens(self) -> int:
         if self._completion_override is not None:
             return self._completion_override
-        return len(self.token_ids)
+        return max(len(self.token_ids), self._streamed_deltas)
 
 
 def predict_options(gr: GenRequest) -> pb.PredictOptions:
@@ -82,6 +86,42 @@ def predict_options(gr: GenRequest) -> pb.PredictOptions:
                 "worker will decode unconstrained"
             )
     return opts
+
+
+def consume_stream(handle: WorkerGenHandle, replies, *,
+                   watchdog=None, channel: str = "",
+                   tr=None) -> tuple[str, bool]:
+    """Drain one PredictStream-shaped reply iterator into ``handle``.
+
+    The one place the wire protocol is interpreted on the API side —
+    WorkerScheduler (single worker) and fleet.FleetScheduler (replica
+    fleets) both feed their handles through here, so a protocol change
+    cannot diverge their accounting. Returns ``(finish, got_final)``:
+    ``got_final=False`` means the stream ended WITHOUT the final usage
+    Reply — the worker/replica died mid-generation; the caller decides
+    whether that is a failover signal (fleet) or a terminal error."""
+    finish = "stop"
+    got_final = False
+    for reply in replies:
+        if watchdog is not None:
+            watchdog.pulse(channel)
+        if handle.cancelled:
+            finish = "cancelled"
+            got_final = True
+            break
+        if reply.finish_reason:
+            finish = reply.finish_reason
+            got_final = True
+            handle._completion_override = reply.tokens or None
+            if reply.prompt_tokens:
+                handle.prompt_tokens = reply.prompt_tokens
+            break
+        if reply.message:
+            if tr is not None and handle.t_first_token is None:
+                tr.event("first_delta")
+            handle._streamed_deltas += 1
+            handle._emit(reply.message.decode("utf-8", "replace"), None)
+    return finish, got_final
 
 
 class WorkerScheduler:
@@ -145,25 +185,22 @@ class WorkerScheduler:
             if tr is not None:
                 tr.end("queued")
                 tr.begin("rpc", worker=client.address)
-            finish = "stop"
-            for reply in client.predict_stream(
+            finish, got_final = consume_stream(
+                handle,
+                client.predict_stream(
                     opts, timeout=600.0,
-                    trace_id=req.trace_id or req.correlation_id):
-                self.watchdog.pulse(self._wd_channel)
-                if handle.cancelled:
-                    finish = "cancelled"
-                    break
-                if reply.finish_reason:
-                    finish = reply.finish_reason
-                    handle._completion_override = reply.tokens or None
-                    if reply.prompt_tokens:
-                        handle.prompt_tokens = reply.prompt_tokens
-                    break
-                if reply.message:
-                    if tr is not None and handle.t_first_token is None:
-                        tr.event("first_delta")
-                    handle._emit(reply.message.decode("utf-8", "replace"),
-                                 None)
+                    trace_id=req.trace_id or req.correlation_id),
+                watchdog=self.watchdog, channel=self._wd_channel, tr=tr)
+            if not got_final:
+                # the stream ended without the final usage Reply: the
+                # worker died (or the tunnel dropped) mid-generation.
+                # Mark the handle failed — completion_tokens falls back
+                # to the streamed-delta count instead of reporting 0.
+                finish = "error"
+                log.warning(
+                    "worker request %d: stream ended without a final "
+                    "reply after %d deltas", handle.id,
+                    handle._streamed_deltas)
             # trace retires before _finish unblocks the awaiting handler
             self.telemetry.finished(tr, handle, finish)
             handle._finish(finish)

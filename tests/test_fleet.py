@@ -1,0 +1,568 @@
+"""Fleet router: placement, failover, shed route-around, disaggregation.
+
+Placement invariants run against stub replicas (pure host arithmetic);
+serving invariants run against a real 2-decode + 1-prefill in-process
+fleet of the tiny debug model; the wire contract (PrefillPrefix →
+TransferPrefix) runs against two real in-process gRPC workers — the
+acceptance matrix of ISSUE 7 on CPU."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from localai_tpu.config.app_config import AppConfig
+from localai_tpu.config.model_config import ModelConfig
+from localai_tpu.engine.scheduler import GenRequest
+from localai_tpu.fleet.prefix import PrefixCache, assemble_chunks, pack_chunks
+from localai_tpu.fleet.replica import BaseReplica, _Reply
+from localai_tpu.fleet.router import FleetUnavailable, Router, affinity_key
+
+TINY = {
+    "name": "ftiny", "model": "debug:tiny", "context_size": 256,
+    "parameters": {"temperature": 0.0, "max_tokens": 8},
+    "engine": {"max_slots": 2, "prefill_buckets": [16, 32, 64, 128],
+               "dtype": "float32", "kv_dtype": "float32",
+               "kv_block_tokens": 16},
+}
+
+
+# ---------------------------------------------------------------------------
+# wire codec + prefix cache (no engines)
+
+
+def _fake_arrays(n=24, bf16=False):
+    k = np.arange(2 * 3 * n * 4, dtype=np.float32).reshape(2, 3, n, 4)
+    out = {"k": k, "v": k + 1.0,
+           "kv_dtype": np.asarray("float32"), "kv_rope": np.asarray("roped")}
+    if bf16:
+        out["k"] = out["k"].astype(np.uint16)
+        out["k_bf16"] = np.int8(1)
+    return out
+
+
+def test_chunk_roundtrip_and_ordering():
+    tokens = list(range(100, 140))
+    arrays = _fake_arrays(bf16=True)
+    chunks = list(pack_chunks(tokens, arrays, chunk_bytes=256))
+    assert len(chunks) > 1 and chunks[-1]["last"]
+    assert chunks[0]["tokens"] == tokens and chunks[0]["n_tokens"] == 24
+    got_tokens, got = assemble_chunks(iter(chunks))
+    assert got_tokens == tokens
+    np.testing.assert_array_equal(got["k"], arrays["k"])
+    np.testing.assert_array_equal(got["v"], arrays["v"])
+    assert "k_bf16" in got  # dtype markers survive the wire
+
+    # out-of-order and truncated streams are refused, not mis-assembled
+    with pytest.raises(ValueError, match="out-of-order"):
+        assemble_chunks(iter([chunks[1]]))
+    with pytest.raises(ValueError, match="truncated"):
+        assemble_chunks(iter(chunks[:-1]))
+
+
+def test_prefix_cache_lcp_and_wait():
+    cache = PrefixCache(min_prefix=8)
+    tokens = list(range(24))
+    cache.store(tokens, _fake_arrays())
+    # full-prompt hit still leaves the 1-token recompute tail
+    hit = cache.lookup(tokens + [99])
+    assert hit is not None and hit.n == 24 and hit.tokens == tokens
+    assert cache.lookup(list(range(50, 60))) is None  # no shared prefix
+    # a store below min_prefix never lands
+    cache.store([1, 2, 3], _fake_arrays(n=3))
+    assert cache.stats()["stores"] == 1
+
+    # wait_for unblocks a waiter when the writer thread stores
+    got = {}
+
+    def waiter():
+        got["arrays"] = cache.wait_for(list(range(200, 224)), timeout=5.0)
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    cache.store(list(range(200, 224)), _fake_arrays())
+    t.join(5.0)
+    assert got["arrays"] is not None
+
+
+def test_assemble_rejects_corrupt_payload():
+    # a garbled npz body must surface as ValueError (the TransferPrefix
+    # handler maps that to INVALID_ARGUMENT), never zipfile.BadZipFile
+    chunks = [{"transfer_id": "t", "seq": 0, "last": True,
+               "data": b"PK\x03\x04 definitely not an npz",
+               "tokens": list(range(20)), "n_tokens": 20}]
+    with pytest.raises(ValueError, match="corrupt"):
+        assemble_chunks(chunks)
+
+
+def test_prefix_cache_byte_budget_and_disk_fallthrough(tmp_path):
+    # byte budget: evict LRU past max_bytes, keep the newest entry even
+    # when it alone exceeds the budget (the exporter blocks on it)
+    small = PrefixCache(min_prefix=8, max_bytes=1)
+    small.store(list(range(24)), _fake_arrays())
+    assert small.stats()["entries"] == 1
+    small.store(list(range(100, 124)), _fake_arrays())
+    assert small.stats()["entries"] == 1  # first evicted, newest kept
+
+    # fallthrough: stores forward to a disk tier; a RAM miss falls
+    # through to it (a fleet replica with a configured disk prompt cache
+    # keeps both reuse tiers — scheduler.attach_prompt_cache layer=True)
+    from localai_tpu.engine.promptcache import PromptKVCache
+
+    disk = PromptKVCache(tmp_path, min_prefix=8)
+    ram = PrefixCache(min_prefix=8, fallthrough=disk, max_entries=1)
+    ram.store(list(range(24)), _fake_arrays())
+    assert disk.stats()["stores"] == 1
+    ram.store(list(range(200, 224)), _fake_arrays())  # evicts the first
+    hit = ram.lookup(list(range(24)) + [99])          # RAM miss → disk hit
+    assert hit is not None and hit.n == 24
+
+
+# ---------------------------------------------------------------------------
+# router placement (stub replicas)
+
+
+class _StubReplica:
+    def __init__(self, rid, role="decode", state="healthy", inflight=0):
+        self.id, self.role, self.state = rid, role, state
+        self.inflight = inflight
+        self.dispatched = 0
+
+    @property
+    def load(self):
+        return (self.inflight, self.dispatched)
+
+
+class _StubPool:
+    def __init__(self, replicas):
+        self.replicas = replicas
+
+    def healthy(self, role="decode"):
+        return [r for r in self.replicas
+                if r.state == "healthy" and r.role == role]
+
+
+def _prompt(seed, tail=0):
+    return [seed] * 64 + list(range(tail))
+
+
+def test_affinity_keeps_same_prefix_on_one_replica():
+    pool = _StubPool([_StubReplica(f"m/r{i}") for i in range(3)])
+    router = Router(pool, None, block_tokens=16)
+    # same first blocks, different tails → same replica every time
+    picks = {router.route(_prompt(7, tail=t))[0].id for t in (0, 5, 11, 23)}
+    assert len(picks) == 1
+    assert router.routed["affinity"] == 4
+    # a short prompt (no full block) has no affinity signal
+    _, reason = router.route([1, 2, 3])
+    assert reason == "least_loaded"
+
+
+def test_consistent_hashing_remaps_only_the_lost_replica():
+    ids = [f"m/r{i}" for i in range(3)]
+    full = _StubPool([_StubReplica(r) for r in ids])
+    prompts = [_prompt(s) for s in range(40)]
+    before = {tuple(p): Router(full, None, block_tokens=16).route(p)[0].id
+              for p in prompts}
+    lost = ids[2]
+    smaller = _StubPool([_StubReplica(r) for r in ids[:2]])
+    router = Router(smaller, None, block_tokens=16)
+    moved = sum(
+        1 for p in prompts
+        if before[tuple(p)] != lost
+        and router.route(p)[0].id != before[tuple(p)]
+    )
+    assert moved == 0  # only the lost replica's keys remap
+
+
+def test_shed_replica_routed_around():
+    pool = _StubPool([_StubReplica(f"m/r{i}") for i in range(3)])
+    router = Router(pool, None, block_tokens=16)
+    target = router.route(_prompt(3))[0]
+
+    class _Shed:
+        def __init__(self, shed):
+            self.shed = shed
+
+        def shedding(self, rid):
+            return rid in self.shed
+
+    router = Router(pool, _Shed({target.id}), block_tokens=16)
+    pick, reason = router.route(_prompt(3))
+    assert pick.id != target.id and reason == "affinity"
+    assert router.routed_around == 1
+    # every replica shedding: degrade to serving, not a fleet-wide 503
+    router = Router(pool, _Shed({r.id for r in pool.replicas}),
+                    block_tokens=16)
+    assert router.route(_prompt(3))[0] is not None
+
+
+def test_failover_excludes_and_exhausts():
+    pool = _StubPool([_StubReplica("m/r0"), _StubReplica("m/r1")])
+    router = Router(pool, None, block_tokens=16)
+    p = _prompt(9)
+    first = router.route(p)[0]
+    second, reason = router.route(p, exclude={first.id}, failover=True)
+    assert second.id != first.id and reason == "failover"
+    with pytest.raises(FleetUnavailable):
+        router.route(p, exclude={first.id, second.id})
+
+
+def test_affinity_key_block_granularity():
+    assert affinity_key(list(range(10)), block_tokens=16) is None
+    a = affinity_key(list(range(100)), block_tokens=16, blocks=4)
+    b = affinity_key(list(range(64)) + [999] * 36, block_tokens=16, blocks=4)
+    assert a == b  # only the first K blocks participate
+    assert a != affinity_key([5] + list(range(1, 100)), block_tokens=16)
+
+
+# ---------------------------------------------------------------------------
+# in-process fleet (real engines)
+
+
+def _build_fleet(replicas=2, prefill=1, threshold=48):
+    from localai_tpu.fleet import FleetServingModel
+    from localai_tpu.fleet.replica import InProcessReplica
+    from localai_tpu.models.manager import build_serving_model
+
+    app = AppConfig()
+    mcfg = ModelConfig.model_validate(TINY)
+
+    def factory(rid, role):
+        return InProcessReplica(
+            rid, role, lambda: build_serving_model(mcfg, app))
+
+    return FleetServingModel(mcfg, app, factory, replicas=replicas,
+                             prefill_replicas=prefill,
+                             disagg_threshold=threshold)
+
+
+@pytest.fixture(scope="module")
+def fleet():
+    fm = _build_fleet()
+    yield fm
+    fm.close()
+
+
+def _gen(fm, text, max_new=6, **kw):
+    h = fm.scheduler.submit(GenRequest(
+        prompt=fm.tokenizer.encode(text), max_new_tokens=max_new,
+        temperature=0.0, **kw))
+    h.result(timeout=300)
+    return h
+
+
+def test_fleet_affinity_placement_serves_one_replica(fleet):
+    prompt = "the same shared prompt prefix, different request"  # ≥ 1 block
+    texts = set()
+    for _ in range(3):
+        h = _gen(fleet, prompt)
+        assert h.finish_reason in ("stop", "length")
+        texts.add(h.text)
+    assert len(texts) == 1  # greedy determinism through the fleet
+    # all three landed on one replica (prefix reuse survives scale-out)
+    served = [r for r in fleet.pool.replicas
+              if r.role == "decode" and r.dispatched > 0]
+    assert len(served) == 1
+    assert fleet.router.routed["affinity"] >= 3
+
+
+def test_disaggregated_handoff_matches_single_engine(fleet):
+    from localai_tpu.models.manager import build_serving_model
+
+    long_prompt = "disaggregate this long prompt please " * 5  # ≥ threshold
+    before = fleet.scheduler.prefix_transfers
+    h = _gen(fleet, long_prompt, max_new=8)
+    assert h.finish_reason in ("stop", "length")
+    assert fleet.scheduler.prefix_transfers == before + 1
+    assert fleet.scheduler.prefix_transfer_bytes > 0
+
+    # byte-identical greedy completion vs one single paged engine
+    single = build_serving_model(ModelConfig.model_validate(TINY),
+                                 AppConfig())
+    try:
+        ref = single.scheduler.submit(GenRequest(
+            prompt=single.tokenizer.encode(long_prompt),
+            max_new_tokens=8, temperature=0.0))
+        ref.result(timeout=300)
+        assert ref.text == h.text
+    finally:
+        single.scheduler.shutdown()
+
+
+def test_dead_replica_failover_and_respawn(fleet):
+    prompt = "failover probe prompt, affinity-long"  # 1 block, < threshold
+    target, _ = fleet.router.route(fleet.tokenizer.encode(prompt))
+    target.kill()
+    # dispatch to the corpse fails instantly (no tokens streamed) → the
+    # request fails over and completes on another replica
+    h = _gen(fleet, prompt)
+    assert h.finish_reason in ("stop", "length")
+    assert fleet.scheduler.failovers >= 1
+    assert target.state in ("dead", "respawning", "healthy")
+    # subsequent requests route around the dead replica
+    if target.state != "healthy":
+        pick, _ = fleet.router.route(fleet.tokenizer.encode(prompt))
+        assert pick.id != target.id
+    # ... until its respawn passes health and it rejoins the ring
+    deadline = time.monotonic() + 180
+    while target.state != "healthy" and time.monotonic() < deadline:
+        time.sleep(0.1)
+    assert target.state == "healthy"
+    # the crash left an error burst in the replica's SLO window, so the
+    # router keeps routing AROUND it (shedding) until the window drains —
+    # prove both halves: traffic still lands somewhere healthy now, and
+    # affinity returns the moment the burst is gone (reset = time passing)
+    pick, _ = fleet.router.route(fleet.tokenizer.encode(prompt))
+    assert pick.state == "healthy"
+    fleet.slo.reset()
+    pick, _ = fleet.router.route(fleet.tokenizer.encode(prompt))
+    assert pick.id == target.id  # affinity restored after recovery
+
+
+def test_kill_mid_request_fleet_keeps_serving(fleet):
+    prompt = "stream then die midway through here"  # 1 block, < threshold
+    target, _ = fleet.router.route(fleet.tokenizer.encode(prompt))
+    h = fleet.scheduler.submit(GenRequest(
+        prompt=fleet.tokenizer.encode(prompt), max_new_tokens=200,
+        temperature=0.0, ignore_eos=True, stream=True))
+    for item in h:
+        if item.delta:
+            target.kill()
+            break
+    h.result(timeout=120)
+    # the kill races the (fast) tiny engine: either it landed mid-stream
+    # (clean error, streamed deltas still counted) or the stream had
+    # already finished — never a hang, never a zero-token "success"
+    assert h.finish_reason in ("error", "length", "stop")
+    assert h.completion_tokens > 0
+    # the fleet keeps serving while the corpse respawns
+    h2 = _gen(fleet, "the fleet survives a replica death")
+    assert h2.finish_reason in ("stop", "length")
+    deadline = time.monotonic() + 180
+    while target.state != "healthy" and time.monotonic() < deadline:
+        time.sleep(0.1)
+    assert target.state == "healthy"
+    fleet.slo.reset()  # drain the crash burst for later tests
+
+
+# ---------------------------------------------------------------------------
+# failover semantics, pinned deterministically with scripted replicas
+
+
+class _ScriptedReplica(BaseReplica):
+    """Stub replica whose predict_stream plays a script: "delta" yields
+    one message, "raise" dies mid-transport, anything else ends the
+    stream with a usage reply."""
+
+    def __init__(self, rid, role):
+        super().__init__(rid, role)
+        self.dead_flag = False
+        self.script = []
+
+    def start(self):
+        pass
+
+    def _dial(self, timeout):
+        return not self.dead_flag
+
+    def process_alive(self):
+        return not self.dead_flag
+
+    def predict_stream(self, opts, trace_id=""):
+        steps = self.script.pop(0) if self.script else ["final"]
+        for step in steps:
+            if step == "delta":
+                yield _Reply(b"x")
+            elif step == "raise":
+                self.dead_flag = True
+                raise RuntimeError("scripted transport death")
+            else:
+                yield _Reply(b"", 3, 5, "stop")
+
+    def metrics(self):
+        return {}
+
+    def stop(self):
+        pass
+
+
+def _scripted_fleet():
+    from types import SimpleNamespace
+
+    from localai_tpu.fleet.pool import ReplicaPool
+    from localai_tpu.fleet.serving import FleetScheduler
+    from localai_tpu.obs.slo import SLOTracker
+
+    pool = ReplicaPool("scripted", _ScriptedReplica, replicas=2,
+                       health_interval=3600.0)
+    pool.start()
+    router = Router(pool, None, block_tokens=16)
+    sched = FleetScheduler(
+        SimpleNamespace(name="scripted"), pool, router,
+        SLOTracker(targets={"e2e_ms": float("inf")}),
+        disagg_threshold=1 << 30)
+    return pool, router, sched
+
+
+def test_prestream_death_fails_over_transparently():
+    pool, router, sched = _scripted_fleet()
+    try:
+        prompt = list(range(32))
+        target, _ = router.route(prompt)
+        target.script = [["raise"]]          # dies before any delta
+        h = sched.submit(GenRequest(prompt=prompt, max_new_tokens=4))
+        h.result(timeout=30)
+        assert h.finish_reason == "stop"     # the other replica finished it
+        assert sched.failovers == 1
+        assert target.state in ("dead", "respawning")
+    finally:
+        pool.shutdown()
+
+
+def test_midstream_death_is_a_clean_error():
+    pool, router, sched = _scripted_fleet()
+    try:
+        prompt = list(range(32))
+        target, _ = router.route(prompt)
+        target.script = [["delta", "delta", "raise"]]  # dies mid-stream
+        h = sched.submit(GenRequest(prompt=prompt, max_new_tokens=4))
+        h.result(timeout=30)
+        # tokens already reached the client: not transparently resumable —
+        # a clean error, with the streamed work still counted
+        assert h.finish_reason == "error"
+        assert h.completion_tokens == 2
+        assert sched.failovers == 0
+        # the fleet itself keeps serving on the survivor
+        h2 = sched.submit(GenRequest(prompt=prompt, max_new_tokens=4))
+        h2.result(timeout=30)
+        assert h2.finish_reason == "stop"
+    finally:
+        pool.shutdown()
+
+
+def test_fleet_metrics_and_gauges(fleet):
+    from localai_tpu.obs.metrics import REGISTRY
+
+    m = fleet.engine_metrics()
+    assert m["total_generated_tokens"] > 0
+    assert m["fleet"]["replicas"].get("healthy", 0) >= 1
+    assert sum(m["fleet"]["routed"].values()) > 0
+    status = fleet.fleet_status()
+    assert {r["id"] for r in status["replicas"]} == \
+        {r.id for r in fleet.pool.replicas}
+    fleet.scheduler.export_gauges()
+    expo = REGISTRY.render()
+    assert 'localai_fleet_replicas{model="ftiny",state="healthy"}' in expo
+    assert 'localai_fleet_routed_total{model="ftiny"' in expo
+    assert ('localai_fleet_prefix_transfer_bytes_total{model="ftiny"}'
+            in expo)
+
+
+# ---------------------------------------------------------------------------
+# the real thing: spawned worker processes, kill -9, respawn
+
+
+@pytest.mark.slow
+def test_worker_fleet_kill9_failover_and_respawn(tmp_path):
+    """kill -9 of one worker replica mid-stream: the request fails over
+    (or errors cleanly if tokens already streamed), the serving process
+    stays up, subsequent requests route around the corpse, and the
+    replica rejoins after its respawn passes health."""
+    from localai_tpu.fleet import FleetServingModel
+    from localai_tpu.fleet.replica import WorkerReplica
+
+    app = AppConfig(model_path=str(tmp_path),
+                    worker_env={"JAX_PLATFORMS": "cpu"})
+    mcfg = ModelConfig.model_validate({**TINY, "context_size": 96})
+
+    def factory(rid, role):
+        return WorkerReplica(rid, role, mcfg, app, env=app.worker_env)
+
+    fm = FleetServingModel(mcfg, app, factory, replicas=2)
+    try:
+        prompt = "kill nine this worker replica mid-stream"
+        target, _ = fm.router.route(fm.tokenizer.encode(prompt))
+        h = fm.scheduler.submit(GenRequest(
+            prompt=fm.tokenizer.encode(prompt), max_new_tokens=80,
+            temperature=0.0, ignore_eos=True, stream=True))
+        killed = False
+        for item in h:
+            if item.delta and not killed:
+                target.kill()  # SIGKILL the worker process
+                killed = True
+            if item.finish_reason is not None:
+                break
+        assert killed
+        h.result(timeout=240)
+        # mid-stream → clean error; if the tiny engine outran the kill,
+        # a natural finish — never a hang, never a 0-token success
+        assert h.finish_reason in ("error", "length", "stop")
+        assert h.completion_tokens > 0
+
+        # the serving process survives and the fleet keeps serving
+        h2 = _gen(fm, "the fleet is still serving after kill -9")
+        assert h2.finish_reason in ("stop", "length")
+        if target.state != "healthy":
+            pick, _ = fm.router.route(fm.tokenizer.encode(prompt))
+            assert pick.id != target.id  # routed around the corpse
+
+        # ...until the respawned process passes health + LoadModel again
+        deadline = time.monotonic() + 300
+        while target.state != "healthy" and time.monotonic() < deadline:
+            time.sleep(0.2)
+        assert target.state == "healthy"
+        fm.slo.reset()  # the crash burst has served its purpose
+        h3 = _gen(fm, prompt)
+        assert h3.finish_reason in ("stop", "length")
+    finally:
+        fm.close()
+
+
+# ---------------------------------------------------------------------------
+# the wire contract: PrefillPrefix → TransferPrefix across real gRPC workers
+
+
+def test_prefix_transfer_over_grpc_workers():
+    import yaml
+
+    from localai_tpu.worker import WorkerClient
+    from localai_tpu.worker import backend_pb2 as pb
+    from localai_tpu.worker.server import BackendServicer, serve_worker
+
+    cfg_yaml = yaml.safe_dump({**TINY, "context_size": 96})
+    servers = []
+    clients = []
+    try:
+        for _ in range(2):
+            servicer = BackendServicer()
+            server, port = serve_worker("127.0.0.1:0", servicer=servicer,
+                                        block=False)
+            client = WorkerClient(f"127.0.0.1:{port}")
+            assert client.load_model(config_yaml=cfg_yaml).success
+            servers.append((server, servicer))
+            clients.append(client)
+        prefill, decode = clients
+        prompt = "transfer this prefix over the wire please!"  # > 16 tokens
+
+        # prefill worker exports; the relay feeds the decode worker
+        chunks = prefill.prefill_prefix(pb.PredictOptions(
+            prompt=prompt, max_tokens=8, temperature=0.0))
+        res = decode.transfer_prefix(chunks)
+        assert res.success and "rows" in res.message
+
+        # the decode worker resumes from the transferred prefix and emits
+        # the same greedy completion as the prefill worker would natively
+        got = decode.predict(pb.PredictOptions(
+            prompt=prompt, max_tokens=6, temperature=0.0))
+        ref = prefill.predict(pb.PredictOptions(
+            prompt=prompt, max_tokens=6, temperature=0.0))
+        assert got.message == ref.message
+        assert got.finish_reason in ("stop", "length")
+    finally:
+        for c in clients:
+            c.close()
+        for server, servicer in servers:
+            servicer.shutdown()
+            server.stop(grace=None)

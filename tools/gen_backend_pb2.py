@@ -1,0 +1,121 @@
+"""Regenerate worker/backend_pb2.py without protoc/grpc_tools.
+
+The image ships protobuf but no protoc, so backend_pb2.py cannot be
+regenerated the usual way. This script edits the schema at the
+FileDescriptorProto level instead: it loads the serialized descriptor
+embedded in the CURRENT backend_pb2.py, applies the declarative additions
+below (new messages / new service methods — keep them in sync with
+backend.proto, which stays the human-readable source of truth), and
+rewrites backend_pb2.py around the new serialized blob.
+
+Usage:  python tools/gen_backend_pb2.py          # rewrite in place
+        python tools/gen_backend_pb2.py --check  # verify blob is current
+"""
+
+from __future__ import annotations
+
+import sys
+from pathlib import Path
+
+from google.protobuf import descriptor_pb2
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+OUT = REPO / "localai_tpu" / "worker" / "backend_pb2.py"
+
+F = descriptor_pb2.FieldDescriptorProto
+
+# message name -> [(field name, number, type, label), ...]
+MESSAGES = {
+    "PrefixChunk": [
+        ("transfer_id", 1, F.TYPE_STRING, F.LABEL_OPTIONAL),
+        ("seq", 2, F.TYPE_INT32, F.LABEL_OPTIONAL),
+        ("data", 3, F.TYPE_BYTES, F.LABEL_OPTIONAL),
+        ("last", 4, F.TYPE_BOOL, F.LABEL_OPTIONAL),
+        ("tokens", 5, F.TYPE_INT32, F.LABEL_REPEATED),
+        ("n_tokens", 6, F.TYPE_INT32, F.LABEL_OPTIONAL),
+    ],
+}
+
+# method name -> (input type, output type, client_streaming, server_streaming)
+METHODS = {
+    "PrefillPrefix": ("PredictOptions", "PrefixChunk", False, True),
+    "TransferPrefix": ("PrefixChunk", "Result", True, False),
+}
+
+TEMPLATE = '''# -*- coding: utf-8 -*-
+# Generated protocol buffer code (tools/gen_backend_pb2.py — the image has
+# no protoc; the descriptor blob is edited at the FileDescriptorProto
+# level from backend.proto's declarative twin in that script). DO NOT EDIT.
+# source: backend.proto
+"""Generated protocol buffer code."""
+from google.protobuf.internal import builder as _builder
+from google.protobuf import descriptor as _descriptor
+from google.protobuf import descriptor_pool as _descriptor_pool
+from google.protobuf import symbol_database as _symbol_database
+# @@protoc_insertion_point(imports)
+
+_sym_db = _symbol_database.Default()
+
+
+DESCRIPTOR = _descriptor_pool.Default().AddSerializedFile({blob!r})
+
+_builder.BuildMessageAndEnumDescriptors(DESCRIPTOR, globals())
+_builder.BuildTopDescriptorsAndMessages(DESCRIPTOR, 'backend_pb2', globals())
+# @@protoc_insertion_point(module_scope)
+'''
+
+
+def build_file_proto() -> descriptor_pb2.FileDescriptorProto:
+    """Current embedded descriptor + the declarative additions above
+    (idempotent: re-running against an already-updated blob is a no-op)."""
+    from localai_tpu.worker import backend_pb2
+
+    fd = descriptor_pb2.FileDescriptorProto()
+    fd.MergeFromString(backend_pb2.DESCRIPTOR.serialized_pb)
+
+    have_msgs = {m.name for m in fd.message_type}
+    for name, fields in MESSAGES.items():
+        if name in have_msgs:
+            continue
+        msg = fd.message_type.add()
+        msg.name = name
+        for fname, number, ftype, label in fields:
+            f = msg.field.add()
+            f.name = fname
+            f.number = number
+            f.type = ftype
+            f.label = label
+
+    svc = next(s for s in fd.service if s.name == "Backend")
+    have_methods = {m.name for m in svc.method}
+    for name, (inp, out, cstream, sstream) in METHODS.items():
+        if name in have_methods:
+            continue
+        m = svc.method.add()
+        m.name = name
+        m.input_type = f".{fd.package}.{inp}"
+        m.output_type = f".{fd.package}.{out}"
+        m.client_streaming = cstream
+        m.server_streaming = sstream
+    return fd
+
+
+def main() -> int:
+    fd = build_file_proto()
+    blob = fd.SerializeToString()
+    text = TEMPLATE.format(blob=blob)
+    if "--check" in sys.argv:
+        if OUT.read_text() != text:
+            print("backend_pb2.py is stale; run tools/gen_backend_pb2.py")
+            return 1
+        print("backend_pb2.py is current")
+        return 0
+    OUT.write_text(text)
+    print(f"wrote {OUT} ({len(blob)} descriptor bytes)")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -33,6 +33,34 @@ sys.path.insert(0, str(REPO))
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
 
+def check_bench_fallback() -> list[str]:
+    """Hard-fail the gate when the LATEST hardware bench round carries the
+    ``paged_fallback`` marker (ROADMAP item 1 calls it a P0: the paged
+    Pallas decode kernel died on Mosaic and bench silently measured the
+    contiguous layout — the number on the board is not the configuration
+    we ship). Only the newest BENCH_r*.json is checked: older rounds are
+    history, not the current state of the kernel."""
+    rounds = sorted(
+        REPO.glob("BENCH_r*.json"),
+        key=lambda p: int("".join(ch for ch in p.stem if ch.isdigit()) or 0),
+    )
+    if not rounds:
+        return []
+    latest = rounds[-1]
+    try:
+        data = json.loads(latest.read_text())
+    except (OSError, ValueError):
+        return []
+    blob = json.dumps(data.get("parsed", data))
+    if "paged_fallback" in blob:
+        return [
+            f"{latest.name}: bench fell back to the contiguous KV layout "
+            f"(paged_fallback marker) — the paged Pallas kernel is broken "
+            f"on hardware (P0)"
+        ]
+    return []
+
+
 def _measure(tol: float) -> dict:
     import bench_micro
 
@@ -56,6 +84,17 @@ def main() -> int:
     jax.config.update("jax_platforms", "cpu")
 
     tol = float(os.environ.get("PERF_SMOKE_TOL", "0.10"))
+
+    fallback = check_bench_fallback()
+    if fallback:
+        # a hardware-confirmed paged fallback fails the PR outright — no
+        # amount of CPU-side throughput can excuse shipping the broken
+        # kernel configuration
+        print(json.dumps({"failures": fallback}))
+        print("PERF SMOKE GATE FAILED:", "; ".join(fallback),
+              file=sys.stderr)
+        return 1
+
     result = _measure(tol)
 
     baseline_path = REPO / "BASELINE.json"

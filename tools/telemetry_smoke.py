@@ -23,7 +23,12 @@ generations through the continuous-batching scheduler, then:
      ``localai_batch_lines_total`` / ``localai_batch_lane_paused``
      series render, and the per-line result file is written
      (``--batch-out`` — CI uploads it as a build artifact);
-  5. writes a TTFT/TPOT summary JSON (``--out``) that CI uploads as a
+  5. asserts the round-10 fleet router end-to-end: a 2-replica (+1
+     prefill) in-process fleet of the tiny model serves mixed traffic
+     through the affinity router, one long prompt takes the
+     disaggregated prefill→TransferPrefix→decode path, and the
+     ``localai_fleet_*`` replica/routing/transfer series render;
+  6. writes a TTFT/TPOT summary JSON (``--out``) that CI uploads as a
      build artifact — the seed of the serving-latency bench trajectory
      (BENCH_*.json tracks throughput; this tracks latency per PR) — and
      the flight-ring snapshot (``--flight-out``) so every CI run carries
@@ -96,6 +101,16 @@ REQUIRED_BATCH = (
     'localai_batch_jobs{state="failed"} 0',
     'localai_batch_lines_total{result="completed"} 5',
     "localai_batch_lane_paused 0",
+)
+# fleet router series (round 10): the 2-replica in-process fleet the smoke
+# boots must leave every replica healthy, a routed mix, and exactly one
+# disaggregated prefix transfer (one long prompt crosses the threshold)
+REQUIRED_FLEET = (
+    'localai_fleet_replicas{model="fleet-smoke",state="healthy"} 3',
+    'localai_fleet_replicas{model="fleet-smoke",state="dead"} 0',
+    'localai_fleet_routed_total{model="fleet-smoke",reason="affinity"}',
+    'localai_fleet_prefix_transfers_total{model="fleet-smoke"} 1',
+    'localai_fleet_prefix_transfer_bytes_total{model="fleet-smoke"}',
 )
 
 
@@ -237,6 +252,75 @@ def check_batch(sched, registry, batch_out: str) -> list[str]:
     return problems
 
 
+def check_fleet(registry) -> list[str]:
+    """Boot a 2-replica (+1 prefill) in-process fleet of the tiny debug
+    model, run mixed traffic through the router (short prompts +
+    one long prompt over the disaggregation threshold), and assert the
+    routing/transfer accounting — the localai_fleet_* exposition strings
+    are checked by REQUIRED_FLEET after this returns."""
+    from localai_tpu.config.app_config import AppConfig
+    from localai_tpu.config.model_config import ModelConfig
+    from localai_tpu.engine.scheduler import GenRequest
+    from localai_tpu.fleet import FleetServingModel
+    from localai_tpu.fleet.replica import InProcessReplica
+    from localai_tpu.models.manager import build_serving_model
+
+    problems: list[str] = []
+    app = AppConfig()
+    mcfg = ModelConfig.model_validate({
+        "name": "fleet-smoke", "model": "debug:tiny", "context_size": 256,
+        "parameters": {"temperature": 0.0, "max_tokens": 8},
+        "engine": {"max_slots": 2, "prefill_buckets": [16, 32, 64, 128],
+                   "dtype": "float32", "kv_dtype": "float32",
+                   "kv_block_tokens": 16},
+    })
+
+    def factory(rid, role):
+        return InProcessReplica(
+            rid, role, lambda: build_serving_model(mcfg, app))
+
+    fm = FleetServingModel(mcfg, app, factory, replicas=2,
+                           prefill_replicas=1, disagg_threshold=48)
+    try:
+        tok = fm.tokenizer
+        handles = [
+            fm.scheduler.submit(GenRequest(
+                prompt=tok.encode(f"fleet smoke request {i} " * (1 + i % 2)),
+                max_new_tokens=6, temperature=0.0,
+            ))
+            for i in range(5)
+        ]
+        # ONE prompt over the disaggregation threshold: prefill replica →
+        # TransferPrefix → decode replica
+        handles.append(fm.scheduler.submit(GenRequest(
+            prompt=tok.encode("fleet disaggregated long prompt " * 6),
+            max_new_tokens=6, temperature=0.0,
+        )))
+        for h in handles:
+            h.result(timeout=300)
+        bad = [h.finish_reason for h in handles
+               if h.finish_reason not in ("stop", "length")]
+        if bad:
+            problems.append(f"fleet requests finished {bad}")
+        if sum(fm.router.routed.values()) != len(handles):
+            problems.append(
+                f"router placed {sum(fm.router.routed.values())} of "
+                f"{len(handles)} requests: {fm.router.routed}")
+        if fm.router.routed["affinity"] < 1:
+            problems.append(
+                f"no affinity placements in {fm.router.routed}")
+        if fm.scheduler.prefix_transfers != 1:
+            problems.append(
+                f"{fm.scheduler.prefix_transfers} prefix transfers "
+                f"(expected 1; {fm.scheduler.disagg_fallbacks} fallbacks)")
+        if fm.scheduler.prefix_transfer_bytes <= 0:
+            problems.append("prefix transfer moved 0 bytes")
+        fm.scheduler.export_gauges()
+    finally:
+        fm.close()
+    return problems
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("--out", default="telemetry_summary.json")
@@ -292,6 +376,7 @@ def main(argv=None) -> int:
         problems = check_introspection(runner, REGISTRY, store)
         problems += check_slo_overload(REGISTRY)
         problems += check_batch(sched, REGISTRY, args.batch_out)
+        problems += check_fleet(REGISTRY)
         flight_pct = sched.flight.percentiles()
         flight_snapshot = {
             "model": "smoke",
@@ -311,7 +396,7 @@ def main(argv=None) -> int:
     exposition = REGISTRY.render()
     missing = [s for s in (REQUIRED_SERIES + REQUIRED_FAMILIES
                            + REQUIRED_INTROSPECTION + REQUIRED_SLO
-                           + REQUIRED_BATCH)
+                           + REQUIRED_BATCH + REQUIRED_FLEET)
                if s not in exposition]
     if missing or problems:
         print("FAIL: missing engine telemetry in /metrics exposition:")
