@@ -186,7 +186,8 @@ def _cached_weights(preset: str, quant: str, cfg, gen):
 
 def run_decode_bench(preset: str, quant: str, steps: int, multi: int,
                      depth: int, num_slots: int = 8, max_ctx: int = 1024,
-                     watchdog=None, channel: str = "bench", flight=None):
+                     watchdog=None, channel: str = "bench", flight=None,
+                     meshed: bool = False):
     """Prefill 8 slots, then timed pipelined multi-step decode.
 
     Returns aggregate decode tok/s. The pipelined loop is the scheduler's
@@ -241,9 +242,26 @@ def run_decode_bench(preset: str, quant: str, steps: int, multi: int,
     # paged KV is the serving default — bench it unless BENCH_PAGED=0
     # (the contiguous escape hatch for round-over-round A/B)
     paged = os.environ.get("BENCH_PAGED", "1") != "0"
+    mesh = None
+    if meshed:
+        # the meshed-paged serving default (ISSUE 8): all visible chips
+        # on the 'model' axis (widest split the q-head count allows),
+        # params sharded with the production partition rules
+        from localai_tpu.parallel import sharding as shd
+        from localai_tpu.parallel.mesh import (MeshPlan, build_mesh,
+                                               default_tensor_parallel)
+
+        devs = jax.devices()
+        tp = default_tensor_parallel(len(devs), cfg.num_heads)
+        if tp < 2:
+            raise RuntimeError(
+                f"meshed phase needs >=2 devices with a head-divisible "
+                f"split; have {len(devs)} device(s), {cfg.num_heads} heads")
+        mesh = build_mesh(MeshPlan(model=tp), devices=devs[:tp])
+        params = shd.shard_params(params, cfg, mesh)
     runner = ModelRunner(
         cfg, params, num_slots=num_slots, max_ctx=max_ctx,
-        prefill_buckets=[128], kv_dtype=kv_dtype, paged=paged,
+        prefill_buckets=[128], kv_dtype=kv_dtype, paged=paged, mesh=mesh,
     )
     pulse()
 
@@ -360,7 +378,7 @@ class _Board:
 
 def _measure(board: _Board, preset: str, quant: str, steps: int, multi: int,
              depth: int, primary: bool, watchdog=None,
-             channel: str = "bench", flight=None) -> None:
+             channel: str = "bench", flight=None, meshed: bool = False) -> None:
     short = "llama8b" if "8b" in preset else "llama1b" if "1b" in preset \
         else preset
     base = BASELINES.get(short, 800.0)
@@ -375,9 +393,11 @@ def _measure(board: _Board, preset: str, quant: str, steps: int, multi: int,
         try:
             tok_s = run_decode_bench(preset, quant, steps, multi, depth,
                                      watchdog=watchdog, channel=channel,
-                                     flight=flight)
+                                     flight=flight, meshed=meshed)
         except Exception as e:  # noqa: BLE001
-            if not paged or board.thread_dead():
+            if not paged or board.thread_dead() or meshed:
+                # the meshed phase has no contiguous fallback: its result
+                # is the mesh×paged configuration or nothing
                 raise
             # the paged path (block tables + paged-attention kernel) died —
             # a number measured on the contiguous layout still beats a 0.0
@@ -391,13 +411,15 @@ def _measure(board: _Board, preset: str, quant: str, steps: int, multi: int,
                                          flight=flight)
             finally:
                 os.environ["BENCH_PAGED"] = "1"
+        mesh_tag = "_meshed" if meshed else ""
         line = {
-            "metric": f"decode_throughput_{short}_bs8_{quant}{w8k}",
+            "metric": f"decode_throughput_{short}_bs8_{quant}{w8k}{mesh_tag}",
             "value": round(tok_s, 2),
             "unit": "tok/s",
             "vs_baseline": round(tok_s / base, 4),
             "phase_s": round(time.monotonic() - t0, 1),
-            "kv": "paged" if paged else "contig",
+            "kv": ("paged+mesh" if meshed and paged
+                   else "paged" if paged else "contig"),
         }
         if note:
             line["note"] = note
@@ -406,16 +428,27 @@ def _measure(board: _Board, preset: str, quant: str, steps: int, multi: int,
             if pct["step_ms_p50"] is not None:
                 line["step_ms_p50"] = pct["step_ms_p50"]
                 line["step_ms_p99"] = pct["step_ms_p99"]
-        board.offer(line, primary)
+        if meshed:
+            # the meshed line rides the output as its own key — offer()
+            # only keeps primaries/promotions, and the meshed phase must
+            # never displace the round-over-round single-device trend
+            board.annotate("meshed", line)
+        else:
+            board.offer(line, primary)
     except Exception as e:  # noqa: BLE001 — keep a number on the board
         note = f"{type(e).__name__}: {e}"[:300]
-        board.offer({
-            "metric": f"decode_throughput_{short}_bs8_{quant}{w8k}",
+        mesh_tag = "_meshed" if meshed else ""
+        fail_line = {
+            "metric": f"decode_throughput_{short}_bs8_{quant}{w8k}{mesh_tag}",
             "value": 0.0,
             "unit": "tok/s",
             "vs_baseline": 0.0,
             "note": note,
-        }, primary and board.result is None)
+        }
+        if meshed:
+            board.annotate("meshed", fail_line)
+            return
+        board.offer(fail_line, primary and board.result is None)
         if primary and not board.thread_dead():
             # a crashed north-star phase must stay diagnosable no matter
             # which line ends up printing — annotate it under its own key
@@ -585,6 +618,22 @@ def main() -> None:
                     board.annotate("device_health", after.to_dict())
                     if not after.ok:
                         return
+        # meshed-paged phase (ISSUE 8 / ROADMAP item 3): the tensor-
+        # parallel serving default over all visible chips, as its own
+        # non-primary line (metric suffix _meshed, kv="paged+mesh") so
+        # the single-device trend stays comparable across rounds. Skips
+        # clean on single-device hosts; BENCH_MESHED=0 disables.
+        import jax
+
+        if (os.environ.get("BENCH_MESHED", "1") != "0"
+                and len(jax.devices()) > 1
+                and deadline - time.monotonic() > 120):
+            mp, mq = ("1b", "int8") if has_8b else (preset, quant)
+            mflight = FlightRecorder(512)
+            guarded("bench:meshed", lambda: _measure(
+                board, mp, mq, steps, multi, depth, primary=False,
+                watchdog=wd, channel="bench:meshed", flight=mflight,
+                meshed=True))
 
     t = threading.Thread(target=work, daemon=True)
     t.start()

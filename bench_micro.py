@@ -177,18 +177,36 @@ def machine_index(n=512, steps=24, repeats=3):
 
 
 def decode_smoke(paged: bool, preset: str = "tiny", num_slots: int = 4,
-                 max_ctx: int = 512, multi: int = 16, repeats: int = 5):
+                 max_ctx: int = 512, multi: int = 16, repeats: int = 5,
+                 mesh_devices: int = 0):
     """Steady-state batched decode tok/s of a debug preset — the CI perf
     smoke measurement. Best-of-``repeats`` (fastest sample): shared
     runners have multi-x contention spikes, and one clean window measures
-    the code's capability; a median would gate on the neighbors."""
+    the code's capability; a median would gate on the neighbors.
+
+    ``mesh_devices`` > 1 runs the meshed layout: a pure tensor-parallel
+    mesh over that many devices (model axis), params sharded with the
+    production partition rules — the CI pin that the pjit/shard_map serving
+    path stays alive on a multi-device host (tools/perf_smoke.py gates the
+    meshed-paged ratio; callers must check the device count first)."""
     from localai_tpu.engine.runner import ModelRunner
     from localai_tpu.models.registry import resolve_model
 
     model = resolve_model(f"debug:{preset}", dtype="float32")
-    runner = ModelRunner(model.cfg, model.params, num_slots=num_slots,
+    mesh = None
+    params = model.params
+    if mesh_devices > 1:
+        import jax
+
+        from localai_tpu.parallel import sharding as shd
+        from localai_tpu.parallel.mesh import MeshPlan, build_mesh
+
+        mesh = build_mesh(MeshPlan(model=mesh_devices),
+                          devices=jax.devices()[:mesh_devices])
+        params = shd.shard_params(params, model.cfg, mesh)
+    runner = ModelRunner(model.cfg, params, num_slots=num_slots,
                          max_ctx=max_ctx, prefill_buckets=[128],
-                         kv_dtype="float32", paged=paged)
+                         kv_dtype="float32", paged=paged, mesh=mesh)
     prompt = list(range(1, 65))
     for _ in range(num_slots):
         runner.admit(runner.acquire_slot(), prompt, temperature=0.0)

@@ -112,6 +112,11 @@ def build_parser() -> argparse.ArgumentParser:
     run.add_argument("--fleet-disagg-threshold", type=int, default=None,
                      help="prompt tokens at which a request takes the "
                           "disaggregated prefill path (default 512)")
+    run.add_argument("--fleet-device-pinning", action="store_true",
+                     default=_env_bool("fleet_device_pinning"),
+                     help="auto-derive per-replica worker env (TPU "
+                          "visible-device slices) so --fleet-replicas N "
+                          "partitions the host's accelerators evenly")
 
     models = sub.add_parser("models", help="model management")
     models_sub = models.add_subparsers(dest="models_command")
@@ -248,13 +253,11 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def _parse_mesh(spec: str) -> Optional[dict]:
-    if not spec:
-        return None
-    out = {}
-    for part in spec.split(","):
-        k, _, v = part.partition("=")
-        out[k.strip()] = int(v)
-    return out
+    # the ONE mesh parser (parallel.mesh.parse_mesh_spec) — shared with
+    # AppConfig.from_env's LOCALAI_MESH handling so flag and env agree
+    from localai_tpu.parallel.mesh import parse_mesh_spec
+
+    return parse_mesh_spec(spec)
 
 
 def _run_util(args, parser) -> int:
@@ -389,6 +392,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             fleet_prefill_replicas=args.fleet_prefill_replicas,
             fleet_backend=args.fleet_backend,
             fleet_disagg_threshold=args.fleet_disagg_threshold,
+            fleet_device_pinning=args.fleet_device_pinning or None,
         )
         serve(cfg)
         return 0

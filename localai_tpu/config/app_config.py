@@ -119,7 +119,15 @@ class AppConfig:
 
     # TPU-specific
     mesh_shape: Optional[dict[str, int]] = None   # None = auto from devices
+                                                  # (LOCALAI_MESH / --mesh
+                                                  # override the topology)
     platform: Optional[str] = None                # force jax platform (tests: cpu)
+
+    # fleet replica device pinning (--fleet-device-pinning /
+    # LOCALAI_FLEET_DEVICE_PINNING): auto-derive per-replica worker_env
+    # (TPU visible-device slices / JAX_PLATFORMS) so --fleet-replicas N
+    # partitions a pod without hand-written env (fleet.pinning)
+    fleet_device_pinning: bool = False
 
     def ensure_dirs(self) -> None:
         """mkdir -p all configured paths (parity: core/startup/startup.go:20-60)."""
@@ -151,6 +159,14 @@ class AppConfig:
                 setattr(cfg, name, [s for s in env.split(",") if s])
             elif typ in ("str", "Optional[str]"):
                 setattr(cfg, name, env)
+        # LOCALAI_MESH uses the CLI's axis syntax ("data=2,model=4" or
+        # "data:2,model:4") — parsed by the ONE parser behind --mesh so
+        # the env override and the flag can never drift
+        mesh_env = os.environ.get("LOCALAI_MESH")
+        if mesh_env is not None:
+            from localai_tpu.parallel.mesh import parse_mesh_spec
+
+            cfg.mesh_shape = parse_mesh_spec(mesh_env)
         for k, v in overrides.items():
             if v is not None:
                 setattr(cfg, k, v)

@@ -150,18 +150,38 @@ def init_paged_cache(
     num_blocks: int,
     block_tokens: int,
     dtype: str = "bfloat16",
+    sharding: Optional[jax.sharding.Sharding] = None,
 ) -> PagedKVCache:
     shape = (cfg.num_layers, num_blocks, cfg.num_kv_heads, block_tokens,
              cfg.hd)
     dt = jnp.dtype(dtype)
+
+    def zeros(shp, d, shd):
+        if shd is not None:
+            # allocate straight into the sharded layout (same idiom as
+            # init_cache: a host zeros array would materialize the whole
+            # pool on one device first); init-time only
+            return jax.jit(  # jaxlint: disable=jit-in-loop
+                lambda: jnp.zeros(shp, d), out_shardings=shd
+            )()
+        return jnp.zeros(shp, d)
+
+    scale_sharding = None
+    if dt == jnp.int8 and sharding is not None:
+        # scale pool drops the head_dim axis; reuse the pool spec minus it
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        scale_sharding = NamedSharding(
+            sharding.mesh, P(*tuple(sharding.spec)[:4]))
     if dt == jnp.int8:
         return PagedKVCache(
-            k=jnp.zeros(shape, dt),
-            v=jnp.zeros(shape, dt),
-            k_scale=jnp.zeros(shape[:4], jnp.float32),
-            v_scale=jnp.zeros(shape[:4], jnp.float32),
+            k=zeros(shape, dt, sharding),
+            v=zeros(shape, dt, sharding),
+            k_scale=zeros(shape[:4], jnp.float32, scale_sharding),
+            v_scale=zeros(shape[:4], jnp.float32, scale_sharding),
         )
-    return PagedKVCache(k=jnp.zeros(shape, dt), v=jnp.zeros(shape, dt))
+    return PagedKVCache(k=zeros(shape, dt, sharding),
+                        v=zeros(shape, dt, sharding))
 
 
 def paged_decode_write(tables: jax.Array, positions: jax.Array,

@@ -30,6 +30,13 @@ Design points:
 
 All mutation happens on the scheduler's engine thread; the lock only
 guards the read side (metrics scrapes from API threads).
+
+Topology-blindness: under a device mesh the pool shards its kv-head
+axis over 'model' (parallel.sharding.paged_kv_spec) while THIS allocator
+stays host-side with its block ids global — every device walks any
+slot's table against its own head shard, so admission, refcounts, and
+prefix sharing are identical on one chip and on eight. Nothing in this
+module may ever depend on the mesh.
 """
 
 from __future__ import annotations

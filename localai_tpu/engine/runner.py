@@ -183,12 +183,17 @@ class ModelRunner:
             self._se_rope = self.rope
             self.rope = se.identity_rope(self.rope)
         # paged KV cache (vLLM-style block pool + tables, engine.paged).
-        # Incompatible modes keep the slot-contiguous layout: a mesh (the
-        # sharded cache spec and ring/pp paths assume slot rows), and
-        # self-extend (unroped cache + grouped rescoring assume row slices).
+        # A plain dp×tp(×seq) mesh composes: the pool shards its kv-head
+        # axis over 'model' (parallel.sharding.paged_kv_spec), the [S, MB]
+        # table mirror shards slots over 'data', and the block allocator
+        # stays host-side and replicated — admission, refcounts, and
+        # prefix sharing are topology-blind. Incompatible modes keep the
+        # slot-contiguous layout: pipeline parallelism (pp_forward's stage
+        # chain assumes layer-sharded slot rows) and self-extend (unroped
+        # cache + grouped rescoring assume row slices).
         incompat = []
-        if mesh is not None:
-            incompat.append("device mesh")
+        if self.pp_enabled:
+            incompat.append("pipeline parallelism")
         if ga_n > 1:
             incompat.append("self-extend")
         if paged in ("auto", None):
@@ -208,9 +213,19 @@ class ModelRunner:
             self.max_blocks = -(-self.max_ctx // self.block_tokens)
             self.ctx_pad = self.max_blocks * self.block_tokens
             # default pool = the contiguous layout's HBM footprint (every
-            # slot can still reach max_ctx), plus the trash block; shrink
-            # via LOCALAI_KV_BLOCKS for real overcommit
-            default_blocks = num_slots * self.max_blocks + 1
+            # slot can still reach max_ctx) scaled by LOCALAI_KV_OVERCOMMIT
+            # (ratio, default 1.0 — <1 shrinks for true overcommit, >1
+            # grows past the contiguous footprint), plus the trash block;
+            # LOCALAI_KV_BLOCKS / kv_num_blocks set an absolute count and
+            # win over the ratio
+            try:
+                self.kv_overcommit = max(0.01, float(
+                    os.environ.get("LOCALAI_KV_OVERCOMMIT", "1.0") or 1.0))
+            except ValueError:
+                self.kv_overcommit = 1.0
+            default_blocks = max(
+                self.max_blocks,
+                int(num_slots * self.max_blocks * self.kv_overcommit)) + 1
             env_blocks = os.environ.get("LOCALAI_KV_BLOCKS", "")
             num_blocks = int(kv_num_blocks or env_blocks or default_blocks)
             self.allocator = pgd.BlockAllocator(
@@ -226,6 +241,7 @@ class ModelRunner:
                 num_kv_heads=cfg.num_kv_heads,
                 head_dim=cfg.hd,
                 block_tokens=self.block_tokens,
+                tp=mesh.shape["model"] if mesh is not None else 1,
             )
             if paged_why:
                 log.info("paged attention: %s; using gather+XLA", paged_why)
@@ -241,6 +257,7 @@ class ModelRunner:
         else:
             self.allocator = None
         kv_sharding = None
+        paged_sharding = None
         if mesh is not None:
             from jax.sharding import NamedSharding
 
@@ -254,10 +271,21 @@ class ModelRunner:
             self.params = params = qnt.block_w8_kernel_params(
                 params, "runner built over a device mesh")
             shd.slots_per_data_shard(num_slots, mesh)  # divisibility check
-            kv_sharding = NamedSharding(mesh, shd.kv_spec(cfg, mesh))
+            if self.paged:
+                # pool kv-heads on 'model' (paged_kv_spec); the [S, MB]
+                # table mirror carries the 'data' sharding instead — the
+                # pool has no slot axis to put it on
+                paged_sharding = NamedSharding(
+                    mesh, shd.paged_kv_spec(cfg, mesh))
+                self.block_tables = jax.device_put(
+                    self.block_tables,
+                    NamedSharding(mesh, shd.block_table_spec()))
+            else:
+                kv_sharding = NamedSharding(mesh, shd.kv_spec(cfg, mesh))
         if self.paged:
             self.kv = kvc.init_paged_cache(
-                cfg, self.allocator.num_blocks, self.block_tokens, kv_dtype
+                cfg, self.allocator.num_blocks, self.block_tokens, kv_dtype,
+                sharding=paged_sharding,
             )
         else:
             self.kv = kvc.init_cache(
@@ -375,6 +403,14 @@ class ModelRunner:
             self._prefill_sp_fn, static_argnames=("bucket",),
             donate_argnums=(1, 2),
         ), "prefill_sp")
+        if self.paged:
+            # ring-attention prefill straight into the sharded block pool
+            # (one long prompt uses every chip without stalling decode —
+            # chosen by begin_admit when the mesh has a 'seq' axis)
+            self._prefill_paged_sp = obs_compile.watch(jax.jit(
+                self._prefill_paged_sp_fn, static_argnames=("bucket",),
+                donate_argnums=(1, 2),
+            ), "prefill_sp")
         self._embed = obs_compile.watch(
             jax.jit(self._embed_fn, static_argnames=("bucket",)), "embed"
         )
@@ -661,6 +697,31 @@ class ModelRunner:
                 sliding_window=cfg.sliding_window,
                 interpret=self._paged_attn_interpret,
             )
+            if self.mesh is not None:
+                from jax.sharding import PartitionSpec as P
+
+                # per-device kernel over (slots/'data', heads/'model'):
+                # the pool's block axis stays whole on every device (table
+                # values are global block ids), its kv-head axis shards on
+                # 'model', and each data shard walks its own slots' SMEM
+                # table mirror — the shard_map body is the single-device
+                # kernel (select_paged_attn_impl gates Pallas off when the
+                # head groups don't split over tp)
+                in_specs = [P("data", "model", None),
+                            P(None, "model", None, None),
+                            P(None, "model", None, None),
+                            P("data", None),
+                            P("data")]
+                if kv.quantized:
+                    in_specs += [P(None, "model", None),
+                                 P(None, "model", None)]
+                kernel = shard_map(
+                    kernel,
+                    mesh=self.mesh,
+                    in_specs=tuple(in_specs),
+                    out_specs=P("data", "model", None),
+                    check_vma=False,
+                )
 
             def attn(q, keys, values, _mask):  # q [S,1,Hq,hd]; keys = pool
                 if kv.quantized:  # (int8 pool, f32 scales) — fused dequant
@@ -767,6 +828,74 @@ class ModelRunner:
             table_row, slot, counts_row, bucket=bucket, sample=True,
             embeds=x,
         )
+
+    def _prefill_paged_sp_fn(self, params, kv, state, tokens, length,
+                             table_row, slot, counts_row, *, bucket: int):
+        """Sequence-parallel paged prefill: the prompt chunks over the
+        'seq' mesh axis, each device runs blockwise ring attention
+        (parallel.ring — composes with 'model'-sharded weights), and the
+        resulting per-layer K/V scatters straight into the slot's reserved
+        blocks through its table row. One dispatch, all chips, no gathered
+        context. Always the FINAL (only) dispatch of its admission —
+        samples and arms the slot exactly like the final chunk of
+        _prefill_paged_fn. tokens: [bucket] i32 (1-D, like _prefill_sp_fn);
+        only fresh admissions route here (offset 0 — shared/loaded prefix
+        rows fall back to the chunked path)."""
+        from localai_tpu.parallel import ring
+
+        cfg = self.cfg
+        hidden, (ks, vs) = ring.sp_prefill_forward(
+            cfg, params, tokens, length, self.mesh, self.rope
+        )
+        # ks/vs [L, T, Hkv, hd] → scatter through the table row; padding
+        # rows (t >= length) redirect to the trash block exactly like
+        # kvcache.paged_prefill_write
+        bt = self.block_tokens
+        MB = table_row.shape[0]
+        T = tokens.shape[0]
+        t = jnp.arange(T)
+        valid = t < length
+        blk = jnp.where(valid, table_row[jnp.minimum(t // bt, MB - 1)], 0)
+        off = t % bt
+        # advanced indices (blk, off) around the head slice broadcast to
+        # the FRONT: the set value is row-major [T, L, H, ...]
+        if kv.quantized:
+            kq, kscale = kvc._quant_chunk(ks)   # [L,T,H,hd], [L,T,H]
+            vq, vscale = kvc._quant_chunk(vs)
+            new_kv = kvc.PagedKVCache(
+                k=kv.k.at[:, blk, :, off].set(kq.transpose(1, 0, 2, 3)),
+                v=kv.v.at[:, blk, :, off].set(vq.transpose(1, 0, 2, 3)),
+                k_scale=kv.k_scale.at[:, blk, :, off].set(
+                    kscale.transpose(1, 0, 2)),
+                v_scale=kv.v_scale.at[:, blk, :, off].set(
+                    vscale.transpose(1, 0, 2)),
+            )
+        else:
+            kdt = kv.k.dtype
+            new_kv = kvc.PagedKVCache(
+                k=kv.k.at[:, blk, :, off].set(
+                    ks.transpose(1, 0, 2, 3).astype(kdt)),
+                v=kv.v.at[:, blk, :, off].set(
+                    vs.transpose(1, 0, 2, 3).astype(kdt)),
+            )
+        last_h = jax.lax.dynamic_index_in_dim(hidden[0], length - 1,
+                                              keepdims=True)
+        logits = mdl.logits_from_hidden(cfg, params, last_h)  # [1, V]
+        counts = state.counts.at[slot].set(counts_row)
+        slot_params = jax.tree.map(lambda a: a[slot][None], state.params)
+        tok, new_key = smp.sample(
+            logits, slot_params, counts[slot][None],
+            state.keys[slot][None], state.bias[slot][None],
+        )
+        new_state = dataclasses.replace(
+            state,
+            tokens=state.tokens.at[slot].set(tok[0]),
+            positions=state.positions.at[slot].set(length),
+            active=state.active.at[slot].set(True),
+            keys=state.keys.at[slot].set(new_key[0]),
+            counts=counts,
+        )
+        return new_kv, new_state, tok[0]
 
     def _embed_fn(self, params, tokens, length, *, bucket: int):
         """Mean-pooled final hidden state over the real tokens — the LLM
@@ -1098,10 +1227,20 @@ class ModelRunner:
                                       else "paged")
         self.last_prefix_reused = lcp
         self.total_prefix_reused += lcp
+        # long fresh prompts on a 'seq' mesh take the ring-attention path:
+        # one dispatch over all chips writing straight into the reserved
+        # blocks (shared/loaded prefix rows need the resume-style chunk
+        # attention, so any lcp keeps the chunked path)
+        n_seq = self.mesh.shape.get("seq", 1) if self.mesh is not None else 1
+        use_sp = (self.sp_enabled and not mm and lcp == 0
+                  and n >= self.sp_threshold
+                  and self.bucket_for(n) % n_seq == 0)
+        if use_sp:
+            self.last_prefill_path = "paged_sp"
         self._prepare_slot(slot, **sampling)
         return PagedAdmission(self, slot, list(prompt), lcp,
                               mm_embeds=mm_embeds,
-                              mm_positions=mm_positions)
+                              mm_positions=mm_positions, sp=use_sp)
 
     def _install_table_row(self, slot: int) -> None:
         self.block_tables = self.block_tables.at[slot].set(
@@ -1480,7 +1619,8 @@ class PagedAdmission:
     to the prefix pool, arms the slot, and returns the first token."""
 
     def __init__(self, runner: ModelRunner, slot: int, prompt: list[int],
-                 start: int, mm_embeds=None, mm_positions=None):
+                 start: int, mm_embeds=None, mm_positions=None,
+                 sp: bool = False):
         self.runner = runner
         self.slot = slot
         self.prompt = prompt
@@ -1490,6 +1630,7 @@ class PagedAdmission:
         self.mm = mm_embeds is not None and len(mm_embeds) > 0
         self.mm_embeds = mm_embeds
         self.mm_positions = mm_positions
+        self.sp = sp                         # ring-attention one-shot path
         self.first_token: Optional[int] = None
         self.done = False
 
@@ -1497,7 +1638,7 @@ class PagedAdmission:
     def chunks_remaining(self) -> int:
         if self.done:
             return 0
-        if self.mm:
+        if self.mm or self.sp:
             return 1
         return max(1, -(-(len(self.prompt) - self.pos)
                         // self.runner.prefill_chunk))
@@ -1513,7 +1654,20 @@ class PagedAdmission:
         slot = self.slot
         n = len(self.prompt)
         table_row = jnp.asarray(r.allocator.table_row(slot))
-        if self.mm:
+        if self.sp:
+            # ring attention over the 'seq' mesh axis, scattered straight
+            # into the reserved blocks — the whole prompt in one dispatch
+            bucket = r.bucket_for(n)
+            padded = np.zeros(bucket, np.int32)
+            padded[:n] = self.prompt
+            r.kv, r.state, tok = r._prefill_paged_sp(
+                r.params, r.kv, r.state, jnp.asarray(padded), jnp.int32(n),
+                table_row, jnp.int32(slot),
+                jnp.asarray(self._counts_row()), bucket=bucket,
+            )
+            self.pos = n
+            last = True
+        elif self.mm:
             bucket = r.bucket_for(n)
             padded = np.zeros((1, bucket), np.int32)
             padded[0, :n] = self.prompt

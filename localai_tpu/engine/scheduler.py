@@ -429,6 +429,8 @@ class Scheduler:
                 "kv_blocks_used": st.used,
                 "kv_blocks_cached": st.cached,
                 "kv_block_watermark": st.high_watermark,
+                "kv_overcommit_ratio": getattr(
+                    self.runner, "kv_overcommit", 1.0),
                 "kv_shared_tokens": alloc.shared_tokens_total,
                 "prefill_chunks": self.total_prefill_chunks,
                 "prefill_chunk_queue_depth": sum(

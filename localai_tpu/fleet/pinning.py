@@ -1,0 +1,129 @@
+"""Per-replica device pinning presets (--fleet-device-pinning).
+
+``--fleet-replicas N`` with worker-backed replicas spawns N engine
+processes — but without device pinning every worker initializes the SAME
+accelerators and the second LoadModel dies on a held TPU chip. The manual
+escape is hand-writing ``worker_env`` per deployment; this module derives
+it instead: the host's visible devices are partitioned into N contiguous
+equal slices (ICI-contiguous in ``jax.devices()`` order, so each replica's
+chips form a ring for its own auto-mesh) and each replica's spawn env pins
+its slice.
+
+Env derivation by platform:
+
+  * **tpu** — ``TPU_VISIBLE_DEVICES=<ids>`` (libtpu claims only those
+    chips) plus ``TPU_PROCESS_BOUNDS``/``TPU_CHIPS_PER_PROCESS_BOUNDS``
+    cleared to single-process defaults so a pod-sliced parent env can't
+    leak multi-process topology into the worker.
+  * **cpu** — ``JAX_PLATFORMS=cpu`` plus
+    ``XLA_FLAGS=--xla_force_host_platform_device_count=<per>`` (virtual
+    CPU devices; the CI/test shape).
+  * anything else (gpu plugins) — ``JAX_PLATFORMS`` passthrough only; no
+    portable visible-device convention to derive, so pinning is a no-op
+    and the operator keeps ``worker_env``.
+
+The pure core (:func:`pinning_env`) takes platform/device-count
+explicitly so tests pin the partition math without touching a backend.
+On a real fleet host declare the topology with
+``LOCALAI_FLEET_PIN_PLATFORM=tpu LOCALAI_FLEET_PIN_DEVICES=8`` — the
+API server process must not probe (and thereby claim) the accelerators
+its workers are about to be pinned to (see :func:`derive_pinning_env`).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+log = logging.getLogger(__name__)
+
+
+def pinning_env(index: int, replicas: int, *, platform: str,
+                n_devices: int) -> dict[str, str]:
+    """Spawn-env additions for replica ``index`` of ``replicas`` on a host
+    with ``n_devices`` ``platform`` accelerators. Pure — no jax import.
+
+    Devices partition into ``replicas`` contiguous slices of
+    ``n_devices // replicas`` (device order is ICI-contiguous, so a slice
+    is a valid ring for the replica's own auto-mesh); the remainder
+    devices stay unused rather than skewing one replica. Returns {} when
+    the partition is impossible (fewer devices than replicas) or the
+    platform has no pinning convention."""
+    if not 0 <= index < replicas:
+        raise ValueError(f"replica index {index} outside fleet size "
+                         f"{replicas}")
+    per = n_devices // replicas
+    if per < 1:
+        log.warning(
+            "device pinning: %d replicas over %d %s device(s) — cannot "
+            "partition; replicas spawn unpinned", replicas, n_devices,
+            platform)
+        return {}
+    if n_devices % replicas:
+        log.warning(
+            "device pinning: %d %s devices do not divide evenly over %d "
+            "replicas; %d device(s) stay unused", n_devices, platform,
+            replicas, n_devices % replicas)
+    ids = range(index * per, (index + 1) * per)
+    if platform == "tpu":
+        return {
+            "TPU_VISIBLE_DEVICES": ",".join(str(i) for i in ids),
+            # single-process topology inside the slice: a pod-sliced
+            # parent env must not leak its process bounds into the worker
+            "TPU_PROCESS_BOUNDS": "",
+            "TPU_CHIPS_PER_PROCESS_BOUNDS": "",
+        }
+    if platform == "cpu":
+        return {
+            "JAX_PLATFORMS": "cpu",
+            "XLA_FLAGS": f"--xla_force_host_platform_device_count={per}",
+        }
+    log.warning(
+        "device pinning: no visible-device convention for platform %r; "
+        "replica %d spawns unpinned (set worker_env explicitly)",
+        platform, index)
+    return {}
+
+
+def derive_pinning_env(index: int, replicas: int) -> dict[str, str]:
+    """:func:`pinning_env` for this host's accelerators.
+
+    Topology comes from ``LOCALAI_FLEET_PIN_PLATFORM`` +
+    ``LOCALAI_FLEET_PIN_DEVICES`` when set — the operator-declared truth
+    for fleet deployments where the API server itself must not touch the
+    accelerators (the recommended worker-fleet setup runs the server
+    under ``--platform cpu`` so it never holds a TPU chip; probing
+    jax.devices() there would both report the WRONG platform and, on an
+    unforced server, initialize libtpu in the parent and claim every
+    chip the workers need). Falls back to the parent's live backend only
+    when the env is absent — correct for in-process experiments, logged
+    so a misconfigured fleet is diagnosable."""
+    import os
+
+    platform = os.environ.get("LOCALAI_FLEET_PIN_PLATFORM", "")
+    nd = os.environ.get("LOCALAI_FLEET_PIN_DEVICES", "")
+    if platform and nd:
+        return pinning_env(index, replicas, platform=platform,
+                           n_devices=int(nd))
+    import jax
+
+    devs = jax.devices()
+    log.info(
+        "device pinning: LOCALAI_FLEET_PIN_PLATFORM/_DEVICES unset; "
+        "deriving from this process's backend (%d %s device(s)) — on a "
+        "TPU host declare the topology via env so the server process "
+        "never initializes (and holds) the chips itself",
+        len(devs), devs[0].platform)
+    return pinning_env(index, replicas, platform=devs[0].platform,
+                       n_devices=len(devs))
+
+
+def pinned_worker_env(base: Optional[dict], index: int,
+                      replicas: int) -> dict[str, str]:
+    """Merge the derived pinning slice over the operator's worker_env
+    (explicit keys win — an operator pinning by hand keeps their layout,
+    and the derived keys fill only the gaps)."""
+    derived = derive_pinning_env(index, replicas)
+    out = dict(derived)
+    out.update(base or {})
+    return out

@@ -30,12 +30,18 @@ class ReplicaPool:
                  *, replicas: int = 2, prefill_replicas: int = 0,
                  health_interval: float = 5.0,
                  failure_threshold: int = 3,
-                 dial_timeout: float = 2.0):
+                 dial_timeout: float = 2.0,
+                 track_queue_depth: bool = False):
         self.model = model
         self.factory = factory
         self.health_interval = health_interval
         self.failure_threshold = failure_threshold
         self.dial_timeout = dial_timeout
+        # refresh each healthy replica's reported decode queue depth on
+        # the monitor sweep (one bounded stats pull per replica per
+        # interval) — opt-in: only the router's queue-override hint reads
+        # it, and fleets without the hint shouldn't pay the RPCs
+        self.track_queue_depth = track_queue_depth
         self.replicas: list[BaseReplica] = []
         for i in range(replicas):
             self.replicas.append(factory(f"{model}/r{i}", "decode"))
@@ -144,6 +150,16 @@ class ReplicaPool:
                 self._spawn_respawn(r)
                 continue
             ok = r.process_alive() and r.dial(self.dial_timeout)
+            if ok and self.track_queue_depth and r.role == "decode":
+                # only decode placement reads the hint — prefill replicas
+                # shouldn't pay the extra metrics RPC per sweep
+                m = r.metrics()
+                if "queue_depth" in m:
+                    r.queue_depth = int(m.get("queue_depth") or 0)
+                else:
+                    # failed scrape (the RPC error dict): a stale high
+                    # reading must not strip affinity traffic forever
+                    r.queue_depth = 0
             if not ok and r.failures >= self.failure_threshold:
                 self._mark_dead(r)
             elif not ok and not r.process_alive():

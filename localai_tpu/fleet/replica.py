@@ -60,6 +60,10 @@ class BaseReplica:
         self.dial_seconds: Optional[float] = None
         self.checked_mono: Optional[float] = None
         self.started_at = time.monotonic()
+        # last reported decode queue depth (monitor-refreshed when the
+        # pool tracks it — router.py's queue-override admission hint
+        # reads this as a plain field, never an RPC)
+        self.queue_depth = 0
 
     # -- accounting (router reads these for least-loaded) ------------------
 

@@ -93,16 +93,24 @@ class Router:
     """Stateless-per-request placement over a ReplicaPool."""
 
     def __init__(self, pool, slo=None, *, block_tokens: int = 64,
-                 affinity_blocks: int = AFFINITY_BLOCKS):
+                 affinity_blocks: int = AFFINITY_BLOCKS,
+                 queue_override: int = 0):
         self.pool = pool
         self.slo = slo                  # per-replica SLOTracker (optional)
         self.block_tokens = block_tokens
         self.affinity_blocks = affinity_blocks
+        # decode-admission hint (LOCALAI_FLEET_QUEUE_OVERRIDE, 0 = off):
+        # when the affinity target's last reported decode queue depth
+        # exceeds this, placement degrades to least-loaded — cache
+        # affinity is worth little behind a queue that long, and the
+        # monitor-refreshed depth costs the hot path nothing
+        self.queue_override = queue_override
         self._ring_cache: tuple[tuple, _Ring] = ((), _Ring(()))
         # observability (snapshot into /v1/fleet) — every request routes
         # from its own dispatch thread, so the counters take a lock
         self._lock = threading.Lock()
-        self.routed = {"affinity": 0, "least_loaded": 0, "failover": 0}
+        self.routed = {"affinity": 0, "least_loaded": 0, "failover": 0,
+                       "queue_override": 0}
         self.routed_around = 0          # shed replicas skipped on the ring
 
     def _ring(self, ids: tuple) -> _Ring:
@@ -144,11 +152,27 @@ class Router:
             ring = self._ring(tuple(sorted(byid)))
             eligible_ids = {r.id for r in eligible}
             for rid in ring.ordered(key):
-                if rid in eligible_ids:
-                    reason = "failover" if failover else "affinity"
-                    with self._lock:
-                        self.routed[reason] += 1
-                    return byid[rid], reason
+                if rid not in eligible_ids:
+                    continue
+                target = byid[rid]
+                if (self.queue_override
+                        and getattr(target, "queue_depth", 0)
+                        > self.queue_override):
+                    # the affinity target is drowning in queued decodes:
+                    # prefix locality saves a prefill, not a queue wait —
+                    # place least-loaded instead (monitor-refreshed depth,
+                    # so the check is a field read)
+                    choice = min(eligible, key=lambda r: r.load)
+                    if choice.id != rid:
+                        reason = ("failover" if failover
+                                  else "queue_override")
+                        with self._lock:
+                            self.routed[reason] += 1
+                        return choice, reason
+                reason = "failover" if failover else "affinity"
+                with self._lock:
+                    self.routed[reason] += 1
+                return target, reason
         # no affinity signal (short prompt) or empty ring: least loaded
         choice = min(eligible, key=lambda r: r.load)
         reason = "failover" if failover else "least_loaded"
@@ -165,5 +189,6 @@ class Router:
             "routed_around": routed_around,
             "affinity_blocks": self.affinity_blocks,
             "block_tokens": self.block_tokens,
+            "queue_override": self.queue_override,
             "vnodes": VNODES,
         }

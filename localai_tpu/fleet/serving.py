@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import itertools
 import logging
+import os
 import threading
 import time
 from typing import Optional
@@ -348,15 +349,25 @@ class FleetServingModel:
         # sheds from routing when >threshold× its error budget burns)
         targets = targets_from_config(app) or {"e2e_ms": float("inf")}
         self.slo = SLOTracker(targets=targets)
+        # decode-admission hint: affinity placement degrades to
+        # least-loaded when the target replica's monitor-reported decode
+        # queue depth exceeds LOCALAI_FLEET_QUEUE_OVERRIDE (0 = off)
+        try:
+            queue_override = int(os.environ.get(
+                "LOCALAI_FLEET_QUEUE_OVERRIDE", "0") or 0)
+        except ValueError:
+            queue_override = 0
         self.pool = ReplicaPool(
             mcfg.name, factory,
             replicas=replicas, prefill_replicas=prefill_replicas,
+            track_queue_depth=queue_override > 0,
         )
         self.pool.start()
         from localai_tpu.engine.paged import block_tokens_default
 
         bt = mcfg.engine.kv_block_tokens or block_tokens_default()
-        self.router = Router(self.pool, self.slo, block_tokens=bt)
+        self.router = Router(self.pool, self.slo, block_tokens=bt,
+                             queue_override=queue_override)
         self.scheduler = FleetScheduler(
             self, self.pool, self.router, self.slo,
             disagg_threshold=(disagg_threshold

@@ -293,6 +293,45 @@ class AudioServingModel:
         return out
 
 
+def _auto_mesh(cfg, num_slots: int):
+    """The no-flag meshed-serving default (ROADMAP item 3): on a single
+    host with >1 visible accelerator, build a dp×tp mesh with tp as wide
+    as the q-head count allows (``model=all`` when it divides). CPU stays
+    single-device — tier-1 semantics are byte-identical without a mesh —
+    unless ``LOCALAI_MESH_AUTO=1`` forces the auto path (CPU-mesh smoke
+    tests); ``LOCALAI_MESH_AUTO=0`` disables it on accelerators. Explicit
+    topology (``--mesh`` / ``LOCALAI_MESH`` / sharding config) never
+    reaches this function. Returns None when a mesh buys nothing."""
+    import jax
+
+    from localai_tpu.parallel.mesh import (MeshPlan, build_mesh,
+                                           default_tensor_parallel)
+
+    auto = os.environ.get("LOCALAI_MESH_AUTO", "")
+    if auto == "0":
+        return None
+    devs = jax.devices()
+    if len(devs) < 2 or (devs[0].platform == "cpu" and auto != "1"):
+        return None
+    tp = default_tensor_parallel(len(devs), cfg.num_heads)
+    if tp < 2:
+        log.warning(
+            "auto mesh: %d devices visible but num_heads=%d admits no "
+            "tensor-parallel split; serving single-device",
+            len(devs), cfg.num_heads)
+        return None
+    dp = len(devs) // tp
+    if dp > 1 and num_slots % dp:
+        # the decode state shards slots over 'data'; an indivisible slot
+        # count keeps TP only (on tp devices) rather than failing the load
+        log.warning(
+            "auto mesh: max_slots=%d not divisible by data=%d; using "
+            "model=%d on %d of %d devices", num_slots, dp, tp, tp,
+            len(devs))
+        return build_mesh(MeshPlan(model=tp), devices=devs[:tp])
+    return build_mesh(MeshPlan(data=dp, model=tp))
+
+
 def build_runner(mcfg: ModelConfig, app: AppConfig) -> tuple[Any, ModelRunner]:
     """Config → (resolved model, live ModelRunner): weights, mesh,
     shardings. Shared by the serving path and multi-host followers — a
@@ -303,6 +342,7 @@ def build_runner(mcfg: ModelConfig, app: AppConfig) -> tuple[Any, ModelRunner]:
     eng = mcfg.engine
     shard = mcfg.sharding
     mesh = None
+    explicit_mesh = False
     want_tp = max(1, shard.tensor_parallel_size)
     want_sp = max(1, shard.sequence_parallel_size)
     want_ep = max(1, shard.expert_parallel_size)
@@ -312,6 +352,7 @@ def build_runner(mcfg: ModelConfig, app: AppConfig) -> tuple[Any, ModelRunner]:
             or want_dp not in (0, 1) or app.mesh_shape):
         from localai_tpu.parallel.mesh import MeshPlan, build_mesh
 
+        explicit_mesh = True
         if app.mesh_shape:
             mesh = build_mesh(MeshPlan(**app.mesh_shape))
         elif want_pp > 1:
@@ -344,6 +385,18 @@ def build_runner(mcfg: ModelConfig, app: AppConfig) -> tuple[Any, ModelRunner]:
         model_path=app.model_path,
         dtype=eng.dtype,
     )
+    if mesh is None and not explicit_mesh:
+        # meshed serving is the default hot path whenever >1 accelerator
+        # is visible (pjit tensor-parallel, paged pool sharded over
+        # 'model'); modes whose runners assume single-device layouts keep
+        # it off: multi-host command mirroring builds its own topology,
+        # speculative decoding drives a contiguous draft pair, and
+        # self-extend forces the unroped single-row cache
+        if not (app.mirror_port or eng.draft_model or eng.grp_attn_n > 1):
+            mesh = _auto_mesh(model.cfg, eng.max_slots)
+            if mesh is not None:
+                log.info("auto mesh for %s: %s", mcfg.name,
+                         dict(mesh.shape))
     params = model.params
     if eng.quantization:
         from localai_tpu.models.quant import quantize_params
@@ -363,17 +416,20 @@ def build_runner(mcfg: ModelConfig, app: AppConfig) -> tuple[Any, ModelRunner]:
     # self-extend lifts the trained-context ceiling by the group factor
     # (llama.cpp: n_ctx >= n_ctx_train * ga_n, grpc-server.cpp:535)
     ctx = min(ctx, model.cfg.max_position_embeddings * max(eng.grp_attn_n, 1))
-    # paged KV (block pool + chunked prefill): the serving default whenever
-    # the engine is a plain single-device runner — speculative decoding and
-    # multi-host mirroring still drive the contiguous layout, and the
-    # runner itself gates off mesh/self-extend. Explicit per-model config
-    # wins; otherwise the compatibility decision applies and
-    # LOCALAI_KV_PAGED=0 force-disables (=1 adds nothing here: auto
-    # already enables everything compatible, and overriding the
-    # draft/mirror exclusions would crash those engines at load).
+    # paged KV (block pool + chunked prefill): the serving default for
+    # single-device AND meshed engines alike (the pool shards its kv-head
+    # axis over 'model'; the table mirror rides 'data') — speculative
+    # decoding and multi-host mirroring still drive the contiguous
+    # layout, and the runner itself gates off pipeline-parallel/
+    # self-extend. Explicit per-model config wins; otherwise the
+    # compatibility decision applies and LOCALAI_KV_PAGED=0
+    # force-disables (=1 adds nothing here: auto already enables
+    # everything compatible, and overriding the draft/mirror exclusions
+    # would crash those engines at load).
     paged = eng.kv_paged
     if paged is None:
-        paged = (mesh is None and eng.grp_attn_n <= 1
+        paged = ((mesh is None or mesh.shape.get("pipe", 1) == 1)
+                 and eng.grp_attn_n <= 1
                  and not eng.draft_model and not app.mirror_port
                  and os.environ.get("LOCALAI_KV_PAGED", "") != "0")
     runner = ModelRunner(
@@ -773,9 +829,22 @@ class ModelManager:
                 return InProcessReplica(
                     rid, role, lambda: build_serving_model(rcfg, app))
         else:
+            total = app.fleet_replicas + app.fleet_prefill_replicas
+
             def factory(rid: str, role: str):
-                return WorkerReplica(rid, role, mcfg, app,
-                                     env=app.worker_env or None)
+                env = dict(app.worker_env or {})
+                if app.fleet_device_pinning:
+                    # rid suffixes are rN (decode) / pN (prefill) in pool
+                    # construction order; prefill replicas take the slices
+                    # after the decode block so all of them partition one
+                    # host without overlap (fleet.pinning)
+                    from localai_tpu.fleet.pinning import pinned_worker_env
+
+                    kind, num = rid.rsplit("/", 1)[-1][0], rid.rsplit("/", 1)[-1][1:]
+                    idx = int(num) + (app.fleet_replicas
+                                      if kind == "p" else 0)
+                    env = pinned_worker_env(app.worker_env, idx, total)
+                return WorkerReplica(rid, role, mcfg, app, env=env or None)
         return FleetServingModel(
             mcfg, app, factory,
             replicas=app.fleet_replicas,

@@ -196,6 +196,12 @@ class Registry:
             "Paged-KV blocks held only by the prefix-sharing pool "
             "(evicted on demand)",
         )
+        self.kv_overcommit = Gauge(
+            "localai_kv_overcommit_ratio",
+            "Paged-KV pool size as a ratio of the contiguous-footprint "
+            "default (LOCALAI_KV_OVERCOMMIT; <1 overcommits HBM, >1 "
+            "grows the prefix-sharing pool)",
+        )
         self.prefill_chunk_queue = Gauge(
             "localai_prefill_chunk_queue_depth",
             "Prompt chunks queued behind the chunked-prefill lane "
@@ -293,7 +299,7 @@ class Registry:
         self.fleet_routed = Counter(
             "localai_fleet_routed_total",
             "Requests placed by the fleet router by reason "
-            "(affinity/least_loaded/failover)",
+            "(affinity/least_loaded/failover/queue_override)",
         )
         self.fleet_prefix_transfers = Counter(
             "localai_fleet_prefix_transfers_total",
@@ -384,6 +390,8 @@ def update_engine_gauges(name: str, m: dict,
         reg.kv_blocks_free.set(m.get("kv_blocks_free", 0), model=name)
         reg.kv_blocks_used.set(m.get("kv_blocks_used", 0), model=name)
         reg.kv_blocks_cached.set(m.get("kv_blocks_cached", 0), model=name)
+        reg.kv_overcommit.set(
+            m.get("kv_overcommit_ratio", 1.0), model=name)
         reg.prefill_chunk_queue.set(
             m.get("prefill_chunk_queue_depth", 0), model=name)
         reg.prefill_chunks.set_total(m.get("prefill_chunks", 0), model=name)

@@ -80,7 +80,7 @@ def select_attn_impl(requested: str, *, num_heads: int, num_kv_heads: int,
 
 def select_paged_attn_impl(requested: str, *, num_heads: int,
                            num_kv_heads: int, head_dim: int,
-                           block_tokens: int,
+                           block_tokens: int, tp: int = 1,
                            backend: str | None = None
                            ) -> tuple[str, bool, str]:
     """Attention-impl decision for the PAGED decode path (the paged analogue
@@ -100,10 +100,19 @@ def select_paged_attn_impl(requested: str, *, num_heads: int,
         impl = os.environ.get("LOCALAI_PAGED_ATTN_IMPL", "") or "auto"
     if impl in ("auto", ""):
         impl = "pallas" if backend == "tpu" else "xla"
+    if impl not in ("pallas", "pallas_interpret", "xla"):
+        raise ValueError(f"unknown paged attention impl {impl!r}")
+    if (impl in ("pallas", "pallas_interpret") and tp > 1
+            and (num_heads % tp or num_kv_heads % tp)):
+        # under a mesh the paged kernel runs per-device via shard_map
+        # (tables/slots on 'data', heads on 'model') — both head counts
+        # must split evenly or the per-shard GQA grouping misaligns (a
+        # replicated-KV pool has no per-shard head group to walk)
+        return "xla", False, (
+            f"heads ({num_heads} q / {num_kv_heads} kv) not divisible by "
+            f"tensor_parallel {tp}")
     if impl == "pallas_interpret":
         return "pallas", True, ""
-    if impl not in ("pallas", "xla"):
-        raise ValueError(f"unknown paged attention impl {impl!r}")
     interpret = impl == "pallas" and backend != "tpu"
     if impl == "pallas" and not interpret:
         if head_dim % 128 or block_tokens % 32:

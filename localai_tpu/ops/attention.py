@@ -438,7 +438,14 @@ def paged_decode_attention(
     [S, Hq, hd]. The kernel walks each slot's block table in SMEM and
     DMAs one [bt, hd] physical block per online-softmax step — identical
     math to ``decode_attention``, with the contiguous slot row replaced
-    by gather-over-block-table."""
+    by gather-over-block-table.
+
+    Under a mesh the runner wraps this in ``shard_map`` with slots (q,
+    tables, positions) on 'data' and head groups (q, pool) on 'model':
+    the body is then the per-device single-chip kernel, so the pool's
+    block axis must arrive WHOLE on every device (table values are
+    global physical block ids) and both head counts must divide the
+    'model' width (``ops.select_paged_attn_impl`` gates that)."""
     S, Hq, hd = q.shape
     Hkv, bt = k_cache.shape[1], k_cache.shape[2]
     MB = tables.shape[1]

@@ -105,5 +105,40 @@ def single_device_mesh() -> Mesh:
     return build_mesh(MeshPlan(), devices=jax.devices()[:1])
 
 
+def parse_mesh_spec(spec: str) -> Optional[dict]:
+    """``"data=2,model=4"`` (or ``data:2,model:4``) → axis dict for
+    MeshPlan. The one parser behind ``--mesh`` and ``LOCALAI_MESH`` so the
+    CLI flag and the env override can never drift. Unknown axes raise —
+    a typo'd axis name must not silently serve an unsharded layout."""
+    if not spec:
+        return None
+    out: dict[str, int] = {}
+    for part in spec.split(","):
+        if not part.strip():
+            continue
+        sep = "=" if "=" in part else ":"
+        k, _, v = part.partition(sep)
+        k = k.strip()
+        if k not in AXES:
+            raise ValueError(
+                f"unknown mesh axis {k!r} in {spec!r}; have {AXES}")
+        out[k] = int(v)
+    return out or None
+
+
+def default_tensor_parallel(n_devices: int, num_heads: int) -> int:
+    """The auto-mesh TP width for one host: all visible devices when the
+    q-head count allows (``model=all``, ISSUE 8 / ROADMAP item 3),
+    otherwise the widest divisor of the device count that splits the
+    heads evenly. KV heads narrower than TP are legal (kv_spec/
+    paged_kv_spec replicate the cache) but the flash kernels need the
+    q-head groups aligned, so only ``num_heads`` gates here. Returns 1
+    when no split works (callers then skip the mesh entirely)."""
+    for tp in range(min(n_devices, num_heads), 0, -1):
+        if n_devices % tp == 0 and num_heads % tp == 0:
+            return tp
+    return 1
+
+
 def named(mesh: Mesh, *spec) -> NamedSharding:
     return NamedSharding(mesh, P(*spec))

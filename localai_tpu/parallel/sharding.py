@@ -129,6 +129,36 @@ def kv_spec(cfg: LlamaConfig, mesh: Mesh) -> P:
     return P(layers, "data", heads, None, None)
 
 
+def paged_kv_spec(cfg: LlamaConfig, mesh: Mesh) -> P:
+    """Paged block pool [L, num_blocks, Hkv, block_tokens, hd]: kv heads on
+    'model', everything else replicated.
+
+    The pool has no slot axis — blocks are shared by all slots through the
+    host-side block tables — so unlike the contiguous cache there is
+    nothing to put on 'data'; the [S, MB] device table mirror carries the
+    'data' sharding instead (runner.block_tables). The block axis stays
+    unsharded on purpose: table values are global physical block ids, and
+    every device must be able to walk any slot's table against its own
+    head shard. Same deep-GQA fallback as kv_spec: when tp does not
+    divide the kv-head count the pool replicates and q-heads stay
+    sharded."""
+    tp = mesh.shape["model"]
+    heads = ("model" if cfg.num_kv_heads % tp == 0 and tp <= cfg.num_kv_heads
+             else None)
+    if heads is None and tp > 1:
+        log.warning(
+            "kv heads (%d) not divisible by tensor_parallel (%d); "
+            "replicating the paged KV pool", cfg.num_kv_heads, tp,
+        )
+    return P(None, None, heads, None, None)
+
+
+def block_table_spec() -> P:
+    """Device mirror of the allocator's block tables [S, MB]: slots on
+    'data' alongside DecodeState, columns replicated."""
+    return P("data", None)
+
+
 def state_specs(mesh: Mesh) -> dict:
     """PartitionSpecs for DecodeState fields (see engine.runner)."""
     return {

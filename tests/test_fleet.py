@@ -566,3 +566,139 @@ def test_prefix_transfer_over_grpc_workers():
         for server, servicer in servers:
             servicer.shutdown()
             server.stop(grace=None)
+
+
+def test_queue_override_degrades_affinity_to_least_loaded():
+    """A drowning affinity target (reported decode queue depth past
+    LOCALAI_FLEET_QUEUE_OVERRIDE) loses its affinity claim: the request
+    places least-loaded with reason queue_override; below the threshold
+    the affinity placement stands."""
+    pool = _StubPool([_StubReplica(f"m/r{i}") for i in range(3)])
+    router = Router(pool, None, block_tokens=16, queue_override=4)
+    p = _prompt(9)
+    target = router.route(p)[0]
+    assert router.routed["affinity"] == 1
+
+    target.queue_depth = 4          # at the threshold: affinity holds
+    assert router.route(p)[0] is target
+
+    target.queue_depth = 5          # past it: least-loaded wins
+    target.inflight = 3             # make the target clearly NOT least-loaded
+    pick, reason = router.route(p)
+    assert pick is not target and reason == "queue_override"
+    assert router.routed["queue_override"] == 1
+
+    # threshold off (0) ignores queue depth entirely
+    router0 = Router(pool, None, block_tokens=16)
+    assert router0.route(p)[0] is target
+
+
+def test_queue_override_noop_when_target_is_least_loaded():
+    """When the affinity target is simultaneously the least-loaded
+    replica, the override keeps it (and keeps the affinity accounting —
+    nothing actually moved)."""
+    reps = [_StubReplica(f"m/r{i}", inflight=5) for i in range(3)]
+    pool = _StubPool(reps)
+    router = Router(pool, None, block_tokens=16, queue_override=1)
+    p = _prompt(9)
+    target = router.route(p)[0]
+    target.queue_depth = 10
+    target.inflight = 0             # drowning by depth, idle by inflight
+    pick, reason = router.route(p)
+    assert pick is target and reason == "affinity"
+
+
+def test_pool_monitor_tracks_queue_depth():
+    """With tracking on, the dial sweep refreshes each healthy replica's
+    reported queue depth from its metrics dict."""
+    from localai_tpu.fleet.pool import ReplicaPool
+
+    class _R(BaseReplica):
+        def __init__(self, rid):
+            super().__init__(rid, "decode")
+            self.state = "healthy"
+
+        def start(self):
+            pass
+
+        def _dial(self, timeout):
+            return True
+
+        def process_alive(self):
+            return True
+
+        def metrics(self):
+            return {"queue_depth": 7, "occupancy": 1.0}
+
+        def stop(self):
+            pass
+
+    pool = ReplicaPool("m", lambda rid, role: _R(rid), replicas=0,
+                       track_queue_depth=True)
+    r = _R("m/r0")
+    pool.replicas.append(r)
+    pool.poll_once()
+    assert r.queue_depth == 7
+
+
+# ---------------------------------------------------------------------------
+# per-replica device pinning presets (--fleet-device-pinning)
+
+
+def test_pinning_env_partitions_tpu_hosts():
+    from localai_tpu.fleet.pinning import pinning_env
+
+    envs = [pinning_env(i, 4, platform="tpu", n_devices=8)
+            for i in range(4)]
+    slices = [e["TPU_VISIBLE_DEVICES"] for e in envs]
+    assert slices == ["0,1", "2,3", "4,5", "6,7"]  # disjoint, covering
+    # pod-topology env must not leak into single-process workers
+    assert all(e["TPU_PROCESS_BOUNDS"] == "" for e in envs)
+
+    # uneven split: remainder devices stay unused, never skew one replica
+    envs = [pinning_env(i, 3, platform="tpu", n_devices=8)
+            for i in range(3)]
+    assert [e["TPU_VISIBLE_DEVICES"] for e in envs] == \
+        ["0,1", "2,3", "4,5"]
+
+
+def test_pinning_env_cpu_and_unknown_platforms():
+    from localai_tpu.fleet.pinning import pinning_env
+
+    env = pinning_env(1, 2, platform="cpu", n_devices=8)
+    assert env["JAX_PLATFORMS"] == "cpu"
+    assert "device_count=4" in env["XLA_FLAGS"]
+    # no convention for gpu plugins → unpinned (operator keeps worker_env)
+    assert pinning_env(0, 2, platform="gpu", n_devices=8) == {}
+    # more replicas than devices → unpinned rather than empty slices
+    assert pinning_env(0, 4, platform="tpu", n_devices=2) == {}
+    with pytest.raises(ValueError):
+        pinning_env(5, 4, platform="tpu", n_devices=8)
+
+
+def test_pinned_worker_env_operator_keys_win():
+    from localai_tpu.fleet import pinning
+
+    orig = pinning.derive_pinning_env
+    pinning.derive_pinning_env = lambda i, n: {
+        "TPU_VISIBLE_DEVICES": "0,1", "TPU_PROCESS_BOUNDS": ""}
+    try:
+        merged = pinning.pinned_worker_env(
+            {"TPU_VISIBLE_DEVICES": "6,7", "MY_FLAG": "1"}, 0, 2)
+    finally:
+        pinning.derive_pinning_env = orig
+    assert merged["TPU_VISIBLE_DEVICES"] == "6,7"  # explicit wins
+    assert merged["MY_FLAG"] == "1"
+    assert merged["TPU_PROCESS_BOUNDS"] == ""      # derived fills gaps
+
+
+def test_pinning_env_declared_topology_beats_backend_probe(monkeypatch):
+    """With LOCALAI_FLEET_PIN_PLATFORM/_DEVICES set, derivation never
+    touches the parent's JAX backend — the server can run --platform cpu
+    on a TPU host and still pin its workers to the real chips."""
+    from localai_tpu.fleet import pinning
+
+    monkeypatch.setenv("LOCALAI_FLEET_PIN_PLATFORM", "tpu")
+    monkeypatch.setenv("LOCALAI_FLEET_PIN_DEVICES", "8")
+    env = pinning.derive_pinning_env(1, 4)
+    assert env["TPU_VISIBLE_DEVICES"] == "2,3"  # not this process's CPUs

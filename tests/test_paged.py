@@ -401,3 +401,163 @@ def test_spec_decoder_rejects_paged_runner(tiny):
                      prefill_buckets=[16], kv_dtype="float32", paged=False)
     with pytest.raises(ValueError, match="contiguous"):
         SpecDecoder(rp, rc)
+
+
+# ---------------------------------------------------------------------------
+# meshed paged serving (ISSUE 8): the block pool sharded over a CPU mesh
+# ---------------------------------------------------------------------------
+
+
+def _tp_mesh():
+    """data=4 × model=2 over the conftest's 8 virtual CPU devices: tiny's
+    2 kv heads split over 'model', 4 slots over 'data'."""
+    from localai_tpu.parallel.mesh import MeshPlan, build_mesh
+
+    return build_mesh(MeshPlan(data=4, model=2))
+
+
+def test_runner_accepts_mesh_with_paged(tiny):
+    """mesh != None with paged=True is a supported configuration (the PR 6
+    'mesh forces contiguous' incompatibility is gone); only pipeline
+    parallelism still forces the slot-contiguous layout."""
+    from localai_tpu.parallel import sharding as shd
+    from localai_tpu.parallel.mesh import MeshPlan, build_mesh
+
+    mesh = _tp_mesh()
+    params = shd.shard_params(tiny.params, tiny.cfg, mesh)
+    r = ModelRunner(tiny.cfg, params, num_slots=4, max_ctx=64,
+                    prefill_buckets=[16], kv_dtype="float32", mesh=mesh,
+                    paged=True, kv_block_tokens=16)
+    assert r.paged and r.mesh is mesh
+
+    from localai_tpu.parallel.pipeline import shard_params_pp
+
+    import jax
+
+    pp_mesh = build_mesh(MeshPlan(pipe=2), devices=jax.devices()[:2])
+    with pytest.raises(ValueError, match="pipeline parallelism"):
+        ModelRunner(tiny.cfg, shard_params_pp(tiny.params, tiny.cfg, pp_mesh),
+                    num_slots=2, max_ctx=64, prefill_buckets=[16],
+                    kv_dtype="float32", mesh=pp_mesh, paged=True)
+
+
+def test_meshed_paged_matches_single_device_greedy(tiny):
+    """Greedy parity: the head-sharded pool + data-sharded table mirror
+    must reproduce the single-device paged engine token-for-token, two
+    prompts of different lengths sharing the pool (chunked + short)."""
+    from localai_tpu.parallel import sharding as shd
+
+    mesh = _tp_mesh()
+    params = shd.shard_params(tiny.params, tiny.cfg, mesh)
+    kw = dict(num_slots=4, max_ctx=96, prefill_buckets=[16, 32],
+              kv_dtype="float32", paged=True, kv_block_tokens=16,
+              prefill_chunk=16)
+    pa = list(b"the quick brown fox jumps over the dog")  # 3 chunks
+    pb = list(b"hi")
+    seqs = {}
+    for name, r in (
+        ("single", ModelRunner(tiny.cfg, tiny.params, **kw)),
+        ("mesh", ModelRunner(tiny.cfg, params, mesh=mesh, **kw)),
+    ):
+        s1 = r.acquire_slot()
+        t1 = r.admit(s1, pa, temperature=0.0)
+        s2 = r.acquire_slot()
+        t2 = r.admit(s2, pb, temperature=0.0)
+        a, b = [t1], [t2]
+        for _ in range(8):
+            toks = r.step()
+            a.append(int(toks[s1]))
+            b.append(int(toks[s2]))
+        seqs[name] = (a, b)
+    assert seqs["mesh"] == seqs["single"]
+
+
+def test_meshed_paged_int8_matches_single_device(tiny):
+    """Scaled-int8 pool under the mesh: the f32 scale pool shards
+    alongside the int8 values (same spec minus head_dim) and greedy
+    decode tracks the single-device quantized path."""
+    from localai_tpu.parallel import sharding as shd
+
+    mesh = _tp_mesh()
+    params = shd.shard_params(tiny.params, tiny.cfg, mesh)
+    kw = dict(num_slots=4, max_ctx=64, prefill_buckets=[16, 32],
+              kv_dtype="int8", paged=True, kv_block_tokens=16,
+              prefill_chunk=16)
+    prompt = list(b"quantized kv under a mesh")
+    outs = {}
+    for name, r in (
+        ("single", ModelRunner(tiny.cfg, tiny.params, **kw)),
+        ("mesh", ModelRunner(tiny.cfg, params, mesh=mesh, **kw)),
+    ):
+        s = r.acquire_slot()
+        t = r.admit(s, prompt, temperature=0.0)
+        outs[name] = [t] + [int(r.step()[s]) for _ in range(6)]
+    assert outs["mesh"] == outs["single"]
+
+
+def test_ring_paged_prefill_matches_contiguous_sp(tiny):
+    """A long prompt on a 'seq' mesh takes the ring-attention paged path
+    (one dispatch over all chips, K/V scattered through the block table)
+    and must emit the same greedy stream as the contiguous SP engine —
+    both prefills run the identical ring math, so this pins the paged
+    scatter + paged decode halves."""
+    import jax
+
+    from localai_tpu.parallel import sharding as shd
+    from localai_tpu.parallel.mesh import MeshPlan, build_mesh
+
+    mesh = build_mesh(MeshPlan(data=2, seq=2, model=2))
+    params = shd.shard_params(tiny.params, tiny.cfg, mesh)
+    rc = ModelRunner(tiny.cfg, params, num_slots=4, max_ctx=128,
+                     prefill_buckets=[64], kv_dtype="float32", mesh=mesh,
+                     sp_threshold=32)
+    rp = ModelRunner(tiny.cfg, params, num_slots=4, max_ctx=128,
+                     prefill_buckets=[64], kv_dtype="float32", mesh=mesh,
+                     sp_threshold=32, paged=True, kv_block_tokens=16,
+                     prefill_chunk=16)
+    assert rp.sp_enabled
+    prompt = list(range(1, 45))
+    sc = rc.acquire_slot()
+    tc = rc.admit(sc, prompt, temperature=0.0)
+    assert rc.last_prefill_path == "sp"
+    sp = rp.acquire_slot()
+    tp = rp.admit(sp, prompt, temperature=0.0)
+    assert rp.last_prefill_path == "paged_sp"
+    a = [tc] + [int(rc.step()[sc]) for _ in range(6)]
+    b = [tp] + [int(rp.step()[sp]) for _ in range(6)]
+    assert a == b
+
+    # short prompts stay on the chunked path (no seq-wide dispatch for a
+    # prompt that fits one chunk)
+    s2 = rp.acquire_slot()
+    rp.admit(s2, list(b"short"), temperature=0.0)
+    assert rp.last_prefill_path == "paged"
+
+
+def test_kv_overcommit_ratio_scales_default_pool(tiny, monkeypatch):
+    """LOCALAI_KV_OVERCOMMIT scales the default pool past (or under) the
+    contiguous footprint; explicit kv_num_blocks still wins."""
+    kw = dict(num_slots=2, max_ctx=64, prefill_buckets=[16],
+              kv_dtype="float32", paged=True, kv_block_tokens=16)
+    base = ModelRunner(tiny.cfg, tiny.params, **kw)
+    assert base.kv_overcommit == 1.0
+    contiguous_blocks = 2 * base.max_blocks + 1
+
+    monkeypatch.setenv("LOCALAI_KV_OVERCOMMIT", "1.5")
+    grown = ModelRunner(tiny.cfg, tiny.params, **kw)
+    assert grown.kv_overcommit == 1.5
+    assert grown.allocator.num_blocks == int(
+        2 * base.max_blocks * 1.5) + 1 > contiguous_blocks
+
+    monkeypatch.setenv("LOCALAI_KV_OVERCOMMIT", "0.5")
+    shrunk = ModelRunner(tiny.cfg, tiny.params, **kw)
+    assert shrunk.allocator.num_blocks < contiguous_blocks
+
+    explicit = ModelRunner(tiny.cfg, tiny.params, kv_num_blocks=7, **kw)
+    assert explicit.allocator.num_blocks == 7  # absolute count wins
+
+    sched = Scheduler(base, ByteTokenizer())
+    try:
+        assert sched.metrics()["kv_overcommit_ratio"] == 1.0
+    finally:
+        sched.shutdown()

@@ -106,3 +106,107 @@ def test_make_shard_fn_places_loader_tensors(mesh):
         (jax.tree_util.DictKey("layers"), jax.tree_util.DictKey("wq")), arr
     )
     assert placed.sharding.shard_shape(placed.shape)[-1] == arr.shape[-1] // 4
+
+
+# ---------------------------------------------------------------------------
+# paged-pool partition rules + mesh spec parsing (ISSUE 8)
+
+
+def test_paged_kv_spec_shards_kv_heads_on_model(mesh):
+    small = resolve_model("debug:small", dtype="float32")  # 4 kv heads
+    # [L, num_blocks, Hkv, bt, hd]: ONLY the kv-head axis shards — block
+    # ids in the host tables are global, so the block axis must stay
+    # whole on every device
+    assert shd.paged_kv_spec(small.cfg, mesh) == \
+        P(None, None, "model", None, None)
+
+
+def test_paged_kv_spec_replicates_on_indivisible_kv_heads():
+    mesh8 = build_mesh(MeshPlan(model=8))
+    tiny = resolve_model("debug:tiny", dtype="float32")  # 2 kv heads < 8
+    assert shd.paged_kv_spec(tiny.cfg, mesh8) == P(None, None, None,
+                                                   None, None)
+
+
+def test_block_table_spec_puts_slots_on_data():
+    assert shd.block_table_spec() == P("data", None)
+
+
+def test_meshed_paged_pool_and_tables_are_sharded():
+    tiny = resolve_model("debug:tiny", dtype="float32")  # 2 kv heads
+    mesh2 = build_mesh(MeshPlan(data=4, model=2))
+    params = shd.shard_params(tiny.params, tiny.cfg, mesh2)
+    r = ModelRunner(tiny.cfg, params, num_slots=4, max_ctx=64,
+                    prefill_buckets=[16], kv_dtype="float32", mesh=mesh2,
+                    paged=True, kv_block_tokens=16)
+    k = r.kv.k
+    # pool [L, N, Hkv, bt, hd]: kv heads split 2-way, block axis whole
+    assert k.sharding.shard_shape(k.shape)[2] == k.shape[2] // 2
+    assert k.sharding.shard_shape(k.shape)[1] == k.shape[1]
+    bt = r.block_tables
+    assert bt.sharding.shard_shape(bt.shape)[0] == bt.shape[0] // 4
+
+
+def test_parse_mesh_spec_both_syntaxes_and_unknown_axis():
+    from localai_tpu.parallel.mesh import parse_mesh_spec
+
+    assert parse_mesh_spec("data=2,model=4") == {"data": 2, "model": 4}
+    assert parse_mesh_spec("data:2,model:4") == {"data": 2, "model": 4}
+    assert parse_mesh_spec("") is None
+    with pytest.raises(ValueError, match="unknown mesh axis"):
+        parse_mesh_spec("modle=4")  # a typo must not serve unsharded
+
+
+def test_default_tensor_parallel_prefers_all_devices():
+    from localai_tpu.parallel.mesh import default_tensor_parallel
+
+    assert default_tensor_parallel(8, num_heads=32) == 8   # model=all
+    assert default_tensor_parallel(8, num_heads=12) == 4   # widest divisor
+    assert default_tensor_parallel(8, num_heads=7) == 1    # no split
+    assert default_tensor_parallel(1, num_heads=32) == 1
+
+
+def test_localai_mesh_env_parses_into_app_config(monkeypatch):
+    from localai_tpu.config.app_config import AppConfig
+
+    monkeypatch.setenv("LOCALAI_MESH", "data:2,model:4")
+    assert AppConfig.from_env().mesh_shape == {"data": 2, "model": 4}
+    monkeypatch.setenv("LOCALAI_MESH", "")
+    assert AppConfig.from_env().mesh_shape is None
+
+
+def test_manager_serves_meshed_paged_by_default(monkeypatch):
+    """ROADMAP item 3 acceptance: with >1 visible device the manager
+    builds the mesh itself — no flag — and keeps the paged layout under
+    it (LOCALAI_MESH_AUTO=1 stands in for a real accelerator host: the
+    CPU backend is excluded from auto-meshing so tier-1 single-device
+    semantics stay byte-identical)."""
+    from localai_tpu.config.app_config import AppConfig
+    from localai_tpu.config.model_config import ModelConfig
+    from localai_tpu.models.manager import build_runner
+
+    mcfg = ModelConfig(**{
+        "name": "meshed", "model": "debug:tiny",
+        "engine": {"max_slots": 4, "prefill_buckets": [16, 32],
+                   "dtype": "float32", "kv_dtype": "float32"},
+    })
+    app = AppConfig()
+
+    monkeypatch.setenv("LOCALAI_MESH_AUTO", "1")
+    _, runner = build_runner(mcfg, app)
+    assert runner.mesh is not None and runner.paged
+    # model=all: tiny's 4 q heads cap tp at 4, dp fills the rest
+    assert runner.mesh.shape["model"] == 4
+    assert runner.mesh.shape["data"] == 2
+
+    # CPU without the force flag: no mesh, single-device paged unchanged
+    monkeypatch.delenv("LOCALAI_MESH_AUTO")
+    _, r2 = build_runner(mcfg, app)
+    assert r2.mesh is None and r2.paged
+
+    # explicit topology (--mesh / LOCALAI_MESH → mesh_shape) always wins
+    app_explicit = AppConfig(mesh_shape={"data": 4, "model": 2})
+    _, r3 = build_runner(mcfg, app_explicit)
+    assert dict(r3.mesh.shape) == {"data": 4, "seq": 1, "pipe": 1,
+                                   "expert": 1, "model": 2}
+    assert r3.paged

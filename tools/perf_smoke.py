@@ -31,6 +31,15 @@ REPO = Path(__file__).resolve().parent.parent
 sys.path.insert(0, str(REPO))
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# two virtual host devices for the meshed-paged smoke (must land before
+# the first jax import; the jax_num_cpu_devices config is version-gated,
+# so the XLA flag is the portable spelling — single-device measurements
+# still run on device 0 only and are unaffected)
+if "--xla_force_host_platform_device_count" not in os.environ.get(
+        "XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=2"
+                               ).strip()
 
 
 def check_bench_fallback() -> list[str]:
@@ -62,12 +71,14 @@ def check_bench_fallback() -> list[str]:
 
 
 def _measure(tol: float) -> dict:
+    import jax
+
     import bench_micro
 
     idx = bench_micro.machine_index()
     contig = bench_micro.decode_smoke(paged=False)
     paged = bench_micro.decode_smoke(paged=True)
-    return {
+    out = {
         "machine_gflops": round(idx, 2),
         "decode_tok_s_contig": round(contig, 1),
         "decode_tok_s_paged": round(paged, 1),
@@ -76,6 +87,17 @@ def _measure(tol: float) -> dict:
         "paged_over_contig": round(paged / contig, 4),
         "tolerance": tol,
     }
+    # meshed-paged smoke: the same paged decode under a 2-device
+    # tensor-parallel mesh (shard_map/pjit serving path). Ratio-gated
+    # against the single-device paged number — machine-independent, like
+    # paged_over_contig. Skips clean when the runner has <2 devices.
+    if len(jax.devices()) >= 2:
+        meshed = bench_micro.decode_smoke(paged=True, mesh_devices=2)
+        out["decode_tok_s_meshed"] = round(meshed, 1)
+        out["meshed_over_paged"] = round(meshed / paged, 4)
+    else:
+        out["meshed"] = "skipped (<2 devices)"
+    return out
 
 
 def main() -> int:
@@ -139,6 +161,16 @@ def main() -> int:
             failures.append(
                 f"paged_over_contig {res['paged_over_contig']:.3f} "
                 f"< {ratio_min} (paged decode path regressed)")
+        # meshed-paged gate: CPU-mesh decode pays real collective overhead
+        # (psum per layer over virtual devices), so the floor is loose —
+        # it catches the path BREAKING or falling off a cliff, not noise.
+        # Absent when <2 devices (skip-clean).
+        meshed_min = floor.get("meshed_over_paged_min", 0.15)
+        if ("meshed_over_paged" in res
+                and res["meshed_over_paged"] < meshed_min):
+            failures.append(
+                f"meshed_over_paged {res['meshed_over_paged']:.3f} "
+                f"< {meshed_min} (meshed-paged decode path regressed)")
         return failures
 
     failures = gate(result)
