@@ -163,16 +163,22 @@ class BatchExecutor:
         self._wake.set()
 
     def stop(self, timeout: float = 10.0) -> None:
-        self._stopping = True
+        # flip the flag under the lock so a concurrent start() can't
+        # observe _stopping=False after this stop claimed the thread;
+        # join outside the lock (start() must stay callable meanwhile)
+        with self._lock:
+            self._stopping = True
+            t = self._thread
         self._wake.set()
-        t = self._thread
         if t is not None:
             t.join(timeout)
 
     # -- main loop ---------------------------------------------------------
 
     def _run(self) -> None:
-        while not self._stopping:
+        # lock-free poll of the stop flag: a bool read is atomic and the
+        # loop only needs eventual visibility (one poll_s of slack)
+        while not self._stopping:  # jaxlint: disable=lock-guarded-attr
             try:
                 self.store.expire_due()
                 job = self.store.runnable()
@@ -194,7 +200,10 @@ class BatchExecutor:
 
     def _job_live(self, bid: str) -> bool:
         job = self.store.get(bid)
-        return (job is not None and not self._stopping
+        # same lock-free stop-flag poll as _run: atomic bool read, the
+        # drain loop re-checks every line
+        return (job is not None
+                and not self._stopping  # jaxlint: disable=lock-guarded-attr
                 and job["status"] == "in_progress")
 
     # -- one job -----------------------------------------------------------

@@ -101,7 +101,7 @@ class FileRegistry:
             [_id_num(f.get("id", ""), "file-") for f in self.files] + [0]
         )
 
-    def _save(self) -> None:
+    def _save(self) -> None:  # jaxlint: guarded-by(_lock)
         _atomic_save(self.upload_dir / UPLOADED_FILES_FILE, self.files)
 
     def next_id(self) -> str:
@@ -168,10 +168,13 @@ class FileRegistry:
 
     # -- read ------------------------------------------------------------
 
-    def get(self, fid: str) -> Optional[dict]:
+    # lock-free readers: ``files`` only ever grows via append or is
+    # rebound to a fresh list under the lock — a scan sees a complete
+    # (possibly one-entry-stale) snapshot, which the HTTP tier tolerates
+    def get(self, fid: str) -> Optional[dict]:  # jaxlint: disable=lock-guarded-attr
         return next((f for f in self.files if f["id"] == fid), None)
 
-    def list(self, purpose: str = "") -> list[dict]:
+    def list(self, purpose: str = "") -> list[dict]:  # jaxlint: disable=lock-guarded-attr
         return [f for f in self.files
                 if not purpose or f.get("purpose") == purpose]
 
@@ -200,7 +203,7 @@ class BatchStore:
             [_id_num(j.get("id", ""), "batch_") for j in self.jobs] + [0]
         )
 
-    def _save(self) -> None:
+    def _save(self) -> None:  # jaxlint: guarded-by(_lock)
         _atomic_save(self.jobs_dir / BATCHES_FILE, self.jobs)
 
     # -- job lifecycle ----------------------------------------------------
@@ -233,10 +236,13 @@ class BatchStore:
             self._save()
         return job
 
-    def get(self, bid: str) -> Optional[dict]:
+    # lock-free readers (same contract as FileRegistry): ``jobs`` only
+    # appends, and job dicts are merged under the lock — pollers tolerate
+    # a one-transition-stale view
+    def get(self, bid: str) -> Optional[dict]:  # jaxlint: disable=lock-guarded-attr
         return next((j for j in self.jobs if j["id"] == bid), None)
 
-    def list(self) -> list[dict]:
+    def list(self) -> list[dict]:  # jaxlint: disable=lock-guarded-attr
         return list(self.jobs)
 
     def transition(self, bid: str, status: str, **updates) -> dict:
@@ -295,13 +301,13 @@ class BatchStore:
             # transition; its terminal state stands
             return self.get(bid)
 
-    def runnable(self) -> Optional[dict]:
+    def runnable(self) -> Optional[dict]:  # jaxlint: disable=lock-guarded-attr
         """Oldest non-terminal job (FIFO — one active job at a time keeps
         the background lane's footprint predictable)."""
         live = [j for j in self.jobs if j["status"] not in TERMINAL_STATES]
         return min(live, key=lambda j: j["created_at"]) if live else None
 
-    def expire_due(self, now: Optional[float] = None) -> list[dict]:
+    def expire_due(self, now: Optional[float] = None) -> list[dict]:  # jaxlint: disable=lock-guarded-attr
         """Expire non-terminal jobs older than the expiry horizon."""
         now = time.time() if now is None else now
         horizon = self.expiry_h * 3600.0
@@ -362,7 +368,7 @@ class BatchStore:
 
     # -- observability ----------------------------------------------------
 
-    def export_gauges(self, registry=None) -> None:
+    def export_gauges(self, registry=None) -> None:  # jaxlint: disable=lock-guarded-attr
         """Refresh ``localai_batch_jobs{state}`` at /metrics scrape time
         (every state gets a series, so dashboards can key on zeros)."""
         from localai_tpu.obs.metrics import REGISTRY

@@ -109,7 +109,7 @@ class BlockAllocator:
     def blocks_for(self, tokens: int) -> int:
         return max(1, -(-tokens // self.block_tokens))
 
-    def _reclaimable(self) -> int:
+    def _reclaimable(self) -> int:  # jaxlint: guarded-by(_lock)
         """Prefix-pool blocks held only by the pool (evictable). Caller
         holds the lock."""
         return sum(1 for b in self._prefix.values() if self._ref[b] == 1)
@@ -151,13 +151,15 @@ class BlockAllocator:
         """Insert ``seq``'s full prompt blocks into the prefix pool (each
         gains a pool reference). Call only after the blocks' contents have
         been dispatched to the device. Returns blocks registered."""
-        table = self.tables.get(seq)
-        if table is None or not prompt:
+        if not prompt:
             return 0
-        bt = self.block_tokens
-        nb = min((len(prompt) - 1) // bt, len(table))
         added = 0
         with self._lock:
+            table = self.tables.get(seq)
+            if table is None:
+                return 0
+            bt = self.block_tokens
+            nb = min((len(prompt) - 1) // bt, len(table))
             for i, key in enumerate(self._chain(prompt, nb, bt)):
                 if key in self._prefix:
                     self._prefix.move_to_end(key)
@@ -171,7 +173,7 @@ class BlockAllocator:
                 added += 1
         return added
 
-    def _evict_one(self) -> Optional[int]:
+    def _evict_one(self) -> Optional[int]:  # jaxlint: guarded-by(_lock)
         """Drop the LRU pool-only block; returns its id. Caller holds the
         lock."""
         victim = next((k for k, b in self._prefix.items()
@@ -193,11 +195,11 @@ class BlockAllocator:
         shared-token count, or None when the pool cannot cover the
         reservation (the caller queues the request). ``seq`` must not
         already hold a table."""
-        assert seq not in self.tables, f"seq {seq} already has a table"
         nb = self.blocks_for(tokens)
         shared = self.match_prefix(prompt) if prompt else []
         shared = shared[: max(0, nb - 1)]  # at least one writable block
         with self._lock:
+            assert seq not in self.tables, f"seq {seq} already has a table"
             # reference the shared blocks FIRST: a pool-only shared block
             # (ref==1) would otherwise be an eligible LRU eviction victim
             # in the fresh loop below and end up in the table twice —
@@ -232,13 +234,13 @@ class BlockAllocator:
     def extend(self, seq: int, tokens: int) -> bool:
         """Grow ``seq``'s existing table to cover ``tokens`` rows (used when
         an admission resumes past disk-loaded rows). False on exhaustion."""
-        table = self.tables.get(seq)
-        if table is None:
-            return False
-        need = self.blocks_for(tokens) - len(table)
-        if need <= 0:
-            return True
         with self._lock:
+            table = self.tables.get(seq)
+            if table is None:
+                return False
+            need = self.blocks_for(tokens) - len(table)
+            if need <= 0:
+                return True
             if need > len(self._free) + self._reclaimable():
                 return False
             for _ in range(need):
@@ -254,11 +256,11 @@ class BlockAllocator:
         return True
 
     def release(self, seq: int) -> None:
-        table = self.tables.pop(seq, None)
-        self.shared_blocks.pop(seq, None)
-        if table is None:
-            return
         with self._lock:
+            table = self.tables.pop(seq, None)
+            self.shared_blocks.pop(seq, None)
+            if table is None:
+                return
             for bid in table:
                 self._ref[bid] -= 1
                 if self._ref[bid] == 0:
@@ -269,7 +271,8 @@ class BlockAllocator:
     def table_row(self, seq: int) -> np.ndarray:
         """[max_blocks_per_seq] i32 device-shaped table row (trash-padded)."""
         row = np.zeros(self.max_blocks_per_seq, np.int32)
-        t = self.tables.get(seq, [])
+        with self._lock:
+            t = list(self.tables.get(seq, []))
         row[: len(t)] = t[: self.max_blocks_per_seq]
         return row
 

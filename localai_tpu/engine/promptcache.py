@@ -70,14 +70,15 @@ class PromptKVCache:
     def _index_path(self) -> Path:
         return self.dir / "index.json"
 
-    def _load_index(self) -> None:
+    # __init__-only: runs before the cache object is shared across threads
+    def _load_index(self) -> None:  # jaxlint: disable=lock-guarded-attr
         try:
             raw = json.loads(self._index_path().read_text())
             self._index = {k: list(map(int, v)) for k, v in raw.items()}
         except (OSError, ValueError):
             self._index = {}
 
-    def _write_index(self) -> None:
+    def _write_index(self) -> None:  # jaxlint: guarded-by(_lock)
         tmp = self._index_path().with_suffix(".tmp")
         tmp.write_text(json.dumps(self._index))
         tmp.replace(self._index_path())

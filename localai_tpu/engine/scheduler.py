@@ -376,7 +376,9 @@ class Scheduler:
             self._pc_thread.start()
 
     @property
-    def busy(self) -> bool:
+    # lock-free liveness poll: every term is an atomic read of an engine-
+    # thread-owned structure; worker Status tolerates a one-iteration lag
+    def busy(self) -> bool:  # jaxlint: disable=lock-guarded-attr
         return (bool(self._slots) or bool(self._prefills)
                 or self._held is not None
                 or not self._pending.empty()
@@ -417,6 +419,15 @@ class Scheduler:
                 1 for c in self._slots.values()
                 if c.handle.request.priority >= PRIORITY_BATCH
             )
+            # capture the lifetime counters under the same lock: a scrape
+            # must not interleave half-updated totals from a mid-dispatch
+            # engine iteration
+            totals = {
+                "prompt": self.total_prompt_tokens,
+                "generated": self.total_generated_tokens,
+                "preemptions": self.total_preemptions,
+                "shed": self.shed_total,
+            }
         paged_stats = {}
         alloc = getattr(self.runner, "allocator", None)
         if alloc is not None:
@@ -446,13 +457,13 @@ class Scheduler:
             "queue_depth": self._pending.qsize(),
             "batch_queue_depth": self._pending_batch.qsize(),
             "batch_slots": batch_slots,
-            "total_prompt_tokens": self.total_prompt_tokens,
-            "total_generated_tokens": self.total_generated_tokens,
+            "total_prompt_tokens": totals["prompt"],
+            "total_generated_tokens": totals["generated"],
             "prefix_tokens_reused": self.runner.total_prefix_reused,
             "last_dispatch_steps": self.last_dispatch_steps,
             "dispatches": self._dispatch_seq,
-            "preemptions": self.total_preemptions,
-            "shed_total": self.shed_total,
+            "preemptions": totals["preemptions"],
+            "shed_total": totals["shed"],
             "step_time_ema": self._step_ema,  # seconds per decoded token
             "step_ms_p50": pct["step_ms_p50"],
             "step_ms_p99": pct["step_ms_p99"],
@@ -467,7 +478,7 @@ class Scheduler:
             ),
         }
 
-    def _kv_utilization(self) -> float:
+    def _kv_utilization(self) -> float:  # jaxlint: disable=lock-guarded-attr
         """Fraction of KV capacity holding live context. Paged runners
         report block-pool utilization (used / allocatable blocks — the
         allocator's own accounting, reservation included); contiguous
@@ -502,7 +513,7 @@ class Scheduler:
                 log.warning("prompt-cache store failed: %s", e)
 
     def _flight_record(self, program: str, steps: int, dt: float,
-                       fresh: bool) -> None:
+                       fresh: bool) -> None:  # jaxlint: disable=lock-guarded-attr
         """One flight-ring record at a drain point. Everything here is a
         host mirror this (engine) thread already owns — ``_slots`` is only
         mutated on this thread, token counts come from ``_consume`` — so
@@ -552,7 +563,10 @@ class Scheduler:
 
     # -- engine thread ---------------------------------------------------
 
-    def _run(self) -> None:
+    # the engine thread is the SOLE mutator of _slots/_prefills/etc.;
+    # its own lock-free reads here are the single-owner-thread design the
+    # class docstring documents (the lock exists for cross-thread viewers)
+    def _run(self) -> None:  # jaxlint: disable=lock-guarded-attr
         # Pipelined multi-step decode: each dispatch advances all slots
         # multi_step tokens inside ONE compiled program (lax.scan), up to
         # pipeline_depth dispatches stay in flight, and each result's D2H
@@ -1128,7 +1142,8 @@ class Scheduler:
         out[: min(len(row), V)] = row[:V]
         return out
 
-    def _process_rows(
+    # engine-thread only (called from _run's drain path) — see _run
+    def _process_rows(  # jaxlint: disable=lock-guarded-attr
         self, rows: np.ndarray, seq: int,
         frozen: Optional[set[int]] = None,
     ) -> None:

@@ -49,7 +49,7 @@ class ExplorerDB:
                 log.warning("explorer db %s unreadable; starting empty",
                             self.path)
 
-    def _persist(self) -> None:
+    def _persist(self) -> None:  # jaxlint: guarded-by(_lock)
         if self.path is None:
             return
         try:

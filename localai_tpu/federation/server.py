@@ -202,7 +202,8 @@ class FederatedServer:
     def serve(self, address: str = "0.0.0.0", port: int = 8080) -> None:
         """Blocking entry (parity: FederatedServer.Start)."""
         log.info("federated router on %s:%d (%d nodes)", address, port,
-                 len(self._nodes))
+                 # boot-time log line; node list is static until serving
+                 len(self._nodes))  # jaxlint: disable=lock-guarded-attr
         web.run_app(self.create_app(), host=address, port=port,
                     print=None, access_log=None)
 

@@ -246,10 +246,12 @@ class ReplicaPool:
                     ) if k in m
                 }
             reps.append(snap)
+        with self._lock:
+            respawns = self.respawns
         return {
             "model": self.model,
             "states": self.states(),
-            "respawns": self.respawns,
+            "respawns": respawns,
             "health_interval_s": self.health_interval,
             "failure_threshold": self.failure_threshold,
             "replicas": reps,

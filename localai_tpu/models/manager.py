@@ -950,7 +950,7 @@ class ModelManager:
 
     # -- shutdown ---------------------------------------------------------
 
-    def _evict_locked(self, name: str) -> None:
+    def _evict_locked(self, name: str) -> None:  # jaxlint: guarded-by(_lock)
         sm = self._models.pop(name, None)
         if sm is not None:
             sm.close()

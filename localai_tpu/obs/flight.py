@@ -106,9 +106,11 @@ class FlightRecorder:
     @property
     def count(self) -> int:
         """Records ever written (resident rows = min(count, capacity))."""
-        return self._n
+        # monotone int, torn reads impossible under the GIL; observability
+        # readers tolerate being one record behind the engine thread
+        return self._n  # jaxlint: disable=lock-guarded-attr
 
-    def _order(self) -> np.ndarray:
+    def _order(self) -> np.ndarray:  # jaxlint: guarded-by(_lock)
         """Resident row indices, oldest → newest (caller holds the lock)."""
         if self._n <= self.capacity:
             return np.arange(self._n)

@@ -200,21 +200,27 @@ class SLOTracker:
                   min_events: Optional[int] = None,
                   retry_after_s: Optional[int] = None) -> None:
         """Replace the given knobs (server boot wires AppConfig through
-        here; omitted knobs keep their current values)."""
-        with self._lock:
-            if targets is not None:
-                self.targets = {k: float(v) for k, v in targets.items()
-                                if float(v) > 0}
-            if objective is not None:
-                self.objective = objective
-            if burn_threshold is not None:
-                self.burn_threshold = burn_threshold
-            if recover_burn is not None:
-                self.recover_burn = recover_burn
-            if min_events is not None:
-                self.min_events = min_events
-            if retry_after_s is not None:
-                self.retry_after_s = retry_after_s
+        here; omitted knobs keep their current values).
+
+        Deliberately lock-free: each knob is an atomic reference swap
+        (``targets`` is replaced wholesale with a fresh dict, never
+        mutated in place), and the admission path reads them lock-free —
+        a reader sees the old or the new configuration, both valid.
+        Taking ``_lock`` here would promote every one of those hot reads
+        to a lock acquisition for no consistency gain."""
+        if targets is not None:
+            self.targets = {k: float(v) for k, v in targets.items()
+                            if float(v) > 0}
+        if objective is not None:
+            self.objective = objective
+        if burn_threshold is not None:
+            self.burn_threshold = burn_threshold
+        if recover_burn is not None:
+            self.recover_burn = recover_burn
+        if min_events is not None:
+            self.min_events = min_events
+        if retry_after_s is not None:
+            self.retry_after_s = retry_after_s
 
     def reset(self) -> None:
         """Drop all events and shedding state (tests, reconfiguration).

@@ -319,10 +319,14 @@ class Watchdog:
 
     def stop(self) -> None:
         self._stop.set()
-        t = self._thread
+        # claim the thread under the lock so a racing start()/stop() pair
+        # can't both join (or leak) the same thread; join OUTSIDE the
+        # lock — holding it across a 5 s join would block start()
+        with self._lock:
+            t = self._thread
+            self._thread = None
         if t is not None:
             t.join(timeout=5)
-        self._thread = None
 
 
 # the process-wide watchdog (runner/scheduler/worker default to it);

@@ -145,8 +145,8 @@ class CommandLeader:
             return
         with self._lock:
             self._conns.append(conn)
-        log.info("multihost: follower %s joined (%d connected)",
-                 addr, len(self._conns))
+            n = len(self._conns)
+        log.info("multihost: follower %s joined (%d connected)", addr, n)
 
     def _handshake(self, conn: socket.socket) -> None:
         import hmac
@@ -167,12 +167,14 @@ class CommandLeader:
         import time
 
         deadline = time.monotonic() + timeout
+        joined = 0
         while time.monotonic() < deadline:
             with self._lock:
-                if len(self._conns) >= n:
-                    return
+                joined = len(self._conns)
+            if joined >= n:
+                return
             time.sleep(0.05)
-        raise TimeoutError(f"only {len(self._conns)} followers joined")
+        raise TimeoutError(f"only {joined} followers joined")
 
     def broadcast(self, model: str, method: str, *args, **kwargs) -> None:
         msg = _pack({

@@ -61,7 +61,7 @@ class VectorStore:
     def _key_bytes(vec: np.ndarray) -> bytes:
         return np.ascontiguousarray(vec, dtype=np.float32).tobytes()
 
-    def _check_dim(self, vec: np.ndarray) -> np.ndarray:
+    def _check_dim(self, vec: np.ndarray) -> np.ndarray:  # jaxlint: guarded-by(_lock)
         v = np.asarray(vec, np.float32).reshape(-1)
         if self.dim is None:
             self.dim = v.shape[0]
@@ -71,7 +71,7 @@ class VectorStore:
             )
         return v
 
-    def _sync_device(self) -> None:
+    def _sync_device(self) -> None:  # jaxlint: guarded-by(_lock)
         """Rebuild the device matrix if rows changed (power-of-two cap)."""
         if not self._dirty:
             return
@@ -120,7 +120,7 @@ class VectorStore:
                 else:
                     self._values[row] = val
 
-    def _row_of(self, vec: np.ndarray) -> Optional[int]:
+    def _row_of(self, vec: np.ndarray) -> Optional[int]:  # jaxlint: guarded-by(_lock)
         """Exact-key lookup that never latches/asserts dimensions — reads
         against an empty or differently-sized store just miss."""
         v = np.asarray(vec, np.float32).reshape(-1)

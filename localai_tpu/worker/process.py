@@ -263,7 +263,7 @@ class WorkerPool:
                 self._workers[name] = wp
             return client
 
-    def _drop_locked(self, name: str) -> None:
+    def _drop_locked(self, name: str) -> None:  # jaxlint: guarded-by(_lock)
         wp = self._workers.pop(name, None)
         if wp is not None:
             if self._watchdog is not None and wp.client is not None:

@@ -132,7 +132,10 @@ class BackendServicer:
                 log.exception("LoadModel failed")
                 return pb.Result(success=False, message=self._load_error)
 
-    def Status(self, request: pb.HealthMessage, context) -> pb.StatusResponse:
+    # _sm/_load_error are single-assignment references set by LoadModel
+    # under the lock; serving paths read them lock-free — a reader sees
+    # None (not loaded) or a fully constructed model, never a torn value
+    def Status(self, request: pb.HealthMessage, context) -> pb.StatusResponse:  # jaxlint: disable=lock-guarded-attr
         if self._sm is None:
             state = (pb.StatusResponse.ERROR if self._load_error
                      else pb.StatusResponse.UNINITIALIZED)
@@ -150,7 +153,7 @@ class BackendServicer:
         return pb.StatusResponse(state=state, memory=mem)
 
     def GetMetrics(self, request: pb.MetricsRequest,
-                   context) -> pb.MetricsResponse:
+                   context) -> pb.MetricsResponse:  # jaxlint: disable=lock-guarded-attr
         if self._sm is None:
             return pb.MetricsResponse(json="{}")
         payload = self._sm.scheduler.metrics()
@@ -166,7 +169,7 @@ class BackendServicer:
 
     # -- inference -------------------------------------------------------
 
-    def _require_model(self, context):
+    def _require_model(self, context):  # jaxlint: disable=lock-guarded-attr
         if self._sm is None:
             context.abort(
                 grpc.StatusCode.FAILED_PRECONDITION,
@@ -406,8 +409,9 @@ class AudioServicer:
                 return pb.Result(success=False,
                                  message=f"{type(e).__name__}: {e}")
 
+    # same single-assignment-reference pattern as BackendServicer._sm
     def AudioTranscription(self, request: pb.TranscriptRequest,
-                           context) -> pb.TranscriptResult:
+                           context) -> pb.TranscriptResult:  # jaxlint: disable=lock-guarded-attr
         from localai_tpu.audio import read_wav
 
         if self._whisper is None:
@@ -504,8 +508,9 @@ class ImageServicer:
                 return pb.Result(success=False,
                                  message=f"{type(e).__name__}: {e}")
 
+    # same single-assignment-reference pattern as BackendServicer._sm
     def GenerateImage(self, request: pb.GenerateImageRequest,
-                      context) -> pb.ImageResult:
+                      context) -> pb.ImageResult:  # jaxlint: disable=lock-guarded-attr
         import io
 
         from PIL import Image

@@ -223,7 +223,8 @@ class WorkerScheduler:
             m = self._owner.client().metrics()
         except Exception as e:  # noqa: BLE001
             return {"error": str(e)}
-        m["shed_total"] = self.shed_total  # API-tier counter, not the RPC's
+        # monotone int scrape read; a one-increment-stale value is fine
+        m["shed_total"] = self.shed_total  # jaxlint: disable=lock-guarded-attr
         return m
 
     def shutdown(self, timeout: float = 10.0) -> None:

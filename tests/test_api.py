@@ -370,8 +370,11 @@ def test_metrics_engine_series(client):
     assert 'localai_queue_wait_seconds_count{model="tiny"}' in body
     assert 'localai_requests_total{' in body
     assert 'localai_decode_dispatches_total{model="tiny"}' in body
-    # compile time recorded by the runner's watched jit entry points
-    assert 'localai_xla_compile_seconds_total{program="prefill"}' in body
+    # compile time recorded by the runner's watched jit entry points —
+    # the paged default prefills through the chunk program, contiguous
+    # engines through "prefill"
+    assert ('localai_xla_compile_seconds_total{program="prefill_chunk"}' in body
+            or 'localai_xla_compile_seconds_total{program="prefill"}' in body)
     # family names present even with no series yet (scrape stability)
     assert "# TYPE localai_prompt_cache_hit_rate gauge" in body
     assert "# TYPE localai_speculative_accept_rate gauge" in body

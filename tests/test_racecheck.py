@@ -1,0 +1,264 @@
+"""tools.racecheck: the instrumented-lock lock-order harness.
+
+Cycle detection on a synthetic ABBA inversion, clean runs on ordered
+acquisition, RLock reentrancy, same-site instance-pair semantics, and
+the install/uninstall patching contract.
+"""
+
+import sys
+import threading
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[1]
+if str(REPO) not in sys.path:
+    sys.path.insert(0, str(REPO))
+
+from tools.racecheck import LockMonitor  # noqa: E402
+
+
+def run_in_thread(fn):
+    t = threading.Thread(target=fn)
+    t.start()
+    t.join(10)
+    assert not t.is_alive()
+
+
+def make_locks(mon, n=2, rlock=False):
+    """n traced locks, each from a DISTINCT creation site."""
+    with mon:
+        if rlock:
+            out = [threading.RLock() for _ in range(1)]  # site A
+            out += [threading.RLock() for _ in range(n - 1)]  # site B
+        else:
+            out = [threading.Lock() for _ in range(1)]
+            out += [threading.Lock() for _ in range(n - 1)]
+    return out
+
+
+def test_abba_inversion_detected():
+    mon = LockMonitor()
+    a, b = make_locks(mon)
+
+    def t1():
+        with a:
+            with b:
+                pass
+
+    def t2():
+        with b:
+            with a:
+                pass
+
+    run_in_thread(t1)
+    run_in_thread(t2)
+    inv = mon.inversions()
+    assert len(inv) == 1
+    report = mon.report()
+    assert "1 inversion" in report
+    # the report names both edges of the cycle with a stack each
+    assert report.count("first acquired at") == 2
+
+
+def test_ordered_acquisition_is_clean():
+    mon = LockMonitor()
+    a, b = make_locks(mon)
+
+    def worker():
+        with a:
+            with b:
+                pass
+
+    for _ in range(3):
+        run_in_thread(worker)
+    assert mon.inversions() == []
+    assert ("tests/test_racecheck.py" in next(iter(mon.edges()))[0])
+
+
+def test_three_lock_cycle_detected():
+    # A->B, B->C, C->A: no single ABBA pair, still a deadlock cycle
+    mon = LockMonitor()
+    with mon:
+        a = threading.Lock()
+        b = threading.Lock()
+        c = threading.Lock()
+
+    for first, second in ((a, b), (b, c), (c, a)):
+        def nest(first=first, second=second):
+            with first:
+                with second:
+                    pass
+        run_in_thread(nest)
+    inv = mon.inversions()
+    assert len(inv) == 1
+    assert len(inv[0].cycle) == 4  # three nodes, closed back to the anchor
+
+
+def test_rlock_reentrancy_is_not_an_edge():
+    mon = LockMonitor()
+    (lk,) = make_locks(mon, n=1, rlock=True)
+
+    def worker():
+        with lk:
+            with lk:  # reentrant re-acquire cannot block
+                pass
+
+    run_in_thread(worker)
+    assert mon.inversions() == []
+    assert mon.edges() == {}
+
+
+def test_same_site_consistent_order_is_clean_but_inversion_flags():
+    # two instances from ONE construction site: nesting them in a
+    # consistent order is legal; both orders is the per-instance ABBA
+    mon = LockMonitor()
+    with mon:
+        locks = [threading.Lock() for _ in range(2)]
+    i1, i2 = locks
+
+    def consistent():
+        with i1:
+            with i2:
+                pass
+
+    run_in_thread(consistent)
+    run_in_thread(consistent)
+    assert mon.inversions() == []
+
+    def inverted():
+        with i2:
+            with i1:
+                pass
+
+    run_in_thread(inverted)
+    inv = mon.inversions()
+    assert len(inv) == 1
+    assert "instance" in inv[0].cycle[0]
+
+
+def test_install_uninstall_restores_primitives():
+    real_lock = threading.Lock
+    real_rlock = threading.RLock
+    mon = LockMonitor()
+    mon.install()
+    try:
+        assert threading.Lock is not real_lock
+        traced = threading.Lock()
+    finally:
+        mon.uninstall()
+    assert threading.Lock is real_lock
+    assert threading.RLock is real_rlock
+    # locks created while installed keep working after uninstall
+    with traced:
+        assert traced.locked()
+    assert not traced.locked()
+    assert mon.locks_created >= 1
+
+
+def test_nonblocking_acquire_records_no_edge():
+    mon = LockMonitor()
+    a, b = make_locks(mon)
+
+    def worker():
+        with a:
+            # a try-lock cannot deadlock this thread: must not add a->b
+            assert b.acquire(blocking=False)
+            b.release()
+
+    run_in_thread(worker)
+    assert mon.edges() == {}
+
+
+def test_event_and_queue_still_work_under_instrumentation():
+    # Condition/Event/Queue are built ON the patched primitives — the
+    # wrapper must satisfy their duck-typed lock contract
+    import queue
+
+    mon = LockMonitor()
+    with mon:
+        ev = threading.Event()
+        q = queue.Queue()
+        cond = threading.Condition()
+
+    def producer():
+        q.put(1)
+        ev.set()
+        with cond:
+            cond.notify_all()
+
+    run_in_thread(producer)
+    assert ev.wait(5)
+    assert q.get(timeout=5) == 1
+    with cond:
+        pass
+    assert mon.inversions() == []
+
+
+def test_condition_wait_on_recursively_held_rlock_keeps_tracking():
+    # Condition.wait() fully releases a recursively-held RLock and then
+    # restores the full depth: the monitor must re-add EVERY level, or
+    # the first post-wait release() forgets the lock while the thread
+    # still owns it and edges acquired afterwards are silently dropped
+    mon = LockMonitor()
+    with mon:
+        rl = threading.RLock()
+        cond = threading.Condition(rl)
+        other = threading.Lock()
+
+    woke = threading.Event()
+
+    def waiter():
+        with rl:           # depth 1
+            with cond:     # depth 2 (Condition shares rl)
+                cond.wait(5)
+            # depth back to 1: rl is STILL held here
+            with other:    # must record the rl -> other edge
+                pass
+        woke.set()
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    # let the waiter reach wait(), then wake it
+    import time
+    for _ in range(100):
+        time.sleep(0.02)
+        with cond:
+            cond.notify_all()
+        if woke.is_set():
+            break
+    t.join(10)
+    assert not t.is_alive()
+    assert any("test_racecheck" in a and "test_racecheck" in b
+               for a, b in mon.edges())
+    assert mon.inversions() == []
+
+
+def test_same_site_pairs_key_on_serials_not_ids():
+    # instance identity must survive GC: serials are process-unique, so
+    # a recycled id() can never pair two locks that never coexisted
+    mon = LockMonitor()
+    with mon:
+        locks = [threading.Lock() for _ in range(3)]
+    serials = [lk.serial for lk in locks]
+    assert len(set(serials)) == 3
+    del locks
+    with mon:
+        fresh = [threading.Lock() for _ in range(3)]
+    assert not set(serials) & {lk.serial for lk in fresh}
+
+
+def test_edges_survive_exceptions_in_critical_section():
+    mon = LockMonitor()
+    a, b = make_locks(mon)
+
+    def worker():
+        try:
+            with a:
+                with b:
+                    raise RuntimeError("boom")
+        except RuntimeError:
+            pass
+
+    run_in_thread(worker)
+    # the with-blocks released both locks despite the raise
+    assert not a.locked() and not b.locked()
+    assert len(mon.edges()) == 1

@@ -16,6 +16,11 @@ from pathlib import Path, PurePosixPath
 from typing import Iterable, Iterator, Optional, Protocol
 
 SUPPRESS_RE = re.compile(r"#\s*jaxlint:\s*disable=([A-Za-z0-9_\-, ]+)")
+# `# jaxlint: guarded-by(_lock)` — a lock-discipline assertion consumed by
+# the lockcheck pass: on a `def` line it means "callers hold <lock>", on an
+# attribute-init line it declares the attribute guarded, on any other
+# statement it asserts the statement runs with <lock> held.
+GUARDED_RE = re.compile(r"#\s*jaxlint:\s*guarded-by\(([A-Za-z0-9_, ]+)\)")
 
 _SCOPES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
 _LOOPS = (ast.For, ast.AsyncFor, ast.While)
@@ -41,6 +46,21 @@ class Rule(Protocol):
     doc: str
 
     def check(self, module: "Module") -> Iterator[Finding]: ...
+
+
+class ProjectRule(Protocol):
+    """A rule that needs the WHOLE scanned file set before it can judge
+    (cross-file consistency, e.g. the metric-name registry check). The
+    engine feeds every parsed module to ``collect`` and asks for findings
+    once at the end. Instances are stateful per run — the engine
+    constructs a fresh one from the registered instance's class."""
+
+    id: str
+    doc: str
+
+    def collect(self, module: "Module") -> None: ...
+
+    def finalize(self) -> Iterator[Finding]: ...
 
 
 class Module:
@@ -124,7 +144,7 @@ class Module:
             text=self.line_text(node.lineno),
         )
 
-    # -- suppressions ----------------------------------------------------
+    # -- suppressions / annotations --------------------------------------
 
     def suppressed(self, finding: Finding) -> bool:
         m = SUPPRESS_RE.search(self.line_text(finding.line))
@@ -132,6 +152,16 @@ class Module:
             return False
         ids = {part.strip() for part in m.group(1).split(",")}
         return "all" in ids or finding.rule in ids
+
+    def guarded_by(self, lineno: int) -> frozenset:
+        """Lock names asserted held by a ``guarded-by(...)`` annotation
+        on ``lineno`` (empty when unannotated)."""
+        m = GUARDED_RE.search(self.line_text(lineno))
+        if not m:
+            return frozenset()
+        return frozenset(
+            p.strip() for p in m.group(1).split(",") if p.strip()
+        )
 
 
 class Baseline:
@@ -224,18 +254,29 @@ def iter_py_files(paths: Iterable[str]) -> Iterator[Path]:
             yield path
 
 
-def lint_file(path: Path, rules: Iterable[Rule]) -> list[Finding]:
+def load_module(path: Path) -> "Module | Finding":
+    """Parse one file → Module, or the parse-error Finding."""
     try:
-        source = path.read_text()
-        module = Module(str(path), source)
+        return Module(str(path), path.read_text())
     except (SyntaxError, UnicodeDecodeError) as e:
         line = getattr(e, "lineno", 1) or 1
-        return [Finding(
+        return Finding(
             file=normalize_path(str(path)), line=line, col=0,
             rule="parse-error", message=f"could not parse: {e}", text="",
-        )]
+        )
+
+
+def lint_file(path: Path, rules: Iterable[Rule]) -> list[Finding]:
+    """Run the per-module rules against one file. ProjectRules (which
+    need the whole scanned file set) are skipped — only lint_paths can
+    meaningfully run those."""
+    module = load_module(path)
+    if isinstance(module, Finding):
+        return [module]
     out: list[Finding] = []
     for rule in rules:
+        if not hasattr(rule, "check"):
+            continue
         for f in rule.check(module):
             if not module.suppressed(f):
                 out.append(f)
@@ -250,7 +291,28 @@ def lint_paths(
         from tools.jaxlint.rules import ALL_RULES
         rules = ALL_RULES
     rules = list(rules)
+    per_module = [r for r in rules if not hasattr(r, "collect")]
+    # project rules accumulate cross-file state: a fresh instance per run
+    # so repeated lint_paths calls in one process never bleed together
+    project = [type(r)() for r in rules if hasattr(r, "collect")]
     findings: list[Finding] = []
+    modules: dict[str, Module] = {}
     for f in iter_py_files(paths):
-        findings.extend(lint_file(f, rules))
+        module = load_module(f)
+        if isinstance(module, Finding):
+            findings.append(module)
+            continue
+        for rule in per_module:
+            for fd in rule.check(module):
+                if not module.suppressed(fd):
+                    findings.append(fd)
+        for rule in project:
+            rule.collect(module)
+        modules[module.path] = module
+    for rule in project:
+        for fd in rule.finalize():
+            m = modules.get(fd.file)
+            if m is None or not m.suppressed(fd):
+                findings.append(fd)
+    findings.sort(key=lambda f: (f.file, f.line, f.col, f.rule))
     return findings

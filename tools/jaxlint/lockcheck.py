@@ -1,0 +1,441 @@
+"""lockcheck: lock-discipline dataflow over classes that own locks.
+
+The host plane of this repo is a many-thread system (fleet pool/router,
+batch executor, obs watchdog/flight/SLO, scheduler lanes) built on
+``threading.Lock``/``RLock``. Its two recurring review-fix classes are
+
+  1. a shared attribute written under ``with self._lock`` in one method
+     but read or written lock-free somewhere else (the PR 8 counter
+     bugs), and
+  2. a blocking operation — device round-trip, replica/worker RPC,
+     ``time.sleep`` — performed while a lock is held, freezing every
+     thread that needs the lock for the duration (the PR 7 scrape
+     stall).
+
+This pass models each class: attributes with at least one write under a
+held lock (outside ``__init__``) are *guarded*; every other access of a
+guarded attribute must hold that lock. Annotations refine the model:
+
+  ``# jaxlint: guarded-by(_lock)`` on a ``def`` line
+      the method's callers hold ``_lock`` (private helpers);
+  on an attribute assignment in ``__init__``
+      declares the attribute guarded even before any locked write;
+  on any other statement
+      asserts that statement runs with the lock held.
+
+Deliberately lock-free reads (host-mirror snapshots, monotone-counter
+scrapes) are waived in place with the standard
+``# jaxlint: disable=lock-guarded-attr (reason)`` comment — the reason
+is the documentation the next reader needs.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import re
+from typing import Iterator, Optional
+
+from tools.jaxlint.core import SUPPRESS_RE, Finding, Module
+
+LOCK_CTORS = {"threading.Lock", "threading.RLock", "Lock", "RLock"}
+# attributes holding these are thread-safe sync primitives themselves —
+# calling .set()/.wait()/.put() on them lock-free is their whole point
+SYNC_CTORS = {
+    "threading.Event", "threading.Condition", "threading.Semaphore",
+    "threading.BoundedSemaphore", "threading.Barrier",
+    "Event", "Condition", "Semaphore",
+    "queue.Queue", "queue.SimpleQueue", "queue.LifoQueue",
+    "queue.PriorityQueue", "Queue", "SimpleQueue",
+}
+
+# receiver methods that mutate the container they're called on
+MUTATORS = {
+    "append", "appendleft", "add", "discard", "remove", "pop", "popleft",
+    "clear", "update", "extend", "insert", "setdefault", "popitem",
+    "put", "put_nowait",
+}
+
+# calls that block the calling thread long enough to matter under a lock
+BLOCKING_DOTTED = {
+    "time.sleep",
+    "jax.device_get", "jax.block_until_ready",
+    "subprocess.run", "subprocess.call", "subprocess.check_output",
+    "subprocess.check_call", "subprocess.Popen",
+}
+# np.asarray/np.array block only when fed a DEVICE value (then they are a
+# device->host sync); on host lists/ndarrays they are cheap copies, so
+# they count only when the argument looks device-resident
+NP_GATHERS = {"np.asarray", "np.array", "numpy.asarray", "numpy.array"}
+DEVICEISH = re.compile(r"\b(jnp|jax)\.|\.(state|kv)\b|device")
+# attribute calls that block regardless of receiver
+BLOCKING_METHODS = {"item", "block_until_ready", "result", "wait"}
+# gRPC service methods (backend.proto) — a stub call under a lock is the
+# scrape-stall class verbatim
+RPC_METHODS = {
+    "Health", "Predict", "PredictStream", "LoadModel", "Embedding",
+    "TokenizeString", "Status", "GetMetrics", "Rerank", "TTS",
+    "SoundGeneration", "GenerateImage", "AudioTranscription",
+    "PrefillPrefix", "TransferPrefix",
+    "StoresSet", "StoresGet", "StoresFind", "StoresDelete",
+}
+# the worker-client / replica wrappers around those RPCs: blocking when
+# invoked on anything that is not plain ``self`` (a method on self is a
+# local computation; the same name on a replica/client object is a
+# network round-trip)
+CLIENT_RPC_METHODS = {
+    "dial", "predict", "predict_stream", "load_model", "health",
+    "prefill_prefix", "transfer_prefix", "tokenize", "embedding",
+    "metrics", "stats", "rerank", "transcribe", "tts",
+    "sound_generation", "generate_image",
+    "stores_set", "stores_get", "stores_find", "stores_delete",
+}
+
+
+@dataclasses.dataclass
+class Access:
+    attr: str
+    node: ast.AST
+    write: bool
+    held: frozenset       # lock names held at this point
+    method: str
+
+
+@dataclasses.dataclass
+class BlockingCall:
+    node: ast.AST
+    what: str
+    held: frozenset
+    method: str
+
+
+class ClassLockModel:
+    """Per-class lock/attribute model built by one AST walk."""
+
+    def __init__(self, module: Module, cls: ast.ClassDef):
+        self.module = module
+        self.cls = cls
+        self.locks: set[str] = set()
+        self.sync_attrs: set[str] = set()
+        self.accesses: list[Access] = []
+        self.blocking: list[BlockingCall] = []
+        self.method_lines: dict[str, int] = {}
+        # attr -> set of lock names it was written under / declared with
+        self.guards: dict[str, set[str]] = {}
+        self._find_locks()
+        if self.locks:
+            for fn in cls.body:
+                if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    self._walk_method(fn)
+            self._infer_guards()
+
+    # -- model construction ----------------------------------------------
+
+    def _find_locks(self) -> None:
+        for node in ast.walk(self.cls):
+            if not isinstance(node, ast.Assign):
+                continue
+            if not isinstance(node.value, ast.Call):
+                continue
+            ctor = self.module.dotted(node.value.func)
+            if ctor not in LOCK_CTORS and ctor not in SYNC_CTORS:
+                continue
+            for t in node.targets:
+                if (isinstance(t, ast.Attribute)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id == "self"):
+                    if ctor in LOCK_CTORS:
+                        self.locks.add(t.attr)
+                    else:
+                        self.sync_attrs.add(t.attr)
+
+    def _lock_name(self, expr: ast.AST) -> Optional[str]:
+        """``self._lock`` → ``_lock`` when it names a tracked lock."""
+        if (isinstance(expr, ast.Attribute)
+                and isinstance(expr.value, ast.Name)
+                and expr.value.id == "self" and expr.attr in self.locks):
+            return expr.attr
+        return None
+
+    def _walk_method(self, fn) -> None:
+        # the signature may span lines; annotations/waivers count on any
+        # of them (a trailing comment naturally lands on the `:` line)
+        sig_end = fn.body[0].lineno if fn.body else fn.lineno + 1
+        sig_lines = range(fn.lineno, max(fn.lineno + 1, sig_end))
+        self.method_lines[fn.name] = sig_lines
+        held = frozenset()
+        for line in sig_lines:
+            held = held | self.module.guarded_by(line)
+        # manual acquire()/release() of a tracked lock: treat the whole
+        # method as holding it — conservative, but manual lock management
+        # is rare here and the alternative is a false-positive storm
+        for node in ast.walk(fn):
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in ("acquire", "release")):
+                name = self._lock_name(node.func.value)
+                if name:
+                    held = held | {name}
+        self._walk_stmts(fn.body, held, fn.name)
+
+    def _walk_stmts(self, stmts, held: frozenset, method: str) -> None:
+        for stmt in stmts:
+            h = held | self.module.guarded_by(stmt.lineno)
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                acquired = set()
+                for item in stmt.items:
+                    name = self._lock_name(item.context_expr)
+                    if name:
+                        acquired.add(name)
+                    else:
+                        self._record_expr(item.context_expr, h, method)
+                self._walk_stmts(stmt.body, h | acquired, method)
+            elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # a nested def runs LATER (thread target, callback): locks
+                # held at definition time are not held at run time
+                self._walk_stmts(
+                    stmt.body, self.module.guarded_by(stmt.lineno),
+                    method)
+            elif isinstance(stmt, ast.ClassDef):
+                continue
+            elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+                self._record_expr(stmt.iter, h, method)
+                self._record_expr(stmt.target, h, method)
+                self._walk_stmts(stmt.body + stmt.orelse, h, method)
+            elif isinstance(stmt, ast.While):
+                self._record_expr(stmt.test, h, method)
+                self._walk_stmts(stmt.body + stmt.orelse, h, method)
+            elif isinstance(stmt, ast.If):
+                self._record_expr(stmt.test, h, method)
+                self._walk_stmts(stmt.body + stmt.orelse, h, method)
+            elif isinstance(stmt, ast.Try):
+                self._walk_stmts(stmt.body, h, method)
+                for hd in stmt.handlers:
+                    self._walk_stmts(hd.body, h, method)
+                self._walk_stmts(stmt.orelse + stmt.finalbody, h, method)
+            else:
+                self._record_stmt(stmt, h, method)
+
+    def _record_stmt(self, stmt, held: frozenset, method: str) -> None:
+        # classify write targets first so _record_expr can skip them
+        if isinstance(stmt, ast.Assign):
+            for t in stmt.targets:
+                self._record_target(t, held, method)
+            self._record_expr(stmt.value, held, method)
+        elif isinstance(stmt, ast.AugAssign):
+            self._record_target(stmt.target, held, method, aug=True)
+            self._record_expr(stmt.value, held, method)
+        elif isinstance(stmt, ast.AnnAssign):
+            self._record_target(stmt.target, held, method)
+            if stmt.value is not None:
+                self._record_expr(stmt.value, held, method)
+        elif isinstance(stmt, ast.Delete):
+            for t in stmt.targets:
+                self._record_target(t, held, method)
+        else:
+            for child in ast.iter_child_nodes(stmt):
+                if isinstance(child, ast.expr):
+                    self._record_expr(child, held, method)
+
+    def _self_attr(self, node) -> Optional[str]:
+        if (isinstance(node, ast.Attribute)
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "self"
+                and node.attr not in self.locks
+                and node.attr not in self.sync_attrs):
+            return node.attr
+        return None
+
+    def _record_target(self, target, held, method, aug=False) -> None:
+        """An assignment target: ``self.x``, ``self.x[k]``, tuples."""
+        if isinstance(target, (ast.Tuple, ast.List)):
+            for e in target.elts:
+                self._record_target(e, held, method, aug=aug)
+            return
+        root = target
+        while isinstance(root, ast.Subscript):
+            self._record_expr(root.slice, held, method)
+            root = root.value
+        attr = self._self_attr(root)
+        if attr is not None:
+            self.accesses.append(
+                Access(attr, target, True, held, method))
+        elif isinstance(root, (ast.Attribute, ast.Name)):
+            self._record_expr(root, held, method)
+
+    def _record_expr(self, expr, held: frozenset, method: str) -> None:
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Call):
+                self._classify_call(node, held, method)
+            attr = self._self_attr(node) if isinstance(
+                node, ast.Attribute) else None
+            if attr is None:
+                continue
+            parent = self.module.parents.get(node)
+            write = (
+                isinstance(node.ctx, (ast.Store, ast.Del))
+                or (isinstance(parent, ast.Attribute)
+                    and parent.attr in MUTATORS
+                    and isinstance(self.module.parents.get(parent),
+                                   ast.Call))
+            )
+            self.accesses.append(Access(attr, node, write, held, method))
+
+    def _classify_call(self, node: ast.Call, held, method) -> None:
+        if not held:
+            return
+        what = self._blocking_kind(node)
+        if what:
+            self.blocking.append(BlockingCall(node, what, held, method))
+
+    def _blocking_kind(self, node: ast.Call) -> Optional[str]:
+        name = self.module.dotted(node.func)
+        if name in BLOCKING_DOTTED:
+            return f"`{name}(...)`"
+        if name in NP_GATHERS and node.args:
+            try:
+                src = ast.unparse(node.args[0])
+            except Exception:
+                src = ""
+            if DEVICEISH.search(src):
+                return f"`{name}(...)` device gather"
+        func = node.func
+        if not isinstance(func, ast.Attribute):
+            return None
+        if func.attr == "item" and not node.args and not node.keywords:
+            return "`.item()` device sync"
+        if func.attr in ("block_until_ready",):
+            return "`.block_until_ready()` device sync"
+        if func.attr in ("result", "wait"):
+            return f"`.{func.attr}(...)` blocking wait"
+        if func.attr in RPC_METHODS:
+            return f"gRPC `.{func.attr}(...)`"
+        try:
+            recv = ast.unparse(func.value)
+        except Exception:
+            recv = ""
+        if "stub" in recv.split("."):
+            return f"gRPC `{recv}.{func.attr}(...)`"
+        if func.attr in CLIENT_RPC_METHODS and recv != "self":
+            return f"replica/worker RPC `.{func.attr}(...)`"
+        return None
+
+    # -- guard inference ---------------------------------------------------
+
+    def _infer_guards(self) -> None:
+        # explicit declarations: `self.x = ...  # jaxlint: guarded-by(_lk)`
+        for node in ast.walk(self.cls):
+            if not isinstance(node, (ast.Assign, ast.AnnAssign,
+                                     ast.AugAssign)):
+                continue
+            declared = self.module.guarded_by(node.lineno)
+            declared = {d for d in declared if d in self.locks}
+            if not declared:
+                continue
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            for t in targets:
+                root = t
+                while isinstance(root, ast.Subscript):
+                    root = root.value
+                attr = self._self_attr(root)
+                if attr:
+                    self.guards.setdefault(attr, set()).update(declared)
+        # inferred: written under a held lock outside __init__
+        for a in self.accesses:
+            if a.write and a.held and a.method != "__init__":
+                self.guards.setdefault(a.attr, set()).update(a.held)
+
+
+def method_waived(module: Module, model: ClassLockModel,
+                  method: str, rule: str) -> bool:
+    """A ``# jaxlint: disable=<rule>`` on a METHOD's ``def`` line waives
+    the whole body — the idiom for single-owner-thread structures where
+    every lock-free access in the method is the same deliberate design
+    (one documented waiver instead of one per line)."""
+    for line in model.method_lines.get(method, ()):
+        m = SUPPRESS_RE.search(module.line_text(line))
+        if not m:
+            continue
+        ids = {p.strip() for p in m.group(1).split(",")}
+        if "all" in ids or rule in ids:
+            return True
+    return False
+
+
+def lock_models(module: Module) -> list[ClassLockModel]:
+    cached = module.__dict__.get("_lock_models")
+    if cached is None:
+        cached = [
+            ClassLockModel(module, node)
+            for node in ast.walk(module.tree)
+            if isinstance(node, ast.ClassDef)
+        ]
+        module.__dict__["_lock_models"] = cached
+    return cached
+
+
+class LockGuardedAttr:
+    """Reads/writes of a lock-guarded attribute without the lock.
+
+    An attribute written under ``with self._lock`` anywhere (or declared
+    with ``guarded-by``) is shared mutable state; touching it lock-free
+    in another method is a data race until proven otherwise. Intentional
+    lock-free reads (host mirrors, monotone counters feeding a scrape)
+    get an inline ``disable`` with the reason spelled out.
+    """
+
+    id = "lock-guarded-attr"
+    doc = ("read/write of an attribute guarded by a class lock "
+           "(written under `with self._lock` elsewhere) while the lock "
+           "is not held")
+
+    def check(self, module: Module) -> Iterator[Finding]:
+        for model in lock_models(module):
+            for a in model.accesses:
+                guard = model.guards.get(a.attr)
+                if not guard or a.method == "__init__":
+                    continue
+                if a.held & guard:
+                    continue
+                if method_waived(module, model, a.method, self.id):
+                    continue
+                kind = "write to" if a.write else "read of"
+                lock = "/".join(sorted(guard))
+                yield module.finding(
+                    a.node, self.id,
+                    f"{kind} '{a.attr}' outside `self.{lock}` — it is "
+                    f"written under that lock elsewhere in "
+                    f"{model.cls.name}; take the lock, or waive with a "
+                    f"reason if the lock-free access is intentional",
+                )
+
+
+class BlockingUnderLock:
+    """Blocking operations while holding a class lock.
+
+    A device round-trip, replica/worker RPC, future/event wait, or
+    ``time.sleep`` under a lock blocks every thread that needs the lock
+    for the call's full duration — the PR 7 scrape stall (stats RPCs
+    under the manager lock) as a lint rule. Copy what the call needs,
+    release the lock, then block.
+    """
+
+    id = "blocking-under-lock"
+    doc = ("device sync, gRPC/replica RPC, future/event wait, "
+           "subprocess, or time.sleep performed while a threading lock "
+           "is held")
+
+    def check(self, module: Module) -> Iterator[Finding]:
+        for model in lock_models(module):
+            for b in model.blocking:
+                if method_waived(module, model, b.method, self.id):
+                    continue
+                lock = "/".join(sorted(b.held))
+                yield module.finding(
+                    b.node, self.id,
+                    f"{b.what} while holding `self.{lock}` in "
+                    f"{model.cls.name}.{b.method} blocks every thread "
+                    f"needing the lock; move the call outside the "
+                    f"critical section",
+                )

@@ -1,0 +1,163 @@
+"""metriccheck: the ``localai_*`` series names must agree everywhere.
+
+The obs registry (``localai_tpu/obs/metrics.py``) is the single source
+of truth for every exported series. Tests assert exposition substrings,
+the README documents the series table, runbooks reference gauges by
+name — all as bare strings. A rename that misses one of them is a
+silent dashboard outage: the scrape succeeds, the panel goes blank.
+
+Two directions, both findings:
+
+  * a ``localai_*`` name referenced in any scanned file (or the
+    README.md sitting next to the scanned ``localai_tpu`` tree) that
+    does not resolve to a registry series — the reference is dead;
+  * a registry series referenced nowhere (not even the README) — the
+    series is undocumented and unasserted, i.e. already half-drifted.
+
+Matching understands the exposition grammar: ``_bucket``/``_sum``/
+``_count`` suffixes resolve to their histogram, and a trailing ``_`` or
+``*`` in docs (``localai_kv_blocks_*``) is a prefix wildcard.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from pathlib import Path
+from typing import Iterator, Optional
+
+from tools.jaxlint.core import Finding, Module, normalize_path
+
+METRIC_RE = re.compile(r"localai_[a-z0-9_]+\*?")
+HIST_SUFFIXES = ("_bucket", "_sum", "_count")
+METRIC_CTORS = {"Histogram", "Counter", "Gauge"}
+# localai_-prefixed strings that are not metric series
+NON_METRICS = {"localai_tpu", "localai_trace_id", "localai_tpu_native"}
+
+
+class MetricNameDrift:
+    id = "metric-name-drift"
+    doc = ("localai_* series name referenced in code/tests/README that "
+           "is missing from the obs/metrics.py registry, or a registry "
+           "series referenced nowhere")
+
+    def __init__(self):
+        # name -> (file, line, kind)
+        self.registry: Optional[dict[str, tuple]] = None
+        self.registry_module: Optional[Module] = None
+        # (file, line, token, text)
+        self.refs: list[tuple] = []
+        self._roots: list[Path] = []
+
+    # -- phase 1: per-module collection -----------------------------------
+
+    def collect(self, module: Module) -> None:
+        path = module.path
+        if "tools/jaxlint" in path:
+            return  # the analyzer's own pattern strings aren't references
+        if path.endswith("obs/metrics.py"):
+            self._collect_registry(module)
+            return
+        root = Path(path).resolve()
+        if root.parent not in self._roots:
+            self._roots.append(root.parent)
+        for node in ast.walk(module.tree):
+            if not (isinstance(node, ast.Constant)
+                    and isinstance(node.value, str)):
+                continue
+            for tok in METRIC_RE.findall(node.value):
+                if tok in NON_METRICS:
+                    continue
+                self.refs.append(
+                    (path, node.lineno, tok,
+                     module.line_text(node.lineno)))
+
+    def _collect_registry(self, module: Module) -> None:
+        self.registry = {}
+        self.registry_module = module
+        for node in ast.walk(module.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Name)
+                    and node.func.id in METRIC_CTORS):
+                continue
+            if not (node.args and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)):
+                continue
+            self.registry[node.args[0].value] = (
+                module.path, node.lineno, node.func.id)
+
+    # -- phase 2: cross-file judgement ------------------------------------
+
+    def finalize(self) -> Iterator[Finding]:
+        if self.registry is None:
+            return  # no registry in the scanned set: pass is inert
+        readme_refs = self._readme_refs()
+        all_refs = self.refs + readme_refs
+        referenced: set[str] = set()
+        for file, line, tok, text in all_refs:
+            hits = self._resolve(tok)
+            if hits:
+                referenced.update(hits)
+            else:
+                yield Finding(
+                    file=file, line=line, col=0, rule=self.id,
+                    message=(
+                        f"series {tok!r} is not in the obs/metrics.py "
+                        f"registry — the reference is dead (renamed or "
+                        f"never registered)"),
+                    text=text,
+                )
+        for name, (file, line, kind) in sorted(self.registry.items()):
+            if name in referenced:
+                continue
+            mod = self.registry_module
+            yield Finding(
+                file=file, line=line, col=0, rule=self.id,
+                message=(
+                    f"registry series {name!r} ({kind}) is referenced "
+                    f"nowhere in the scanned tree or README — document "
+                    f"it (README metrics table) or drop it"),
+                text=mod.line_text(line) if mod else "",
+            )
+
+    def _resolve(self, tok: str) -> set:
+        """Registry names a reference token matches (empty = dead)."""
+        if tok.endswith("*") or tok.endswith("_"):
+            prefix = tok.rstrip("*")
+            return {n for n in self.registry if n.startswith(prefix)}
+        if tok in self.registry:
+            return {tok}
+        for suf in HIST_SUFFIXES:
+            if tok.endswith(suf):
+                base = tok[: -len(suf)]
+                if self.registry.get(base, ("", 0, ""))[2] == "Histogram":
+                    return {base}
+        return set()
+
+    def _readme_refs(self) -> list[tuple]:
+        """README.md next to the registry (or a scan root): every
+        localai_* token with its line, so doc drift is a finding at the
+        exact README line."""
+        candidates = []
+        if self.registry_module is not None:
+            # <root>/localai_tpu/obs/metrics.py -> <root>/README.md
+            p = Path(self.registry_module.path).resolve()
+            candidates.append(p.parents[2] / "README.md")
+        for root in self._roots:
+            for up in (root, *root.parents[:3]):
+                candidates.append(up / "README.md")
+        out, seen = [], set()
+        for cand in candidates:
+            if cand in seen:
+                continue
+            seen.add(cand)
+            if not cand.is_file():
+                continue
+            for i, line in enumerate(cand.read_text().splitlines(), 1):
+                for tok in METRIC_RE.findall(line):
+                    if tok not in NON_METRICS:
+                        out.append(
+                            (normalize_path(str(cand)), i, tok,
+                             line.strip()))
+            break  # the nearest README is the project README
+        return out

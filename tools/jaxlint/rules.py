@@ -504,10 +504,30 @@ class UnknownJaxConfig:
         return False
 
 
+from tools.jaxlint.lockcheck import (  # noqa: E402
+    BlockingUnderLock,
+    LockGuardedAttr,
+)
+from tools.jaxlint.metriccheck import MetricNameDrift  # noqa: E402
+from tools.jaxlint.shardcheck import (  # noqa: E402
+    HostSyncOnSharded,
+    MeshAxisSpec,
+    ShardMapArity,
+)
+
 ALL_RULES = [
     HostSyncInHotPath(),
     JitInLoop(),
     TracerControlFlow(),
     RngKeyReuse(),
     UnknownJaxConfig(),
+    # lockcheck (lock-discipline dataflow)
+    LockGuardedAttr(),
+    BlockingUnderLock(),
+    # shardcheck (mesh-spec validation)
+    MeshAxisSpec(),
+    ShardMapArity(),
+    HostSyncOnSharded(),
+    # metriccheck (registry <-> reference drift; project-wide)
+    MetricNameDrift(),
 ]

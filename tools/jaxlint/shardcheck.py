@@ -1,0 +1,287 @@
+"""shardcheck: PartitionSpec / shard_map specs vs the declared mesh.
+
+The mesh axis names are a string-typed API: a ``PartitionSpec("modle")``
+typo compiles fine and silently serves an unsharded (or wrongly
+sharded) layout. This pass validates every axis string against the
+axes the project actually declares (``AXES`` in
+``localai_tpu/parallel/mesh.py``, discovered relative to the scanned
+tree so fixtures can carry their own), checks ``shard_map`` spec arity
+against the wrapped function's signature, and flags host
+materialization of values produced by ``shard_map``/sharded
+``device_put`` — each of those gathers the full global array through
+one host.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from pathlib import Path
+from typing import Iterator, Optional
+
+from tools.jaxlint.core import Finding, Module
+
+# fallback when no mesh.py is reachable from the scanned tree
+DEFAULT_AXES = ("data", "seq", "pipe", "expert", "model")
+
+MESH_REL_PATHS = (
+    Path("localai_tpu") / "parallel" / "mesh.py",
+    Path("parallel") / "mesh.py",
+)
+
+HOST_SYNC_FNS = {"np.asarray", "np.array", "numpy.asarray", "numpy.array",
+                 "jax.device_get"}
+
+
+def _axes_from_source(path: Path) -> Optional[tuple]:
+    try:
+        tree = ast.parse(path.read_text())
+    except (OSError, SyntaxError):
+        return None
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Assign)
+                and any(isinstance(t, ast.Name) and t.id == "AXES"
+                        for t in node.targets)
+                and isinstance(node.value, (ast.Tuple, ast.List))):
+            vals = [e.value for e in node.value.elts
+                    if isinstance(e, ast.Constant)
+                    and isinstance(e.value, str)]
+            if vals:
+                return tuple(vals)
+    return None
+
+
+class _AxisRegistry:
+    """Discovers the declared mesh axes for a scanned file by walking up
+    from the file toward a ``parallel/mesh.py``; results cached per
+    directory so a whole-tree lint parses mesh.py once."""
+
+    def __init__(self):
+        self._by_dir: dict[Path, tuple] = {}
+
+    def axes_for(self, module_path: str) -> tuple:
+        d = Path(module_path).resolve().parent
+        probe = d
+        seen = []
+        while True:
+            if probe in self._by_dir:
+                axes = self._by_dir[probe]
+                break
+            seen.append(probe)
+            for rel in MESH_REL_PATHS:
+                cand = probe / rel
+                if cand.is_file():
+                    axes = _axes_from_source(cand) or DEFAULT_AXES
+                    break
+            else:
+                if probe.parent == probe:
+                    axes = DEFAULT_AXES
+                    break
+                probe = probe.parent
+                continue
+            break
+        for p in seen:
+            self._by_dir[p] = axes
+        return axes
+
+
+_REGISTRY = _AxisRegistry()
+
+
+def _is_partition_spec(module: Module, func) -> bool:
+    name = module.dotted(func) or ""
+    return name.endswith("PartitionSpec") or name in ("P", "jax.P")
+
+
+def _is_named_helper(module: Module, func) -> bool:
+    """The repo's ``named(mesh, *spec)`` NamedSharding helper."""
+    name = module.dotted(func) or ""
+    return name == "named" or name.endswith(".named")
+
+
+def _is_shard_map(module: Module, func) -> bool:
+    name = module.dotted(func) or ""
+    return name == "shard_map" or name.endswith(".shard_map")
+
+
+class MeshAxisSpec:
+    """Axis names in PartitionSpec / named() not declared on the mesh."""
+
+    id = "unknown-mesh-axis"
+    doc = ("PartitionSpec/named() axis string not among the mesh axes "
+           "declared in parallel/mesh.py (AXES)")
+
+    def check(self, module: Module) -> Iterator[Finding]:
+        axes = None
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if _is_partition_spec(module, node.func):
+                args = node.args
+            elif _is_named_helper(module, node.func):
+                args = node.args[1:]  # named(mesh, *spec)
+            else:
+                continue
+            for arg in args:
+                for bad in self._bad_axes(module, arg):
+                    if axes is None:
+                        axes = _REGISTRY.axes_for(module.path)
+                    if bad in axes:
+                        continue
+                    yield module.finding(
+                        node, self.id,
+                        f"axis {bad!r} is not a declared mesh axis "
+                        f"{_REGISTRY.axes_for(module.path)}; a typo here "
+                        f"silently mis-shards the array",
+                    )
+
+    def _bad_axes(self, module, arg) -> Iterator[str]:
+        """String constants inside one spec element (axis or axis tuple);
+        every string is a candidate (validity is judged by the caller)."""
+        for n in ast.walk(arg):
+            if isinstance(n, ast.Constant) and isinstance(n.value, str):
+                yield n.value
+
+
+class ShardMapArity:
+    """shard_map in_specs arity vs the wrapped function's signature."""
+
+    id = "shard-map-arity"
+    doc = ("shard_map(f, in_specs=...) spec count does not match the "
+           "wrapped function's positional signature")
+
+    def check(self, module: Module) -> Iterator[Finding]:
+        # index module-level + nested function defs by name for resolution
+        defs: dict[str, ast.AST] = {}
+        for node in ast.walk(module.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                defs.setdefault(node.name, node)
+        for node in ast.walk(module.tree):
+            if not (isinstance(node, ast.Call)
+                    and _is_shard_map(module, node.func)):
+                continue
+            in_specs = None
+            for kw in node.keywords:
+                if kw.arg == "in_specs":
+                    in_specs = kw.value
+            if in_specs is None or not isinstance(
+                    in_specs, (ast.Tuple, ast.List)):
+                continue  # single spec or opaque expression: no arity
+            n_specs = len(in_specs.elts)
+            target = node.args[0] if node.args else None
+            params = self._positional_params(target, defs)
+            if params is None or params == n_specs:
+                continue
+            name = (getattr(target, "id", None)
+                    or ("<lambda>" if isinstance(target, ast.Lambda)
+                        else "<fn>"))
+            yield module.finding(
+                node, self.id,
+                f"shard_map wraps {name} taking {params} positional "
+                f"argument(s) but in_specs has {n_specs} spec(s); the "
+                f"mismatch raises only at trace time",
+            )
+
+    def _positional_params(self, target, defs) -> Optional[int]:
+        fn = None
+        if isinstance(target, ast.Lambda):
+            fn = target
+        elif isinstance(target, ast.Name):
+            fn = defs.get(target.id)
+        if fn is None:
+            return None
+        args = fn.args
+        if args.vararg is not None:
+            return None  # *args absorbs any arity
+        return len(args.posonlyargs) + len(args.args)
+
+
+class HostSyncOnSharded:
+    """Host materialization of a sharded value.
+
+    ``.item()`` / ``np.asarray`` / ``float()`` on a value produced by
+    ``shard_map`` (or placed with a NamedSharding) gathers every shard
+    through one host — on a real mesh that is an all-device sync plus a
+    full-array device→host copy on the hot path.
+    """
+
+    id = "host-sync-on-sharded"
+    doc = (".item()/np.asarray/float() on a value produced by shard_map "
+           "or sharded device_put — gathers all shards through the host")
+
+    SHARDED_SRC = re.compile(
+        r"\b(shard_map\s*\(|NamedSharding\s*\(|device_put\s*\(.*"
+        r"(named\s*\(|NamedSharding\s*\(|P\s*\())")
+
+    def check(self, module: Module) -> Iterator[Finding]:
+        if Path(module.path).name.startswith(("test_", "conftest")):
+            return  # tests gather sharded outputs on purpose (parity)
+        scopes = [module.tree] + [
+            n for n in ast.walk(module.tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        ]
+        for scope in scopes:
+            yield from self._check_scope(module, scope)
+
+    @staticmethod
+    def _scope_nodes(scope):
+        """Walk ``scope`` without descending into nested function defs
+        (each scope is analyzed exactly once)."""
+        own = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+        stack = list(ast.iter_child_nodes(scope))
+        while stack:
+            node = stack.pop()
+            if isinstance(node, own):
+                continue
+            yield node
+            stack.extend(ast.iter_child_nodes(node))
+
+    def _check_scope(self, module, scope) -> Iterator[Finding]:
+        sharded: set[str] = set()
+        for node in self._scope_nodes(scope):
+            if isinstance(node, ast.Assign):
+                try:
+                    src = ast.unparse(node.value)
+                except Exception:
+                    continue
+                if self.SHARDED_SRC.search(src):
+                    for t in node.targets:
+                        elts = (t.elts if isinstance(t, (ast.Tuple,
+                                                         ast.List))
+                                else [t])
+                        sharded.update(e.id for e in elts
+                                       if isinstance(e, ast.Name))
+        if not sharded:
+            return
+        for node in self._scope_nodes(scope):
+            if not isinstance(node, ast.Call):
+                continue
+            hit = self._sync_arg(module, node)
+            if hit is None:
+                continue
+            what, arg = hit
+            root = arg
+            while isinstance(root, (ast.Subscript, ast.Attribute)):
+                root = root.value
+            if isinstance(root, ast.Name) and root.id in sharded:
+                yield module.finding(
+                    node, self.id,
+                    f"{what} on {root.id!r}, which holds a sharded value "
+                    f"(assigned from shard_map/NamedSharding in this "
+                    f"scope); gather once off the hot path or keep it "
+                    f"device-side",
+                )
+
+    def _sync_arg(self, module, node):
+        func = node.func
+        if (isinstance(func, ast.Attribute) and func.attr == "item"
+                and not node.args):
+            return "`.item()`", func.value
+        name = module.dotted(func)
+        if name in HOST_SYNC_FNS and node.args:
+            return f"`{name}(...)`", node.args[0]
+        if (isinstance(func, ast.Name) and func.id in ("int", "float")
+                and len(node.args) == 1
+                and not isinstance(node.args[0], ast.Constant)):
+            return f"`{func.id}()`", node.args[0]
+        return None

@@ -1,0 +1,450 @@
+"""racecheck: an opt-in instrumented-lock harness for lock-order races.
+
+The static lockcheck pass (tools/jaxlint) sees one class at a time; it
+cannot see that the fleet dispatch thread takes the router lock inside
+the pool lock while the monitor thread takes them the other way round.
+This harness sees exactly that: :class:`LockMonitor` replaces
+``threading.Lock``/``RLock`` so every lock created afterwards records,
+per thread, the stack of locks currently held. Acquiring B while
+holding A adds the directed edge A→B to the process-wide lock-order
+graph; a cycle in that graph is a deadlock waiting for the right
+interleaving — the classic ABBA inversion is its 2-node case.
+
+Lock identity is the CONSTRUCTION SITE (file:line), not the instance:
+`obs/metrics.py:52` names every Histogram's lock at once, so an
+ordering violation between two instances of the same class is caught
+even when each individual pair of instances deadlocks only once a year.
+Same-site edges (instance i1 of a class locked inside instance i2 of
+the same class) are tracked at instance granularity and flagged only
+when BOTH orders of one instance pair are observed — nesting two
+sibling locks in a consistent order is legal.
+
+CI runs this over the telemetry smoke's full fleet + batch + shed
+lifecycle (``python -m tools.telemetry_smoke --racecheck``) and fails
+on any inversion. For a demonstration of what a report looks like:
+
+    python tools/racecheck.py --demo
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import traceback
+from typing import Iterator, Optional
+
+# the genuine primitives, captured before any monitor patches them —
+# the monitor's own bookkeeping must never recurse through a wrapper
+_REAL_LOCK = threading.Lock
+_REAL_RLOCK = threading.RLock
+
+
+def _creation_site() -> tuple[str, int]:
+    """(file, line) of the frame that called threading.Lock() — skipping
+    threading.py internals (Condition/Event/Queue built on Lock should
+    blame THEIR caller, the object that owns them)."""
+    f = sys._getframe(2)
+    while f is not None:
+        fn = f.f_code.co_filename
+        if not fn.endswith(("threading.py", "queue.py")):
+            return (fn, f.f_lineno)
+        f = f.f_back
+    return ("<unknown>", 0)
+
+
+class _TracedLock:
+    """Wraps one real lock; reports acquisition ordering to the monitor."""
+
+    _recursive = False
+
+    def __init__(self, monitor: "LockMonitor", site: tuple[str, int]):
+        self._lock = (_REAL_RLOCK() if self._recursive else _REAL_LOCK())
+        self._mon = monitor
+        self.site = site
+        # process-unique, never recycled — same-site instance pairs key on
+        # this, not id(): CPython reuses ids after GC, and a recycled id
+        # would fabricate a phantom both-orders inversion (or mask a real
+        # one) between instances that never coexisted
+        self.serial = monitor._next_serial()
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        if blocking:
+            # order is recorded BEFORE blocking: the edge exists the
+            # moment this thread commits to waiting while holding others
+            self._mon._note_wait(self)
+        ok = self._lock.acquire(blocking, timeout)
+        if ok:
+            self._mon._note_acquired(self)
+        return ok
+
+    def release(self) -> None:
+        self._mon._note_released(self)
+        self._lock.release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def locked(self) -> bool:
+        return self._lock.locked()
+
+    def __repr__(self) -> str:
+        return f"<traced {type(self).__name__} @ {self.site[0]}:{self.site[1]}>"
+
+
+class _TracedRLock(_TracedLock):
+    _recursive = True
+
+    def locked(self) -> bool:  # RLock has no .locked() pre-3.12
+        fn = getattr(self._lock, "locked", None)
+        return fn() if fn is not None else False
+
+    # threading.Condition's duck-typed RLock protocol. Without these it
+    # falls back to an acquire(False) ownership probe, which an RLock's
+    # reentrancy answers WRONG ("not owned" while owned) — Condition()
+    # (default RLock) must keep working under instrumentation.
+    def _is_owned(self) -> bool:
+        return self._lock._is_owned()
+
+    def _release_save(self):
+        # full release of every recursion level: drop all held entries,
+        # remembering how many so _acquire_restore can re-add them all —
+        # restoring just one would make the monitor forget the lock after
+        # the first post-wait release() while the thread still owns it
+        held = self._mon._held()
+        count = 0
+        for i in range(len(held) - 1, -1, -1):
+            if held[i] is self:
+                del held[i]
+                count += 1
+        return (count, self._lock._release_save())
+
+    def _acquire_restore(self, state) -> None:
+        count, inner = state
+        self._lock._acquire_restore(inner)
+        held = self._mon._held()
+        for _ in range(max(1, count)):
+            held.append(self)
+
+
+class Inversion:
+    """One lock-order cycle, with a sample acquisition stack per edge."""
+
+    def __init__(self, cycle: list[str], stacks: dict[tuple, list[str]]):
+        self.cycle = cycle          # site labels, cycle[0] == cycle[-1]
+        self.stacks = stacks        # (a_label, b_label) -> stack lines
+
+    def render(self) -> str:
+        out = [" -> ".join(self.cycle)]
+        for (a, b), stack in self.stacks.items():
+            out.append(f"  edge {a} -> {b} first acquired at:")
+            out.extend(f"    {line}" for line in stack)
+        return "\n".join(out)
+
+
+class LockMonitor:
+    """Process-wide lock-order graph built from traced acquisitions."""
+
+    def __init__(self, stack_limit: int = 14):
+        self.stack_limit = stack_limit
+        self._meta = _REAL_LOCK()
+        self._tls = threading.local()
+        # site -> stable label
+        self._sites: dict[tuple[str, int], str] = {}
+        # (site_a, site_b) [a != b] -> sample stack (first observation)
+        self._edges: dict[tuple, list[str]] = {}
+        self._edge_count: dict[tuple, int] = {}
+        # same-site nesting: site -> {(serial_a, serial_b): sample stack}
+        self._same_site: dict[tuple, dict[tuple, list[str]]] = {}
+        self._installed = False
+        self.locks_created = 0
+        self._serial = 0
+
+    def _next_serial(self) -> int:
+        with self._meta:
+            self._serial += 1
+            return self._serial
+
+    # -- patching ----------------------------------------------------------
+
+    def install(self) -> "LockMonitor":
+        """Patch ``threading.Lock``/``RLock``; only locks created AFTER
+        this call are traced (install before importing the system under
+        test)."""
+        if self._installed:
+            return self
+        mon = self
+
+        def make_lock():
+            site = _creation_site()
+            mon._register(site)
+            return _TracedLock(mon, site)
+
+        def make_rlock():
+            site = _creation_site()
+            mon._register(site)
+            return _TracedRLock(mon, site)
+
+        threading.Lock = make_lock          # type: ignore[assignment]
+        threading.RLock = make_rlock        # type: ignore[assignment]
+        self._installed = True
+        return self
+
+    def uninstall(self) -> None:
+        """Restore the real primitives. Already-created traced locks keep
+        working (and keep reporting) — only new creations stop."""
+        if self._installed:
+            threading.Lock = _REAL_LOCK     # type: ignore[assignment]
+            threading.RLock = _REAL_RLOCK   # type: ignore[assignment]
+            self._installed = False
+
+    def __enter__(self) -> "LockMonitor":
+        return self.install()
+
+    def __exit__(self, *exc) -> None:
+        self.uninstall()
+
+    # -- tracing callbacks (hot; keep allocation-free when possible) -------
+
+    def _register(self, site) -> None:
+        with self._meta:
+            self.locks_created += 1
+            if site not in self._sites:
+                short = site[0]
+                for marker in ("/localai_tpu/", "/tools/", "/tests/"):
+                    i = short.rfind(marker)
+                    if i >= 0:
+                        short = short[i + 1:]
+                        break
+                else:
+                    short = short.rsplit("/", 1)[-1]
+                self._sites[site] = f"{short}:{site[1]}"
+
+    def _held(self) -> list:
+        held = getattr(self._tls, "held", None)
+        if held is None:
+            held = self._tls.held = []
+        return held
+
+    def _note_wait(self, lock: _TracedLock) -> None:
+        held = self._held()
+        if not held:
+            return
+        if any(h is lock for h in held):
+            return  # reentrant RLock acquire cannot block
+        stack: Optional[list[str]] = None
+        for h in held:
+            if h.site == lock.site:
+                key = (h.serial, lock.serial)
+                bucket = self._same_site.setdefault(lock.site, {})
+                if key not in bucket:
+                    if stack is None:
+                        stack = self._stack()
+                    with self._meta:
+                        bucket.setdefault(key, stack)
+            else:
+                key = (h.site, lock.site)
+                if key not in self._edges:
+                    if stack is None:
+                        stack = self._stack()
+                    with self._meta:
+                        self._edges.setdefault(key, stack)
+                with self._meta:
+                    self._edge_count[key] = self._edge_count.get(key, 0) + 1
+
+    def _note_acquired(self, lock: _TracedLock) -> None:
+        self._held().append(lock)
+
+    def _note_released(self, lock: _TracedLock) -> None:
+        held = self._held()
+        for i in range(len(held) - 1, -1, -1):
+            if held[i] is lock:
+                del held[i]
+                return
+
+    def _stack(self) -> list[str]:
+        frames = traceback.extract_stack(sys._getframe(3),
+                                         limit=self.stack_limit)
+        return [f"{fr.filename.rsplit('/', 1)[-1]}:{fr.lineno} "
+                f"in {fr.name}" for fr in frames]
+
+    # -- analysis ----------------------------------------------------------
+
+    def edges(self) -> dict[tuple, int]:
+        """(site_label_a, site_label_b) -> observation count."""
+        with self._meta:
+            return {
+                (self._sites[a], self._sites[b]): n
+                for (a, b), n in self._edge_count.items()
+            }
+
+    def inversions(self) -> list[Inversion]:
+        """Every elementary lock-order cycle observed, plus same-site
+        instance pairs seen in both orders."""
+        with self._meta:
+            adj: dict[tuple, set] = {}
+            for a, b in self._edges:
+                adj.setdefault(a, set()).add(b)
+            edges = dict(self._edges)
+            same = {s: dict(pairs) for s, pairs in self._same_site.items()}
+            labels = dict(self._sites)
+        out: list[Inversion] = []
+        for cycle in _cycles(adj):
+            stacks = {}
+            for a, b in zip(cycle, cycle[1:]):
+                stacks[(labels[a], labels[b])] = edges.get((a, b), [])
+            out.append(Inversion([labels[s] for s in cycle], stacks))
+        for site, pairs in same.items():
+            seen = set(pairs)
+            for (ia, ib), stack in pairs.items():
+                if (ib, ia) in seen and ia < ib:  # report each pair once
+                    lbl = labels[site]
+                    out.append(Inversion(
+                        [f"{lbl}<instance A>", f"{lbl}<instance B>",
+                         f"{lbl}<instance A>"],
+                        {(f"{lbl}<A>", f"{lbl}<B>"): stack,
+                         (f"{lbl}<B>", f"{lbl}<A>"): pairs[(ib, ia)]},
+                    ))
+        return out
+
+    def report(self) -> str:
+        inv = self.inversions()
+        with self._meta:
+            n_sites = len(self._sites)
+            n_edges = len(self._edge_count)
+        head = (f"racecheck: {self.locks_created} locks from {n_sites} "
+                f"sites, {n_edges} ordered edges, "
+                f"{len(inv)} inversion(s)")
+        if not inv:
+            return head
+        return "\n".join([head, ""] + [i.render() for i in inv])
+
+
+def _cycles(adj: dict[tuple, set]) -> Iterator[list]:
+    """Elementary cycles via DFS from each SCC (bounded and simple: the
+    lock graphs here are tiny). Each cycle is reported once, anchored at
+    its smallest node."""
+    sccs = _tarjan(adj)
+    for scc in sccs:
+        if len(scc) < 2:
+            continue
+        scc_set = set(scc)
+        anchor = min(scc)
+        # one representative cycle through the anchor
+        path = [anchor]
+        seen_cycle = None
+
+        def dfs(node, visited):
+            nonlocal seen_cycle
+            if seen_cycle is not None:
+                return
+            for nxt in sorted(adj.get(node, ())):
+                if nxt == anchor and len(path) > 1:
+                    seen_cycle = path + [anchor]
+                    return
+                if nxt in scc_set and nxt not in visited:
+                    path.append(nxt)
+                    visited.add(nxt)
+                    dfs(nxt, visited)
+                    if seen_cycle is not None:
+                        return
+                    visited.discard(nxt)
+                    path.pop()
+
+        dfs(anchor, {anchor})
+        if seen_cycle is not None:
+            yield seen_cycle
+
+
+def _tarjan(adj: dict) -> list[list]:
+    """Iterative Tarjan SCC (no recursion limit surprises)."""
+    index: dict = {}
+    low: dict = {}
+    on_stack: set = set()
+    stack: list = []
+    sccs: list[list] = []
+    counter = [0]
+    nodes = set(adj)
+    for vs in adj.values():
+        nodes.update(vs)
+
+    for root in nodes:
+        if root in index:
+            continue
+        work = [(root, iter(sorted(adj.get(root, ()))))]
+        index[root] = low[root] = counter[0]
+        counter[0] += 1
+        stack.append(root)
+        on_stack.add(root)
+        while work:
+            node, it = work[-1]
+            advanced = False
+            for nxt in it:
+                if nxt not in index:
+                    index[nxt] = low[nxt] = counter[0]
+                    counter[0] += 1
+                    stack.append(nxt)
+                    on_stack.add(nxt)
+                    work.append((nxt, iter(sorted(adj.get(nxt, ())))))
+                    advanced = True
+                    break
+                elif nxt in on_stack:
+                    low[node] = min(low[node], index[nxt])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[node])
+            if low[node] == index[node]:
+                scc = []
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    scc.append(w)
+                    if w == node:
+                        break
+                sccs.append(scc)
+    return sccs
+
+
+# -- CLI demo ---------------------------------------------------------------
+
+def _demo() -> int:
+    """Provoke a textbook ABBA inversion and print the report (this is
+    what a failing CI racecheck step looks like)."""
+    mon = LockMonitor().install()
+    try:
+        lock_a = threading.Lock()
+        lock_b = threading.Lock()
+    finally:
+        mon.uninstall()
+
+    # the ORDER is the race: the graph records A→B then B→A even though
+    # the threads never actually interleave into the deadlock
+    def t1():
+        with lock_a:
+            with lock_b:
+                pass
+
+    def t2():
+        with lock_b:
+            with lock_a:
+                pass
+
+    for fn in (t1, t2):
+        th = threading.Thread(target=fn)
+        th.start()
+        th.join()
+    print(mon.report())
+    return 1 if mon.inversions() else 0
+
+
+if __name__ == "__main__":
+    if "--demo" in sys.argv:
+        sys.exit(_demo())
+    print(__doc__)
+    sys.exit(0)

@@ -34,9 +34,18 @@ generations through the continuous-batching scheduler, then:
      the flight-ring snapshot (``--flight-out``) so every CI run carries
      the engine timeline it measured.
 
+  7. under ``--racecheck``, runs the WHOLE lifecycle above with
+     ``tools.racecheck``'s instrumented locks installed (every
+     ``threading.Lock``/``RLock`` the serving stack creates records its
+     acquisition ordering) and fails if the observed lock-order graph
+     contains a cycle — an ABBA inversion across the fleet pool/router,
+     batch executor, scheduler, and obs planes is a deadlock waiting
+     for load, exactly what this smoke's mixed traffic provokes.
+
 Usage:  python -m tools.telemetry_smoke [--out telemetry_summary.json]
                                         [--flight-out flight_snapshot.json]
                                         [--batch-out batch_result.jsonl]
+                                        [--racecheck]
 """
 
 from __future__ import annotations
@@ -330,7 +339,20 @@ def main(argv=None) -> int:
     # two dispatch-rounds past the compile-bearing first one, so the
     # flight ring has post-compile samples and step_ms percentiles exist
     parser.add_argument("--max-tokens", type=int, default=40)
+    parser.add_argument(
+        "--racecheck", action="store_true",
+        help="run the lifecycle under tools.racecheck instrumented locks "
+             "and fail on any observed lock-order inversion")
     args = parser.parse_args(argv)
+
+    monitor = None
+    if args.racecheck:
+        # install BEFORE the localai imports below: module import is when
+        # the process-wide locks (trace store, registry, watchdog) are
+        # constructed, and only post-install locks are traced
+        from tools.racecheck import LockMonitor
+
+        monitor = LockMonitor().install()
 
     from localai_tpu.engine.runner import ModelRunner
     from localai_tpu.engine.scheduler import GenRequest, Scheduler
@@ -393,6 +415,21 @@ def main(argv=None) -> int:
     finally:
         sched.shutdown()
 
+    racecheck_summary = None
+    if monitor is not None:
+        monitor.uninstall()
+        inversions = monitor.inversions()
+        print(monitor.report())
+        if inversions:
+            print("FAIL: lock-order inversions observed across the "
+                  "fleet+batch+shed lifecycle (see report above)")
+            return 1
+        racecheck_summary = {
+            "locks_created": monitor.locks_created,
+            "ordered_edges": len(monitor.edges()),
+            "inversions": 0,
+        }
+
     exposition = REGISTRY.render()
     missing = [s for s in (REQUIRED_SERIES + REQUIRED_FAMILIES
                            + REQUIRED_INTROSPECTION + REQUIRED_SLO
@@ -439,6 +476,8 @@ def main(argv=None) -> int:
             k: v for k, v in engine_metrics.items() if k != "active_slots"
         },
     }
+    if racecheck_summary is not None:
+        summary["racecheck"] = racecheck_summary
     with open(args.out, "w") as f:
         json.dump(summary, f, indent=2, sort_keys=True)
     with open(args.flight_out, "w") as f:
