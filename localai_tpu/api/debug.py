@@ -27,6 +27,17 @@ the given timestamp (pollers pass the ``ts`` of the last record they
 saw); ``?limit=N`` bounds the newest records returned. The "what was the
 engine doing for the last N seconds" view — reading it never touches a
 device.
+
+``GET /debug/kv`` — per-model paged block-pool audit: allocator stats,
+live tables, and the result of ``BlockAllocator.check_invariants()``
+(block conservation + refcount sanity). Any violation is a leak.
+
+``/debug/faults`` — the fault-injection registry (localai_tpu.faults):
+``GET`` lists armed specs with hit/fire counts plus the self-healing
+supervisor state per model; ``POST {"site", "mode", "after", "times",
+"match", "delay_s"}`` arms one; ``DELETE`` (``?site=`` to scope) clears.
+Chaos tooling only — nothing is armed (and the hot path pays one boolean
+read) unless an operator or ``LOCALAI_FAULT_*`` arms it.
 """
 
 from __future__ import annotations
@@ -36,6 +47,7 @@ import time
 
 from aiohttp import web
 
+from localai_tpu import faults
 from localai_tpu.obs import compile as obs_compile
 from localai_tpu.obs import device as obs_device
 from localai_tpu.obs import watchdog as obs_watchdog
@@ -153,10 +165,78 @@ async def flight(request: web.Request) -> web.Response:
     })
 
 
+async def kv(request: web.Request) -> web.Response:
+    state = _state(request)
+    models = {}
+    for name, sm in state.manager.loaded_snapshot().items():
+        alloc = getattr(getattr(sm, "runner", None), "allocator", None)
+        if alloc is None:
+            continue  # contiguous / worker-backed / non-LLM engines
+        st = alloc.stats()
+        sched = getattr(sm, "scheduler", None)
+        models[name] = {
+            "block_tokens": alloc.block_tokens,
+            "blocks": {
+                "total": st.total, "free": st.free, "used": st.used,
+                "cached": st.cached, "watermark": st.high_watermark,
+            },
+            "tables": {str(s): n
+                       for s, n in alloc.tables_snapshot().items()},
+            "shared_tokens_total": alloc.shared_tokens_total,
+            "evictions_total": alloc.evictions_total,
+            "invariant_violations": alloc.check_invariants(),
+            "violations_seen": getattr(
+                sched, "kv_invariant_violations", 0),
+        }
+    return web.json_response({"models": models})
+
+
+async def faults_get(request: web.Request) -> web.Response:
+    state = _state(request)
+    supervisors = {}
+    for name, sm in state.manager.loaded_snapshot().items():
+        sup = getattr(getattr(sm, "scheduler", None), "supervisor", None)
+        if sup is not None:
+            supervisors[name] = sup.status()
+    return web.json_response({
+        "active": faults.active(),
+        "sites": faults.SITES,
+        "armed": faults.snapshot(),
+        "supervisors": supervisors,
+    })
+
+
+async def faults_post(request: web.Request) -> web.Response:
+    try:
+        body = await request.json()
+    except Exception:  # noqa: BLE001 — malformed body is a client error
+        raise web.HTTPBadRequest(text="body must be a JSON object")
+    if not isinstance(body, dict) or not body.get("site"):
+        raise web.HTTPBadRequest(text='need {"site": ..., ...}')
+    allowed = {"site", "mode", "after", "times", "match", "delay_s"}
+    unknown = set(body) - allowed
+    if unknown:
+        raise web.HTTPBadRequest(text=f"unknown fields {sorted(unknown)}")
+    try:
+        spec = faults.arm(faults.FaultSpec(**body))
+    except (TypeError, ValueError) as e:
+        raise web.HTTPBadRequest(text=str(e))
+    return web.json_response({"armed": spec.to_dict()})
+
+
+async def faults_delete(request: web.Request) -> web.Response:
+    site = request.query.get("site") or None
+    return web.json_response({"cleared": faults.clear(site)})
+
+
 def routes() -> list[web.RouteDef]:
     return [
         web.get("/debug/devices", devices),
         web.get("/debug/programs", programs),
         web.get("/debug/stacks", stacks),
         web.get("/debug/flight", flight),
+        web.get("/debug/kv", kv),
+        web.get("/debug/faults", faults_get),
+        web.post("/debug/faults", faults_post),
+        web.delete("/debug/faults", faults_delete),
     ]

@@ -40,7 +40,7 @@ TRACE_KEY = "trace_id"
 # (a 15s Prometheus scrape would otherwise dominate the http ring)
 TRACE_SKIP = {"/metrics", "/healthz", "/readyz", "/v1/traces", "/v1/slo",
               "/debug/devices", "/debug/programs", "/debug/stacks",
-              "/debug/flight"}
+              "/debug/flight", "/debug/kv", "/debug/faults"}
 TRACE_SKIP_PREFIXES = ("/debug/timeline/",)
 
 # paths reachable without an API key (parity: auth exemption filter,
@@ -78,6 +78,12 @@ class AppState:
         self.config = app_config or AppConfig()
         self.loader = loader or ConfigLoader(self.config.model_path)
         self.manager = manager or ModelManager(self.config, self.loader)
+        # deterministic fault injection (localai_tpu.faults): arm any
+        # LOCALAI_FAULT_* specs once at boot — the registry is never
+        # consulted from a request path while nothing is armed
+        from localai_tpu import faults
+
+        faults.install_from_env()
         # SLO observatory targets from app config (env-overridable via
         # LOCALAI_SLO_* through AppConfig.from_env; all-zero = shedding
         # disabled). Wired here so every server entry path — serve(),

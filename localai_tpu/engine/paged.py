@@ -50,6 +50,8 @@ from typing import Optional
 
 import numpy as np
 
+from localai_tpu.faults import registry as _faults
+
 
 def block_tokens_default() -> int:
     """Tokens per KV block (``LOCALAI_KV_BLOCK_TOKENS``, default 64)."""
@@ -195,6 +197,9 @@ class BlockAllocator:
         shared-token count, or None when the pool cannot cover the
         reservation (the caller queues the request). ``seq`` must not
         already hold a table."""
+        if _faults.ACTIVE and _faults.apply("paged.allocate",
+                                            key=str(seq)) is not None:
+            return None  # injected exhaustion: report the pool full
         nb = self.blocks_for(tokens)
         shared = self.match_prefix(prompt) if prompt else []
         shared = shared[: max(0, nb - 1)]  # at least one writable block
@@ -276,6 +281,13 @@ class BlockAllocator:
         row[: len(t)] = t[: self.max_blocks_per_seq]
         return row
 
+    def tables_snapshot(self) -> dict[int, int]:
+        """{seq: table length} under the lock — the /debug/kv view (the
+        engine thread inserts/pops tables concurrently; iterating the
+        live dict from an API thread would race the mutation)."""
+        with self._lock:
+            return {seq: len(t) for seq, t in self.tables.items()}
+
     def stats(self) -> BlockStats:
         with self._lock:
             free = len(self._free)
@@ -288,3 +300,90 @@ class BlockAllocator:
                 used=total - free - cached,
                 high_watermark=self._watermark,
             )
+
+    def check_invariants(self) -> list[str]:
+        """Block-conservation audit from refcount ground truth. Returns
+        violation strings (empty = healthy). Invariants:
+
+          * every allocatable block is exactly one of {free, referenced};
+            free blocks carry refcount 0, referenced ones ≥ 1 — so
+            ``free + used + cached == total`` by construction;
+          * the free list holds no duplicates and never the trash block;
+          * every table block id is a live (ref ≥ 1) non-trash block, and
+            a table's shared leading blocks are also pool-referenced
+            (ref ≥ 2);
+          * every prefix-pool chain entry maps to a live block and the
+            key↔block indices agree.
+
+        O(blocks + table rows) under the lock — called from scheduler
+        drains only behind ``LOCALAI_KV_CHECK`` and from every chaos
+        scenario, surfaced at ``/debug/kv``."""
+        problems: list[str] = []
+        with self._lock:
+            free_set = set(self._free)
+            if len(free_set) != len(self._free):
+                problems.append("free list holds duplicate block ids")
+            if 0 in free_set:
+                problems.append("trash block 0 is on the free list")
+            if self._ref[0] < 1:
+                problems.append("trash block 0 lost its standing reference")
+            for bid in range(1, self.num_blocks):
+                ref = int(self._ref[bid])
+                if bid in free_set and ref != 0:
+                    problems.append(
+                        f"block {bid} is free but has refcount {ref}")
+                if bid not in free_set and ref < 1:
+                    problems.append(
+                        f"block {bid} leaked: refcount {ref}, not free")
+            for seq, table in self.tables.items():
+                shared = self.shared_blocks.get(seq, 0)
+                for i, bid in enumerate(table):
+                    if bid == 0:
+                        problems.append(f"seq {seq} table maps trash block")
+                        continue
+                    if bid in free_set:
+                        problems.append(
+                            f"seq {seq} table block {bid} is on the "
+                            "free list")
+                    want = 2 if i < shared else 1
+                    if int(self._ref[bid]) < want:
+                        problems.append(
+                            f"seq {seq} {'shared ' if i < shared else ''}"
+                            f"block {bid} refcount {int(self._ref[bid])} "
+                            f"< {want}")
+            for key, bid in self._prefix.items():
+                if int(self._ref[bid]) < 1:
+                    problems.append(
+                        f"cached chain block {bid} refcount "
+                        f"{int(self._ref[bid])} < 1")
+                if self._block_key.get(bid) != key:
+                    problems.append(
+                        f"prefix pool and block-key index disagree on "
+                        f"block {bid}")
+            if len(self._block_key) != len(self._prefix):
+                problems.append("block-key index size != prefix pool size")
+            # conservation, derived INDEPENDENTLY of stats() (whose
+            # ``used`` is total - free - cached by construction): every
+            # live block must be reachable from a table or the prefix
+            # pool, and the reachable census must add up block by block
+            table_ids = {bid for t in self.tables.values() for bid in t}
+            pool_ids = set(self._prefix.values())
+            live = {bid for bid in range(1, self.num_blocks)
+                    if int(self._ref[bid]) > 0 and bid not in free_set}
+            for bid in sorted(live - table_ids - pool_ids):
+                problems.append(
+                    f"block {bid} leaked: refcount {int(self._ref[bid])} "
+                    "but referenced by no table or pool entry")
+            free = len(self._free)
+            cached = self._reclaimable()
+            total = self.num_blocks - 1
+            used = total - free - cached
+            used_census = len(
+                (table_ids | pool_ids)
+                - {bid for bid in pool_ids if int(self._ref[bid]) == 1})
+            if used_census != used:
+                problems.append(
+                    f"conservation broken: {used_census} blocks live in "
+                    f"tables/pool vs used {used} "
+                    f"(free {free}, cached {cached}, total {total})")
+        return problems

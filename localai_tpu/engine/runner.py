@@ -40,6 +40,13 @@ from localai_tpu.utils.jaxcompat import shard_map
 
 log = logging.getLogger(__name__)
 
+# sampled-row sentinel for the per-row NaN/inf logits guard: a slot whose
+# (biased) logits row went non-finite reports this instead of a token id,
+# riding the [S] token transfer the host already pays for — zero extra
+# device syncs. Distinct from the speculative SKIP sentinel (-1); the
+# scheduler fails the affected request and quarantines the slot.
+NAN_TOKEN = -2
+
 
 def _prompt_counts_row(vocab_size: int, prompt) -> np.ndarray:
     """[V] i32 bincount of the FULL prompt for resume-style prefills (the
@@ -245,19 +252,19 @@ class ModelRunner:
             )
             if paged_why:
                 log.info("paged attention: %s; using gather+XLA", paged_why)
-            self.block_tables = jnp.zeros(
-                (num_slots, self.max_blocks), jnp.int32)
             # one device-resident zeros row reused by every non-final
             # chunk dispatch (whose sample=False program ignores counts —
             # no per-chunk [V] host alloc + H2D copy)
             self._zero_counts = jnp.zeros(cfg.vocab_size, jnp.int32)
-            # disk prompt-cache rows loaded into a slot's fresh blocks
-            # (the only slot-resident reuse that survives release)
-            self._loaded_rows: dict[int, int] = {}
         else:
             self.allocator = None
-        kv_sharding = None
-        paged_sharding = None
+        # shardings are kept so reinit() (self-healing engine rebuild)
+        # can rebuild the device state into the exact same layout
+        self._kv_sharding = None
+        self._paged_sharding = None
+        self._table_sharding = None
+        self._seed = seed
+        self.kv_dtype = kv_dtype
         if mesh is not None:
             from jax.sharding import NamedSharding
 
@@ -275,57 +282,20 @@ class ModelRunner:
                 # pool kv-heads on 'model' (paged_kv_spec); the [S, MB]
                 # table mirror carries the 'data' sharding instead — the
                 # pool has no slot axis to put it on
-                paged_sharding = NamedSharding(
+                self._paged_sharding = NamedSharding(
                     mesh, shd.paged_kv_spec(cfg, mesh))
-                self.block_tables = jax.device_put(
-                    self.block_tables,
-                    NamedSharding(mesh, shd.block_table_spec()))
+                self._table_sharding = NamedSharding(
+                    mesh, shd.block_table_spec())
             else:
-                kv_sharding = NamedSharding(mesh, shd.kv_spec(cfg, mesh))
-        if self.paged:
-            self.kv = kvc.init_paged_cache(
-                cfg, self.allocator.num_blocks, self.block_tokens, kv_dtype,
-                sharding=paged_sharding,
-            )
-        else:
-            self.kv = kvc.init_cache(
-                cfg, num_slots, self.max_ctx, kv_dtype, sharding=kv_sharding
-            )
-        self.state = DecodeState.init(num_slots, cfg.vocab_size, seed)
+                self._kv_sharding = NamedSharding(
+                    mesh, shd.kv_spec(cfg, mesh))
+        self._init_device_state()
         if mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
 
-            from localai_tpu.parallel import sharding as shd
-
-            specs = shd.state_specs(mesh)
-
-            def place(name: str, leaf):
-                spec = shd._sanitize(specs[name], leaf.shape, mesh)
-                return jax.device_put(leaf, NamedSharding(mesh, spec))
-
-            self.state = DecodeState(
-                tokens=place("tokens", self.state.tokens),
-                positions=place("positions", self.state.positions),
-                active=place("active", self.state.active),
-                keys=place("keys", self.state.keys),
-                counts=place("counts", self.state.counts),
-                bias=place("bias", self.state.bias),
-                params=jax.tree.map(
-                    lambda a: jax.device_put(
-                        a, NamedSharding(mesh, P("data"))
-                    ),
-                    self.state.params,
-                ),
-            )
             self.rope = jax.device_put(
                 self.rope, NamedSharding(mesh, P())
             )
-        self._free_slots = list(range(num_slots))
-        # host mirror of which slots are serving: admit()/release() are the
-        # only transitions, so liveness queries never touch the device
-        self._active_slots: set[int] = set()
-
-        self.kv_dtype = kv_dtype
         # every jit entry point is wrapped by obs.compile.watch: the first
         # dispatch of each program shape compiles synchronously, so its
         # wall time lands in the localai_xla_compile_* series (the
@@ -420,6 +390,85 @@ class ModelRunner:
         self.last_prefix_reused = 0       # tokens reused by the last admit
         self.total_prefix_reused = 0
 
+    # -- device-state lifecycle (construction + self-healing rebuild) ----
+
+    def _init_device_state(self) -> None:
+        """(Re)build everything device-resident and per-slot: KV pool,
+        decode state, block tables, allocator bookkeeping, free-slot
+        list. Called once at construction and again by :meth:`reinit`
+        after a suspected device wedge — params, compiled programs, and
+        shardings are untouched, so no retrace/recompile happens."""
+        cfg = self.cfg
+        if self.paged:
+            self.allocator = pgd.BlockAllocator(
+                self.allocator.num_blocks, self.block_tokens,
+                self.max_blocks)
+            # disk prompt-cache rows loaded into a slot's fresh blocks
+            # (the only slot-resident reuse that survives release)
+            self._loaded_rows: dict[int, int] = {}
+            tables = jnp.zeros((self.num_slots, self.max_blocks), jnp.int32)
+            if self._table_sharding is not None:
+                tables = jax.device_put(tables, self._table_sharding)
+            self.block_tables = tables
+            self.kv = kvc.init_paged_cache(
+                cfg, self.allocator.num_blocks, self.block_tokens,
+                self.kv_dtype, sharding=self._paged_sharding,
+            )
+        else:
+            self.kv = kvc.init_cache(
+                cfg, self.num_slots, self.max_ctx, self.kv_dtype,
+                sharding=self._kv_sharding,
+            )
+        state = DecodeState.init(self.num_slots, cfg.vocab_size, self._seed)
+        if self.mesh is not None:
+            state = self._place_state(state)
+        self.state = state
+        self._free_slots = list(range(self.num_slots))
+        # host mirror of which slots are serving: admit()/release() are the
+        # only transitions, so liveness queries never touch the device
+        self._active_slots: set[int] = set()
+
+    def _place_state(self, state: DecodeState) -> DecodeState:
+        """Shard a fresh DecodeState over the mesh (the construction-time
+        layout, reapplied verbatim on rebuild)."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from localai_tpu.parallel import sharding as shd
+
+        mesh = self.mesh
+        specs = shd.state_specs(mesh)
+
+        def place(name: str, leaf):
+            spec = shd._sanitize(specs[name], leaf.shape, mesh)
+            return jax.device_put(leaf, NamedSharding(mesh, spec))
+
+        return DecodeState(
+            tokens=place("tokens", state.tokens),
+            positions=place("positions", state.positions),
+            active=place("active", state.active),
+            keys=place("keys", state.keys),
+            counts=place("counts", state.counts),
+            bias=place("bias", state.bias),
+            params=jax.tree.map(
+                lambda a: jax.device_put(
+                    a, NamedSharding(mesh, P("data"))
+                ),
+                state.params,
+            ),
+        )
+
+    def reinit(self) -> None:
+        """Self-healing engine rebuild (faults.supervisor): drop the
+        possibly-corrupt device state and allocate a fresh KV pool /
+        decode state / block tables in the original layout. The old
+        arrays may still be referenced by an abandoned dispatch thread
+        parked in a dead round-trip; they are released here and freed
+        whenever that thread exits. Callers own slot bookkeeping — every
+        previously admitted request must already be failed."""
+        self._init_device_state()
+        self.last_prefill_path = ""
+        self.last_prefix_reused = 0
+
     # -- jitted programs -------------------------------------------------
 
     def _decode_fn(self, params, kv: KVCache, state: DecodeState):
@@ -488,7 +537,17 @@ class ModelRunner:
         # not depend on batch composition (key advances == tokens sampled)
         keys = jnp.where(state.active, keys, state.keys)
         tokens = jnp.where(state.active, tokens, state.tokens)
-        counts = smp.update_counts(state.counts, tokens, state.active)
+        # per-row NaN/inf guard on the effective (biased) logits: one bad
+        # row must fail only its own slot, never silently poison the
+        # co-batched streams. The verdict rides the sampled-token row as
+        # the NAN_TOKEN sentinel — no extra transfer, no host branch.
+        row_ok = jnp.all(
+            jnp.isfinite(logits.astype(jnp.float32) + state.bias), axis=-1)
+        tokens = jnp.where(state.active & ~row_ok, NAN_TOKEN, tokens)
+        # clamp the sentinel out of the scatter index (the slot is dead
+        # either way; a wrapped negative index would dirty a real count)
+        counts = smp.update_counts(
+            state.counts, jnp.maximum(tokens, 0), state.active)
         positions = jnp.where(
             state.active, jnp.minimum(pos + 1, self.max_ctx - 1), pos
         )

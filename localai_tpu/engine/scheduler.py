@@ -25,6 +25,7 @@ from __future__ import annotations
 import dataclasses
 import itertools
 import logging
+import os
 import queue
 import threading
 import time
@@ -33,14 +34,21 @@ from typing import Any, Optional, Protocol, Sequence
 
 import numpy as np
 
-from localai_tpu.engine.runner import ModelRunner
+from localai_tpu.engine.runner import NAN_TOKEN, ModelRunner
 from localai_tpu.engine.stream import IncrementalDetokenizer, StopChecker
+from localai_tpu.faults import registry as _faults
 from localai_tpu.obs import compile as obs_compile
 from localai_tpu.obs import flight as obs_flight
 from localai_tpu.obs import watchdog as obs_watchdog
 from localai_tpu.obs.engine import EngineTelemetry
 
 log = logging.getLogger(__name__)
+
+
+class _EngineAbandoned(Exception):
+    """Raised inside a fenced-off engine thread (its epoch was bumped by
+    a rebuild while it sat in a blocked round-trip): exit without
+    touching the rebuilt engine's state."""
 
 
 # admission lanes: interactive requests (API traffic with a client
@@ -317,6 +325,40 @@ class Scheduler:
         self._stopping = False
         self._lock = threading.Lock()
         self._dispatch_seq = 0
+        # self-healing (faults.supervisor): rebuild() bumps _epoch so a
+        # wedged engine thread — parked inside a device round-trip that
+        # may never return — is fenced off and exits harmlessly when (if)
+        # it unblocks, while a fresh thread takes over the re-initialized
+        # runner state. rebuild()/mark_failed() run ONLY on the
+        # supervisor's single recovery thread (its _recovering flag is
+        # the serialization point), which owns the engine structures
+        # exactly while the fenced thread is parked — the same single-
+        # owner-thread design the engine loop itself uses. failed latches
+        # after the supervisor exhausts its bounded rebuild attempts:
+        # submit() then fails fast and the manager's dead-engine reload
+        # path owns further recovery.
+        self._epoch = 0
+        self.failed = False
+        self.rebuilds = 0
+        self.supervisor = None          # set by EngineSupervisor
+        # NaN/inf decode guard: a slot whose logits row went non-finite
+        # fails only its own request and is quarantined (kept out of
+        # admission) for a fixed number of dispatches — a transient blip
+        # returns the slot to service, a poisoned cache region keeps
+        # erroring visibly instead of silently corrupting co-batched
+        # streams. Counters feed localai_nan_rows_total.
+        self._quarantined: dict[int, int] = {}  # slot -> release dispatch
+        self.nan_rows = 0
+        try:
+            self._nan_quarantine = int(os.environ.get(
+                "LOCALAI_NAN_QUARANTINE_DISPATCHES", "16") or 16)
+        except ValueError:
+            self._nan_quarantine = 16
+        # block-leak invariant sweep (engine.paged.check_invariants) on
+        # every drain — debug builds and the chaos harness only; the
+        # O(blocks) walk is too hot for production dispatch cadence
+        self._kv_check = os.environ.get("LOCALAI_KV_CHECK", "") == "1"
+        self.kv_invariant_violations = 0
         # per-slot resident tokens (prompt + generated) for KV prefix reuse
         self._resident: dict[int, list[int]] = {}
         # lifetime metrics (GetMetrics parity)
@@ -328,7 +370,7 @@ class Scheduler:
         # owned by obs.slo (single-writer rule, see update_engine_gauges)
         self.shed_total = 0
         self._thread = threading.Thread(
-            target=self._run, name="engine", daemon=True
+            target=self._run, args=(0,), name="engine", daemon=True
         )
         self._thread.start()
 
@@ -337,9 +379,25 @@ class Scheduler:
     def submit(self, req: GenRequest) -> GenHandle:
         handle = GenHandle(req, next(self._ids))
         handle.trace = self.telemetry.queued(handle)
-        lane = (self._pending_batch if req.priority >= PRIORITY_BATCH
-                else self._pending)
-        lane.put(handle)
+        # failed-check and enqueue are one atomic step vs mark_failed()'s
+        # terminal queue drain (which flips the flag under the same lock
+        # BEFORE draining): a submit can land in the queue only while the
+        # drain is still obligated to pop it — no handle is ever parked
+        # on a dead engine unresolved
+        with self._lock:
+            rejected = self.failed
+            if not rejected:
+                lane = (self._pending_batch
+                        if req.priority >= PRIORITY_BATCH
+                        else self._pending)
+                lane.put(handle)
+        if rejected:
+            # the supervisor exhausted its rebuild budget: fail fast with
+            # a clean error instead of queueing onto a dead engine
+            self.telemetry.finished(handle.trace, handle, "error",
+                                    preempted=False)
+            handle._finish("error")
+            return handle
         self._wake.set()
         return handle
 
@@ -427,6 +485,7 @@ class Scheduler:
                 "generated": self.total_generated_tokens,
                 "preemptions": self.total_preemptions,
                 "shed": self.shed_total,
+                "failed": self.failed,
             }
         paged_stats = {}
         alloc = getattr(self.runner, "allocator", None)
@@ -464,6 +523,12 @@ class Scheduler:
             "dispatches": self._dispatch_seq,
             "preemptions": totals["preemptions"],
             "shed_total": totals["shed"],
+            # self-healing + NaN-guard surface (faults subsystem)
+            "engine_state": "failed" if totals["failed"] else "serving",
+            "rebuilds": self.rebuilds,
+            "nan_rows": self.nan_rows,
+            "quarantined_slots": len(self._quarantined),
+            "kv_invariant_violations": self.kv_invariant_violations,
             "step_time_ema": self._step_ema,  # seconds per decoded token
             "step_ms_p50": pct["step_ms_p50"],
             "step_ms_p99": pct["step_ms_p99"],
@@ -541,6 +606,23 @@ class Scheduler:
             compile=fresh,
         )
         self._flight_mark = emitted
+        if self._kv_check:
+            self._check_kv_invariants()
+
+    def _check_kv_invariants(self) -> None:
+        """Debug-flag drain sweep: the block allocator must conserve its
+        pool (free + used + cached == total, refcount sanity) after every
+        dispatch. Violations log, count, and feed
+        localai_kv_invariant_violations_total — they mean a leak."""
+        alloc = getattr(self.runner, "allocator", None)
+        if alloc is None:
+            return
+        problems = alloc.check_invariants()
+        if problems:
+            self.kv_invariant_violations += len(problems)
+            self.telemetry.registry.kv_invariant_violations.inc(
+                len(problems), model=self.telemetry.model or "engine")
+            log.error("KV block invariants violated: %s", problems)
 
     def _flight_forensics(self) -> dict:
         """Watchdog context provider: the last-N engine timeline attached
@@ -554,6 +636,8 @@ class Scheduler:
     def shutdown(self, timeout: float = 10.0) -> None:
         self._stopping = True
         self._wake.set()
+        if self.supervisor is not None:
+            self.supervisor.detach()
         self.watchdog.remove_context(f"flight:{self._wd_channel}")
         self._thread.join(timeout)
         if self._pc_thread is not None:
@@ -561,12 +645,198 @@ class Scheduler:
             self._pc_thread.join(timeout)
             self._pc_thread = None
 
+    # -- self-healing (faults.supervisor drives these) -------------------
+
+    def _fail_handle(self, handle: GenHandle, reason: str = "error",
+                     *, preempted: bool = True) -> None:
+        self.telemetry.finished(handle.trace, handle, reason,
+                                preempted=preempted)
+        handle._finish(reason)
+
+    def rebuild(self, probe_timeout: float = 30.0) -> None:
+        """Tear down and re-initialize the engine after a suspected-wedged
+        dispatch (called by the EngineSupervisor, off-thread, while the
+        engine thread is presumed parked inside a device round-trip that
+        may never return).
+
+        Sequence: fence the old engine thread off (epoch bump — it exits
+        whenever its blocked call returns, without touching the rebuilt
+        state), fail every request holding engine state with a clean
+        ``error`` (the API tier maps that to a 5xx), re-initialize the
+        runner's device state (fresh KV pool / decode state / tables —
+        compiled programs survive), verify the device answers with a
+        probe dispatch in an abandonable thread, then start a fresh
+        engine thread that resumes the still-queued requests. Raises if
+        the probe fails or times out — the supervisor escalates.
+
+        Runs ONLY on the supervisor's single recovery thread (its
+        ``_recovering`` flag is the serialization point): while the
+        fenced engine thread is parked, that thread is the sole owner of
+        the engine structures — the same single-owner-thread design the
+        engine loop itself uses (``_lock`` still guards the cross-thread
+        ``_slots`` views)."""
+        if self.spec is not None:
+            raise RuntimeError(
+                "engine rebuild is not supported with speculative decoding")
+        if self._stopping:
+            raise RuntimeError("scheduler is shutting down")
+        self._epoch += 1
+        epoch = self._epoch
+        with self._lock:
+            failed = list(self._slots.items())
+            self._slots.clear()
+            self.total_preemptions += len(failed) + len(self._prefills)
+        log.warning("engine rebuild: fencing old engine thread "
+                    "(epoch %d), draining %d active slots",
+                    epoch - 1, len(failed))
+        for _slot, ctx in failed:
+            self._fail_handle(ctx.handle)
+        for pf in list(self._prefills):
+            self._fail_handle(pf.handle)
+        self._prefills.clear()
+        # the held request has no engine state (its reservation is
+        # only attempted at admit) — it survives the rebuild and is
+        # retried against the fresh pool, like the queued requests
+        self._resident.clear()
+        self._quarantined.clear()
+        self._spec_dirty = False
+        self._last_drain_t = None
+        # the fenced thread never exits its wedged guard, so its arm()
+        # has no disarm(): drop the channel or the leaked armed count
+        # fires a spurious stall (and rebuild) every idle gap forever
+        self.watchdog.reset(self._wd_channel)
+        self.runner.reinit()
+        self._probe(probe_timeout)
+        self.rebuilds += 1
+        self._thread = threading.Thread(
+            target=self._run, args=(epoch,), name="engine", daemon=True
+        )
+        self._thread.start()
+        self._wake.set()
+
+    def _probe(self, timeout: float) -> None:
+        """One real admit+release against the rebuilt runner, in a side
+        thread so a still-dead device costs ``timeout`` seconds (and an
+        abandoned daemon) instead of wedging the supervisor forever."""
+        done = threading.Event()
+        err: list = []
+
+        def probe() -> None:
+            slot = None
+            try:
+                slot = self.runner.acquire_slot()
+                if slot is None:
+                    raise RuntimeError("no free slot after reinit")
+                self.runner.admit(slot, [1, 2, 3], temperature=0.0)
+                self.runner.release(slot)
+            except Exception as e:  # noqa: BLE001 — reported to the waiter
+                err.append(e)
+                if slot is not None:
+                    try:
+                        self.runner.release(slot)
+                    except Exception:  # noqa: BLE001
+                        pass
+            finally:
+                done.set()
+
+        t = threading.Thread(target=probe, name="engine-probe", daemon=True)
+        t.start()
+        if not done.wait(timeout):
+            raise RuntimeError(
+                f"probe dispatch made no progress in {timeout}s")
+        if err:
+            raise RuntimeError(f"probe dispatch failed: {err[0]}")
+
+    def mark_failed(self) -> None:
+        """Terminal state: the supervisor exhausted its rebuild budget.
+        Every queued/held request resolves with a clean error, future
+        submits fail fast, and the engine thread is fenced off; the
+        manager's dead-engine reload path owns any further recovery."""
+        self._epoch += 1  # fence whatever engine thread still exists
+        with self._lock:
+            # flag flip and slot collection share the lock submit()'s
+            # check-and-enqueue holds: every handle that beat the flip is
+            # already in a queue the drain below will pop
+            self.failed = True
+            failed = list(self._slots.items())
+            self._slots.clear()
+            self.total_preemptions += len(failed)
+        for _slot, ctx in failed:
+            self._fail_handle(ctx.handle)
+        for pf in list(self._prefills):
+            self._fail_handle(pf.handle)
+        self._prefills.clear()
+        if self._held is not None:
+            self._fail_handle(self._held, preempted=False)
+            self._held = None
+        while True:
+            handle = self._next_pending()
+            if handle is None:
+                break
+            self._fail_handle(handle, preempted=False)
+
+    # -- fault injection (chaos harness; no-ops unless armed) ------------
+
+    def _inject_slot_faults(self) -> None:
+        """decode.nan site: poison the bias row of the first active slot
+        whose correlation/trace id matches an armed spec — its next
+        logits row goes NaN on device and the per-row guard must catch
+        it. Runs only when faults.ACTIVE (never in production)."""
+        with self._lock:
+            slots = {s: c.handle.request for s, c in self._slots.items()}
+        for slot, req in slots.items():
+            key = req.correlation_id or req.trace_id or str(slot)
+            spec = _faults.fire("decode.nan", key=key)
+            if spec is None:
+                continue
+            row = np.full(self.runner.cfg.vocab_size, np.nan, np.float32)
+            self._engine.set_bias(slot, row)
+
+    def _poisoned(self, slot: int, ctx: _SlotCtx) -> None:
+        """The device-side per-row finite guard flagged this slot's
+        logits (NAN_TOKEN sentinel in the sampled row): fail ONLY the
+        affected request with ``error`` and quarantine the slot for
+        ``LOCALAI_NAN_QUARANTINE_DISPATCHES`` dispatches — co-batched
+        slots keep streaming untouched."""
+        self.nan_rows += 1
+        self.telemetry.registry.nan_rows.inc(
+            model=self.telemetry.model or "engine")
+        log.error(
+            "non-finite logits for slot %d (request %s): failing the "
+            "request, quarantining the slot for %d dispatches",
+            slot, ctx.handle.request.correlation_id or ctx.handle.id,
+            self._nan_quarantine)
+        self._release(slot, ctx, "error")
+        # _release returned the slot to the free list; pull it back out
+        # until the quarantine window passes
+        if self._engine.acquire_slot(slot) is not None:
+            self._quarantined[slot] = (
+                self._dispatch_seq + self._nan_quarantine)
+
+    def _unquarantine(self) -> None:
+        for slot, release_at in list(self._quarantined.items()):
+            if self._dispatch_seq >= release_at:
+                del self._quarantined[slot]
+                self._engine.release(slot)
+                log.info("slot %d leaves NaN quarantine", slot)
+
     # -- engine thread ---------------------------------------------------
+
+    def _run(self, epoch: int) -> None:
+        """Engine-thread entry: run the loop until shutdown — or until a
+        rebuild fences this thread off (``_epoch`` moved past ours while
+        we sat in a blocked round-trip), in which case exit silently:
+        the replacement thread owns the state now."""
+        try:
+            self._run_loop(epoch)
+        except _EngineAbandoned:
+            log.warning("engine thread (epoch %d) abandoned after rebuild",
+                        epoch)
 
     # the engine thread is the SOLE mutator of _slots/_prefills/etc.;
     # its own lock-free reads here are the single-owner-thread design the
     # class docstring documents (the lock exists for cross-thread viewers)
-    def _run(self) -> None:  # jaxlint: disable=lock-guarded-attr
+    def _run_loop(self, epoch: int) -> None:  # jaxlint: disable=lock-guarded-attr
         # Pipelined multi-step decode: each dispatch advances all slots
         # multi_step tokens inside ONE compiled program (lax.scan), up to
         # pipeline_depth dispatches stay in flight, and each result's D2H
@@ -589,7 +859,13 @@ class Scheduler:
             # a dead tunnel parks this exact line forever, and the stall
             # forensics must say so.
             with self.watchdog.guard(self._wd_channel):
+                if _faults.ACTIVE:  # chaos: wedge/raise inside the guard
+                    _faults.apply("engine.drain", key=self._wd_channel)
                 rows = np.asarray(toks)  # jaxlint: disable=host-sync-in-hot-path
+            if self._epoch != epoch:
+                # a rebuild replaced this engine while we were parked in
+                # the round-trip above — the state is no longer ours
+                raise _EngineAbandoned
             now = time.monotonic()
             if k == 0 and self.spec is not None:  # speculative window
                 self.spec.observe_window(rows)
@@ -621,7 +897,12 @@ class Scheduler:
                 k, dt, fresh,
             )
 
-        while not self._stopping:
+        while not self._stopping and self._epoch == epoch:
+            if _faults.ACTIVE:
+                # decode.nan chaos: poison a matching active slot's bias
+                # row so its next logits go non-finite — exercising the
+                # real device-side guard end to end
+                self._inject_slot_faults()
             admitted = self._admit_pending()
             # chunked prefill: ONE chunk per loop iteration, so pending
             # chunks and decode dispatches alternate — a long prompt
@@ -640,6 +921,9 @@ class Scheduler:
                     self._wake.clear()
                 continue
             try:
+                if _faults.ACTIVE:  # chaos: a device dispatch that raises
+                    _faults.apply("engine.dispatch", key="decode")
+
                 def constrained_slots() -> set[int]:
                     return {
                         s for s, c in self._slots.items()
@@ -731,7 +1015,13 @@ class Scheduler:
                                      bool(inflight), t_issue, fresh))
                     if len(inflight) >= self.pipeline_depth:
                         drain_one()
+            except _EngineAbandoned:
+                raise
             except Exception:  # noqa: BLE001 — engine must not die silently
+                if self._epoch != epoch:
+                    # a rebuild raced this dispatch; the new engine owns
+                    # the slots — do not fail them from the fenced thread
+                    raise _EngineAbandoned
                 log.exception("decode step failed; failing active requests")
                 inflight.clear()
                 with self._lock:
@@ -830,6 +1120,8 @@ class Scheduler:
             return None
 
     def _admit_pending(self) -> bool:
+        if self._quarantined:
+            self._unquarantine()
         admitted = False
         while self._engine.free_slots():
             if self._held is not None:
@@ -1163,6 +1455,11 @@ class Scheduler:
                 if i > 0 and frozen is not None and slot in frozen:
                     continue
                 tok = int(rows[i, slot])
+                if tok == NAN_TOKEN:
+                    # per-row NaN/inf guard sentinel: fail THIS request,
+                    # quarantine the slot, keep the rest of the batch
+                    self._poisoned(slot, ctx)
+                    continue
                 if tok < 0:  # SKIP sentinel: speculative window ended early
                     continue
                 self._consume(slot, ctx, tok)
@@ -1250,3 +1547,7 @@ class Scheduler:
         # query racing the response must not see a half-annotated trace
         self.telemetry.finished(ctx.handle.trace, ctx.handle, reason)
         ctx.handle._finish(reason)
+        if reason in ("stop", "length") and self.supervisor is not None:
+            # a natural completion closes any open incident: the
+            # supervisor's bounded rebuild budget refills
+            self.supervisor.note_healthy()

@@ -1,0 +1,31 @@
+"""Deterministic fault injection + self-healing supervision.
+
+``faults.registry`` is the injection registry: named points in the
+serving stack check it (behind a module-global ``ACTIVE`` flag that is
+False in production, so a disarmed build pays one attribute load — no
+env lookups, no per-dispatch allocation) and fail on purpose when a
+matching :class:`FaultSpec` is armed. ``faults.supervisor`` closes the
+detect→recover loop the obs subsystem only observes: a watchdog stall
+on an engine channel escalates from trace-dump to a bounded, backed-off
+engine rebuild, and past the bound the model is marked failed.
+
+Armed via ``LOCALAI_FAULT_*`` environment variables at boot
+(:func:`install_from_env`) or the ``/debug/faults`` endpoint at runtime;
+``tools/chaos_smoke.py`` drives the full stack through scripted fault
+schedules in CI.
+"""
+
+from localai_tpu.faults.registry import (  # noqa: F401
+    SITES,
+    FaultInjected,
+    FaultSpec,
+    active,
+    apply,
+    arm,
+    clear,
+    fire,
+    install_from_env,
+    parse_spec,
+    snapshot,
+)
+from localai_tpu.faults.supervisor import EngineSupervisor  # noqa: F401

@@ -15,13 +15,24 @@ pull."""
 from __future__ import annotations
 
 import logging
+import os
+import random
 import threading
 import time
 from typing import Callable, Optional
 
+from localai_tpu.faults import registry as _faults
 from localai_tpu.fleet.replica import DEAD, HEALTHY, RESPAWNING, BaseReplica
+from localai_tpu.obs.metrics import REGISTRY
 
 log = logging.getLogger(__name__)
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
 
 
 class ReplicaPool:
@@ -50,6 +61,18 @@ class ReplicaPool:
         self._lock = threading.Lock()
         self._respawning: set[str] = set()
         self.respawns = 0
+        # respawn pacing: a replica whose respawn keeps failing is retried
+        # on jittered exponential backoff (base doubled per consecutive
+        # failure, capped) instead of hammering a dead host every sweep;
+        # a successful rejoin resets the clock. Exported per replica as
+        # localai_fleet_respawn_backoff_s.
+        self.respawn_backoff_base = _env_float(
+            "LOCALAI_FLEET_RESPAWN_BASE_S", 1.0)
+        self.respawn_backoff_cap = _env_float(
+            "LOCALAI_FLEET_RESPAWN_CAP_S", 60.0)
+        self._respawn_failures: dict[str, int] = {}
+        self._respawn_after: dict[str, float] = {}
+        self.respawn_backoff_s: dict[str, float] = {}
         self._stop = threading.Event()
         self._monitor: Optional[threading.Thread] = None
 
@@ -147,7 +170,10 @@ class ReplicaPool:
             if r.state == RESPAWNING or self._stop.is_set():
                 continue
             if r.state == DEAD:
-                self._spawn_respawn(r)
+                with self._lock:
+                    hold = self._respawn_after.get(r.id, 0.0)
+                if time.monotonic() >= hold:
+                    self._spawn_respawn(r)
                 continue
             ok = r.process_alive() and r.dial(self.dial_timeout)
             if ok and self.track_queue_depth and r.role == "decode":
@@ -193,6 +219,8 @@ class ReplicaPool:
                     r.stop()
                 except Exception:  # noqa: BLE001
                     pass
+                if _faults.ACTIVE:  # chaos: a respawn that keeps failing
+                    _faults.apply("fleet.respawn", key=r.id)
                 r.start()
                 if self._stop.is_set():
                     # shutdown raced the spawn: its stop() sweep already
@@ -209,20 +237,52 @@ class ReplicaPool:
                 if r.dial(self.dial_timeout):
                     with self._lock:
                         self.respawns += 1
+                    self._note_rejoined(r)
                     log.info("fleet %s: replica %s respawned",
                              self.model, r.id)
                 else:
                     r.state = DEAD
+                    self._note_respawn_failed(r)
             except Exception as e:  # noqa: BLE001
-                log.warning("fleet %s: respawn of %s failed: %s "
-                            "(retrying next sweep)", self.model, r.id, e)
                 r.state = DEAD
+                backoff = self._note_respawn_failed(r)
+                log.warning("fleet %s: respawn of %s failed: %s "
+                            "(retrying in %.1fs)", self.model, r.id, e,
+                            backoff)
             finally:
                 with self._lock:
                     self._respawning.discard(r.id)
 
         threading.Thread(target=respawn, name=f"fleet-respawn-{r.id}",
                          daemon=True).start()
+
+    def _note_respawn_failed(self, r: BaseReplica) -> float:
+        """Advance the replica's jittered exponential respawn backoff:
+        base × 2^consecutive-failures, ±25% jitter, capped. The next
+        sweep skips the replica until the hold expires. Returns the
+        applied delay (logging/tests)."""
+        with self._lock:
+            n = self._respawn_failures.get(r.id, 0)
+            self._respawn_failures[r.id] = n + 1
+            base = min(self.respawn_backoff_cap,
+                       self.respawn_backoff_base * (2 ** n))
+            delay = min(self.respawn_backoff_cap,
+                        base * (0.75 + 0.5 * random.random()))
+            self.respawn_backoff_s[r.id] = delay
+            self._respawn_after[r.id] = time.monotonic() + delay
+        REGISTRY.fleet_respawn_backoff.set(
+            delay, model=self.model, replica=r.id)
+        return delay
+
+    def _note_rejoined(self, r: BaseReplica) -> None:
+        """A respawn passed health + LoadModel: the backoff clock resets
+        so the next incident starts from the base again."""
+        with self._lock:
+            self._respawn_failures.pop(r.id, None)
+            self._respawn_after.pop(r.id, None)
+            self.respawn_backoff_s.pop(r.id, None)
+        REGISTRY.fleet_respawn_backoff.set(
+            0.0, model=self.model, replica=r.id)
 
     # -- observability -----------------------------------------------------
 
@@ -248,10 +308,12 @@ class ReplicaPool:
             reps.append(snap)
         with self._lock:
             respawns = self.respawns
+            backoff = dict(self.respawn_backoff_s)
         return {
             "model": self.model,
             "states": self.states(),
             "respawns": respawns,
+            "respawn_backoff_s": backoff,
             "health_interval_s": self.health_interval,
             "failure_threshold": self.failure_threshold,
             "replicas": reps,

@@ -24,6 +24,8 @@ import threading
 import time
 from typing import Any, Iterator, Optional
 
+from localai_tpu.faults import registry as _faults
+
 log = logging.getLogger(__name__)
 
 STARTING = "starting"
@@ -278,6 +280,11 @@ class InProcessReplica(BaseReplica):
                     continue
                 if self._killed:
                     raise RuntimeError(f"replica {self.id} died mid-stream")
+                if _faults.ACTIVE:
+                    # same chaos surface as the gRPC worker stream: an
+                    # injected error/slowdown mid-stream, keyed by the
+                    # replica id so a schedule can target one replica
+                    _faults.apply("worker.stream", key=self.id)
                 if item.finish_reason is not None:
                     yield _Reply(b"", handle.completion_tokens,
                                  handle.prompt_tokens, item.finish_reason)

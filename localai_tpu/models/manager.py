@@ -545,6 +545,19 @@ def build_serving_model(mcfg: ModelConfig, app: AppConfig) -> ServingModel:
         prompt_cache_all=mcfg.prompt_cache_all,
         telemetry=EngineTelemetry(model=mcfg.name),
     )
+    # self-healing supervisor (localai_tpu.faults): a watchdog stall on
+    # this engine's channel escalates trace → drain-with-5xx → runner
+    # re-init → probe dispatch, bounded+backed-off, then marks the model
+    # failed (the dead-engine reload path here owns further recovery).
+    # Speculative engines are excluded (the draft pair's device state
+    # can't be rebuilt independently); LOCALAI_SELF_HEAL=0 disables.
+    # (multi-host mirrored runners are also excluded: a leader-local
+    # rebuild would desync the follower group's replayed command stream)
+    if (spec is None and not app.mirror_port
+            and os.environ.get("LOCALAI_SELF_HEAL", "1") != "0"):
+        from localai_tpu.faults import EngineSupervisor
+
+        EngineSupervisor(scheduler)
     # vision tower: explicit mmproj ref, or auto from a llava checkpoint dir
     vision = None
     vt_ref = mcfg.mmproj or (

@@ -38,6 +38,7 @@ import time
 import weakref
 from typing import Any, Callable, Optional
 
+from localai_tpu.faults import registry as _faults
 from localai_tpu.obs.metrics import REGISTRY, Registry
 
 _install_lock = threading.Lock()
@@ -260,6 +261,10 @@ def watch(fn: Callable, program: str,
         if not fresh:
             CATALOG.dispatched(program, key)
             return fn(*args, **kwargs)
+        if _faults.ACTIVE:
+            # chaos: a compile failure is a first-dispatch failure — the
+            # site raises here, before the program is traced/compiled
+            _faults.apply("engine.compile", key=program)
         t0 = time.monotonic()
         out = fn(*args, **kwargs)
         dt = time.monotonic() - t0

@@ -310,6 +310,44 @@ class Registry:
             "Packed KV-prefix bytes streamed between replicas over "
             "TransferPrefix",
         )
+        self.fleet_respawn_backoff = Gauge(
+            "localai_fleet_respawn_backoff_s",
+            "Current jittered-exponential respawn hold per dead replica "
+            "(0 after a successful rejoin)",
+        )
+        # -- fault injection + self-healing (localai_tpu.faults) -----------
+        self.faults_injected = Counter(
+            "localai_faults_injected_total",
+            "Deterministic faults fired by injection site "
+            "(LOCALAI_FAULT_* / POST /debug/faults — 0 in production)",
+        )
+        self.nan_rows = Counter(
+            "localai_nan_rows_total",
+            "Decode logits rows caught non-finite by the per-row NaN/inf "
+            "guard (the affected request fails `error`, its slot is "
+            "quarantined; co-batched requests keep streaming)",
+        )
+        self.quarantined_slots = Gauge(
+            "localai_quarantined_slots",
+            "Decode slots currently held out of admission by the NaN "
+            "quarantine",
+        )
+        self.engine_rebuilds = Counter(
+            "localai_engine_rebuilds_total",
+            "Self-healing engine rebuilds completed (stall → drain → "
+            "runner re-init → probe dispatch → engine thread restart)",
+        )
+        self.engine_failed = Gauge(
+            "localai_engine_failed",
+            "1 after the supervisor exhausted its bounded rebuild budget "
+            "and marked the model failed (submits fail fast)",
+        )
+        self.kv_invariant_violations = Counter(
+            "localai_kv_invariant_violations_total",
+            "BlockAllocator.check_invariants violations observed at "
+            "scheduler drains (LOCALAI_KV_CHECK=1) — any nonzero value "
+            "is a block leak",
+        )
         # -- stall forensics + device health (obs.watchdog / obs.device) --
         self.engine_stalled = Gauge(
             "localai_engine_stalled",
@@ -396,6 +434,11 @@ def update_engine_gauges(name: str, m: dict,
             m.get("prefill_chunk_queue_depth", 0), model=name)
         reg.prefill_chunks.set_total(m.get("prefill_chunks", 0), model=name)
     reg.decode_dispatches.set_total(m.get("dispatches", 0), model=name)
+    if "quarantined_slots" in m:
+        # point-in-time NaN-quarantine census; the nan_rows/rebuilds
+        # counter families are event-time (scheduler/supervisor are their
+        # sole writers) and deliberately NOT synced here
+        reg.quarantined_slots.set(m["quarantined_slots"], model=name)
     reg.prefix_reused.set_total(m.get("prefix_tokens_reused", 0), model=name)
     pc = m.get("prompt_cache")
     if pc:

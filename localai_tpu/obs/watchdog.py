@@ -119,7 +119,7 @@ class Watchdog:
 
     # -- heartbeat API (hot path: two clock reads + one lock) -------------
 
-    def _entry(self, channel: str, now: float) -> _Channel:
+    def _entry(self, channel: str, now: float) -> _Channel:  # jaxlint: guarded-by(_lock)
         ch = self._channels.get(channel)
         if ch is None:
             ch = self._channels[channel] = _Channel(now)
@@ -177,6 +177,15 @@ class Watchdog:
         with self._lock:
             self._callbacks.append(cb)
 
+    def remove_callback(self, cb: Callable[[StallEvent], None]) -> None:
+        """Unregister a stall callback (supervisors detach at scheduler
+        shutdown so a dead engine's closure is not kept alive here)."""
+        with self._lock:
+            try:
+                self._callbacks.remove(cb)
+            except ValueError:
+                pass
+
     def add_context(self, name: str, fn: Callable[[], dict]) -> None:
         """Register a forensic context provider: ``fn()`` returns a
         JSON-able dict recorded as a ``context`` event (attr ``source`` =
@@ -190,6 +199,20 @@ class Watchdog:
         a dead engine's closure is not kept alive by the watchdog)."""
         with self._lock:
             self._contexts.pop(name, None)
+
+    def reset(self, channel: str) -> None:
+        """Forget a channel's state entirely — armed count included.
+
+        The self-healing rebuild path needs this: a truly wedged engine
+        thread is parked inside a ``guard`` it will never exit, so its
+        arm() has no matching disarm() and the channel would stay armed
+        forever — every later idle gap past the deadline would fire a
+        spurious stall (and another rebuild) on a healthy engine. The
+        abandoned thread's eventual disarm() on the recreated channel is
+        a no-op (disarm only decrements a positive count)."""
+        with self._lock:
+            self._channels.pop(channel, None)
+        self._set_stall_gauge(channel)
 
     def stalled(self, channel: Optional[str] = None) -> bool:
         with self._lock:

@@ -26,6 +26,7 @@ from typing import Any, Iterator, Optional
 
 import grpc
 
+from localai_tpu.faults import registry as _faults
 from localai_tpu.worker import backend_pb2 as pb
 from localai_tpu.worker import rpc
 
@@ -204,6 +205,11 @@ class BackendServicer:
             request, sm, trace_id=rpc.trace_id_from_context(context)))
         try:
             for item in handle:
+                if _faults.ACTIVE:
+                    # chaos: a worker stream that errors (raise) or
+                    # crawls (sleep) mid-flight — the caller's failover/
+                    # watchdog paths must absorb it
+                    _faults.apply("worker.stream", key=sm.name)
                 if item.finish_reason is not None:
                     yield pb.Reply(
                         message=b"",
@@ -596,6 +602,9 @@ def main(argv: Optional[list[str]] = None) -> int:
         level=os.environ.get("LOCALAI_LOG_LEVEL", "INFO").upper(),
         format="%(asctime)s %(levelname).1s %(name)s: %(message)s",
     )
+    # deterministic fault injection (chaos harness): LOCALAI_FAULT_* in a
+    # spawned worker's env arms its registry at boot, never per request
+    _faults.install_from_env()
     # honor JAX_PLATFORMS even when a sitecustomize imported jax before the
     # env var could take effect (jax.config wins until backend init)
     plat = os.environ.get("JAX_PLATFORMS")

@@ -754,6 +754,51 @@ def test_debug_flight_reports_dispatch_records(client):
                       params={"limit": "many"}).status_code == 400
 
 
+def test_debug_kv_reports_block_audit(client):
+    client.post("/v1/chat/completions", json={
+        "model": "tiny",
+        "messages": [{"role": "user", "content": "kv audit"}],
+        "max_tokens": 4,
+    })
+    data = client.get("/debug/kv").json()
+    tiny = data["models"]["tiny"]
+    blocks = tiny["blocks"]
+    # conservation holds with all traffic drained
+    assert blocks["free"] + blocks["used"] + blocks["cached"] \
+        == blocks["total"]
+    assert tiny["invariant_violations"] == []
+    assert tiny["block_tokens"] >= 8
+    assert "violations_seen" in tiny
+
+
+def test_debug_faults_arm_list_clear(client):
+    from localai_tpu import faults
+
+    try:
+        data = client.get("/debug/faults").json()
+        assert data["active"] is False and data["armed"] == []
+        assert "engine.drain" in data["sites"]
+        # the in-process supervisor attached by build_serving_model shows
+        assert data["supervisors"]["tiny"]["failed"] is False
+        r = client.post("/debug/faults", json={
+            "site": "engine.dispatch", "mode": "raise", "after": 3,
+            "times": 1, "match": "decode"})
+        assert r.status_code == 200
+        data = client.get("/debug/faults").json()
+        assert data["active"] is True
+        assert data["armed"][0]["site"] == "engine.dispatch"
+        assert client.post("/debug/faults", json={
+            "site": "no.such.site"}).status_code == 400
+        assert client.post("/debug/faults", json={
+            "site": "engine.dispatch", "bogus": 1}).status_code == 400
+        assert client.post("/debug/faults", json=[1, 2]).status_code == 400
+        cleared = client.delete("/debug/faults").json()
+        assert cleared["cleared"] == 1
+        assert client.get("/debug/faults").json()["active"] is False
+    finally:
+        faults.clear()
+
+
 def test_v1_slo_reports_windows(client):
     client.post("/v1/chat/completions", json={
         "model": "tiny",

@@ -641,6 +641,75 @@ def test_pool_monitor_tracks_queue_depth():
     assert r.queue_depth == 7
 
 
+def test_respawn_backoff_grows_caps_and_resets():
+    """A replica whose respawn keeps failing is retried on jittered
+    exponential backoff (strictly growing across the first doublings,
+    never past the cap, skipped until the hold expires); a successful
+    rejoin resets the clock and zeroes the gauge."""
+    from localai_tpu.fleet.pool import ReplicaPool
+    from localai_tpu.obs.metrics import REGISTRY
+
+    class _Flaky(BaseReplica):
+        def __init__(self, rid, role="decode"):
+            super().__init__(rid, role)
+            self.fail_starts = 0
+            self.up = True
+
+        def start(self):
+            if self.fail_starts > 0:
+                self.fail_starts -= 1
+                raise RuntimeError("boot refused")
+            self.up = True
+
+        def _dial(self, timeout):
+            return self.up
+
+        def process_alive(self):
+            return self.up
+
+        def metrics(self):
+            return {}
+
+        def stop(self):
+            pass
+
+    pool = ReplicaPool("backoff", lambda rid, role: _Flaky(rid, role),
+                       replicas=1, health_interval=3600.0)
+    pool.respawn_backoff_base = 0.05
+    pool.respawn_backoff_cap = 0.15
+    pool.start()
+    try:
+        r = pool.replicas[0]
+        r.fail_starts = 3
+        r.up = False
+        pool.note_failure(r)
+        backoffs = []
+        deadline = time.monotonic() + 30
+        while len(backoffs) < 3 and time.monotonic() < deadline:
+            pool.poll_once()
+            b = pool.respawn_backoff_s.get(r.id)
+            if b is not None and (not backoffs or b != backoffs[-1]):
+                backoffs.append(b)
+            time.sleep(0.01)
+        assert len(backoffs) == 3, backoffs
+        # ±25% jitter bands of 0.05/0.10 are disjoint → strict growth;
+        # the third doubling (0.20) must clip to the 0.15 cap
+        assert backoffs[1] > backoffs[0], backoffs
+        assert all(b <= pool.respawn_backoff_cap for b in backoffs)
+        deadline = time.monotonic() + 30
+        while r.state != "healthy" and time.monotonic() < deadline:
+            pool.poll_once()
+            time.sleep(0.01)
+        assert r.state == "healthy"
+        assert r.id not in pool.respawn_backoff_s  # clock reset on rejoin
+        assert pool.snapshot()["respawn_backoff_s"] == {}
+        text = REGISTRY.render()
+        assert ('localai_fleet_respawn_backoff_s'
+                '{model="backoff",replica="backoff/r0"} 0.0') in text
+    finally:
+        pool.shutdown()
+
+
 # ---------------------------------------------------------------------------
 # per-replica device pinning presets (--fleet-device-pinning)
 

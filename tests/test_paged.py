@@ -339,6 +339,41 @@ def test_pool_exhaustion_holds_request_until_blocks_free(tiny):
         s.shutdown()
 
 
+def test_cancel_races_pool_exhaustion_hold(tiny):
+    """A request cancelled while parked in the scheduler's pool-
+    exhaustion hold (``_held``) must resolve ``cancelled``, release its
+    head-of-line place, and let a successor admit — with every block
+    conserved afterwards."""
+    import time
+
+    s = _paged_sched(tiny, kv_num_blocks=8)
+    try:
+        a = s.submit(GenRequest(prompt=list(b"pool filler request"),
+                                max_new_tokens=90, temperature=0.0))
+        held = s.submit(GenRequest(prompt=list(b"about to be held"),
+                                   max_new_tokens=90, temperature=0.0))
+        deadline = time.monotonic() + 30
+        while s._held is not held and time.monotonic() < deadline:
+            time.sleep(0.005)
+        assert s._held is held, "second request never parked in the hold"
+        held.cancel()
+        successor = s.submit(GenRequest(prompt=list(b"held successor"),
+                                        max_new_tokens=8, temperature=0.0))
+        held.result(timeout=60)
+        assert held.finish_reason == "cancelled"
+        a.result(timeout=120)
+        successor.result(timeout=120)
+        assert a.finish_reason is not None
+        assert successor.finish_reason in ("stop", "length")
+        # the cancelled hold left nothing behind: all blocks return and
+        # the allocator's conservation invariants hold
+        st = s.runner.allocator.stats()
+        assert st.free + st.cached == st.total
+        assert s.runner.allocator.check_invariants() == []
+    finally:
+        s.shutdown()
+
+
 def test_paged_metrics_export_block_gauges(tiny):
     s = _paged_sched(tiny)
     try:

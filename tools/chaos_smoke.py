@@ -1,0 +1,547 @@
+"""CI chaos smoke: drive the full stack through scripted faults and prove
+the self-healing paths actually heal.
+
+Every scenario arms a deterministic fault schedule (localai_tpu.faults),
+runs real traffic through a real engine (the tiny debug model — no
+downloads, CPU only), and then asserts the recovery invariants:
+
+  * **no request lost** — every submitted request resolves to tokens or a
+    clean ``error`` finish (nothing hangs, nothing disappears);
+  * **block conservation** — ``BlockAllocator.check_invariants()`` is
+    empty after the dust settles AND every block is back
+    (free + cached == total) once all requests drained;
+  * **no deadlock** — each scenario completes inside its own deadline
+    (the harness itself is the timeout);
+  * **shedding recovers** — the SLO admission-control lifecycle trips and
+    then clears once the fast window slides;
+  * **respawn backoff observed** — a replica whose respawn keeps failing
+    is retried on growing, capped holds, and the clock resets on rejoin.
+
+Scenarios (≥6, see ``SCENARIOS``):
+
+  nan_poison        one co-batched request's logits forced NaN → it fails
+                    ``error``, its slot quarantines, the OTHER request
+                    finishes with byte-identical greedy output
+  engine_rebuild    a dispatch wedged past the stall deadline → watchdog
+                    trips → supervisor drains handles with clean errors,
+                    re-inits the runner, probe dispatch passes, a fresh
+                    engine thread serves the next request
+  dispatch_raise    a device dispatch raises mid-decode → active requests
+                    fail ``error``, the engine keeps serving
+  compile_fail      the first dispatch of a program raises (compile
+                    failure) → clean errors, next traffic compiles fine
+  pool_exhaustion   a tiny block pool holds admissions; a held request
+                    cancelled mid-hold releases its place and a successor
+                    admits; everything resolves, blocks conserve
+  fleet_failover    a 2-replica fleet loses one replica pre-stream → the
+                    router fails over and the request completes
+  respawn_backoff   respawns forced to fail → jittered exponential holds
+                    grow (and cap), then clear on successful rejoin
+  shed_recover      burn-rate shedding trips under a synthetic overload
+                    and recovers when the window slides (injected clock)
+
+Usage:  python -m tools.chaos_smoke [--out chaos_report.json]
+        python -m tools.chaos_smoke --only nan_poison,engine_rebuild
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def _build_engine(name: str, *, watchdog=None, registry=None, store=None,
+                  max_ctx: int = 512, num_slots: int = 4,
+                  kv_num_blocks=None, supervisor: bool = False,
+                  sup_kwargs=None):
+    """A paged tiny-model engine with isolated telemetry (the process
+    registry stays clean for the exposition checks at the end)."""
+    from localai_tpu.engine.runner import ModelRunner
+    from localai_tpu.engine.scheduler import Scheduler
+    from localai_tpu.models.registry import resolve_model
+    from localai_tpu.obs.engine import EngineTelemetry
+    from localai_tpu.obs.metrics import REGISTRY
+    from localai_tpu.obs.slo import SLOTracker
+    from localai_tpu.obs.trace import TraceStore
+    from localai_tpu.utils.tokenizer import ByteTokenizer
+
+    registry = registry or REGISTRY
+    store = store or TraceStore()
+    tiny = resolve_model("debug:tiny", dtype="float32")
+    runner = ModelRunner(
+        tiny.cfg, tiny.params, num_slots=num_slots, max_ctx=max_ctx,
+        prefill_buckets=[16, 32], kv_dtype="float32",
+        paged=True, kv_block_tokens=16, prefill_chunk=16,
+        kv_num_blocks=kv_num_blocks,
+    )
+    sched = Scheduler(
+        runner, ByteTokenizer(),
+        telemetry=EngineTelemetry(
+            model=name, store=store, registry=registry,
+            slo=SLOTracker(registry=registry, targets={})),
+        watchdog=watchdog,
+    )
+    if supervisor:
+        from localai_tpu.faults import EngineSupervisor
+
+        EngineSupervisor(sched, registry=registry, **(sup_kwargs or {}))
+    return runner, sched
+
+
+def _req(text: str, **kw):
+    from localai_tpu.engine.scheduler import GenRequest
+    from localai_tpu.utils.tokenizer import ByteTokenizer
+
+    kw.setdefault("temperature", 0.0)
+    return GenRequest(prompt=ByteTokenizer().encode(text), **kw)
+
+
+def _resolved(handles) -> list[str]:
+    """Invariant: no request lost — every handle reached a terminal
+    finish. Returns problems."""
+    problems = []
+    for h in handles:
+        if h.finish_reason is None:
+            problems.append(f"request {h.id} never resolved")
+        elif h.finish_reason not in ("stop", "length", "error", "cancelled"):
+            problems.append(
+                f"request {h.id} finished {h.finish_reason!r}")
+    return problems
+
+
+def _blocks_conserved(runner) -> list[str]:
+    """Invariant: the allocator conserves its pool and, with all traffic
+    drained, holds zero live reservations."""
+    problems = list(runner.allocator.check_invariants())
+    st = runner.allocator.stats()
+    if st.free + st.cached != st.total:
+        problems.append(
+            f"blocks leaked after drain: free {st.free} + cached "
+            f"{st.cached} != total {st.total} (used {st.used})")
+    return problems
+
+
+# -- scenarios -------------------------------------------------------------
+
+def scenario_nan_poison() -> dict:
+    """One slot's logits poisoned NaN mid-decode: the per-row guard fails
+    ONLY that request; a co-batched request must finish byte-identical
+    to an unpoisoned run; the slot quarantines and later returns."""
+    from localai_tpu import faults
+
+    runner, sched = _build_engine("chaos-nan")
+    try:
+        ref = sched.generate(_req("co-batched survivor", max_new_tokens=24),
+                             timeout=120)
+        faults.arm(faults.FaultSpec(site="decode.nan", mode="nan",
+                                    match="chaos-poison", times=1))
+        poisoned = sched.submit(_req("poison target", max_new_tokens=400,
+                                     correlation_id="chaos-poison"))
+        survivor = sched.submit(_req("co-batched survivor",
+                                     max_new_tokens=24))
+        poisoned.result(120)
+        survivor.result(120)
+        problems = _resolved([poisoned, survivor])
+        if poisoned.finish_reason != "error":
+            problems.append(
+                f"poisoned request finished {poisoned.finish_reason!r}, "
+                "not error")
+        if survivor.finish_reason not in ("stop", "length"):
+            problems.append(
+                f"survivor finished {survivor.finish_reason!r}")
+        if survivor.token_ids != ref.token_ids:
+            problems.append(
+                "co-batched survivor's greedy output diverged from the "
+                "unpoisoned reference")
+        if sched.nan_rows < 1:
+            problems.append("nan_rows counter never moved")
+        if not sched._quarantined and sched.metrics()[
+                "quarantined_slots"] == 0:
+            problems.append("poisoned slot was not quarantined")
+        # quarantine must RELEASE: run traffic past the window and check
+        # all slots admit again
+        for _ in range(3):
+            sched.generate(_req("post-poison traffic", max_new_tokens=40),
+                           timeout=120)
+        deadline = time.monotonic() + 30
+        while sched._quarantined and time.monotonic() < deadline:
+            sched.generate(_req("quarantine drain", max_new_tokens=40),
+                           timeout=120)
+        if sched._quarantined:
+            problems.append("slot never left quarantine")
+        problems += _blocks_conserved(runner)
+        return {"problems": problems,
+                "nan_rows": sched.nan_rows,
+                "poisoned_tokens": poisoned.completion_tokens}
+    finally:
+        faults.clear()
+        sched.shutdown()
+
+
+def scenario_engine_rebuild() -> dict:
+    """A dispatch wedged past the stall deadline: the watchdog trips, the
+    supervisor drains the stuck handle with a clean error, re-inits the
+    runner, the probe dispatch passes, and a subsequent request completes
+    on the fresh engine thread — the full escalation ladder."""
+    from localai_tpu import faults
+    from localai_tpu.obs.metrics import REGISTRY
+    from localai_tpu.obs.trace import TraceStore
+    from localai_tpu.obs.watchdog import Watchdog
+
+    store = TraceStore()
+    wd = Watchdog(deadline=0.5, registry=REGISTRY, store=store,
+                  poll_interval=0.1)
+    runner, sched = _build_engine(
+        "chaos-rebuild", watchdog=wd, store=store, supervisor=True,
+        sup_kwargs={"max_rebuilds": 3, "backoff_s": 0.05,
+                    "probe_timeout_s": 60.0})
+    try:
+        warm = sched.generate(_req("warm up", max_new_tokens=8), timeout=120)
+        wedged = sched.submit(_req("about to wedge", max_new_tokens=400))
+        # arm only once the request is actively decoding: otherwise the
+        # hang can fire on a leftover pipelined drain of the warmup and
+        # the rebuild drains an empty batch instead of this handle
+        deadline = time.monotonic() + 60
+        while wedged.t_first_token is None and time.monotonic() < deadline:
+            time.sleep(0.02)
+        faults.arm(faults.FaultSpec(site="engine.drain", mode="hang",
+                                    delay_s=3.0, times=1))
+        wedged.result(90)
+        problems = _resolved([warm, wedged])
+        if wedged.finish_reason != "error":
+            problems.append(
+                f"wedged request finished {wedged.finish_reason!r}, "
+                "not a clean error")
+        deadline = time.monotonic() + 60
+        while sched.rebuilds == 0 and time.monotonic() < deadline:
+            time.sleep(0.1)
+        if sched.rebuilds != 1:
+            problems.append(f"expected 1 rebuild, saw {sched.rebuilds}")
+        if sched.failed:
+            problems.append("engine marked failed on a recoverable stall")
+        faults.clear()
+        after = sched.generate(_req("after rebuild", max_new_tokens=8),
+                               timeout=120)
+        if after.finish_reason not in ("stop", "length"):
+            problems.append(
+                f"post-rebuild request finished {after.finish_reason!r}")
+        stall_traces = [t for t in store.recent(limit=10, kind="stall")]
+        if not stall_traces:
+            problems.append("no forensic stall trace recorded")
+        problems += _blocks_conserved(runner)
+        return {"problems": problems, "rebuilds": sched.rebuilds,
+                "post_rebuild_tokens": after.completion_tokens}
+    finally:
+        faults.clear()
+        sched.shutdown()
+        wd.stop()
+
+
+def scenario_dispatch_raise() -> dict:
+    """A device dispatch raising mid-decode: the engine's catch-all fails
+    the active requests cleanly and keeps serving."""
+    from localai_tpu import faults
+
+    runner, sched = _build_engine("chaos-raise")
+    try:
+        faults.arm(faults.FaultSpec(site="engine.dispatch", mode="raise",
+                                    after=2, times=1))
+        handles = [sched.submit(_req(f"dispatch victim {i}",
+                                     max_new_tokens=200))
+                   for i in range(2)]
+        for h in handles:
+            h.result(120)
+        problems = _resolved(handles)
+        if not any(h.finish_reason == "error" for h in handles):
+            problems.append("no request saw the injected dispatch error")
+        after = sched.generate(_req("after dispatch error",
+                                    max_new_tokens=8), timeout=120)
+        if after.finish_reason not in ("stop", "length"):
+            problems.append(
+                f"post-error request finished {after.finish_reason!r}")
+        problems += _blocks_conserved(runner)
+        return {"problems": problems,
+                "finishes": [h.finish_reason for h in handles]}
+    finally:
+        faults.clear()
+        sched.shutdown()
+
+
+def scenario_compile_fail() -> dict:
+    """The first dispatch of the decode program raises (a compile
+    failure): clean errors, and the NEXT dispatch compiles and serves."""
+    from localai_tpu import faults
+
+    faults.arm(faults.FaultSpec(site="engine.compile", mode="raise",
+                                match="decode", times=1))
+    runner, sched = _build_engine("chaos-compile")
+    try:
+        first = sched.submit(_req("compile victim", max_new_tokens=16))
+        first.result(120)
+        problems = _resolved([first])
+        if first.finish_reason != "error":
+            problems.append(
+                f"compile-failure request finished "
+                f"{first.finish_reason!r}, not error")
+        faults.clear()
+        after = sched.generate(_req("after compile failure",
+                                    max_new_tokens=8), timeout=120)
+        if after.finish_reason not in ("stop", "length"):
+            problems.append(
+                f"post-compile-failure request finished "
+                f"{after.finish_reason!r}")
+        problems += _blocks_conserved(runner)
+        return {"problems": problems}
+    finally:
+        faults.clear()
+        sched.shutdown()
+
+
+def scenario_pool_exhaustion() -> dict:
+    """Block-pool exhaustion holds admissions; a cancel racing the hold
+    queue releases its place and a successor admits; every request
+    resolves and every block returns."""
+    runner, sched = _build_engine("chaos-pool", max_ctx=256,
+                                  kv_num_blocks=25)  # 24 allocatable
+    try:
+        # each request reserves ceil((prompt+new+1)/16) blocks; two ~12-
+        # block reservations fill the 24-block pool, the third holds
+        big = [sched.submit(_req("pool filler " * 4, max_new_tokens=150))
+               for _ in range(2)]
+        held = sched.submit(_req("held by exhaustion", max_new_tokens=150))
+        time.sleep(0.5)
+        if held.finish_reason is not None:
+            return {"problems": ["third request was not held "
+                                 f"({held.finish_reason})"]}
+        # cancel while parked in the hold queue: its place frees and a
+        # successor admits once the pool drains
+        held.cancel()
+        successor = sched.submit(_req("held successor", max_new_tokens=8))
+        held.result(120)
+        for h in big:
+            h.result(180)
+        successor.result(180)
+        problems = _resolved(big + [held, successor])
+        if held.finish_reason != "cancelled":
+            problems.append(
+                f"cancelled held request finished {held.finish_reason!r}")
+        if successor.finish_reason not in ("stop", "length"):
+            problems.append(
+                f"successor finished {successor.finish_reason!r}")
+        problems += _blocks_conserved(runner)
+        st = runner.allocator.stats()
+        return {"problems": problems,
+                "watermark": st.high_watermark, "total": st.total}
+    finally:
+        sched.shutdown()
+
+
+def _build_fleet(name: str, *, replicas: int = 2):
+    from localai_tpu.config.app_config import AppConfig
+    from localai_tpu.config.model_config import ModelConfig
+    from localai_tpu.fleet import FleetServingModel
+    from localai_tpu.fleet.replica import InProcessReplica
+    from localai_tpu.models.manager import build_serving_model
+
+    app = AppConfig()
+    mcfg = ModelConfig.model_validate({
+        "name": name, "model": "debug:tiny", "context_size": 256,
+        "parameters": {"temperature": 0.0, "max_tokens": 8},
+        "engine": {"max_slots": 2, "prefill_buckets": [16, 32, 64],
+                   "dtype": "float32", "kv_dtype": "float32",
+                   "kv_block_tokens": 16},
+    })
+
+    def factory(rid, role):
+        return InProcessReplica(
+            rid, role, lambda: build_serving_model(mcfg, app))
+
+    return FleetServingModel(mcfg, app, factory, replicas=replicas,
+                             prefill_replicas=0, disagg_threshold=10_000)
+
+
+def scenario_fleet_failover() -> dict:
+    """One replica's stream dies before it ever yields: the fleet
+    scheduler fails over to the surviving replica and the request
+    completes — then the dead replica's respawn rejoins it."""
+    from localai_tpu import faults
+
+    fm = _build_fleet("chaos-fleet")
+    try:
+        warm = fm.scheduler.submit(_req("fleet warmup", max_new_tokens=6))
+        warm.result(180)
+        # kill whichever replica the next request routes to, pre-stream:
+        # raise on the FIRST reply of either replica's next stream
+        faults.arm(faults.FaultSpec(site="worker.stream", mode="raise",
+                                    times=1))
+        victim = fm.scheduler.submit(
+            _req("failover me please", max_new_tokens=6))
+        victim.result(180)
+        problems = _resolved([warm, victim])
+        if victim.finish_reason not in ("stop", "length"):
+            problems.append(
+                f"failover request finished {victim.finish_reason!r} "
+                f"(failovers={fm.scheduler.failovers})")
+        if fm.scheduler.failovers < 1:
+            problems.append("no failover recorded")
+        return {"problems": problems,
+                "failovers": fm.scheduler.failovers,
+                "routed": dict(fm.router.routed)}
+    finally:
+        faults.clear()
+        fm.close()
+
+
+def scenario_respawn_backoff() -> dict:
+    """A dead replica whose respawn keeps failing: retries are spaced by
+    growing jittered-exponential holds (capped), and a successful rejoin
+    resets the backoff to zero."""
+    from localai_tpu import faults
+
+    fm = _build_fleet("chaos-respawn")
+    pool = fm.pool
+    try:
+        pool.respawn_backoff_base = 0.2
+        pool.respawn_backoff_cap = 1.0
+        victim = pool.replicas[0]
+        faults.arm(faults.FaultSpec(site="fleet.respawn", mode="raise",
+                                    match=victim.id, times=3))
+        victim.kill()
+        pool.note_failure(victim)
+        backoffs = []
+        deadline = time.monotonic() + 60
+        while len(backoffs) < 3 and time.monotonic() < deadline:
+            pool.poll_once()
+            b = pool.respawn_backoff_s.get(victim.id)
+            if b is not None and (not backoffs or b != backoffs[-1]):
+                backoffs.append(b)
+            time.sleep(0.1)
+        problems = []
+        if len(backoffs) < 3:
+            problems.append(
+                f"expected 3 failed-respawn holds, saw {backoffs}")
+        else:
+            if not backoffs[1] > backoffs[0]:
+                problems.append(f"backoff did not grow: {backoffs}")
+            if any(b > pool.respawn_backoff_cap for b in backoffs):
+                problems.append(f"backoff exceeded cap: {backoffs}")
+        # the schedule is exhausted (times=3): the next retry succeeds
+        # and must reset the backoff clock
+        deadline = time.monotonic() + 60
+        while (victim.state != "healthy"
+               and time.monotonic() < deadline):
+            pool.poll_once()
+            time.sleep(0.1)
+        if victim.state != "healthy":
+            problems.append(
+                f"replica never rejoined (state {victim.state})")
+        if pool.respawn_backoff_s.get(victim.id):
+            problems.append("backoff did not reset on rejoin")
+        h = fm.scheduler.submit(_req("post respawn", max_new_tokens=6))
+        h.result(180)
+        problems += _resolved([h])
+        return {"problems": problems, "backoffs": backoffs,
+                "respawns": pool.respawns}
+    finally:
+        faults.clear()
+        fm.close()
+
+
+def scenario_shed_recover() -> dict:
+    """SLO burn-rate shedding trips under a synthetic overload and
+    recovers once the fast window slides (injected clock) — the
+    admission-control half of the recovery story."""
+    from localai_tpu.obs.metrics import Registry
+    from localai_tpu.obs.slo import SLOTracker
+
+    reg = Registry()
+    t = {"now": 1000.0}
+    slo = SLOTracker(registry=reg, clock=lambda: t["now"],
+                     targets={"ttft_ms": 0.001}, burn_threshold=1.0,
+                     recover_burn=1.0, min_events=3)
+    problems = []
+    for _ in range(4):
+        slo.observe("chaos-shed", ttft_ms=50.0, e2e_ms=80.0)
+    if not slo.should_shed("chaos-shed"):
+        problems.append("overload did not trip shedding")
+    slo.shed("chaos-shed")
+    t["now"] += 120.0
+    if slo.should_shed("chaos-shed"):
+        problems.append("shedding did not recover after the window slid")
+    return {"problems": problems}
+
+
+SCENARIOS = {
+    "nan_poison": scenario_nan_poison,
+    "engine_rebuild": scenario_engine_rebuild,
+    "dispatch_raise": scenario_dispatch_raise,
+    "compile_fail": scenario_compile_fail,
+    "pool_exhaustion": scenario_pool_exhaustion,
+    "fleet_failover": scenario_fleet_failover,
+    "respawn_backoff": scenario_respawn_backoff,
+    "shed_recover": scenario_shed_recover,
+}
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--out", default="chaos_report.json")
+    parser.add_argument("--only", default="",
+                        help="comma-separated scenario subset")
+    args = parser.parse_args(argv)
+
+    # every chaos engine also runs the per-drain block-leak sweep
+    # (Scheduler reads the flag at construction) — a leak under fault
+    # load shows up as localai_kv_invariant_violations_total, not just
+    # at the end-of-scenario audit
+    import os
+
+    os.environ.setdefault("LOCALAI_KV_CHECK", "1")
+
+    from localai_tpu import faults
+    from localai_tpu.obs.metrics import REGISTRY
+
+    names = [n for n in args.only.split(",") if n] or list(SCENARIOS)
+    unknown = [n for n in names if n not in SCENARIOS]
+    if unknown:
+        print(f"unknown scenarios: {unknown}; have {sorted(SCENARIOS)}")
+        return 2
+    report = {"scenarios": {}, "ok": True}
+    for name in names:
+        t0 = time.monotonic()
+        print(f"=== chaos scenario: {name}")
+        try:
+            result = SCENARIOS[name]()
+        except Exception as e:  # noqa: BLE001 — a crash IS a failure
+            import traceback
+
+            traceback.print_exc()
+            result = {"problems": [f"scenario crashed: {e}"]}
+        finally:
+            faults.clear()  # a failed scenario must not arm the next
+        result["seconds"] = round(time.monotonic() - t0, 2)
+        result["ok"] = not result["problems"]
+        report["scenarios"][name] = result
+        report["ok"] = report["ok"] and result["ok"]
+        status = "ok" if result["ok"] else "FAIL"
+        print(f"    {status} in {result['seconds']}s"
+              + (f": {result['problems']}" if result["problems"] else ""))
+    # the fault receipts: every armed schedule above must have fired
+    # through the real injection sites and landed in the counter family
+    exposition = REGISTRY.render()
+    if "localai_faults_injected_total{" not in exposition:
+        report["ok"] = False
+        report["scenarios"].setdefault("_exposition", {})[
+            "problems"] = ["localai_faults_injected_total never rendered"]
+    with open(args.out, "w") as f:
+        json.dump(report, f, indent=2, sort_keys=True)
+    n_ok = sum(1 for r in report["scenarios"].values() if r.get("ok"))
+    print(f"{'OK' if report['ok'] else 'FAIL'}: {n_ok}/{len(names)} "
+          f"scenarios green; report → {args.out}")
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
