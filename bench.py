@@ -313,6 +313,148 @@ def run_decode_bench(preset: str, quant: str, steps: int, multi: int,
     return dispatches * multi * num_slots / dt
 
 
+def run_spec_bench(preset: str, quant: str, steps: int,
+                   num_slots: int = 8, max_ctx: int = 1024,
+                   gamma: int = 4, watchdog=None, channel: str = "bench",
+                   flight=None):
+    """Paged + speculative decode (localai_tpu.spec): the n-gram
+    self-drafter over repetitive prompts, one verify-k window per
+    dispatch. Returns (tok/s, accept_rate, tokens_per_dispatch).
+
+    Windows serialize (the host drafter proposes from drained history),
+    so the measured number is the honest end-to-end speculative TPOT —
+    host proposal time included. A lookup miss falls back to one plain
+    decode dispatch, exactly like the scheduler's lane."""
+    import jax
+
+    _apply_platform()
+    import numpy as np
+
+    def pulse() -> None:
+        if watchdog is not None:
+            watchdog.pulse(channel)
+
+    from localai_tpu.engine.runner import ModelRunner
+    from localai_tpu.models.registry import (
+        DEBUG_PRESETS,
+        resolve_model,
+        synthetic_quantized_params,
+    )
+    from localai_tpu.spec import NGramDrafter, SpecEngine
+
+    kv_dtype = "bfloat16"
+    if quant in ("int8", "int4", "int8_w8a8"):
+        import dataclasses
+
+        cfg = dataclasses.replace(DEBUG_PRESETS[preset], dtype="bfloat16")
+        params = _cached_weights(
+            preset, quant, cfg,
+            lambda: synthetic_quantized_params(cfg, quant))
+        kv_dtype = "int8"
+    else:
+        model = resolve_model(f"debug:{preset}", dtype="bfloat16")
+        cfg, params = model.cfg, model.params
+    jax.block_until_ready(jax.tree.leaves(params)[0])
+    pulse()
+    runner = ModelRunner(
+        cfg, params, num_slots=num_slots, max_ctx=max_ctx,
+        prefill_buckets=[128], kv_dtype=kv_dtype, paged=True,
+    )
+    eng = SpecEngine(runner, NGramDrafter(num_slots, gamma))
+    pulse()
+    prompt = list(range(1, 4)) * 33 + [1]  # 100-token repetitive prompt
+    slots = []
+    for _ in range(num_slots):
+        slot = eng.acquire_slot()
+        eng.admit(slot, prompt, temperature=0.0)
+        slots.append(slot)
+        pulse()
+    # warmup: compile the verify window + the plain fallback. The plain
+    # step's tokens MUST feed the drafter history like the fallback
+    # branch below — a silently-dropped token desyncs every slot's
+    # n-gram record and the measured accept rate becomes fiction.
+    try:
+        eng.step_spec()
+    except RuntimeError:
+        pass
+    toks = np.asarray(runner.step())
+    for s in slots:
+        eng.drafter.observe(s, [int(toks[s])])
+    jax.block_until_ready(runner.state.tokens)
+    pulse()
+    eng0_emitted, eng0_windows = eng.total_emitted, eng.total_windows
+    target_tokens = steps * num_slots
+    emitted = 0
+    dispatches = 0
+    t0 = time.perf_counter()
+    last_t = time.monotonic()
+    while emitted < target_tokens and dispatches < steps * 2:
+        dispatches += 1
+        rows = eng.step_spec_async()
+        if rows is None:  # lookup miss everywhere — plain fallback
+            toks = np.asarray(runner.step())
+            for s in slots:
+                eng.drafter.observe(s, [int(toks[s])])
+            emitted += num_slots
+            w = None
+        else:
+            w = eng.observe_window(np.asarray(rows))
+            emitted += w["emitted"]
+        now = time.monotonic()
+        if flight is not None:
+            flight.record(
+                program="spec" if w else "decode", steps=1,
+                dispatch_ms=(now - last_t) * 1e3, occupancy=1.0,
+                queue_depth=0, kv_utilization=0.0,
+                tokens=w["emitted"] if w else num_slots,
+                spec_proposed=w["proposed"] if w else 0,
+                spec_accepted=w["accepted"] if w else 0,
+            )
+        last_t = now
+        pulse()
+    dt = time.perf_counter() - t0
+    d_emit = eng.total_emitted - eng0_emitted
+    d_win = eng.total_windows - eng0_windows
+    return (emitted / dt, eng.accept_rate,
+            (d_emit / (d_win * num_slots)) if d_win else 0.0)
+
+
+def _measure_spec(board, preset: str, quant: str, steps: int,
+                  watchdog=None, channel: str = "bench:spec",
+                  flight=None) -> None:
+    """Speculative phase: rides the output under its own ``spec`` key
+    (like the meshed phase — it must never displace the single-device
+    trend line). BENCH_SPEC=0 skips it."""
+    short = "llama8b" if "8b" in preset else "llama1b" if "1b" in preset \
+        else preset
+    t0 = time.monotonic()
+    try:
+        tok_s, accept, per_dispatch = run_spec_bench(
+            preset, quant, steps, watchdog=watchdog, channel=channel,
+            flight=flight)
+        line = {
+            "metric": f"decode_throughput_{short}_bs8_{quant}_spec",
+            "value": round(tok_s, 2),
+            "unit": "tok/s",
+            "phase_s": round(time.monotonic() - t0, 1),
+            "kv": "paged+spec",
+            "spec_accept_rate": round(accept, 4),
+            "spec_tokens_per_dispatch": round(per_dispatch, 4),
+        }
+        if flight is not None:
+            pct = flight.percentiles()
+            if pct["step_ms_p50"] is not None:
+                line["step_ms_p50"] = pct["step_ms_p50"]
+                line["step_ms_p99"] = pct["step_ms_p99"]
+        board.annotate("spec", line)
+    except Exception as e:  # noqa: BLE001 — keep a diagnosable line
+        board.annotate("spec", {
+            "metric": f"decode_throughput_{short}_bs8_{quant}_spec",
+            "value": 0.0, "unit": "tok/s",
+            "note": f"{type(e).__name__}: {e}"[:300],
+        })
+
+
 class _Board:
     """The one-JSON-line contract: whoever prints, prints best-known-now."""
 
@@ -634,6 +776,16 @@ def main() -> None:
                 board, mp, mq, steps, multi, depth, primary=False,
                 watchdog=wd, channel="bench:meshed", flight=mflight,
                 meshed=True))
+        # speculative phase (ISSUE 11): the paged+spec lane with the
+        # n-gram self-drafter on repetitive prompts — its own output key
+        # ("spec"), BENCH_SPEC=0 escape, never displaces the trend line
+        if (os.environ.get("BENCH_SPEC", "1") != "0"
+                and deadline - time.monotonic() > 90):
+            sp, sq = ("1b", "int8") if has_8b else (preset, quant)
+            sflight = FlightRecorder(512)
+            guarded("bench:spec", lambda: _measure_spec(
+                board, sp, sq, steps, watchdog=wd,
+                channel="bench:spec", flight=sflight))
 
     t = threading.Thread(target=work, daemon=True)
     t.start()

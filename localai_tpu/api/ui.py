@@ -738,11 +738,13 @@ async function refresh() {
                  fmt(m.percentiles.step_ms_p99, 2),
                  fmt(last.occupancy, 2),
                  last.queue_depth ?? '—',
-                 fmt(last.kv_utilization, 2)]);
+                 fmt(last.kv_utilization, 2),
+                 last.spec_accept == null ? '—'
+                                          : fmt(last.spec_accept, 2)]);
     }
     table(document.getElementById('flight'),
           ['model', 'dispatches', 'tokens', 'step p50 ms', 'step p99 ms',
-           'occupancy', 'queue', 'kv util'], rows);
+           'occupancy', 'queue', 'kv util', 'spec accept'], rows);
   } catch (e) {
     document.getElementById('flight').textContent = 'error: ' + e.message;
   }

@@ -181,6 +181,18 @@ class EngineConfig(BaseModel):
     # tokens per window; the target verifies them in one batched forward.
     draft_model: Optional[str] = None
     n_draft: int = 4
+    # Block-native speculation lane (localai_tpu.spec). None = auto: ON
+    # for paged engines (LOCALAI_SPEC=0 force-disables, =1 has nothing
+    # to add), OFF for contiguous engines unless draft_model is set.
+    # spec_drafter picks the proposal source: "model" loads draft_model
+    # co-located, "ngram" self-drafts via prompt lookup (no second model
+    # — the single-model deployment default), "auto" = model when
+    # draft_model is configured else ngram. spec_gamma is the window
+    # size (draft tokens verified per dispatch; default n_draft, or
+    # LOCALAI_SPEC_GAMMA).
+    spec: Optional[bool] = None
+    spec_drafter: str = "auto"
+    spec_gamma: Optional[int] = None
     # Self-extend / group attention (parity: llama.cpp grp_attn_n/grp_attn_w,
     # grpc-server.cpp:210-211): grp_attn_n>1 serves up to
     # max_position_embeddings * grp_attn_n context via grouped positions —

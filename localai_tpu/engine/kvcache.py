@@ -277,6 +277,110 @@ def paged_prefill_write(table_row: jax.Array, offset: jax.Array,
     return write
 
 
+def verify_write(positions: jax.Array):
+    """KV write policy for the batched speculative verify forward: writes
+    the window chunk [S, T, H, hd] at cache[s, :, positions[s] + t] and
+    exposes the full per-layer cache as keys ([S, H, C, hd]) —
+    ``decode_write`` generalized to T tokens per slot. Rejected positions
+    leave garbage KV *above* each slot's accepted frontier, which the
+    decode masks never read and later writes overwrite — rollback is free
+    by construction (same invariant as the bucketed prefill paths)."""
+
+    def write(layer_kv, k_new, v_new):
+        dt = k_new.dtype
+        S, T = k_new.shape[0], k_new.shape[1]
+        s = jnp.arange(S)[:, None]
+        pmat = positions[:, None] + jnp.arange(T)[None, :]  # [S, T]
+        if len(layer_kv) == 4:  # scaled int8 cache
+            k_layer, v_layer, ks_layer, vs_layer = layer_kv
+            kq, ks = _quant_chunk(k_new)  # [S, T, H, hd], [S, T, H]
+            vq, vs = _quant_chunk(v_new)
+            new_k = k_layer.at[s, :, pmat].set(kq)
+            new_v = v_layer.at[s, :, pmat].set(vq)
+            new_ks = ks_layer.at[s, :, pmat].set(ks)
+            new_vs = vs_layer.at[s, :, pmat].set(vs)
+            keys = new_k.astype(dt) * new_ks[..., None].astype(dt)
+            values = new_v.astype(dt) * new_vs[..., None].astype(dt)
+            return (new_k, new_v, new_ks, new_vs), keys, values
+        k_layer, v_layer = layer_kv
+        kdt = k_layer.dtype
+        new_k = k_layer.at[s, :, pmat].set(k_new.astype(kdt))
+        new_v = v_layer.at[s, :, pmat].set(v_new.astype(kdt))
+        return (new_k, new_v), new_k.astype(dt), new_v.astype(dt)
+
+    return write
+
+
+def paged_verify_write(tables: jax.Array, positions: jax.Array,
+                       ctx_limit: int):
+    """KV write policy for the batched speculative verify forward over a
+    block pool — ``paged_decode_write`` generalized to T tokens per slot.
+
+    Window token t of slot s lands at
+    ``pool[tables[s, (positions[s]+t)//bt], :, (positions[s]+t)%bt]``.
+    Rows at or past ``ctx_limit`` (the runner's max_ctx) redirect to the
+    trash block: near the context edge a window row beyond the last real
+    position must never wrap onto the slot's own earlier rows via the
+    clamped block index. Inactive/mid-prefill slots' device table rows
+    are all-zeros, so their static-shape writes land in trash exactly
+    like decode. Exposes the gathered logical context [S, H, MB*bt, hd]
+    so window tokens attend over the prefix + the window so far.
+
+    Rollback is a per-slot position rollback only: the rejected tail's
+    rows (values AND int8 scale rows — they ride the same scatter) stay
+    as garbage inside the slot's reserved speculation blocks and are
+    overwritten by the next window/decode write before anything can
+    attend to them."""
+
+    def write(layer_kv, k_new, v_new):  # k_new [S, T, H, hd]
+        dt = k_new.dtype
+        bt = layer_kv[0].shape[2]
+        MB = tables.shape[1]
+        S, T = k_new.shape[0], k_new.shape[1]
+        s = jnp.arange(S)[:, None]
+        pmat = positions[:, None] + jnp.arange(T)[None, :]   # [S, T]
+        safe = pmat < ctx_limit
+        blk = jnp.where(
+            safe, tables[s, jnp.minimum(pmat // bt, MB - 1)], 0)
+        off = pmat % bt
+        if len(layer_kv) == 4:  # scaled int8 pool
+            k_layer, v_layer, ks_layer, vs_layer = layer_kv
+            kq, ks = _quant_chunk(k_new)          # [S, T, H, hd], [S, T, H]
+            vq, vs = _quant_chunk(v_new)
+            new_k = k_layer.at[blk, :, off].set(kq)
+            new_v = v_layer.at[blk, :, off].set(vq)
+            new_ks = ks_layer.at[blk, :, off].set(ks)
+            new_vs = vs_layer.at[blk, :, off].set(vs)
+            keys = (gather_blocks(new_k, tables).astype(dt)
+                    * gather_block_scales(new_ks, tables)[..., None]
+                    .astype(dt))
+            values = (gather_blocks(new_v, tables).astype(dt)
+                      * gather_block_scales(new_vs, tables)[..., None]
+                      .astype(dt))
+            return (new_k, new_v, new_ks, new_vs), keys, values
+        k_layer, v_layer = layer_kv               # [N, H, bt, hd]
+        kdt = k_layer.dtype
+        new_k = k_layer.at[blk, :, off].set(k_new.astype(kdt))
+        new_v = v_layer.at[blk, :, off].set(v_new.astype(kdt))
+        return ((new_k, new_v), gather_blocks(new_k, tables).astype(dt),
+                gather_blocks(new_v, tables).astype(dt))
+
+    return write
+
+
+def verify_mask(cfg: LlamaConfig, positions: jax.Array, T: int,
+                max_ctx: int) -> jax.Array:
+    """[S, T, C] mask for the speculative verify forward: window token t
+    (absolute position positions[s]+t) attends causally over the slot's
+    prefix + the window so far."""
+    c = jnp.arange(max_ctx)[None, None, :]
+    pos = positions[:, None, None] + jnp.arange(T)[None, :, None]
+    m = c <= pos
+    if cfg.sliding_window:
+        m &= c > pos - cfg.sliding_window
+    return m
+
+
 def decode_write(positions: jax.Array, raw: bool = False):
     """KV write policy for batched single-token decode.
 

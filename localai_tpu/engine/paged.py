@@ -69,6 +69,7 @@ class BlockStats:
     cached: int         # prefix-pool blocks reclaimable on demand
     used: int           # referenced by at least one live sequence
     high_watermark: int  # max concurrently-used blocks since init
+    spec_reserved: int = 0  # blocks held purely for speculative lookahead
 
     @property
     def available(self) -> int:
@@ -98,6 +99,13 @@ class BlockAllocator:
         self.tables: dict[int, list[int]] = {}
         # how many leading blocks of each table are shared (read-only)
         self.shared_blocks: dict[int, int] = {}
+        # speculation reservation: trailing blocks of a table held ONLY so
+        # a draft window can overshoot the decode frontier (localai_tpu.
+        # spec). Rollback is a runner-side position rollback — the blocks
+        # stay reserved for the slot's lifetime and never enter the
+        # prefix pool (register_prefix is prompt-keyed), so rejection
+        # can't leak or share a speculation row.
+        self.spec_blocks: dict[int, int] = {}
         # prefix pool: chain-hash of covered tokens -> block id, LRU order
         self._prefix: "OrderedDict[str, int]" = OrderedDict()
         self._block_key: dict[int, str] = {}
@@ -191,16 +199,23 @@ class BlockAllocator:
     # -- allocate / release ----------------------------------------------
 
     def allocate(self, seq: int, tokens: int,
-                 prompt: Optional[list[int]] = None) -> Optional[int]:
-        """Build ``seq``'s block table covering ``tokens`` rows, sharing
-        pool-cached prompt prefix blocks where possible. Returns the
-        shared-token count, or None when the pool cannot cover the
-        reservation (the caller queues the request). ``seq`` must not
-        already hold a table."""
+                 prompt: Optional[list[int]] = None,
+                 spec_tokens: int = 0) -> Optional[int]:
+        """Build ``seq``'s block table covering ``tokens + spec_tokens``
+        rows, sharing pool-cached prompt prefix blocks where possible.
+        ``spec_tokens`` extra rows are the slot's speculative-decoding
+        lookahead (a draft window writes up to gamma rows past the decode
+        frontier); the blocks they add beyond the base reservation are
+        recorded as speculation blocks — pure reservation, audited by
+        :meth:`check_invariants`, freed with the table at release.
+        Returns the shared-token count, or None when the pool cannot
+        cover the reservation (the caller queues the request). ``seq``
+        must not already hold a table."""
         if _faults.ACTIVE and _faults.apply("paged.allocate",
                                             key=str(seq)) is not None:
             return None  # injected exhaustion: report the pool full
-        nb = self.blocks_for(tokens)
+        nb = self.blocks_for(tokens + spec_tokens)
+        nb_spec = nb - self.blocks_for(tokens)
         shared = self.match_prefix(prompt) if prompt else []
         shared = shared[: max(0, nb - 1)]  # at least one writable block
         with self._lock:
@@ -230,24 +245,39 @@ class BlockAllocator:
                 self._ref[bid] = 1
             self.tables[seq] = shared + fresh
             self.shared_blocks[seq] = len(shared)
+            if nb_spec:
+                self.spec_blocks[seq] = nb_spec
             used = self.num_blocks - 1 - len(self._free) - self._reclaimable()
             self._watermark = max(self._watermark, used)
         n_shared = len(shared) * self.block_tokens
         self.shared_tokens_total += n_shared
         return n_shared
 
-    def extend(self, seq: int, tokens: int) -> bool:
-        """Grow ``seq``'s existing table to cover ``tokens`` rows (used when
-        an admission resumes past disk-loaded rows). False on exhaustion."""
+    def extend(self, seq: int, tokens: int, spec_tokens: int = 0) -> bool:
+        """Grow ``seq``'s existing table to cover ``tokens + spec_tokens``
+        rows (used when an admission resumes past disk-loaded rows);
+        ``spec_tokens`` records the speculative lookahead exactly like
+        :meth:`allocate`. False on exhaustion."""
         with self._lock:
             table = self.tables.get(seq)
             if table is None:
                 return False
-            need = self.blocks_for(tokens) - len(table)
+            nb = self.blocks_for(tokens + spec_tokens)
+            nb_spec = nb - self.blocks_for(tokens)
+            need = nb - len(table)
             if need <= 0:
+                # the retained table already covers the reservation and
+                # any lookahead: there is no distinct speculation tail to
+                # account (recording one would make check_invariants
+                # audit unrelated old tail blocks)
+                self.spec_blocks.pop(seq, None)
                 return True
             if need > len(self._free) + self._reclaimable():
-                return False
+                return False  # nothing recorded — nothing was reserved
+            if nb_spec:
+                self.spec_blocks[seq] = nb_spec
+            else:
+                self.spec_blocks.pop(seq, None)
             for _ in range(need):
                 if not self._free:
                     evicted = self._evict_one()
@@ -264,6 +294,7 @@ class BlockAllocator:
         with self._lock:
             table = self.tables.pop(seq, None)
             self.shared_blocks.pop(seq, None)
+            self.spec_blocks.pop(seq, None)
             if table is None:
                 return
             for bid in table:
@@ -299,6 +330,7 @@ class BlockAllocator:
                 cached=cached,
                 used=total - free - cached,
                 high_watermark=self._watermark,
+                spec_reserved=sum(self.spec_blocks.values()),
             )
 
     def check_invariants(self) -> list[str]:
@@ -351,6 +383,26 @@ class BlockAllocator:
                             f"seq {seq} {'shared ' if i < shared else ''}"
                             f"block {bid} refcount {int(self._ref[bid])} "
                             f"< {want}")
+            for seq, nspec in self.spec_blocks.items():
+                table = self.tables.get(seq)
+                if table is None:
+                    problems.append(
+                        f"seq {seq} holds a speculation reservation "
+                        f"({nspec} blocks) but no table")
+                    continue
+                if nspec < 0 or nspec > len(table):
+                    problems.append(
+                        f"seq {seq} speculation reservation {nspec} "
+                        f"outside its table of {len(table)} blocks")
+                    continue
+                # speculation blocks are the table TAIL and must never be
+                # shared through the prefix pool (a rejected draft row in
+                # a shared block would poison every sharer)
+                for bid in table[len(table) - nspec:]:
+                    if bid in self._block_key:
+                        problems.append(
+                            f"seq {seq} speculation block {bid} leaked "
+                            "into the prefix pool")
             for key, bid in self._prefix.items():
                 if int(self._ref[bid]) < 1:
                     problems.append(

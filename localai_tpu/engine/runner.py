@@ -47,6 +47,11 @@ log = logging.getLogger(__name__)
 # scheduler fails the affected request and quarantines the slot.
 NAN_TOKEN = -2
 
+# speculative-window sentinel in emitted [T, S] rows: no token for this
+# (step, slot) — the slot's window ended at an earlier rejection (or the
+# slot is inactive). Consumers (scheduler._process_rows) skip it.
+SKIP = -1
+
 
 def _prompt_counts_row(vocab_size: int, prompt) -> np.ndarray:
     """[V] i32 bincount of the FULL prompt for resume-style prefills (the
@@ -311,6 +316,12 @@ class ModelRunner:
             self._decode_frozen_n_fn, static_argnames=("n",),
             donate_argnums=(1, 2),
         ), "decode_frozen_n")
+        # speculative verify (localai_tpu.spec): one batched T-wide target
+        # forward scores a whole draft window per dispatch. One program per
+        # gamma (the window width is baked into the proposals shape).
+        self._verify = obs_compile.watch(
+            jax.jit(self._verify_fn, donate_argnums=(1, 2)), "verify"
+        )
         self._prefill = obs_compile.watch(jax.jit(
             self._prefill_fn, static_argnames=("bucket",), donate_argnums=(1, 2)
         ), "prefill")
@@ -337,6 +348,9 @@ class ModelRunner:
                 self._decode_paged_frozen_n_fn, static_argnames=("n",),
                 donate_argnums=(1, 2),
             ), "decode_frozen_n")
+            self._verify_paged = obs_compile.watch(
+                jax.jit(self._verify_paged_fn, donate_argnums=(1, 2)),
+                "verify")
             self._prefill_paged = obs_compile.watch(jax.jit(
                 self._prefill_paged_fn,
                 static_argnames=("bucket", "sample"),
@@ -555,6 +569,123 @@ class ModelRunner:
             state, tokens=tokens, positions=positions, keys=keys, counts=counts
         )
         return new_state, tokens
+
+    # -- speculative verify programs (localai_tpu.spec drives these) -----
+
+    def _accept_scan(self, state: DecodeState, logits, proposals):
+        """Accept/sample scan over a speculative window: the full sampler
+        chain per position with sequentially-updated counts, so emitted
+        tokens follow the exact non-speculative sampling distribution
+        (naive-match acceptance: a draft token is accepted iff it equals
+        the token the target itself sampled; on mismatch the target's
+        sample is the correction and the window ends). PRNG keys advance
+        once per EMITTED token, preserving the seeded-stream contract.
+
+        logits [S, T, V], proposals [S, T-1]. Returns (new_state,
+        emitted [T, S]) where SKIP marks positions past a slot's
+        accepted window; positions roll forward by exactly the emitted
+        count — the rejected tail is rolled back for every slot
+        independently."""
+        S = self.num_slots
+        G = proposals.shape[1]
+        T = G + 1
+
+        def acc_body(carry, xs):
+            counts, keys, still, n_emit, final_tok = carry
+            logits_t, draft_t, t = xs  # [S, V], [S], scalar
+            tok, new_keys = smp.sample(
+                logits_t, state.params, counts, keys, state.bias
+            )
+            # per-row NaN/inf guard, same contract as _decode_tail: a
+            # non-finite effective logits row reports the NAN_TOKEN
+            # sentinel instead of a sample (and ends the slot's window —
+            # the sentinel can never equal a draft token), so the
+            # scheduler fails ONLY that request. Speculation is the
+            # default lane; skipping the guard here would reopen the
+            # silent-poison class the plain path closed.
+            row_ok = jnp.all(
+                jnp.isfinite(logits_t.astype(jnp.float32) + state.bias),
+                axis=-1)
+            tok = jnp.where(row_ok, tok, NAN_TOKEN)
+            emit_now = still & state.active
+            keys = jnp.where(emit_now, new_keys, keys)
+            # clamp the sentinel out of the scatter index (the slot is
+            # dead either way; a wrapped negative index would dirty a
+            # real count) — mirrors _decode_tail
+            counts = counts.at[jnp.arange(S), jnp.maximum(tok, 0)].add(
+                emit_now.astype(counts.dtype)
+            )
+            final_tok = jnp.where(emit_now, tok, final_tok)
+            n_emit = n_emit + emit_now.astype(jnp.int32)
+            is_match = emit_now & (t < G) & (tok == draft_t)
+            emitted_t = jnp.where(emit_now, tok, SKIP)
+            return (counts, keys, is_match, n_emit, final_tok), emitted_t
+
+        init = (
+            state.counts,
+            state.keys,
+            jnp.ones(S, jnp.bool_),
+            jnp.zeros(S, jnp.int32),
+            state.tokens,
+        )
+        draft_padded = jnp.concatenate(
+            [proposals, jnp.full((S, 1), SKIP, jnp.int32)], axis=1
+        )
+        (counts, keys, _, n_emit, final_tok), emitted = jax.lax.scan(
+            acc_body, init,
+            (logits.transpose(1, 0, 2), draft_padded.T, jnp.arange(T)),
+        )  # emitted [T, S]
+        new_pos = jnp.minimum(state.positions + n_emit, self.max_ctx - 1)
+        new_state = dataclasses.replace(
+            state, tokens=final_tok, positions=new_pos, keys=keys,
+            counts=counts,
+        )
+        return new_state, emitted
+
+    def _verify_fn(self, params, kv: KVCache, state: DecodeState,
+                   proposals):
+        """One speculative verify dispatch over the contiguous cache: a
+        T=gamma+1-wide batched forward scores every draft position at each
+        slot's frontier (positions offset per slot — decode generalized to
+        T tokens), then the accept/sample scan emits the accepted prefix +
+        correction. proposals [S, gamma] i32; returns emitted [T, S]."""
+        cfg = self.cfg
+        T = proposals.shape[1] + 1
+        p0 = state.positions
+        positions = p0[:, None] + jnp.arange(T)[None, :]     # [S, T]
+        tokens = jnp.concatenate(
+            [state.tokens[:, None], proposals], axis=1)      # [S, T]
+        mask = kvc.verify_mask(cfg, p0, T, self.max_ctx)
+        write = kvc.verify_write(p0)
+        hidden, new_stack = self._forward(
+            params, tokens, positions, write, kv.stacked(), mask,
+        )
+        logits = mdl.logits_from_hidden(cfg, params, hidden)  # [S, T, V]
+        new_state, emitted = self._accept_scan(state, logits, proposals)
+        return KVCache.from_stacked(new_stack), new_state, emitted
+
+    def _verify_paged_fn(self, params, kv: kvc.PagedKVCache,
+                         state: DecodeState, tables, proposals):
+        """Paged twin of _verify_fn: draft rows scatter through the block
+        tables into each slot's reserved speculation blocks, window tokens
+        attend resume-style over the gathered prefix + window, and the
+        accept scan rolls every slot's frontier back independently — the
+        rejected tail is a per-slot position rollback, never a table
+        mutation (co-batched slots are untouched by construction)."""
+        cfg = self.cfg
+        T = proposals.shape[1] + 1
+        p0 = state.positions
+        positions = p0[:, None] + jnp.arange(T)[None, :]     # [S, T]
+        tokens = jnp.concatenate(
+            [state.tokens[:, None], proposals], axis=1)      # [S, T]
+        mask = kvc.verify_mask(cfg, p0, T, self.ctx_pad)
+        write = kvc.paged_verify_write(tables, p0, self.max_ctx)
+        hidden, new_stack = self._forward(
+            params, tokens, positions, write, kv.stacked(), mask,
+        )
+        logits = mdl.logits_from_hidden(cfg, params, hidden)  # [S, T, V]
+        new_state, emitted = self._accept_scan(state, logits, proposals)
+        return kvc.PagedKVCache.from_stacked(new_stack), new_state, emitted
 
     def _decode_n_fn(self, params, kv: KVCache, state: DecodeState, *, n: int):
         """n decode steps in ONE dispatch via lax.scan — amortizes host→device
@@ -1093,6 +1224,10 @@ class ModelRunner:
         reserve_tokens: Optional[int] = None,       # paged mode: worst-case
                                                     # rows (prompt + max_new)
                                                     # to reserve; None → max_ctx
+        spec_tokens: int = 0,                       # paged mode: extra
+                                                    # speculation-lookahead rows
+                                                    # (localai_tpu.spec);
+                                                    # ignored contiguous
     ) -> int:
         """Prefill a prompt into a slot; returns the first sampled token.
 
@@ -1112,6 +1247,7 @@ class ModelRunner:
             adm = self.begin_admit(
                 slot, prompt,
                 reserve_tokens=reserve_tokens,
+                spec_tokens=spec_tokens,
                 resident=resident, valid_n=valid_n,
                 mm_embeds=mm_embeds, mm_positions=mm_positions,
                 temperature=temperature, top_k=top_k, top_p=top_p,
@@ -1229,6 +1365,7 @@ class ModelRunner:
     def begin_admit(
         self, slot: int, prompt: list[int], *,
         reserve_tokens: Optional[int] = None,
+        spec_tokens: int = 0,
         resident: Optional[list[int]] = None,
         valid_n: Optional[int] = None,
         mm_embeds=None, mm_positions=None,
@@ -1239,8 +1376,12 @@ class ModelRunner:
         state, and return a PagedAdmission whose ``step_chunk()`` the
         caller drives — interleaving chunk dispatches with decode
         dispatches so one long prompt never stalls other slots' TPOT.
-        Returns None when the pool cannot cover the reservation (the
-        scheduler keeps the request queued)."""
+        ``spec_tokens`` reserves extra speculation rows past the decode
+        worst case (a draft window writes up to gamma rows beyond the
+        frontier; see localai_tpu.spec) — recorded separately by the
+        allocator so rollback accounting is auditable. Returns None when
+        the pool cannot cover the reservation (the scheduler keeps the
+        request queued)."""
         assert self.paged, "begin_admit requires a paged runner"
         if not prompt:
             prompt = [0]
@@ -1250,14 +1391,20 @@ class ModelRunner:
                 f"prompt ({n} tokens) exceeds context {self.max_ctx}")
         reserve = min(self.max_ctx, max(n + 1, reserve_tokens
                                         or self.max_ctx))
-        if self.allocator.blocks_for(reserve) > self.allocator.num_blocks - 1:
+        # the speculation lookahead never needs rows past max_ctx (the
+        # write policy trash-redirects there and the scheduler gates
+        # windows off near the edge)
+        spec_tokens = max(0, min(int(spec_tokens), self.max_ctx - reserve))
+        if self.allocator.blocks_for(
+                reserve + spec_tokens) > self.allocator.num_blocks - 1:
             # can NEVER fit, even with an empty pool (overcommitted
             # LOCALAI_KV_BLOCKS): reject like the prompt-exceeds-context
             # check — holding it would head-of-line block admission forever
             raise ValueError(
-                f"reservation of {reserve} tokens "
-                f"({self.allocator.blocks_for(reserve)} blocks) exceeds the "
-                f"block pool ({self.allocator.num_blocks - 1} blocks); "
+                f"reservation of {reserve + spec_tokens} tokens "
+                f"({self.allocator.blocks_for(reserve + spec_tokens)} "
+                f"blocks) exceeds the block pool "
+                f"({self.allocator.num_blocks - 1} blocks); "
                 "lower max_new_tokens or raise LOCALAI_KV_BLOCKS")
         mm = mm_embeds is not None and len(mm_embeds) > 0
         lcp = 0
@@ -1267,7 +1414,8 @@ class ModelRunner:
             # covers everything else
             lcp = self.reusable_prefix(slot, resident, prompt, valid_n)
         if lcp:
-            if not self.allocator.extend(slot, reserve):
+            if not self.allocator.extend(slot, reserve,
+                                         spec_tokens=spec_tokens):
                 self.allocator.release(slot)
                 self._loaded_rows.pop(slot, None)
                 return None
@@ -1277,7 +1425,8 @@ class ModelRunner:
                 self.allocator.release(slot)
             self._loaded_rows.pop(slot, None)
             shared = self.allocator.allocate(
-                slot, reserve, prompt=None if mm else prompt)
+                slot, reserve, prompt=None if mm else prompt,
+                spec_tokens=spec_tokens)
             if shared is None:
                 return None
             lcp = shared
@@ -1392,6 +1541,26 @@ class ModelRunner:
             self.params, self.kv, self.state
         )
         return tokens
+
+    def verify_async(self, proposals) -> jax.Array:
+        """One speculative verify dispatch over all slots: score the
+        [S, gamma] draft ``proposals`` with a single gamma+1-wide target
+        forward, accept/sample on device, and return the [gamma+1, S]
+        emitted-token device array (SKIP = nothing for that step/slot).
+        Works on both KV layouts; the paged variant writes the window
+        through the block-table mirror and rolls rejected tails back
+        per slot. No host sync — callers overlap the read."""
+        proposals = jnp.asarray(proposals, jnp.int32)
+        if self.paged:
+            self.kv, self.state, emitted = self._verify_paged(
+                self.params, self.kv, self.state, self.block_tables,
+                proposals,
+            )
+            return emitted
+        self.kv, self.state, emitted = self._verify(
+            self.params, self.kv, self.state, proposals
+        )
+        return emitted
 
     def step_n(self, n: int) -> np.ndarray:
         """n decode iterations in one dispatch; returns tokens [n, S].

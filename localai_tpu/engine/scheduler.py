@@ -258,15 +258,24 @@ class Scheduler:
         self.watchdog.add_context(
             f"flight:{self._wd_channel}", self._flight_forensics
         )
-        # speculative decoding (engine.speculative.SpecDecoder): when set and
+        # speculative decoding (localai_tpu.spec.SpecEngine): when set and
         # no grammar constraint is active, dispatches run draft+verify
-        # windows instead of plain multi-step decode. Slot lifecycle ops
-        # route through the spec decoder so the draft's state mirrors the
-        # target's. After any non-speculative dispatch the drafts are stale
-        # (missing KV for the plainly-decoded tokens) — _spec_dirty forces a
-        # per-slot draft resync before the next window.
+        # windows instead of plain multi-step decode — on BOTH KV layouts
+        # (the paged verify writes through the block-table mirror into
+        # speculation blocks reserved at admission). Slot lifecycle ops
+        # route through the spec engine so the drafter's state mirrors the
+        # target's. After any non-speculative dispatch (or a chunked
+        # admission, which bypasses spec.admit) the drafts are stale —
+        # _spec_dirty forces a per-slot resync before the next window. A
+        # drafter may decline a window (self-drafting with no lookup hit
+        # anywhere): that dispatch falls back to plain multi-step decode.
         self.spec = spec
         self._spec_dirty = False
+        # slots admitted through the chunked path whose drafter seeding
+        # is pending — resynced individually (a full-batch resync per
+        # admission would cost O(slots) draft prefills for model
+        # drafters)
+        self._spec_stale: set[int] = set()
         self._engine = spec if spec is not None else runner
         # disk prompt-KV persistence (engine.promptcache): looked up when the
         # in-memory resident record can't cover the prompt; finished slots
@@ -306,9 +315,9 @@ class Scheduler:
         # chunked prefill (paged runners): admissions queue their prompt
         # chunks here and the engine loop interleaves ONE chunk per
         # iteration with decode dispatches, so a long prompt never stalls
-        # other slots' TPOT. Spec engines keep the contiguous one-shot
-        # admit (SpecDecoder rejects paged runners at construction).
-        self._chunked = bool(getattr(runner, "paged", False)) and spec is None
+        # other slots' TPOT. Paged spec engines chunk too — the drafter
+        # is seeded from the resident record once the final chunk lands.
+        self._chunked = bool(getattr(runner, "paged", False))
         self._prefills: "deque[_PendingPrefill]" = deque()
         self.total_prefill_chunks = 0
         # a request the paged block pool couldn't cover yet: admission is
@@ -499,6 +508,7 @@ class Scheduler:
                 "kv_blocks_used": st.used,
                 "kv_blocks_cached": st.cached,
                 "kv_block_watermark": st.high_watermark,
+                "kv_blocks_spec_reserved": st.spec_reserved,
                 "kv_overcommit_ratio": getattr(
                     self.runner, "kv_overcommit", 1.0),
                 "kv_shared_tokens": alloc.shared_tokens_total,
@@ -538,7 +548,14 @@ class Scheduler:
             ),
             **(
                 {"spec_acceptance_rate": self.spec.acceptance_rate,
-                 "spec_windows": self.spec.total_windows}
+                 "spec_windows": self.spec.total_windows,
+                 "spec_accept_rate": self.spec.accept_rate,
+                 "spec_draft_tokens": self.spec.total_proposed,
+                 "spec_accepted_tokens": self.spec.total_accepted,
+                 "spec_tokens_per_dispatch": self.spec.tokens_per_dispatch,
+                 "spec_suppressed": self.spec.total_suppressed,
+                 "spec_drafter": self.spec.drafter.name,
+                 "spec_gamma": self.spec.gamma}
                 if self.spec is not None else {}
             ),
         }
@@ -578,13 +595,16 @@ class Scheduler:
                 log.warning("prompt-cache store failed: %s", e)
 
     def _flight_record(self, program: str, steps: int, dt: float,
-                       fresh: bool) -> None:  # jaxlint: disable=lock-guarded-attr
+                       fresh: bool, spec_proposed: int = 0,
+                       spec_accepted: int = 0,
+                       ) -> None:  # jaxlint: disable=lock-guarded-attr
         """One flight-ring record at a drain point. Everything here is a
         host mirror this (engine) thread already owns — ``_slots`` is only
         mutated on this thread, token counts come from ``_consume`` — so
         the cost is a handful of scalar reads plus one in-place ring row
         write. Called AFTER ``_process_rows`` so occupancy/tokens reflect
-        end-of-dispatch state."""
+        end-of-dispatch state. ``spec_proposed``/``spec_accepted`` are
+        THIS dispatch's draft counts (speculative windows only)."""
         emitted = self._tokens_emitted
         num_slots = self.runner.num_slots
         batch_slots = sum(
@@ -603,6 +623,8 @@ class Scheduler:
             preemptions=self.total_preemptions,
             spec_accept=(self.spec.acceptance_rate
                          if self.spec is not None else None),
+            spec_proposed=spec_proposed,
+            spec_accepted=spec_accepted,
             compile=fresh,
         )
         self._flight_mark = emitted
@@ -675,9 +697,11 @@ class Scheduler:
         the engine structures — the same single-owner-thread design the
         engine loop itself uses (``_lock`` still guards the cross-thread
         ``_slots`` views)."""
-        if self.spec is not None:
+        if self.spec is not None and not getattr(
+                self.spec, "supports_rebuild", False):
             raise RuntimeError(
-                "engine rebuild is not supported with speculative decoding")
+                "engine rebuild is not supported with this speculative "
+                "engine")
         if self._stopping:
             raise RuntimeError("scheduler is shutting down")
         self._epoch += 1
@@ -700,12 +724,17 @@ class Scheduler:
         self._resident.clear()
         self._quarantined.clear()
         self._spec_dirty = False
+        self._spec_stale.clear()
         self._last_drain_t = None
         # the fenced thread never exits its wedged guard, so its arm()
         # has no disarm(): drop the channel or the leaked armed count
         # fires a spurious stall (and rebuild) every idle gap forever
         self.watchdog.reset(self._wd_channel)
         self.runner.reinit()
+        if self.spec is not None:
+            # the drafter's device/host state referenced the old pool —
+            # reset it alongside (SpecEngine.reinit)
+            self.spec.reinit()
         self._probe(probe_timeout)
         self.rebuilds += 1
         self._thread = threading.Thread(
@@ -867,8 +896,9 @@ class Scheduler:
                 # the round-trip above — the state is no longer ours
                 raise _EngineAbandoned
             now = time.monotonic()
+            window = None
             if k == 0 and self.spec is not None:  # speculative window
-                self.spec.observe_window(rows)
+                window = self.spec.observe_window(rows)
             # per-token timing for the adaptive streaming dispatch size:
             # when this dispatch was issued while another was still on the
             # device, the interval between drains is pure device time for
@@ -879,22 +909,37 @@ class Scheduler:
                 dt = now - self._last_drain_t
             else:
                 dt = now - t_issue
-            if not fresh and k > 0:
-                self._observe_step_time(dt / k)
+            # a spec window's effective step count is its measured yield:
+            # mean emitted tokens per active slot-window this dispatch.
+            # With speculation the default lane, these dispatches feed
+            # the step-time percentiles and the EMA like any other —
+            # excluding them would blind the timeline to the hot path.
+            k_eff = k
+            if window is not None:
+                k_eff = (max(1, round(window["emitted"]
+                                      / window["windows"]))
+                         if window["windows"] else 0)
+            if not fresh and k_eff > 0:
+                self._observe_step_time(dt / k_eff)
                 # measured per-dispatch latency feeds the compiled-program
                 # cost catalog (achieved-vs-roofline at /debug/programs)
                 obs_compile.note_latency(
-                    "decode_n" if k > 1 else "decode", dt, steps=k)
+                    "verify" if k == 0
+                    else "decode_n" if k > 1 else "decode",
+                    dt, steps=k_eff)
             self._last_drain_t = now
             if rows.ndim == 1:
                 rows = rows[None]
             self._process_rows(rows, seq)
-            # flight ring: spec windows record as steps=0 (variable token
-            # yield — excluded from step-time percentiles, their tokens
-            # still counted); compile-bearing dispatches are flagged
+            # flight ring: spec windows carry their yield as steps plus
+            # per-dispatch proposed/accepted counts (ROADMAP item 3:
+            # accept-rate in the flight ring); compile-bearing dispatches
+            # are flagged
             self._flight_record(
                 "spec" if k == 0 else ("decode_n" if k > 1 else "decode"),
-                k, dt, fresh,
+                k_eff, dt, fresh,
+                spec_proposed=window["proposed"] if window else 0,
+                spec_accepted=window["accepted"] if window else 0,
             )
 
         while not self._stopping and self._epoch == epoch:
@@ -973,29 +1018,51 @@ class Scheduler:
                         self._flight_record(
                             "decode_frozen_n", steps, dt, fresh)
                     self._last_drain_t = None  # sync path: drain clock stale
-                elif (self.spec is not None and self._spec_dirty
-                        and inflight):
-                    # a resync must see the COMPLETE resident record — drain
-                    # the in-flight plain dispatches before rebuilding drafts
-                    drain_one()
-                    continue
-                elif self._spec_usable():
-                    self._dispatch_seq += 1
-                    self._fresh_shape("spec")
-                    t_issue = time.monotonic()
-                    tokens = self.spec.step_spec_async()
-                    self.last_dispatch_steps = self.spec.gamma + 1
-                    try:
-                        tokens.copy_to_host_async()
-                    except AttributeError:
-                        pass
-                    # k=0 marks a spec window: rows carry SKIP sentinels and
-                    # contribute acceptance telemetry, not the step-time EMA
-                    inflight.append((tokens, self._dispatch_seq, 0,
-                                     bool(inflight), t_issue, True))
-                    if len(inflight) >= self.pipeline_depth:
-                        drain_one()
                 else:
+                    # cheap speculation pre-gate, BEFORE any drain or
+                    # resync: suppressed (acceptance backoff) or
+                    # no-candidate (n-gram lookup misses everywhere)
+                    # dispatches must cost exactly plain pipelined
+                    # decode — the whole drain/resync/propose sequence
+                    # is only worth paying when a window could land
+                    spec_ready = self._spec_ready()
+                    if spec_ready and self._spec_dirty and inflight:
+                        # a resync must see the COMPLETE resident record
+                        # — drain the in-flight plain dispatches before
+                        # rebuilding drafts
+                        drain_one()
+                        continue
+                    spec_rows = None
+                    if spec_ready and self._spec_usable():
+                        if not self.spec.pipeline_safe:
+                            # host drafters (n-gram lookup) propose from
+                            # drained history — the previous window must
+                            # be observed before the next proposal, so
+                            # spec dispatches serialize for them
+                            while inflight:
+                                drain_one()
+                            if not self._slots:
+                                continue
+                        t_issue = time.monotonic()
+                        # None = the drafter declined (no lookup hit
+                        # anywhere) — fall through to plain decode
+                        spec_rows = self.spec.step_spec_async()
+                    if spec_rows is not None:
+                        self._dispatch_seq += 1
+                        fresh = self._fresh_shape("spec")
+                        self.last_dispatch_steps = self.spec.gamma + 1
+                        try:
+                            spec_rows.copy_to_host_async()
+                        except AttributeError:
+                            pass
+                        # k=0 marks a spec window: rows carry SKIP
+                        # sentinels; the drain folds the real token yield
+                        # into the flight ring + step-time EMA
+                        inflight.append((spec_rows, self._dispatch_seq, 0,
+                                         bool(inflight), t_issue, fresh))
+                        if len(inflight) >= self.pipeline_depth:
+                            drain_one()
+                        continue
                     if self.spec is not None:
                         self._spec_dirty = True
                     steps = self._effective_steps()
@@ -1034,6 +1101,22 @@ class Scheduler:
                                             "error")
                     ctx.handle._finish("error")
 
+    def _spec_ready(self) -> bool:
+        """The cheap speculation pre-gate, run BEFORE any pipeline drain
+        or drafter resync: not backoff-suppressed, and the drafter has a
+        proposal candidate for at least one active slot (checked against
+        the live resident records — the same data a resync would seed).
+        Keeping this ahead of _spec_usable means no-structure traffic
+        keeps full plain-decode pipelining and suppressed cooldowns cost
+        nothing."""
+        if self.spec is None:
+            return False
+        if self.spec.suppressed_tick():
+            return False
+        with self._lock:
+            residents = {s: self._resident.get(s) for s in self._slots}
+        return self.spec.has_candidate(residents)
+
     def _spec_usable(self) -> bool:
         """Speculative windows require: a spec decoder, every active slot
         far enough from the context edge (a window writes gamma+1 KV rows),
@@ -1057,6 +1140,16 @@ class Scheduler:
             for s in slots:
                 self.spec.resync_draft(s, self._resident[s])
             self._spec_dirty = False
+            self._spec_stale.clear()
+        elif self._spec_stale:
+            # freshly admitted slots only — seed each one individually
+            if any(self._resident.get(s) is None
+                   for s in self._spec_stale if s in slots):
+                return False  # multimodal slot: no token record to seed
+            for s in list(self._spec_stale):
+                if s in slots:
+                    self.spec.resync_draft(s, self._resident[s])
+                self._spec_stale.discard(s)
         return True
 
     def _fresh_shape(self, key) -> bool:
@@ -1321,6 +1414,11 @@ class Scheduler:
         with self._lock:
             self._slots[slot] = ctx
             self.total_prompt_tokens += handle.prompt_tokens
+        if self.spec is not None and self._chunked:
+            # chunked paged admissions bypass spec.admit — mark THIS
+            # slot's draft stale so the drafter is seeded from the
+            # resident record before the next speculative window
+            self._spec_stale.add(slot)
         self._consume(slot, ctx, first)
 
     def _reservation_fits(self, req: GenRequest) -> bool:
@@ -1331,10 +1429,14 @@ class Scheduler:
         alloc = getattr(self.runner, "allocator", None)
         if alloc is None or not self._chunked:
             return True
+        # spec engines reserve a gamma+1 speculation lookahead on top of
+        # the decode worst case (begin_admit spec_tokens) — mirror it here
+        # or a full pool would loop begin_admit→None on every iteration
+        look = self.spec.gamma + 1 if self.spec is not None else 0
         reserve = min(
             self.runner.max_ctx,
             len(req.prompt) + (req.max_new_tokens
-                               or self.default_max_tokens) + 1,
+                               or self.default_max_tokens) + 1 + look,
         )
         need = alloc.blocks_for(reserve) - len(alloc.match_prefix(req.prompt))
         return alloc.stats().available >= need

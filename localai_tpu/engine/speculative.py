@@ -1,101 +1,40 @@
-"""Speculative decoding: draft-model propose, target-model verify.
+"""Compatibility shim over :mod:`localai_tpu.spec`.
 
-Parity surface: the reference plumbs ``DraftModel``/``NDraft`` through its
-config and proto (/root/reference/core/config/backend_config.go:143,
-backend/backend.proto:210) into llama.cpp's speculative sampling. The TPU
-redesign runs the whole window — draft scan, batched verify forward,
-sequential accept/sample scan — as ONE compiled program per window:
+The contiguous-only draft+verify window engine that used to live here
+was replaced by the block-native speculation subsystem
+(:class:`localai_tpu.spec.SpecEngine`): pluggable drafters behind one
+protocol (co-located draft model, self-drafting n-gram lookup), a
+verify-k batched target dispatch that works over BOTH KV layouts
+(contiguous slot rows and the paged block-table mirror), and per-slot
+accept/rollback inside the compiled program. One code path — this module
+only keeps the old import surface alive:
 
-  * the draft model decodes ``gamma+1`` greedy steps under ``lax.scan``
-    (the +1 step feeds the last proposal so the draft KV has no hole when
-    every token is accepted);
-  * the target runs ONE ``gamma+1``-wide batched forward over all slots
-    (positions offset per slot — a "verify" write policy scatters the chunk
-    KV at each slot's frontier, exactly like decode but T tokens at once);
-  * acceptance is a tiny ``lax.scan`` over the window positions running the
-    REAL sampler chain (bias + penalties + top-k/p + per-slot PRNG) on the
-    verify logits with counts updated sequentially — so emitted tokens are
-    drawn from exactly the distribution non-speculative decode would use
-    (naive-match acceptance: a draft token is accepted iff it equals the
-    token the target itself sampled; on mismatch the target's sample is the
-    correction). PRNG keys advance once per EMITTED token, preserving the
-    seeded-stream contract.
-
-KV rollback is free by construction: rejected positions hold garbage KV
-*above* each slot's decode frontier (positions[s]), which the attention
-masks never read and later writes overwrite — the same invariant the
-bucketed prefill paths rely on (engine/kvcache.py).
+* :data:`SKIP` — the emitted-row sentinel (now defined in engine.runner
+  next to NAN_TOKEN);
+* :func:`verify_write` / :func:`verify_mask` — the KV write policy and
+  mask (now in engine.kvcache with the other policies);
+* :class:`SpecDecoder` — a thin SpecEngine subclass pairing a target
+  with a draft ModelRunner, preserving the historical constructor and
+  the ``.draft`` attribute tests and callers use. Paged targets are
+  fully supported now (the PR 6 rejection is gone).
 """
 
 from __future__ import annotations
 
-import dataclasses
-import logging
-from typing import Optional
-
-import jax
-import jax.numpy as jnp
-import numpy as np
-
-from localai_tpu.engine import kvcache as kvc
-from localai_tpu.engine import sampling as smp
-from localai_tpu.engine.kvcache import KVCache
-from localai_tpu.engine.runner import DecodeState, ModelRunner
-from localai_tpu.models import llama as mdl
-
-log = logging.getLogger(__name__)
-
-SKIP = -1  # sentinel in emitted rows: no token for this (step, slot)
+from localai_tpu.engine.kvcache import verify_mask, verify_write  # noqa: F401
+from localai_tpu.engine.runner import SKIP, ModelRunner  # noqa: F401
+from localai_tpu.spec.drafter import ModelDrafter
+from localai_tpu.spec.engine import SpecEngine, build_spec_engine
 
 
-def verify_write(positions: jax.Array):
-    """KV write policy for the batched verify forward: writes the chunk
-    [S, T, H, hd] at cache[s, :, positions[s] + t] and exposes the full
-    per-layer cache as keys ([S, H, C, hd]) — decode_write generalized to
-    T tokens per slot."""
+class SpecDecoder(SpecEngine):
+    """Target + draft-model speculation (the historical constructor).
 
-    def write(layer_kv, k_new, v_new):
-        dt = k_new.dtype
-        S, T = k_new.shape[0], k_new.shape[1]
-        s = jnp.arange(S)[:, None]
-        pmat = positions[:, None] + jnp.arange(T)[None, :]  # [S, T]
-        if len(layer_kv) == 4:  # scaled int8 cache
-            k_layer, v_layer, ks_layer, vs_layer = layer_kv
-            kq, ks = kvc._quant_chunk(k_new)  # [S, T, H, hd], [S, T, H]
-            vq, vs = kvc._quant_chunk(v_new)
-            new_k = k_layer.at[s, :, pmat].set(kq)
-            new_v = v_layer.at[s, :, pmat].set(vq)
-            new_ks = ks_layer.at[s, :, pmat].set(ks)
-            new_vs = vs_layer.at[s, :, pmat].set(vs)
-            keys = new_k.astype(dt) * new_ks[..., None].astype(dt)
-            values = new_v.astype(dt) * new_vs[..., None].astype(dt)
-            return (new_k, new_v, new_ks, new_vs), keys, values
-        k_layer, v_layer = layer_kv
-        kdt = k_layer.dtype
-        new_k = k_layer.at[s, :, pmat].set(k_new.astype(kdt))
-        new_v = v_layer.at[s, :, pmat].set(v_new.astype(kdt))
-        return (new_k, new_v), new_k.astype(dt), new_v.astype(dt)
-
-    return write
-
-
-def verify_mask(cfg, positions: jax.Array, T: int, max_ctx: int) -> jax.Array:
-    """[S, T, C] mask: window token t (absolute position positions[s]+t)
-    attends causally over the slot's prefix + the window so far."""
-    c = jnp.arange(max_ctx)[None, None, :]
-    pos = positions[:, None, None] + jnp.arange(T)[None, :, None]
-    m = c <= pos
-    if cfg.sliding_window:
-        m &= c > pos - cfg.sliding_window
-    return m
-
-
-class SpecDecoder:
-    """Couples a target ModelRunner with a small draft model.
-
-    The scheduler drives it exactly like multi-step decode, except each
-    dispatch returns [gamma+1, S] token rows where SKIP (-1) marks
-    positions past a slot's accepted window."""
+    ``draft`` is a contiguous ModelRunner for the draft model; the
+    target may use either KV layout. The scheduler drives it exactly
+    like multi-step decode: each dispatch returns [gamma+1, S] token
+    rows where SKIP (-1) marks positions past a slot's accepted
+    window."""
 
     def __init__(self, target: ModelRunner, draft: ModelRunner,
                  gamma: int = 4):
@@ -107,261 +46,23 @@ class SpecDecoder:
             )
         if draft.num_slots != target.num_slots:
             raise ValueError("draft and target must have equal slot counts")
-        if getattr(target, "paged", False) or getattr(draft, "paged", False):
+        if getattr(draft, "paged", False):
             raise ValueError(
-                "speculative decoding requires contiguous KV caches "
-                "(build the runners with paged=False)")
-        self.target = target
-        self.draft = draft
-        self.gamma = int(gamma)
-        self.num_slots = target.num_slots
-        self.max_ctx = target.max_ctx
-        self.cfg = target.cfg
-        # accepted-token telemetry (window efficiency = emitted tokens per
-        # ACTIVE slot-window, over the gamma+1 ceiling)
-        self.total_emitted = 0
-        self.total_windows = 0
-        self.total_eligible = 0   # active slot-windows × (gamma+1)
-        self.last_prefix_reused = 0
-        from localai_tpu.obs import compile as obs_compile
-
-        self._spec = obs_compile.watch(
-            jax.jit(self._spec_fn, donate_argnums=(1, 2, 4, 5)),
-            "spec_window",
-        )
-
-    # -- jitted program ---------------------------------------------------
-
-    def _spec_fn(self, tparams, tkv: KVCache, tstate: DecodeState,
-                 dparams, dkv: KVCache, dstate: DecodeState):
-        gamma = self.gamma
-        T = gamma + 1
-
-        # 1) draft: gamma+1 greedy decode steps in a scan. The extra step
-        # writes the last proposal's KV (no hole on full acceptance); its
-        # sampled token is discarded.
-        def draft_body(carry, _):
-            kv, st = carry
-            kv, st, tok = self.draft._decode_fn(dparams, kv, st)
-            return (kv, st), tok
-
-        (dkv, dstate), draft_toks = jax.lax.scan(
-            draft_body, (dkv, dstate), None, length=T
-        )
-        proposals = draft_toks.T[:, :gamma]  # [S, gamma]
-
-        # 2) target: one batched T-wide verify forward at each slot frontier
-        cfg = self.cfg
-        p0 = tstate.positions
-        positions = p0[:, None] + jnp.arange(T)[None, :]     # [S, T]
-        tokens = jnp.concatenate(
-            [tstate.tokens[:, None], proposals], axis=1
-        )  # [S, T]
-        mask = verify_mask(cfg, p0, T, self.max_ctx)
-        write = verify_write(p0)
-        hidden, new_stack = mdl.forward(
-            cfg, tparams, tokens, positions, write, tkv.stacked(), mask,
-            self.target.rope,
-        )
-        logits = mdl.logits_from_hidden(cfg, tparams, hidden)  # [S, T, V]
-
-        # 3) accept/sample scan over the window: the full sampler chain per
-        # position with sequentially-updated counts — emitted tokens follow
-        # the exact non-speculative sampling distribution.
-        S = self.num_slots
-
-        def acc_body(carry, xs):
-            counts, keys, still, n_emit, final_tok = carry
-            logits_t, draft_t, t = xs  # [S, V], [S], scalar
-            tok, new_keys = smp.sample(
-                logits_t, tstate.params, counts, keys, tstate.bias
-            )
-            emit_now = still & tstate.active
-            # keys advance once per EMITTED token (seeded-stream contract,
-            # same pattern as ModelRunner._decode_fn's inactive-slot hold)
-            keys = jnp.where(emit_now, new_keys, keys)
-            counts = counts.at[jnp.arange(S), tok].add(
-                emit_now.astype(counts.dtype)
-            )
-            final_tok = jnp.where(emit_now, tok, final_tok)
-            n_emit = n_emit + emit_now.astype(jnp.int32)
-            is_match = emit_now & (t < gamma) & (tok == draft_t)
-            emitted_t = jnp.where(emit_now, tok, SKIP)
-            return (counts, keys, is_match, n_emit, final_tok), emitted_t
-
-        init = (
-            tstate.counts,
-            tstate.keys,
-            jnp.ones(S, jnp.bool_),
-            jnp.zeros(S, jnp.int32),
-            tstate.tokens,
-        )
-        draft_padded = jnp.concatenate(
-            [proposals, jnp.full((S, 1), SKIP, jnp.int32)], axis=1
-        )
-        (counts, keys, _, n_emit, final_tok), emitted = jax.lax.scan(
-            acc_body, init,
-            (logits.transpose(1, 0, 2), draft_padded.T, jnp.arange(T)),
-        )  # emitted [T, S]
-
-        new_pos = jnp.minimum(p0 + n_emit, self.max_ctx - 1)
-        tstate = dataclasses.replace(
-            tstate, tokens=final_tok, positions=new_pos, keys=keys,
-            counts=counts,
-        )
-        # 4) draft resync: roll its frontier back to the accepted length and
-        # feed it the corrected token next window
-        dstate = dataclasses.replace(
-            dstate, tokens=final_tok, positions=new_pos,
-        )
-        return (KVCache.from_stacked(new_stack), tstate,
-                dkv, dstate, emitted)
-
-    # -- host API ---------------------------------------------------------
-
-    def step_spec_async(self) -> jax.Array:
-        """One speculative window over all slots; returns the [gamma+1, S]
-        emitted-token device array (SKIP = nothing for that step/slot)."""
-        (self.target.kv, self.target.state,
-         self.draft.kv, self.draft.state, emitted) = self._spec(
-            self.target.params, self.target.kv, self.target.state,
-            self.draft.params, self.draft.kv, self.draft.state,
-        )
-        return emitted
-
-    def step_spec(self) -> np.ndarray:
-        # synchronous by contract (telemetry + tests); the scheduler's hot
-        # path uses step_spec_async + copy_to_host_async
-        rows = np.asarray(  # jaxlint: disable=host-sync-in-hot-path
-            self.step_spec_async()
-        )
-        self.observe_window(rows)
-        return rows
-
-    def observe_window(self, rows: np.ndarray) -> None:
-        """Fold one drained window into the acceptance telemetry. An active
-        slot always emits ≥1 token, so active columns are the ones with any
-        non-SKIP entry."""
-        self.total_windows += 1
-        emitted = (rows != SKIP).sum(axis=0)
-        self.total_emitted += int(emitted.sum())
-        self.total_eligible += int((emitted > 0).sum()) * (self.gamma + 1)
-
-    # -- slot lifecycle (scheduler-facing, mirrors ModelRunner) -----------
-
-    def admit(self, slot: int, prompt: list[int], **kw) -> int:
-        """Prefill both models; the target's first sampled token seeds both
-        token streams (the draft's own first sample is discarded)."""
-        first = self.target.admit(slot, prompt, **kw)
-        self.last_prefix_reused = self.target.last_prefix_reused
-        # draft: plain greedy prefill — no resident reuse, no multimodal
-        self.draft.admit(slot, prompt, temperature=0.0)
-        st = self.draft.state
-        self.draft.state = dataclasses.replace(
-            st,
-            tokens=st.tokens.at[slot].set(jnp.int32(first)),
-            positions=st.positions.at[slot].set(
-                self.target.state.positions[slot]
-            ),
-        )
-        return first
-
-    def resync_draft(self, slot: int, resident: list[int]) -> None:
-        """Rebuild one slot's draft KV after non-speculative dispatches
-        advanced the target without it (grammar-constrained interludes).
-        ``resident`` is the scheduler's prompt+generated token record; its
-        last element is the next token to feed."""
-        prompt = list(resident[:-1]) or [0]
-        self.draft.admit(slot, prompt, temperature=0.0)
-        st = self.draft.state
-        self.draft.state = dataclasses.replace(
-            st,
-            tokens=st.tokens.at[slot].set(jnp.int32(resident[-1])),
-            # device-side copy of the target's frontier — no host sync
-            positions=st.positions.at[slot].set(
-                self.target.state.positions[slot]
-            ),
-        )
-
-    def acquire_slot(self, slot: Optional[int] = None) -> Optional[int]:
-        got = self.target.acquire_slot(slot)
-        if got is not None:
-            self.draft.acquire_slot(got)
-        return got
-
-    def free_slots(self) -> list[int]:
-        return self.target.free_slots()
-
-    def release(self, slot: int) -> None:
-        self.target.release(slot)
-        self.draft.release(slot)
-
-    def set_bias(self, slot: int, bias_row) -> None:
-        self.target.set_bias(slot, bias_row)
-
-    def reusable_prefix(self, slot: int, resident, prompt,
-                        valid_n=None) -> int:
-        return self.target.reusable_prefix(slot, resident, prompt, valid_n)
-
-    def slot_positions(self) -> np.ndarray:
-        return self.target.slot_positions()
-
-    def slot_position(self, slot: int) -> int:
-        return self.target.slot_position(slot)
+                "the draft runner must be contiguous (its window scans "
+                "run over slot rows; build it with paged=False)")
+        super().__init__(target, ModelDrafter(draft, gamma), gamma=gamma)
 
     @property
-    def acceptance_rate(self) -> float:
-        """Emitted tokens per active slot-window / (gamma+1): 1.0 = every
-        window fully accepted for every active slot."""
-        if not self.total_eligible:
-            return 0.0
-        return self.total_emitted / self.total_eligible
-
-    def stats(self) -> dict:
-        """Window telemetry snapshot (obs /metrics + GetMetrics surface)."""
-        return {
-            "gamma": self.gamma,
-            "windows": self.total_windows,
-            "emitted": self.total_emitted,
-            "eligible": self.total_eligible,
-            "acceptance_rate": self.acceptance_rate,
-        }
+    def draft(self) -> ModelRunner:
+        return self.drafter.runner
 
 
 def build_spec_decoder(target: ModelRunner, draft_ref: str, *,
                        model_path="models", gamma: int = 4,
-                       dtype: str = "bfloat16") -> SpecDecoder:
-    """Resolve ``draft_ref`` and couple it to ``target`` (manager entry)."""
-    if getattr(target, "pp_enabled", False):
-        # the verify forward here calls mdl.forward directly — it would
-        # GSPMD over pipe-sharded stacked weights, all-gathering the full
-        # weight set per window (defeating capacity mode)
-        raise ValueError(
-            "speculative decoding is not supported with pipeline "
-            "parallelism")
-    if getattr(target, "ga_n", 1) > 1:
-        # self-extend targets carry an UNroped KV cache + identity rope
-        # table; the verify forward here would compute position-blind
-        # attention — reject rather than emit garbage
-        raise ValueError(
-            "speculative decoding is not supported with self-extend "
-            "(grp_attn_n > 1)")
-    from localai_tpu.models.registry import resolve_model
-
-    draft = resolve_model(draft_ref, model_path=model_path, dtype=dtype)
-    params = draft.params
-    if target.mesh is not None:
-        from localai_tpu.parallel import sharding as shd
-
-        params = shd.shard_params(params, draft.cfg, target.mesh)
-    runner = ModelRunner(
-        draft.cfg, params,
-        num_slots=target.num_slots,
-        max_ctx=target.max_ctx,
-        prefill_buckets=list(target.buckets[:-1]) or None,
-        kv_dtype=target.kv_dtype,
-        mesh=target.mesh,
-        # spec windows run contiguous slot-row KV programs on both caches
-        paged=False,
+                       dtype: str = "bfloat16") -> SpecEngine:
+    """Resolve ``draft_ref`` and couple it to ``target`` (legacy manager
+    entry — new callers use :func:`localai_tpu.spec.build_spec_engine`)."""
+    return build_spec_engine(
+        target, drafter="model", draft_ref=draft_ref,
+        model_path=model_path, gamma=gamma, dtype=dtype,
     )
-    return SpecDecoder(target, runner, gamma=gamma)

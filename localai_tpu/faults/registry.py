@@ -68,6 +68,11 @@ SITES = {
                   "id) — exercises the per-row NaN guard.",
     "paged.allocate": "BlockAllocator.allocate (key: seq/slot id). "
                       "exhaust = report the pool full.",
+    "spec.draft": "speculative drafter proposals (key: drafter name). "
+                  "garble (any non-raise mode) = replace every proposal "
+                  "with divergent garbage tokens (acceptance collapses; "
+                  "rollback + co-batched streams must stay byte-"
+                  "correct); raise = drafter failure mid-window.",
     "worker.stream": "per-reply inside PredictStream, worker gRPC and "
                      "in-process replicas alike (key: model/replica id). "
                      "raise = stream dies mid-flight; sleep = slow "

@@ -63,10 +63,13 @@ class EngineSupervisor:
                  backoff_cap_s: Optional[float] = None,
                  probe_timeout_s: Optional[float] = None,
                  registry: Optional[Registry] = None):
-        if scheduler.spec is not None:
+        if (scheduler.spec is not None
+                and not getattr(scheduler.spec, "supports_rebuild",
+                                False)):
             raise ValueError(
-                "speculative engines cannot be supervised (the draft "
-                "pair's device state is not independently rebuildable)")
+                "this speculative engine cannot be supervised (no "
+                "reinit() — its draft state is not independently "
+                "rebuildable; localai_tpu.spec.SpecEngine is)")
         self.scheduler = scheduler
         self.registry = registry or REGISTRY
         self.model = scheduler.telemetry.model or "engine"

@@ -389,10 +389,11 @@ def build_runner(mcfg: ModelConfig, app: AppConfig) -> tuple[Any, ModelRunner]:
         # meshed serving is the default hot path whenever >1 accelerator
         # is visible (pjit tensor-parallel, paged pool sharded over
         # 'model'); modes whose runners assume single-device layouts keep
-        # it off: multi-host command mirroring builds its own topology,
-        # speculative decoding drives a contiguous draft pair, and
-        # self-extend forces the unroped single-row cache
-        if not (app.mirror_port or eng.draft_model or eng.grp_attn_n > 1):
+        # it off: multi-host command mirroring builds its own topology
+        # and self-extend forces the unroped single-row cache.
+        # Speculative decoding composes now — the draft runner shares
+        # the target's mesh (localai_tpu.spec.ModelDrafter)
+        if not (app.mirror_port or eng.grp_attn_n > 1):
             mesh = _auto_mesh(model.cfg, eng.max_slots)
             if mesh is not None:
                 log.info("auto mesh for %s: %s", mcfg.name,
@@ -418,19 +419,20 @@ def build_runner(mcfg: ModelConfig, app: AppConfig) -> tuple[Any, ModelRunner]:
     ctx = min(ctx, model.cfg.max_position_embeddings * max(eng.grp_attn_n, 1))
     # paged KV (block pool + chunked prefill): the serving default for
     # single-device AND meshed engines alike (the pool shards its kv-head
-    # axis over 'model'; the table mirror rides 'data') — speculative
-    # decoding and multi-host mirroring still drive the contiguous
-    # layout, and the runner itself gates off pipeline-parallel/
-    # self-extend. Explicit per-model config wins; otherwise the
-    # compatibility decision applies and LOCALAI_KV_PAGED=0
+    # axis over 'model'; the table mirror rides 'data'). Speculative
+    # decoding runs block-native on this layout (localai_tpu.spec), so
+    # draft-model engines are paged too; only multi-host mirroring still
+    # drives the contiguous layout, and the runner itself gates off
+    # pipeline-parallel/self-extend. Explicit per-model config wins;
+    # otherwise the compatibility decision applies and LOCALAI_KV_PAGED=0
     # force-disables (=1 adds nothing here: auto already enables
-    # everything compatible, and overriding the draft/mirror exclusions
-    # would crash those engines at load).
+    # everything compatible, and overriding the mirror exclusion would
+    # crash that engine at load).
     paged = eng.kv_paged
     if paged is None:
         paged = ((mesh is None or mesh.shape.get("pipe", 1) == 1)
                  and eng.grp_attn_n <= 1
-                 and not eng.draft_model and not app.mirror_port
+                 and not app.mirror_port
                  and os.environ.get("LOCALAI_KV_PAGED", "") != "0")
     runner = ModelRunner(
         model.cfg,
@@ -478,35 +480,60 @@ def build_serving_model(mcfg: ModelConfig, app: AppConfig) -> ServingModel:
         if app.mirror_followers:
             leader.wait_for(app.mirror_followers)
         runner = MirroredRunner(runner, leader, mcfg.name)
+    # block-native speculative decoding (localai_tpu.spec): the default
+    # for paged engines — the self-drafting n-gram lane needs no second
+    # model, so single-model deployments get speculation out of the box;
+    # a configured draft_model upgrades the drafter to a co-located
+    # draft runner sharing the mesh. Contiguous engines opt in via
+    # draft_model (the legacy shape). Knobs: engine.spec/spec_drafter/
+    # spec_gamma, LOCALAI_SPEC=0 kill switch, LOCALAI_SPEC_DRAFTER /
+    # LOCALAI_SPEC_GAMMA / LOCALAI_SPEC_NGRAM_MAX env overrides.
     spec = None
-    if eng.draft_model and app.mirror_port:
+    spec_want = eng.spec
+    if spec_want is None:
+        spec_want = ((getattr(runner, "paged", False)
+                      or bool(eng.draft_model))
+                     and os.environ.get("LOCALAI_SPEC", "") != "0")
+    if spec_want and app.mirror_port:
         log.warning(
-            "%s: draft_model is not supported with multi-host command "
-            "mirroring yet; serving without speculative decoding", mcfg.name
+            "%s: speculative decoding is not supported with multi-host "
+            "command mirroring yet; serving without it", mcfg.name
         )
-    elif eng.draft_model and eng.grp_attn_n > 1:
+    elif spec_want and eng.grp_attn_n > 1:
         log.warning(
-            "%s: draft_model is not supported with self-extend "
-            "(grp_attn_n>1); serving without speculative decoding",
-            mcfg.name,
+            "%s: speculative decoding is not supported with self-extend "
+            "(grp_attn_n>1); serving without it", mcfg.name,
         )
-    elif eng.draft_model and getattr(runner, "pp_enabled", False):
+    elif spec_want and getattr(runner, "pp_enabled", False):
         log.warning(
-            "%s: draft_model is not supported with pipeline parallelism; "
-            "serving without speculative decoding", mcfg.name,
+            "%s: speculative decoding is not supported with pipeline "
+            "parallelism; serving without it", mcfg.name,
         )
-    elif eng.draft_model:
-        from localai_tpu.engine.speculative import build_spec_decoder
+    elif spec_want:
+        from localai_tpu.spec import build_spec_engine
 
-        spec = build_spec_decoder(
-            runner, eng.draft_model,
+        drafter = (os.environ.get("LOCALAI_SPEC_DRAFTER", "")
+                   or eng.spec_drafter or "auto")
+        if drafter == "model" and not eng.draft_model:
+            log.warning(
+                "%s: spec_drafter=model but no draft_model configured; "
+                "using the n-gram self-drafter", mcfg.name)
+            drafter = "ngram"
+        gamma = eng.spec_gamma
+        if gamma is None and eng.draft_model:
+            gamma = max(1, eng.n_draft)
+        spec = build_spec_engine(
+            runner,
+            drafter=drafter,
+            draft_ref=eng.draft_model,
             model_path=app.model_path,
-            gamma=max(1, eng.n_draft),
+            gamma=gamma,
             dtype=eng.dtype,
         )
         log.info(
-            "%s: speculative decoding with draft %s (n_draft=%d)",
-            mcfg.name, eng.draft_model, eng.n_draft,
+            "%s: speculative decoding on (%s drafter, gamma=%d, %s KV)",
+            mcfg.name, spec.drafter.name, spec.gamma,
+            "paged" if spec.paged else "contiguous",
         )
     prompt_cache = None
     if mcfg.prompt_cache_path and app.mirror_port:
@@ -549,11 +576,13 @@ def build_serving_model(mcfg: ModelConfig, app: AppConfig) -> ServingModel:
     # this engine's channel escalates trace → drain-with-5xx → runner
     # re-init → probe dispatch, bounded+backed-off, then marks the model
     # failed (the dead-engine reload path here owns further recovery).
-    # Speculative engines are excluded (the draft pair's device state
-    # can't be rebuilt independently); LOCALAI_SELF_HEAL=0 disables.
-    # (multi-host mirrored runners are also excluded: a leader-local
-    # rebuild would desync the follower group's replayed command stream)
-    if (spec is None and not app.mirror_port
+    # SpecEngine engines rebuild too (drafter.reinit rides the runner
+    # re-init); only legacy spec objects without supports_rebuild are
+    # excluded. LOCALAI_SELF_HEAL=0 disables. (multi-host mirrored
+    # runners are also excluded: a leader-local rebuild would desync the
+    # follower group's replayed command stream)
+    if ((spec is None or getattr(spec, "supports_rebuild", False))
+            and not app.mirror_port
             and os.environ.get("LOCALAI_SELF_HEAL", "1") != "0"):
         from localai_tpu.faults import EngineSupervisor
 

@@ -19,9 +19,12 @@ Design constraints (same contract as :mod:`obs.trace`):
   * **Windowed percentiles from the ring.** Per-token step time
     (``dispatch_ms / steps``) percentiles (p50/p90/p99) are computed on
     demand from the resident rows, excluding compile-bearing first
-    dispatches (``compile=True``) and speculative windows (``steps=0`` —
-    their token yield is variable), so the numbers answer "what is decode
-    doing NOW", which the lifetime EMA cannot.
+    dispatches (``compile=True``), so the numbers answer "what is decode
+    doing NOW", which the lifetime EMA cannot. Speculative windows record
+    their MEASURED yield (mean emitted tokens per active slot-window) as
+    ``steps`` plus per-dispatch ``spec_proposed``/``spec_accepted``
+    counts — with speculation the default lane they are part of the
+    decode timeline, not an exclusion.
 
 One instance per Scheduler (``Scheduler.flight``); bench phases build
 their own. Surfaced at ``GET /debug/flight`` and attached to every stall
@@ -64,6 +67,8 @@ class FlightRecorder:
         self._tokens = np.zeros(n, np.int64)
         self._preemptions = np.zeros(n, np.int64)
         self._spec_accept = np.full(n, np.nan)
+        self._spec_proposed = np.zeros(n, np.int64)
+        self._spec_accepted = np.zeros(n, np.int64)
         self._compile = np.zeros(n, bool)
         self._program: list[str] = [""] * n
         self._n = 0                # records ever written (ring head = n % cap)
@@ -75,13 +80,17 @@ class FlightRecorder:
                occupancy: float, queue_depth: int, kv_utilization: float,
                tokens: int, preemptions: int = 0,
                spec_accept: Optional[float] = None,
+               spec_proposed: int = 0, spec_accepted: int = 0,
                compile: bool = False, ts: Optional[float] = None,
                batch_slots: int = 0) -> None:
         """Append one dispatch record (host scalars only).
 
         ``batch_slots`` tags the record with the lane mix: how many of the
         occupied slots were background batch-lane requests at drain time
-        (0 = pure interactive dispatch)."""
+        (0 = pure interactive dispatch). ``spec_proposed``/
+        ``spec_accepted`` are THIS dispatch's draft-token counts (0 for
+        non-speculative dispatches) — the per-window accept trace the
+        cumulative ``spec_accept`` ratio can't show."""
         now = time.monotonic() if ts is None else ts
         with self._lock:
             i = self._n % self.capacity
@@ -96,6 +105,8 @@ class FlightRecorder:
             self._preemptions[i] = preemptions
             self._spec_accept[i] = (np.nan if spec_accept is None
                                     else spec_accept)
+            self._spec_proposed[i] = spec_proposed
+            self._spec_accepted[i] = spec_accepted
             self._compile[i] = compile
             self._program[i] = program
             self._n += 1
@@ -146,6 +157,8 @@ class FlightRecorder:
                 "tokens": self._tokens[order].tolist(),
                 "preempt": self._preemptions[order].tolist(),
                 "acc": self._spec_accept[order].tolist(),
+                "proposed": self._spec_proposed[order].tolist(),
+                "accepted": self._spec_accepted[order].tolist(),
                 "compile": self._compile[order].tolist(),
                 "program": [self._program[i] for i in order],
             }
@@ -170,6 +183,8 @@ class FlightRecorder:
                 "tokens": cols["tokens"][j],
                 "preemptions": cols["preempt"][j],
                 "spec_accept": (None if np.isnan(acc) else round(acc, 4)),
+                "spec_proposed": cols["proposed"][j],
+                "spec_accepted": cols["accepted"][j],
                 "compile": cols["compile"][j],
             })
         return out

@@ -239,6 +239,23 @@ class Registry:
             "localai_speculative_windows_total",
             "Speculative draft+verify windows dispatched",
         )
+        self.spec_accept_ratio = Gauge(
+            "localai_spec_accept_rate",
+            "Draft tokens accepted / proposed (lifetime ratio)",
+        )
+        self.spec_draft_tokens = Counter(
+            "localai_spec_draft_tokens_total",
+            "Draft tokens proposed to the speculative verify dispatch",
+        )
+        self.spec_accepted_tokens = Counter(
+            "localai_spec_accepted_tokens_total",
+            "Draft tokens accepted by the target's accept/sample scan",
+        )
+        self.spec_tokens_per_dispatch = Gauge(
+            "localai_spec_tokens_per_dispatch",
+            "Mean emitted tokens per active slot-window (>1 = the "
+            "verify-k dispatch beats single-step decode)",
+        )
         self.compile_count = Counter(
             "localai_xla_compile_total",
             "XLA program compilations observed (first dispatch per shape)",
@@ -450,6 +467,14 @@ def update_engine_gauges(name: str, m: dict,
     if "spec_acceptance_rate" in m:
         reg.spec_accept_rate.set(m["spec_acceptance_rate"], model=name)
         reg.spec_windows.set_total(m.get("spec_windows", 0), model=name)
+        reg.spec_accept_ratio.set(
+            m.get("spec_accept_rate", 0.0), model=name)
+        reg.spec_draft_tokens.set_total(
+            m.get("spec_draft_tokens", 0), model=name)
+        reg.spec_accepted_tokens.set_total(
+            m.get("spec_accepted_tokens", 0), model=name)
+        reg.spec_tokens_per_dispatch.set(
+            m.get("spec_tokens_per_dispatch", 0.0), model=name)
     # windowed step-time percentiles from the flight ring (the EMA's
     # windowed counterpart; absent until a post-compile dispatch lands)
     for q in ("p50", "p99"):

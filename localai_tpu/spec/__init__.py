@@ -1,0 +1,22 @@
+"""Block-native speculative decoding for the paged+meshed hot path.
+
+One speculation code path for both KV layouts: pluggable drafters
+(:mod:`.drafter` — a co-located draft model, or self-drafting n-gram
+prompt lookup needing no second model), a verify-k batched target
+dispatch (``ModelRunner.verify_async``), and per-slot accept/rollback
+inside the compiled program. :class:`.engine.SpecEngine` is the
+scheduler-facing lane; ``engine.speculative.SpecDecoder`` remains as a
+thin compatibility shim over it."""
+
+from localai_tpu.engine.runner import SKIP
+from localai_tpu.spec.drafter import Drafter, ModelDrafter, NGramDrafter
+from localai_tpu.spec.engine import SpecEngine, build_spec_engine
+
+__all__ = [
+    "SKIP",
+    "Drafter",
+    "ModelDrafter",
+    "NGramDrafter",
+    "SpecEngine",
+    "build_spec_engine",
+]

@@ -427,15 +427,28 @@ def test_disk_prefix_export_transfers_across_layouts(tiny):
         assert out == base, (paged, out, base)
 
 
-def test_spec_decoder_rejects_paged_runner(tiny):
-    from localai_tpu.engine.speculative import SpecDecoder
+def test_spec_decoder_accepts_paged_runner(tiny):
+    """The PR 6 'SpecDecoder rejects paged runners' guard is gone: the
+    block-native lane (localai_tpu.spec) verifies draft windows straight
+    through the paged table mirror. Only a PAGED DRAFT stays rejected —
+    its window scans run over contiguous slot rows."""
+    from localai_tpu.engine.speculative import SKIP, SpecDecoder
 
     rp = ModelRunner(tiny.cfg, tiny.params, num_slots=2, max_ctx=64,
                      prefill_buckets=[16], kv_dtype="float32", paged=True)
     rc = ModelRunner(tiny.cfg, tiny.params, num_slots=2, max_ctx=64,
                      prefill_buckets=[16], kv_dtype="float32", paged=False)
+    spec = SpecDecoder(rp, rc, gamma=2)
+    slot = spec.acquire_slot()
+    spec.admit(slot, list(b"paged spec"), temperature=0.0)
+    rows = spec.step_spec()
+    assert 1 <= int((rows[:, slot] != SKIP).sum()) <= 3
+    assert not rp.allocator.check_invariants()
+
+    rp2 = ModelRunner(tiny.cfg, tiny.params, num_slots=2, max_ctx=64,
+                      prefill_buckets=[16], kv_dtype="float32", paged=True)
     with pytest.raises(ValueError, match="contiguous"):
-        SpecDecoder(rp, rc)
+        SpecDecoder(rc, rp2)
 
 
 # ---------------------------------------------------------------------------

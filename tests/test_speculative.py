@@ -78,8 +78,15 @@ def test_spec_positions_and_state_advance(small, tiny):
     emitted = int((rows[:, slot] != SKIP).sum())
     assert 1 <= emitted <= 4
     assert spec.slot_position(slot) == p0 + emitted
-    # draft frontier tracks the target's
-    assert int(spec.draft.state.positions[slot]) == p0 + emitted
+    # the draft frontier re-syncs lazily from the target's device state
+    # at the START of the next draft window (ModelDrafter._draft_fn takes
+    # the target's tokens/positions as fresh jit inputs — eager aliasing
+    # of donated buffers would dangle); a second window must therefore
+    # keep emitting from the rolled-back frontier
+    rows2 = spec.step_spec()
+    emitted2 = int((rows2[:, slot] != SKIP).sum())
+    assert 1 <= emitted2 <= 4
+    assert spec.slot_position(slot) == p0 + emitted + emitted2
 
 
 def test_spec_int8_kv(small, tiny):

@@ -33,6 +33,10 @@ Scenarios (≥6, see ``SCENARIOS``):
   pool_exhaustion   a tiny block pool holds admissions; a held request
                     cancelled mid-hold releases its place and a successor
                     admits; everything resolves, blocks conserve
+  spec_divergence   every speculative draft proposal garbled mid-serving
+                    → acceptance collapses but co-batched greedy streams
+                    stay byte-identical (per-slot rollback) and the
+                    block pool conserves
   fleet_failover    a 2-replica fleet loses one replica pre-stream → the
                     router fails over and the request completes
   respawn_backoff   respawns forced to fail → jittered exponential holds
@@ -55,7 +59,8 @@ import time
 def _build_engine(name: str, *, watchdog=None, registry=None, store=None,
                   max_ctx: int = 512, num_slots: int = 4,
                   kv_num_blocks=None, supervisor: bool = False,
-                  sup_kwargs=None):
+                  sup_kwargs=None, spec_gamma: int = 0,
+                  multi_step: int = 16):
     """A paged tiny-model engine with isolated telemetry (the process
     registry stays clean for the exposition checks at the end)."""
     from localai_tpu.engine.runner import ModelRunner
@@ -82,12 +87,22 @@ def _build_engine(name: str, *, watchdog=None, registry=None, store=None,
             model=name, store=store, registry=registry,
             slo=SLOTracker(registry=registry, targets={})),
         watchdog=watchdog,
+        multi_step=multi_step,
+        spec=_spec_engine(runner, spec_gamma) if spec_gamma else None,
     )
     if supervisor:
         from localai_tpu.faults import EngineSupervisor
 
         EngineSupervisor(sched, registry=registry, **(sup_kwargs or {}))
     return runner, sched
+
+
+def _spec_engine(runner, gamma: int):
+    """Self-drafting speculation lane over the paged runner (the serving
+    default shape; localai_tpu.spec)."""
+    from localai_tpu.spec import NGramDrafter, SpecEngine
+
+    return SpecEngine(runner, NGramDrafter(runner.num_slots, gamma))
 
 
 def _req(text: str, **kw):
@@ -338,6 +353,77 @@ def scenario_pool_exhaustion() -> dict:
         sched.shutdown()
 
 
+def scenario_spec_divergence() -> dict:
+    """spec.draft chaos: every drafter proposal replaced with divergent
+    garbage tokens mid-serving. Acceptance collapses, but the accept scan
+    emits the target's own samples — so BOTH co-batched greedy streams
+    must stay byte-identical to the no-fault reference, the per-slot
+    rollback must conserve blocks (check_invariants clean after every
+    drain via LOCALAI_KV_CHECK), and nothing may leak once drained."""
+    from localai_tpu import faults
+
+    # a huge logit bias forces a cyclic greedy stream so the n-gram
+    # self-drafter actually proposes (deterministic windows to garble).
+    # multi_step=4: the speculation pre-gate reads resident records that
+    # lag by the in-flight dispatch, so with the default 16-step
+    # dispatches a 32-token request would finish before the lookup
+    # candidate becomes visible — real generations are orders of
+    # magnitude longer, chaos requests are not
+    kw_a = dict(max_new_tokens=32, logit_bias={97: 1e4}, ignore_eos=True)
+    kw_b = dict(max_new_tokens=32, logit_bias={98: 1e4}, ignore_eos=True)
+
+    runner, sched = _build_engine("chaos-spec-ref", spec_gamma=4,
+                                  multi_step=4)
+    try:
+        ra = sched.submit(_req("spec target stream", **kw_a))
+        rb = sched.submit(_req("co-batched bystander", **kw_b))
+        ra.result(120)
+        rb.result(120)
+        ref = (ra.token_ids, rb.token_ids)
+        ref_windows = sched.spec.total_windows
+    finally:
+        sched.shutdown()
+
+    runner, sched = _build_engine("chaos-spec", spec_gamma=4,
+                                  multi_step=4)
+    try:
+        faults.arm(faults.FaultSpec(site="spec.draft", mode="garble",
+                                    times=0))
+        ga = sched.submit(_req("spec target stream", **kw_a))
+        gb = sched.submit(_req("co-batched bystander", **kw_b))
+        ga.result(120)
+        gb.result(120)
+        problems = _resolved([ga, gb])
+        if (ga.token_ids, gb.token_ids) != ref:
+            problems.append(
+                "greedy streams diverged under garbled drafts (rollback "
+                "must make rejected windows invisible)")
+        if ref_windows < 1:
+            problems.append("reference run never dispatched a spec window")
+        if sched.spec.total_windows < 1:
+            problems.append("garbled run never dispatched a spec window")
+        fired = sum(s["fired"] for s in faults.snapshot()
+                    if s["site"] == "spec.draft")
+        if fired < 1:
+            problems.append("spec.draft fault never fired")
+        inv = runner.allocator.check_invariants()
+        if inv:
+            problems.append(f"invariants after garbled windows: {inv}")
+        if sched.kv_invariant_violations:
+            problems.append(
+                f"{sched.kv_invariant_violations} per-drain invariant "
+                "violations during the garbled run")
+        problems += _blocks_conserved(runner)
+        return {"problems": problems,
+                "ref_windows": ref_windows,
+                "garbled_windows": sched.spec.total_windows,
+                "garbled_accept_rate": round(sched.spec.accept_rate, 4),
+                "faults_fired": fired}
+    finally:
+        faults.clear()
+        sched.shutdown()
+
+
 def _build_fleet(name: str, *, replicas: int = 2):
     from localai_tpu.config.app_config import AppConfig
     from localai_tpu.config.model_config import ModelConfig
@@ -479,6 +565,7 @@ SCENARIOS = {
     "dispatch_raise": scenario_dispatch_raise,
     "compile_fail": scenario_compile_fail,
     "pool_exhaustion": scenario_pool_exhaustion,
+    "spec_divergence": scenario_spec_divergence,
     "fleet_failover": scenario_fleet_failover,
     "respawn_backoff": scenario_respawn_backoff,
     "shed_recover": scenario_shed_recover,

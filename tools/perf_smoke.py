@@ -12,7 +12,10 @@ floor is *normalized*: tok/s divided by a machine-speed index (a fixed
 jitted matmul loop's effective GFLOP/s, ``bench_micro.machine_index``)
 measured in the same process. The paged/contiguous *ratio* is additionally
 gated — it is machine-independent and catches a paged-path regression
-even if the normalization drifts.
+even if the normalization drifts. A speculative-lane smoke rides along:
+the n-gram self-drafter on a repetitive prompt must keep accept-rate > 0
+and tokens-per-dispatch > 1 (absolute gates — acceptance arithmetic is
+hardware-independent).
 
 Usage:
     python tools/perf_smoke.py              # gate (CI)
@@ -70,6 +73,46 @@ def check_bench_fallback() -> list[str]:
     return []
 
 
+def _spec_smoke() -> dict:
+    """Speculative-lane smoke (ISSUE 11 gate): the n-gram self-drafter
+    over a paged tiny engine on a repetitive prompt must achieve a
+    positive draft accept-rate and >1 emitted token per verify dispatch
+    — the whole point of the verify-k window is amortizing the per-step
+    host round-trip, and a regression to ≤1 means the lane is dead
+    weight. Deterministic: greedy debug-model decode enters a cycle the
+    prompt-lookup drafter picks up."""
+    import numpy as np
+
+    from localai_tpu.engine.runner import ModelRunner
+    from localai_tpu.models.registry import resolve_model
+    from localai_tpu.spec import NGramDrafter, SpecEngine
+
+    tiny = resolve_model("debug:tiny", dtype="float32")
+    runner = ModelRunner(
+        tiny.cfg, tiny.params, num_slots=2, max_ctx=256,
+        prefill_buckets=[64], kv_dtype="float32",
+        paged=True, kv_block_tokens=16,
+    )
+    eng = SpecEngine(runner, NGramDrafter(2, gamma=4))
+    slot = eng.acquire_slot()
+    eng.admit(slot, list(b"abc abc abc abc abc abc"), temperature=0.0)
+    iters = 0
+    while eng.total_windows < 8 and iters < 80:
+        iters += 1
+        rows = eng.step_spec_async()
+        if rows is None:  # lookup miss — plain decode grows the history
+            tok = int(runner.step()[slot])
+            eng.drafter.observe(slot, [tok])
+            continue
+        eng.observe_window(np.asarray(rows))
+    return {
+        "spec_windows": eng.total_windows,
+        "spec_accept_rate": round(eng.accept_rate, 4),
+        "spec_tokens_per_dispatch": round(eng.tokens_per_dispatch, 4),
+        "spec_invariants": runner.allocator.check_invariants(),
+    }
+
+
 def _measure(tol: float) -> dict:
     import jax
 
@@ -97,6 +140,7 @@ def _measure(tol: float) -> dict:
         out["meshed_over_paged"] = round(meshed / paged, 4)
     else:
         out["meshed"] = "skipped (<2 devices)"
+    out.update(_spec_smoke())
     return out
 
 
@@ -171,6 +215,21 @@ def main() -> int:
             failures.append(
                 f"meshed_over_paged {res['meshed_over_paged']:.3f} "
                 f"< {meshed_min} (meshed-paged decode path regressed)")
+        # speculative-lane gate: absolute (no machine normalization
+        # needed — acceptance arithmetic is hardware-independent)
+        if res.get("spec_accept_rate", 0.0) <= 0.0:
+            failures.append(
+                "spec_accept_rate is 0 (the n-gram self-drafter never "
+                "got a draft accepted)")
+        if res.get("spec_tokens_per_dispatch", 0.0) <= 1.0:
+            failures.append(
+                f"spec_tokens_per_dispatch "
+                f"{res.get('spec_tokens_per_dispatch')} <= 1 (the "
+                "verify-k window no longer amortizes dispatches)")
+        if res.get("spec_invariants"):
+            failures.append(
+                f"spec smoke violated block invariants: "
+                f"{res['spec_invariants']}")
         return failures
 
     failures = gate(result)
