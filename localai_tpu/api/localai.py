@@ -152,6 +152,73 @@ async def fleet_status(request: web.Request) -> web.Response:
     })
 
 
+async def fleet_register(request: web.Request) -> web.Response:
+    """POST /federated/register on the SERVING instance: a remote worker
+    announces itself (``{"address": "host:port", "model": optional,
+    "role": "decode"|"prefill"}``) and is adopted into the matching fleet
+    pools as a RemoteReplica — the fleet-tier twin of the federation
+    router's registry, with the same ``peer_token`` guard, the same
+    unroutable-address rejection, and offline-eviction parity (a peer
+    that stops answering dials is evicted from routing and redialed on
+    backoff, exactly like the router flips nodes offline)."""
+    import hmac
+
+    from localai_tpu.federation.server import validate_advertised_address
+
+    state = _state(request)
+    if state.config.peer_token:
+        header = request.headers.get("Authorization", "")
+        token = header.removeprefix("Bearer ").strip()
+        if not hmac.compare_digest(token, state.config.peer_token):
+            return web.json_response({"error": "invalid peer token"},
+                                     status=401)
+    try:
+        body = await request.json()
+        address = str(body["address"])
+    except Exception:
+        return web.json_response({"error": "address is required"},
+                                 status=400)
+    try:
+        validate_advertised_address(address)
+    except ValueError as e:
+        return web.json_response({"error": str(e)}, status=400)
+    role = str(body.get("role", "decode"))
+    if role not in ("decode", "prefill"):
+        return web.json_response(
+            {"error": f"unknown role {role!r} (decode|prefill)"},
+            status=400)
+    model = body.get("model")
+    targets = {}
+    for name, sm in state.manager.loaded_snapshot().items():
+        if model and name != model:
+            continue
+        if hasattr(sm, "adopt_remote"):
+            targets[name] = sm
+    if not targets:
+        return web.json_response(
+            {"error": (f"model {model!r} is not fleet-served" if model
+                       else "no fleet-served model loaded")},
+            status=409)
+    if len(targets) > 1:
+        # a worker process holds ONE model: adopting it into several
+        # pools would leave every pool after the first seeing Status
+        # READY and silently serving the FIRST pool's model under its
+        # own name — the registration must say which model the peer is
+        # for
+        return web.json_response(
+            {"error": "multiple fleet-served models are loaded "
+                      f"({sorted(targets)}); pass \"model\" to say which "
+                      "one the peer serves"},
+            status=409)
+    loop = asyncio.get_running_loop()
+    adopted = {}
+    for name, sm in targets.items():
+        # the adoption dials + LoadModels the peer — off the event loop
+        adopted[name] = await loop.run_in_executor(
+            None, sm.adopt_remote, address, role)
+    return web.json_response({"address": address, "adopted": adopted})
+
+
 async def system(request: web.Request) -> web.Response:
     """GET /system (parity: SystemInformations, routes/localai.go:64 —
     CPU/GPU info becomes the JAX device inventory)."""
@@ -265,6 +332,7 @@ def routes() -> list[web.RouteDef]:
         web.get("/metrics", metrics),
         web.get("/v1/slo", slo_report),
         web.get("/v1/fleet", fleet_status),
+        web.post("/federated/register", fleet_register),
         web.get("/system", system),
         web.post("/v1/tokenize", tokenize),
         web.post("/tokenize", tokenize),

@@ -117,6 +117,16 @@ def build_parser() -> argparse.ArgumentParser:
                      help="auto-derive per-replica worker env (TPU "
                           "visible-device slices) so --fleet-replicas N "
                           "partitions the host's accelerators evenly")
+    run.add_argument("--fleet-hosts", default=None,
+                     help="comma-separated host:port remote workers to "
+                          "adopt into every fleet pool (cross-host "
+                          "serving; failed remotes are evicted and "
+                          "redialed on backoff, never respawned)")
+    run.add_argument("--fleet-rpc-timeout-s", type=float, default=None,
+                     help="per-reply inactivity deadline on cross-"
+                          "replica streams and control RPCs (default "
+                          "120; 0 disables; size above worst-case "
+                          "queue wait + TTFT)")
 
     models = sub.add_parser("models", help="model management")
     models_sub = models.add_subparsers(dest="models_command")
@@ -393,6 +403,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             fleet_backend=args.fleet_backend,
             fleet_disagg_threshold=args.fleet_disagg_threshold,
             fleet_device_pinning=args.fleet_device_pinning or None,
+            fleet_hosts=([h for h in args.fleet_hosts.split(",") if h]
+                         if args.fleet_hosts is not None else None),
+            fleet_rpc_timeout_s=args.fleet_rpc_timeout_s,
         )
         serve(cfg)
         return 0

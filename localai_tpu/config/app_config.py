@@ -116,6 +116,21 @@ class AppConfig:
     fleet_prefill_replicas: int = 0
     fleet_backend: str = "worker"
     fleet_disagg_threshold: int = 512
+    # cross-host fleet: adopt externally managed remote workers at
+    # host:port into every fleet pool (LOCALAI_FLEET_HOSTS, comma-
+    # separated; CLI --fleet-hosts). Remotes are evicted-with-redial on
+    # failure, never respawned — this process does not own their
+    # lifecycle. More peers can join at runtime via the token-guarded
+    # POST /federated/register on the serving instance.
+    fleet_hosts: list[str] = field(default_factory=list)
+    # per-reply inactivity deadline on every cross-replica stream and the
+    # control-plane RPC bound (LOCALAI_FLEET_RPC_TIMEOUT_S /
+    # --fleet-rpc-timeout-s; 0 disables). Size it above worst-case queue
+    # wait + TTFT — a cold replica's first-dispatch compile is legitimate
+    # silence. Retry count for idempotent cross-host RPCs is env-only:
+    # LOCALAI_FLEET_RPC_RETRIES (default 2), as are the redial backoff
+    # knobs LOCALAI_FLEET_REDIAL_{BASE,CAP}_S.
+    fleet_rpc_timeout_s: float = 120.0
 
     # TPU-specific
     mesh_shape: Optional[dict[str, int]] = None   # None = auto from devices

@@ -79,6 +79,16 @@ SITES = {
                      "replica.",
     "fleet.respawn": "fleet replica respawn attempt (key: replica id). "
                      "raise = respawn fails (exercises backoff).",
+    "fleet.dial": "replica health dial, every replica kind (key: replica "
+                  "id). raise = peer unreachable/refused — a network "
+                  "partition as the monitor sees it (exercises eviction "
+                  "and redial backoff).",
+    "fleet.transport": "per-message on the cross-replica stream pump "
+                       "(fleet.net.bounded_stream; key: replica id). "
+                       "raise = connection reset mid-stream (partition "
+                       "under traffic); sleep = slow link — delay_s past "
+                       "LOCALAI_FLEET_RPC_TIMEOUT_S trips the dispatch "
+                       "deadline.",
 }
 
 # module-global fast gate: hot paths read this one attribute and skip the

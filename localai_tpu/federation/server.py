@@ -32,6 +32,45 @@ FED_KEY = web.AppKey("fed", object)
 SESSION_KEY = web.AppKey("session", ClientSession)
 HEALTH_KEY = web.AppKey("health_task", object)
 
+
+def validate_advertised_address(address: str) -> str:
+    """Reject an advertised address that is unroutable BY CONSTRUCTION —
+    empty host, missing/zero/garbage port, or a wildcard bind address
+    (0.0.0.0/::/*). A peer advertising one of these can never be dialed
+    back, so accepting it only seeds the registry (and any fleet pool
+    adopting from it) with a permanently offline node. Returns the
+    address unchanged (scheme preserved); raises ValueError.
+
+    Deliberately *constructional* only: whether a well-formed address is
+    actually reachable is the health loop's job, not registration's."""
+    hostport = address
+    for scheme in ("http://", "https://"):
+        if hostport.startswith(scheme):
+            hostport = hostport[len(scheme):]
+            break
+    hostport = hostport.split("/", 1)[0]
+    # IPv6 literal: [::1]:8080
+    if hostport.startswith("["):
+        host, _, rest = hostport[1:].partition("]")
+        port_s = rest.removeprefix(":")
+    else:
+        host, _, port_s = hostport.rpartition(":")
+    if not host:
+        raise ValueError(f"advertised address {address!r} has no host")
+    if host in ("0.0.0.0", "::", "*"):
+        raise ValueError(
+            f"advertised address {address!r} is a wildcard bind address, "
+            "not a routable peer address")
+    try:
+        port = int(port_s)
+    except ValueError:
+        raise ValueError(
+            f"advertised address {address!r} has no numeric port") from None
+    if not 1 <= port <= 65535:
+        raise ValueError(
+            f"advertised address {address!r} has out-of-range port {port}")
+    return address
+
 # hop-by-hop headers never forwarded by an HTTP proxy (RFC 9110 §7.6.1)
 HOP_HEADERS = {
     "connection", "keep-alive", "proxy-authenticate",
@@ -102,6 +141,11 @@ class FederatedServer:
                 self._nodes[nid] = node
                 log.info("federation: registered node %s", nid)
             node.online = True
+            # an evicted node re-registering is a REJOIN: its failure
+            # count starts over, exactly like ReplicaPool._note_rejoined
+            # resets the respawn/redial backoff clock — stale failures
+            # must not poison the next incident's escalation
+            node.failures = 0
             node.last_seen = time.monotonic()
             return node
 
@@ -163,10 +207,14 @@ class FederatedServer:
                 if ok:
                     if not node.online:
                         log.info("federation: node %s back online", node.id)
+                        # rejoin resets the failure count (mirror
+                        # ReplicaPool._note_rejoined)
+                        node.failures = 0
                     node.online = True
                     node.last_seen = time.monotonic()
                 else:
                     node.online = False
+                    node.failures += 1
 
     # -- HTTP app ----------------------------------------------------------
 
@@ -233,6 +281,10 @@ async def _register_endpoint(request: web.Request) -> web.Response:
     except Exception:
         return web.json_response({"error": "address is required"},
                                  status=400)
+    try:
+        validate_advertised_address(address)
+    except ValueError as e:
+        return web.json_response({"error": str(e)}, status=400)
     node = fed.register(address)
     return web.json_response(node.snapshot())
 

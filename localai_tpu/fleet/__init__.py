@@ -15,10 +15,19 @@ KV engine instead of libp2p:
     retry-with-failover and the disaggregated prefill→decode handoff.
   * :mod:`localai_tpu.fleet.prefix` — the in-memory prefix cache +
     chunked npz wire format behind the TransferPrefix RPC.
+  * :mod:`localai_tpu.fleet.net` — the cross-host RPC discipline:
+    explicit deadlines (LOCALAI_FLEET_RPC_TIMEOUT_S), bounded jittered
+    retries for idempotent calls, and the stream pump that turns a
+    partitioned peer's silence into a prompt failover.
+  * :mod:`localai_tpu.fleet.replica` — the replica kinds: spawned
+    workers, in-process engines, and adopted remotes (RemoteReplica:
+    evicted-with-redial, never respawned).
 """
 
+from localai_tpu.fleet.net import RpcDeadlineExceeded, bounded_stream
 from localai_tpu.fleet.pool import ReplicaPool
 from localai_tpu.fleet.prefix import PrefixCache, assemble_chunks, pack_chunks
+from localai_tpu.fleet.replica import RemoteReplica
 from localai_tpu.fleet.router import Router, affinity_key
 from localai_tpu.fleet.serving import FleetScheduler, FleetServingModel
 
@@ -26,9 +35,12 @@ __all__ = [
     "FleetScheduler",
     "FleetServingModel",
     "PrefixCache",
+    "RemoteReplica",
     "ReplicaPool",
     "Router",
+    "RpcDeadlineExceeded",
     "affinity_key",
     "assemble_chunks",
+    "bounded_stream",
     "pack_chunks",
 ]

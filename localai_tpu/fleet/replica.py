@@ -6,6 +6,12 @@ Two implementations behind one duck-typed surface:
     (worker/process.py WorkerProcess + worker/client.py WorkerClient), the
     production shape: crash isolation per replica, device pinning via the
     spawn env, KV prefixes crossing the wire as PrefixChunk streams.
+  * :class:`RemoteReplica` — an externally managed worker dialed at
+    ``host:port`` across the network (static ``LOCALAI_FLEET_HOSTS``
+    adoption or a federation-registry join): same WorkerClient transport
+    as WorkerReplica, but NOT respawnable — this process does not own the
+    remote's lifecycle, so a failed remote is *evicted* from routing and
+    *redialed* on jittered exponential backoff instead of respawned.
   * :class:`InProcessReplica` — a full engine (build_serving_model) inside
     this process: the CPU-testable shape the router/pool/disaggregation
     tests and the CI telemetry smoke drive, with the same reply/chunk
@@ -13,8 +19,10 @@ Two implementations behind one duck-typed surface:
     both, so the two kinds cannot drift).
 
 States: ``starting`` → ``healthy`` ⇄ ``dead`` → ``respawning`` →
-``healthy``. "Shedding" is not a stored state — it is derived per route
-from the fleet's per-replica SLO tracker (router.py)."""
+``healthy`` for locally owned replicas; remotes flip ``healthy`` ⇄
+``evicted`` (redial instead of respawn). "Shedding" is not a stored state
+— it is derived per route from the fleet's per-replica SLO tracker
+(router.py)."""
 
 from __future__ import annotations
 
@@ -32,6 +40,9 @@ STARTING = "starting"
 HEALTHY = "healthy"
 DEAD = "dead"
 RESPAWNING = "respawning"
+# a remote replica out of routing after failed dials: the pool redials it
+# on backoff but never tries to (re)spawn a process it does not own
+EVICTED = "evicted"
 
 
 class _Reply:
@@ -49,6 +60,10 @@ class _Reply:
 
 class BaseReplica:
     """Shared lifecycle/accounting; subclasses provide transport."""
+
+    # False on replicas whose process this server does not own (remotes):
+    # the pool evicts-and-redials them instead of stop()+respawn
+    respawnable = True
 
     def __init__(self, rid: str, role: str):
         self.id = rid
@@ -91,6 +106,12 @@ class BaseReplica:
     def dial(self, timeout: float = 2.0) -> bool:
         t0 = time.monotonic()
         try:
+            if _faults.ACTIVE:
+                # chaos: an unreachable/refusing peer as the monitor sees
+                # it — the injected raise is a failed dial, exactly like a
+                # real partition (keyed by replica id so a schedule can
+                # partition one peer)
+                _faults.apply("fleet.dial", key=self.id)
             ok = self._dial(timeout)
         except Exception:  # noqa: BLE001 — a dial failing IS the signal
             ok = False
@@ -137,7 +158,8 @@ class BaseReplica:
     def prefill_prefix(self, opts: Any, trace_id: str = "") -> Iterator:
         raise NotImplementedError
 
-    def transfer_prefix(self, chunks: Iterator, trace_id: str = "") -> Any:
+    def transfer_prefix(self, chunks: Iterator, trace_id: str = "",
+                        timeout: Optional[float] = None) -> Any:
         raise NotImplementedError
 
     def metrics(self) -> dict:
@@ -151,24 +173,16 @@ class BaseReplica:
         raise NotImplementedError
 
 
-class WorkerReplica(BaseReplica):
-    """A replica backed by its own spawned gRPC worker process."""
+class _ClientReplica(BaseReplica):
+    """Transport shared by every WorkerClient-backed replica (spawned
+    worker processes AND adopted remote workers): the streaming dispatch,
+    both halves of the disaggregated prefix handoff, bounded stats pulls,
+    and the LoadModel handshake. Subclasses own lifecycle (spawn vs dial)
+    and set ``self._client``."""
 
-    def __init__(self, rid: str, role: str, mcfg, app,
-                 *, env: Optional[dict] = None):
-        super().__init__(rid, role)
-        self.mcfg = mcfg
-        self.app = app
-        self._env = dict(env or {})
-        self._wp = None
-        self._client = None
-
-    def start(self) -> None:
-        from localai_tpu.worker.process import WorkerProcess
-
-        self._wp = WorkerProcess(self.id, env=self._env or None)
-        self._client = self._wp.start()
-        self._load_model()
+    mcfg = None
+    app = None
+    _client = None
 
     def _load_model(self) -> None:
         import yaml
@@ -194,14 +208,25 @@ class WorkerReplica(BaseReplica):
     def prefill_prefix(self, opts, trace_id: str = "") -> Iterator:
         return self._client.prefill_prefix(opts, trace_id=trace_id)
 
-    def transfer_prefix(self, chunks, trace_id: str = ""):
+    def transfer_prefix(self, chunks, trace_id: str = "",
+                        timeout: Optional[float] = None):
+        from localai_tpu.fleet import net
         from localai_tpu.worker import backend_pb2 as pb
 
         def as_protos():
             for c in chunks:
                 yield c if not isinstance(c, dict) else pb.PrefixChunk(**c)
 
-        return self._client.transfer_prefix(as_protos(), trace_id=trace_id)
+        # explicit deadline: the transfer moves bulk KV rows, so it gets
+        # headroom (4×) over the per-reply bound — but never hangs a
+        # partitioned peer's dispatch thread for the 600 s stream
+        # default. The caller (FleetScheduler) passes its CONFIGURED
+        # timeout so --fleet-rpc-timeout-s governs this path too; the
+        # env read is only the no-caller fallback.
+        t = net.rpc_timeout_s() if timeout is None else timeout
+        return self._client.transfer_prefix(
+            as_protos(), timeout=(t * 4 if t > 0 else 600.0),
+            trace_id=trace_id)
 
     def metrics(self) -> dict:
         try:
@@ -210,6 +235,26 @@ class WorkerReplica(BaseReplica):
             return self._client.metrics(timeout=3.0)
         except Exception as e:  # noqa: BLE001 — stats pull ≠ serving
             return {"error": str(e)}
+
+
+class WorkerReplica(_ClientReplica):
+    """A replica backed by its own spawned gRPC worker process."""
+
+    def __init__(self, rid: str, role: str, mcfg, app,
+                 *, env: Optional[dict] = None):
+        super().__init__(rid, role)
+        self.mcfg = mcfg
+        self.app = app
+        self._env = dict(env or {})
+        self._wp = None
+        self._client = None
+
+    def start(self) -> None:
+        from localai_tpu.worker.process import WorkerProcess
+
+        self._wp = WorkerProcess(self.id, env=self._env or None)
+        self._client = self._wp.start()
+        self._load_model()
 
     def process_alive(self) -> bool:
         return self._wp is not None and self._wp.alive
@@ -223,6 +268,72 @@ class WorkerReplica(BaseReplica):
         if self._wp is not None:
             self._wp.stop()
             self._wp = None
+            self._client = None
+
+
+class RemoteReplica(_ClientReplica):
+    """A replica served by an externally managed worker at ``host:port``
+    — another box entirely. Adopted from the static ``LOCALAI_FLEET_HOSTS``
+    list or a ``POST /federated/register`` join; this process does NOT own
+    the remote's lifecycle, so ``respawnable = False``: on failed dials
+    the pool evicts it from routing and redials on backed-off holds
+    instead of respawning. ``stop()`` only closes the channel."""
+
+    respawnable = False
+
+    def __init__(self, rid: str, role: str, address: str,
+                 mcfg=None, app=None, *, dial_timeout: float = 5.0):
+        super().__init__(rid, role)
+        self.address = address
+        self.mcfg = mcfg
+        self.app = app
+        self.dial_timeout = dial_timeout
+        self._client = None
+
+    def start(self) -> None:
+        """Dial (or redial) the remote: a fresh channel, a health gate,
+        and — because a redial may find a *rebooted, empty* worker — a
+        Status check that re-issues LoadModel when the peer lost the
+        model. Raises when the peer is unreachable; the pool turns that
+        into eviction + backed-off redial, never a respawn."""
+        from localai_tpu.worker.client import WorkerClient
+
+        if self._client is not None:
+            self._client.close()
+        self._client = WorkerClient(self.address)
+        if not self._client.health(self.dial_timeout):
+            raise RuntimeError(
+                f"remote replica {self.id} at {self.address} is "
+                "unreachable")
+        if self.mcfg is not None:
+            self._ensure_loaded()
+
+    def _ensure_loaded(self) -> None:
+        from localai_tpu.fleet import net
+        from localai_tpu.worker import backend_pb2 as pb
+
+        # idempotent status probe: bounded retry absorbs a peer that just
+        # came up and is still binding its servicer. NOTE: Status carries
+        # no model identity (a worker process holds exactly ONE model),
+        # so READY is trusted as "holds THIS pool's model" — the
+        # registration layer enforces that a peer is only ever adopted
+        # into one model's pool (api.localai.fleet_register refuses an
+        # ambiguous join).
+        st = net.call_with_retries(
+            lambda: self._client.status(timeout=self.dial_timeout),
+            rid=self.id, what="status")
+        if st.state in (pb.StatusResponse.READY, pb.StatusResponse.BUSY):
+            return
+        self._load_model()
+
+    def process_alive(self) -> bool:
+        """No local process to poll — the health dial is the only truth
+        about a peer across a network."""
+        return self._client is not None
+
+    def stop(self) -> None:
+        if self._client is not None:
+            self._client.close()
             self._client = None
 
 
@@ -306,7 +417,10 @@ class InProcessReplica(BaseReplica):
         prompt, arrays = export_prefix(sm, gr, self._cache())
         yield from pack_chunks(prompt, arrays)
 
-    def transfer_prefix(self, chunks, trace_id: str = ""):
+    def transfer_prefix(self, chunks, trace_id: str = "",
+                        timeout: Optional[float] = None):
+        # timeout accepted for surface parity with the client-backed
+        # kinds; an in-process import has no wire to bound
         from types import SimpleNamespace
 
         from localai_tpu.fleet.prefix import import_prefix

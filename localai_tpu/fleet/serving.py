@@ -35,6 +35,7 @@ from typing import Optional
 from localai_tpu.config.app_config import AppConfig
 from localai_tpu.config.model_config import ModelConfig
 from localai_tpu.engine.scheduler import GenHandle, GenRequest
+from localai_tpu.fleet import net
 from localai_tpu.fleet.pool import ReplicaPool
 from localai_tpu.fleet.router import FleetUnavailable, Router
 from localai_tpu.obs import EngineTelemetry
@@ -53,13 +54,21 @@ class FleetScheduler:
 
     def __init__(self, owner: "FleetServingModel", pool: ReplicaPool,
                  router: Router, slo: SLOTracker,
-                 *, disagg_threshold: int = 512, max_failovers: int = 2):
+                 *, disagg_threshold: int = 512, max_failovers: int = 2,
+                 rpc_timeout_s: Optional[float] = None):
         self._owner = owner
         self.pool = pool
         self.router = router
         self.slo = slo                      # per-REPLICA observatory
         self.disagg_threshold = disagg_threshold
         self.max_failovers = max_failovers
+        # per-reply inactivity deadline on every cross-replica stream
+        # (fleet.net.bounded_stream): a partitioned peer never RSTs, so
+        # silence — not an error — is how a dead remote presents; the
+        # deadline turns it into a prompt failover instead of a hung
+        # dispatch thread (0 disables)
+        self.rpc_timeout_s = (net.rpc_timeout_s() if rpc_timeout_s is None
+                              else rpc_timeout_s)
         self._ids = itertools.count()
         self._inflight = 0
         self._lock = threading.Lock()
@@ -144,6 +153,11 @@ class FleetScheduler:
                 try:
                     finish = self._dispatch(handle, replica, tr)
                 except Exception as e:  # noqa: BLE001 — replica ≠ fleet
+                    if isinstance(e, net.RpcDeadlineExceeded):
+                        # silence past the inactivity bound — a partition
+                        # or a link too slow to serve from
+                        REGISTRY.fleet_rpc_deadlines.inc(
+                            model=self._owner.name)
                     self.slo.observe(replica.id, error=True)
                     self.pool.note_failure(replica)
                     streamed = handle.t_first_token is not None
@@ -188,10 +202,16 @@ class FleetScheduler:
         try:
             if tr is not None:
                 tr.begin("rpc", replica=replica.id)
+            # every dispatch stream — local or remote — runs through the
+            # bounded pump: explicit per-reply deadline, and the
+            # fleet.transport chaos site fires at the same layer a real
+            # NIC would fail
             finish, got_final = consume_stream(
                 handle,
-                replica.predict_stream(
-                    opts, trace_id=req.trace_id or req.correlation_id),
+                net.bounded_stream(
+                    replica.predict_stream(
+                        opts, trace_id=req.trace_id or req.correlation_id),
+                    self.rpc_timeout_s, rid=replica.id),
                 watchdog=self.watchdog, channel=self._wd_channel, tr=tr)
             if not got_final:
                 # the stream went away without a final usage reply — a
@@ -230,7 +250,12 @@ class FleetScheduler:
             pre_err = True
             try:
                 chunks = []
-                for c in pre.prefill_prefix(opts, trace_id=trace_id):
+                # bounded pump: a partitioned prefill replica surfaces as
+                # RpcDeadlineExceeded here (charged to `pre`), never as a
+                # silently hung handoff
+                for c in net.bounded_stream(
+                        pre.prefill_prefix(opts, trace_id=trace_id),
+                        self.rpc_timeout_s, rid=pre.id):
                     nbytes += len(
                         c["data"] if isinstance(c, dict) else c.data)
                     self.watchdog.pulse(self._wd_channel)
@@ -239,9 +264,19 @@ class FleetScheduler:
             finally:
                 pre.done(error=pre_err)
             blame = decode
-            res = decode.transfer_prefix(iter(chunks), trace_id=trace_id)
+            # importing a prefix is idempotent (a re-store of the same
+            # rows is a no-op on the peer), so the transfer gets the
+            # bounded jittered retry a flaky link deserves — the buffered
+            # chunk list re-streams cleanly
+            res = net.call_with_retries(
+                lambda: decode.transfer_prefix(iter(chunks),
+                                               trace_id=trace_id,
+                                               timeout=self.rpc_timeout_s),
+                rid=decode.id, what="transfer_prefix")
             ok = bool(getattr(res, "success", False))
         except Exception as e:  # noqa: BLE001 — disagg is an optimization
+            if isinstance(e, net.RpcDeadlineExceeded):
+                REGISTRY.fleet_rpc_deadlines.inc(model=self._owner.name)
             log.warning(
                 "fleet %s: disaggregated prefill %s→%s failed on %s (%s); "
                 "falling back to direct dispatch",
@@ -276,7 +311,7 @@ class FleetScheduler:
         occ = []
         kvu = []
         per_replica: dict[str, dict] = {}
-        for r in self.pool.replicas:
+        for r in self.pool.members():
             if r.state != "healthy":
                 per_replica[r.id] = {"state": r.state}
                 continue
@@ -313,7 +348,8 @@ class FleetScheduler:
     def export_gauges(self) -> None:
         """Scrape-time refresh of the fleet gauge family."""
         states = self.pool.states()
-        for state in ("starting", "healthy", "dead", "respawning"):
+        for state in ("starting", "healthy", "dead", "respawning",
+                      "evicted"):
             REGISTRY.fleet_replicas.set(
                 states.get(state, 0), model=self._owner.name, state=state)
 
@@ -327,7 +363,9 @@ class FleetServingModel:
 
     def __init__(self, mcfg: ModelConfig, app: AppConfig, factory,
                  *, replicas: int, prefill_replicas: int = 0,
-                 disagg_threshold: Optional[int] = None):
+                 disagg_threshold: Optional[int] = None,
+                 remote_hosts: Optional[list[str]] = None,
+                 rpc_timeout_s: Optional[float] = None):
         from localai_tpu.models.registry import resolve_tokenizer
         from localai_tpu.templates.cache import TemplateCache
 
@@ -357,9 +395,22 @@ class FleetServingModel:
                 "LOCALAI_FLEET_QUEUE_OVERRIDE", "0") or 0)
         except ValueError:
             queue_override = 0
+        # cross-host: every `host:port` in remote_hosts (default: the
+        # app's fleet_hosts / LOCALAI_FLEET_HOSTS list) is adopted as a
+        # RemoteReplica — same routing surface, but evicted-with-redial
+        # on failure instead of respawned (we do not own the peer)
+        from localai_tpu.fleet.replica import RemoteReplica
+
+        hosts = (remote_hosts if remote_hosts is not None
+                 else list(getattr(app, "fleet_hosts", []) or []))
+        remotes = [
+            RemoteReplica(f"{mcfg.name}/{host}", "decode", host, mcfg, app)
+            for host in hosts
+        ]
         self.pool = ReplicaPool(
             mcfg.name, factory,
             replicas=replicas, prefill_replicas=prefill_replicas,
+            remotes=remotes,
             track_queue_depth=queue_override > 0,
         )
         self.pool.start()
@@ -373,12 +424,33 @@ class FleetServingModel:
             disagg_threshold=(disagg_threshold
                               if disagg_threshold is not None
                               else app.fleet_disagg_threshold),
+            rpc_timeout_s=(rpc_timeout_s if rpc_timeout_s is not None
+                           else getattr(app, "fleet_rpc_timeout_s", None)),
         )
         self.loaded_at = time.monotonic()
         self.last_used = time.monotonic()
 
     def touch(self) -> None:
         self.last_used = time.monotonic()
+
+    def adopt_remote(self, address: str, role: str = "decode") -> dict:
+        """Adopt a remote worker at ``address`` into this fleet's pool
+        (the federation-registry join path: POST /federated/register on
+        the serving instance). Dial + LoadModel run inline so the caller
+        gets the verdict; a peer that registers and then fails its first
+        dial lands straight in the eviction/redial loop — offline-
+        eviction parity with the federation router's registry."""
+        from localai_tpu.fleet.replica import RemoteReplica
+
+        rid = f"{self.name}/{address}"
+        replica = RemoteReplica(rid, role, address, self.config, self.app)
+        adopted = self.pool.adopt(replica, wait=True)
+        current = self.pool.get(rid)
+        return {
+            "id": rid,
+            "adopted": adopted,
+            "state": current.state if current is not None else "unknown",
+        }
 
     @property
     def busy(self) -> bool:
@@ -404,7 +476,7 @@ class FleetServingModel:
             "prefix_transfer_bytes": self.scheduler.prefix_transfer_bytes,
             "disagg_fallbacks": self.scheduler.disagg_fallbacks,
             "shedding": {
-                r.id: self.slo.shedding(r.id) for r in self.pool.replicas
+                r.id: self.slo.shedding(r.id) for r in self.pool.members()
             },
         }
 

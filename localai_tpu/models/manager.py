@@ -800,8 +800,10 @@ class ModelManager:
         # generation protocol, and /v1/embeddings//v1/rerank need the
         # in-process runner.embed surface.
         ext = self.app.external_backends.get(mcfg.name)
-        if (self.app.fleet_replicas > 1 and not ext
-                and mcfg.backend in ("", "worker")):
+        # remote hosts alone are enough to go fleet-tier: a box with one
+        # (or zero) local engines can still front a pod of adopted peers
+        if ((self.app.fleet_replicas > 1 or self.app.fleet_hosts)
+                and not ext and mcfg.backend in ("", "worker")):
             from localai_tpu.config.model_config import Usecase
 
             if (mcfg.has_usecase(Usecase.EMBEDDINGS)
@@ -854,7 +856,11 @@ class ModelManager:
         ``worker`` (default) spawns one gRPC worker process per replica
         (crash isolation; pin devices per replica via worker_env),
         ``inprocess`` builds N engines in this process (CPU tests, CI
-        smoke, single-host experiments)."""
+        smoke, single-host experiments). On top of the local replicas,
+        every ``host:port`` in app.fleet_hosts is adopted as a
+        RemoteReplica (cross-host serving; the facade reads the list off
+        the app config), and more peers can join at runtime through
+        POST /federated/register."""
         from localai_tpu.fleet import FleetServingModel
         from localai_tpu.fleet.replica import InProcessReplica, WorkerReplica
 

@@ -332,6 +332,40 @@ class Registry:
             "Current jittered-exponential respawn hold per dead replica "
             "(0 after a successful rejoin)",
         )
+        # cross-host fleet (remote replica adoption + network faults):
+        # remotes are evicted-with-redial, never respawned — this process
+        # does not own a peer's lifecycle
+        self.fleet_adoptions = Counter(
+            "localai_fleet_adoptions_total",
+            "Remote replicas adopted into a fleet pool (static "
+            "LOCALAI_FLEET_HOSTS entries + federation-registry joins)",
+        )
+        self.fleet_evictions = Counter(
+            "localai_fleet_evictions_total",
+            "Remote replicas evicted from routing after consecutive "
+            "failed health dials (partition / refused / flapping peer)",
+        )
+        self.fleet_redials = Counter(
+            "localai_fleet_redials_total",
+            "Evicted remote replicas successfully redialed back into "
+            "the routing ring",
+        )
+        self.fleet_redial_backoff = Gauge(
+            "localai_fleet_redial_backoff_s",
+            "Current jittered-exponential redial hold per evicted remote "
+            "replica (0 after a successful rejoin)",
+        )
+        self.fleet_rpc_retries = Counter(
+            "localai_fleet_rpc_retries_total",
+            "Bounded jittered retries of idempotent cross-host fleet "
+            "RPCs, by rpc name (fleet.net.call_with_retries)",
+        )
+        self.fleet_rpc_deadlines = Counter(
+            "localai_fleet_rpc_deadline_exceeded_total",
+            "Cross-host fleet RPCs (dispatch/prefill stream inactivity "
+            "or control-plane calls) that blew "
+            "LOCALAI_FLEET_RPC_TIMEOUT_S",
+        )
         # -- fault injection + self-healing (localai_tpu.faults) -----------
         self.faults_injected = Counter(
             "localai_faults_injected_total",

@@ -6,6 +6,16 @@ serialization when parallel requests are disabled, UTF-8-safe streaming
 (byte chunks reassembled into runes happens worker-side here; deltas are
 whole UTF-8 strings by construction, core/backend/llm.go:122-138 is no
 longer needed).
+
+Deadline discipline: EVERY RPC carries a default deadline — control-plane
+calls (health/status/metrics/tokenize/stores) a short one, work-shaped
+calls (predict/load/transcode) a generation-scale one — and the channel
+runs gRPC keepalive pings so a peer that stops ACKing (SIGKILLed host,
+network partition: no RST ever arrives) fails in-flight RPCs with
+UNAVAILABLE instead of holding them to the full deadline. Streams are
+additionally inactivity-bounded by the fleet tier
+(fleet.net.bounded_stream), since their *total* deadline must stay
+generation-scale.
 """
 
 from __future__ import annotations
@@ -21,6 +31,14 @@ from localai_tpu.worker import rpc
 from localai_tpu.worker.rpc import BackendStub
 
 
+# work-shaped RPCs (generation, model load, media): bounded, but at the
+# scale of the work itself
+WORK_TIMEOUT_S = 600.0
+# control-plane RPCs (health already 5 s, status 5 s, metrics 10 s,
+# tokenize/stores below): a wedged peer must cost seconds on these paths
+CONTROL_TIMEOUT_S = 60.0
+
+
 class WorkerClient:
     def __init__(self, address: str, *, parallel: bool = True,
                  watchdog: Optional[Any] = None):
@@ -28,7 +46,15 @@ class WorkerClient:
         self._channel = grpc.insecure_channel(
             address,
             options=[("grpc.max_receive_message_length", 256 * 1024 * 1024),
-                     ("grpc.max_send_message_length", 256 * 1024 * 1024)],
+                     ("grpc.max_send_message_length", 256 * 1024 * 1024),
+                     # keepalive: a partitioned/SIGKILLed peer never sends
+                     # a RST, so without pings an in-flight stream would
+                     # only fail at its total deadline — 30 s ping + 10 s
+                     # ack bound turns that silence into UNAVAILABLE
+                     ("grpc.keepalive_time_ms", 30_000),
+                     ("grpc.keepalive_timeout_ms", 10_000),
+                     ("grpc.keepalive_permit_without_calls", 0),
+                     ("grpc.http2.max_pings_without_data", 0)],
         )
         self._stub = BackendStub(self._channel)
         # parallel=False serializes all calls (parity: --parallel-requests
@@ -72,20 +98,20 @@ class WorkerClient:
 
     def load_model(self, *, model: str = "", config_yaml: str = "",
                    model_path: str = "", context_size: int = 0,
-                   seed: int = 0, timeout: float = 600.0) -> pb.Result:
+                   seed: int = 0, timeout: float = WORK_TIMEOUT_S) -> pb.Result:
         return self._call(self._stub.LoadModel, pb.ModelOptions(
             model=model, config_yaml=config_yaml, model_path=model_path,
             context_size=context_size, seed=seed,
         ), timeout)
 
     def predict(self, opts: pb.PredictOptions,
-                timeout: float = 600.0,
+                timeout: float = WORK_TIMEOUT_S,
                 trace_id: str = "") -> pb.Reply:
         return self._call(self._stub.Predict, opts, timeout,
                           metadata=rpc.trace_metadata(trace_id) or None)
 
     def predict_stream(self, opts: pb.PredictOptions,
-                       timeout: float = 600.0,
+                       timeout: float = WORK_TIMEOUT_S,
                        trace_id: str = "") -> Iterator[pb.Reply]:
         self._enter()
         try:
@@ -97,7 +123,7 @@ class WorkerClient:
             self._exit()
 
     def prefill_prefix(self, opts: pb.PredictOptions,
-                       timeout: float = 600.0,
+                       timeout: float = WORK_TIMEOUT_S,
                        trace_id: str = "") -> Iterator[pb.PrefixChunk]:
         """Run a prefill on this (prefill-role) replica and stream back its
         packed KV-prefix chunks (fleet disaggregation)."""
@@ -111,20 +137,20 @@ class WorkerClient:
             self._exit()
 
     def transfer_prefix(self, chunks: Iterator[pb.PrefixChunk],
-                        timeout: float = 600.0,
+                        timeout: float = WORK_TIMEOUT_S,
                         trace_id: str = "") -> pb.Result:
         """Stream prefix chunks into this (decode-role) replica's cache."""
         return self._call(self._stub.TransferPrefix, chunks, timeout,
                           metadata=rpc.trace_metadata(trace_id) or None)
 
     def embedding(self, text: str = "", tokens: Optional[list[int]] = None,
-                  timeout: float = 600.0) -> list[float]:
+                  timeout: float = WORK_TIMEOUT_S) -> list[float]:
         res = self._call(self._stub.Embedding, pb.EmbeddingRequest(
             text=text, tokens=tokens or []), timeout)
         return list(res.embeddings)
 
     def tokenize(self, text: str, add_bos: bool = False,
-                 timeout: float = 60.0) -> list[int]:
+                 timeout: float = CONTROL_TIMEOUT_S) -> list[int]:
         res = self._call(self._stub.TokenizeString, pb.TokenizationRequest(
             text=text, add_bos=add_bos), timeout)
         return list(res.tokens)
@@ -137,13 +163,13 @@ class WorkerClient:
         return json.loads(res.json or "{}")
 
     def tts(self, text: str, *, voice: str = "", language: str = "",
-            dst: str = "", timeout: float = 600.0) -> pb.AudioResult:
+            dst: str = "", timeout: float = WORK_TIMEOUT_S) -> pb.AudioResult:
         return self._call(self._stub.TTS, pb.TTSRequest(
             text=text, voice=voice, language=language, dst=dst), timeout)
 
     def sound_generation(self, text: str, *, duration: Optional[float] = None,
                          dst: str = "",
-                         timeout: float = 600.0) -> pb.AudioResult:
+                         timeout: float = WORK_TIMEOUT_S) -> pb.AudioResult:
         req = pb.SoundGenerationRequest(text=text, dst=dst)
         if duration is not None:
             req.duration = duration
@@ -151,7 +177,7 @@ class WorkerClient:
 
     def transcribe(self, *, path: str = "", audio: bytes = b"",
                    language: str = "", translate: bool = False,
-                   timeout: float = 600.0) -> pb.TranscriptResult:
+                   timeout: float = WORK_TIMEOUT_S) -> pb.TranscriptResult:
         return self._call(self._stub.AudioTranscription, pb.TranscriptRequest(
             path=path, audio=audio, language=language, translate=translate,
         ), timeout)
@@ -159,36 +185,36 @@ class WorkerClient:
     def generate_image(self, prompt: str, *, negative: str = "",
                        width: int = 512, height: int = 512, step: int = 0,
                        seed: int = 0, dst: str = "",
-                       timeout: float = 600.0) -> pb.ImageResult:
+                       timeout: float = WORK_TIMEOUT_S) -> pb.ImageResult:
         return self._call(self._stub.GenerateImage, pb.GenerateImageRequest(
             positive_prompt=prompt, negative_prompt=negative,
             width=width, height=height, step=step, seed=seed, dst=dst,
         ), timeout)
 
     def rerank(self, query: str, documents: list[str], top_n: int = 0,
-               timeout: float = 600.0) -> pb.RerankResult:
+               timeout: float = WORK_TIMEOUT_S) -> pb.RerankResult:
         return self._call(self._stub.Rerank, pb.RerankRequest(
             query=query, documents=documents, top_n=top_n), timeout)
 
     def stores_set(self, keys: list[list[float]],
-                   values: list[bytes], timeout: float = 60.0) -> pb.Result:
+                   values: list[bytes], timeout: float = CONTROL_TIMEOUT_S) -> pb.Result:
         return self._call(self._stub.StoresSet, pb.StoresSetOptions(
             keys=[pb.StoresKey(floats=k) for k in keys],
             values=[pb.StoresValue(bytes=v) for v in values],
         ), timeout)
 
     def stores_get(self, keys: list[list[float]],
-                   timeout: float = 60.0) -> pb.StoresGetResult:
+                   timeout: float = CONTROL_TIMEOUT_S) -> pb.StoresGetResult:
         return self._call(self._stub.StoresGet, pb.StoresGetOptions(
             keys=[pb.StoresKey(floats=k) for k in keys]), timeout)
 
     def stores_find(self, key: list[float], top_k: int,
-                    timeout: float = 60.0) -> pb.StoresFindResult:
+                    timeout: float = CONTROL_TIMEOUT_S) -> pb.StoresFindResult:
         return self._call(self._stub.StoresFind, pb.StoresFindOptions(
             key=pb.StoresKey(floats=key), top_k=top_k), timeout)
 
     def stores_delete(self, keys: list[list[float]],
-                      timeout: float = 60.0) -> pb.Result:
+                      timeout: float = CONTROL_TIMEOUT_S) -> pb.Result:
         return self._call(self._stub.StoresDelete, pb.StoresDeleteOptions(
             keys=[pb.StoresKey(floats=k) for k in keys]), timeout)
 

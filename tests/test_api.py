@@ -998,6 +998,40 @@ def test_batches_ui_page_served(client):
     assert "Batch jobs" in r.text
 
 
+def test_fleet_register_endpoint_guards(server, client):
+    """POST /federated/register on the serving instance (fleet-tier
+    registry join): unroutable-by-construction addresses are 400, the
+    peer_token guard answers 401, and with no fleet-served model loaded
+    a well-formed join is a clean 409 — never a silent no-op."""
+    # constructionally unroutable: rejected before any model is consulted
+    for bad in ("127.0.0.1:0", ":8080", "0.0.0.0:1234", "host:nope"):
+        r = client.post("/federated/register", json={"address": bad})
+        assert r.status_code == 400, (bad, r.status_code)
+    assert client.post("/federated/register",
+                       json={}).status_code == 400
+    r = client.post("/federated/register",
+                    json={"address": "127.0.0.1:19999",
+                          "role": "supervisor"})
+    assert r.status_code == 400  # unknown role
+    # no fleet-served model in this (single-engine) server
+    r = client.post("/federated/register",
+                    json={"address": "127.0.0.1:19999"})
+    assert r.status_code == 409
+    # the shared peer_token guards the join exactly like the router's
+    # registry guards registration
+    server.state.config.peer_token = "sekrit"
+    try:
+        r = client.post("/federated/register",
+                        json={"address": "127.0.0.1:19999"})
+        assert r.status_code == 401
+        r = client.post("/federated/register",
+                        json={"address": "127.0.0.1:19999"},
+                        headers={"Authorization": "Bearer sekrit"})
+        assert r.status_code == 409  # authorized, still no fleet model
+    finally:
+        server.state.config.peer_token = ""
+
+
 def test_embeddings_and_rerank_shed_under_overload(client):
     """Satellite: the SLO admission hook covers embeddings and rerank too,
     with the same preserved Retry-After header."""

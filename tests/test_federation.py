@@ -204,6 +204,81 @@ def test_nodes_endpoint_and_registration_token(cluster):
         assert len(fed.nodes()) == 3
 
 
+def test_register_rejects_unroutable_addresses(cluster):
+    """Hardened register: an advertised address that is unroutable BY
+    CONSTRUCTION (empty host, missing/zero port, wildcard bind) is a 400,
+    never a registry entry — it could only ever seed a permanently
+    offline node."""
+    a, b, fed, router = cluster
+    with httpx.Client(base_url=f"http://{router.addr}",
+                      timeout=10.0) as c:
+        before = len(fed.nodes())
+        for bad in (":8080",            # empty host
+                    "127.0.0.1:0",      # port 0
+                    "127.0.0.1",        # no port at all
+                    "127.0.0.1:http",   # garbage port
+                    "127.0.0.1:70000",  # out of range
+                    "0.0.0.0:8080",     # wildcard bind address
+                    "[::]:8080"):
+            r = c.post("/federated/register", json={"address": bad})
+            assert r.status_code == 400, (bad, r.status_code)
+        assert len(fed.nodes()) == before
+        # a well-formed address still lands (incl. IPv6 literal)
+        assert c.post("/federated/register",
+                      json={"address": "[::1]:9001"}).status_code == 200
+
+
+def test_validate_advertised_address_unit():
+    from localai_tpu.federation.server import validate_advertised_address
+
+    assert validate_advertised_address("127.0.0.1:8080")
+    assert validate_advertised_address("http://node-7:9090")
+    assert validate_advertised_address("[::1]:9001")
+    for bad in ("", ":1", "host:", "host:0", "0.0.0.0:5", "*:5",
+                "https://:8080", "host:-1"):
+        with pytest.raises(ValueError):
+            validate_advertised_address(bad)
+
+
+def test_evict_then_rejoin_resets_failure_count(cluster):
+    """Offline-eviction parity with the fleet pool: a node's failure
+    count survives while it is offline but RESETS the moment it rejoins
+    (re-register or health-loop revival) — mirror of
+    ReplicaPool._note_rejoined, so the next incident escalates from a
+    clean slate."""
+    a, b, fed, router = cluster
+    node = next(n for n in fed.nodes() if n.id == a.addr)
+    fed.mark_offline(node)
+    fed.mark_offline(node)
+    assert node.failures == 2 and not node.online
+    # rejoin path 1: explicit re-register
+    again = fed.register(a.addr)
+    assert again is node and node.online and node.failures == 0
+
+    # rejoin path 2: the health loop revives a node that answers again
+    fed.mark_offline(node)
+    assert node.failures == 1
+    asyncio.run(_one_health_pass(fed))
+    assert node.online and node.failures == 0
+
+
+async def _one_health_pass(fed):
+    from aiohttp import ClientSession
+
+    async with ClientSession() as session:
+        await fed.check_health(session)
+
+
+def test_health_loop_counts_failures_while_offline():
+    """Failed sweeps advance the failure count (the eviction signal);
+    only a rejoin clears it."""
+    fed = FederatedServer(["127.0.0.1:1"], health_interval=60)
+    node = fed.nodes()[0]
+    asyncio.run(_one_health_pass(fed))
+    asyncio.run(_one_health_pass(fed))
+    assert not node.online and node.failures == 2
+
+
 def test_announce_retries_until_router_up():
     stub = _AppThread(_instance_app("solo"))
     fed = FederatedServer([], peer_token="tok", health_interval=0.2)

@@ -711,6 +711,296 @@ def test_respawn_backoff_grows_caps_and_resets():
 
 
 # ---------------------------------------------------------------------------
+# cross-host fleet: remote replica adoption, eviction/redial, RPC deadlines
+
+
+def _grpc_workers(n):
+    """n in-thread gRPC workers on 127.0.0.1 ports (the cross-host shape
+    on loopback). Returns ([(server, servicer)], [addr])."""
+    from localai_tpu.worker.server import BackendServicer, serve_worker
+
+    workers, addrs = [], []
+    for _ in range(n):
+        sv = BackendServicer()
+        server, port = serve_worker("127.0.0.1:0", servicer=sv,
+                                    block=False)
+        workers.append((server, sv))
+        addrs.append(f"127.0.0.1:{port}")
+    return workers, addrs
+
+
+def _stop_grpc_workers(workers):
+    for server, sv in workers:
+        sv.shutdown()
+        server.stop(grace=None)
+
+
+def _remote_fleet(addrs, **kw):
+    from localai_tpu.fleet import FleetServingModel
+
+    app = AppConfig()
+    mcfg = ModelConfig.model_validate({**TINY, "context_size": 96})
+    return FleetServingModel(mcfg, app, lambda rid, role: None,
+                             replicas=0, remote_hosts=list(addrs),
+                             disagg_threshold=1 << 30, **kw)
+
+
+def test_remote_adoption_from_fleet_hosts_and_registry_join():
+    """Static adoption (the LOCALAI_FLEET_HOSTS path) boots remote
+    workers into the pool as non-respawnable RemoteReplicas; a
+    mid-traffic adopt_remote (the /federated/register path) joins
+    another, under traffic, with the adoption counter moving and the
+    newcomer taking least-loaded requests."""
+    workers, addrs = _grpc_workers(2)
+    fm = None
+    try:
+        fm = _remote_fleet(addrs[:1])
+        assert [r.state for r in fm.pool.replicas] == ["healthy"]
+        assert not fm.pool.replicas[0].respawnable
+        h = _gen(fm, "served across the wire by an adopted remote")
+        assert h.finish_reason in ("stop", "length")
+        snap = fm.pool.snapshot()
+        assert snap["replicas"][0]["remote"] is True
+        assert snap["replicas"][0]["address"] == addrs[0]
+
+        # registry join mid-traffic: requests keep completing around it
+        h_live = fm.scheduler.submit(GenRequest(
+            prompt=fm.tokenizer.encode("in flight during the join"),
+            max_new_tokens=24, temperature=0.0))
+        verdict = fm.adopt_remote(addrs[1])
+        assert verdict["adopted"] and verdict["state"] == "healthy"
+        assert fm.pool.adoptions == 2  # the static host counts too
+        h_live.result(timeout=120)
+        assert h_live.finish_reason in ("stop", "length")
+        # a duplicate join is refused, not doubled
+        assert fm.adopt_remote(addrs[1])["adopted"] is False
+        # the fresh peer (0 dispatched) absorbs least-loaded traffic
+        joined = fm.pool.get(verdict["id"])
+        for i in range(3):
+            assert _gen(fm, f"[{i}]", max_new=3).finish_reason in (
+                "stop", "length")
+        assert joined.dispatched >= 1
+    finally:
+        if fm is not None:
+            fm.close()
+        _stop_grpc_workers(workers)
+
+
+def test_partition_evicts_remote_with_zero_lost_requests():
+    """fleet.dial + fleet.transport faults against one remote = a
+    network partition: every request completes via route-around, the
+    victim is EVICTED (distinct from local death/respawn), and healing
+    the partition redials it back with the backoff clock reset."""
+    from localai_tpu import faults
+
+    workers, addrs = _grpc_workers(2)
+    fm = None
+    try:
+        fm = _remote_fleet(addrs)
+        pool = fm.pool
+        pool.redial_backoff_base = 0.1
+        pool.redial_backoff_cap = 0.5
+        for i in range(2):
+            _gen(fm, f"[w{i}]")  # both peers warm
+        victim = pool.replicas[0]
+        faults.arm(faults.FaultSpec(site="fleet.transport", mode="raise",
+                                    match=victim.id, times=0))
+        faults.arm(faults.FaultSpec(site="fleet.dial", mode="raise",
+                                    match=victim.id, times=0))
+        handles = [fm.scheduler.submit(GenRequest(
+            prompt=fm.tokenizer.encode(
+                f"partitioned request {i} with a full block of prompt"),
+            max_new_tokens=5, temperature=0.0)) for i in range(5)]
+        for h in handles:
+            h.result(timeout=120)
+        assert all(h.finish_reason in ("stop", "length")
+                   for h in handles), [h.finish_reason for h in handles]
+        deadline = time.monotonic() + 30
+        while victim.state != "evicted" and time.monotonic() < deadline:
+            pool.poll_once()
+            time.sleep(0.05)
+        assert victim.state == "evicted"
+        assert pool.evictions == 1
+        # partition heals → backed-off redial rejoins and resets
+        faults.clear()
+        deadline = time.monotonic() + 60
+        while victim.state != "healthy" and time.monotonic() < deadline:
+            pool.poll_once()
+            time.sleep(0.05)
+        assert victim.state == "healthy"
+        assert pool.redials == 1
+        assert victim.id not in pool.redial_backoff_s
+    finally:
+        faults.clear()
+        if fm is not None:
+            fm.close()
+        _stop_grpc_workers(workers)
+
+
+def test_redial_backoff_grows_caps_and_resets():
+    """An evicted remote whose redials keep failing walks the jittered
+    exponential hold schedule (growing, capped) and a successful rejoin
+    zeroes the gauge — the remote twin of respawn backoff."""
+    from localai_tpu import faults
+    from localai_tpu.fleet.pool import ReplicaPool
+    from localai_tpu.obs.metrics import REGISTRY
+
+    class _Remote(BaseReplica):
+        respawnable = False
+
+        def __init__(self, rid, role="decode"):
+            super().__init__(rid, role)
+            self.state = "healthy"
+
+        def start(self):
+            pass
+
+        def _dial(self, timeout):
+            return True
+
+        def process_alive(self):
+            return True
+
+        def metrics(self):
+            return {}
+
+        def stop(self):
+            pass
+
+    pool = ReplicaPool("redial", lambda rid, role: None, replicas=0,
+                       health_interval=3600.0)
+    pool.redial_backoff_base = 0.05
+    pool.redial_backoff_cap = 0.15
+    r = _Remote("redial/peer")
+    pool.replicas.append(r)
+    pool._started = True
+    try:
+        faults.arm(faults.FaultSpec(site="fleet.dial", mode="raise",
+                                    match=r.id, times=4))
+        pool.note_failure(r)
+        assert r.state == "evicted"
+        backoffs = []
+        deadline = time.monotonic() + 30
+        while len(backoffs) < 3 and time.monotonic() < deadline:
+            pool.poll_once()
+            b = pool.redial_backoff_s.get(r.id)
+            if b is not None and (not backoffs or b != backoffs[-1]):
+                backoffs.append(b)
+            time.sleep(0.01)
+        assert len(backoffs) == 3, backoffs
+        assert backoffs[1] > backoffs[0], backoffs
+        assert all(b <= pool.redial_backoff_cap for b in backoffs)
+        deadline = time.monotonic() + 30
+        while r.state != "healthy" and time.monotonic() < deadline:
+            pool.poll_once()
+            time.sleep(0.01)
+        assert r.state == "healthy"
+        assert r.id not in pool.redial_backoff_s
+        assert pool.evictions == 1 and pool.redials == 1
+        text = REGISTRY.render()
+        assert ('localai_fleet_redial_backoff_s'
+                '{model="redial",replica="redial/peer"} 0.0') in text
+        assert 'localai_fleet_evictions_total' in text
+        assert 'localai_fleet_redials_total' in text
+    finally:
+        faults.clear()
+        pool.shutdown()
+
+
+def test_slow_link_deadline_fires_and_fails_over():
+    """A replica whose stream stays silent past the fleet RPC deadline:
+    the bounded pump raises, the dispatch fails over pre-stream, and the
+    request completes on the healthy peer — a dead remote can never hang
+    the dispatch thread."""
+    from types import SimpleNamespace
+
+    from localai_tpu.fleet.pool import ReplicaPool
+    from localai_tpu.fleet.serving import FleetScheduler
+    from localai_tpu.obs.slo import SLOTracker
+
+    class _SlowReplica(_ScriptedReplica):
+        slow = False
+
+        def predict_stream(self, opts, trace_id=""):
+            if self.slow:
+                time.sleep(5.0)  # silence, not an error — like a
+                #                  partitioned peer
+            yield _Reply(b"x")
+            yield _Reply(b"", 3, 5, "stop")
+
+    pool = ReplicaPool("slow", _SlowReplica, replicas=2,
+                       health_interval=3600.0)
+    pool.start()
+    router = Router(pool, None, block_tokens=16)
+    sched = FleetScheduler(
+        SimpleNamespace(name="slow"), pool, router,
+        SLOTracker(targets={"e2e_ms": float("inf")}),
+        disagg_threshold=1 << 30, rpc_timeout_s=0.5)
+    try:
+        prompt = list(range(32))
+        victim, _ = router.route(prompt)
+        victim.slow = True
+        t0 = time.monotonic()
+        h = sched.submit(GenRequest(prompt=prompt, max_new_tokens=4))
+        h.result(timeout=30)
+        assert h.finish_reason == "stop"      # the healthy peer finished
+        assert sched.failovers == 1
+        assert time.monotonic() - t0 < 4.0    # deadline, not the 5 s nap
+    finally:
+        pool.shutdown()
+
+
+def test_bounded_stream_deadline_and_passthrough():
+    from localai_tpu.fleet import net
+
+    # passthrough: items come through in order, completion is clean
+    assert list(net.bounded_stream(iter([1, 2, 3]), 5.0)) == [1, 2, 3]
+
+    # an upstream exception is relayed, not swallowed
+    def boom():
+        yield 1
+        raise RuntimeError("mid-stream death")
+
+    it = net.bounded_stream(boom(), 5.0)
+    assert next(it) == 1
+    with pytest.raises(RuntimeError, match="mid-stream death"):
+        next(it)
+
+    # silence past the deadline raises RpcDeadlineExceeded
+    def stall():
+        yield 1
+        time.sleep(10.0)
+        yield 2
+
+    it = net.bounded_stream(stall(), 0.3, rid="m/slow")
+    assert next(it) == 1
+    with pytest.raises(net.RpcDeadlineExceeded, match="m/slow"):
+        next(it)
+
+
+def test_call_with_retries_is_bounded_and_jittered():
+    from localai_tpu.fleet import net
+
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise ConnectionError("flap")
+        return "ok"
+
+    assert net.call_with_retries(flaky, retries=3,
+                                 base_delay=0.01) == "ok"
+    assert calls["n"] == 3
+
+    def always_down():
+        raise ConnectionError("down")
+
+    with pytest.raises(ConnectionError):
+        net.call_with_retries(always_down, retries=2, base_delay=0.01)
+
+
+# ---------------------------------------------------------------------------
 # per-replica device pinning presets (--fleet-device-pinning)
 
 

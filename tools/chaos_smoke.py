@@ -44,6 +44,27 @@ Scenarios (≥6, see ``SCENARIOS``):
   shed_recover      burn-rate shedding trips under a synthetic overload
                     and recovers when the window slides (injected clock)
 
+Cross-host network scenarios (real gRPC workers on 127.0.0.1 ports,
+adopted as RemoteReplicas — the fleet's cross-host shape on loopback):
+
+  network_partition one remote's link drops every message and refuses
+                    every dial → traffic routes around it with ZERO lost
+                    requests, the peer is EVICTED (never respawned), and
+                    once the partition heals a backed-off redial rejoins
+                    it
+  slow_link         one remote delivers each reply slower than
+                    LOCALAI_FLEET_RPC_TIMEOUT_S → the dispatch deadline
+                    fires, the request fails over (affinity degrades to
+                    the healthy peer), nothing is lost
+  flapping_peer     a remote evicts, fails several redials (holds grow,
+                    capped), rejoins, then flaps AGAIN → the second
+                    incident's backoff restarts from the base (reset
+                    proven by observation, not trust)
+  registry_join     a second remote registers mid-traffic (the
+                    /federated/register adoption path) → in-flight and
+                    subsequent requests all complete and the newcomer
+                    starts taking traffic
+
 Usage:  python -m tools.chaos_smoke [--out chaos_report.json]
         python -m tools.chaos_smoke --only nan_poison,engine_rebuild
 """
@@ -535,6 +556,364 @@ def scenario_respawn_backoff() -> dict:
         fm.close()
 
 
+# -- cross-host network scenarios ------------------------------------------
+# (real gRPC workers bound to 127.0.0.1 ports, adopted as RemoteReplicas:
+# the same dial/stream/LoadModel path a real NIC carries, on loopback)
+
+
+def _remote_fleet(name: str, n: int = 2, *, rpc_timeout_s=None):
+    """``n`` in-thread gRPC workers + a FleetServingModel that adopts
+    them as remotes (0 local replicas). Returns (fm, workers, addrs);
+    ``workers`` keeps the servicers so the scenarios can audit each
+    peer's BlockAllocator after the dust settles."""
+    from localai_tpu.config.app_config import AppConfig
+    from localai_tpu.config.model_config import ModelConfig
+    from localai_tpu.fleet import FleetServingModel
+    from localai_tpu.worker.server import BackendServicer, serve_worker
+
+    app = AppConfig()
+    mcfg = ModelConfig.model_validate({
+        "name": name, "model": "debug:tiny", "context_size": 256,
+        "parameters": {"temperature": 0.0, "max_tokens": 8},
+        "engine": {"max_slots": 2, "prefill_buckets": [16, 32, 64],
+                   "dtype": "float32", "kv_dtype": "float32",
+                   "kv_block_tokens": 16,
+                   # the network scenarios measure LINK behavior under a
+                   # chaos-scale deadline; speculation's lazy verify-
+                   # program compile would add seconds of legitimate
+                   # first-window silence (spec chaos coverage lives in
+                   # scenario_spec_divergence)
+                   "spec": False},
+    })
+    workers = []
+    addrs = []
+    for _ in range(n):
+        sv = BackendServicer()
+        server, port = serve_worker("127.0.0.1:0", servicer=sv,
+                                    block=False)
+        workers.append((server, sv))
+        addrs.append(f"127.0.0.1:{port}")
+    fm = FleetServingModel(mcfg, app, lambda rid, role: None, replicas=0,
+                           remote_hosts=addrs, disagg_threshold=1 << 30,
+                           rpc_timeout_s=rpc_timeout_s)
+    return fm, workers, addrs
+
+
+def _stop_workers(workers) -> None:
+    for server, sv in workers:
+        try:
+            sv.shutdown()
+        finally:
+            server.stop(grace=None)
+
+
+def _remote_blocks_conserved(workers, settle_s: float = 15.0) -> list[str]:
+    """Block conservation on every PEER's allocator — a partition must
+    not leak reservations on either side of the wire. An abandoned
+    dispatch's cancel propagates asynchronously (the peer's engine may
+    still be draining its last batch), so transient occupancy gets
+    ``settle_s`` to clear before it counts as a leak."""
+    deadline = time.monotonic() + settle_s
+    while True:
+        problems = []
+        for _, sv in workers:
+            sm = sv._sm
+            if sm is None:
+                continue
+            for p in _blocks_conserved(sm.runner):
+                problems.append(f"peer {sm.name}: {p}")
+        if not problems or time.monotonic() >= deadline:
+            return problems
+        time.sleep(0.25)
+
+
+def scenario_network_partition() -> dict:
+    """A partition eats every message to (and dial of) one remote: all
+    traffic completes via route-around — zero lost requests — the peer is
+    evicted (not respawned), and after the partition heals a backed-off
+    redial returns it to the ring."""
+    from localai_tpu import faults
+
+    fm, workers, _ = _remote_fleet("chaos-partition")
+    pool = fm.pool
+    pool.redial_backoff_base = 0.2
+    pool.redial_backoff_cap = 1.0
+    try:
+        warm = [fm.scheduler.submit(
+            _req(f"pre-partition warmup {i}", max_new_tokens=6))
+            for i in range(2)]
+        for h in warm:
+            h.result(120)
+        victim = pool.replicas[0]
+        # the partition: every stream message dropped, every dial refused
+        faults.arm(faults.FaultSpec(site="fleet.transport", mode="raise",
+                                    match=victim.id, times=0))
+        faults.arm(faults.FaultSpec(site="fleet.dial", mode="raise",
+                                    match=victim.id, times=0))
+        traffic = [fm.scheduler.submit(
+            _req(f"partitioned traffic {i} with enough prompt length",
+                 max_new_tokens=6)) for i in range(6)]
+        for h in traffic:
+            h.result(120)
+        problems = _resolved(warm + traffic)
+        lost = [h.id for h in traffic
+                if h.finish_reason not in ("stop", "length")]
+        if lost:
+            problems.append(
+                f"requests lost to the partition: {lost} "
+                f"({[h.finish_reason for h in traffic]})")
+        deadline = time.monotonic() + 30
+        while victim.state != "evicted" and time.monotonic() < deadline:
+            pool.poll_once()
+            time.sleep(0.05)
+        if victim.state != "evicted":
+            problems.append(
+                f"partitioned remote is {victim.state!r}, not evicted")
+        if pool.evictions < 1:
+            problems.append("eviction counter never moved")
+        # requests keep landing on the survivor while the victim is out
+        pick, _ = fm.router.route(
+            _req("route check prompt, long enough for a block").prompt)
+        if pick.id == victim.id:
+            problems.append("router still places traffic on the "
+                            "partitioned remote")
+        # heal the partition: the next redial (past its hold) rejoins
+        faults.clear()
+        deadline = time.monotonic() + 60
+        while victim.state != "healthy" and time.monotonic() < deadline:
+            pool.poll_once()
+            time.sleep(0.05)
+        if victim.state != "healthy":
+            problems.append(
+                f"remote never rejoined after the partition healed "
+                f"(state {victim.state})")
+        if pool.redials < 1:
+            problems.append("redial counter never moved")
+        if pool.redial_backoff_s.get(victim.id):
+            problems.append("redial backoff did not reset on rejoin")
+        after = fm.scheduler.submit(_req("post-heal request",
+                                         max_new_tokens=6))
+        after.result(120)
+        problems += _resolved([after])
+        problems += _remote_blocks_conserved(workers)
+        return {"problems": problems,
+                "evictions": pool.evictions, "redials": pool.redials,
+                "failovers": fm.scheduler.failovers}
+    finally:
+        faults.clear()
+        fm.close()
+        _stop_workers(workers)
+
+
+def scenario_slow_link() -> dict:
+    """One remote's link crawls: each reply arrives slower than the RPC
+    deadline. The dispatch deadline fires (localai_fleet_rpc_deadline_
+    exceeded_total), the request fails over — affinity degrades to the
+    healthy peer — and nothing is lost."""
+    from localai_tpu import faults
+    from localai_tpu.obs.metrics import REGISTRY
+    from localai_tpu.worker.serving import predict_options
+
+    fm, workers, _ = _remote_fleet("chaos-slowlink", rpc_timeout_s=0.75)
+    try:
+        # warm BOTH peers directly on the exact prompt SHAPES the chaos
+        # traffic uses — twice per peer, because the paged engine
+        # compiles lazily per shape: the first family member takes the
+        # fresh chunked-prefill program, the second takes the prefix-
+        # SHARED resume program (the warm prompt seeded the block pool's
+        # prefix sharing). Either compile is seconds of legitimate
+        # silence, and a cold peer would trip the tight chaos-scale
+        # deadline for reasons that are not the link under test — the
+        # production sizing rule is deadline > worst-case queue wait +
+        # TTFT
+        for r in fm.pool.replicas:
+            for tag in ("98", "99"):
+                opts = predict_options(_req(
+                    f"slow link shared prompt prefix for affinity {tag}",
+                    max_new_tokens=5))
+                for _ in r.predict_stream(opts):
+                    pass
+        # the victim must be the affinity TARGET of the traffic family —
+        # otherwise the slow link sits on a replica the prompts never
+        # reach and nothing is under test
+        victim, _ = fm.router.route(_req(
+            "slow link shared prompt prefix for affinity 00").prompt)
+        faults.arm(faults.FaultSpec(site="fleet.transport", mode="sleep",
+                                    delay_s=2.0, match=victim.id, times=0))
+        # an affinity-length prompt family: the same prefix keeps hashing
+        # to the same ring slot, so if that slot is the victim the
+        # deadline + failover path runs every time. Sequential on
+        # purpose: the inactivity deadline covers queue wait too, so a
+        # concurrent stampede of failovers onto the 2-slot survivor
+        # would trip ITS deadline by starvation — that is the deadline-
+        # sizing rule (deadline > worst-case queue wait + TTFT), not the
+        # slow link under test
+        traffic = []
+        for i in range(5):
+            # constant prompt length (same compiled shapes as the
+            # warmup); the differing digits sit past the full-block
+            # affinity window, so the family shares one ring key
+            h = fm.scheduler.submit(
+                _req(f"slow link shared prompt prefix for affinity {i:02d}",
+                     max_new_tokens=5))
+            h.result(120)
+            traffic.append(h)
+        problems = _resolved(traffic)
+        lost = [h.id for h in traffic
+                if h.finish_reason not in ("stop", "length")]
+        if lost:
+            problems.append(f"requests lost to the slow link: {lost}")
+        if fm.scheduler.failovers < 1:
+            problems.append(
+                "no failover — the slow link never tripped the deadline "
+                f"(victim dispatched={victim.dispatched})")
+        expo = REGISTRY.render()
+        if "localai_fleet_rpc_deadline_exceeded_total" not in expo:
+            problems.append(
+                "localai_fleet_rpc_deadline_exceeded_total never rendered")
+        problems += _remote_blocks_conserved(workers)
+        return {"problems": problems,
+                "failovers": fm.scheduler.failovers,
+                "routed": dict(fm.router.routed)}
+    finally:
+        faults.clear()
+        fm.close()
+        _stop_workers(workers)
+
+
+def scenario_flapping_peer() -> dict:
+    """A flapping remote: evicted, fails several redials (holds grow and
+    cap), rejoins — then flaps again. The second incident's first hold
+    must start back at the base: a reset that isn't observed is a reset
+    that doesn't exist."""
+    from localai_tpu import faults
+
+    fm, workers, _ = _remote_fleet("chaos-flap")
+    pool = fm.pool
+    pool.redial_backoff_base = 0.2
+    pool.redial_backoff_cap = 0.6
+    try:
+        victim = pool.replicas[0]
+
+        def flap(n_fails: int) -> list[float]:
+            # dial refusals: 1 for note_failure's confirm + n_fails
+            # failed redial attempts, then the schedule exhausts and the
+            # next redial succeeds
+            faults.arm(faults.FaultSpec(site="fleet.dial", mode="raise",
+                                        match=victim.id,
+                                        times=1 + n_fails))
+            pool.note_failure(victim)
+            holds: list[float] = []
+            deadline = time.monotonic() + 60
+            while victim.state != "healthy" and time.monotonic() < deadline:
+                pool.poll_once()
+                b = pool.redial_backoff_s.get(victim.id)
+                if b is not None and (not holds or b != holds[-1]):
+                    holds.append(b)
+                time.sleep(0.05)
+            return holds
+
+        problems = []
+        first = flap(3)
+        if victim.state != "healthy":
+            problems.append("victim never rejoined after first flap")
+        if len(first) < 3:
+            problems.append(f"expected 3 growing holds, saw {first}")
+        else:
+            if not first[1] > first[0]:
+                problems.append(f"backoff did not grow: {first}")
+            if any(b > pool.redial_backoff_cap for b in first):
+                problems.append(f"backoff exceeded cap: {first}")
+        if pool.redial_backoff_s.get(victim.id):
+            problems.append("backoff did not reset after first rejoin")
+        second = flap(1)
+        if victim.state != "healthy":
+            problems.append("victim never rejoined after second flap")
+        # ±25% jitter bands: base 0.2 → ≤0.25; second doubling ≥0.3 — a
+        # leaked failure count would start the second flap past the base
+        if second and second[0] > pool.redial_backoff_base * 1.25:
+            problems.append(
+                f"second incident started at {second[0]:.2f}s — the "
+                "backoff clock did not reset on rejoin")
+        if pool.evictions < 2:
+            problems.append(f"expected 2 evictions, saw {pool.evictions}")
+        if pool.redials < 2:
+            problems.append(f"expected 2 redials, saw {pool.redials}")
+        h = fm.scheduler.submit(_req("post-flap request", max_new_tokens=6))
+        h.result(120)
+        problems += _resolved([h])
+        problems += _remote_blocks_conserved(workers)
+        return {"problems": problems, "first": first, "second": second,
+                "evictions": pool.evictions, "redials": pool.redials}
+    finally:
+        faults.clear()
+        fm.close()
+        _stop_workers(workers)
+
+
+def scenario_registry_join() -> dict:
+    """A second remote registers mid-traffic (the /federated/register
+    adoption path): nothing in flight is disturbed, the consistent-hash
+    ring remaps only its share, and the newcomer starts taking traffic."""
+    import threading
+
+    from localai_tpu.worker.server import BackendServicer, serve_worker
+
+    fm, workers, _ = _remote_fleet("chaos-join", n=1)
+    extra = None
+    try:
+        problems = []
+        handles = []
+        stop = threading.Event()
+
+        def traffic() -> None:
+            i = 0
+            while not stop.is_set() and i < 12:
+                h = fm.scheduler.submit(
+                    _req(f"join traffic {i}", max_new_tokens=4))
+                handles.append(h)
+                h.result(120)
+                i += 1
+
+        t = threading.Thread(target=traffic, daemon=True)
+        t.start()
+        time.sleep(0.3)  # traffic in flight before the join
+        sv = BackendServicer()
+        server, port = serve_worker("127.0.0.1:0", servicer=sv,
+                                    block=False)
+        extra = (server, sv)
+        verdict = fm.adopt_remote(f"127.0.0.1:{port}")
+        t.join(120)
+        stop.set()
+        if not verdict["adopted"] or verdict["state"] != "healthy":
+            problems.append(f"mid-traffic join failed: {verdict}")
+        problems += _resolved(handles)
+        lost = [h.id for h in handles
+                if h.finish_reason not in ("stop", "length")]
+        if lost:
+            problems.append(f"requests lost across the join: {lost}")
+        if fm.pool.adoptions < 1:
+            problems.append("adoption counter never moved")
+        # short prompts place least-loaded: the fresh peer (0 dispatched)
+        # must start absorbing traffic
+        joined = fm.pool.get(verdict["id"])
+        for i in range(4):
+            h = fm.scheduler.submit(_req(f"[{i}]", max_new_tokens=3))
+            h.result(120)
+            problems += _resolved([h])
+        if joined is None or joined.dispatched < 1:
+            problems.append("joined remote never served a request")
+        problems += _remote_blocks_conserved(workers + [extra])
+        return {"problems": problems, "verdict": verdict,
+                "joined_dispatched": joined.dispatched if joined else 0,
+                "requests": len(handles)}
+    finally:
+        fm.close()
+        _stop_workers(workers)
+        if extra is not None:
+            _stop_workers([extra])
+
+
 def scenario_shed_recover() -> dict:
     """SLO burn-rate shedding trips under a synthetic overload and
     recovers once the fast window slides (injected clock) — the
@@ -569,6 +948,10 @@ SCENARIOS = {
     "fleet_failover": scenario_fleet_failover,
     "respawn_backoff": scenario_respawn_backoff,
     "shed_recover": scenario_shed_recover,
+    "network_partition": scenario_network_partition,
+    "slow_link": scenario_slow_link,
+    "flapping_peer": scenario_flapping_peer,
+    "registry_join": scenario_registry_join,
 }
 
 

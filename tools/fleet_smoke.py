@@ -1,0 +1,189 @@
+"""CI cross-host fleet smoke: a real 2-process fleet on 127.0.0.1 ports.
+
+Where tests/test_fleet.py proves the pieces and tools/chaos_smoke.py the
+recovery invariants, this smoke proves the WHOLE cross-host shape end to
+end with real process and network boundaries:
+
+  1. spawn two worker *processes* (``python -m localai_tpu.worker.server``
+     each on its own 127.0.0.1 port — separate interpreters, real gRPC
+     over a real socket: the cross-host topology on loopback);
+  2. adopt worker #1 statically (the ``LOCALAI_FLEET_HOSTS`` path) and
+     worker #2 dynamically mid-traffic (the ``POST /federated/register``
+     adoption path, ``FleetServingModel.adopt_remote``);
+  3. run mixed traffic — short least-loaded prompts and a shared-prefix
+     affinity family — across both;
+  4. inject ONE network partition against a victim peer (``fleet.dial`` +
+     ``fleet.transport`` faults): every in-flight and subsequent request
+     must still complete (route-around, zero lost), the victim must be
+     EVICTED (never respawned — we do not own its process);
+  5. heal the partition: the backed-off redial must rejoin the victim
+     and reset its hold;
+  6. assert the new ``localai_fleet_*`` eviction/redial series actually
+     rendered: adoptions, evictions, redials, redial-backoff gauge.
+
+Usage:  python -m tools.fleet_smoke [--out fleet_smoke.json]
+Exit code 0 = every gate passed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--out", default="fleet_smoke.json")
+    args = parser.parse_args(argv)
+
+    from localai_tpu import faults
+    from localai_tpu.config.app_config import AppConfig
+    from localai_tpu.config.model_config import ModelConfig
+    from localai_tpu.engine.scheduler import GenRequest
+    from localai_tpu.fleet import FleetServingModel
+    from localai_tpu.obs.metrics import REGISTRY
+    from localai_tpu.worker.process import WorkerProcess
+
+    problems: list[str] = []
+    report: dict = {"problems": problems}
+
+    mcfg = ModelConfig.model_validate({
+        "name": "fsmoke", "model": "debug:tiny", "context_size": 256,
+        "parameters": {"temperature": 0.0, "max_tokens": 8},
+        "engine": {"max_slots": 2, "prefill_buckets": [16, 32, 64],
+                   "dtype": "float32", "kv_dtype": "float32",
+                   "kv_block_tokens": 16},
+    })
+    app = AppConfig()
+
+    # -- 1. two real worker processes on loopback ports -------------------
+    print("fleet_smoke: spawning 2 worker processes")
+    wps = [WorkerProcess(f"fsmoke-host{i}",
+                         env={"JAX_PLATFORMS": "cpu"}) for i in range(2)]
+    fm = None
+    try:
+        for wp in wps:
+            wp.start()
+        addrs = [f"127.0.0.1:{wp.port}" for wp in wps]
+        report["hosts"] = addrs
+
+        # -- 2a. static adoption (the LOCALAI_FLEET_HOSTS path) -----------
+        fm = FleetServingModel(mcfg, app, lambda rid, role: None,
+                               replicas=0, remote_hosts=addrs[:1],
+                               disagg_threshold=1 << 30)
+        fm.pool.redial_backoff_base = 0.2
+        fm.pool.redial_backoff_cap = 1.0
+
+        def gen(text: str, n: int = 5):
+            h = fm.scheduler.submit(GenRequest(
+                prompt=fm.tokenizer.encode(text), max_new_tokens=n,
+                temperature=0.0))
+            h.result(timeout=180)
+            return h
+
+        def run_mix(tag: str, count: int = 6) -> list:
+            handles = []
+            for i in range(count):
+                if i % 2 == 0:  # affinity family: one shared block prefix
+                    text = ("shared affinity prefix for the smoke run "
+                            f"padded out to a full block {tag} {i}")
+                else:           # short prompt: least-loaded placement
+                    text = f"[{tag}{i}]"
+                handles.append(gen(text))
+            return handles
+
+        # -- 2b. dynamic adoption mid-traffic (register path) -------------
+        print("fleet_smoke: adopting second host mid-traffic")
+        first = run_mix("warm", 4)
+        verdict = fm.adopt_remote(addrs[1])
+        report["join"] = verdict
+        if not verdict["adopted"] or verdict["state"] != "healthy":
+            problems.append(f"dynamic adoption failed: {verdict}")
+        second = run_mix("joined", 6)
+
+        # -- 3/4. one injected partition under traffic --------------------
+        victim = fm.pool.get(verdict["id"]) or fm.pool.replicas[0]
+        print(f"fleet_smoke: partitioning {victim.id}")
+        faults.arm(faults.FaultSpec(site="fleet.transport", mode="raise",
+                                    match=victim.id, times=0))
+        faults.arm(faults.FaultSpec(site="fleet.dial", mode="raise",
+                                    match=victim.id, times=0))
+        partitioned = run_mix("partitioned", 6)
+        lost = [h.id for h in first + second + partitioned
+                if h.finish_reason not in ("stop", "length")]
+        if lost:
+            problems.append(f"requests lost: {lost}")
+        deadline = time.monotonic() + 30
+        while victim.state != "evicted" and time.monotonic() < deadline:
+            fm.pool.poll_once()
+            time.sleep(0.05)
+        if victim.state != "evicted":
+            problems.append(
+                f"victim is {victim.state!r}, not evicted")
+        if fm.pool.evictions < 1:
+            problems.append("no eviction recorded")
+
+        # -- 5. heal → backed-off redial rejoins --------------------------
+        print("fleet_smoke: healing the partition")
+        faults.clear()
+        deadline = time.monotonic() + 60
+        while victim.state != "healthy" and time.monotonic() < deadline:
+            fm.pool.poll_once()
+            time.sleep(0.05)
+        if victim.state != "healthy":
+            problems.append(f"victim never redialed back in "
+                            f"(state {victim.state})")
+        if fm.pool.redials < 1:
+            problems.append("no redial recorded")
+        if fm.pool.redial_backoff_s.get(victim.id):
+            problems.append("redial backoff did not reset on rejoin")
+        final = gen("after the partition healed")
+        if final.finish_reason not in ("stop", "length"):
+            problems.append(
+                f"post-heal request finished {final.finish_reason!r}")
+
+        # -- 6. the series must be OBSERVABLE, not just incremented -------
+        fm.scheduler.export_gauges()
+        expo = REGISTRY.render()
+        for series in ("localai_fleet_adoptions_total",
+                       "localai_fleet_evictions_total",
+                       "localai_fleet_redials_total",
+                       "localai_fleet_redial_backoff_s",
+                       "localai_fleet_routed_total"):
+            if series not in expo:
+                problems.append(f"{series} missing from the exposition")
+        report["counters"] = {
+            "adoptions": fm.pool.adoptions,
+            "evictions": fm.pool.evictions,
+            "redials": fm.pool.redials,
+            "failovers": fm.scheduler.failovers,
+            "routed": dict(fm.router.routed),
+        }
+    except Exception as e:  # noqa: BLE001 — a crash IS a failure
+        import traceback
+
+        traceback.print_exc()
+        problems.append(f"smoke crashed: {e}")
+    finally:
+        faults.clear()
+        if fm is not None:
+            fm.close()
+        for wp in wps:
+            try:
+                wp.stop()
+            except Exception:  # noqa: BLE001 — teardown must finish
+                pass
+
+    report["ok"] = not problems
+    with open(args.out, "w") as f:
+        json.dump(report, f, indent=2, sort_keys=True)
+    print(f"{'OK' if report['ok'] else 'FAIL'}: cross-host fleet smoke"
+          + (f" — {problems}" if problems else "")
+          + f"; report → {args.out}")
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
