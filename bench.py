@@ -310,7 +310,19 @@ def run_decode_bench(preset: str, quant: str, steps: int, multi: int,
         np.asarray(q.popleft())
         last_t = note_drain(last_t)
     dt = time.perf_counter() - t0
-    return dispatches * multi * num_slots / dt
+    # phase provenance for the output line (ISSUE 14 satellite): which
+    # attention kernel actually served the measurement, the KV dtype, and
+    # the dispatch amortization — "1002 tok/s" means nothing round-over-
+    # round without knowing whether the flash kernel or the gather
+    # fallback produced it
+    impl = (runner.paged_attn_impl if paged
+            else runner.decode_attn_impl)
+    info = {
+        "kernel_impl": "pallas" if impl == "pallas" else "lax",
+        "kv_dtype": str(runner.kv_dtype),
+        "tokens_per_dispatch": multi * num_slots,
+    }
+    return dispatches * multi * num_slots / dt, info
 
 
 def run_spec_bench(preset: str, quant: str, steps: int,
@@ -415,8 +427,16 @@ def run_spec_bench(preset: str, quant: str, steps: int,
     dt = time.perf_counter() - t0
     d_emit = eng.total_emitted - eng0_emitted
     d_win = eng.total_windows - eng0_windows
+    info = {
+        "kernel_impl": ("pallas" if runner.paged_attn_impl == "pallas"
+                        else "lax"),
+        "kv_dtype": str(runner.kv_dtype),
+        # batch-level emitted tokens per verify dispatch (the per-slot
+        # figure rides spec_tokens_per_dispatch)
+        "tokens_per_dispatch": round(d_emit / d_win, 4) if d_win else 0.0,
+    }
     return (emitted / dt, eng.accept_rate,
-            (d_emit / (d_win * num_slots)) if d_win else 0.0)
+            (d_emit / (d_win * num_slots)) if d_win else 0.0, info)
 
 
 def _measure_spec(board, preset: str, quant: str, steps: int,
@@ -429,7 +449,7 @@ def _measure_spec(board, preset: str, quant: str, steps: int,
         else preset
     t0 = time.monotonic()
     try:
-        tok_s, accept, per_dispatch = run_spec_bench(
+        tok_s, accept, per_dispatch, info = run_spec_bench(
             preset, quant, steps, watchdog=watchdog, channel=channel,
             flight=flight)
         line = {
@@ -440,6 +460,7 @@ def _measure_spec(board, preset: str, quant: str, steps: int,
             "kv": "paged+spec",
             "spec_accept_rate": round(accept, 4),
             "spec_tokens_per_dispatch": round(per_dispatch, 4),
+            **info,
         }
         if flight is not None:
             pct = flight.percentiles()
@@ -533,9 +554,9 @@ def _measure(board: _Board, preset: str, quant: str, steps: int, multi: int,
     note = ""
     try:
         try:
-            tok_s = run_decode_bench(preset, quant, steps, multi, depth,
-                                     watchdog=watchdog, channel=channel,
-                                     flight=flight, meshed=meshed)
+            tok_s, info = run_decode_bench(
+                preset, quant, steps, multi, depth, watchdog=watchdog,
+                channel=channel, flight=flight, meshed=meshed)
         except Exception as e:  # noqa: BLE001
             if not paged or board.thread_dead() or meshed:
                 # the meshed phase has no contiguous fallback: its result
@@ -548,9 +569,10 @@ def _measure(board: _Board, preset: str, quant: str, steps: int, multi: int,
             os.environ["BENCH_PAGED"] = "0"
             try:
                 paged = False
-                tok_s = run_decode_bench(preset, quant, steps, multi, depth,
-                                         watchdog=watchdog, channel=channel,
-                                         flight=flight)
+                tok_s, info = run_decode_bench(
+                    preset, quant, steps, multi, depth, watchdog=watchdog,
+                    channel=channel, flight=flight)
+                info["kernel_impl"] = "fallback"
             finally:
                 os.environ["BENCH_PAGED"] = "1"
         mesh_tag = "_meshed" if meshed else ""
@@ -562,6 +584,7 @@ def _measure(board: _Board, preset: str, quant: str, steps: int, multi: int,
             "phase_s": round(time.monotonic() - t0, 1),
             "kv": ("paged+mesh" if meshed and paged
                    else "paged" if paged else "contig"),
+            **info,
         }
         if note:
             line["note"] = note
@@ -629,11 +652,27 @@ def main() -> None:
     wd.start()
 
     board = _Board()
+    # BENCH_PHASES=1b,8b,meshed,spec — comma-list phase selector so a
+    # triage round can run ONE phase at a time instead of dying opaquely
+    # mid-sequence (ROADMAP item 1: r03 crashed, r04 timed out, r05
+    # completed zero phases — with the selector the next round bisects).
+    # Empty/unset = every phase (the driver default). Unknown names are
+    # ignored so a selector typo degrades to a skipped phase, never a
+    # crashed round.
+    sel = {t.strip().removeprefix("debug:")
+           for t in os.environ.get("BENCH_PHASES", "").split(",")
+           if t.strip()}
+
+    def phase_on(*names: str) -> bool:
+        return not sel or any(n in sel for n in names)
+
     phases: list[tuple] = []
     if preset in ("llama3-8b", "8b"):          # cheap trend config first,
-        phases.append(("1b", "int8", False))   # then the north star
-        phases.append(("llama3-8b", quant, True))
-    else:
+        if phase_on("1b"):                     # then the north star
+            phases.append(("1b", "int8", not phase_on("8b", "llama3-8b")))
+        if phase_on("8b", "llama3-8b"):
+            phases.append(("llama3-8b", quant, True))
+    elif phase_on(preset):
         phases.append((preset, quant, True))
 
     def probe_w8_kernel():
@@ -658,8 +697,8 @@ def main() -> None:
             return
         os.environ["LOCALAI_W8_KERNEL"] = "1"
         try:
-            t_on = run_decode_bench("1b", "int8", steps, multi, depth,
-                                    watchdog=wd, channel="bench:w8probe")
+            t_on, _ = run_decode_bench("1b", "int8", steps, multi, depth,
+                                       watchdog=wd, channel="bench:w8probe")
         except Exception:  # noqa: BLE001 — probe failure → stay off
             t_on = 0.0
         if board.thread_dead():
@@ -720,7 +759,11 @@ def main() -> None:
                 "note": f"device probe failed: {probe.error}",
             }, primary=True)
             return
-        has_8b = any("8b" in p for p, _, _ in phases)
+        # derived from the PRESET, not the selector-filtered phase list:
+        # BENCH_PHASES=meshed on an 8b run must still measure the meshed/
+        # spec phases on the ("1b","int8") config every unfiltered run
+        # uses, or the bisected phase isn't the phase that failed
+        has_8b = preset in ("llama3-8b", "8b")
         for p, q, primary in phases:
             remaining = deadline - time.monotonic()
             if remaining <= 30:
@@ -750,7 +793,9 @@ def main() -> None:
                 if not after.ok:
                     return
                 continue
-            if p == "1b" and q == "int8" and has_8b and quant == "int8":
+            if (p == "1b" and q == "int8" and has_8b and quant == "int8"
+                    and phase_on("8b", "llama3-8b")):  # probe feeds the
+                # 8B phase only — pointless when the selector skips it
                 if not guarded("bench:w8probe", probe_w8_kernel):
                     # a stalled probe must not leave the unvalidated
                     # kernel force-enabled for the 8B phase, and a dead
@@ -768,6 +813,7 @@ def main() -> None:
         import jax
 
         if (os.environ.get("BENCH_MESHED", "1") != "0"
+                and phase_on("meshed")
                 and len(jax.devices()) > 1
                 and deadline - time.monotonic() > 120):
             mp, mq = ("1b", "int8") if has_8b else (preset, quant)
@@ -780,6 +826,7 @@ def main() -> None:
         # n-gram self-drafter on repetitive prompts — its own output key
         # ("spec"), BENCH_SPEC=0 escape, never displaces the trend line
         if (os.environ.get("BENCH_SPEC", "1") != "0"
+                and phase_on("spec")
                 and deadline - time.monotonic() > 90):
             sp, sq = ("1b", "int8") if has_8b else (preset, quant)
             sflight = FlightRecorder(512)

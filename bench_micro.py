@@ -178,7 +178,8 @@ def machine_index(n=512, steps=24, repeats=3):
 
 def decode_smoke(paged: bool, preset: str = "tiny", num_slots: int = 4,
                  max_ctx: int = 512, multi: int = 16, repeats: int = 5,
-                 mesh_devices: int = 0):
+                 mesh_devices: int = 0, kv_dtype: str = "float32",
+                 kv_block_tokens: int = 0):
     """Steady-state batched decode tok/s of a debug preset — the CI perf
     smoke measurement. Best-of-``repeats`` (fastest sample): shared
     runners have multi-x contention spikes, and one clean window measures
@@ -188,7 +189,11 @@ def decode_smoke(paged: bool, preset: str = "tiny", num_slots: int = 4,
     mesh over that many devices (model axis), params sharded with the
     production partition rules — the CI pin that the pjit/shard_map serving
     path stays alive on a multi-device host (tools/perf_smoke.py gates the
-    meshed-paged ratio; callers must check the device count first)."""
+    meshed-paged ratio; callers must check the device count first).
+
+    ``kv_dtype`` selects the pool dtype (``int4`` exercises the nibble-
+    packed paged pool + fused dequant); ``kv_block_tokens`` overrides the
+    pool block size (0 = runner default / tuned table)."""
     from localai_tpu.engine.runner import ModelRunner
     from localai_tpu.models.registry import resolve_model
 
@@ -206,7 +211,8 @@ def decode_smoke(paged: bool, preset: str = "tiny", num_slots: int = 4,
         params = shd.shard_params(params, model.cfg, mesh)
     runner = ModelRunner(model.cfg, params, num_slots=num_slots,
                          max_ctx=max_ctx, prefill_buckets=[128],
-                         kv_dtype="float32", paged=paged, mesh=mesh)
+                         kv_dtype=kv_dtype, paged=paged, mesh=mesh,
+                         kv_block_tokens=kv_block_tokens or None)
     prompt = list(range(1, 65))
     for _ in range(num_slots):
         runner.admit(runner.acquire_slot(), prompt, temperature=0.0)

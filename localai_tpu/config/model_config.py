@@ -160,7 +160,10 @@ class EngineConfig(BaseModel):
         default_factory=lambda: [128, 512, 2048, 8192]
     )
     dtype: str = "bfloat16"           # compute/weight dtype
-    kv_dtype: str = "bfloat16"        # KV-cache dtype (int8 supported)
+    kv_dtype: str = "bfloat16"        # KV-cache dtype: bfloat16/float32,
+                                      # scaled int8, or int4 (paged pools
+                                      # only — nibble-packed along head_dim;
+                                      # LOCALAI_KV_DTYPE overrides defaults)
     quantization: Optional[str] = None  # "int8" | "int8_w8a8" | "int4"
     donate_kv: bool = True            # buffer donation for in-place KV updates
     decode_steps_per_dispatch: int = 16  # tokens per dispatch (lax.scan) —

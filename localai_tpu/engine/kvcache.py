@@ -23,7 +23,11 @@ import jax.numpy as jnp
 from jax import lax
 
 from localai_tpu.models.llama import LlamaConfig
-from localai_tpu.models.quant import quantize_lastdim as _quant_chunk
+from localai_tpu.models.quant import (
+    quantize_lastdim as _quant_chunk,
+    quantize_lastdim4 as _quant_chunk4,
+    unpack_int4_lastdim as _unpack4,
+)
 from localai_tpu.ops.attention import gather_block_scales, gather_blocks
 
 
@@ -116,7 +120,11 @@ class PagedKVCache:
     tokens each, shared by all slots through per-slot block tables
     ([S, max_blocks] i32, engine.paged.BlockAllocator). Block 0 is the
     trash block (garbage-write target for inactive slots). int8 caches
-    carry f32 scales [L, N, Hkv, bt], same scaled-int8 scheme as KVCache."""
+    carry f32 scales [L, N, Hkv, bt], same scaled-int8 scheme as KVCache.
+    int4 pools store nibble-packed int8 with last dim hd/2 (halves layout,
+    models.quant.quantize_lastdim4) and the SAME scale shape — the packed
+    last dim is how every consumer detects int4, so the pool stays
+    self-describing through the stacked pytree."""
 
     k: jax.Array
     v: jax.Array
@@ -152,9 +160,14 @@ def init_paged_cache(
     dtype: str = "bfloat16",
     sharding: Optional[jax.sharding.Sharding] = None,
 ) -> PagedKVCache:
-    shape = (cfg.num_layers, num_blocks, cfg.num_kv_heads, block_tokens,
-             cfg.hd)
-    dt = jnp.dtype(dtype)
+    int4 = str(dtype) == "int4"
+    if int4 and cfg.hd % 2:
+        raise ValueError(f"int4 KV needs an even head_dim, got {cfg.hd}")
+    # int4 pools store nibble-packed int8 along head_dim (hd/2 bytes/row)
+    hd = cfg.hd // 2 if int4 else cfg.hd
+    shape = (cfg.num_layers, num_blocks, cfg.num_kv_heads, block_tokens, hd)
+    dt = jnp.dtype("int8") if int4 else jnp.dtype(dtype)
+    quantized = int4 or dt == jnp.int8
 
     def zeros(shp, d, shd):
         if shd is not None:
@@ -167,13 +180,13 @@ def init_paged_cache(
         return jnp.zeros(shp, d)
 
     scale_sharding = None
-    if dt == jnp.int8 and sharding is not None:
+    if quantized and sharding is not None:
         # scale pool drops the head_dim axis; reuse the pool spec minus it
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         scale_sharding = NamedSharding(
             sharding.mesh, P(*tuple(sharding.spec)[:4]))
-    if dt == jnp.int8:
+    if quantized:
         return PagedKVCache(
             k=zeros(shape, dt, sharding),
             v=zeros(shape, dt, sharding),
@@ -182,6 +195,24 @@ def init_paged_cache(
         )
     return PagedKVCache(k=zeros(shape, dt, sharding),
                         v=zeros(shape, dt, sharding))
+
+
+def _pool_quant(layer_kv, k_new):
+    """The quantizer matching a paged pool's storage: int4 when the pool's
+    last dim is the packed hd/2 (self-describing layout), else int8.
+    ``k_new`` carries the full head_dim."""
+    int4 = layer_kv[0].shape[-1] * 2 == k_new.shape[-1]
+    return (_quant_chunk4 if int4 else _quant_chunk), int4
+
+
+def _gather_dequant(cache, scales, tables, dt, int4: bool):
+    """Gather + dequantize a quantized pool's logical context for the XLA
+    attend: [S, H, MB*bt, hd] in ``dt`` (int4 pools unpack first)."""
+    g = gather_blocks(cache, tables)
+    if int4:
+        g = _unpack4(g)
+    return (g.astype(dt)
+            * gather_block_scales(scales, tables)[..., None].astype(dt))
 
 
 def paged_decode_write(tables: jax.Array, positions: jax.Array,
@@ -203,10 +234,11 @@ def paged_decode_write(tables: jax.Array, positions: jax.Array,
         s = jnp.arange(tables.shape[0])
         blk = tables[s, positions // bt]          # [S]
         off = positions % bt
-        if len(layer_kv) == 4:  # scaled int8 pool
+        if len(layer_kv) == 4:  # scaled int8/int4 pool
             k_layer, v_layer, ks_layer, vs_layer = layer_kv
-            kq, ks = _quant_chunk(k_new[:, 0])    # [S, H, hd], [S, H]
-            vq, vs = _quant_chunk(v_new[:, 0])
+            quant, int4 = _pool_quant(layer_kv, k_new)
+            kq, ks = quant(k_new[:, 0])    # [S, H, hd or hd/2], [S, H]
+            vq, vs = quant(v_new[:, 0])
             new_k = k_layer.at[blk, :, off].set(kq)
             new_v = v_layer.at[blk, :, off].set(vq)
             new_ks = ks_layer.at[blk, :, off].set(ks)
@@ -214,10 +246,8 @@ def paged_decode_write(tables: jax.Array, positions: jax.Array,
             new_kv = (new_k, new_v, new_ks, new_vs)
             if raw:
                 return new_kv, (new_k, new_ks), (new_v, new_vs)
-            keys = (gather_blocks(new_k, tables).astype(dt)
-                    * gather_block_scales(new_ks, tables)[..., None].astype(dt))
-            values = (gather_blocks(new_v, tables).astype(dt)
-                      * gather_block_scales(new_vs, tables)[..., None].astype(dt))
+            keys = _gather_dequant(new_k, new_ks, tables, dt, int4)
+            values = _gather_dequant(new_v, new_vs, tables, dt, int4)
             return new_kv, keys, values
         k_layer, v_layer = layer_kv               # [N, H, bt, hd]
         kdt = k_layer.dtype
@@ -254,18 +284,17 @@ def paged_prefill_write(table_row: jax.Array, offset: jax.Array,
         blk = jnp.where(valid, table_row[jnp.minimum(pos // bt, MB - 1)], 0)
         off = pos % bt
         row = table_row[None]                     # [1, MB]
-        if len(layer_kv) == 4:  # scaled int8 pool
+        if len(layer_kv) == 4:  # scaled int8/int4 pool
             k_layer, v_layer, ks_layer, vs_layer = layer_kv
-            kq, ks = _quant_chunk(k_new[0])       # [T, H, hd], [T, H]
-            vq, vs = _quant_chunk(v_new[0])
+            quant, int4 = _pool_quant(layer_kv, k_new)
+            kq, ks = quant(k_new[0])       # [T, H, hd or hd/2], [T, H]
+            vq, vs = quant(v_new[0])
             new_k = k_layer.at[blk, :, off].set(kq)
             new_v = v_layer.at[blk, :, off].set(vq)
             new_ks = ks_layer.at[blk, :, off].set(ks)
             new_vs = vs_layer.at[blk, :, off].set(vs)
-            keys = (gather_blocks(new_k, row).astype(dt)
-                    * gather_block_scales(new_ks, row)[..., None].astype(dt))
-            values = (gather_blocks(new_v, row).astype(dt)
-                      * gather_block_scales(new_vs, row)[..., None].astype(dt))
+            keys = _gather_dequant(new_k, new_ks, row, dt, int4)
+            values = _gather_dequant(new_v, new_vs, row, dt, int4)
             return (new_k, new_v, new_ks, new_vs), keys, values
         k_layer, v_layer = layer_kv
         kdt = k_layer.dtype
@@ -343,20 +372,17 @@ def paged_verify_write(tables: jax.Array, positions: jax.Array,
         blk = jnp.where(
             safe, tables[s, jnp.minimum(pmat // bt, MB - 1)], 0)
         off = pmat % bt
-        if len(layer_kv) == 4:  # scaled int8 pool
+        if len(layer_kv) == 4:  # scaled int8/int4 pool
             k_layer, v_layer, ks_layer, vs_layer = layer_kv
-            kq, ks = _quant_chunk(k_new)          # [S, T, H, hd], [S, T, H]
-            vq, vs = _quant_chunk(v_new)
+            quant, int4 = _pool_quant(layer_kv, k_new)
+            kq, ks = quant(k_new)       # [S, T, H, hd or hd/2], [S, T, H]
+            vq, vs = quant(v_new)
             new_k = k_layer.at[blk, :, off].set(kq)
             new_v = v_layer.at[blk, :, off].set(vq)
             new_ks = ks_layer.at[blk, :, off].set(ks)
             new_vs = vs_layer.at[blk, :, off].set(vs)
-            keys = (gather_blocks(new_k, tables).astype(dt)
-                    * gather_block_scales(new_ks, tables)[..., None]
-                    .astype(dt))
-            values = (gather_blocks(new_v, tables).astype(dt)
-                      * gather_block_scales(new_vs, tables)[..., None]
-                      .astype(dt))
+            keys = _gather_dequant(new_k, new_ks, tables, dt, int4)
+            values = _gather_dequant(new_v, new_vs, tables, dt, int4)
             return (new_k, new_v, new_ks, new_vs), keys, values
         k_layer, v_layer = layer_kv               # [N, H, bt, hd]
         kdt = k_layer.dtype

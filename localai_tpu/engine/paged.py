@@ -2,7 +2,10 @@
 
 vLLM-style PagedAttention bookkeeping (Kwon et al., SOSP 2023) adapted to
 this engine's static-shape XLA model: HBM holds one block pool
-``[L, num_blocks, Hkv, block_tokens, hd]`` (engine.kvcache.PagedKVCache) and
+``[L, num_blocks, Hkv, block_tokens, hd]`` (engine.kvcache.PagedKVCache;
+int4 pools nibble-pack head_dim so their last dim is ``hd/2`` — the
+allocator is deliberately dtype-blind, a block id maps the same rows
+whatever the pool stores) and
 every slot owns a *block table* — a [max_blocks] i32 row mapping logical
 context blocks to physical pool blocks. All allocation state (free list,
 refcounts, prefix-sharing pool) lives here on the host; the device only

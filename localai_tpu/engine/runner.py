@@ -219,9 +219,36 @@ class ModelRunner:
             if self.paged and incompat:
                 raise ValueError(
                     f"paged KV cache is incompatible with {incompat}")
+        if kv_dtype == "int4" and not self.paged:
+            raise ValueError(
+                "kv_dtype=int4 requires the paged KV layout (the nibble-"
+                "packed pool scatter only exists for block pools); use "
+                "int8 for contiguous caches")
+        tp_width = mesh.shape["model"] if mesh is not None else 1
         if self.paged:
-            self.block_tokens = int(
-                kv_block_tokens or pgd.block_tokens_default())
+            # per-shape tuned defaults (ops.tuning, written by
+            # tools/autotune.py): explicit kwargs and env knobs win,
+            # then the tuning table, then the built-in defaults
+            from localai_tpu.ops import tuning as ops_tuning
+
+            tuned = ops_tuning.lookup(
+                cfg.hd, cfg.num_kv_heads, kv_dtype, tp_width)
+            try:
+                env_bt = int(
+                    os.environ.get("LOCALAI_KV_BLOCK_TOKENS", "") or 0)
+            except ValueError:
+                env_bt = 0
+            self.block_tokens = max(8, int(
+                kv_block_tokens or env_bt
+                or (tuned.block_tokens if tuned else 0)
+                or pgd.block_tokens_default()))
+            try:
+                env_buf = int(
+                    os.environ.get("LOCALAI_PAGED_NUM_BUFFERS", "") or 0)
+            except ValueError:
+                env_buf = 0
+            self.paged_num_buffers = max(2, int(
+                env_buf or (tuned.num_buffers if tuned else 0) or 2))
             self.max_blocks = -(-self.max_ctx // self.block_tokens)
             self.ctx_pad = self.max_blocks * self.block_tokens
             # default pool = the contiguous layout's HBM footprint (every
@@ -253,16 +280,51 @@ class ModelRunner:
                 num_kv_heads=cfg.num_kv_heads,
                 head_dim=cfg.hd,
                 block_tokens=self.block_tokens,
-                tp=mesh.shape["model"] if mesh is not None else 1,
+                tp=tp_width,
+                kv_dtype=kv_dtype,
+                # reuse the entry fetched above — an empty TuneEntry
+                # means "already looked up, no preference", so one
+                # construction emits exactly one lookup receipt
+                tuned=tuned or ops_tuning.TuneEntry(),
             )
             if paged_why:
                 log.info("paged attention: %s; using gather+XLA", paged_why)
+            # collective/compute overlap (parallel.overlap): meshed decode
+            # runs the trunk as a manual-TP shard_map with the per-layer
+            # psums decomposed into chunked psum_scatter+all_gather so ICI
+            # latency hides behind the matmuls. LOCALAI_MESH_OVERLAP =
+            # auto(default)/psum/0; resolve_mode gates unsupported meshes
+            # back to GSPMD.
+            self.overlap_mode = ""
+            self.overlap_chunks = 4
+            if mesh is not None:
+                from localai_tpu.parallel import overlap as ovl
+
+                self.overlap_mode, ovl_why = ovl.resolve_mode(
+                    cfg, mesh,
+                    os.environ.get("LOCALAI_MESH_OVERLAP", "auto"))
+                try:
+                    self.overlap_chunks = max(1, int(os.environ.get(
+                        "LOCALAI_MESH_OVERLAP_CHUNKS", "") or 4))
+                except ValueError:
+                    pass
+                if self.overlap_mode:
+                    log.info(
+                        "meshed decode: manual-TP %s reductions "
+                        "(chunks=%d)", self.overlap_mode,
+                        self.overlap_chunks)
+                elif ovl_why:
+                    log.info("meshed decode overlap unavailable: %s "
+                             "(GSPMD psum path)", ovl_why)
             # one device-resident zeros row reused by every non-final
             # chunk dispatch (whose sample=False program ignores counts —
             # no per-chunk [V] host alloc + H2D copy)
             self._zero_counts = jnp.zeros(cfg.vocab_size, jnp.int32)
         else:
             self.allocator = None
+            self.overlap_mode = ""
+            self.overlap_chunks = 4
+            self.paged_num_buffers = 2
         # shardings are kept so reinit() (self-healing engine rebuild)
         # can rebuild the device state into the exact same layout
         self._kv_sharding = None
@@ -877,6 +939,25 @@ class ModelRunner:
         (not donated — it changes only at admit/release)."""
         cfg = self.cfg
         pos = state.positions
+        if self.overlap_mode:
+            # manual-TP trunk with decomposed per-layer reductions
+            # (parallel.overlap); sampling/logits keep the GSPMD tail
+            from localai_tpu.parallel import overlap as ovl
+
+            trunk = {k: params[k] for k in ovl.TRUNK_KEYS}
+            hidden, new_stack = ovl.paged_decode_trunk(
+                cfg, trunk, self.mesh, state.tokens, pos,
+                kv.stacked(), tables, self.rope,
+                ctx_pad=self.ctx_pad,
+                mode=self.overlap_mode,
+                chunks=self.overlap_chunks,
+                use_pallas=self.paged_attn_impl == "pallas",
+                interpret=self._paged_attn_interpret,
+                num_buffers=self.paged_num_buffers,
+            )
+            new_state, tokens = self._decode_tail(params, state, hidden)
+            return (kvc.PagedKVCache.from_stacked(new_stack), new_state,
+                    tokens)
         raw = self.paged_attn_impl == "pallas"
         attn = None
         if raw:
@@ -886,6 +967,7 @@ class ModelRunner:
                 ops.paged_decode_attention,
                 sliding_window=cfg.sliding_window,
                 interpret=self._paged_attn_interpret,
+                num_buffers=self.paged_num_buffers,
             )
             if self.mesh is not None:
                 from jax.sharding import PartitionSpec as P
@@ -1050,8 +1132,12 @@ class ModelRunner:
         # advanced indices (blk, off) around the head slice broadcast to
         # the FRONT: the set value is row-major [T, L, H, ...]
         if kv.quantized:
-            kq, kscale = kvc._quant_chunk(ks)   # [L,T,H,hd], [L,T,H]
-            vq, vscale = kvc._quant_chunk(vs)
+            # int4 pools (packed hd/2 last dim) take the nibble packer
+            quant = (kvc._quant_chunk4
+                     if kv.k.shape[-1] * 2 == ks.shape[-1]
+                     else kvc._quant_chunk)
+            kq, kscale = quant(ks)   # [L,T,H,hd or hd/2], [L,T,H]
+            vq, vscale = quant(vs)
             new_kv = kvc.PagedKVCache(
                 k=kv.k.at[:, blk, :, off].set(kq.transpose(1, 0, 2, 3)),
                 v=kv.v.at[:, blk, :, off].set(vq.transpose(1, 0, 2, 3)),
@@ -1766,6 +1852,8 @@ class ModelRunner:
 
         k, v = unpack("k"), unpack("v")
         L, H, hd = self.cfg.num_layers, self.cfg.num_kv_heads, self.cfg.hd
+        if str(self.kv_dtype) == "int4":
+            hd //= 2  # int4 exports stay nibble-packed along head_dim
         if k.shape != (L, H, n, hd) or v.shape != (L, H, n, hd):
             return False
         if self.paged:

@@ -502,6 +502,14 @@ class Scheduler:
             st = alloc.stats()
             paged_stats = {
                 "kv_block_tokens": self.runner.block_tokens,
+                # kernel-impl receipt ("pallas" | "lax"): feeds the
+                # localai_paged_kernel_impl series so a silent fallback
+                # off the flash kernel is dashboard-visible
+                "paged_attn_impl": (
+                    "pallas"
+                    if getattr(self.runner, "paged_attn_impl", "") ==
+                    "pallas" else "lax"),
+                "kv_dtype": str(self.runner.kv_dtype),
                 "kv_blocks_total": st.total,
                 # free = immediately free + reclaimable prefix-pool cache
                 "kv_blocks_free": st.free + st.cached,

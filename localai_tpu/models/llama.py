@@ -310,16 +310,21 @@ def _grouped_attn(cfg: LlamaConfig, q, keys, values, mask):
 
     q: [S, T, Hq, hd], keys/values head-major: [S, Hkv, Lk, hd],
     mask: [S, T, Lk] bool (True = attend). Returns [S, T, Hq, hd].
-    """
-    S, T = q.shape[0], q.shape[1]
-    Hkv, g, hd = cfg.num_kv_heads, cfg.q_per_kv, cfg.hd
+
+    Head counts come from the operand SHAPES, not cfg: under manual tensor
+    parallelism (shard_map bodies — parallel.ring, parallel.overlap) each
+    device carries Hq/tp and Hkv/tp heads, and the same math applies to
+    the local group."""
+    S, T, Hq = q.shape[0], q.shape[1], q.shape[2]
+    Hkv, hd = keys.shape[1], cfg.hd
+    g = Hq // Hkv
     qg = q.reshape(S, T, Hkv, g, hd)
     scores = jnp.einsum("stkgh,sklh->skgtl", qg, keys) / math.sqrt(hd)
     scores = scores.astype(jnp.float32)
     scores = jnp.where(mask[:, None, None, :, :], scores, -1e30)
     probs = jax.nn.softmax(scores, axis=-1).astype(values.dtype)
     out = jnp.einsum("skgtl,sklh->stkgh", probs, values)
-    return out.reshape(S, T, cfg.num_heads, hd)
+    return out.reshape(S, T, Hq, hd)
 
 
 def forward(
@@ -335,6 +340,11 @@ def forward(
                             # (Pallas flash kernels inject here; None = XLA)
     embeds: Optional[jax.Array] = None,  # [B, T, D] input embeddings override
                             # (multimodal injection bypasses the token gather)
+    reduce: Any = None,     # manual-TP row-parallel reduction applied to the
+                            # attention-out / mlp-down products inside a
+                            # shard_map body (parallel.overlap) — plain psum
+                            # or the chunked psum_scatter+all_gather overlap
+                            # decomposition; None = single device / GSPMD
 ) -> tuple[jax.Array, Any]:
     """Shared transformer trunk: returns (hidden [B, T, D], updated kv_stack).
 
@@ -358,7 +368,7 @@ def forward(
             new_kv, keys, values = kv_write(layer_kv, k_new, v_new)
             return attn(q, keys, values, mask), new_kv
 
-        y, new_kv = _layer(cfg, carry, lp, cos, sin, attend)
+        y, new_kv = _layer(cfg, carry, lp, cos, sin, attend, reduce=reduce)
         return y, new_kv
 
     x, new_kv_stack = lax.scan(body, x, (params["layers"], kv_stack))

@@ -434,13 +434,28 @@ def build_runner(mcfg: ModelConfig, app: AppConfig) -> tuple[Any, ModelRunner]:
                  and eng.grp_attn_n <= 1
                  and not app.mirror_port
                  and os.environ.get("LOCALAI_KV_PAGED", "") != "0")
+    # LOCALAI_KV_DTYPE flips the KV-cache dtype fleet-wide (int8 halves
+    # KV bytes vs bf16; int4 halves them again via the nibble-packed
+    # paged pool). Explicit per-model config wins; int4 only exists for
+    # the paged layout, so contiguous engines (mirrors, self-extend)
+    # keep their configured dtype with a warning instead of crashing
+    # at runner construction.
+    kv_dtype = eng.kv_dtype
+    env_kv = os.environ.get("LOCALAI_KV_DTYPE", "").strip()
+    if env_kv and kv_dtype == "bfloat16":
+        if env_kv == "int4" and not paged:
+            log.warning(
+                "LOCALAI_KV_DTYPE=int4 ignored for %s: int4 KV requires "
+                "the paged layout (engine is contiguous)", mcfg.name)
+        else:
+            kv_dtype = env_kv
     runner = ModelRunner(
         model.cfg,
         params,
         num_slots=eng.max_slots,
         max_ctx=ctx,
         prefill_buckets=eng.prefill_buckets,
-        kv_dtype=eng.kv_dtype,
+        kv_dtype=kv_dtype,
         rope_freq_base=mcfg.rope_freq_base,
         rope_freq_scale=mcfg.rope_freq_scale,
         seed=mcfg.seed or 0,

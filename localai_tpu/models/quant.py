@@ -195,6 +195,37 @@ def quantize_lastdim(x: jax.Array) -> tuple[jax.Array, jax.Array]:
 _quant_activations = quantize_lastdim
 
 
+def quantize_lastdim4(x: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """Dynamic symmetric int4 over the last axis, nibble-packed: x [..., K]
+    (K even) → (packed int8 [..., K/2], scale f32 [...]). The scaled-int4
+    KV pool recipe (engine.kvcache): scale = amax|x| / 7 per row, values
+    clipped to [-7, 7]. Packing is HALVES layout — element i of the first
+    half lands in the LOW nibble of byte i, element i of the second half
+    in the HIGH nibble — so :func:`unpack_int4_lastdim` is two shifts and
+    a concat (no interleave/relayout on the TPU lane axis)."""
+    xf = x.astype(jnp.float32)
+    scale = jnp.maximum(jnp.max(jnp.abs(xf), axis=-1), 1e-8) / 7.0
+    q = jnp.clip(jnp.round(xf / scale[..., None]), -7, 7).astype(jnp.int8)
+    half = q.shape[-1] // 2
+    lo = q[..., :half]
+    hi = q[..., half:]
+    packed = jnp.bitwise_or(
+        jnp.bitwise_and(lo, jnp.int8(0x0F)),
+        jnp.left_shift(hi, 4).astype(jnp.int8),
+    )
+    return packed, scale
+
+
+def unpack_int4_lastdim(packed: jax.Array) -> jax.Array:
+    """Inverse of the :func:`quantize_lastdim4` packing: int8 [..., K/2] →
+    int8 [..., K] in [-8, 7]. Low nibbles sign-extend via the left/right
+    arithmetic-shift pair; high nibbles via a plain arithmetic right
+    shift — both are VPU-native, no lookup tables."""
+    lo = jnp.right_shift(jnp.left_shift(packed, 4).astype(jnp.int8), 4)
+    hi = jnp.right_shift(packed, 4)
+    return jnp.concatenate([lo, hi], axis=-1).astype(jnp.int8)
+
+
 def _int8_dot(xq: jax.Array, wq: jax.Array, transpose_w: bool) -> jax.Array:
     """Native int8×int8→int32 dot over the last axis of xq."""
     k_axis = 1 if transpose_w else 0

@@ -393,6 +393,28 @@ class Registry:
             "1 after the supervisor exhausted its bounded rebuild budget "
             "and marked the model failed (submits fail fast)",
         )
+        self.autotune_lookups = Counter(
+            "localai_autotune_lookups_total",
+            "Per-shape kernel tuning-table lookups (ops.tuning) by "
+            "result=hit|miss — a fleet whose table stopped matching its "
+            "serving shapes shows an all-miss ratio here",
+        )
+        self.autotune_entries = Gauge(
+            "localai_autotune_table_entries",
+            "Entries in the loaded kernel tuning table "
+            "(LOCALAI_TUNE_CACHE; 0 = defaults everywhere)",
+        )
+        self.autotune_sweep_seconds = Gauge(
+            "localai_autotune_sweep_seconds",
+            "Wall seconds of the last tools/autotune.py sweep per shape "
+            "key",
+        )
+        self.paged_kernel_impl = Gauge(
+            "localai_paged_kernel_impl",
+            "1 for the paged decode attention implementation each engine "
+            "selected (impl=pallas|lax) — a silent fallback off the "
+            "Pallas kernel flips the labeled series",
+        )
         self.kv_invariant_violations = Counter(
             "localai_kv_invariant_violations_total",
             "BlockAllocator.check_invariants violations observed at "
@@ -484,6 +506,13 @@ def update_engine_gauges(name: str, m: dict,
         reg.prefill_chunk_queue.set(
             m.get("prefill_chunk_queue_depth", 0), model=name)
         reg.prefill_chunks.set_total(m.get("prefill_chunks", 0), model=name)
+        impl = m.get("paged_attn_impl")
+        if impl:
+            # one-hot over the impl label so a kernel→fallback flip is a
+            # visible series transition, not a silent value change
+            for label in ("pallas", "lax"):
+                reg.paged_kernel_impl.set(
+                    1.0 if impl == label else 0.0, model=name, impl=label)
     reg.decode_dispatches.set_total(m.get("dispatches", 0), model=name)
     if "quarantined_slots" in m:
         # point-in-time NaN-quarantine census; the nan_rows/rebuilds

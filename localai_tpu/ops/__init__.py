@@ -81,23 +81,47 @@ def select_attn_impl(requested: str, *, num_heads: int, num_kv_heads: int,
 def select_paged_attn_impl(requested: str, *, num_heads: int,
                            num_kv_heads: int, head_dim: int,
                            block_tokens: int, tp: int = 1,
-                           backend: str | None = None
-                           ) -> tuple[str, bool, str]:
+                           kv_dtype: str = "bfloat16",
+                           backend: str | None = None,
+                           tuned=None) -> tuple[str, bool, str]:
     """Attention-impl decision for the PAGED decode path (the paged analogue
     of ``select_attn_impl``). Returns (impl, interpret, reason).
 
     The Pallas paged kernel DMAs one [block_tokens, head_dim] physical
     block per online-softmax step, so on hardware it needs Mosaic-tileable
     blocks: head_dim 128-aligned and block_tokens covering the dtype's
-    sublane minimum (32 covers int8, the narrowest pool dtype). The
-    ``gather + XLA`` fallback (ops.paged_decode_attention_ref wired through
-    the paged write policies) has no shape constraints and is the CPU/test
-    path. Override with ``LOCALAI_PAGED_ATTN_IMPL``.
+    sublane minimum (32 covers int8, the narrowest full-width pool dtype).
+    int4 pools are nibble-packed along head_dim, so their DMA'd last dim
+    is head_dim/2 — on hardware that needs head_dim 256-aligned to stay
+    lane-tileable (hd-128 int4 models take the gather fallback unless a
+    tuned or env override proves the kernel). The ``gather + XLA``
+    fallback (ops.paged_decode_attention_ref wired through the paged
+    write policies) has no shape constraints and is the CPU/test path.
+
+    Precedence: an explicit ``requested`` wins; then the
+    ``LOCALAI_PAGED_ATTN_IMPL`` env override; then a tuned entry from the
+    per-shape tuning table (ops.tuning, keyed by head_dim / kv heads /
+    kv_dtype / tp — pass ``tuned`` to reuse an entry the caller already
+    looked up and skip the second lookup receipt); then the backend
+    default. Hard shape gates apply to every source except the explicit
+    env override-to-xla (tuned "pallas" on an untileable shape still
+    falls back, with the reason reported). A tuned "pallas" is honored
+    ONLY on a real TPU backend: off-TPU that impl would mean the Pallas
+    *interpreter* — orders of magnitude slower — and the table is an
+    automatic source, not a user's explicit interpret opt-in.
     """
     backend = backend or jax.default_backend()
     impl = requested
     if impl in ("auto", ""):
         impl = os.environ.get("LOCALAI_PAGED_ATTN_IMPL", "") or "auto"
+    if impl in ("auto", ""):
+        if tuned is None:
+            from localai_tpu.ops import tuning
+
+            tuned = tuning.lookup(head_dim, num_kv_heads, kv_dtype, tp)
+        if tuned is not None and tuned.impl and (
+                tuned.impl != "pallas" or backend == "tpu"):
+            impl = tuned.impl
     if impl in ("auto", ""):
         impl = "pallas" if backend == "tpu" else "xla"
     if impl not in ("pallas", "pallas_interpret", "xla"):
@@ -119,6 +143,11 @@ def select_paged_attn_impl(requested: str, *, num_heads: int,
             return "xla", False, (
                 f"head_dim={head_dim} block_tokens={block_tokens} not "
                 f"Mosaic-tileable (need hd%128==0, bt%32==0)")
+        if kv_dtype == "int4" and head_dim % 256:
+            # the nibble-packed pool's DMA'd last dim is head_dim/2
+            return "xla", False, (
+                f"int4 pool packs head_dim to {head_dim // 2} lanes "
+                f"(need hd%256==0 for the packed Mosaic tiling)")
         if num_heads % num_kv_heads:
             return "xla", False, (
                 f"heads ({num_heads} q / {num_kv_heads} kv) not grouped")

@@ -37,6 +37,15 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# the in-kernel dequant step for int4 KV pools: the ONE halves-layout
+# unpacker (pure VPU shifts — models.quant has no ops imports at module
+# level, so no cycle) shared with the pool writers; a drifted second
+# copy would make the kernels silently dequantize differently from the
+# scatter that packed the rows
+from localai_tpu.models.quant import (
+    unpack_int4_lastdim as _unpack_nibbles,
+)
+
 _NEG_INF = -1e30
 
 
@@ -62,9 +71,15 @@ def _pick_block_aligned(total: int, target: int) -> int:
     return b
 
 
+
+
 def _flash_loop(q, kv_slice, kbuf, vbuf, ksem, vsem, lo, nb, block_k,
-                mask_for_block, scales=None, scale_dma=None):
-    """Online-softmax loop over KV blocks [lo, nb) with double-buffered DMA.
+                mask_for_block, scales=None, scale_dma=None, depth: int = 2,
+                unpack: bool = False):
+    """Online-softmax loop over KV blocks [lo, nb) with ``depth``-deep
+    double-buffered DMA (depth 2 = classic ping-pong; 3 keeps one extra
+    block in flight for gather-latency-bound paged pools — autotunable via
+    ops.tuning).
 
     q: [rows, hd] f32 (pre-scaled). ``kv_slice(hbm_ref, i)`` yields the
     [block_k, hd] HBM slice for block i; ``mask_for_block(i)`` the
@@ -82,8 +97,13 @@ def _flash_loop(q, kv_slice, kbuf, vbuf, ksem, vsem, lo, nb, block_k,
     live per-block in HBM (pool layout, no per-head VMEM residency), so
     they ride the same double-buffered DMA as K/V. A tuple
     (ks_hbm(i), vs_hbm(i), ksbuf, vsbuf, kssem, vssem) — block i's [1,
-    block_k] HBM slices plus their [2, 1, block_k] scratch and semaphores.
-    Mutually exclusive with ``scales``.
+    block_k] HBM slices plus their [depth, 1, block_k] scratch and
+    semaphores. Mutually exclusive with ``scales``.
+
+    ``unpack=True`` fuses int4 KV dequantization: the buffered blocks are
+    nibble-packed int8 ([block_k, hd/2], models.quant.quantize_lastdim4)
+    and unpack in VMEM right after the DMA wait — HALF the int8 path's
+    HBM bytes moved per block, with the same per-position scale fusion.
     """
     k_hbm, v_hbm = kv_slice
     rows, hd = q.shape
@@ -110,19 +130,29 @@ def _flash_loop(q, kv_slice, kbuf, vbuf, ksem, vsem, lo, nb, block_k,
             pltpu.make_async_copy(
                 vs_hbm(i), vsbuf.at[slot], vssem.at[slot]).wait()
 
+    # prime the pipeline: depth-1 blocks in flight before the first fold
+    # (the loop body keeps exactly depth-1 ahead of the block in hand)
     start(lo, 0)
+    for j in range(1, depth - 1):
+        @pl.when(lo + j < nb)
+        def _prime(j=j):
+            start(lo + j, j)
 
     def body(i, carry):
         m, l, acc = carry
-        slot = lax.rem(i - lo, 2)
+        slot = lax.rem(i - lo, depth)
 
-        @pl.when(i + 1 < nb)
+        @pl.when(i + depth - 1 < nb)
         def _prefetch():
-            start(i + 1, lax.rem(i + 1 - lo, 2))
+            start(i + depth - 1, lax.rem(i + depth - 1 - lo, depth))
 
         wait(i, slot)
-        k = kbuf[slot].astype(jnp.float32)
-        v = vbuf[slot].astype(jnp.float32)
+        if unpack:
+            k = _unpack_nibbles(kbuf[slot]).astype(jnp.float32)
+            v = _unpack_nibbles(vbuf[slot]).astype(jnp.float32)
+        else:
+            k = kbuf[slot].astype(jnp.float32)
+            v = vbuf[slot].astype(jnp.float32)
         s = q @ k.T  # [rows, block_k] — MXU
         if scales is not None:
             s = s * ks_block(i)[None, :]
@@ -375,12 +405,15 @@ def gather_block_scales(scales: jax.Array, tables: jax.Array) -> jax.Array:
 
 def _paged_decode_kernel(pos_ref, tbl_ref, q_ref, k_ref, v_ref, *rest,
                          block_tokens: int, sm_scale: float,
-                         sliding_window: Optional[int], quantized: bool):
+                         sliding_window: Optional[int], quantized: bool,
+                         int4: bool, num_buffers: int):
     # k_ref/v_ref are the FULL [N, Hkv, bt, hd] block pool in HBM; the
     # block walked at loop step i is tbl_ref[slot, i] (SMEM block table),
     # so the DMA gathers physically-scattered blocks in logical order.
-    # Scale rows ([N, Hkv, bt] f32 for int8 pools) are per-block in HBM
-    # and ride the same double-buffered DMA (scale_dma in _flash_loop).
+    # Scale rows ([N, Hkv, bt] f32 for int8/int4 pools) are per-block in
+    # HBM and ride the same buffered DMA (scale_dma in _flash_loop). int4
+    # pools arrive nibble-packed [N, Hkv, bt, hd/2] and unpack in VMEM
+    # after the DMA wait — half the int8 path's bytes per block.
     if quantized:
         (ks_ref, vs_ref, o_ref, kbuf, vbuf, ksbuf, vsbuf,
          ksem, vsem, kssem, vssem) = rest
@@ -418,21 +451,23 @@ def _paged_decode_kernel(pos_ref, tbl_ref, q_ref, k_ref, v_ref, *rest,
                      ksbuf, vsbuf, kssem, vssem)
     out = _flash_loop(q, (slice_of(k_ref), slice_of(v_ref)),
                       kbuf, vbuf, ksem, vsem, lo, nb, bt, mask_for_block,
-                      scale_dma=scale_dma)
+                      scale_dma=scale_dma, depth=num_buffers, unpack=int4)
     o_ref[0, 0] = out.astype(o_ref.dtype)
 
 
 def paged_decode_attention(
     q: jax.Array,            # [S, Hq, hd]
     k_cache: jax.Array,      # [N, Hkv, bt, hd] block pool
+                             # (int4: nibble-packed [N, Hkv, bt, hd/2])
     v_cache: jax.Array,      # [N, Hkv, bt, hd]
     tables: jax.Array,       # [S, MB] i32 per-slot block tables
     positions: jax.Array,    # [S] i32 — current token's KV write position
-    k_scale: Optional[jax.Array] = None,  # [N, Hkv, bt] f32 (scaled-int8)
+    k_scale: Optional[jax.Array] = None,  # [N, Hkv, bt] f32 (int8/int4)
     v_scale: Optional[jax.Array] = None,
     *,
     sliding_window: Optional[int] = None,
     interpret: bool = False,
+    num_buffers: int = 2,
 ) -> jax.Array:
     """Flash GQA decode attention over a paged block pool. Returns
     [S, Hq, hd]. The kernel walks each slot's block table in SMEM and
@@ -452,10 +487,14 @@ def paged_decode_attention(
     g = Hq // Hkv
     qg = q.reshape(S, Hkv, g, hd)
     quantized = k_scale is not None
+    # an int4 pool is self-describing: its last dim is the packed hd/2
+    int4 = quantized and k_cache.shape[-1] * 2 == hd
+    depth = max(2, int(num_buffers))
 
     kernel = functools.partial(
         _paged_decode_kernel, block_tokens=bt, sm_scale=hd ** -0.5,
         sliding_window=sliding_window, quantized=quantized,
+        int4=int4, num_buffers=depth,
     )
     in_specs = [
         pl.BlockSpec((S,), lambda s, h: (0,), memory_space=pltpu.SMEM),
@@ -468,16 +507,18 @@ def paged_decode_attention(
     args = [positions.astype(jnp.int32), tables.astype(jnp.int32), qg,
             k_cache, v_cache]
     scratch = [
-        pltpu.VMEM((2, bt, hd), k_cache.dtype),
-        pltpu.VMEM((2, bt, hd), v_cache.dtype),
+        # int4 pools buffer the packed [bt, hd/2] bytes — unpack happens
+        # after the DMA wait, so the scratch mirrors the pool's last dim
+        pltpu.VMEM((depth, bt, k_cache.shape[-1]), k_cache.dtype),
+        pltpu.VMEM((depth, bt, v_cache.shape[-1]), v_cache.dtype),
     ]
     if quantized:
         in_specs += [pl.BlockSpec(memory_space=pl.ANY),
                      pl.BlockSpec(memory_space=pl.ANY)]
         args += [k_scale, v_scale]
-        scratch += [pltpu.VMEM((2, 1, bt), jnp.float32),
-                    pltpu.VMEM((2, 1, bt), jnp.float32)]
-    scratch += [pltpu.SemaphoreType.DMA((2,))] * (4 if quantized else 2)
+        scratch += [pltpu.VMEM((depth, 1, bt), jnp.float32),
+                    pltpu.VMEM((depth, 1, bt), jnp.float32)]
+    scratch += [pltpu.SemaphoreType.DMA((depth,))] * (4 if quantized else 2)
     out = pl.pallas_call(
         kernel,
         grid=(S, Hkv),
@@ -503,14 +544,22 @@ def paged_decode_attention_ref(
 ) -> jax.Array:
     """Pure-lax paged decode attention (gather + masked softmax): the CPU
     fallback and the numerical reference the Pallas kernel is tested
-    against. Returns [S, Hq, hd]."""
+    against. Handles f32/bf16, scaled-int8 and nibble-packed int4 pools
+    (int4 detected from the pool's packed hd/2 last dim). Returns
+    [S, Hq, hd]."""
     S, Hq, hd = q.shape
     Hkv, bt = k_cache.shape[1], k_cache.shape[2]
     MB = tables.shape[1]
     g = Hq // Hkv
+    int4 = k_scale is not None and k_cache.shape[-1] * 2 == hd
 
-    keys = gather_blocks(k_cache, tables).astype(jnp.float32)
-    values = gather_blocks(v_cache, tables).astype(jnp.float32)
+    keys = gather_blocks(k_cache, tables)
+    values = gather_blocks(v_cache, tables)
+    if int4:
+        keys = _unpack_nibbles(keys)
+        values = _unpack_nibbles(values)
+    keys = keys.astype(jnp.float32)
+    values = values.astype(jnp.float32)
     if k_scale is not None:
         keys = keys * gather_block_scales(k_scale, tables)[..., None]
         values = values * gather_block_scales(v_scale, tables)[..., None]

@@ -103,20 +103,12 @@ def ring_attention(
 
 
 def _tp_param_specs(cfg: LlamaConfig, mesh: Mesh, params: Any) -> Any:
-    """Per-leaf PartitionSpecs for the trunk params under TP ('model' axis),
-    mirroring parallel.sharding.shard_params' placement (quantized leaves
-    expand to (q, scale) specs)."""
-    from localai_tpu.models.llama import param_shapes
+    """Per-leaf PartitionSpecs for the trunk params under TP ('model' axis)
+    — the shared helper in parallel.sharding (also used by the
+    parallel.overlap decode path)."""
     from localai_tpu.parallel import sharding as shd
 
-    specs = shd.param_specs(cfg, mesh, shapes=param_shapes(cfg))
-    # drop spec entries (lm_head) that the trunk params may not carry
-    specs = {k: v for k, v in specs.items() if k in params}
-    return jax.tree.map(
-        lambda sp, arr: shd.expand_quantized_spec(sp, arr, mesh),
-        specs, {k: params[k] for k in specs},
-        is_leaf=lambda x: isinstance(x, P),
-    )
+    return shd.tp_param_specs(cfg, mesh, params)
 
 
 def sp_prefill_forward(

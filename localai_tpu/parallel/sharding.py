@@ -159,6 +159,32 @@ def block_table_spec() -> P:
     return P("data", None)
 
 
+def tp_param_specs(cfg: LlamaConfig, mesh: Mesh, params: Any) -> Any:
+    """Per-leaf PartitionSpecs for (a subset of) the trunk params under
+    manual tensor parallelism ('model' axis), mirroring shard_params'
+    placement — quantized leaves expand to (q, scale) specs. The single
+    spec source for every manual-SPMD shard_map over the trunk
+    (parallel.ring sequence-parallel prefill, parallel.overlap decode)."""
+    specs = param_specs(cfg, mesh, shapes=None)
+    # drop spec entries (e.g. lm_head) the caller's param subset omits
+    specs = {k: v for k, v in specs.items() if k in params}
+    return jax.tree.map(
+        lambda sp, arr: expand_quantized_spec(sp, arr, mesh),
+        specs, {k: params[k] for k in specs},
+        is_leaf=lambda x: isinstance(x, P),
+    )
+
+
+def overlap_intermediate_spec() -> P:
+    """Layout of the reduce-scattered row-parallel intermediate in the
+    collective/compute-overlap decode path (parallel.overlap): each
+    psum_scatter chunk of the attention-out / mlp-down product lands
+    [S, T, D/tp] with the hidden dim on 'model' before its all_gather
+    re-replicates it. Exposed so tests can pin the decomposition's
+    layout contract."""
+    return P(None, None, "model")
+
+
 def state_specs(mesh: Mesh) -> dict:
     """PartitionSpecs for DecodeState fields (see engine.runner)."""
     return {

@@ -420,7 +420,10 @@ def build_spec_engine(target: ModelRunner, *,
         num_slots=target.num_slots,
         max_ctx=target.max_ctx,
         prefill_buckets=list(target.buckets[:-1]) or None,
-        kv_dtype=target.kv_dtype,
+        # int4 is a paged-pool-only layout; a contiguous draft cache
+        # falls back to the scaled-int8 scheme (same bandwidth class)
+        kv_dtype=("int8" if target.kv_dtype == "int4"
+                  else target.kv_dtype),
         mesh=target.mesh,
         # the draft serves window scans over slot rows only — contiguous
         paged=False,

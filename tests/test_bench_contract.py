@@ -57,8 +57,13 @@ def test_bench_quantized_decode_path_runs_on_cpu():
     sys.path.insert(0, str(REPO))
     import bench
 
-    tok_s = bench.run_decode_bench(
+    tok_s, info = bench.run_decode_bench(
         "tiny", "int8", steps=2, multi=1, depth=1,
         num_slots=2, max_ctx=256,
     )
     assert tok_s > 0
+    # phase-provenance fields (ISSUE 14): every decode line must say
+    # which kernel and KV dtype produced its number
+    assert info["kernel_impl"] in ("pallas", "lax")
+    assert info["kv_dtype"] == "int8"
+    assert info["tokens_per_dispatch"] == 2
