@@ -666,6 +666,37 @@ def main() -> None:
     def phase_on(*names: str) -> bool:
         return not sel or any(n in sel for n in names)
 
+    # BENCH_PROFILE=<phase> (1b / 8b / meshed / spec): wrap EXACTLY ONE
+    # matching phase in a jax.profiler capture and record the artifact
+    # path in the output JSON — the ROADMAP item 1 hardware round needs
+    # slow-phase attribution (which program, which gap), not another
+    # blind retry. One phase only: profiling is real device overhead and
+    # a whole-round capture would skew every number on the board.
+    profile_sel = (os.environ.get("BENCH_PROFILE", "")
+                   .strip().removeprefix("debug:"))
+    profiled = {"armed": bool(profile_sel)}
+
+    def maybe_profiled(names: tuple, fn):
+        if not profiled["armed"] or profile_sel not in names:
+            return fn
+        profiled["armed"] = False  # exactly one phase captures
+
+        def wrapped():
+            import jax
+
+            path = os.path.join(
+                os.environ.get("BENCH_PROFILE_DIR", "bench_profile"),
+                f"phase-{profile_sel}")
+            os.makedirs(path, exist_ok=True)
+            jax.profiler.start_trace(path)
+            try:
+                fn()
+            finally:
+                jax.profiler.stop_trace()
+                board.annotate("profile_phase", profile_sel)
+                board.annotate("profile_dir", path)
+        return wrapped
+
     phases: list[tuple] = []
     if preset in ("llama3-8b", "8b"):          # cheap trend config first,
         if phase_on("1b"):                     # then the north star
@@ -775,10 +806,12 @@ def main() -> None:
             # measured progress is still readable from here (partial
             # tokens + step-time percentiles instead of a bare 0.0)
             flight = FlightRecorder(512)
-            ok = guarded(label, lambda p=p, q=q, primary=primary,
-                         flight=flight: _measure(
+            phase_fn = (lambda p=p, q=q, primary=primary,
+                        flight=flight, label=label: _measure(
                 board, p, q, steps, multi, depth, primary,
                 watchdog=wd, channel=label, flight=flight))
+            names = (p, "8b") if p == "llama3-8b" else (p,)
+            ok = guarded(label, maybe_profiled(names, phase_fn))
             if not ok:
                 board.annotate("partial_tokens", flight.total_tokens)
                 pct = flight.percentiles()
@@ -818,10 +851,11 @@ def main() -> None:
                 and deadline - time.monotonic() > 120):
             mp, mq = ("1b", "int8") if has_8b else (preset, quant)
             mflight = FlightRecorder(512)
-            guarded("bench:meshed", lambda: _measure(
-                board, mp, mq, steps, multi, depth, primary=False,
-                watchdog=wd, channel="bench:meshed", flight=mflight,
-                meshed=True))
+            guarded("bench:meshed", maybe_profiled(("meshed",), lambda:
+                _measure(
+                    board, mp, mq, steps, multi, depth, primary=False,
+                    watchdog=wd, channel="bench:meshed", flight=mflight,
+                    meshed=True)))
         # speculative phase (ISSUE 11): the paged+spec lane with the
         # n-gram self-drafter on repetitive prompts — its own output key
         # ("spec"), BENCH_SPEC=0 escape, never displaces the trend line
@@ -830,9 +864,10 @@ def main() -> None:
                 and deadline - time.monotonic() > 90):
             sp, sq = ("1b", "int8") if has_8b else (preset, quant)
             sflight = FlightRecorder(512)
-            guarded("bench:spec", lambda: _measure_spec(
-                board, sp, sq, steps, watchdog=wd,
-                channel="bench:spec", flight=sflight))
+            guarded("bench:spec", maybe_profiled(("spec",), lambda:
+                _measure_spec(
+                    board, sp, sq, steps, watchdog=wd,
+                    channel="bench:spec", flight=sflight)))
 
     t = threading.Thread(target=work, daemon=True)
     t.start()

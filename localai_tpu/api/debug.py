@@ -28,6 +28,18 @@ saw); ``?limit=N`` bounds the newest records returned. The "what was the
 engine doing for the last N seconds" view — reading it never touches a
 device.
 
+``GET /debug/fleet/flight`` — the fleet-wide flight view: every replica's
+ring harvested over GetTelemetry (off the event loop, fleet RPC deadline)
+and merged into one table with a ``replica`` column plus per-replica
+step-time percentiles (obs.fleetview). A wedged replica degrades to an
+``unreachable`` pane — the endpoint itself always answers.
+
+``GET /debug/profiles`` — the anomaly-capture manifest (obs.profiler):
+every auto-captured jax.profiler trace with its trigger (stall /
+slo_shed / step_p99_regression), triggering trace id, reason, and
+artifact path, plus the manager's rate-limit state (cooldown, per-hour
+budget, skip counts).
+
 ``GET /debug/kv`` — per-model paged block-pool audit: allocator stats,
 live tables, and the result of ``BlockAllocator.check_invariants()``
 (block conservation + refcount sanity). Any violation is a leak.
@@ -165,6 +177,46 @@ async def flight(request: web.Request) -> web.Response:
     })
 
 
+async def fleet_flight(request: web.Request) -> web.Response:
+    from localai_tpu.obs import fleetview
+
+    state = _state(request)
+    try:
+        since = float(request.query.get("since", 0.0))
+    except ValueError:
+        raise web.HTTPBadRequest(
+            text="since must be a number (a record's monotonic ts)")
+    try:
+        limit = int(request.query.get("limit", 256))
+    except ValueError:
+        raise web.HTTPBadRequest(text="limit must be an integer")
+    limit = max(1, min(limit, 4096))
+    loop = asyncio.get_running_loop()
+
+    def build() -> dict:
+        # one bounded GetTelemetry per replica, NEVER on the event loop:
+        # a wedged replica costs its pane one fleet RPC deadline, not the
+        # endpoint
+        models = {}
+        for name, sm in state.manager.loaded_snapshot().items():
+            if getattr(sm, "pool", None) is None:
+                continue
+            models[name] = fleetview.fleet_flight(
+                sm, since=since, limit=limit)
+        return models
+
+    return web.json_response({
+        "now_monotonic": round(time.monotonic(), 6),
+        "models": await loop.run_in_executor(state.executor, build),
+    })
+
+
+async def profiles(request: web.Request) -> web.Response:
+    from localai_tpu.obs.profiler import PROFILER
+
+    return web.json_response(PROFILER.report())
+
+
 async def kv(request: web.Request) -> web.Response:
     state = _state(request)
     models = {}
@@ -235,6 +287,8 @@ def routes() -> list[web.RouteDef]:
         web.get("/debug/programs", programs),
         web.get("/debug/stacks", stacks),
         web.get("/debug/flight", flight),
+        web.get("/debug/fleet/flight", fleet_flight),
+        web.get("/debug/profiles", profiles),
         web.get("/debug/kv", kv),
         web.get("/debug/faults", faults_get),
         web.post("/debug/faults", faults_post),

@@ -10,7 +10,6 @@ from __future__ import annotations
 
 import asyncio
 import logging
-import threading
 import time
 
 from aiohttp import web
@@ -102,8 +101,12 @@ async def metrics(request: web.Request) -> web.Response:
     # SLO observatory: burn-rate + shedding gauges refresh at scrape time
     # too (host-side window scans only — never a device dispatch)
     from localai_tpu.obs import slo as obs_slo
+    from localai_tpu.obs import trace as obs_trace
 
     obs_slo.SLO.export_gauges()
+    # trace-store sizing receipt (LOCALAI_TRACE_CAPACITY): dashboards can
+    # tell "trace evicted from the ring" from "trace never recorded"
+    REGISTRY.trace_ring_size.set(obs_trace.STORE.capacity)
     # offline batch subsystem: job-state gauge + lane-paused flag refresh
     # at scrape time (host-side JSON reads only)
     state.batches.export_gauges()
@@ -268,9 +271,6 @@ async def engine_metrics(request: web.Request) -> web.Response:
     return web.json_response(_state(request).manager.metrics())
 
 
-_trace_lock = threading.Lock()
-
-
 async def backend_trace(request: web.Request) -> web.Response:
     """POST {seconds?, dir?} → capture a device/XLA profiler trace
     (jax.profiler, TensorBoard/XProf format) while serving continues.
@@ -305,7 +305,12 @@ async def backend_trace(request: web.Request) -> web.Response:
     def capture() -> str:
         import jax
 
-        if not _trace_lock.acquire(blocking=False):
+        # single-flight is SHARED with the anomaly profiler
+        # (obs.profiler): the device runs at most one capture at a time
+        # no matter which surface asked for it
+        from localai_tpu.obs.profiler import PROFILER
+
+        if not PROFILER.acquire_capture():
             raise RuntimeError("a trace capture is already running")
         try:
             path = str(out / time.strftime("trace-%Y%m%d-%H%M%S"))
@@ -314,7 +319,7 @@ async def backend_trace(request: web.Request) -> web.Response:
             jax.profiler.stop_trace()
             return path
         finally:
-            _trace_lock.release()
+            PROFILER.release_capture()
 
     loop = asyncio.get_running_loop()
     try:

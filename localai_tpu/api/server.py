@@ -40,8 +40,9 @@ TRACE_KEY = "trace_id"
 # (a 15s Prometheus scrape would otherwise dominate the http ring)
 TRACE_SKIP = {"/metrics", "/healthz", "/readyz", "/v1/traces", "/v1/slo",
               "/debug/devices", "/debug/programs", "/debug/stacks",
-              "/debug/flight", "/debug/kv", "/debug/faults"}
-TRACE_SKIP_PREFIXES = ("/debug/timeline/",)
+              "/debug/flight", "/debug/fleet/flight", "/debug/profiles",
+              "/debug/kv", "/debug/faults"}
+TRACE_SKIP_PREFIXES = ("/debug/timeline/", "/v1/traces/")
 
 # paths reachable without an API key (parity: auth exemption filter,
 # core/http/middleware/auth.go:17+)
@@ -94,6 +95,15 @@ class AppState:
             targets=obs_slo.targets_from_config(self.config),
             burn_threshold=self.config.slo_burn_threshold,
         )
+        # anomaly-triggered profiler capture (obs.profiler): armed only
+        # when LOCALAI_PROFILE_ON_ANOMALY=1 — hooks watchdog stalls, SLO
+        # shed onsets, and the per-engine flight rings; profiles land
+        # under <backend-assets>/profiles with a manifest
+        # (GET /debug/profiles)
+        from localai_tpu.obs import profiler as obs_profiler
+
+        obs_profiler.install_from_env(
+            str(self.config.backend_assets_path or "."))
         self.galleries: list[Gallery] = [
             Gallery(name=g.get("name", ""), url=g.get("url", ""))
             for g in self.config.galleries

@@ -3,18 +3,35 @@
 ``GET /v1/traces`` — newest-first span trees from the ring-buffer trace
 store (``?limit=N``, ``?kind=request|http``, ``?model=name``).
 
+``GET /v1/traces/{trace_id}`` — ONE stitched waterfall for one trace id:
+the front door's spans plus every fleet replica's harvested half
+(GetTelemetry), remote span trees skew-anchored to the local dispatch
+RPC span and tagged ``replica=`` (obs.fleetview). The harvest runs off
+the event loop with the fleet RPC deadline — a wedged replica degrades
+to an ``unreachable`` pane, never a hung endpoint.
+
 ``GET /debug/timeline/{request_id}`` — every trace matching one trace id
 or engine request id (the HTTP span plus each engine request it spawned,
 e.g. n>1 fan-out), merged into one flat, time-ordered timeline with
 offsets relative to the earliest span — the "where did my latency go"
-view for a single request.
+view for a single request. When the trace crossed replicas, the response
+additionally carries the stitched fleet waterfall under ``fleet``.
 """
 
 from __future__ import annotations
 
+import asyncio
+
 from aiohttp import web
 
+from localai_tpu.obs import fleetview
 from localai_tpu.obs.trace import STORE, mono_to_wall
+
+
+def _state(request: web.Request):
+    from localai_tpu.api.server import STATE_KEY
+
+    return request.app[STATE_KEY]
 
 
 async def list_traces(request: web.Request) -> web.Response:
@@ -31,6 +48,46 @@ async def list_traces(request: web.Request) -> web.Response:
         "object": "list",
         "traces": [t.to_dict() for t in traces],
     })
+
+
+async def _stitched(request: web.Request, tid: str,
+                    local: list[dict]) -> dict:
+    """Harvest every fleet-served model's replicas named by this trace's
+    spans and stitch one waterfall — one bounded GetTelemetry per named
+    replica, on the executor (never the event loop)."""
+    state = _state(request)
+    loop = asyncio.get_running_loop()
+
+    def build() -> dict:
+        harvested: dict[str, dict] = {}
+        for sm in state.manager.loaded_snapshot().values():
+            if getattr(sm, "pool", None) is not None:
+                harvested.update(
+                    fleetview.harvest_for_trace(sm, tid, local))
+        return fleetview.stitch(tid, local, harvested)
+
+    return await loop.run_in_executor(state.executor, build)
+
+
+async def trace_detail(request: web.Request) -> web.Response:
+    tid = request.match_info["trace_id"]
+    hits = STORE.find(tid)
+    if not hits:
+        raise web.HTTPNotFound(
+            text=f"no trace recorded for {tid!r} (traces are kept in a "
+                 f"bounded ring; see /v1/traces for what is retained)"
+        )
+    local = [t.to_dict() for t in hits]
+    # STORE.find also matches engine request ids ("model-N") — those are
+    # per-process counters, NOT safe to harvest by (a worker's "model-N"
+    # is a different request). Resolve to the matched traces' real trace
+    # id before pulling the remote half.
+    tids = {t.trace_id for t in hits}
+    harvest_tid = tid if tid in tids else (
+        next(iter(tids)) if len(tids) == 1 else None)
+    if harvest_tid is None:
+        return web.json_response(fleetview.stitch(tid, local, {}))
+    return web.json_response(await _stitched(request, harvest_tid, local))
 
 
 async def timeline(request: web.Request) -> web.Response:
@@ -55,16 +112,35 @@ async def timeline(request: web.Request) -> web.Response:
                 "attrs": dict(s.attrs),
             })
     events.sort(key=lambda e: e["offset_ms"])
-    return web.json_response({
+    local = [t.to_dict() for t in hits]
+    body = {
         "request_id": rid,
         "start_unix": round(mono_to_wall(origin), 6),
-        "traces": [t.to_dict() for t in hits],
+        "traces": local,
         "timeline": events,
-    })
+    }
+    # the fleet half rides along when the trace crossed replicas: the
+    # stitched waterfall carries front-door AND replica-side spans in one
+    # skew-anchored sequence (local-only traces add nothing and skip the
+    # harvest entirely). The harvest key must be a genuine TRACE id —
+    # {request_id} also matches engine request ids ("model-N"), which are
+    # per-process counters: harvesting by one would pull a STRANGER's
+    # "model-N" spans off the worker and stitch them into this timeline.
+    tids = {t.trace_id for t in hits}
+    harvest_tid = rid if rid in tids else (
+        next(iter(tids)) if len(tids) == 1 else None)
+    if harvest_tid is not None and fleetview.replica_ids_for_trace(local):
+        stitched = await _stitched(request, harvest_tid, local)
+        body["fleet"] = {
+            "replicas": stitched["replicas"],
+            "waterfall": stitched["waterfall"],
+        }
+    return web.json_response(body)
 
 
 def routes() -> list[web.RouteDef]:
     return [
         web.get("/v1/traces", list_traces),
+        web.get("/v1/traces/{trace_id}", trace_detail),
         web.get("/debug/timeline/{request_id}", timeline),
     ]

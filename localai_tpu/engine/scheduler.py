@@ -39,6 +39,7 @@ from localai_tpu.engine.stream import IncrementalDetokenizer, StopChecker
 from localai_tpu.faults import registry as _faults
 from localai_tpu.obs import compile as obs_compile
 from localai_tpu.obs import flight as obs_flight
+from localai_tpu.obs import profiler as obs_profiler
 from localai_tpu.obs import watchdog as obs_watchdog
 from localai_tpu.obs.engine import EngineTelemetry
 
@@ -258,6 +259,11 @@ class Scheduler:
         self.watchdog.add_context(
             f"flight:{self._wd_channel}", self._flight_forensics
         )
+        # anomaly profiler: the ring is watched (weakly) for step-time
+        # p99 regressions against its own trailing window — a no-op dict
+        # insert unless LOCALAI_PROFILE_ON_ANOMALY armed the manager
+        obs_profiler.PROFILER.watch_flight(
+            self.telemetry.model or "engine", self.flight)
         # speculative decoding (localai_tpu.spec.SpecEngine): when set and
         # no grammar constraint is active, dispatches run draft+verify
         # windows instead of plain multi-step decode — on BOTH KV layouts
@@ -669,6 +675,8 @@ class Scheduler:
         if self.supervisor is not None:
             self.supervisor.detach()
         self.watchdog.remove_context(f"flight:{self._wd_channel}")
+        obs_profiler.PROFILER.unwatch_flight(
+            self.telemetry.model or "engine")
         self._thread.join(timeout)
         if self._pc_thread is not None:
             self._pc_queue.put(None)  # flush: writer drains FIFO first

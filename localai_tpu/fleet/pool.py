@@ -421,11 +421,14 @@ class ReplicaPool:
                 snap["address"] = getattr(r, "address", None)
             if with_metrics and r.state == HEALTHY:
                 m = r.metrics()
+                # step-time percentiles + spec accept ride along so
+                # /v1/fleet explains route-around decisions per replica
                 snap["engine"] = {
                     k: m.get(k) for k in (
                         "occupancy", "queue_depth", "kv_utilization",
                         "total_generated_tokens", "step_ms_p50",
-                        "step_ms_p99", "error",
+                        "step_ms_p99", "spec_accept_rate",
+                        "spec_tokens_per_dispatch", "error",
                     ) if k in m
                 }
             reps.append(snap)

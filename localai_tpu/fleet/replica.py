@@ -165,6 +165,14 @@ class BaseReplica:
     def metrics(self) -> dict:
         raise NotImplementedError
 
+    def telemetry(self, trace_id: str = "", since: float = 0.0,
+                  limit: int = 256, recent: int = 20) -> dict:
+        """This replica's observability pane (obs.fleetview payload:
+        trace spans + flight snapshot + metrics). Never raises — a
+        wedged/partitioned replica returns ``{"error", "unreachable"}``
+        so the caller degrades that replica's pane, not the endpoint."""
+        raise NotImplementedError
+
     def process_alive(self) -> bool:
         """Cheap no-RPC liveness (worker: process poll)."""
         raise NotImplementedError
@@ -235,6 +243,22 @@ class _ClientReplica(BaseReplica):
             return self._client.metrics(timeout=3.0)
         except Exception as e:  # noqa: BLE001 — stats pull ≠ serving
             return {"error": str(e)}
+
+    def telemetry(self, trace_id: str = "", since: float = 0.0,
+                  limit: int = 256, recent: int = 20) -> dict:
+        from localai_tpu.fleet import net
+
+        try:
+            # the harvest carries the fleet RPC deadline — one bounded
+            # pull, no retries: a wedged peer must degrade its pane in one
+            # deadline, not three (the read is idempotent; the NEXT pane
+            # refresh is the retry)
+            t = net.rpc_timeout_s()
+            return self._client.get_telemetry(
+                trace_id=trace_id, since=since, limit=limit, recent=recent,
+                timeout=t if t > 0 else 60.0)
+        except Exception as e:  # noqa: BLE001 — telemetry pull ≠ serving
+            return {"error": str(e), "unreachable": True}
 
 
 class WorkerReplica(_ClientReplica):
@@ -434,6 +458,31 @@ class InProcessReplica(BaseReplica):
         if self.sm is None:
             return {"error": "not started"}
         return self.sm.scheduler.metrics()
+
+    def telemetry(self, trace_id: str = "", since: float = 0.0,
+                  limit: int = 256, recent: int = 20) -> dict:
+        # same payload builder the gRPC servicer uses (obs.fleetview), so
+        # the wire and in-process panes cannot drift. NOTE: in-process
+        # engines share the front door's trace STORE — the stitcher
+        # dedupes harvested traces it already holds locally.
+        from localai_tpu.obs.fleetview import telemetry_payload
+
+        if self._killed or self.sm is None:
+            return {"error": f"replica {self.id} is dead",
+                    "unreachable": True}
+        try:
+            payload = telemetry_payload(
+                self.sm.scheduler, trace_id=trace_id, since=since,
+                limit=limit, recent=recent)
+            # the stitcher must dedupe ONLY panes that share the caller's
+            # store: request ids are per-process counters, so a worker's
+            # "model-0" legitimately coexists with the front door's —
+            # only an in-process replica's traces are literally the same
+            # records
+            payload["shared_store"] = True
+            return payload
+        except Exception as e:  # noqa: BLE001 — telemetry pull ≠ serving
+            return {"error": str(e), "unreachable": True}
 
     def process_alive(self) -> bool:
         return self._dial(0.0)

@@ -127,6 +127,11 @@ class FleetScheduler:
                         failover=attempt > 0)
                     if tr is not None:
                         tr.end("route", replica=replica.id, reason=reason)
+                        # trace-level replica id (overwritten on failover
+                        # → the replica that actually served): the
+                        # telemetry harvest reads this to know WHOSE pane
+                        # holds the other half of the waterfall
+                        tr.annotate(replica=replica.id)
                 except FleetUnavailable as e:
                     if tr is not None:
                         tr.end("route", error=str(e))
@@ -237,6 +242,9 @@ class FleetScheduler:
         nbytes = 0
         if tr is not None:
             tr.begin("prefix_transfer", prefill=pre.id, decode=decode.id)
+            # disagg requests span TWO replicas — record the prefill half
+            # so the harvest stitches both panes into one waterfall
+            tr.annotate(prefill_replica=pre.id)
         ok = False
         # the export is materialized before the decode-side call so a
         # failure is charged to the replica that actually failed: lazy

@@ -36,6 +36,15 @@ Cooperating pieces:
     config, multi-window burn rates, and burn-rate admission control
     (429 + ``Retry-After`` with automatic recovery) behind
     ``GET /v1/slo`` and ``localai_overload_shedding``.
+  * ``obs.fleetview`` — the fleet telemetry plane: per-replica
+    GetTelemetry harvests (trace spans + flight ring + metrics) stitched
+    into one skew-anchored waterfall per trace id
+    (``GET /v1/traces/{id}``) and one merged fleet flight table
+    (``GET /debug/fleet/flight``).
+  * ``obs.profiler`` — anomaly-triggered ``jax.profiler`` capture:
+    watchdog stalls, SLO shed onsets, and step-time p99 regressions fire
+    a bounded, rate-limited, single-flight capture recorded in a manifest
+    (``GET /debug/profiles``, ``localai_profiles_captured_total``).
 
 HTTP surface: ``GET /v1/traces``, ``GET /debug/timeline/{request_id}``
 (``api.traces``), ``GET /debug/devices``, ``GET /debug/programs``,
@@ -54,6 +63,7 @@ from localai_tpu.obs.metrics import (
     escape_label_value,
     update_engine_gauges,
 )
+from localai_tpu.obs.profiler import PROFILER, ProfileManager
 from localai_tpu.obs.slo import SLO, SLOTracker
 from localai_tpu.obs.trace import (
     STORE,
@@ -65,6 +75,7 @@ from localai_tpu.obs.trace import (
 from localai_tpu.obs.watchdog import WATCHDOG, StallEvent, Watchdog
 
 __all__ = [
+    "PROFILER",
     "REGISTRY",
     "SLO",
     "STORE",
@@ -74,6 +85,7 @@ __all__ = [
     "FlightRecorder",
     "Gauge",
     "Histogram",
+    "ProfileManager",
     "Registry",
     "RequestTrace",
     "SLOTracker",

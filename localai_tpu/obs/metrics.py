@@ -421,6 +421,19 @@ class Registry:
             "scheduler drains (LOCALAI_KV_CHECK=1) — any nonzero value "
             "is a block leak",
         )
+        # -- fleet telemetry plane + anomaly profiler (obs.fleetview /
+        # obs.profiler) ---------------------------------------------------
+        self.trace_ring_size = Gauge(
+            "localai_trace_ring_size",
+            "Finished-trace ring capacity per trace kind "
+            "(LOCALAI_TRACE_CAPACITY; default 256)",
+        )
+        self.profiles_captured = Counter(
+            "localai_profiles_captured_total",
+            "Anomaly-triggered jax.profiler captures by trigger "
+            "(stall/slo_shed/step_p99_regression) — each one is listed "
+            "with its triggering trace id at GET /debug/profiles",
+        )
         # -- stall forensics + device health (obs.watchdog / obs.device) --
         self.engine_stalled = Gauge(
             "localai_engine_stalled",

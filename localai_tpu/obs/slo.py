@@ -190,6 +190,10 @@ class SLOTracker:
         self._events: dict[str, _Series] = {}
         self._shedding: dict[str, bool] = {}
         self._shed_total: dict[str, int] = {}
+        # fired once per not-shedding → shedding transition (the anomaly
+        # profiler hooks this: a shed ONSET is the moment worth a capture,
+        # not every request refused while shedding stands)
+        self._shed_callbacks: list[Callable[[str], None]] = []
 
     # -- configuration ----------------------------------------------------
 
@@ -340,7 +344,8 @@ class SLOTracker:
         slow = self.burn_rate(model, SLOW, now=now)
         n_fast, _ = self._counts(model, _SPAN[FAST], now)
         with self._lock:
-            shedding = self._shedding.get(model, False)
+            was = self._shedding.get(model, False)
+            shedding = was
             if shedding:
                 if fast < self.recover_burn:
                     shedding = False
@@ -352,9 +357,34 @@ class SLOTracker:
             # "not shedding" — only track models with actual state
             if shedding or model in self._shedding:
                 self._shedding[model] = shedding
+            callbacks = (list(self._shed_callbacks)
+                         if shedding and not was else ())
         self.registry.overload_shedding.set(1 if shedding else 0,
                                             model=model)
+        for cb in callbacks:  # onset only, outside the lock
+            try:
+                cb(model)
+            except Exception:  # noqa: BLE001 — observers must not break
+                pass           # the admission path
         return shedding
+
+    def on_shed(self, cb: Callable[[str], None]) -> None:
+        """Register a callback fired once per shedding ONSET (the
+        not-shedding → shedding transition) with the model name.
+        Exceptions are swallowed — an observer must never break the
+        admission path that detected the overload."""
+        with self._lock:
+            self._shed_callbacks.append(cb)
+
+    def remove_shed_callback(self, cb: Callable[[str], None]) -> None:
+        """Unregister an onset callback (the anomaly profiler detaches
+        at stop() so a torn-down manager's closure is not kept alive —
+        and a later install cannot double-fire)."""
+        with self._lock:
+            try:
+                self._shed_callbacks.remove(cb)
+            except ValueError:
+                pass
 
     def should_shed(self, model: str, now: Optional[float] = None) -> bool:
         """The admission-path decision, with hysteresis.

@@ -23,6 +23,7 @@ same trace id.
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 import uuid
@@ -160,13 +161,28 @@ class RequestTrace:
         }
 
 
+def default_capacity() -> int:
+    """Per-kind finished-trace ring size: ``LOCALAI_TRACE_CAPACITY``,
+    default 256. Each trace kind (request/http/stall/batch) gets its own
+    ring of this size; sizing up trades host RAM for a longer forensic
+    horizon (a busy fleet front door can blow through 256 request traces
+    in seconds). Exported as ``localai_trace_ring_size`` so a dashboard
+    can tell 'trace evicted' from 'trace never recorded'."""
+    try:
+        return max(1, int(os.environ.get("LOCALAI_TRACE_CAPACITY", "")
+                          or 256))
+    except ValueError:
+        return 256
+
+
 class TraceStore:
     """Active table + bounded rings of finished traces, one ring per
     trace kind — high-volume HTTP spans (scrapes, probes) must not evict
     the engine request traces the subsystem exists to retain."""
 
-    def __init__(self, capacity: int = 256):
-        self.capacity = capacity
+    def __init__(self, capacity: Optional[int] = None):
+        self.capacity = (capacity if capacity is not None
+                         else default_capacity())
         self._lock = threading.Lock()
         self._active: dict[int, RequestTrace] = {}
         self._done: dict[str, deque[RequestTrace]] = {}

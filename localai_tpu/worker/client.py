@@ -162,6 +162,26 @@ class WorkerClient:
         res = self._stub.GetMetrics(pb.MetricsRequest(), timeout=timeout)
         return json.loads(res.json or "{}")
 
+    def get_telemetry(self, *, trace_id: str = "", since: float = 0.0,
+                      limit: int = 256, recent: int = 20,
+                      timeout: float = CONTROL_TIMEOUT_S) -> dict:
+        """Harvest this worker's telemetry pane (trace spans for one
+        trace id or a recent window, flight-ring snapshot, scheduler
+        metrics). Control-plane shaped: bounded deadline, host-side data
+        only — the fleet tier passes its configured RPC deadline so a
+        wedged replica costs one deadline, never a hung harvest.
+
+        Proto3 cannot tell an explicit 0 from unset, so "no flight
+        records" / "no recent traces" travel as -1 — the servicer maps
+        0/unset to its defaults and negatives to zero, keeping the wire
+        pane byte-for-byte consistent with an in-process replica's."""
+        res = self._stub.GetTelemetry(pb.TelemetryRequest(
+            trace_id=trace_id, since=since,
+            limit=limit if limit > 0 else -1,
+            recent=recent if recent > 0 else -1,
+        ), timeout=timeout)
+        return json.loads(res.json or "{}")
+
     def tts(self, text: str, *, voice: str = "", language: str = "",
             dst: str = "", timeout: float = WORK_TIMEOUT_S) -> pb.AudioResult:
         return self._call(self._stub.TTS, pb.TTSRequest(

@@ -70,6 +70,9 @@ METHODS: dict[str, tuple[str, Any, Any]] = {
     # fleet disaggregation: prefill export out, prefix-block transfer in
     "PrefillPrefix": (SERVER_STREAM, pb.PredictOptions, pb.PrefixChunk),
     "TransferPrefix": (CLIENT_STREAM, pb.PrefixChunk, pb.Result),
+    # fleet telemetry harvest: trace spans + flight ring + metrics in one
+    # bounded control-plane pull (obs/fleetview stitching)
+    "GetTelemetry": (UNARY, pb.TelemetryRequest, pb.TelemetryResponse),
 }
 
 _HANDLER_FACTORY = {

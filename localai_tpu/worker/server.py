@@ -168,6 +168,25 @@ class BackendServicer:
         ]
         return pb.MetricsResponse(json=json.dumps(payload))
 
+    def GetTelemetry(self, request: pb.TelemetryRequest,
+                     context) -> pb.TelemetryResponse:  # jaxlint: disable=lock-guarded-attr
+        """Fleet telemetry harvest (obs/fleetview): this replica's spans
+        for one trace id (or a recent window), its flight-ring snapshot,
+        and its scheduler metrics dict — everything host-side, so the
+        pull can never queue work behind a wedged device dispatch. The
+        payload shape is owned by obs.fleetview.telemetry_payload (shared
+        with InProcessReplica, so the replica kinds cannot drift)."""
+        from localai_tpu.obs.fleetview import telemetry_payload
+
+        sched = self._sm.scheduler if self._sm is not None else None
+        # 0/unset → defaults; -1 is the client's explicit "none" (proto3
+        # cannot carry a distinguishable 0), clamped back to 0 here
+        payload = telemetry_payload(
+            sched, trace_id=request.trace_id, since=request.since,
+            limit=max(0, request.limit or 256),
+            recent=max(0, request.recent or 20))
+        return pb.TelemetryResponse(json=json.dumps(payload))
+
     # -- inference -------------------------------------------------------
 
     def _require_model(self, context):  # jaxlint: disable=lock-guarded-attr

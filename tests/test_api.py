@@ -754,6 +754,47 @@ def test_debug_flight_reports_dispatch_records(client):
                       params={"limit": "many"}).status_code == 400
 
 
+def test_trace_detail_stitched_waterfall(client):
+    # a single-engine model still renders the one-waterfall view (no
+    # replica panes to harvest; front-door + engine spans untagged)
+    r = client.post("/v1/chat/completions", json={
+        "model": "tiny",
+        "messages": [{"role": "user", "content": "stitch detail"}],
+        "max_tokens": 6,
+    }, headers={"X-Trace-ID": "trace-detail-1"})
+    assert r.status_code == 200
+    body = client.get("/v1/traces/trace-detail-1").json()
+    assert body["trace_id"] == "trace-detail-1"
+    assert body["replicas"] == {}
+    names = [e["name"] for e in body["waterfall"]]
+    assert "decode" in names
+    offsets = [e["offset_ms"] for e in body["waterfall"]]
+    assert offsets == sorted(offsets)
+    assert all(e["replica"] == "" for e in body["waterfall"])
+    # unknown trace id → 404, not an empty waterfall
+    assert client.get("/v1/traces/trace-nope-404").status_code == 404
+
+
+def test_debug_fleet_flight_and_profiles(client):
+    # no fleet-served model loaded: the merged view answers with an
+    # empty models map (never errors), and the profile manifest renders
+    # its (disarmed) state
+    data = client.get("/debug/fleet/flight").json()
+    assert data["models"] == {}
+    assert client.get("/debug/fleet/flight",
+                      params={"since": "soon"}).status_code == 400
+    assert client.get("/debug/fleet/flight",
+                      params={"limit": "many"}).status_code == 400
+    prof = client.get("/debug/profiles").json()
+    assert prof["enabled"] is False  # LOCALAI_PROFILE_ON_ANOMALY unset
+    assert prof["profiles"] == [] and "cooldown_s" in prof
+
+
+def test_metrics_exports_trace_ring_size(client):
+    body = client.get("/metrics").text
+    assert "localai_trace_ring_size 256" in body
+
+
 def test_debug_kv_reports_block_audit(client):
     client.post("/v1/chat/completions", json={
         "model": "tiny",

@@ -54,6 +54,24 @@ def test_store_ring_is_bounded_and_newest_first():
     assert store.find("t4")[0].finished
 
 
+def test_trace_capacity_env_knob(monkeypatch):
+    # LOCALAI_TRACE_CAPACITY sizes the per-kind finished-trace rings
+    # (ISSUE 15 satellite); garbage/unset falls back to the 256 default,
+    # and an explicit constructor capacity always wins
+    from localai_tpu.obs import trace as obs_trace
+
+    monkeypatch.setenv("LOCALAI_TRACE_CAPACITY", "7")
+    assert obs_trace.default_capacity() == 7
+    assert obs_trace.TraceStore().capacity == 7
+    monkeypatch.setenv("LOCALAI_TRACE_CAPACITY", "garbage")
+    assert obs_trace.default_capacity() == 256
+    monkeypatch.setenv("LOCALAI_TRACE_CAPACITY", "-3")
+    assert obs_trace.default_capacity() == 1  # clamped positive
+    monkeypatch.delenv("LOCALAI_TRACE_CAPACITY")
+    assert obs_trace.default_capacity() == 256
+    assert obs_trace.TraceStore(capacity=3).capacity == 3
+
+
 def test_store_find_matches_trace_or_request_id():
     store = TraceStore()
     a = RequestTrace("shared-tid", "req-a")

@@ -1,0 +1,263 @@
+"""Anomaly-triggered profiler capture (obs.profiler, ISSUE 15).
+
+Everything runs against an injected clock + fake capture_fn — the
+trigger / rate-limit / cooldown / single-flight state machine is the
+unit under test, not jax.profiler (the CI telemetry smoke exercises the
+real capture)."""
+
+import json
+import threading
+import time
+
+from localai_tpu.obs.flight import FlightRecorder
+from localai_tpu.obs.metrics import Registry
+from localai_tpu.obs.profiler import ProfileManager
+from localai_tpu.obs.slo import SLOTracker
+from localai_tpu.obs.trace import TraceStore
+from localai_tpu.obs.watchdog import Watchdog
+
+
+def make_pm(tmp_path, clock, caps, **kw):
+    kw.setdefault("enabled", True)
+    kw.setdefault("seconds", 0.01)
+    kw.setdefault("max_per_hour", 4)
+    kw.setdefault("cooldown_s", 10.0)
+    return ProfileManager(
+        out_dir=str(tmp_path), registry=kw.pop("registry", Registry()),
+        clock=lambda: clock["now"],
+        capture_fn=lambda path, s: caps.append(path), **kw)
+
+
+def test_disabled_never_captures(tmp_path):
+    caps = []
+    pm = make_pm(tmp_path, {"now": 0.0}, caps, enabled=False)
+    assert not pm.maybe_capture("stall", sync=True)
+    assert caps == [] and pm.entries() == []
+
+
+def test_capture_manifest_and_receipts(tmp_path):
+    clock = {"now": 1000.0}
+    caps = []
+    reg = Registry()
+    pm = make_pm(tmp_path, clock, caps, registry=reg)
+    assert pm.maybe_capture("stall", trace_id="stall-abc",
+                            reason="channel went dark", sync=True)
+    assert len(caps) == 1
+    entry = pm.entries()[0]
+    assert entry["trigger"] == "stall"
+    assert entry["trace_id"] == "stall-abc"
+    assert entry["ok"] is True
+    man = json.loads((tmp_path / "manifest.json").read_text())
+    assert [p["id"] for p in man["profiles"]] == [entry["id"]]
+    assert ('localai_profiles_captured_total{trigger="stall"} 1'
+            in reg.render())
+
+
+def test_cooldown_blocks_second_capture(tmp_path):
+    clock = {"now": 1000.0}
+    caps = []
+    pm = make_pm(tmp_path, clock, caps, cooldown_s=30.0)
+    assert pm.maybe_capture("stall", sync=True)
+    clock["now"] += 5.0
+    assert not pm.maybe_capture("stall", sync=True)
+    assert pm.report()["skipped"]["cooldown"] == 1
+    clock["now"] += 30.0  # cooldown over
+    assert pm.maybe_capture("stall", sync=True)
+    assert len(caps) == 2
+
+
+def test_hourly_cap_and_refill(tmp_path):
+    clock = {"now": 0.0}
+    caps = []
+    pm = make_pm(tmp_path, clock, caps, max_per_hour=2, cooldown_s=0.0)
+    assert pm.maybe_capture("stall", sync=True)
+    assert pm.maybe_capture("slo_shed", sync=True)
+    assert not pm.maybe_capture("stall", sync=True)  # budget spent
+    assert pm.report()["skipped"]["hourly_cap"] == 1
+    clock["now"] += 3601.0  # the hour window slides
+    assert pm.maybe_capture("stall", sync=True)
+    assert len(caps) == 3
+
+
+def test_single_flight_shared_lock(tmp_path):
+    clock = {"now": 0.0}
+    caps = []
+    pm = make_pm(tmp_path, clock, caps, cooldown_s=0.0)
+    # the manual-trace path (POST /backend/trace) holds the same lock
+    assert pm.acquire_capture()
+    try:
+        assert not pm.maybe_capture("stall", sync=True)
+        assert pm.report()["skipped"]["in_flight"] == 1
+    finally:
+        pm.release_capture()
+    assert pm.maybe_capture("stall", sync=True)
+
+
+def test_single_flight_concurrent_trigger(tmp_path):
+    clock = {"now": 0.0}
+    started = threading.Event()
+    release = threading.Event()
+    done = []
+
+    def slow_capture(path, seconds):
+        started.set()
+        release.wait(5.0)
+        done.append(path)
+
+    reg = Registry()
+    pm = ProfileManager(enabled=True, seconds=0.01, out_dir=str(tmp_path),
+                        max_per_hour=10, cooldown_s=0.0, registry=reg,
+                        clock=lambda: clock["now"],
+                        capture_fn=slow_capture)
+    assert pm.maybe_capture("stall")          # async capture holds the lock
+    assert started.wait(5.0)
+    assert not pm.maybe_capture("stall")      # second trigger mid-capture
+    release.set()
+    assert pm.wait_idle(5.0)
+    assert len(done) == 1 and len(pm.entries()) == 1
+
+
+def test_failed_capture_is_a_receipt_and_releases(tmp_path):
+    clock = {"now": 0.0}
+
+    def broken(path, seconds):
+        raise RuntimeError("no backend")
+
+    pm = ProfileManager(enabled=True, seconds=0.01, out_dir=str(tmp_path),
+                        cooldown_s=0.0, registry=Registry(),
+                        clock=lambda: clock["now"], capture_fn=broken)
+    assert pm.maybe_capture("stall", sync=True)
+    entry = pm.entries()[0]
+    assert entry["ok"] is False and "no backend" in entry["error"]
+    # the lock was released — the next trigger can run
+    assert pm.acquire_capture()
+    pm.release_capture()
+
+
+def test_watchdog_stall_trigger(tmp_path):
+    caps = []
+    reg = Registry()
+    store = TraceStore(8)
+    wd = Watchdog(deadline=0.05, registry=reg, store=store,
+                  poll_interval=0.01)
+    pm = ProfileManager(enabled=True, seconds=0.01, out_dir=str(tmp_path),
+                        cooldown_s=0.0, registry=reg,
+                        capture_fn=lambda p, s: caps.append(p))
+    pm.install(watchdog=wd, slo=SLOTracker(registry=reg, targets={}))
+    wd.start()
+    release = threading.Event()
+
+    def hung():
+        with wd.guard("pm-stall"):
+            release.wait(5.0)
+
+    t = threading.Thread(target=hung, daemon=True)
+    t.start()
+    deadline = time.monotonic() + 5.0
+    while not pm.entries() and time.monotonic() < deadline:
+        time.sleep(0.02)
+    release.set()
+    t.join(5.0)
+    pm.wait_idle(5.0)
+    wd.stop()
+    pm.stop()
+    entries = pm.entries()
+    assert entries and entries[0]["trigger"] == "stall"
+    # the capture is joined to the watchdog's forensic stall trace
+    assert entries[0]["trace_id"].startswith("stall-")
+    # recovery events never trigger
+    assert all(e["trigger"] == "stall" for e in entries)
+
+
+def test_shed_onset_trigger_fires_once(tmp_path):
+    caps = []
+    reg = Registry()
+    clock = {"now": 1000.0}
+    slo = SLOTracker(registry=reg, clock=lambda: clock["now"],
+                     targets={"ttft_ms": 0.001}, burn_threshold=1.0,
+                     recover_burn=1.0, min_events=3)
+    pm = ProfileManager(enabled=True, seconds=0.01, out_dir=str(tmp_path),
+                        cooldown_s=0.0, registry=reg,
+                        clock=lambda: clock["now"],
+                        capture_fn=lambda p, s: caps.append(p))
+    pm.install(slo=slo, watchdog=Watchdog(deadline=60, registry=reg,
+                                          store=TraceStore(4)))
+    for _ in range(4):
+        slo.observe("hot", ttft_ms=50.0)
+    assert slo.should_shed("hot")
+    assert slo.should_shed("hot")  # standing shed: onset already fired
+    pm.wait_idle(5.0)
+    pm.stop()
+    sheds = [e for e in pm.entries() if e["trigger"] == "slo_shed"]
+    assert len(sheds) == 1 and sheds[0]["model"] == "hot"
+
+
+def test_regression_detector(tmp_path):
+    caps = []
+    pm = ProfileManager(enabled=True, seconds=0.01, out_dir=str(tmp_path),
+                        cooldown_s=0.0, max_per_hour=100,
+                        regression_ratio=2.0, registry=Registry(),
+                        capture_fn=lambda p, s: caps.append(p))
+    rec = FlightRecorder(256)
+
+    def feed(n, ms):
+        for _ in range(n):
+            rec.record(program="decode_n", steps=8, dispatch_ms=ms,
+                       occupancy=0.5, queue_depth=0, kv_utilization=0.1,
+                       tokens=8)
+
+    pm.watch_flight("m", rec)
+    feed(64, 16.0)                      # 2 ms/step baseline
+    assert pm.check_regressions() == []  # healthy: no trigger
+    feed(32, 20.0)                      # 2.5 ms/step: below the 2x ratio
+    assert pm.check_regressions() == []
+    feed(32, 80.0)                      # 10 ms/step: 4-5x regression
+    assert pm.check_regressions() == ["m"]
+    pm.wait_idle(5.0)
+    assert pm.entries()[0]["trigger"] == "step_p99_regression"
+    assert pm.entries()[0]["model"] == "m"
+    # the same records never re-trigger (wait for a fresh window)
+    assert pm.check_regressions() == []
+    # compile-bearing rows are excluded from both windows
+    rec2 = FlightRecorder(256)
+    pm.watch_flight("m2", rec2)
+    for _ in range(80):
+        rec2.record(program="decode_n", steps=8, dispatch_ms=16.0,
+                    occupancy=0.5, queue_depth=0, kv_utilization=0.1,
+                    tokens=8)
+    for _ in range(32):
+        rec2.record(program="decode_n", steps=8, dispatch_ms=400.0,
+                    occupancy=0.5, queue_depth=0, kv_utilization=0.1,
+                    tokens=8, compile=True)
+    assert "m2" not in pm.check_regressions()
+
+
+def test_watch_flight_weakref_drops_dead_ring(tmp_path):
+    pm = make_pm(tmp_path, {"now": 0.0}, [])
+    rec = FlightRecorder(8)
+    pm.watch_flight("gone", rec)
+    del rec
+    import gc
+
+    gc.collect()
+    assert pm.check_regressions() == []
+    with pm._lock:
+        assert "gone" not in pm._flights
+
+
+def test_install_idempotent_and_stop_deregisters(tmp_path):
+    reg = Registry()
+    wd = Watchdog(deadline=60, registry=reg, store=TraceStore(4))
+    slo = SLOTracker(registry=reg, targets={})
+    pm = make_pm(tmp_path, {"now": 0.0}, [], registry=reg)
+    pm.install(watchdog=wd, slo=slo)
+    pm.install(watchdog=wd, slo=slo)  # second install is a no-op
+    assert len(wd._callbacks) == 1
+    assert len(slo._shed_callbacks) == 1
+    # stop() DEREGISTERS: an install after stop registers exactly once
+    # (a leaked hook would fire two captures per stall)
+    pm.stop()
+    assert wd._callbacks == [] and slo._shed_callbacks == []
+    pm.install(watchdog=wd, slo=slo)
+    assert len(wd._callbacks) == 1 and len(slo._shed_callbacks) == 1
+    pm.stop()

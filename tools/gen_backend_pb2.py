@@ -36,12 +36,22 @@ MESSAGES = {
         ("tokens", 5, F.TYPE_INT32, F.LABEL_REPEATED),
         ("n_tokens", 6, F.TYPE_INT32, F.LABEL_OPTIONAL),
     ],
+    "TelemetryRequest": [
+        ("trace_id", 1, F.TYPE_STRING, F.LABEL_OPTIONAL),
+        ("since", 2, F.TYPE_DOUBLE, F.LABEL_OPTIONAL),
+        ("limit", 3, F.TYPE_INT32, F.LABEL_OPTIONAL),
+        ("recent", 4, F.TYPE_INT32, F.LABEL_OPTIONAL),
+    ],
+    "TelemetryResponse": [
+        ("json", 1, F.TYPE_STRING, F.LABEL_OPTIONAL),
+    ],
 }
 
 # method name -> (input type, output type, client_streaming, server_streaming)
 METHODS = {
     "PrefillPrefix": ("PredictOptions", "PrefixChunk", False, True),
     "TransferPrefix": ("PrefixChunk", "Result", True, False),
+    "GetTelemetry": ("TelemetryRequest", "TelemetryResponse", False, False),
 }
 
 TEMPLATE = '''# -*- coding: utf-8 -*-

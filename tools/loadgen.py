@@ -1,0 +1,235 @@
+"""Minimal mixed-workload load generator (ROADMAP item 5 names it).
+
+One reusable traffic source for the perf gate, the chaos harness, and the
+telemetry smoke: a configurable **kind mix** (chat / embeddings /
+background-batch), a **tenant mix** (weighted — the seed of per-tenant
+QoS testing), and a Poisson **arrival process** (seeded, so a CI run is
+reproducible).  The generator is sink-agnostic: it drives whatever
+surface the caller adapts — an in-process ServingModel, a fleet facade,
+or an HTTP client — through three optional callables:
+
+  * ``sink.chat(text, *, tenant, trace_id, background=False)`` →
+    handle with ``result(timeout)`` + ``finish_reason`` (``background``
+    marks batch-lane traffic: PRIORITY_BATCH on an engine sink);
+  * ``sink.embedding(text, *, tenant)`` → vector (called inline on a
+    worker thread);
+
+Kinds the sink does not provide drop out of the mix (a fleet facade has
+no ``embed`` — its mix renormalizes over chat+batch instead of failing).
+
+Used by ``tools/telemetry_smoke.py`` as the fleet traffic source (the
+stitched traces and the merged fleet flight view need realistic
+*concurrent* load, not one sequential request per assertion) and runnable
+standalone against the in-process debug model:
+
+    python -m tools.loadgen --total 64 --rate 16 --seed 7
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import random
+import sys
+import threading
+import time
+from typing import Any, Optional
+
+PROMPTS = (
+    "summarize the maintenance runbook",
+    "write a haiku about block tables",
+    "what changed in the last deploy",
+    "translate 'hello fleet' to french",
+    "explain paged attention in one line",
+    "draft a status update for the oncall",
+)
+
+DEFAULT_MIX = {"chat": 0.6, "embeddings": 0.2, "batch": 0.2}
+
+
+@dataclasses.dataclass
+class Tenant:
+    """One traffic source: requests carry its name (the correlation /
+    trace prefix) and arrive in proportion to its weight."""
+
+    name: str
+    weight: float = 1.0
+
+
+def parse_tenants(spec: str) -> list[Tenant]:
+    """``"free:3,pro:1"`` → [Tenant(free, 3), Tenant(pro, 1)]."""
+    out = []
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        name, _, w = part.partition(":")
+        out.append(Tenant(name, float(w) if w else 1.0))
+    return out or [Tenant("default")]
+
+
+class LoadGen:
+    def __init__(self, *, mix: Optional[dict[str, float]] = None,
+                 tenants: Optional[list[Tenant]] = None,
+                 rate: float = 8.0, seed: int = 0,
+                 max_tokens: int = 8):
+        self.mix = {k: float(v) for k, v in (mix or DEFAULT_MIX).items()
+                    if float(v) > 0}
+        self.tenants = list(tenants or [Tenant("default")])
+        self.rate = max(0.1, rate)        # mean arrivals per second
+        self.rng = random.Random(seed)
+        self.max_tokens = max_tokens
+
+    def _pick(self, weighted: list[tuple[Any, float]]) -> Any:
+        total = sum(w for _, w in weighted)
+        x = self.rng.random() * total
+        for item, w in weighted:
+            x -= w
+            if x <= 0:
+                return item
+        return weighted[-1][0]
+
+    def run(self, sink: Any, *, total: int = 32,
+            timeout_s: float = 300.0) -> dict:
+        """Issue ``total`` requests with Poisson gaps at ``rate``/s and
+        wait for every one. Returns the per-kind/per-tenant/outcome
+        summary. Never raises on a failed request — failures are counted
+        (the chaos harness injects them on purpose)."""
+        kinds = [(k, w) for k, w in self.mix.items()
+                 if k == "embeddings" and getattr(sink, "embedding", None)
+                 or k in ("chat", "batch") and getattr(sink, "chat", None)]
+        if not kinds:
+            raise ValueError("sink provides neither chat nor embedding")
+        tenants = [(t, t.weight) for t in self.tenants]
+        counts: dict[str, int] = {}
+        by_tenant: dict[str, int] = {}
+        outcomes: dict[str, int] = {}
+        handles: list[tuple[Any, str]] = []
+        threads: list[threading.Thread] = []
+        errors: list[str] = []
+        trace_ids: list[str] = []
+        t0 = time.monotonic()
+        for i in range(total):
+            kind = self._pick(kinds)
+            tenant = self._pick(tenants)
+            counts[kind] = counts.get(kind, 0) + 1
+            by_tenant[tenant.name] = by_tenant.get(tenant.name, 0) + 1
+            text = self.rng.choice(PROMPTS) + f" [{tenant.name}/{i}]"
+            trace_id = f"loadgen-{tenant.name}-{i}"
+            if kind == "embeddings":
+                def embed(text=text, tenant=tenant):
+                    try:
+                        sink.embedding(text, tenant=tenant.name)
+                    except Exception as e:  # noqa: BLE001 — counted below
+                        errors.append(f"embedding: {e}")
+
+                th = threading.Thread(target=embed, daemon=True)
+                th.start()
+                threads.append(th)
+            else:
+                try:
+                    h = sink.chat(text, tenant=tenant.name,
+                                  trace_id=trace_id,
+                                  background=(kind == "batch"))
+                    handles.append((h, kind))
+                    trace_ids.append(trace_id)
+                except Exception as e:  # noqa: BLE001 — counted below
+                    errors.append(f"{kind}: {e}")
+            time.sleep(self.rng.expovariate(self.rate))
+        deadline = time.monotonic() + timeout_s
+        for h, kind in handles:
+            try:
+                h.result(timeout=max(1.0, deadline - time.monotonic()))
+                reason = h.finish_reason or "none"
+            except Exception as e:  # noqa: BLE001 — failures are COUNTED,
+                # never raised: the chaos harness injects them on purpose
+                errors.append(f"{kind}: {e}")
+                reason = "exception"
+            outcomes[reason] = outcomes.get(reason, 0) + 1
+        for th in threads:
+            th.join(timeout=max(1.0, deadline - time.monotonic()))
+        return {
+            "total": total,
+            "wall_s": round(time.monotonic() - t0, 2),
+            "kinds": counts,
+            "tenants": by_tenant,
+            "outcomes": outcomes,
+            "errors": errors,
+            "trace_ids": trace_ids,
+        }
+
+
+class EngineSink:
+    """Adapter over any scheduler-shaped facade (in-process ServingModel,
+    WorkerServingModel, FleetServingModel): chat submits GenRequests
+    (batch kind at PRIORITY_BATCH), embeddings go through the runner when
+    it has one."""
+
+    def __init__(self, sm: Any, *, max_tokens: int = 8):
+        self.sm = sm
+        self.max_tokens = max_tokens
+        if getattr(getattr(sm, "runner", None), "embed", None) is None:
+            self.embedding = None  # fleet/worker facades: chat+batch only
+
+    def chat(self, text: str, *, tenant: str = "default",
+             trace_id: str = "", background: bool = False):
+        from localai_tpu.engine.scheduler import PRIORITY_BATCH, GenRequest
+
+        return self.sm.scheduler.submit(GenRequest(
+            prompt=self.sm.tokenizer.encode(text),
+            max_new_tokens=self.max_tokens, temperature=0.0,
+            trace_id=trace_id, correlation_id=f"{tenant}:{trace_id}",
+            priority=PRIORITY_BATCH if background else 0,
+        ))
+
+    def embedding(self, text: str, *, tenant: str = "default"):
+        return self.sm.runner.embed(self.sm.tokenizer.encode(text))
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--total", type=int, default=32)
+    parser.add_argument("--rate", type=float, default=8.0)
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--max-tokens", type=int, default=8)
+    parser.add_argument("--tenants", default="default:1",
+                        help='weighted tenant mix, e.g. "free:3,pro:1"')
+    parser.add_argument("--mix", default="",
+                        help='kind mix, e.g. "chat:0.5,embeddings:0.3,'
+                             'batch:0.2" (default 0.6/0.2/0.2)')
+    args = parser.parse_args(argv)
+
+    mix = None
+    if args.mix:
+        mix = {}
+        for part in args.mix.split(","):
+            k, _, w = part.strip().partition(":")
+            mix[k] = float(w or 1.0)
+
+    from localai_tpu.config.app_config import AppConfig
+    from localai_tpu.config.model_config import ModelConfig
+    from localai_tpu.models.manager import build_serving_model
+
+    mcfg = ModelConfig.model_validate({
+        "name": "loadgen", "model": "debug:tiny", "context_size": 256,
+        "engine": {"max_slots": 4, "prefill_buckets": [16, 32, 64],
+                   "dtype": "float32", "kv_dtype": "float32"},
+    })
+    sm = build_serving_model(mcfg, AppConfig())
+    try:
+        gen = LoadGen(mix=mix, tenants=parse_tenants(args.tenants),
+                      rate=args.rate, seed=args.seed,
+                      max_tokens=args.max_tokens)
+        summary = gen.run(EngineSink(sm, max_tokens=args.max_tokens),
+                          total=args.total)
+    finally:
+        sm.scheduler.shutdown()
+    print(json.dumps(summary, indent=2, sort_keys=True))
+    bad = [r for r in summary["outcomes"]
+           if r not in ("stop", "length")] or summary["errors"]
+    return 1 if bad else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

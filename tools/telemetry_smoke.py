@@ -34,7 +34,19 @@ generations through the continuous-batching scheduler, then:
      the flight-ring snapshot (``--flight-out``) so every CI run carries
      the engine timeline it measured.
 
-  7. under ``--racecheck``, runs the WHOLE lifecycle above with
+  7. asserts the round-15 fleet telemetry plane end-to-end: a 2-replica
+     WORKER-PROCESS fleet serves a mixed tenant workload from
+     ``tools.loadgen``, one request's trace renders as ONE stitched
+     waterfall (front-door spans untagged, worker-side engine spans
+     harvested over the GetTelemetry RPC, skew-anchored and
+     ``replica=``-tagged), the merged fleet flight view
+     (``--fleet-flight-out``, a CI artifact) carries ≥2 replicas' rings
+     with a ``replica`` column, and an injected ``engine.drain`` stall
+     auto-captures a jax.profiler trace into the profile manifest
+     (``--profile-dir``) with its triggering trace id — while a second
+     stall inside the cooldown does NOT capture;
+
+  8. under ``--racecheck``, runs the WHOLE lifecycle above with
      ``tools.racecheck``'s instrumented locks installed (every
      ``threading.Lock``/``RLock`` the serving stack creates records its
      acquisition ordering) and fails if the observed lock-order graph
@@ -120,6 +132,15 @@ REQUIRED_FLEET = (
     'localai_fleet_routed_total{model="fleet-smoke",reason="affinity"}',
     'localai_fleet_prefix_transfers_total{model="fleet-smoke"} 1',
     'localai_fleet_prefix_transfer_bytes_total{model="fleet-smoke"}',
+)
+# fleet telemetry plane series (round 15): the worker-process fleet must
+# come up healthy, the anomaly profiler must capture EXACTLY one stall-
+# triggered profile (the cooldown eats the second), and the trace-ring
+# sizing receipt must render
+REQUIRED_FLEETVIEW = (
+    'localai_fleet_replicas{model="fleet-grpc",state="healthy"} 2',
+    'localai_profiles_captured_total{trigger="stall"} 1',
+    "localai_trace_ring_size",
 )
 
 
@@ -330,11 +351,174 @@ def check_fleet(registry) -> list[str]:
     return problems
 
 
+def check_fleetview(registry, fleet_flight_out: str) -> list[str]:
+    """Round-15 fleet telemetry plane: a 2-replica WORKER-PROCESS fleet
+    under a tools.loadgen mixed tenant workload → one request stitched
+    into ONE waterfall (front-door + worker spans, worker side harvested
+    over the real GetTelemetry gRPC and skew-anchored) + the merged
+    fleet flight view written as a CI artifact."""
+    import json as jsonlib
+
+    from localai_tpu.config.app_config import AppConfig
+    from localai_tpu.config.model_config import ModelConfig
+    from localai_tpu.fleet import FleetServingModel
+    from localai_tpu.fleet.replica import WorkerReplica
+    from localai_tpu.obs import fleetview
+    from localai_tpu.obs.trace import STORE
+    from tools.loadgen import EngineSink, LoadGen, Tenant
+
+    problems: list[str] = []
+    app = AppConfig()
+    mcfg = ModelConfig.model_validate({
+        "name": "fleet-grpc", "model": "debug:tiny", "context_size": 256,
+        "parameters": {"temperature": 0.0, "max_tokens": 6},
+        "engine": {"max_slots": 2, "prefill_buckets": [16, 32, 64, 128],
+                   "dtype": "float32", "kv_dtype": "float32",
+                   "kv_block_tokens": 16},
+    })
+
+    def factory(rid, role):
+        return WorkerReplica(rid, role, mcfg, app,
+                             env={"JAX_PLATFORMS": "cpu"})
+
+    fm = FleetServingModel(mcfg, app, factory, replicas=2,
+                           prefill_replicas=0, disagg_threshold=1 << 30)
+    try:
+        gen = LoadGen(mix={"chat": 0.7, "batch": 0.3},
+                      tenants=[Tenant("free", 3), Tenant("pro", 1)],
+                      rate=10.0, seed=3, max_tokens=6)
+        summary = gen.run(EngineSink(fm, max_tokens=6), total=8)
+        bad = {r: n for r, n in summary["outcomes"].items()
+               if r not in ("stop", "length")}
+        if bad or summary["errors"]:
+            problems.append(
+                f"loadgen traffic failed: {bad} {summary['errors']}")
+        stitched = None
+        for tid in summary["trace_ids"]:
+            local = [t.to_dict() for t in STORE.find(tid)]
+            if not local:
+                continue
+            s = fleetview.stitched_trace(fm, tid, local)
+            if any(e["replica"] for e in s["waterfall"]):
+                stitched = s
+                break
+        if stitched is None:
+            problems.append(
+                "no loadgen trace stitched a worker-side half "
+                "(GetTelemetry harvest returned nothing)")
+        else:
+            worker_spans = {e["name"] for e in stitched["waterfall"]
+                            if e["replica"]}
+            front_spans = {e["name"] for e in stitched["waterfall"]
+                           if not e["replica"]}
+            if not {"prefill", "decode"} & worker_spans:
+                problems.append(
+                    f"worker-side engine spans missing: {worker_spans}")
+            if "rpc" not in front_spans:
+                problems.append(
+                    f"front-door rpc span missing: {front_spans}")
+            panes = [p for p in stitched["replicas"].values()
+                     if p.get("traces")]
+            if not panes or not panes[0]["traces"][0]["attrs"].get(
+                    "skew_anchored"):
+                problems.append("harvested worker trace is not "
+                                "skew-anchored")
+        flight = fleetview.fleet_flight(fm)
+        with_records = [rid for rid, p in flight["replicas"].items()
+                        if p.get("records")]
+        if len(with_records) < 2:
+            problems.append(
+                f"merged fleet flight covers {with_records} "
+                f"(need >=2 replicas): {flight['replicas']}")
+        if flight["count"] == 0 or any(
+                "replica" not in r for r in flight["records"]):
+            problems.append("merged fleet flight rows miss the replica "
+                            "column")
+        with open(fleet_flight_out, "w") as f:
+            jsonlib.dump(flight, f, indent=2, sort_keys=True)
+        fm.scheduler.export_gauges()
+    finally:
+        fm.close()
+    return problems
+
+
+def check_anomaly_capture(registry, profile_dir: str) -> list[str]:
+    """Round-15 anomaly profiler: an injected ``engine.drain`` stall
+    trips the watchdog and auto-captures a (real) jax.profiler trace
+    with the stall's forensic trace id; a second stall inside the
+    cooldown is refused. Scratch watchdog + scratch manager — hermetic,
+    no env fiddling."""
+    from pathlib import Path
+
+    from localai_tpu import faults
+    from localai_tpu.engine.runner import ModelRunner
+    from localai_tpu.engine.scheduler import GenRequest, Scheduler
+    from localai_tpu.models.registry import resolve_model
+    from localai_tpu.obs import EngineTelemetry, TraceStore, Watchdog
+    from localai_tpu.obs.profiler import ProfileManager
+    from localai_tpu.obs.slo import SLOTracker
+    from localai_tpu.utils.tokenizer import ByteTokenizer
+
+    problems: list[str] = []
+    store = TraceStore()
+    wd = Watchdog(deadline=0.8, registry=registry, store=store,
+                  poll_interval=0.1)
+    wd.start()
+    pm = ProfileManager(enabled=True, seconds=0.2, out_dir=profile_dir,
+                        max_per_hour=10, cooldown_s=3600.0,
+                        registry=registry)
+    pm.install(watchdog=wd, slo=SLOTracker(registry=registry, targets={}))
+    tiny = resolve_model("debug:tiny", dtype="float32")
+    runner = ModelRunner(tiny.cfg, tiny.params, num_slots=2, max_ctx=64,
+                         prefill_buckets=[16], kv_dtype="float32",
+                         paged=True, kv_block_tokens=16)
+    sched = Scheduler(
+        runner, ByteTokenizer(), watchdog=wd,
+        telemetry=EngineTelemetry(model="stall-anomaly", store=store,
+                                  slo=SLOTracker(registry=registry,
+                                                 targets={})))
+    tok = ByteTokenizer()
+    try:
+        for _ in range(2):  # second stall lands inside the cooldown
+            faults.arm(faults.FaultSpec(
+                site="engine.drain", mode="hang", delay_s=3.0, times=1,
+                match="stall-anomaly"))
+            h = sched.submit(GenRequest(prompt=tok.encode("stall me"),
+                                        max_new_tokens=4, temperature=0.0))
+            h.result(timeout=120)
+        pm.wait_idle(30.0)
+        stalls = [e for e in pm.entries() if e["trigger"] == "stall"]
+        if len(stalls) != 1:
+            problems.append(
+                f"expected exactly 1 stall capture (cooldown eats the "
+                f"second), got {len(stalls)}")
+        else:
+            if not stalls[0]["trace_id"].startswith("stall-"):
+                problems.append(
+                    f"capture carries no triggering trace id: {stalls[0]}")
+            if not stalls[0].get("ok"):
+                problems.append(
+                    f"profiler capture failed: {stalls[0].get('error')}")
+        if pm.report()["skipped"].get("cooldown", 0) < 1:
+            problems.append("second stall inside the cooldown was not "
+                            "refused")
+        if not (Path(profile_dir) / "manifest.json").exists():
+            problems.append("no profile manifest written")
+    finally:
+        faults.clear("engine.drain")
+        sched.shutdown()
+        pm.stop()
+        wd.stop()
+    return problems
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("--out", default="telemetry_summary.json")
     parser.add_argument("--flight-out", default="flight_snapshot.json")
     parser.add_argument("--batch-out", default="batch_result.jsonl")
+    parser.add_argument("--fleet-flight-out", default="fleet_flight.json")
+    parser.add_argument("--profile-dir", default="profile_manifest")
     parser.add_argument("--requests", type=int, default=4)
     # two dispatch-rounds past the compile-bearing first one, so the
     # flight ring has post-compile samples and step_ms percentiles exist
@@ -399,6 +583,13 @@ def main(argv=None) -> int:
         problems += check_slo_overload(REGISTRY)
         problems += check_batch(sched, REGISTRY, args.batch_out)
         problems += check_fleet(REGISTRY)
+        problems += check_fleetview(REGISTRY, args.fleet_flight_out)
+        problems += check_anomaly_capture(REGISTRY, args.profile_dir)
+        # scrape-time trace-ring sizing receipt, exactly what GET /metrics
+        # exports (LOCALAI_TRACE_CAPACITY satellite)
+        from localai_tpu.obs.trace import STORE as TRACE_STORE
+
+        REGISTRY.trace_ring_size.set(TRACE_STORE.capacity)
         flight_pct = sched.flight.percentiles()
         flight_snapshot = {
             "model": "smoke",
@@ -433,7 +624,8 @@ def main(argv=None) -> int:
     exposition = REGISTRY.render()
     missing = [s for s in (REQUIRED_SERIES + REQUIRED_FAMILIES
                            + REQUIRED_INTROSPECTION + REQUIRED_SLO
-                           + REQUIRED_BATCH + REQUIRED_FLEET)
+                           + REQUIRED_BATCH + REQUIRED_FLEET
+                           + REQUIRED_FLEETVIEW)
                if s not in exposition]
     if missing or problems:
         print("FAIL: missing engine telemetry in /metrics exposition:")
@@ -484,7 +676,9 @@ def main(argv=None) -> int:
         json.dump(flight_snapshot, f, indent=2, sort_keys=True)
     print(f"OK: engine telemetry present; summary → {args.out}, "
           f"flight ring → {args.flight_out}, "
-          f"batch result → {args.batch_out}")
+          f"batch result → {args.batch_out}, "
+          f"fleet flight → {args.fleet_flight_out}, "
+          f"profiles → {args.profile_dir}/manifest.json")
     print(f"    ttft mean {summary['ttft']['mean_ms']}ms  "
           f"tpot mean {summary['tpot']['mean_ms']}ms  "
           f"over {len(ttfts)} requests; "
