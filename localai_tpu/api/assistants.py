@@ -16,6 +16,7 @@ AssistantStore delegates to a shared instance."""
 
 from __future__ import annotations
 
+import asyncio
 import logging
 import threading
 import time
@@ -410,7 +411,11 @@ async def get_file_content(request: web.Request) -> web.Response:
         return err
     try:
         path = _registry(request).content_path(f["id"])
-        return web.Response(body=path.read_bytes())
+        # uploaded files can be MBs: read them executor-side, never on
+        # the event loop
+        loop = asyncio.get_running_loop()
+        body = await loop.run_in_executor(None, path.read_bytes)
+        return web.Response(body=body)
     except (OSError, ValueError) as e:
         return web.json_response(error_body(str(e), code=500), status=500)
 

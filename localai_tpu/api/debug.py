@@ -219,28 +219,36 @@ async def profiles(request: web.Request) -> web.Response:
 
 async def kv(request: web.Request) -> web.Response:
     state = _state(request)
-    models = {}
-    for name, sm in state.manager.loaded_snapshot().items():
-        alloc = getattr(getattr(sm, "runner", None), "allocator", None)
-        if alloc is None:
-            continue  # contiguous / worker-backed / non-LLM engines
-        st = alloc.stats()
-        sched = getattr(sm, "scheduler", None)
-        models[name] = {
-            "block_tokens": alloc.block_tokens,
-            "blocks": {
-                "total": st.total, "free": st.free, "used": st.used,
-                "cached": st.cached, "watermark": st.high_watermark,
-            },
-            "tables": {str(s): n
-                       for s, n in alloc.tables_snapshot().items()},
-            "shared_tokens_total": alloc.shared_tokens_total,
-            "evictions_total": alloc.evictions_total,
-            "invariant_violations": alloc.check_invariants(),
-            "violations_seen": getattr(
-                sched, "kv_invariant_violations", 0),
-        }
-    return web.json_response({"models": models})
+    loop = asyncio.get_running_loop()
+
+    def build() -> dict:
+        # allocator walks + invariant checks scale with table count:
+        # executor-side, like every other debug-pane builder here
+        models = {}
+        for name, sm in state.manager.loaded_snapshot().items():
+            alloc = getattr(getattr(sm, "runner", None), "allocator", None)
+            if alloc is None:
+                continue  # contiguous / worker-backed / non-LLM engines
+            st = alloc.stats()
+            sched = getattr(sm, "scheduler", None)
+            models[name] = {
+                "block_tokens": alloc.block_tokens,
+                "blocks": {
+                    "total": st.total, "free": st.free, "used": st.used,
+                    "cached": st.cached, "watermark": st.high_watermark,
+                },
+                "tables": {str(s): n
+                           for s, n in alloc.tables_snapshot().items()},
+                "shared_tokens_total": alloc.shared_tokens_total,
+                "evictions_total": alloc.evictions_total,
+                "invariant_violations": alloc.check_invariants(),
+                "violations_seen": getattr(
+                    sched, "kv_invariant_violations", 0),
+            }
+        return models
+
+    return web.json_response(
+        {"models": await loop.run_in_executor(state.executor, build)})
 
 
 async def faults_get(request: web.Request) -> web.Response:

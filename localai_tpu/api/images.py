@@ -85,9 +85,17 @@ async def _init_image(request: web.Request, file_ref: str):
         except (binascii.Error, ValueError):
             raise web.HTTPBadRequest(text="file is neither a URL nor base64")
     try:
-        img = Image.open(io.BytesIO(data)).convert("RGB")
+        # PIL decode of an arbitrary-size upload takes tens of ms —
+        # executor-side, never on the event loop
+        return await oai._in_executor(request, _decode_rgb, data)
     except Exception as e:  # noqa: BLE001
         raise web.HTTPBadRequest(text=f"cannot decode init image: {e}")
+
+
+def _decode_rgb(data: bytes) -> np.ndarray:
+    from PIL import Image
+
+    img = Image.open(io.BytesIO(data)).convert("RGB")
     return np.asarray(img, np.uint8)
 
 
@@ -97,6 +105,26 @@ def _encode_png(arr: np.ndarray) -> bytes:
     buf = io.BytesIO()
     Image.fromarray(arr).save(buf, format="PNG")
     return buf.getvalue()
+
+
+def _finalize_png(img: np.ndarray, width: int, height: int) -> bytes:
+    """Resize (the pipeline buckets latent sizes to 64-multiples; return
+    exactly what the client asked for) + PNG-encode, executor-side."""
+    if img.shape[:2] != (height, width):
+        from PIL import Image
+
+        img = np.asarray(
+            Image.fromarray(img).resize((width, height)), np.uint8
+        )
+    return _encode_png(img)
+
+
+def _store_png(png: bytes, image_path: str) -> str:
+    name = f"{uuid.uuid4().hex}.png"
+    out = Path(image_path)
+    out.mkdir(parents=True, exist_ok=True)
+    (out / name).write_bytes(png)
+    return name
 
 
 async def generations(request: web.Request) -> web.Response:
@@ -147,25 +175,18 @@ async def generations(request: web.Request) -> web.Response:
                         control_scale=mcfg.diffusers.control_scale,
                     ),
                 )
-                img = result.image
-                if img.shape[:2] != (height, width):
-                    # the pipeline buckets latent sizes to 64-multiples;
-                    # return exactly what the client asked for
-                    from PIL import Image
-
-                    img = np.asarray(
-                        Image.fromarray(img).resize((width, height)), np.uint8
-                    )
-                png = _encode_png(img)
+                # resize + PNG encode are CPU-bound milliseconds per
+                # image; like the generate call above they run on the
+                # API executor, not the event loop
+                png = await oai._in_executor(
+                    request, _finalize_png, result.image, width, height)
                 if b64:
                     items.append(
                         {"b64_json": base64.b64encode(png).decode()}
                     )
                 else:
-                    name = f"{uuid.uuid4().hex}.png"
-                    out = Path(state.config.image_path)
-                    out.mkdir(parents=True, exist_ok=True)
-                    (out / name).write_bytes(png)
+                    name = await oai._in_executor(
+                        request, _store_png, png, state.config.image_path)
                     base = f"{request.scheme}://{request.host}"
                     items.append(
                         {"url": f"{base}/generated-images/{name}"}

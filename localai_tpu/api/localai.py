@@ -268,7 +268,10 @@ async def backend_shutdown(request: web.Request) -> web.Response:
 async def engine_metrics(request: web.Request) -> web.Response:
     """Per-model live slot metrics (parity: the GetMetrics RPC surface,
     grpc-server.cpp:2434-2457, exposed over /backend/monitor)."""
-    return web.json_response(_state(request).manager.metrics())
+    loop = asyncio.get_running_loop()
+    metrics = await loop.run_in_executor(
+        None, _state(request).manager.metrics)
+    return web.json_response(metrics)
 
 
 async def backend_trace(request: web.Request) -> web.Response:

@@ -10,6 +10,7 @@ streaming maps directly onto StreamResponse).
 
 from __future__ import annotations
 
+import asyncio
 import contextvars
 import logging
 import secrets
@@ -396,7 +397,12 @@ def create_app(state: Optional[AppState] = None) -> web.Application:
     app.add_routes(debug_routes.routes())
 
     async def on_cleanup(_app):
-        state.shutdown()
+        # shutdown joins engine threads and workers — seconds of wall
+        # time; run it off-loop so in-flight connection teardown (and a
+        # loopsan watching the dispatch) never sees the stall. Not on
+        # state.executor: shutdown() tears that executor down.
+        await asyncio.get_running_loop().run_in_executor(
+            None, state.shutdown)
 
     app.on_cleanup.append(on_cleanup)
     return app

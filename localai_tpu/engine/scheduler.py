@@ -487,7 +487,9 @@ class Scheduler:
                 }
                 for s, c in self._slots.items()
             ]
-            kv_utilization = self._kv_utilization()
+            # alloc.stats() inside is host-side allocator accounting,
+            # not a worker RPC — the name-based heuristic misreads it
+            kv_utilization = self._kv_utilization()  # jaxlint: disable=blocking-under-lock
             batch_slots = sum(
                 1 for c in self._slots.values()
                 if c.handle.request.priority >= PRIORITY_BATCH

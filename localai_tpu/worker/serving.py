@@ -283,7 +283,10 @@ class WorkerServingModel:
             # stays healthy; a new object means a respawn (empty process) —
             # only then pay the Status round trip + LoadModel
             if c is not self._loaded_client:
-                self._ensure_loaded(c)
+                # load-once barrier, deliberately under the lock:
+                # concurrent callers MUST wait for the respawned
+                # worker's LoadModel — racing it would double-load
+                self._ensure_loaded(c)  # jaxlint: disable=blocking-under-lock
                 self._loaded_client = c
             return c
 

@@ -627,6 +627,55 @@ def test_inline_suppression(tmp_path):
     assert found[0].line == 7
 
 
+# -- unknown-suppression ---------------------------------------------------
+
+def waiver(rule_id):
+    """A disable comment assembled at runtime: the repo's own self-check
+    scans THIS file's raw source, so a bogus rule id must never appear
+    as a literal waiver here (the metriccheck TYPO precedent)."""
+    return "# jax" + "lint: disable=" + rule_id
+
+
+def test_unknown_suppression_flags_typos(tmp_path):
+    found = lint_snippet(tmp_path, """
+        import numpy as np
+
+        def decode_step(tokens):
+            return np.asarray(tokens)  WAIVER
+    """.replace("WAIVER", waiver("host-sync-in-hot-pth")))
+    rules = rules_of(found)
+    # the typo'd waiver is flagged AND suppresses nothing: the finding
+    # it meant to silence still fires
+    assert sorted(rules) == ["host-sync-in-hot-path", "unknown-suppression"]
+    msg = next(f for f in found if f.rule == "unknown-suppression").message
+    assert "host-sync-in-hot-pth" in msg
+    assert "did you mean 'host-sync-in-hot-path'" in msg
+
+
+def test_unknown_suppression_checks_every_id_in_a_list(tmp_path):
+    found = lint_snippet(tmp_path, """
+        import numpy as np
+
+        def decode_step(tokens):
+            return np.asarray(tokens)  WAIVER
+    """.replace("WAIVER",
+                waiver("host-sync-in-hot-path,jit-in-looop")))
+    assert rules_of(found) == ["unknown-suppression"]
+    assert "jit-in-looop" in found[0].message
+
+
+def test_valid_waivers_and_all_stay_silent(tmp_path):
+    found = lint_snippet(tmp_path, """
+        import numpy as np
+
+        def decode_step(tokens):
+            a = np.asarray(tokens)  # jaxlint: disable=host-sync-in-hot-path
+            b = np.asarray(tokens)  # jaxlint: disable=all
+            return a, b
+    """)
+    assert found == []
+
+
 # -- baseline --------------------------------------------------------------
 
 def test_baseline_round_trip(tmp_path):
@@ -763,8 +812,57 @@ def test_cli_list_rules():
                  "unknown-jax-config", "lock-guarded-attr",
                  "blocking-under-lock", "unknown-mesh-axis",
                  "shard-map-arity", "host-sync-on-sharded",
-                 "metric-name-drift"):
+                 "metric-name-drift", "unknown-suppression",
+                 "blocking-in-async", "blocking-in-stream",
+                 "async-lock-blocking-await", "coroutine-not-awaited"):
         assert rule in res.stdout
+
+
+def test_cli_prune_baseline_round_trip(tmp_path):
+    bad = tmp_path / "localai_tpu" / "engine" / "bad.py"
+    bad.parent.mkdir(parents=True)
+    bad.write_text(
+        "import jax\n"
+        'jax.config.update("jax_definitely_not_an_option", 8)\n'
+        'jax.config.update("jax_also_not_an_option", 9)\n'
+    )
+    res = run_cli(["--write-baseline", "localai_tpu"], cwd=tmp_path)
+    assert res.returncode == 0
+
+    # fix ONE finding: its baseline entry goes stale — reported (not
+    # fatal) with the prune hint
+    bad.write_text(
+        "import jax\n"
+        'jax.config.update("jax_definitely_not_an_option", 8)\n'
+    )
+    res = run_cli(["localai_tpu"], cwd=tmp_path)
+    assert res.returncode == 0
+    assert "stale baseline entr" in res.stderr
+    assert "--prune-baseline" in res.stderr
+
+    res = run_cli(["--prune-baseline", "localai_tpu"], cwd=tmp_path)
+    assert res.returncode == 0
+    assert "pruned 1 stale entry" in res.stdout
+
+    # pruned: no stale note, the surviving finding is still absorbed
+    res = run_cli(["localai_tpu"], cwd=tmp_path)
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "stale" not in res.stderr
+    assert "(1 baselined)" in res.stderr
+
+    # pruning never ADDS entries: a fresh regression still fails
+    bad.write_text(bad.read_text()
+                   + 'jax.config.update("jax_third_bogus_option", 1)\n')
+    res = run_cli(["localai_tpu"], cwd=tmp_path)
+    assert res.returncode == 1
+
+
+def test_cli_prune_baseline_needs_a_baseline_file(tmp_path):
+    (tmp_path / "localai_tpu").mkdir()
+    (tmp_path / "localai_tpu" / "mod.py").write_text("x = 1\n")
+    res = run_cli(["--prune-baseline", "localai_tpu"], cwd=tmp_path)
+    assert res.returncode == 1
+    assert "needs a baseline file" in res.stderr
 
 
 def test_lockcheck_findings_are_baselineable(tmp_path):

@@ -1,0 +1,457 @@
+"""tools.jaxlint loopcheck: the call-graph-aware event-loop rules.
+
+Per rule: a must-flag fixture, a near-miss that stays silent, the
+waiver paths (`# jaxlint: offloaded`, `# jaxlint: disable=`), and the
+baseline round-trip — plus the acceptance cross-check: one injected
+``time.sleep`` in an async handler caught by BOTH the static pass and
+the runtime sanitizer (tools.loopsan).
+"""
+
+import asyncio
+import sys
+import textwrap
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[1]
+if str(REPO) not in sys.path:
+    sys.path.insert(0, str(REPO))
+
+from tools.jaxlint import Baseline, lint_paths  # noqa: E402
+
+API_MOD = "localai_tpu/api/mod.py"
+
+
+def lint_snippet(tmp_path, code, relpath=API_MOD):
+    f = tmp_path / relpath
+    f.parent.mkdir(parents=True, exist_ok=True)
+    f.write_text(textwrap.dedent(code))
+    return lint_paths([str(tmp_path)])
+
+
+def rules_of(findings):
+    return [f.rule for f in findings]
+
+
+# -- blocking-in-async: direct sites ----------------------------------------
+
+def test_direct_blocking_in_async_flags(tmp_path):
+    found = lint_snippet(tmp_path, """
+        import time
+        from PIL import Image
+
+        async def handler(request, path):
+            time.sleep(0.1)
+            img = Image.open(path)
+            data = path.read_bytes()
+            return img, data
+    """)
+    assert rules_of(found) == ["blocking-in-async"] * 3
+    assert "event loop" in found[0].message
+
+
+def test_awaited_and_offloaded_calls_are_fine(tmp_path):
+    found = lint_snippet(tmp_path, """
+        import asyncio
+        import time
+
+        def _decode(data):
+            time.sleep(0.1)      # sync helper: fine on its own
+            return data
+
+        async def handler(request, data):
+            loop = asyncio.get_running_loop()
+            out = await loop.run_in_executor(None, _decode, data)
+            more = await asyncio.to_thread(_decode, data)
+            return out, more
+    """)
+    assert found == []
+
+
+def test_executor_closure_is_not_inline(tmp_path):
+    # a nested def handed to run_in_executor runs OFF the loop — its
+    # blocking body must not taint the enclosing async def
+    found = lint_snippet(tmp_path, """
+        import asyncio
+
+        async def handler(request, path):
+            loop = asyncio.get_running_loop()
+
+            def build():
+                return path.read_bytes()
+
+            return await loop.run_in_executor(None, build)
+    """)
+    assert found == []
+
+
+# -- blocking-in-async: transitive through project helpers ------------------
+
+def test_transitive_blocking_through_helper_flags(tmp_path):
+    found = lint_snippet(tmp_path, """
+        import time
+
+        def _resize(img):
+            return _encode(img)
+
+        def _encode(img):
+            time.sleep(0.05)
+            return img
+
+        async def handler(request, img):
+            return _resize(img)
+    """)
+    assert rules_of(found) == ["blocking-in-async"]
+    # the witness chain names every hop down to the blocking leaf
+    assert "_resize" in found[0].message
+    assert "_encode" in found[0].message
+    assert "time.sleep" in found[0].message
+
+
+def test_offloaded_def_annotation_clears_taint(tmp_path):
+    found = lint_snippet(tmp_path, """
+        import time
+
+        # runs only via state.executor (see handler)
+        def _encode(img):  # jaxlint: offloaded (executor-side only)
+            time.sleep(0.05)
+            return img
+
+        async def handler(request, img):
+            return _encode(img)
+    """)
+    assert found == []
+
+
+def test_offloaded_statement_annotation_clears_call(tmp_path):
+    found = lint_snippet(tmp_path, """
+        import time
+
+        def _encode(img):
+            time.sleep(0.05)
+            return img
+
+        async def handler(request, img):
+            return _encode(img)  # jaxlint: offloaded (wrapped upstream)
+    """)
+    assert found == []
+
+
+def test_inline_disable_waives_loopcheck_finding(tmp_path):
+    found = lint_snippet(tmp_path, """
+        import time
+
+        async def handler(request):
+            time.sleep(0.1)  # jaxlint: disable=blocking-in-async
+    """)
+    assert found == []
+
+
+def test_loopcheck_skips_test_files(tmp_path):
+    # tests block loops on purpose (fixtures simulating slow handlers)
+    found = lint_snippet(tmp_path, """
+        import time
+
+        async def handler(request):
+            time.sleep(0.1)
+    """, "tests/test_mod.py")
+    assert found == []
+
+
+# -- blocking-in-stream -----------------------------------------------------
+
+def test_blocking_in_async_generator_flags_as_stream(tmp_path):
+    found = lint_snippet(tmp_path, """
+        import time
+
+        async def stream_tokens(chunks):
+            for c in chunks:
+                time.sleep(0.01)
+                yield c
+    """)
+    assert rules_of(found) == ["blocking-in-stream"]
+    assert "between chunks" in found[0].message
+
+
+def test_blocking_in_async_for_body_flags_as_stream(tmp_path):
+    found = lint_snippet(tmp_path, """
+        async def pump(source, sink):
+            async for item in source:
+                sink.write_bytes(item)
+    """)
+    assert rules_of(found) == ["blocking-in-stream"]
+
+
+def test_clean_async_generator_is_fine(tmp_path):
+    found = lint_snippet(tmp_path, """
+        import asyncio
+
+        async def stream_tokens(handle):
+            while True:
+                delta = await handle.next_delta()
+                if delta is None:
+                    return
+                yield delta
+                await asyncio.sleep(0)
+    """)
+    assert found == []
+
+
+# -- async-lock-blocking-await ----------------------------------------------
+
+def test_asyncio_lock_across_executor_await_flags(tmp_path):
+    found = lint_snippet(tmp_path, """
+        import asyncio
+
+        class Cache:
+            def __init__(self):
+                self._lock = asyncio.Lock()
+
+            async def refresh(self, loop, fn):
+                async with self._lock:
+                    self.value = await loop.run_in_executor(None, fn)
+    """)
+    assert rules_of(found) == ["async-lock-blocking-await"]
+    assert "self._lock" in found[0].message
+
+
+def test_await_outside_lock_span_is_fine(tmp_path):
+    found = lint_snippet(tmp_path, """
+        import asyncio
+
+        class Cache:
+            def __init__(self):
+                self._lock = asyncio.Lock()
+
+            async def refresh(self, loop, fn):
+                fresh = await loop.run_in_executor(None, fn)
+                async with self._lock:
+                    self.value = fresh
+    """)
+    assert found == []
+
+
+def test_lock_across_slow_async_callee_flags(tmp_path):
+    found = lint_snippet(tmp_path, """
+        import asyncio
+
+        class Cache:
+            def __init__(self):
+                self._lock = asyncio.Lock()
+
+            async def _rebuild(self, loop, fn):
+                return await loop.run_in_executor(None, fn)
+
+            async def refresh(self, loop, fn):
+                async with self._lock:
+                    self.value = await self._rebuild(loop, fn)
+    """)
+    assert rules_of(found) == ["async-lock-blocking-await"]
+    assert "_rebuild" in found[0].message
+
+
+def test_lock_across_fast_await_is_fine(tmp_path):
+    # awaiting a quick project coroutine under the lock is the normal
+    # critical-section pattern, not a pinned-lock hazard
+    found = lint_snippet(tmp_path, """
+        import asyncio
+
+        class Cache:
+            def __init__(self):
+                self._lock = asyncio.Lock()
+
+            async def _bump(self):
+                self.n = getattr(self, "n", 0) + 1
+                return self.n
+
+            async def refresh(self):
+                async with self._lock:
+                    return await self._bump()
+    """)
+    assert found == []
+
+
+# -- coroutine-not-awaited --------------------------------------------------
+
+def test_discarded_coroutine_flags(tmp_path):
+    found = lint_snippet(tmp_path, """
+        async def notify(subscribers, event):
+            for s in subscribers:
+                await s.send(event)
+
+        async def handler(subs, event):
+            notify(subs, event)
+            return True
+    """)
+    assert rules_of(found) == ["coroutine-not-awaited"]
+    assert "never runs" in found[0].message
+
+
+def test_awaited_and_task_wrapped_coroutines_are_fine(tmp_path):
+    found = lint_snippet(tmp_path, """
+        import asyncio
+
+        async def notify(subscribers, event):
+            for s in subscribers:
+                await s.send(event)
+
+        async def handler(subs, event):
+            await notify(subs, event)
+            task = asyncio.create_task(notify(subs, event))
+            return task
+    """)
+    assert found == []
+
+
+# -- upgraded blocking-under-lock: transitive through helpers ---------------
+
+def test_blocking_under_lock_through_helper_flags(tmp_path):
+    found = lint_snippet(tmp_path, """
+        import threading
+        import time
+
+        class Pool:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def _respawn(self):
+                time.sleep(0.5)
+
+            def sweep(self):
+                with self._lock:
+                    self._respawn()
+    """, "localai_tpu/mod.py")
+    assert rules_of(found) == ["blocking-under-lock"]
+    assert "_respawn" in found[0].message
+    assert "time.sleep" in found[0].message
+
+
+def test_lock_domain_ignores_loop_only_leaves(tmp_path):
+    # file I/O is loop-fatal but fine under a startup lock: the async
+    # domain tags must not leak into the lock pass
+    found = lint_snippet(tmp_path, """
+        import threading
+
+        class Pool:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def _read_config(self, path):
+                return path.read_text()
+
+            def reload(self, path):
+                with self._lock:
+                    self.cfg = self._read_config(path)
+    """, "localai_tpu/mod.py")
+    assert found == []
+
+
+def test_transitive_lock_finding_is_waivable(tmp_path):
+    found = lint_snippet(tmp_path, """
+        import threading
+        import time
+
+        class Pool:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def _respawn(self):
+                time.sleep(0.5)
+
+            def sweep(self):
+                with self._lock:
+                    # load-once barrier: callers must wait
+                    self._respawn()  # jaxlint: disable=blocking-under-lock
+    """, "localai_tpu/mod.py")
+    assert found == []
+
+
+# -- upgraded host-sync-on-sharded: transitive producers --------------------
+
+def write_mesh(tmp_path):
+    f = tmp_path / "localai_tpu" / "parallel" / "mesh.py"
+    f.parent.mkdir(parents=True, exist_ok=True)
+    f.write_text('AXES = ("data", "model")\n')
+
+
+def test_host_sync_on_sharded_via_producer_function(tmp_path):
+    write_mesh(tmp_path)
+    found = lint_snippet(tmp_path, """
+        import numpy as np
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        def make_sharded(mesh, f, x):
+            out = shard_map(f, mesh=mesh, in_specs=P("data"),
+                            out_specs=P("data"))(x)
+            return out
+
+        def consume(mesh, f, x):
+            y = make_sharded(mesh, f, x)
+            return np.asarray(y)
+    """, "localai_tpu/parallel/mod.py")
+    assert rules_of(found) == ["host-sync-on-sharded"]
+
+
+def test_non_sharded_producer_stays_silent(tmp_path):
+    write_mesh(tmp_path)
+    found = lint_snippet(tmp_path, """
+        import numpy as np
+
+        def make_host(x):
+            return [v + 1 for v in x]
+
+        def consume(x):
+            y = make_host(x)
+            return np.asarray(y)
+    """, "localai_tpu/parallel/mod.py")
+    assert found == []
+
+
+# -- baseline ---------------------------------------------------------------
+
+def test_loopcheck_findings_are_baselineable(tmp_path):
+    code = """
+        import time
+
+        async def handler(request):
+            time.sleep(0.1)
+    """
+    found = lint_snippet(tmp_path, code)
+    assert rules_of(found) == ["blocking-in-async"]
+    baseline = Baseline.from_findings(found)
+    new, stale = baseline.filter(lint_snippet(tmp_path, code))
+    assert new == [] and stale == []
+
+
+# -- the acceptance cross-check ---------------------------------------------
+
+INJECTED = """
+    import time
+
+    async def sse_handler(request):
+        time.sleep(0.2)     # deliberate: both halves must catch this
+        return request
+"""
+
+
+def test_injected_sleep_caught_by_both_halves(tmp_path):
+    # static half: loopcheck flags the handler from source alone
+    found = lint_snippet(tmp_path, INJECTED)
+    assert rules_of(found) == ["blocking-in-async"]
+    assert "time.sleep" in found[0].message
+
+    # runtime half: the same handler shape, actually dispatched on a
+    # live loop, is caught by the sanitizer with its wall time
+    from tools.loopsan import LoopSanitizer
+
+    async def sse_handler():
+        time.sleep(0.2)
+
+    san = LoopSanitizer(threshold_ms=50.0)
+    with san:
+        asyncio.run(sse_handler())
+    stalls = san.stalls()
+    assert len(stalls) == 1
+    assert stalls[0].duration_ms >= 150.0
+    assert "sse_handler" in stalls[0].label

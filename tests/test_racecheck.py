@@ -5,6 +5,7 @@ acquisition, RLock reentrancy, same-site instance-pair semantics, and
 the install/uninstall patching contract.
 """
 
+import subprocess
 import sys
 import threading
 from pathlib import Path
@@ -262,3 +263,31 @@ def test_edges_survive_exceptions_in_critical_section():
     # the with-blocks released both locks despite the raise
     assert not a.locked() and not b.locked()
     assert len(mon.edges()) == 1
+
+
+def test_lazy_threadpool_import_under_monitor():
+    # concurrent.futures.thread registers lock._at_fork_reinit with
+    # os.register_at_fork at IMPORT time, so a monitor-created lock must
+    # answer it — or the first lazy ThreadPoolExecutor import while the
+    # monitor is installed (fleetview's concurrent telemetry harvest
+    # during the --racecheck smoke) dies with "cannot import name".
+    # A subprocess guarantees the module is genuinely not yet imported.
+    code = (
+        "import sys\n"
+        f"sys.path.insert(0, {str(REPO)!r})\n"
+        "assert 'concurrent.futures.thread' not in sys.modules\n"
+        "from tools.racecheck import LockMonitor\n"
+        "mon = LockMonitor()\n"
+        "mon.install()\n"
+        "from concurrent.futures import ThreadPoolExecutor\n"
+        "with ThreadPoolExecutor(max_workers=1) as ex:\n"
+        "    assert ex.submit(int, '7').result() == 7\n"
+        "mon.uninstall()\n"
+        "print('OK')\n"
+    )
+    res = subprocess.run(
+        [sys.executable, "-c", code],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "OK" in res.stdout

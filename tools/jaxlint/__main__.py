@@ -9,6 +9,7 @@ current findings.
 from __future__ import annotations
 
 import argparse
+import os
 import sys
 from pathlib import Path
 
@@ -33,6 +34,9 @@ def main(argv: list[str] | None = None) -> int:
                     help="report every finding, ignoring any baseline")
     ap.add_argument("--write-baseline", action="store_true",
                     help="accept current findings into the baseline file")
+    ap.add_argument("--prune-baseline", action="store_true",
+                    help="rewrite the baseline dropping stale entries "
+                         "(fixed findings) — never adds new ones")
     ap.add_argument("--list-rules", action="store_true",
                     help="print rule ids and one-line docs, then exit")
     args = ap.parse_args(argv)
@@ -61,17 +65,49 @@ def main(argv: list[str] | None = None) -> int:
 
     stale: list[tuple] = []
     if not args.no_baseline and baseline_path.is_file():
-        new, stale = Baseline.load(baseline_path).filter(findings)
+        baseline = Baseline.load(baseline_path)
+        new, stale = baseline.filter(findings)
         suppressed = len(findings) - len(new)
     else:
-        new, suppressed = findings, 0
+        baseline, new, suppressed = None, findings, 0
+
+    if args.prune_baseline:
+        if baseline is None:
+            print("jaxlint: --prune-baseline needs a baseline file",
+                  file=sys.stderr)
+            return 1
+        if stale:
+            for k in stale:
+                baseline.entries.pop(k, None)
+            # keep absorbed counts exact: re-derive from what actually
+            # matched this run (a partially-stale multi-count entry
+            # shrinks rather than disappearing)
+            matched = Baseline.from_findings(
+                [f for f in findings if f not in new])
+            baseline.entries = {
+                k: min(c, matched.entries.get(k, 0))
+                for k, c in baseline.entries.items()
+                if matched.entries.get(k, 0) > 0
+            }
+            baseline.write(baseline_path)
+        print(f"jaxlint: pruned {len(stale)} stale entr"
+              f"{'y' if len(stale) == 1 else 'ies'} from "
+              f"{baseline_path}")
+        stale = []
 
     for f in new:
         print(f.render())
     if stale:
-        print(f"jaxlint: note: {len(stale)} stale baseline entr"
-              f"{'y' if len(stale) == 1 else 'ies'} (fixed findings) — "
-              f"consider --write-baseline", file=sys.stderr)
+        note = (f"{len(stale)} stale baseline entr"
+                f"{'y' if len(stale) == 1 else 'ies'} (fixed findings) "
+                f"— run --prune-baseline")
+        print(f"jaxlint: note: {note}", file=sys.stderr)
+        if os.environ.get("GITHUB_ACTIONS"):
+            # surfaces as an annotation on the workflow run
+            print(f"::warning title=jaxlint stale baseline::{note}")
+            for file, rule, text in stale:
+                print(f"::warning file={file},title=stale baseline "
+                      f"entry::{rule}: {text}")
     tail = f" ({suppressed} baselined)" if suppressed else ""
     print(f"jaxlint: {len(new)} finding(s){tail}", file=sys.stderr)
     return 1 if new else 0

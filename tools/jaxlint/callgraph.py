@@ -1,0 +1,528 @@
+"""Project-wide call graph + blocking-taint propagation.
+
+The single-module passes (lockcheck, the hot-path rules) judge one
+function body at a time, so one level of helper indirection hides a
+violation: ``async def handler`` calling ``_encode_png`` which calls
+``Image.fromarray(...).save(...)`` looks clean to a body-local scan.
+This module builds the graph those passes need:
+
+  * a **function index** over every scanned module — module-level
+    ``def``s and class methods, keyed ``path::Class.name``;
+  * **call edges** resolved the two ways this codebase actually calls
+    its own code: module-level names (including ``from x import f`` and
+    ``import x as y; y.f(...)``) and ``self.method(...)`` (with
+    one-hop base-class lookup inside the same module);
+  * **blocking classification** of leaf calls — device round-trips,
+    ``time.sleep``, gRPC/replica RPCs, subprocess, file and PIL I/O,
+    lock acquires and future/event waits — each tagged with the
+    *domains* it matters for (``async``: stalls the event loop;
+    ``lock``: stalls every thread needing a held lock);
+  * **taint propagation**: a function is blocking-tainted when its own
+    scope contains a blocking leaf or it (synchronously) calls a
+    tainted project function. The witness chain is kept so findings can
+    say *why* (``helper → _encode_png → PIL Image.fromarray``).
+
+Scope walks never descend into nested ``def``/``lambda``: a closure
+passed to ``run_in_executor``/``to_thread`` runs OFF the calling
+context, which is exactly why the offload idiom is written that way.
+A call that is itself directly awaited is skipped too — ``await
+lock.acquire()`` is the asyncio primitive, not the blocking one.
+
+The ``# jaxlint: offloaded`` annotation is the escape hatch for code
+the graph cannot see runs off-loop: on a ``def`` line it marks the
+whole function as executor-side (never taints, body never flagged by
+the loop rules); on any other line it clears that line's blocking
+leaves. Always written with the reason: ``# jaxlint: offloaded (runs
+via state.executor only)``.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import re
+from typing import Iterator, Optional
+
+from tools.jaxlint.core import Module
+
+OFFLOADED_RE = re.compile(r"#\s*jaxlint:\s*offloaded\b")
+
+# -- the shared blocking-leaf vocabulary (lockcheck imports these) ----------
+
+# calls that block the calling thread long enough to matter under a lock
+# or on the event loop
+BLOCKING_DOTTED = {
+    "time.sleep",
+    "jax.device_get", "jax.block_until_ready",
+    "subprocess.run", "subprocess.call", "subprocess.check_output",
+    "subprocess.check_call", "subprocess.Popen",
+}
+# np.asarray/np.array block only when fed a DEVICE value (then they are a
+# device->host sync); on host lists/ndarrays they are cheap copies, so
+# they count only when the argument looks device-resident
+NP_GATHERS = {"np.asarray", "np.array", "numpy.asarray", "numpy.array"}
+DEVICEISH = re.compile(r"\b(jnp|jax)\.|\.(state|kv)\b|device")
+# attribute calls that block regardless of receiver
+BLOCKING_METHODS = {"item", "block_until_ready", "result", "wait"}
+# gRPC service methods (backend.proto) — a stub call under a lock is the
+# scrape-stall class verbatim
+RPC_METHODS = {
+    "Health", "Predict", "PredictStream", "LoadModel", "Embedding",
+    "TokenizeString", "Status", "GetMetrics", "Rerank", "TTS",
+    "SoundGeneration", "GenerateImage", "AudioTranscription",
+    "PrefillPrefix", "TransferPrefix",
+    "StoresSet", "StoresGet", "StoresFind", "StoresDelete",
+}
+# the worker-client / replica wrappers around those RPCs: blocking when
+# invoked on anything that is not plain ``self`` (a method on self is a
+# local computation; the same name on a replica/client object is a
+# network round-trip)
+CLIENT_RPC_METHODS = {
+    "dial", "predict", "predict_stream", "load_model", "health",
+    "prefill_prefix", "transfer_prefix", "tokenize", "embedding",
+    "metrics", "stats", "rerank", "transcribe", "tts",
+    "sound_generation", "generate_image",
+    "stores_set", "stores_get", "stores_find", "stores_delete",
+}
+
+# event-loop-only leaves: disk and image-codec work is milliseconds-to-
+# hundreds-of-ms — fatal on the loop, but not the lockcheck noise class
+# (a config read under a startup lock is fine)
+PIL_RE = re.compile(r"(^|\.)Image\.(open|fromarray|frombytes|new)$")
+FILE_METHODS = {"read_text", "read_bytes", "write_text", "write_bytes"}
+# image/array payloads whose np materialization is either a device pull
+# or a multi-MB host copy — both loop-fatal (extends DEVICEISH for the
+# async domain only)
+PAYLOADISH = re.compile(
+    r"\b(jnp|jax)\.|\.(state|kv)\b|device|\bimg\b|image|audio|wav|frame")
+
+# a call whose callable argument escapes to a worker thread: the call
+# itself is the offload, never a blocking leaf
+OFFLOADER_SUFFIXES = ("run_in_executor", "to_thread", "_in_executor")
+
+_SYNC_DOMAINS = frozenset({"async", "lock"})
+_ASYNC_ONLY = frozenset({"async"})
+
+
+@dataclasses.dataclass
+class BlockingSite:
+    node: ast.Call
+    desc: str
+    domains: frozenset
+
+
+@dataclasses.dataclass
+class CallEdge:
+    node: ast.Call
+    callee: str         # FuncNode key
+    awaited: bool
+
+
+@dataclasses.dataclass
+class FuncNode:
+    key: str            # "<module.path>::<qualname>"
+    qualname: str       # "name" or "Class.name"
+    module: Module
+    node: ast.AST       # FunctionDef | AsyncFunctionDef
+    cls: Optional[str]
+    is_async: bool
+    offloaded: bool     # `# jaxlint: offloaded` on a signature line
+    sites: list = dataclasses.field(default_factory=list)   # [BlockingSite]
+    edges: list = dataclasses.field(default_factory=list)   # [CallEdge]
+    is_generator: bool = False
+
+
+def signature_lines(module: Module, fn) -> range:
+    """The def's signature may span lines; annotations count on any of
+    them (a trailing comment naturally lands on the ``:`` line)."""
+    sig_end = fn.body[0].lineno if fn.body else fn.lineno + 1
+    return range(fn.lineno, max(fn.lineno + 1, sig_end))
+
+
+def is_offloaded_def(module: Module, fn) -> bool:
+    return any(OFFLOADED_RE.search(module.line_text(line))
+               for line in signature_lines(module, fn))
+
+
+def own_scope(fn) -> Iterator[ast.AST]:
+    """Walk ``fn``'s body without descending into nested def/lambda —
+    nested callables run in another context (thread target, executor
+    closure, later callback), never inline."""
+    nested = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+    stack = list(fn.body) if hasattr(fn, "body") else [fn]
+    while stack:
+        node = stack.pop()
+        if isinstance(node, nested):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def classify_blocking(module: Module, node: ast.Call,
+                      deviceish: re.Pattern = DEVICEISH
+                      ) -> Optional[tuple[str, frozenset]]:
+    """(description, domains) when ``node`` is a blocking leaf call."""
+    name = module.dotted(node.func)
+    if name in BLOCKING_DOTTED:
+        return f"`{name}(...)`", _SYNC_DOMAINS
+    if name in NP_GATHERS and node.args:
+        try:
+            src = ast.unparse(node.args[0])
+        except Exception:
+            src = ""
+        if deviceish.search(src):
+            return f"`{name}(...)` device/payload gather", _SYNC_DOMAINS
+    if name and PIL_RE.search(name):
+        return f"PIL `{name}(...)` image decode/encode", _ASYNC_ONLY
+    if name == "open":
+        return "`open(...)` file I/O", _ASYNC_ONLY
+    func = node.func
+    if not isinstance(func, ast.Attribute):
+        return None
+    if func.attr == "item" and not node.args and not node.keywords:
+        return "`.item()` device sync", _SYNC_DOMAINS
+    if func.attr == "block_until_ready":
+        return "`.block_until_ready()` device sync", _SYNC_DOMAINS
+    if func.attr in ("result", "wait"):
+        return f"`.{func.attr}(...)` blocking wait", _SYNC_DOMAINS
+    if func.attr == "acquire":
+        # a NON-awaited acquire in async context is either a threading
+        # lock (blocks the loop) or a forgotten-await asyncio acquire —
+        # both findings. lockcheck models held locks separately.
+        return "`.acquire(...)` lock wait", _ASYNC_ONLY
+    if func.attr in FILE_METHODS:
+        return f"`.{func.attr}(...)` file I/O", _ASYNC_ONLY
+    if func.attr in RPC_METHODS:
+        return f"gRPC `.{func.attr}(...)`", _SYNC_DOMAINS
+    try:
+        recv = ast.unparse(func.value)
+    except Exception:
+        recv = ""
+    if "stub" in recv.split("."):
+        return f"gRPC `{recv}.{func.attr}(...)`", _SYNC_DOMAINS
+    if func.attr in CLIENT_RPC_METHODS and recv != "self":
+        return f"replica/worker RPC `.{func.attr}(...)`", _SYNC_DOMAINS
+    return None
+
+
+def is_offloader(module: Module, node: ast.Call) -> bool:
+    name = module.dotted(node.func) or ""
+    return name.endswith(OFFLOADER_SUFFIXES)
+
+
+# the sharded-producer vocabulary (shared with shardcheck's deep pass)
+SHARDED_SRC = re.compile(
+    r"\b(shard_map\s*\(|NamedSharding\s*\(|device_put\s*\(.*"
+    r"(named\s*\(|NamedSharding\s*\(|P\s*\())")
+
+
+class CallGraph:
+    """One graph over the whole scanned module set. Build with
+    :func:`build_graph` — repeated project rules in one run share the
+    instance (it is cached on the Module objects themselves, so there
+    is no cross-run staleness)."""
+
+    def __init__(self, modules: list[Module]):
+        self.modules = list(modules)
+        self.key = frozenset(m.path for m in self.modules)
+        self.functions: dict[str, FuncNode] = {}
+        # per module: name -> key for top-level defs
+        self._top: dict[str, dict[str, str]] = {}
+        # per module: class -> {method -> key}, class -> [base names]
+        self._methods: dict[str, dict[str, dict[str, str]]] = {}
+        self._bases: dict[str, dict[str, list[str]]] = {}
+        # dotted module name suffix -> path ("" on ambiguity)
+        self._mod_by_dotted: dict[str, str] = {}
+        # per module: alias -> ("mod", path) | ("func", key)
+        self._imports: dict[str, dict[str, tuple]] = {}
+        self._taint_memo: dict[tuple, Optional[list]] = {}
+        self._sharded_memo: dict[str, bool] = {}
+        for m in self.modules:
+            self._index_module(m)
+        for m in self.modules:
+            self._index_imports(m)
+        for fn in self.functions.values():
+            self._scan_body(fn)
+
+    # -- indexing ----------------------------------------------------------
+
+    def _index_module(self, m: Module) -> None:
+        top: dict[str, str] = {}
+        methods: dict[str, dict[str, str]] = {}
+        bases: dict[str, list[str]] = {}
+        for node in m.tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                key = f"{m.path}::{node.name}"
+                top[node.name] = key
+                self._add_func(key, node.name, m, node, None)
+            elif isinstance(node, ast.ClassDef):
+                per: dict[str, str] = {}
+                for sub in node.body:
+                    if isinstance(sub, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                        qual = f"{node.name}.{sub.name}"
+                        key = f"{m.path}::{qual}"
+                        per[sub.name] = key
+                        self._add_func(key, qual, m, sub, node.name)
+                methods[node.name] = per
+                bases[node.name] = [
+                    b.id for b in node.bases if isinstance(b, ast.Name)
+                ]
+        self._top[m.path] = top
+        self._methods[m.path] = methods
+        self._bases[m.path] = bases
+        # register every dotted suffix of the path so absolute imports
+        # resolve whether the scan root is the repo or a tmp fixture tree
+        parts = m.path.replace("\\", "/").split("/")
+        parts[-1] = parts[-1][:-3]  # strip .py
+        if parts[-1] == "__init__":
+            parts = parts[:-1]
+        for i in range(len(parts)):
+            dotted = ".".join(parts[i:])
+            if not dotted:
+                continue
+            if dotted in self._mod_by_dotted \
+                    and self._mod_by_dotted[dotted] != m.path:
+                self._mod_by_dotted[dotted] = ""  # ambiguous suffix
+            else:
+                self._mod_by_dotted[dotted] = m.path
+
+    def _add_func(self, key, qualname, m, node, cls) -> None:
+        self.functions[key] = FuncNode(
+            key=key, qualname=qualname, module=m, node=node, cls=cls,
+            is_async=isinstance(node, ast.AsyncFunctionDef),
+            offloaded=is_offloaded_def(m, node),
+            is_generator=any(isinstance(n, (ast.Yield, ast.YieldFrom))
+                             for n in own_scope(node)),
+        )
+
+    def _module_path(self, dotted: str) -> Optional[str]:
+        hit = self._mod_by_dotted.get(dotted)
+        return hit or None
+
+    def _index_imports(self, m: Module) -> None:
+        imp: dict[str, tuple] = {}
+        for node in ast.walk(m.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    path = self._module_path(a.name)
+                    if path:
+                        imp[a.asname or a.name.split(".")[0]] = \
+                            ("mod", path) if a.asname else ("pkg", a.name)
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                base = self._module_path(node.module)
+                for a in node.names:
+                    # `from pkg import mod` vs `from mod import func`
+                    sub = self._module_path(f"{node.module}.{a.name}")
+                    if sub:
+                        imp[a.asname or a.name] = ("mod", sub)
+                    elif base and a.name in self._top.get(base, {}):
+                        imp[a.asname or a.name] = (
+                            "func", self._top[base][a.name])
+        self._imports[m.path] = imp
+
+    # -- call resolution ---------------------------------------------------
+
+    def resolve_call(self, module: Module, cls: Optional[str],
+                     node: ast.Call) -> Optional[str]:
+        """FuncNode key for a call on a module-level name, an imported
+        project module's attribute, or ``self.method``."""
+        func = node.func
+        imp = self._imports.get(module.path, {})
+        if isinstance(func, ast.Name):
+            hit = self._top.get(module.path, {}).get(func.id)
+            if hit:
+                return hit
+            tag = imp.get(func.id)
+            if tag and tag[0] == "func":
+                return tag[1]
+            return None
+        if not isinstance(func, ast.Attribute):
+            return None
+        # self.method(...) — own class, then one-hop same-module bases
+        if isinstance(func.value, ast.Name) and func.value.id == "self" \
+                and cls is not None:
+            methods = self._methods.get(module.path, {})
+            hit = methods.get(cls, {}).get(func.attr)
+            if hit:
+                return hit
+            for base in self._bases.get(module.path, {}).get(cls, ()):
+                hit = methods.get(base, {}).get(func.attr)
+                if hit:
+                    return hit
+            return None
+        # alias.func(...) — `import localai_tpu.api.openai as oai` or
+        # `from localai_tpu.api import openai`
+        if isinstance(func.value, ast.Name):
+            tag = imp.get(func.value.id)
+            if tag and tag[0] == "mod":
+                return self._top.get(tag[1], {}).get(func.attr)
+            return None
+        # fully dotted: localai_tpu.api.openai.func(...)
+        dotted = module.dotted(func)
+        if dotted and "." in dotted:
+            mod, _, fname = dotted.rpartition(".")
+            path = self._module_path(mod)
+            if path:
+                return self._top.get(path, {}).get(fname)
+        return None
+
+    # -- body scan ---------------------------------------------------------
+
+    def _scan_body(self, fn: FuncNode) -> None:
+        m = fn.module
+        for node in own_scope(fn.node):
+            if not isinstance(node, ast.Call):
+                continue
+            parent = m.parents.get(node)
+            awaited = isinstance(parent, ast.Await)
+            callee = self.resolve_call(m, fn.cls, node)
+            if callee is not None:
+                fn.edges.append(CallEdge(node, callee, awaited))
+                continue
+            if awaited or is_offloader(m, node):
+                continue
+            if OFFLOADED_RE.search(m.line_text(node.lineno)):
+                continue
+            hit = classify_blocking(m, node, deviceish=PAYLOADISH)
+            if hit:
+                fn.sites.append(BlockingSite(node, hit[0], hit[1]))
+
+    # -- taint -------------------------------------------------------------
+
+    def taint(self, key: str, domain: str = "async",
+              _stack: Optional[frozenset] = None) -> Optional[list[str]]:
+        """Witness chain (labels ending in the blocking desc) when the
+        function's own scope — or, transitively, a synchronously-called
+        project helper's — contains a blocking leaf in ``domain``.
+        ``None`` when clean. Offloaded functions never taint."""
+        memo_key = (key, domain)
+        if memo_key in self._taint_memo:
+            return self._taint_memo[memo_key]
+        fn = self.functions.get(key)
+        if fn is None or fn.offloaded:
+            self._taint_memo[memo_key] = None
+            return None
+        stack = _stack or frozenset()
+        if key in stack:
+            return None  # recursion: judged by the outer frame
+        for s in fn.sites:
+            if domain in s.domains:
+                self._taint_memo[memo_key] = [s.desc]
+                return [s.desc]
+        for e in fn.edges:
+            callee = self.functions.get(e.callee)
+            if callee is None or callee.is_async or e.awaited:
+                continue  # an awaited/async callee is judged on its own
+            sub = self.taint(e.callee, domain, stack | {key})
+            if sub is not None:
+                chain = [callee.qualname] + sub
+                self._taint_memo[memo_key] = chain
+                return chain
+        self._taint_memo[memo_key] = None
+        return None
+
+    def call_taint(self, module: Module, cls: Optional[str],
+                   node: ast.Call, domain: str = "async"
+                   ) -> Optional[list[str]]:
+        """Taint chain for a concrete call site, or None."""
+        key = self.resolve_call(module, cls, node)
+        if key is None:
+            return None
+        fn = self.functions[key]
+        if fn.is_async:
+            return None
+        sub = self.taint(key, domain)
+        return [fn.qualname] + sub if sub is not None else None
+
+    # -- sharded returns (shardcheck's deep pass) --------------------------
+
+    def returns_sharded(self, key: str,
+                        _stack: Optional[frozenset] = None) -> bool:
+        """True when the function returns a value produced by shard_map /
+        NamedSharding placement — directly, via a local, or via a call to
+        another sharded-returning project function."""
+        if key in self._sharded_memo:
+            return self._sharded_memo[key]
+        fn = self.functions.get(key)
+        if fn is None:
+            return False
+        stack = _stack or frozenset()
+        if key in stack:
+            return False
+        sharded_locals: set[str] = set()
+        for node in own_scope(fn.node):
+            if isinstance(node, ast.Assign):
+                try:
+                    src = ast.unparse(node.value)
+                except Exception:
+                    continue
+                produced = bool(SHARDED_SRC.search(src))
+                if not produced and isinstance(node.value, ast.Call):
+                    callee = self.resolve_call(
+                        fn.module, fn.cls, node.value)
+                    produced = callee is not None and self.returns_sharded(
+                        callee, stack | {key})
+                if produced:
+                    for t in node.targets:
+                        elts = (t.elts if isinstance(t, (ast.Tuple,
+                                                         ast.List))
+                                else [t])
+                        sharded_locals.update(
+                            e.id for e in elts if isinstance(e, ast.Name))
+        out = False
+        for node in own_scope(fn.node):
+            if not isinstance(node, ast.Return) or node.value is None:
+                continue
+            v = node.value
+            if isinstance(v, ast.Name) and v.id in sharded_locals:
+                out = True
+            elif isinstance(v, ast.Call):
+                callee = self.resolve_call(fn.module, fn.cls, v)
+                if callee is not None and self.returns_sharded(
+                        callee, stack | {key}):
+                    out = True
+            else:
+                try:
+                    if SHARDED_SRC.search(ast.unparse(v)):
+                        out = True
+                except Exception:
+                    pass
+            if out:
+                break
+        self._sharded_memo[key] = out
+        return out
+
+    def sharded_producer_names(self, module: Module,
+                               cls: Optional[str]) -> set[str]:
+        """Top-level/function names IN SCOPE of ``module`` that resolve
+        to sharded-returning project functions (used to extend the
+        per-scope dataflow in shardcheck)."""
+        out: set[str] = set()
+        for name, key in self._top.get(module.path, {}).items():
+            if self.returns_sharded(key):
+                out.add(name)
+        for alias, tag in self._imports.get(module.path, {}).items():
+            if tag[0] == "func" and self.returns_sharded(tag[1]):
+                out.add(alias)
+        return out
+
+
+def build_graph(modules: list[Module]) -> CallGraph:
+    """Build (or reuse) the CallGraph for a module set. The instance is
+    cached on the Module objects: several project rules in one
+    lint_paths run receive the SAME Module objects, so they share one
+    graph; fresh parses (the next run) never see a stale one."""
+    modules = list(modules)
+    if not modules:
+        return CallGraph([])
+    key = frozenset(m.path for m in modules)
+    cached = modules[0].__dict__.get("_callgraph")
+    if (cached is not None and cached.key == key
+            and all(m.__dict__.get("_callgraph") is cached
+                    for m in modules)):
+        return cached
+    graph = CallGraph(modules)
+    for m in modules:
+        m.__dict__["_callgraph"] = graph
+    return graph

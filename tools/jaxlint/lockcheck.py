@@ -33,9 +33,19 @@ from __future__ import annotations
 
 import ast
 import dataclasses
-import re
 from typing import Iterator, Optional
 
+# the blocking-call vocabulary is shared with the call-graph layer (and
+# through it with loopcheck): one definition of "what blocks a thread"
+from tools.jaxlint.callgraph import (  # noqa: F401 — re-exported names
+    BLOCKING_DOTTED,
+    BLOCKING_METHODS,
+    CLIENT_RPC_METHODS,
+    DEVICEISH,
+    NP_GATHERS,
+    RPC_METHODS,
+    build_graph,
+)
 from tools.jaxlint.core import SUPPRESS_RE, Finding, Module
 
 LOCK_CTORS = {"threading.Lock", "threading.RLock", "Lock", "RLock"}
@@ -55,42 +65,6 @@ MUTATORS = {
     "clear", "update", "extend", "insert", "setdefault", "popitem",
     "put", "put_nowait",
 }
-
-# calls that block the calling thread long enough to matter under a lock
-BLOCKING_DOTTED = {
-    "time.sleep",
-    "jax.device_get", "jax.block_until_ready",
-    "subprocess.run", "subprocess.call", "subprocess.check_output",
-    "subprocess.check_call", "subprocess.Popen",
-}
-# np.asarray/np.array block only when fed a DEVICE value (then they are a
-# device->host sync); on host lists/ndarrays they are cheap copies, so
-# they count only when the argument looks device-resident
-NP_GATHERS = {"np.asarray", "np.array", "numpy.asarray", "numpy.array"}
-DEVICEISH = re.compile(r"\b(jnp|jax)\.|\.(state|kv)\b|device")
-# attribute calls that block regardless of receiver
-BLOCKING_METHODS = {"item", "block_until_ready", "result", "wait"}
-# gRPC service methods (backend.proto) — a stub call under a lock is the
-# scrape-stall class verbatim
-RPC_METHODS = {
-    "Health", "Predict", "PredictStream", "LoadModel", "Embedding",
-    "TokenizeString", "Status", "GetMetrics", "Rerank", "TTS",
-    "SoundGeneration", "GenerateImage", "AudioTranscription",
-    "PrefillPrefix", "TransferPrefix",
-    "StoresSet", "StoresGet", "StoresFind", "StoresDelete",
-}
-# the worker-client / replica wrappers around those RPCs: blocking when
-# invoked on anything that is not plain ``self`` (a method on self is a
-# local computation; the same name on a replica/client object is a
-# network round-trip)
-CLIENT_RPC_METHODS = {
-    "dial", "predict", "predict_stream", "load_model", "health",
-    "prefill_prefix", "transfer_prefix", "tokenize", "embedding",
-    "metrics", "stats", "rerank", "transcribe", "tts",
-    "sound_generation", "generate_image",
-    "stores_set", "stores_get", "stores_find", "stores_delete",
-}
-
 
 @dataclasses.dataclass
 class Access:
@@ -119,6 +93,9 @@ class ClassLockModel:
         self.sync_attrs: set[str] = set()
         self.accesses: list[Access] = []
         self.blocking: list[BlockingCall] = []
+        # non-blocking calls made WITH a lock held: resolved against the
+        # project call graph at finalize time (helper indirection)
+        self.candidates: list[BlockingCall] = []
         self.method_lines: dict[str, int] = {}
         # attr -> set of lock names it was written under / declared with
         self.guards: dict[str, set[str]] = {}
@@ -287,6 +264,8 @@ class ClassLockModel:
         what = self._blocking_kind(node)
         if what:
             self.blocking.append(BlockingCall(node, what, held, method))
+        else:
+            self.candidates.append(BlockingCall(node, "", held, method))
 
     def _blocking_kind(self, node: ast.Call) -> Optional[str]:
         name = self.module.dotted(node.func)
@@ -419,23 +398,57 @@ class BlockingUnderLock:
     for the call's full duration — the PR 7 scrape stall (stats RPCs
     under the manager lock) as a lint rule. Copy what the call needs,
     release the lock, then block.
+
+    A ProjectRule since the loopcheck PR: locked calls that resolve
+    through the project call graph to a blocking-tainted helper are
+    flagged too, so ``with self._lock: self._refresh()`` no longer
+    hides the RPC one ``def`` away inside ``_refresh``.
     """
 
     id = "blocking-under-lock"
     doc = ("device sync, gRPC/replica RPC, future/event wait, "
            "subprocess, or time.sleep performed while a threading lock "
-           "is held")
+           "is held — directly or via a project helper (call graph)")
 
-    def check(self, module: Module) -> Iterator[Finding]:
-        for model in lock_models(module):
-            for b in model.blocking:
-                if method_waived(module, model, b.method, self.id):
-                    continue
-                lock = "/".join(sorted(b.held))
-                yield module.finding(
-                    b.node, self.id,
-                    f"{b.what} while holding `self.{lock}` in "
-                    f"{model.cls.name}.{b.method} blocks every thread "
-                    f"needing the lock; move the call outside the "
-                    f"critical section",
-                )
+    def __init__(self):
+        self._modules: list[Module] = []
+
+    def collect(self, module: Module) -> None:
+        self._modules.append(module)
+
+    def finalize(self) -> Iterator[Finding]:
+        from tools.jaxlint.callgraph import OFFLOADED_RE
+
+        graph = build_graph(self._modules)
+        for module in self._modules:
+            for model in lock_models(module):
+                for b in model.blocking:
+                    if method_waived(module, model, b.method, self.id):
+                        continue
+                    lock = "/".join(sorted(b.held))
+                    yield module.finding(
+                        b.node, self.id,
+                        f"{b.what} while holding `self.{lock}` in "
+                        f"{model.cls.name}.{b.method} blocks every "
+                        f"thread needing the lock; move the call "
+                        f"outside the critical section",
+                    )
+                for c in model.candidates:
+                    if method_waived(module, model, c.method, self.id):
+                        continue
+                    if OFFLOADED_RE.search(
+                            module.line_text(c.node.lineno)):
+                        continue
+                    chain = graph.call_taint(
+                        module, model.cls.name, c.node, domain="lock")
+                    if chain is None:
+                        continue
+                    lock = "/".join(sorted(c.held))
+                    path = " → ".join(chain)
+                    yield module.finding(
+                        c.node, self.id,
+                        f"call to `{chain[0]}(...)` while holding "
+                        f"`self.{lock}` in {model.cls.name}.{c.method} "
+                        f"reaches blocking work ({path}); move the "
+                        f"call outside the critical section",
+                    )

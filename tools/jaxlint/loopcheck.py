@@ -1,0 +1,345 @@
+"""loopcheck: event-loop blocking analysis over the project call graph.
+
+The API front door is ONE asyncio loop; a single blocked callback
+freezes every tenant's stream at once. These rules find the blocking
+before it ships, using :mod:`tools.jaxlint.callgraph` so one level of
+helper indirection (``async handler → _encode_png → PIL``) no longer
+hides it:
+
+``blocking-in-async``
+    a blocking leaf — device round-trip, ``time.sleep``, gRPC/replica
+    RPC, file/PIL/subprocess I/O, lock/future wait — in an ``async
+    def``'s own scope, or a call to a sync project helper that
+    transitively reaches one. Offload with ``await
+    loop.run_in_executor(...)`` / ``asyncio.to_thread(...)``, or
+    annotate ``# jaxlint: offloaded (reason)`` when the code provably
+    runs executor-side.
+
+``blocking-in-stream``
+    the same sites inside an async *generator* (or an ``async for``
+    body) — SSE streams stall between every chunk, which multiplies
+    the damage by the token count.
+
+``async-lock-blocking-await``
+    an ``asyncio.Lock`` held across an ``await`` of an executor
+    offload or of a slow async callee. The loop keeps turning, but the
+    lock is pinned for the blocked call's full wall time — every other
+    task needing it queues behind one straggler.
+
+``coroutine-not-awaited``
+    a statement-position call of a project ``async def`` whose
+    coroutine is discarded — the body never runs. (The runtime warning
+    for this only fires at GC time, usually far from the bug.)
+
+Test files (``test_*``/``conftest``) are skipped: tests block event
+loops on purpose (fixtures simulating slow handlers). The runtime
+cross-check for everything static analysis cannot see — attribute-of-
+attribute receivers, dynamic dispatch — is ``tools/loopsan.py``.
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+from typing import Iterator, Optional
+
+from tools.jaxlint.callgraph import (
+    OFFLOADED_RE,
+    CallGraph,
+    FuncNode,
+    build_graph,
+    is_offloader,
+    own_scope,
+)
+from tools.jaxlint.core import Finding, Module
+
+ASYNC_LOCK_CTORS = {
+    "asyncio.Lock", "asyncio.Condition", "asyncio.Semaphore",
+    "asyncio.BoundedSemaphore",
+}
+
+OFFLOAD_HINT = ("offload it (`await loop.run_in_executor(...)` / "
+                "`asyncio.to_thread(...)`) or annotate "
+                "`# jaxlint: offloaded (reason)` if it provably runs "
+                "executor-side")
+
+
+def _is_test_file(path: str) -> bool:
+    return Path(path).name.startswith(("test_", "conftest"))
+
+
+def _async_lock_exprs(module: Module) -> set[str]:
+    """Unparsed assignment targets bound to asyncio sync primitives —
+    ``{"self._lock", "lock"}`` — matched textually against ``async
+    with`` context expressions."""
+    cached = module.__dict__.get("_async_lock_exprs")
+    if cached is not None:
+        return cached
+    out: set[str] = set()
+    for node in ast.walk(module.tree):
+        if not (isinstance(node, ast.Assign)
+                and isinstance(node.value, ast.Call)):
+            continue
+        if module.dotted(node.value.func) not in ASYNC_LOCK_CTORS:
+            continue
+        for t in node.targets:
+            try:
+                out.add(ast.unparse(t))
+            except Exception:
+                pass
+    module.__dict__["_async_lock_exprs"] = out
+    return out
+
+
+class _Analysis:
+    """All four rules' findings, computed in one pass over the graph and
+    cached on it — each ProjectRule below just reads its bucket."""
+
+    def __init__(self, graph: CallGraph):
+        self.graph = graph
+        self.findings: dict[str, list[Finding]] = {
+            "blocking-in-async": [],
+            "blocking-in-stream": [],
+            "async-lock-blocking-await": [],
+            "coroutine-not-awaited": [],
+        }
+        self._slow_memo: dict[str, bool] = {}
+        for fn in graph.functions.values():
+            if _is_test_file(fn.module.path):
+                continue
+            if fn.is_async and not fn.offloaded:
+                self._check_async_fn(fn)
+                self._check_lock_spans(fn)
+        for m in graph.modules:
+            if not _is_test_file(m.path):
+                self._check_discarded(m)
+
+    # -- blocking-in-async / blocking-in-stream ---------------------------
+
+    def _stream_ctx(self, fn: FuncNode, node: ast.AST) -> bool:
+        """The site stalls a stream: the enclosing async def is a
+        generator, or the site sits in an ``async for`` body."""
+        if fn.is_generator:
+            return True
+        m = fn.module
+        for anc in m.ancestors(node):
+            if anc is fn.node:
+                break
+            if isinstance(anc, ast.AsyncFor):
+                return True
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.Lambda)):
+                break
+        return False
+
+    def _emit(self, fn: FuncNode, node: ast.AST, what: str) -> None:
+        if self._stream_ctx(fn, node):
+            rule = "blocking-in-stream"
+            consequence = (f"stalls the SSE/stream consumer between "
+                           f"chunks in `async def {fn.qualname}`")
+        else:
+            rule = "blocking-in-async"
+            consequence = (f"blocks the event loop in `async def "
+                           f"{fn.qualname}` — every other request "
+                           f"freezes for its duration")
+        self.findings[rule].append(fn.module.finding(
+            node, rule, f"{what} {consequence}; {OFFLOAD_HINT}"))
+
+    def _check_async_fn(self, fn: FuncNode) -> None:
+        m = fn.module
+        for s in fn.sites:
+            if "async" in s.domains:
+                self._emit(fn, s.node, s.desc)
+        for e in fn.edges:
+            if e.awaited:
+                continue
+            callee = self.graph.functions.get(e.callee)
+            if callee is None or callee.is_async:
+                continue
+            if OFFLOADED_RE.search(m.line_text(e.node.lineno)):
+                continue
+            chain = self.graph.taint(e.callee, "async")
+            if chain is None:
+                continue
+            path = " → ".join([callee.qualname] + chain)
+            self._emit(fn, e.node,
+                       f"the inline call `{callee.qualname}(...)` is "
+                       f"blocking-tainted ({path}), so it")
+
+    # -- async-lock-blocking-await ----------------------------------------
+
+    def _async_slow(self, key: str,
+                    _stack: Optional[frozenset] = None) -> bool:
+        """The async function's own wall time can be long: it has a
+        blocking leaf, awaits an executor offload, calls a tainted sync
+        helper, or awaits another slow async project callee."""
+        if key in self._slow_memo:
+            return self._slow_memo[key]
+        fn = self.graph.functions.get(key)
+        if fn is None or fn.offloaded:
+            return False
+        stack = _stack or frozenset()
+        if key in stack:
+            return False
+        out = any("async" in s.domains for s in fn.sites)
+        if not out:
+            for node in own_scope(fn.node):
+                if (isinstance(node, ast.Call)
+                        and is_offloader(fn.module, node)):
+                    out = True
+                    break
+        if not out:
+            for e in fn.edges:
+                callee = self.graph.functions.get(e.callee)
+                if callee is None:
+                    continue
+                if callee.is_async:
+                    if e.awaited and self._async_slow(
+                            e.callee, stack | {key}):
+                        out = True
+                        break
+                elif self.graph.taint(e.callee, "async") is not None:
+                    out = True
+                    break
+        self._slow_memo[key] = out
+        return out
+
+    def _check_lock_spans(self, fn: FuncNode) -> None:
+        m = fn.module
+        locks = _async_lock_exprs(m)
+        if not locks:
+            return
+        for stmt in own_scope(fn.node):
+            if not isinstance(stmt, ast.AsyncWith):
+                continue
+            held = None
+            for item in stmt.items:
+                try:
+                    src = ast.unparse(item.context_expr)
+                except Exception:
+                    continue
+                if src in locks:
+                    held = src
+                    break
+            if held is None:
+                continue
+            for node in self._with_scope(stmt):
+                if not isinstance(node, ast.Await):
+                    continue
+                val = node.value
+                if not isinstance(val, ast.Call):
+                    continue
+                what = None
+                if is_offloader(m, val):
+                    what = "an executor offload"
+                else:
+                    key = self.graph.resolve_call(m, fn.cls, val)
+                    if key is not None:
+                        callee = self.graph.functions[key]
+                        if callee.is_async and self._async_slow(key):
+                            what = (f"slow `async def "
+                                    f"{callee.qualname}` (it offloads "
+                                    f"or reaches blocking work)")
+                if what is None:
+                    continue
+                if OFFLOADED_RE.search(m.line_text(node.lineno)):
+                    continue
+                self.findings["async-lock-blocking-await"].append(
+                    m.finding(
+                        node, "async-lock-blocking-await",
+                        f"awaiting {what} while holding asyncio lock "
+                        f"`{held}` in `async def {fn.qualname}` pins "
+                        f"the lock for the call's full wall time — "
+                        f"every task needing it queues behind this "
+                        f"one; copy what the call needs, release the "
+                        f"lock, then await",
+                    ))
+
+    @staticmethod
+    def _with_scope(stmt: ast.AsyncWith) -> Iterator[ast.AST]:
+        nested = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+        stack = list(stmt.body)
+        while stack:
+            node = stack.pop()
+            if isinstance(node, nested):
+                continue
+            yield node
+            stack.extend(ast.iter_child_nodes(node))
+
+    # -- coroutine-not-awaited --------------------------------------------
+
+    def _check_discarded(self, m: Module) -> None:
+        for node in ast.walk(m.tree):
+            if not (isinstance(node, ast.Expr)
+                    and isinstance(node.value, ast.Call)):
+                continue
+            cls = None
+            for anc in m.ancestors(node):
+                if isinstance(anc, ast.ClassDef):
+                    cls = anc.name
+                    break
+            key = self.graph.resolve_call(m, cls, node.value)
+            if key is None:
+                continue
+            callee = self.graph.functions[key]
+            if not callee.is_async:
+                continue
+            self.findings["coroutine-not-awaited"].append(m.finding(
+                node, "coroutine-not-awaited",
+                f"statement-position call of `async def "
+                f"{callee.qualname}` discards the coroutine — the body "
+                f"never runs; `await` it or hand it to "
+                f"`asyncio.create_task(...)`",
+            ))
+
+
+def loop_analysis(modules: list[Module]) -> _Analysis:
+    graph = build_graph(modules)
+    analysis = getattr(graph, "_loop_analysis", None)
+    if analysis is None:
+        analysis = _Analysis(graph)
+        graph._loop_analysis = analysis
+    return analysis
+
+
+class _LoopRule:
+    """Base: collect the module set, share one analysis per run."""
+
+    id = ""
+    doc = ""
+
+    def __init__(self):
+        self._modules: list[Module] = []
+
+    def collect(self, module: Module) -> None:
+        self._modules.append(module)
+
+    def finalize(self) -> Iterator[Finding]:
+        if not self._modules:
+            return
+        yield from loop_analysis(self._modules).findings[self.id]
+
+
+class BlockingInAsync(_LoopRule):
+    id = "blocking-in-async"
+    doc = ("blocking call (device sync, sleep, gRPC, file/PIL/"
+           "subprocess I/O, lock/future wait) reachable from an async "
+           "def — directly or through sync project helpers")
+
+
+class BlockingInStream(_LoopRule):
+    id = "blocking-in-stream"
+    doc = ("blocking call inside an async stream generator or `async "
+           "for` body — stalls every consumer between chunks")
+
+
+class AsyncLockBlockingAwait(_LoopRule):
+    id = "async-lock-blocking-await"
+    doc = ("asyncio.Lock held across an await of an executor offload "
+           "or a blocking-tainted async callee")
+
+
+class CoroutineNotAwaited(_LoopRule):
+    id = "coroutine-not-awaited"
+    doc = ("statement-position call of a project async def whose "
+           "coroutine is never awaited — the body never runs")

@@ -13,7 +13,7 @@ import difflib
 import re
 from typing import Iterator, Optional
 
-from tools.jaxlint.core import _LOOPS, _SCOPES, Finding, Module
+from tools.jaxlint.core import _LOOPS, _SCOPES, SUPPRESS_RE, Finding, Module
 
 
 # ---------------------------------------------------------------------------
@@ -504,9 +504,57 @@ class UnknownJaxConfig:
         return False
 
 
+# ---------------------------------------------------------------------------
+# unknown-suppression
+# ---------------------------------------------------------------------------
+
+class UnknownSuppression:
+    """``# jaxlint: disable=<id>`` naming a rule that does not exist.
+
+    A typo'd rule id suppresses nothing while *looking* like a waiver —
+    the finding it meant to silence still fires (confusing) or, worse,
+    the author believes dangerous code is covered when it never was.
+    """
+
+    id = "unknown-suppression"
+    doc = ("`# jaxlint: disable=<id>` with a rule id that does not "
+           "exist — the typo'd waiver silently suppresses nothing")
+
+    def _valid_ids(self) -> set:
+        return {r.id for r in ALL_RULES} | {"all", "parse-error"}
+
+    def check(self, module: Module) -> Iterator[Finding]:
+        valid = self._valid_ids()
+        for lineno, line in enumerate(module.lines, start=1):
+            m = SUPPRESS_RE.search(line)
+            if not m:
+                continue
+            for part in m.group(1).split(","):
+                rid = part.strip()
+                if not rid or rid in valid:
+                    continue
+                hint = ""
+                close = difflib.get_close_matches(rid, valid, n=1)
+                if close:
+                    hint = f" (did you mean '{close[0]}'?)"
+                yield Finding(
+                    file=module.path, line=lineno,
+                    col=max(0, line.find("#")), rule=self.id,
+                    message=f"'{rid}' is not a jaxlint rule id{hint}; "
+                            f"this waiver suppresses nothing",
+                    text=module.line_text(lineno),
+                )
+
+
 from tools.jaxlint.lockcheck import (  # noqa: E402
     BlockingUnderLock,
     LockGuardedAttr,
+)
+from tools.jaxlint.loopcheck import (  # noqa: E402
+    AsyncLockBlockingAwait,
+    BlockingInAsync,
+    BlockingInStream,
+    CoroutineNotAwaited,
 )
 from tools.jaxlint.metriccheck import MetricNameDrift  # noqa: E402
 from tools.jaxlint.shardcheck import (  # noqa: E402
@@ -521,13 +569,19 @@ ALL_RULES = [
     TracerControlFlow(),
     RngKeyReuse(),
     UnknownJaxConfig(),
-    # lockcheck (lock-discipline dataflow)
+    UnknownSuppression(),
+    # lockcheck (lock-discipline dataflow; call-graph-aware)
     LockGuardedAttr(),
     BlockingUnderLock(),
-    # shardcheck (mesh-spec validation)
+    # shardcheck (mesh-spec validation; call-graph-aware)
     MeshAxisSpec(),
     ShardMapArity(),
     HostSyncOnSharded(),
     # metriccheck (registry <-> reference drift; project-wide)
     MetricNameDrift(),
+    # loopcheck (event-loop blocking over the project call graph)
+    BlockingInAsync(),
+    BlockingInStream(),
+    AsyncLockBlockingAwait(),
+    CoroutineNotAwaited(),
 ]

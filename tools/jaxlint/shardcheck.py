@@ -203,6 +203,12 @@ class HostSyncOnSharded:
     ``shard_map`` (or placed with a NamedSharding) gathers every shard
     through one host — on a real mesh that is an all-device sync plus a
     full-array device→host copy on the hot path.
+
+    A ProjectRule since the loopcheck PR: a local assigned from a
+    project function that *returns* a sharded value (directly or
+    transitively — the call graph tracks it) counts as sharded too, so
+    ``out = build_sharded(x)`` one helper away no longer hides the
+    gather.
     """
 
     id = "host-sync-on-sharded"
@@ -213,15 +219,32 @@ class HostSyncOnSharded:
         r"\b(shard_map\s*\(|NamedSharding\s*\(|device_put\s*\(.*"
         r"(named\s*\(|NamedSharding\s*\(|P\s*\())")
 
-    def check(self, module: Module) -> Iterator[Finding]:
-        if Path(module.path).name.startswith(("test_", "conftest")):
-            return  # tests gather sharded outputs on purpose (parity)
-        scopes = [module.tree] + [
-            n for n in ast.walk(module.tree)
-            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
-        ]
-        for scope in scopes:
-            yield from self._check_scope(module, scope)
+    def __init__(self):
+        self._modules: list[Module] = []
+
+    def collect(self, module: Module) -> None:
+        self._modules.append(module)
+
+    def finalize(self) -> Iterator[Finding]:
+        from tools.jaxlint.callgraph import build_graph
+
+        graph = build_graph(self._modules)
+        for module in self._modules:
+            if Path(module.path).name.startswith(("test_", "conftest")):
+                continue  # tests gather sharded outputs on purpose
+            scopes = [module.tree] + [
+                n for n in ast.walk(module.tree)
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+            ]
+            for scope in scopes:
+                yield from self._check_scope(module, scope, graph)
+
+    @staticmethod
+    def _scope_cls(module: Module, scope) -> Optional[str]:
+        for anc in module.ancestors(scope):
+            if isinstance(anc, ast.ClassDef):
+                return anc.name
+        return None
 
     @staticmethod
     def _scope_nodes(scope):
@@ -236,7 +259,8 @@ class HostSyncOnSharded:
             yield node
             stack.extend(ast.iter_child_nodes(node))
 
-    def _check_scope(self, module, scope) -> Iterator[Finding]:
+    def _check_scope(self, module, scope, graph) -> Iterator[Finding]:
+        cls = self._scope_cls(module, scope)
         sharded: set[str] = set()
         for node in self._scope_nodes(scope):
             if isinstance(node, ast.Assign):
@@ -244,7 +268,19 @@ class HostSyncOnSharded:
                     src = ast.unparse(node.value)
                 except Exception:
                     continue
-                if self.SHARDED_SRC.search(src):
+                produced = bool(self.SHARDED_SRC.search(src))
+                if not produced:
+                    # a call (possibly `f(...)(x)`) whose project callee
+                    # returns a sharded value — helper indirection
+                    call = node.value
+                    if (isinstance(call, ast.Call)
+                            and isinstance(call.func, ast.Call)):
+                        call = call.func
+                    if isinstance(call, ast.Call):
+                        key = graph.resolve_call(module, cls, call)
+                        produced = (key is not None
+                                    and graph.returns_sharded(key))
+                if produced:
                     for t in node.targets:
                         elts = (t.elts if isinstance(t, (ast.Tuple,
                                                          ast.List))

@@ -187,6 +187,73 @@ class EngineSink:
         return self.sm.runner.embed(self.sm.tokenizer.encode(text))
 
 
+class _HttpChatHandle:
+    """Handle-shaped view of one in-flight HTTP chat POST: a worker
+    thread owns the request; ``result()`` joins it (the GenHandle
+    surface LoadGen expects)."""
+
+    def __init__(self):
+        self.finish_reason: Optional[str] = None
+        self._text = ""
+        self._error: Optional[str] = None
+        self._done = threading.Event()
+
+    def result(self, timeout: Optional[float] = None) -> str:
+        if not self._done.wait(timeout):
+            raise TimeoutError("HTTP chat request did not complete")
+        if self._error is not None:
+            raise RuntimeError(self._error)
+        return self._text
+
+
+class HttpSink:
+    """LoadGen sink over a LIVE HTTP API: each ``chat()`` POSTs
+    ``/v1/chat/completions`` from its own worker thread (the arrival
+    process never blocks on a response), returning a handle whose
+    ``result()`` joins the POST. No embedding surface — the kind mix
+    renormalizes to chat+batch, and ``background`` traffic shares the
+    endpoint (HTTP carries no lane flag; lane QoS belongs to the engine
+    sink). Used by ``telemetry_smoke --loopsan`` so the event-loop
+    sanitizer sees real aiohttp handler dispatch, not in-process
+    scheduler calls."""
+
+    def __init__(self, base_url: str, model: str, *,
+                 max_tokens: int = 8, timeout: float = 120.0):
+        import httpx
+
+        self._client = httpx.Client(base_url=base_url, timeout=timeout)
+        self.model = model
+        self.max_tokens = max_tokens
+
+    def chat(self, text: str, *, tenant: str = "default",
+             trace_id: str = "", background: bool = False):
+        h = _HttpChatHandle()
+
+        def post():
+            try:
+                r = self._client.post("/v1/chat/completions", json={
+                    "model": self.model, "max_tokens": self.max_tokens,
+                    "temperature": 0.0,
+                    "messages": [{"role": "user", "content": text}],
+                })
+                r.raise_for_status()
+                choice = r.json()["choices"][0]
+                h.finish_reason = choice.get("finish_reason")
+                h._text = choice["message"].get("content") or ""
+            except Exception as e:  # noqa: BLE001 — surfaced via result()
+                h._error = f"{tenant}/{trace_id}: {e}"
+                h.finish_reason = "exception"
+            finally:
+                h._done.set()
+
+        threading.Thread(target=post, daemon=True,
+                         name=f"loadgen-http-{trace_id}").start()
+        return h
+
+    def close(self) -> None:
+        self._client.close()
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("--total", type=int, default=32)

@@ -1,0 +1,238 @@
+"""loopsan: a runtime event-loop stall sanitizer.
+
+The static loopcheck pass (tools/jaxlint) reasons over the project call
+graph; it cannot see blocking behind attribute-of-attribute receivers,
+dynamic dispatch, or third-party internals. This harness sees exactly
+that: :class:`LoopSanitizer` wraps asyncio's callback dispatch
+(``Handle._run`` — every task step and ``call_soon`` callback on every
+loop goes through it) and records per-callback wall time with the
+owning task/handler name. Any callback that holds the loop longer than
+the threshold (default 50 ms — at 8 concurrent SSE streams that is a
+visible hiccup on every one of them) is reported as a *stall*, with the
+mid-stall Python stack captured by a sampler thread so the report names
+the blocking line, not just the handler.
+
+The pairing mirrors racecheck (static lockcheck + runtime LockMonitor,
+PR 9): CI drives the full 2-replica fleet + loadgen lifecycle under it
+(``python -m tools.telemetry_smoke --loopsan``) and fails on any stall.
+For a demonstration of what a report looks like:
+
+    python tools/loopsan.py --demo
+"""
+
+from __future__ import annotations
+
+import asyncio
+import asyncio.events
+import sys
+import threading
+import time
+import traceback
+
+# the genuine dispatch, captured before any sanitizer patches it —
+# TimerHandle inherits it, so timer callbacks are covered too
+_REAL_HANDLE_RUN = asyncio.events.Handle._run
+
+
+def _label(handle) -> str:
+    """Owning task/handler name for a dispatched handle. A task step's
+    callback is the bound ``Task.__step`` — name the task and its coro;
+    anything else is a plain ``call_soon``/timer callback."""
+    cb = getattr(handle, "_callback", None)
+    owner = getattr(cb, "__self__", None)
+    if isinstance(owner, asyncio.Task):
+        coro = owner.get_coro()
+        qn = getattr(coro, "__qualname__", None) or repr(coro)
+        return f"task {owner.get_name()} ({qn})"
+    qn = getattr(cb, "__qualname__", None) or repr(cb)
+    return f"callback {qn}"
+
+
+def _format_frame_stack(frame, limit: int) -> list[str]:
+    frames = traceback.extract_stack(frame, limit=limit)
+    return [f"{fr.filename.rsplit('/', 1)[-1]}:{fr.lineno} in {fr.name}"
+            for fr in frames]
+
+
+class Stall:
+    """One callback that held the event loop past the threshold."""
+
+    def __init__(self, label: str, duration_ms: float,
+                 stack: list[str]):
+        self.label = label
+        self.duration_ms = duration_ms
+        self.stack = stack
+
+    def render(self) -> str:
+        out = [f"{self.duration_ms:8.1f} ms  {self.label}"]
+        out.extend(f"    {line}" for line in self.stack)
+        return "\n".join(out)
+
+    def to_dict(self) -> dict:
+        return {"label": self.label,
+                "duration_ms": round(self.duration_ms, 2),
+                "stack": list(self.stack)}
+
+
+class LoopSanitizer:
+    """Process-wide event-loop stall detector.
+
+    ``install()`` patches ``Handle._run``; every loop in the process
+    (on any thread) is covered from that moment. A daemon sampler
+    thread polls the in-flight dispatch table and snapshots the running
+    thread's Python stack once a callback crosses the threshold — the
+    stack is captured MID-stall, pointing at the blocking call itself.
+    Short of the sampler's poll period (a stall that finishes between
+    polls), the report still carries the duration and owner, just
+    without a stack.
+    """
+
+    def __init__(self, threshold_ms: float = 50.0,
+                 poll_ms: float = 5.0, stack_limit: int = 14):
+        self.threshold_ms = float(threshold_ms)
+        self.poll_ms = float(poll_ms)
+        self.stack_limit = stack_limit
+        self._meta = threading.Lock()
+        # thread id -> stack of [handle, t0, sampled_stack|None]
+        # (a stack, not a single slot: run_until_complete inside a
+        # callback re-enters dispatch on the same thread)
+        self._active: dict[int, list[list]] = {}
+        self._stalls: list[Stall] = []
+        self._installed = False
+        self._stop = threading.Event()
+        self._sampler: threading.Thread | None = None
+        self.callbacks_seen = 0
+
+    # -- patching ----------------------------------------------------------
+
+    def install(self) -> "LoopSanitizer":
+        if self._installed:
+            return self
+        san = self
+
+        def _run(handle):
+            tid = threading.get_ident()
+            entry = [handle, time.perf_counter(), None]
+            with san._meta:
+                san.callbacks_seen += 1
+                san._active.setdefault(tid, []).append(entry)
+            try:
+                return _REAL_HANDLE_RUN(handle)
+            finally:
+                dt_ms = (time.perf_counter() - entry[1]) * 1000.0
+                with san._meta:
+                    stack = san._active.get(tid)
+                    if stack and stack[-1] is entry:
+                        stack.pop()
+                if dt_ms >= san.threshold_ms:
+                    san._note_stall(handle, dt_ms, entry[2])
+
+        asyncio.events.Handle._run = _run  # type: ignore[method-assign]
+        self._stop.clear()
+        self._sampler = threading.Thread(
+            target=self._sample_loop, name="loopsan-sampler", daemon=True)
+        self._sampler.start()
+        self._installed = True
+        return self
+
+    def uninstall(self) -> None:
+        """Restore the real dispatch and stop the sampler. Stalls
+        recorded so far stay available for report()."""
+        if not self._installed:
+            return
+        asyncio.events.Handle._run = _REAL_HANDLE_RUN  # type: ignore
+        self._stop.set()
+        if self._sampler is not None:
+            self._sampler.join(timeout=2.0)
+            self._sampler = None
+        self._installed = False
+
+    def __enter__(self) -> "LoopSanitizer":
+        return self.install()
+
+    def __exit__(self, *exc) -> None:
+        self.uninstall()
+
+    # -- sampler -----------------------------------------------------------
+
+    def _sample_loop(self) -> None:
+        while not self._stop.wait(self.poll_ms / 1000.0):
+            now = time.perf_counter()
+            with self._meta:
+                pending = [(tid, stack[-1])
+                           for tid, stack in self._active.items() if stack]
+            for tid, entry in pending:
+                if entry[2] is not None:
+                    continue
+                if (now - entry[1]) * 1000.0 < self.threshold_ms:
+                    continue
+                frame = sys._current_frames().get(tid)
+                if frame is not None:
+                    # formatted outside _meta: extract_stack reads source
+                    entry[2] = _format_frame_stack(frame, self.stack_limit)
+
+    # -- recording / analysis ----------------------------------------------
+
+    def _note_stall(self, handle, dt_ms: float, stack) -> None:
+        if stack is None:
+            stack = ["<stall shorter than a sampler poll; "
+                     "no mid-stall stack captured>"]
+        s = Stall(_label(handle), dt_ms, stack)
+        with self._meta:
+            self._stalls.append(s)
+
+    def stalls(self) -> list[Stall]:
+        with self._meta:
+            return list(self._stalls)
+
+    def reset(self) -> None:
+        """Drop recorded stalls/counters (e.g. after a deliberate
+        self-check stall) without disturbing the installed patch."""
+        with self._meta:
+            self._stalls.clear()
+            self.callbacks_seen = 0
+
+    def report(self) -> str:
+        stalls = self.stalls()
+        head = (f"loopsan: {self.callbacks_seen} callbacks dispatched, "
+                f"{len(stalls)} stall(s) >= {self.threshold_ms:g} ms")
+        if not stalls:
+            return head
+        return "\n".join([head, ""] + [s.render() for s in stalls])
+
+    def snapshot(self) -> dict:
+        return {
+            "threshold_ms": self.threshold_ms,
+            "callbacks_seen": self.callbacks_seen,
+            "stalls": [s.to_dict() for s in self.stalls()],
+        }
+
+
+# -- CLI demo ---------------------------------------------------------------
+
+def _demo() -> int:
+    """Provoke a textbook loop stall (time.sleep in an async handler)
+    next to a clean awaited workload, and print the report (this is
+    what a failing CI loopsan step looks like)."""
+    san = LoopSanitizer(threshold_ms=50.0)
+
+    async def blocking_handler():
+        time.sleep(0.2)     # the bug: sync sleep on the event loop
+
+    async def clean_handler():
+        await asyncio.sleep(0.05)   # yields: never holds the loop
+
+    async def main():
+        await asyncio.gather(clean_handler(), blocking_handler())
+
+    with san:
+        asyncio.run(main())
+    print(san.report())
+    return 1 if san.stalls() else 0
+
+
+if __name__ == "__main__":
+    if "--demo" in sys.argv:
+        sys.exit(_demo())
+    print(__doc__)
+    sys.exit(0)

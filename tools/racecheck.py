@@ -91,6 +91,13 @@ class _TracedLock:
     def locked(self) -> bool:
         return self._lock.locked()
 
+    def _at_fork_reinit(self) -> None:
+        # concurrent.futures.thread dereferences this at IMPORT time
+        # (os.register_at_fork(after_in_child=lock._at_fork_reinit)) —
+        # without the delegation a lazy ThreadPoolExecutor import while
+        # the monitor is installed dies with AttributeError
+        self._lock._at_fork_reinit()
+
     def __repr__(self) -> str:
         return f"<traced {type(self).__name__} @ {self.site[0]}:{self.site[1]}>"
 

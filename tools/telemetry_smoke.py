@@ -46,7 +46,18 @@ generations through the continuous-batching scheduler, then:
      (``--profile-dir``) with its triggering trace id — while a second
      stall inside the cooldown does NOT capture;
 
-  8. under ``--racecheck``, runs the WHOLE lifecycle above with
+  8. under ``--loopsan``, boots the REAL aiohttp API tier over a
+     2-replica in-process fleet of the tiny model and runs it under
+     ``tools.loopsan``'s event-loop stall sanitizer: first a deliberate
+     ``time.sleep(0.2)`` injected onto the loop must be caught (the
+     sanitizer's own self-check — a detector that can't see a 200 ms
+     stall proves nothing), then mixed ``tools.loadgen`` HTTP traffic
+     plus one live SSE stream must complete with ZERO callbacks holding
+     the loop ≥ 50 ms — the runtime proof that the API layer's executor
+     offloads (the static loopcheck contract) actually hold under load.
+     The stall report lands in ``--loopsan-out`` (a CI artifact);
+
+  9. under ``--racecheck``, runs the WHOLE lifecycle above with
      ``tools.racecheck``'s instrumented locks installed (every
      ``threading.Lock``/``RLock`` the serving stack creates records its
      acquisition ordering) and fails if the observed lock-order graph
@@ -58,6 +69,8 @@ Usage:  python -m tools.telemetry_smoke [--out telemetry_summary.json]
                                         [--flight-out flight_snapshot.json]
                                         [--batch-out batch_result.jsonl]
                                         [--racecheck]
+                                        [--loopsan]
+                                        [--loopsan-out loopsan_report.json]
 """
 
 from __future__ import annotations
@@ -512,6 +525,190 @@ def check_anomaly_capture(registry, profile_dir: str) -> list[str]:
     return problems
 
 
+# the fleet-served model for the --loopsan phase: NO embeddings usecase
+# (embeddings-capable models keep the single-engine path — manager._load),
+# so with fleet_replicas=2 this serves from a 2-replica in-process fleet
+LOOPSAN_YAML = """\
+name: fleet-http
+model: "debug:tiny"
+context_size: 96
+parameters:
+  temperature: 0.0
+  max_tokens: 8
+engine:
+  max_slots: 2
+  prefill_buckets: [16, 32]
+  dtype: float32
+  kv_dtype: float32
+"""
+
+
+def check_loopsan(loopsan_out: str) -> list[str]:
+    """Round-16 event-loop sanitizer: boot the real aiohttp API over a
+    2-replica in-process fleet, install ``tools.loopsan``, prove the
+    detector catches a deliberately injected ``time.sleep(0.2)`` on the
+    loop, reset, then drive mixed loadgen HTTP traffic plus one SSE
+    stream and require ZERO ≥ 50 ms stalls. The earlier phases run the
+    engine/fleet stack on plain threads — the event loop only exists in
+    the API tier, so this phase is where the sanitizer has something to
+    watch."""
+    import asyncio
+    import json as jsonlib
+    import tempfile
+    import threading
+    from pathlib import Path
+
+    import httpx
+
+    from localai_tpu.api.server import AppState, create_app
+    from localai_tpu.config.app_config import AppConfig
+    from localai_tpu.config.loader import ConfigLoader
+    from tools.loadgen import HttpSink, LoadGen, Tenant
+    from tools.loopsan import LoopSanitizer
+
+    problems: list[str] = []
+    selfcheck: dict = {}
+    with tempfile.TemporaryDirectory() as tmp:
+        models = Path(tmp) / "models"
+        models.mkdir()
+        (models / "fleet-http.yaml").write_text(LOOPSAN_YAML)
+        cfg = AppConfig(
+            model_path=str(models),
+            upload_path=str(Path(tmp) / "uploads"),
+            config_path=str(Path(tmp) / "conf"),
+            fleet_replicas=2, fleet_backend="inprocess",
+        )
+        loader = ConfigLoader(models)
+        loader.load_from_path(context_size=cfg.context_size)
+        state = AppState(cfg, loader)
+
+        boot: dict = {}
+        started = threading.Event()
+
+        def serve():
+            from aiohttp import web
+
+            loop = asyncio.new_event_loop()
+            asyncio.set_event_loop(loop)
+            boot["loop"] = loop
+
+            async def up():
+                app = create_app(state)
+                runner = web.AppRunner(app)
+                await runner.setup()
+                site = web.TCPSite(runner, "127.0.0.1", 0)
+                await site.start()
+                boot["port"] = runner.addresses[0][1]
+                boot["runner"] = runner
+                started.set()
+
+            loop.run_until_complete(up())
+            loop.run_forever()
+
+        th = threading.Thread(target=serve, daemon=True, name="loopsan-api")
+        th.start()
+        if not started.wait(60):
+            return ["loopsan: API server failed to start"]
+        base = f"http://127.0.0.1:{boot['port']}"
+        loop = boot["loop"]
+
+        def chat_body(text, **extra):
+            return {"model": "fleet-http", "max_tokens": 6,
+                    "temperature": 0.0,
+                    "messages": [{"role": "user", "content": text}],
+                    **extra}
+
+        try:
+            # warm up BEFORE the sanitizer installs: the first request
+            # builds both fleet replicas (jit compile in executor
+            # threads); measuring loop health while compiles monopolize
+            # CPU would report scheduler noise, not handler stalls
+            with httpx.Client(base_url=base, timeout=300.0) as c:
+                r = c.post("/v1/chat/completions",
+                           json=chat_body("loopsan warmup"))
+                if r.status_code != 200:
+                    return [f"loopsan: warmup request failed "
+                            f"{r.status_code}: {r.text[:200]}"]
+
+            san = LoopSanitizer(threshold_ms=50.0)
+            san.install()
+            try:
+                # self-check: a sync sleep dispatched onto the live loop
+                # is EXACTLY the bug class the sanitizer exists for — it
+                # must be caught before a clean run means anything
+                loop.call_soon_threadsafe(time.sleep, 0.2)
+                deadline = time.monotonic() + 10.0
+                while not san.stalls() and time.monotonic() < deadline:
+                    time.sleep(0.02)
+                injected = san.stalls()
+                if len(injected) != 1:
+                    problems.append(
+                        f"loopsan self-check: injected 200 ms sleep "
+                        f"produced {len(injected)} stall(s), expected 1")
+                else:
+                    s = injected[0]
+                    if "sleep" not in s.label or s.duration_ms < 150.0:
+                        problems.append(
+                            f"loopsan self-check: stall misattributed: "
+                            f"{s.label} ({s.duration_ms:.1f} ms)")
+                    selfcheck = s.to_dict()
+                san.reset()
+
+                sink = HttpSink(base, "fleet-http", max_tokens=6)
+                try:
+                    gen = LoadGen(mix={"chat": 0.7, "batch": 0.3},
+                                  tenants=[Tenant("free", 3),
+                                           Tenant("pro", 1)],
+                                  rate=12.0, seed=5, max_tokens=6)
+                    summary = gen.run(sink, total=10)
+                finally:
+                    sink.close()
+                bad = {r: n for r, n in summary["outcomes"].items()
+                       if r not in ("stop", "length")}
+                if bad or summary["errors"]:
+                    problems.append(f"loopsan: HTTP traffic failed: "
+                                    f"{bad} {summary['errors']}")
+                # one live SSE stream: the chunked writer must yield
+                # between deltas, never hold the loop for a whole reply
+                events = []
+                with httpx.Client(base_url=base, timeout=120.0) as c:
+                    with c.stream(
+                            "POST", "/v1/chat/completions",
+                            json=chat_body("stream smoke", stream=True),
+                    ) as resp:
+                        status = resp.status_code
+                        for line in resp.iter_lines():
+                            if line.startswith("data: "):
+                                events.append(line)
+                if status != 200 or len(events) < 2:
+                    problems.append(f"loopsan: SSE stream broke: status "
+                                    f"{status}, {len(events)} events")
+                stalls = san.stalls()
+                snap = san.snapshot()
+            finally:
+                san.uninstall()
+        finally:
+            fut = asyncio.run_coroutine_threadsafe(
+                boot["runner"].cleanup(), loop)
+            fut.result(30)
+            loop.call_soon_threadsafe(loop.stop)
+            th.join(15)
+
+    if snap["callbacks_seen"] == 0:
+        problems.append("loopsan: sanitizer observed no loop callbacks — "
+                        "the Handle._run patch is not active")
+    snap["injected_selfcheck"] = selfcheck
+    with open(loopsan_out, "w") as f:
+        jsonlib.dump(snap, f, indent=2, sort_keys=True)
+    if stalls:
+        print(san.report())
+        problems.append(
+            f"loopsan: {len(stalls)} event-loop stall(s) >= "
+            f"{san.threshold_ms:g} ms during the fleet HTTP lifecycle "
+            f"(report → {loopsan_out})")
+    return problems
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("--out", default="telemetry_summary.json")
@@ -527,6 +724,11 @@ def main(argv=None) -> int:
         "--racecheck", action="store_true",
         help="run the lifecycle under tools.racecheck instrumented locks "
              "and fail on any observed lock-order inversion")
+    parser.add_argument(
+        "--loopsan", action="store_true",
+        help="boot the real HTTP API over a 2-replica fleet under "
+             "tools.loopsan and fail on any event-loop stall >= 50 ms")
+    parser.add_argument("--loopsan-out", default="loopsan_report.json")
     args = parser.parse_args(argv)
 
     monitor = None
@@ -585,6 +787,8 @@ def main(argv=None) -> int:
         problems += check_fleet(REGISTRY)
         problems += check_fleetview(REGISTRY, args.fleet_flight_out)
         problems += check_anomaly_capture(REGISTRY, args.profile_dir)
+        if args.loopsan:
+            problems += check_loopsan(args.loopsan_out)
         # scrape-time trace-ring sizing receipt, exactly what GET /metrics
         # exports (LOCALAI_TRACE_CAPACITY satellite)
         from localai_tpu.obs.trace import STORE as TRACE_STORE
@@ -678,7 +882,8 @@ def main(argv=None) -> int:
           f"flight ring → {args.flight_out}, "
           f"batch result → {args.batch_out}, "
           f"fleet flight → {args.fleet_flight_out}, "
-          f"profiles → {args.profile_dir}/manifest.json")
+          f"profiles → {args.profile_dir}/manifest.json"
+          + (f", loopsan → {args.loopsan_out}" if args.loopsan else ""))
     print(f"    ttft mean {summary['ttft']['mean_ms']}ms  "
           f"tpot mean {summary['tpot']['mean_ms']}ms  "
           f"over {len(ttfts)} requests; "
