@@ -226,17 +226,35 @@ async def kv(request: web.Request) -> web.Response:
         # executor-side, like every other debug-pane builder here
         models = {}
         for name, sm in state.manager.loaded_snapshot().items():
+            sched = getattr(sm, "scheduler", None)
             alloc = getattr(getattr(sm, "runner", None), "allocator", None)
             if alloc is None:
+                # fleet facades have no local allocator, but their KV
+                # economy plane (prefix directory + sibling/migration
+                # counters) is this endpoint's business too
+                directory = getattr(sched, "directory", None)
+                if directory is not None:
+                    models[name] = {
+                        "directory": directory.stats(),
+                        "sibling_transfers": sched.sibling_transfers,
+                        "sibling_fallbacks": sched.sibling_fallbacks,
+                        "migrations": sched.migrations,
+                        "migration_fallbacks": sched.migration_fallbacks,
+                    }
+                    # host-tier roll-up across replicas rides the same
+                    # metrics pane the /metrics scrape reads
+                    m = sched.metrics()
+                    if "kv_tier_spills" in m:
+                        models[name]["tier"] = {
+                            "blocks": m.get("kv_tier_blocks", 0),
+                            "bytes": m.get("kv_tier_bytes", 0),
+                            "spills_total": m.get("kv_tier_spills", 0),
+                            "reloads_total": m.get("kv_tier_reloads", 0),
+                        }
                 continue  # contiguous / worker-backed / non-LLM engines
-            st = alloc.stats()
-            sched = getattr(sm, "scheduler", None)
             models[name] = {
                 "block_tokens": alloc.block_tokens,
-                "blocks": {
-                    "total": st.total, "free": st.free, "used": st.used,
-                    "cached": st.cached, "watermark": st.high_watermark,
-                },
+                "blocks": {},
                 "tables": {str(s): n
                            for s, n in alloc.tables_snapshot().items()},
                 "shared_tokens_total": alloc.shared_tokens_total,
@@ -245,6 +263,14 @@ async def kv(request: web.Request) -> web.Response:
                 "violations_seen": getattr(
                     sched, "kv_invariant_violations", 0),
             }
+            st = alloc.stats()
+            models[name]["blocks"] = {
+                "total": st.total, "free": st.free, "used": st.used,
+                "cached": st.cached, "watermark": st.high_watermark,
+            }
+            ts = alloc.tier_stats()
+            if ts is not None:
+                models[name]["tier"] = ts
         return models
 
     return web.json_response(

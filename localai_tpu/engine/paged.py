@@ -116,6 +116,16 @@ class BlockAllocator:
         # lifetime counters (telemetry)
         self.shared_tokens_total = 0
         self.evictions_total = 0
+        # optional HBM→host spill tier under the prefix pool (fleet.
+        # kveconomy.tiering.HostTier, attached by the runner): LRU pool
+        # evictions pack their rows to host RAM instead of vanishing,
+        # and a chain-walk miss re-onboards them. The allocator stays
+        # device-blind — pack/load are runner callbacks.
+        self._tier = None
+        self._tier_pack = None
+        self._tier_load = None
+        self.spills_total = 0
+        self.reloads_total = 0
 
     # -- sizing -----------------------------------------------------------
 
@@ -126,6 +136,77 @@ class BlockAllocator:
         """Prefix-pool blocks held only by the pool (evictable). Caller
         holds the lock."""
         return sum(1 for b in self._prefix.values() if self._ref[b] == 1)
+
+    # -- HBM→host tiering -------------------------------------------------
+
+    def attach_tier(self, tier, *, pack, load) -> None:
+        """Wire the host-RAM spill tier under the prefix pool.
+
+        ``pack(bid) -> payload dict`` gathers one pool block's raw rows
+        to host numpy; ``load(bid, payload)`` scatters them back —
+        both are runner-owned so this module never touches the device.
+        Call before serving starts (engine-thread mutation discipline
+        applies once traffic flows)."""
+        with self._lock:
+            self._tier = tier
+            self._tier_pack = pack
+            self._tier_load = load
+
+    def _spill(self, key: str, bid: int) -> None:  # jaxlint: guarded-by(_lock)
+        """Best-effort park of an evicted pool block in the host tier.
+        Caller holds the lock; the device gather is the price of not
+        losing host-RAM-sized cache capacity — eviction is already the
+        slow path."""
+        try:
+            payload = self._tier_pack(bid)  # jaxlint: disable=blocking-under-lock
+            if payload is not None and self._tier.put(key, payload):
+                self.spills_total += 1
+        except Exception:  # noqa: BLE001 — a failed spill is a plain evict
+            pass
+
+    def _reload(self, key: str,
+                exclude: list[int]) -> Optional[int]:  # jaxlint: guarded-by(_lock)
+        """Re-onboard a spilled chain block into a free (or freshly
+        evicted) pool block; returns its id as a pool-referenced prefix
+        entry, or None. Caller holds the lock. ``exclude`` protects
+        blocks already matched this walk from being picked as eviction
+        victims (they carry only the pool reference until allocate()
+        pins them)."""
+        if not self._tier.contains(key):
+            return None
+        if self._free:
+            bid = self._free.pop()
+        else:
+            bid = self._evict_one(exclude=exclude)
+            if bid is None:
+                return None
+        payload = self._tier.take(key)
+        if payload is None:  # raced away (budget churn)
+            self._free.append(bid)
+            return None
+        try:
+            self._tier_load(bid, payload)  # jaxlint: disable=blocking-under-lock
+        except Exception:  # noqa: BLE001 — corrupt spill = miss, not error
+            self._free.append(bid)
+            return None
+        self._prefix[key] = bid
+        self._block_key[bid] = key
+        self._ref[bid] = 1
+        self.reloads_total += 1
+        return bid
+
+    def tier_stats(self) -> Optional[dict]:
+        """The spill tier's accounting pane (None when tiering is off)."""
+        with self._lock:
+            tier = self._tier
+            spills = self.spills_total
+            reloads = self.reloads_total
+        if tier is None:
+            return None
+        s = tier.stats()
+        s["spills_total"] = spills
+        s["reloads_total"] = reloads
+        return s
 
     # -- prefix sharing ---------------------------------------------------
 
@@ -155,6 +236,10 @@ class BlockAllocator:
         with self._lock:
             for key in self._chain(prompt, nb, bt):
                 bid = self._prefix.get(key)
+                if bid is None and self._tier is not None:
+                    # HBM miss, maybe a host-RAM hit: re-onboard the
+                    # spilled block and keep walking the chain
+                    bid = self._reload(key, exclude=out)
                 if bid is None:
                     break
                 out.append(bid)
@@ -184,19 +269,32 @@ class BlockAllocator:
                 self._block_key[bid] = key
                 self._ref[bid] += 1
                 added += 1
+                if self._tier is not None:
+                    # this chain just re-materialized in HBM from a fresh
+                    # prefill — any spilled copy is now stale (a block is
+                    # HBM-resident XOR spilled, audited by
+                    # check_invariants)
+                    self._tier.discard(key)
         return added
 
-    def _evict_one(self) -> Optional[int]:  # jaxlint: guarded-by(_lock)
+    def _evict_one(self, exclude: Optional[list[int]] = None,
+                   ) -> Optional[int]:  # jaxlint: guarded-by(_lock)
         """Drop the LRU pool-only block; returns its id. Caller holds the
-        lock."""
+        lock. With a tier attached the victim's rows spill to host RAM
+        first (best effort). ``exclude`` shields blocks a concurrent
+        chain walk already claimed (pool-ref-only until allocate pins
+        them) from victim selection."""
+        shielded = set(exclude or ())
         victim = next((k for k, b in self._prefix.items()
-                       if self._ref[b] == 1), None)
+                       if self._ref[b] == 1 and b not in shielded), None)
         if victim is None:
             return None
         bid = self._prefix.pop(victim)
         del self._block_key[bid]
         self._ref[bid] = 0
         self.evictions_total += 1
+        if self._tier is not None:
+            self._spill(victim, bid)
         return bid
 
     # -- allocate / release ----------------------------------------------
@@ -417,6 +515,24 @@ class BlockAllocator:
                         f"block {bid}")
             if len(self._block_key) != len(self._prefix):
                 problems.append("block-key index size != prefix pool size")
+            if self._tier is not None:
+                # tier residency: a chain lives in the HBM pool XOR the
+                # host tier — double residency means a reload forgot to
+                # consume the spill (stale host rows would shadow newer
+                # HBM contents on the next churn cycle)
+                hbm_keys = set(self._prefix)
+                for key in self._tier.keys():
+                    if key in hbm_keys:
+                        problems.append(
+                            f"chain {key[:12]}… resident in the HBM pool "
+                            "AND spilled to the host tier")
+                # host-side accounting under the tier's own fine lock,
+                # not a device/RPC round-trip
+                ts = self._tier.stats()  # jaxlint: disable=blocking-under-lock
+                if ts["bytes"] > ts["budget_bytes"]:
+                    problems.append(
+                        f"host tier over budget: {ts['bytes']} bytes "
+                        f"held vs {ts['budget_bytes']} budgeted")
             # conservation, derived INDEPENDENTLY of stats() (whose
             # ``used`` is total - free - cached by construction): every
             # live block must be reachable from a table or the prefix

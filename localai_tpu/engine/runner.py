@@ -490,6 +490,18 @@ class ModelRunner:
                 cfg, self.allocator.num_blocks, self.block_tokens,
                 self.kv_dtype, sharding=self._paged_sharding,
             )
+            # HBM→host prefix-pool tiering (LOCALAI_KV_TIER_MB, off by
+            # default): LRU pool evictions spill their raw block rows to
+            # host RAM and re-onboard on a later chain hit. Rebuilt with
+            # the allocator on every reinit — a rebuilt pool starts cold,
+            # and stale spills from the pre-wedge cache must not shadow
+            # it (lazy import: fleet.kveconomy is runtime-only here).
+            from localai_tpu.fleet.kveconomy.tiering import tier_from_env
+
+            tier = tier_from_env()
+            if tier is not None:
+                self.allocator.attach_tier(
+                    tier, pack=self.pack_block, load=self.load_block)
         else:
             self.kv = kvc.init_cache(
                 cfg, self.num_slots, self.max_ctx, self.kv_dtype,
@@ -1755,6 +1767,35 @@ class ModelRunner:
         return int(self.slot_positions()[slot])
 
     # -- prompt-cache persistence (engine.promptcache) -------------------
+
+    def pack_block(self, bid: int) -> Optional[dict]:
+        """One pool block's raw rows as host numpy — the HBM→host spill
+        payload (BlockAllocator tiering). Rows keep the pool dtype
+        byte-exact: bf16 stays bf16, int4 stays nibble-packed (half the
+        f32 bytes), so spill→reload is an identity round-trip."""
+        if not self.paged:
+            return None
+        kv = self.kv
+        out = {"k": np.asarray(kv.k[:, bid]), "v": np.asarray(kv.v[:, bid])}
+        if kv.quantized:
+            out["k_scale"] = np.asarray(kv.k_scale[:, bid])
+            out["v_scale"] = np.asarray(kv.v_scale[:, bid])
+        return out
+
+    def load_block(self, bid: int, payload: dict) -> None:
+        """Scatter a spilled block's rows back into pool block ``bid``
+        (tier re-onboarding; inverse of :meth:`pack_block`)."""
+        kv = self.kv
+        new = {
+            "k": kv.k.at[:, bid].set(jnp.asarray(payload["k"], kv.k.dtype)),
+            "v": kv.v.at[:, bid].set(jnp.asarray(payload["v"], kv.v.dtype)),
+        }
+        if kv.quantized:
+            new["k_scale"] = kv.k_scale.at[:, bid].set(
+                jnp.asarray(payload["k_scale"], jnp.float32))
+            new["v_scale"] = kv.v_scale.at[:, bid].set(
+                jnp.asarray(payload["v_scale"], jnp.float32))
+        self.kv = kvc.PagedKVCache(**new)
 
     def snapshot_prefix(self, slot: int, n: Optional[int] = None) -> dict:
         """Device-array snapshot of one slot's first ``n`` KV rows.

@@ -141,6 +141,11 @@ class GenHandle:
         self.t_done: Optional[float] = None
         # lifecycle trace (obs.RequestTrace), attached by the scheduler
         self.trace = None
+        # live-migration export flag (fleet.kveconomy): a migration
+        # cancels the request but still needs its prompt+generation KV
+        # snapshotted into the prompt cache at release — set by the
+        # replica's migrate_out before cancel()
+        self.migrate_export = False
         # global admission order (engine thread stamps it in _start):
         # lane-ordering tests and forensics read it; None until admitted
         self.admit_index: Optional[int] = None
@@ -533,6 +538,15 @@ class Scheduler:
                     p.adm.chunks_remaining for p in list(self._prefills)
                 ),
             }
+            ts = alloc.tier_stats()
+            if ts is not None:
+                paged_stats.update({
+                    "kv_tier_blocks": ts["entries"],
+                    "kv_tier_bytes": ts["bytes"],
+                    "kv_tier_budget_bytes": ts["budget_bytes"],
+                    "kv_tier_spills": ts["spills_total"],
+                    "kv_tier_reloads": ts["reloads_total"],
+                })
         return {
             "active_slots": active,
             "num_slots": num_slots,
@@ -1643,16 +1657,20 @@ class Scheduler:
             self.total_generated_tokens += ctx.handle.completion_tokens
             if reason in ("cancelled", "error"):
                 self.total_preemptions += 1
+        migrating = (reason == "cancelled"
+                     and getattr(ctx.handle, "migrate_export", False))
         if (self.prompt_cache is not None
                 and not self.prompt_cache.read_only
-                and reason in ("stop", "length")):
+                and (reason in ("stop", "length") or migrating)):
             r = self._resident.get(slot)
             if r:
                 # prompt_cache_all keeps generation too; otherwise prompt
                 # only. Generated length comes from the host record — no
-                # device sync on the engine thread.
+                # device sync on the engine thread. A migration export
+                # always keeps the generation: the destination replica
+                # resumes from the full token record's frontier.
                 pos = min(len(r) - 1, self.runner.max_ctx - 1)
-                keep = (pos if self.prompt_cache_all
+                keep = (pos if (self.prompt_cache_all or migrating)
                         else min(ctx.handle.prompt_tokens, pos))
                 if keep >= self.prompt_cache.min_prefix:
                     try:

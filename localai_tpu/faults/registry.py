@@ -89,6 +89,12 @@ SITES = {
                        "under traffic); sleep = slow link — delay_s past "
                        "LOCALAI_FLEET_RPC_TIMEOUT_S trips the dispatch "
                        "deadline.",
+    "fleet.sibling": "inside the directory-driven sibling prefix fetch "
+                     "(FleetScheduler._sibling_fetch; key: the DONOR "
+                     "replica id). raise = donor dies mid-TransferPrefix "
+                     "— the fetch must fall back to a plain re-prefill "
+                     "and drop the stale directory entry, never fail "
+                     "the request.",
 }
 
 # module-global fast gate: hot paths read this one attribute and skip the

@@ -1,0 +1,26 @@
+"""Fleet-wide KV economy: prefix directory, HBM→host tiering, migration.
+
+Three cooperating pieces that turn N replicas' private KV caches into one
+fleet-wide pool:
+
+  * :mod:`directory` — the serving instance's map of *which replica holds
+    which prefix blocks* (keyed by the same token-chain hashes the
+    router's affinity heuristic uses). The router consults it to place
+    requests on known-warm KV, and the fleet scheduler uses it to fetch a
+    prefix from a sibling over TransferPrefix instead of re-prefilling.
+  * :mod:`tiering` — a host-RAM spill tier under the paged
+    ``BlockAllocator``'s prefix pool: LRU-evicted HBM blocks park in host
+    memory (int4 pools at half the bytes) and re-onboard on a later
+    chain match, making effective prefix-cache capacity host-RAM-sized.
+  * :mod:`migration` — the ticket protocol for moving an in-flight slot
+    between replicas mid-generation (drain-free deploys, hot-spot
+    rebalancing), built on the layout-independent
+    ``snapshot_prefix``/``load_prefix`` round-trip.
+"""
+
+from localai_tpu.fleet.kveconomy.directory import PrefixDirectory
+from localai_tpu.fleet.kveconomy.migration import MigrationTicket
+from localai_tpu.fleet.kveconomy.tiering import HostTier, tier_from_env
+
+__all__ = ["PrefixDirectory", "HostTier", "tier_from_env",
+           "MigrationTicket"]

@@ -62,6 +62,11 @@ class ReplicaPool:
             self.replicas.append(factory(f"{model}/p{i}", "prefill"))
         self._lock = threading.Lock()
         self._respawning: set[str] = set()
+        # death listeners: called with the replica id once per
+        # DEAD/EVICTED transition (the kveconomy prefix directory hooks
+        # here to invalidate every entry naming the replica — a respawn
+        # comes back with COLD HBM, so the old entries are lies)
+        self._death_listeners: list[Callable[[str], None]] = []
         self.respawns = 0
         # remote lifecycle accounting, distinct from local respawn: a
         # failed remote is EVICTED from routing and REDIALED on backoff —
@@ -227,6 +232,12 @@ class ReplicaPool:
         live = self.healthy(role)
         return min(live, key=lambda r: r.load) if live else None
 
+    def add_death_listener(self, fn: Callable[[str], None]) -> None:
+        """Register ``fn(replica_id)`` to run on every DEAD/EVICTED
+        transition (once per incident — _mark_dead is idempotent)."""
+        with self._lock:
+            self._death_listeners.append(fn)
+
     def note_failure(self, replica: BaseReplica) -> None:
         """A request-level transport failure on ``replica`` (called by the
         dispatch thread). A dead process is marked dead IMMEDIATELY —
@@ -284,6 +295,12 @@ class ReplicaPool:
             r.state = DEAD if r.respawnable else EVICTED
             if not r.respawnable:
                 self.evictions += 1
+            listeners = list(self._death_listeners)
+        for fn in listeners:
+            try:
+                fn(r.id)
+            except Exception:  # noqa: BLE001 — bookkeeping ≠ recovery
+                log.exception("death listener failed for %s", r.id)
         if r.respawnable:
             log.warning("fleet %s: replica %s marked dead "
                         "(%d consecutive dial failures)",

@@ -162,6 +162,27 @@ class BaseReplica:
                         timeout: Optional[float] = None) -> Any:
         raise NotImplementedError
 
+    def export_cached(self, prompt: list,
+                      trace_id: str = "") -> Optional[list]:
+        """Sibling-fetch donor half: this replica's ALREADY-CACHED prefix
+        rows for ``prompt`` as TransferPrefix chunks, without running any
+        prefill — or None when nothing matching is cached. Default None:
+        client-backed replicas have no remote cache-peek RPC, so the
+        fleet scheduler falls back to ``prefill_prefix`` for them (cheap
+        on the donor — its paged prefix pool makes the re-prefill mostly
+        block reuse)."""
+        return None
+
+    def migrate_out(self, corr_id: str,
+                    timeout: float = 30.0) -> Optional[dict]:
+        """Live-migration donor half: cancel the in-flight request with
+        the KV-export flag set and return ``{"tokens": full token
+        record, "generated": n, "chunks": TransferPrefix payload or
+        None}`` — or None when the request is unknown here / the kind
+        doesn't support migration (client-backed replicas would need a
+        dedicated RPC)."""
+        return None
+
     def metrics(self) -> dict:
         raise NotImplementedError
 
@@ -371,6 +392,12 @@ class InProcessReplica(BaseReplica):
         self._factory = factory
         self.sm = None
         self._killed = False
+        # correlation id → inner GenHandle while its stream is being
+        # pumped: the live-migration surface (migrate_out) finds the
+        # in-flight request here. Plain dict: writes are
+        # insert/pop-by-key from the dispatch thread, reads are a
+        # single get() from the migration caller — GIL-atomic.
+        self._streaming: dict = {}
 
     def start(self) -> None:
         from localai_tpu.fleet.prefix import PrefixCache
@@ -400,6 +427,8 @@ class InProcessReplica(BaseReplica):
         sm = self.sm
         gr = gen_request_from_options(opts, sm, trace_id=trace_id)
         handle = sm.scheduler.submit(gr)
+        if gr.correlation_id:
+            self._streaming[gr.correlation_id] = handle
         try:
             while True:
                 try:
@@ -427,6 +456,8 @@ class InProcessReplica(BaseReplica):
                 if item.delta:
                     yield _Reply(item.delta.encode("utf-8"))
         finally:
+            if gr.correlation_id:
+                self._streaming.pop(gr.correlation_id, None)
             if handle.finish_reason is None:
                 handle.cancel()
 
@@ -453,6 +484,61 @@ class InProcessReplica(BaseReplica):
             raise RuntimeError(f"replica {self.id} is dead")
         n = import_prefix(self._cache(), chunks)
         return SimpleNamespace(success=True, message=f"{n} rows")
+
+    def export_cached(self, prompt: list,
+                      trace_id: str = "") -> Optional[list]:
+        from localai_tpu.fleet.prefix import pack_chunks
+
+        if self._killed or self.sm is None:
+            return None
+        cache = self._cache()
+        if cache is None:
+            return None
+        hit = cache.lookup(list(prompt))
+        # the LCP winner must be a TRUE prefix of the prompt: lookup can
+        # return an entry that diverges past the common prefix, and its
+        # arrays cover the entry's rows, not the LCP
+        if hit is None or list(hit.tokens) != list(prompt)[:len(hit.tokens)]:
+            return None
+        return list(pack_chunks(hit.tokens, hit.arrays,
+                                transfer_id=trace_id))
+
+    def migrate_out(self, corr_id: str,
+                    timeout: float = 30.0) -> Optional[dict]:
+        from localai_tpu.fleet.prefix import pack_chunks
+
+        ih = self._streaming.get(corr_id)
+        if ih is None or self._killed or self.sm is None:
+            return None
+        # flag first, then cancel: the engine's release reads the flag,
+        # keeps the generated tail, and snapshots prompt+generation KV
+        # into this replica's prefix cache (scheduler._release)
+        ih.migrate_export = True
+        ih.cancel()
+        try:
+            ih.result(timeout)
+        except TimeoutError:
+            return None
+        full = list(ih.request.prompt) + list(ih.token_ids)
+        out = {"tokens": full, "generated": len(ih.token_ids),
+               "chunks": None}
+        cache = self._cache()
+        if cache is None or len(full) < cache.min_prefix:
+            return out  # nothing exportable: destination re-prefills
+        # the export lands off-thread (prompt-cache writer); the stored
+        # key is the full token record (migration keeps the generation)
+        arrays = cache.wait_for(full, timeout=min(timeout, 10.0))
+        tokens = full
+        if arrays is None:
+            # context-cap edge (or a racing store): take the longest
+            # cached true prefix instead — the destination re-prefills
+            # only the uncovered tail
+            hit = cache.lookup(full)
+            if hit is not None and list(hit.tokens) == full[:len(hit.tokens)]:
+                tokens, arrays = list(hit.tokens), hit.arrays
+        if arrays is not None:
+            out["chunks"] = list(pack_chunks(tokens, arrays))
+        return out
 
     def metrics(self) -> dict:
         if self.sm is None:

@@ -94,11 +94,16 @@ class Router:
 
     def __init__(self, pool, slo=None, *, block_tokens: int = 64,
                  affinity_blocks: int = AFFINITY_BLOCKS,
-                 queue_override: int = 0):
+                 queue_override: int = 0, directory=None):
         self.pool = pool
         self.slo = slo                  # per-replica SLOTracker (optional)
         self.block_tokens = block_tokens
         self.affinity_blocks = affinity_blocks
+        # fleet prefix directory (kveconomy.PrefixDirectory, optional):
+        # the RECORD of which replica holds which prefix blocks, checked
+        # before the ring heuristic — a known-warm holder beats where
+        # the hash says the prefix should be
+        self.directory = directory
         # decode-admission hint (LOCALAI_FLEET_QUEUE_OVERRIDE, 0 = off):
         # when the affinity target's last reported decode queue depth
         # exceeds this, placement degrades to least-loaded — cache
@@ -110,7 +115,7 @@ class Router:
         # from its own dispatch thread, so the counters take a lock
         self._lock = threading.Lock()
         self.routed = {"affinity": 0, "least_loaded": 0, "failover": 0,
-                       "queue_override": 0}
+                       "queue_override": 0, "directory": 0}
         self.routed_around = 0          # shed replicas skipped on the ring
 
     def _ring(self, ids: tuple) -> _Ring:
@@ -148,6 +153,24 @@ class Router:
 
         key = affinity_key(prompt, block_tokens=self.block_tokens,
                            blocks=self.affinity_blocks)
+        if key is not None and self.directory is not None:
+            # directory first: a replica KNOWN to hold this prefix's
+            # blocks (noted at completion/transfer time) beats the ring's
+            # prediction — e.g. after a failover or a ring remap moved
+            # the heuristic target away from the warm KV
+            rid = self.directory.lookup(key, (r.id for r in eligible))
+            if rid is not None:
+                target = byid[rid]
+                if not (self.queue_override
+                        and getattr(target, "queue_depth", 0)
+                        > self.queue_override):
+                    reason = "failover" if failover else "directory"
+                    with self._lock:
+                        self.routed[reason] += 1
+                    return target, reason
+                # holder is drowning in queued decodes: fall through to
+                # the ring/least-loaded placement — the fleet scheduler's
+                # sibling fetch moves the KV to wherever we land instead
         if key is not None:
             ring = self._ring(tuple(sorted(byid)))
             eligible_ids = {r.id for r in eligible}

@@ -35,9 +35,12 @@ from typing import Optional
 from localai_tpu.config.app_config import AppConfig
 from localai_tpu.config.model_config import ModelConfig
 from localai_tpu.engine.scheduler import GenHandle, GenRequest
+from localai_tpu.faults import registry as _faults
 from localai_tpu.fleet import net
+from localai_tpu.fleet.kveconomy import MigrationTicket, PrefixDirectory
+from localai_tpu.fleet.kveconomy.migration import continuation_request
 from localai_tpu.fleet.pool import ReplicaPool
-from localai_tpu.fleet.router import FleetUnavailable, Router
+from localai_tpu.fleet.router import FleetUnavailable, Router, affinity_key
 from localai_tpu.obs import EngineTelemetry
 from localai_tpu.obs import watchdog as obs_watchdog
 from localai_tpu.obs.metrics import REGISTRY
@@ -72,6 +75,14 @@ class FleetScheduler:
         self._ids = itertools.count()
         self._inflight = 0
         self._lock = threading.Lock()
+        # fleet prefix directory (shared with the router, which probes it
+        # for placement; the scheduler writes it and fetches against it)
+        self.directory: Optional[PrefixDirectory] = router.directory
+        # handle.id → (handle, replica currently serving it): the live-
+        # migration surface (migrate_inflight/drain) finds in-flight
+        # requests here. Plain dict — per-key insert/pop from the owning
+        # dispatch thread, point get() from callers — GIL-atomic.
+        self._active: dict[int, tuple] = {}
         self.telemetry = EngineTelemetry(model=owner.name)
         self.watchdog = obs_watchdog.WATCHDOG
         self._wd_channel = f"fleet:{owner.name}"
@@ -81,6 +92,11 @@ class FleetScheduler:
         self.prefix_transfers = 0
         self.prefix_transfer_bytes = 0
         self.disagg_fallbacks = 0
+        self.sibling_transfers = 0          # directory-driven KV pulls
+        self.sibling_transfer_bytes = 0
+        self.sibling_fallbacks = 0          # stale entry → re-prefill
+        self.migrations = 0                 # live slot moves completed
+        self.migration_fallbacks = 0
 
     @property
     def busy(self) -> bool:
@@ -93,6 +109,13 @@ class FleetScheduler:
 
     def submit(self, gr: GenRequest) -> GenHandle:
         handle = WorkerGenHandle(gr, next(self._ids))
+        if not gr.correlation_id:
+            # migration must be able to address the replica-side stream of
+            # any request (replica._streaming keys on correlation id) —
+            # mint one when the API tier didn't; never overwrite a
+            # caller-set id
+            gr.correlation_id = f"fleet:{self._owner.name}:{handle.id}"
+        handle._migration = None            # staked by migrate_inflight
         handle.trace = self.telemetry.queued(handle)
         if gr.mm_embeds is not None:
             self.telemetry.finished(handle.trace, handle, "error")
@@ -154,7 +177,14 @@ class FleetScheduler:
                         with self._lock:
                             self.failovers += 1
                         continue
+                elif reason not in ("affinity", "directory"):
+                    # placement could not follow the warm KV (queue
+                    # override, failover, ring miss): if the directory
+                    # knows a sibling holding this prefix, pull it over
+                    # TransferPrefix instead of re-prefilling here
+                    self._sibling_fetch(req, replica, tr)
                 t_dispatch = time.monotonic()
+                self._active[handle.id] = (handle, replica)
                 try:
                     finish = self._dispatch(handle, replica, tr)
                 except Exception as e:  # noqa: BLE001 — replica ≠ fleet
@@ -165,6 +195,14 @@ class FleetScheduler:
                             model=self._owner.name)
                     self.slo.observe(replica.id, error=True)
                     self.pool.note_failure(replica)
+                    ticket = getattr(handle, "_migration", None)
+                    if ticket is not None:
+                        # the donor died mid-migration: resolve the ticket
+                        # so migrate_inflight's wait returns instead of
+                        # timing out; the normal failover path takes over
+                        ticket.ready.set()
+                        ticket.finish("error")
+                        handle._migration = None
                     streamed = handle.t_first_token is not None
                     log.warning(
                         "fleet %s: replica %s failed request %d (%s); "
@@ -181,6 +219,18 @@ class FleetScheduler:
                     self.telemetry.finished(tr, handle, "error")
                     handle._finish("error")
                     return
+                ticket = getattr(handle, "_migration", None)
+                if finish == "cancelled" and ticket is not None:
+                    # not a client cancel: migrate_out cancelled the donor
+                    # stream — finish the request on the destination
+                    finish = self._migrate_continue(
+                        handle, ticket, tr, donor=replica)
+                    handle._migration = None
+                elif finish in ("stop", "length"):
+                    # the replica now holds this prompt's prefix KV (the
+                    # engine stores it at release) — record the fact so
+                    # later placement follows it
+                    self._note_prefix(req.prompt, replica.id)
                 now = time.monotonic()
                 self.slo.observe(
                     replica.id,
@@ -193,14 +243,18 @@ class FleetScheduler:
                 handle._finish(finish)
                 return
         finally:
+            self._active.pop(handle.id, None)
             self.watchdog.disarm(self._wd_channel)
             with self._lock:
                 self._inflight -= 1
 
-    def _dispatch(self, handle: WorkerGenHandle, replica, tr) -> str:
+    def _dispatch(self, handle: WorkerGenHandle, replica, tr,
+                  req: Optional[GenRequest] = None) -> str:
         """One streaming attempt against one replica. Raises on transport
-        failure (the caller decides whether failover is still safe)."""
-        req = handle.request
+        failure (the caller decides whether failover is still safe).
+        ``req`` overrides the handle's request (migration continuations
+        dispatch a rewritten request through the original handle)."""
+        req = handle.request if req is None else req
         opts = predict_options(req)
         replica.begin()
         error = True
@@ -301,10 +355,287 @@ class FleetScheduler:
             REGISTRY.fleet_prefix_transfers.inc(model=self._owner.name)
             REGISTRY.fleet_prefix_transfer_bytes.inc(
                 nbytes, model=self._owner.name)
+            # the decode replica now holds the transferred prefix
+            self._note_prefix(req.prompt, decode.id)
         else:
             with self._lock:
                 self.disagg_fallbacks += 1
         return ok
+
+    # -- KV economy: directory, sibling fetch, live migration -------------
+
+    def _note_prefix(self, prompt: list, rid: str) -> None:
+        """Record in the fleet directory that ``rid`` holds ``prompt``'s
+        prefix KV (same key granularity the router's affinity uses)."""
+        if self.directory is None:
+            return
+        self.directory.note(
+            affinity_key(prompt, block_tokens=self.router.block_tokens,
+                         blocks=self.router.affinity_blocks), rid)
+
+    def _sibling_fetch(self, req: GenRequest, target, tr) -> bool:
+        """Directory-driven warm-up: when placement lands a request away
+        from its warm KV, pull the prefix from the holding sibling over
+        TransferPrefix before dispatching — one bulk copy instead of a
+        re-prefill. Best effort: a stale directory entry (replica-side
+        LRU eviction, a dying donor) costs one failed fetch, after which
+        the entry is dropped and the plain dispatch prefills as usual —
+        never a request error."""
+        if self.directory is None:
+            return False
+        key = affinity_key(req.prompt, block_tokens=self.router.block_tokens,
+                           blocks=self.router.affinity_blocks)
+        if key is None:
+            return False
+        donor_id = self.directory.holder(
+            key, (r.id for r in self.pool.healthy("decode")),
+            exclude=(target.id,))
+        if donor_id is None:
+            return False
+        donor = self.pool.get(donor_id)
+        if donor is None or donor.state != "healthy":
+            return False
+        trace_id = req.trace_id or req.correlation_id
+        nbytes = 0
+        ok = False
+        if tr is not None:
+            tr.begin("sibling_fetch", donor=donor.id, target=target.id)
+        try:
+            if _faults.ACTIVE:
+                # chaos: the donor dies mid-fetch — this leg must degrade
+                # to a plain re-prefill, never fail the request
+                _faults.apply("fleet.sibling", key=donor.id)
+            chunks = donor.export_cached(req.prompt, trace_id=trace_id)
+            if chunks is None:
+                # no cache-peek surface (client-backed donor) or the
+                # cached entry diverged: re-prefill ON THE DONOR — its
+                # paged prefix pool makes this mostly block reuse — and
+                # stream the rows over, same as the disagg export
+                opts = predict_options(req)
+                donor.begin()
+                derr = True
+                try:
+                    chunks = []
+                    for c in net.bounded_stream(
+                            donor.prefill_prefix(opts, trace_id=trace_id),
+                            self.rpc_timeout_s, rid=donor.id):
+                        self.watchdog.pulse(self._wd_channel)
+                        chunks.append(c)
+                    derr = False
+                finally:
+                    donor.done(error=derr)
+            if not chunks:
+                raise RuntimeError("donor exported no prefix chunks")
+            nbytes = sum(len(c["data"] if isinstance(c, dict) else c.data)
+                         for c in chunks)
+            res = net.call_with_retries(
+                lambda: target.transfer_prefix(iter(chunks),
+                                               trace_id=trace_id,
+                                               timeout=self.rpc_timeout_s),
+                rid=target.id, what="transfer_prefix")
+            ok = bool(getattr(res, "success", False))
+            if not ok:
+                raise RuntimeError("target refused the prefix transfer")
+        except Exception as e:  # noqa: BLE001 — the fetch is an optimization
+            if isinstance(e, net.RpcDeadlineExceeded):
+                REGISTRY.fleet_rpc_deadlines.inc(model=self._owner.name)
+            log.warning(
+                "fleet %s: sibling prefix fetch %s→%s failed (%s); "
+                "dropping directory entry, falling back to local prefill",
+                self._owner.name, donor.id, target.id, e)
+            self.directory.drop(key, donor.id)
+            with self._lock:
+                self.sibling_fallbacks += 1
+            REGISTRY.fleet_sibling_fallbacks.inc(model=self._owner.name)
+        finally:
+            if tr is not None:
+                tr.end("sibling_fetch", ok=ok, bytes=nbytes)
+        if ok:
+            with self._lock:
+                self.sibling_transfers += 1
+                self.sibling_transfer_bytes += nbytes
+            REGISTRY.fleet_sibling_transfers.inc(model=self._owner.name)
+            REGISTRY.fleet_sibling_transfer_bytes.inc(
+                nbytes, model=self._owner.name)
+            self.directory.note(key, target.id)
+        return ok
+
+    def migrate_inflight(self, handle: WorkerGenHandle,
+                         dest_id: Optional[str] = None,
+                         timeout: float = 30.0) -> bool:
+        """Move an in-flight request to another replica at its next
+        dispatch boundary (operator drain, rebalancing, chaos drills).
+        Blocks until the migration resolves; True only when the request
+        actually continued on the destination. Safe to call from any
+        thread — the dispatch thread owns the request lifecycle
+        throughout."""
+        req = handle.request
+        if req.constraint is not None:
+            # the destination would recompile the grammar FSM from
+            # position 0 over a prompt that already contains donor
+            # generations — constrained requests stay put
+            return False
+        entry = self._active.get(handle.id)
+        if entry is None or handle.finish_reason is not None:
+            return False
+        donor = entry[1]
+        dests = [r for r in self.pool.healthy("decode") if r.id != donor.id]
+        if dest_id is not None:
+            dests = [r for r in dests if r.id == dest_id]
+        if not dests:
+            return False
+        dest = min(dests, key=lambda r: r.load)
+        ticket = MigrationTicket(dest.id)
+        # stake BEFORE cancelling: the dispatch thread must find the
+        # ticket when the donor's "cancelled" final reply unwinds
+        handle._migration = ticket
+        out = None
+        try:
+            out = donor.migrate_out(req.correlation_id, timeout=timeout)
+        except Exception as e:  # noqa: BLE001 — donor export ≠ request
+            ticket.fail(str(e))
+        if out is not None:
+            ticket.chunks = out.get("chunks")
+            ticket.full_tokens = out.get("tokens")
+            ticket.donor_tokens = int(out.get("generated") or 0)
+            ticket.ready.set()
+        elif not ticket.error:
+            # the donor doesn't know this request (already finished, or a
+            # replica kind without a migration surface) and nothing was
+            # cancelled: unstake so a later genuine client cancel isn't
+            # misread as a migration
+            handle._migration = None
+            return False
+        if not ticket.completed.wait(timeout):
+            return False
+        return ticket.outcome == "migrated"
+
+    def drain(self, rid: str, timeout: float = 30.0) -> dict:
+        """Migrate every in-flight request off replica ``rid`` (drain-free
+        shutdown / rebalancing). Returns {"moved": n, "failed": n}."""
+        moved = failed = 0
+        for _, entry in list(self._active.items()):
+            handle, replica = entry
+            if replica.id != rid or handle.finish_reason is not None:
+                continue
+            if self.migrate_inflight(handle, timeout=timeout):
+                moved += 1
+            else:
+                failed += 1
+        return {"moved": moved, "failed": failed}
+
+    def _migrate_continue(self, handle: WorkerGenHandle,
+                          ticket: MigrationTicket, tr, donor) -> str:
+        """Dispatch-thread half of a live migration: the donor stream just
+        unwound "cancelled" with ``ticket`` staked. Transfer the exported
+        KV into the destination and re-dispatch a continuation (full token
+        record as prompt, remaining budget); every failure leg falls back
+        to a correct full re-prefill — slow, never lossy. Returns the
+        request's final finish reason."""
+        req = handle.request
+        if tr is not None:
+            tr.begin("migrate", donor=donor.id, dest=ticket.dest_id)
+        try:
+            if not ticket.ready.wait(30.0) or ticket.error \
+                    or not ticket.full_tokens:
+                return self._migration_fallback(
+                    handle, ticket, tr,
+                    ticket.error or "donor export timed out")
+            cont = continuation_request(req, ticket.full_tokens,
+                                        ticket.donor_tokens)
+            if cont.max_new_tokens <= 0:
+                # the donor spent the whole budget before the boundary:
+                # the move is complete with nothing left to generate
+                self._finish_migration(handle, ticket, req, 0)
+                return "length"
+            dest = self.pool.get(ticket.dest_id)
+            targets = ([dest] if dest is not None
+                       and dest.state == "healthy" else [])
+            # the continuation is self-contained (full token record), so
+            # any healthy sibling can finish it if the preferred
+            # destination died between staking and transfer
+            targets += [r for r in self.pool.healthy("decode")
+                        if r.id != donor.id
+                        and all(r.id != t.id for t in targets)]
+            trace_id = req.trace_id or req.correlation_id
+            for dest in targets[:2]:
+                n_text = len(handle.text)
+                try:
+                    if ticket.chunks:
+                        # best effort: a failed import only costs the
+                        # destination a re-prefill of the token record
+                        try:
+                            dest.transfer_prefix(
+                                iter(ticket.chunks), trace_id=trace_id,
+                                timeout=self.rpc_timeout_s)
+                        except Exception as e:  # noqa: BLE001
+                            log.warning(
+                                "fleet %s: migration KV transfer to %s "
+                                "failed (%s); destination will re-prefill",
+                                self._owner.name, dest.id, e)
+                    self._active[handle.id] = (handle, dest)
+                    finish = self._dispatch(handle, dest, tr, req=cont)
+                    self._finish_migration(
+                        handle, ticket, req,
+                        getattr(handle, "_completion_override", None) or 0)
+                    self._note_prefix(req.prompt, dest.id)
+                    return finish
+                except Exception as e:  # noqa: BLE001 — dest ≠ request
+                    self.slo.observe(dest.id, error=True)
+                    self.pool.note_failure(dest)
+                    log.warning(
+                        "fleet %s: migration continuation on %s failed "
+                        "(%s)", self._owner.name, dest.id, e)
+                    if len(handle.text) > n_text:
+                        # this continuation streamed deltas before dying —
+                        # a retry would replay text
+                        ticket.finish("error")
+                        return "error"
+            return self._migration_fallback(
+                handle, ticket, tr, "no destination could continue")
+        finally:
+            if tr is not None:
+                tr.end("migrate", outcome=ticket.outcome)
+
+    def _finish_migration(self, handle: WorkerGenHandle,
+                          ticket: MigrationTicket, req: GenRequest,
+                          cont_tokens: int) -> None:
+        """Splice usage across the boundary: the client sees ONE request
+        — donor tokens + destination tokens, and the ORIGINAL prompt
+        length (the continuation's inflated prompt is an implementation
+        detail)."""
+        handle._completion_override = ticket.donor_tokens + cont_tokens
+        handle.prompt_tokens = len(req.prompt)
+        with self._lock:
+            self.migrations += 1
+        REGISTRY.fleet_migrations.inc(model=self._owner.name)
+        ticket.finish("migrated")
+
+    def _migration_fallback(self, handle: WorkerGenHandle,
+                            ticket: MigrationTicket, tr, why: str) -> str:
+        """The migration could not complete. If nothing reached the
+        client yet the original request re-dispatches from scratch
+        (correct, just slower); a half-streamed request cannot be
+        replayed and finishes ``error``."""
+        with self._lock:
+            self.migration_fallbacks += 1
+        REGISTRY.fleet_migration_fallbacks.inc(model=self._owner.name)
+        log.warning("fleet %s: live migration of request %d fell back "
+                    "(%s)", self._owner.name, handle.id, why)
+        ticket.finish("fallback")
+        if handle.t_first_token is not None:
+            return "error"
+        try:
+            replica, _ = self.router.route(handle.request.prompt,
+                                           failover=True)
+            REGISTRY.fleet_routed.inc(model=self._owner.name,
+                                      reason="failover")
+            self._active[handle.id] = (handle, replica)
+            return self._dispatch(handle, replica, tr)
+        except Exception as e:  # noqa: BLE001
+            log.warning("fleet %s: post-migration re-dispatch failed (%s)",
+                        self._owner.name, e)
+            return "error"
 
     # -- observability / lifecycle ----------------------------------------
 
@@ -316,6 +647,11 @@ class FleetScheduler:
         totals = {"total_prompt_tokens": 0, "total_generated_tokens": 0,
                   "queue_depth": 0, "dispatches": 0, "preemptions": 0,
                   "prefix_tokens_reused": 0}
+        # host-RAM KV tier roll-up (only exported when some replica has a
+        # tier attached — worker dicts without the keys stay invisible)
+        tier = {"kv_tier_blocks": 0, "kv_tier_bytes": 0,
+                "kv_tier_spills": 0, "kv_tier_reloads": 0}
+        tiered = False
         occ = []
         kvu = []
         per_replica: dict[str, dict] = {}
@@ -329,10 +665,16 @@ class FleetScheduler:
                 continue
             for k in totals:
                 totals[k] += m.get(k, 0) or 0
+            if "kv_tier_spills" in m:
+                tiered = True
+                for k in tier:
+                    tier[k] += m.get(k, 0) or 0
             if m.get("occupancy") is not None:
                 occ.append(m["occupancy"])
             if m.get("kv_utilization") is not None:
                 kvu.append(m["kv_utilization"])
+        if tiered:
+            totals.update(tier)
         with self._lock:
             fleet = {
                 "replicas": self.pool.states(),
@@ -341,9 +683,16 @@ class FleetScheduler:
                 "prefix_transfers": self.prefix_transfers,
                 "prefix_transfer_bytes": self.prefix_transfer_bytes,
                 "disagg_fallbacks": self.disagg_fallbacks,
+                "sibling_transfers": self.sibling_transfers,
+                "sibling_transfer_bytes": self.sibling_transfer_bytes,
+                "sibling_fallbacks": self.sibling_fallbacks,
+                "migrations": self.migrations,
+                "migration_fallbacks": self.migration_fallbacks,
                 **self.router.snapshot(),
             }
             shed = self.shed_total
+        if self.directory is not None:
+            fleet["directory"] = self.directory.stats()
         return {
             **totals,
             "occupancy": sum(occ) / len(occ) if occ else 0.0,
@@ -360,6 +709,17 @@ class FleetScheduler:
                       "evicted"):
             REGISTRY.fleet_replicas.set(
                 states.get(state, 0), model=self._owner.name, state=state)
+        if self.directory is not None:
+            st = self.directory.stats()
+            REGISTRY.fleet_directory_entries.set(
+                st["entries"], model=self._owner.name)
+            REGISTRY.fleet_directory_hits.set_total(
+                st["hits"], model=self._owner.name)
+            REGISTRY.fleet_directory_misses.set_total(
+                st["misses"], model=self._owner.name)
+            REGISTRY.fleet_directory_drops.set_total(
+                st["drops"] + st["invalidations"],
+                model=self._owner.name)
 
     def shutdown(self, timeout: float = 10.0) -> None:
         self.pool.shutdown()
@@ -425,8 +785,16 @@ class FleetServingModel:
         from localai_tpu.engine.paged import block_tokens_default
 
         bt = mcfg.engine.kv_block_tokens or block_tokens_default()
+        # fleet prefix directory: the RECORD of which replica holds which
+        # prefix blocks (kveconomy). The router probes it for placement;
+        # the scheduler writes it and pulls KV from siblings against it;
+        # replica death invalidates every entry naming the corpse (a
+        # respawned engine boots cold — the old entries are lies)
+        self.directory = PrefixDirectory()
+        self.pool.add_death_listener(self.directory.drop_replica)
         self.router = Router(self.pool, self.slo, block_tokens=bt,
-                             queue_override=queue_override)
+                             queue_override=queue_override,
+                             directory=self.directory)
         self.scheduler = FleetScheduler(
             self, self.pool, self.router, self.slo,
             disagg_threshold=(disagg_threshold
@@ -483,6 +851,13 @@ class FleetServingModel:
             "prefix_transfers": self.scheduler.prefix_transfers,
             "prefix_transfer_bytes": self.scheduler.prefix_transfer_bytes,
             "disagg_fallbacks": self.scheduler.disagg_fallbacks,
+            "directory": self.directory.stats(),
+            "sibling_transfers": self.scheduler.sibling_transfers,
+            "sibling_transfer_bytes":
+                self.scheduler.sibling_transfer_bytes,
+            "sibling_fallbacks": self.scheduler.sibling_fallbacks,
+            "migrations": self.scheduler.migrations,
+            "migration_fallbacks": self.scheduler.migration_fallbacks,
             "shedding": {
                 r.id: self.slo.shedding(r.id) for r in self.pool.members()
             },

@@ -316,7 +316,7 @@ class Registry:
         self.fleet_routed = Counter(
             "localai_fleet_routed_total",
             "Requests placed by the fleet router by reason "
-            "(affinity/least_loaded/failover/queue_override)",
+            "(affinity/directory/least_loaded/failover/queue_override)",
         )
         self.fleet_prefix_transfers = Counter(
             "localai_fleet_prefix_transfers_total",
@@ -326,6 +326,71 @@ class Registry:
             "localai_fleet_prefix_transfer_bytes_total",
             "Packed KV-prefix bytes streamed between replicas over "
             "TransferPrefix",
+        )
+        # -- fleet KV economy (fleet.kveconomy) ----------------------------
+        self.fleet_directory_entries = Gauge(
+            "localai_fleet_directory_entries",
+            "Prefix keys tracked by the fleet prefix directory "
+            "(which replica holds which prefix blocks)",
+        )
+        self.fleet_directory_hits = Counter(
+            "localai_fleet_directory_hits_total",
+            "Routing probes the prefix directory answered with a live "
+            "holder (request placed on known-warm KV)",
+        )
+        self.fleet_directory_misses = Counter(
+            "localai_fleet_directory_misses_total",
+            "Routing probes the prefix directory could not answer "
+            "(unknown key or no eligible holder — ring heuristic decides)",
+        )
+        self.fleet_directory_drops = Counter(
+            "localai_fleet_directory_drops_total",
+            "Directory entries invalidated: stale holders dropped after "
+            "a failed fetch + whole-replica invalidations on death",
+        )
+        self.fleet_sibling_transfers = Counter(
+            "localai_fleet_sibling_transfers_total",
+            "Directory-driven sibling KV-prefix fetches completed "
+            "(prefix pulled over TransferPrefix instead of re-prefilled)",
+        )
+        self.fleet_sibling_transfer_bytes = Counter(
+            "localai_fleet_sibling_transfer_bytes_total",
+            "Packed KV bytes moved by sibling prefix fetches",
+        )
+        self.fleet_sibling_fallbacks = Counter(
+            "localai_fleet_sibling_fallbacks_total",
+            "Sibling fetches that failed (stale directory entry / dying "
+            "donor) and fell back to a plain local prefill",
+        )
+        self.fleet_migrations = Counter(
+            "localai_fleet_migrations_total",
+            "Live in-flight slot migrations completed (request resumed "
+            "on the destination replica mid-generation)",
+        )
+        self.fleet_migration_fallbacks = Counter(
+            "localai_fleet_migration_fallbacks_total",
+            "Live migrations that could not complete and fell back "
+            "(full re-prefill re-dispatch, or error if already streamed)",
+        )
+        self.kv_tier_blocks = Gauge(
+            "localai_kv_tier_blocks",
+            "Cold prefix blocks currently resident in the host-RAM KV "
+            "tier (spilled out of HBM)",
+        )
+        self.kv_tier_bytes = Gauge(
+            "localai_kv_tier_bytes",
+            "Host-RAM bytes held by the KV tier (bounded by "
+            "LOCALAI_KV_TIER_MB)",
+        )
+        self.kv_tier_spills = Counter(
+            "localai_kv_tier_spills_total",
+            "Prefix blocks spilled HBM→host RAM at eviction instead of "
+            "being discarded",
+        )
+        self.kv_tier_reloads = Counter(
+            "localai_kv_tier_reloads_total",
+            "Spilled prefix blocks re-onboarded host RAM→HBM on a "
+            "prefix-match hit (a prefill saved by the tier)",
         )
         self.fleet_respawn_backoff = Gauge(
             "localai_fleet_respawn_backoff_s",
@@ -526,6 +591,14 @@ def update_engine_gauges(name: str, m: dict,
             for label in ("pallas", "lax"):
                 reg.paged_kernel_impl.set(
                     1.0 if impl == label else 0.0, model=name, impl=label)
+    if "kv_tier_spills" in m:
+        # host-RAM tier attached (single engine OR the fleet roll-up —
+        # the latter carries the tier sums without the kv_blocks pane)
+        reg.kv_tier_blocks.set(m.get("kv_tier_blocks", 0), model=name)
+        reg.kv_tier_bytes.set(m.get("kv_tier_bytes", 0), model=name)
+        reg.kv_tier_spills.set_total(m.get("kv_tier_spills", 0), model=name)
+        reg.kv_tier_reloads.set_total(
+            m.get("kv_tier_reloads", 0), model=name)
     reg.decode_dispatches.set_total(m.get("dispatches", 0), model=name)
     if "quarantined_slots" in m:
         # point-in-time NaN-quarantine census; the nan_rows/rebuilds

@@ -572,16 +572,17 @@ def test_backend_trace_capture(tmp_path):
                           json=[1, 2]).status_code == 400
             assert c.post("/backend/trace",
                           json={"seconds": "soon"}).status_code == 400
-            # one capture at a time: a held capture lock → 409 Conflict
-            from localai_tpu.api import localai as localai_routes
+            # one capture at a time: the profiler's shared capture lock
+            # held (an anomaly capture in flight) → 409 Conflict
+            from localai_tpu.obs.profiler import PROFILER
 
-            assert localai_routes._trace_lock.acquire(timeout=5)
+            assert PROFILER.acquire_capture()
             try:
                 r = c.post("/backend/trace", json={"seconds": 0.2})
                 assert r.status_code == 409
                 assert "already running" in r.json()["error"]["message"]
             finally:
-                localai_routes._trace_lock.release()
+                PROFILER.release_capture()
     finally:
         srv.stop()
 

@@ -265,7 +265,10 @@ def test_fleet_affinity_placement_serves_one_replica(fleet):
     served = [r for r in fleet.pool.replicas
               if r.role == "decode" and r.dispatched > 0]
     assert len(served) == 1
-    assert fleet.router.routed["affinity"] >= 3
+    # request 1 is a ring pick; repeats may route by the prefix
+    # directory instead (same replica, reason "directory")
+    routed = fleet.router.routed
+    assert routed["affinity"] + routed.get("directory", 0) >= 3
 
 
 def test_disaggregated_handoff_matches_single_engine(fleet):
@@ -317,8 +320,14 @@ def test_dead_replica_failover_and_respawn(fleet):
     pick, _ = fleet.router.route(fleet.tokenizer.encode(prompt))
     assert pick.state == "healthy"
     fleet.slo.reset()
-    pick, _ = fleet.router.route(fleet.tokenizer.encode(prompt))
-    assert pick.id == target.id  # affinity restored after recovery
+    pick, reason = fleet.router.route(fleet.tokenizer.encode(prompt))
+    # the prefix directory may (correctly) keep preferring the replica
+    # that served the failover traffic — ITS copy of the KV is the warm
+    # one. Drop that record to prove the ring itself forgot nothing:
+    if reason == "directory" and fleet.scheduler.directory is not None:
+        fleet.scheduler.directory.drop_replica(pick.id)
+        pick, reason = fleet.router.route(fleet.tokenizer.encode(prompt))
+    assert pick.id == target.id  # ring affinity restored after recovery
 
 
 def test_kill_mid_request_fleet_keeps_serving(fleet):

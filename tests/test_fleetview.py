@@ -233,8 +233,16 @@ def test_get_telemetry_rpc(worker):
 
 
 def test_get_telemetry_flight_since_windowing(worker):
-    t = worker.get_telemetry()
-    last_ts = t["flight"]["records"][-1]["ts"]
+    # the engine thread may still be writing a trailing drain record
+    # when the previous test's stream ends — wait for the ring to quiesce
+    last_ts = worker.get_telemetry()["flight"]["records"][-1]["ts"]
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        time.sleep(0.05)
+        ts = worker.get_telemetry()["flight"]["records"][-1]["ts"]
+        if ts == last_ts:
+            break
+        last_ts = ts
     # feeding back the last seen ts returns only newer records (none yet)
     t2 = worker.get_telemetry(since=last_ts)
     assert t2["flight"]["records"] == []

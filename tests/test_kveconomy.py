@@ -1,0 +1,379 @@
+"""Fleet KV economy: prefix directory, HBM→host tiering, migration.
+
+Directory and tier semantics run as pure-host units; routing integration
+runs against stub replicas; spill→reload parity and the tier-residency
+audit run against real paged runners (f32 AND nibble-packed int4); the
+churn invariant — a stale directory entry costs one failed fetch, never
+a request error — runs against a real 2-replica in-process fleet. The
+acceptance matrix of ISSUE 17 on CPU."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from localai_tpu.engine.runner import ModelRunner
+from localai_tpu.engine.scheduler import GenRequest
+from localai_tpu.fleet.kveconomy import HostTier, PrefixDirectory
+from localai_tpu.fleet.kveconomy.directory import directory_capacity_from_env
+from localai_tpu.fleet.kveconomy.migration import (MigrationTicket,
+                                                  continuation_request)
+from localai_tpu.fleet.kveconomy.tiering import tier_from_env
+from localai_tpu.fleet.router import Router, affinity_key
+from localai_tpu.models.registry import resolve_model
+from localai_tpu.utils.tokenizer import ByteTokenizer
+
+
+def _payload(n=64):
+    a = np.arange(n, dtype=np.float32)
+    return {"k": a, "v": a + 1.0}
+
+
+# ---------------------------------------------------------------------------
+# host tier (pure numpy LRU)
+
+
+def test_host_tier_put_take_discard():
+    tier = HostTier(1 << 20)
+    assert tier.put("a", _payload())
+    assert tier.contains("a")
+    got = tier.take("a")
+    np.testing.assert_array_equal(got["k"], _payload()["k"])
+    # take CONSUMES the spill: a chain is HBM-resident xor spilled
+    assert not tier.contains("a") and tier.take("a") is None
+    tier.put("b", _payload())
+    tier.discard("b")
+    st = tier.stats()
+    assert st["entries"] == 0 and st["bytes"] == 0
+    assert st["stores_total"] == 2 and st["takes_total"] == 1
+
+
+def test_host_tier_byte_budget_evicts_lru():
+    one = 2 * _payload()["k"].nbytes          # bytes of one payload
+    tier = HostTier(2 * one)                  # room for exactly two
+    tier.put("a", _payload())
+    tier.put("b", _payload())
+    tier.put("c", _payload())                 # budget → LRU "a" dropped
+    assert not tier.contains("a")
+    assert tier.contains("b") and tier.contains("c")
+    st = tier.stats()
+    assert st["budget_drops_total"] == 1 and st["bytes"] <= 2 * one
+    # re-putting an existing key replaces, never double-counts
+    tier.put("c", _payload())
+    assert tier.stats()["bytes"] <= 2 * one
+
+
+def test_host_tier_oversize_reject_and_env_knob(monkeypatch):
+    tier = HostTier(16)                       # smaller than any payload
+    assert not tier.put("big", _payload())
+    st = tier.stats()
+    assert st["oversize_rejects_total"] == 1 and st["entries"] == 0
+    with pytest.raises(ValueError):
+        HostTier(0)
+    monkeypatch.delenv("LOCALAI_KV_TIER_MB", raising=False)
+    assert tier_from_env() is None            # off by default (seed shape)
+    monkeypatch.setenv("LOCALAI_KV_TIER_MB", "2")
+    t = tier_from_env()
+    assert t is not None and t.budget_bytes == 2 << 20
+    monkeypatch.setenv("LOCALAI_KV_TIER_MB", "not-a-number")
+    assert tier_from_env() is None
+
+
+# ---------------------------------------------------------------------------
+# prefix directory (pure host map)
+
+
+def test_directory_note_lookup_prefers_freshest():
+    d = PrefixDirectory(max_entries=64)
+    d.note(1, "m/r0")
+    d.note(1, "m/r1")                          # freshest holder
+    assert d.lookup(1, ["m/r0", "m/r1"]) == "m/r1"
+    assert d.lookup(1, ["m/r0"]) == "m/r0"     # eligibility filters
+    assert d.lookup(1, ["m/r9"]) is None       # no eligible holder = miss
+    assert d.lookup(2, ["m/r0"]) is None       # unknown key = miss
+    assert d.lookup(None, ["m/r0"]) is None    # short prompt: no key
+    st = d.stats()
+    assert st["hits"] == 2 and st["misses"] == 2 and st["notes"] == 2
+
+
+def test_directory_holder_is_counter_silent_and_excludes():
+    d = PrefixDirectory(max_entries=64)
+    d.note(7, "m/r0")
+    d.note(7, "m/r1")
+    assert d.holder(7, ["m/r0", "m/r1"], exclude=["m/r1"]) == "m/r0"
+    assert d.holder(7, ["m/r1"], exclude=["m/r1"]) is None
+    st = d.stats()
+    assert st["hits"] == 0 and st["misses"] == 0
+
+
+def test_directory_drop_and_drop_replica():
+    d = PrefixDirectory(max_entries=64)
+    for key in (1, 2, 3):
+        d.note(key, "m/r0")
+    d.note(2, "m/r1")
+    d.drop(2, "m/r0")                          # stale holder forgotten…
+    assert d.lookup(2, ["m/r0"]) is None
+    assert d.lookup(2, ["m/r1"]) == "m/r1"     # …other holders survive
+    d.drop(9, "m/r0")                          # unknown key: no-op
+    touched = d.drop_replica("m/r0")           # death listener path
+    assert touched == 2                        # keys 1 and 3
+    assert d.lookup(1, ["m/r0"]) is None
+    assert d.stats()["entries"] == 1           # key 2 via m/r1 remains
+    assert d.stats()["invalidations"] == 1     # one whole-replica event
+    assert d.drop_replica("m/r0") == 0         # idempotent, not recounted
+    assert d.stats()["invalidations"] == 1
+
+
+def test_directory_lru_cap_and_env(monkeypatch):
+    d = PrefixDirectory(max_entries=4)
+    for key in range(8):
+        d.note(key, "m/r0")
+    assert d.stats()["entries"] == 4
+    assert d.lookup(0, ["m/r0"]) is None       # oldest keys fell off
+    assert d.lookup(7, ["m/r0"]) == "m/r0"
+    monkeypatch.setenv("LOCALAI_KV_DIR_ENTRIES", "32")
+    assert directory_capacity_from_env() == 32
+    monkeypatch.setenv("LOCALAI_KV_DIR_ENTRIES", "2")
+    assert directory_capacity_from_env() == 16  # floor
+    monkeypatch.setenv("LOCALAI_KV_DIR_ENTRIES", "junk")
+    assert directory_capacity_from_env() == 4096
+
+
+# ---------------------------------------------------------------------------
+# router integration (stub replicas)
+
+
+class _StubReplica:
+    def __init__(self, rid, role="decode", queue_depth=0):
+        self.id, self.role, self.state = rid, role, "healthy"
+        self.inflight = 0
+        self.dispatched = 0
+        self.queue_depth = queue_depth
+
+    @property
+    def load(self):
+        return (self.inflight, self.dispatched)
+
+
+class _StubPool:
+    def __init__(self, replicas):
+        self.replicas = replicas
+
+    def healthy(self, role="decode"):
+        return [r for r in self.replicas
+                if r.state == "healthy" and r.role == role]
+
+
+def test_router_directory_overrides_ring_affinity():
+    pool = _StubPool([_StubReplica(f"m/r{i}") for i in range(3)])
+    prompt = [7] * 64
+    ring_pick = Router(pool, None, block_tokens=16).route(prompt)[0].id
+    warm = next(r.id for r in pool.replicas if r.id != ring_pick)
+    directory = PrefixDirectory(max_entries=64)
+    directory.note(affinity_key(prompt, block_tokens=16), warm)
+    router = Router(pool, None, block_tokens=16, directory=directory)
+    pick, reason = router.route(prompt)
+    assert pick.id == warm and reason == "directory"
+    assert router.routed["directory"] == 1
+    # failover re-dispatch through a directory hit is tagged failover
+    pick, reason = router.route(prompt, failover=True)
+    assert pick.id == warm and reason == "failover"
+    # the holder excluded (it just failed this request) → ring fallback
+    pick, reason = router.route(prompt, exclude={warm})
+    assert pick.id != warm and reason in ("affinity", "least_loaded")
+
+
+def test_router_directory_respects_queue_override():
+    drowning = _StubReplica("m/r0", queue_depth=9)
+    drowning.inflight = 3                      # drowning ⇒ loaded
+    idle = _StubReplica("m/r1")
+    pool = _StubPool([drowning, idle])
+    directory = PrefixDirectory(max_entries=64)
+    prompt = [3] * 64
+    directory.note(affinity_key(prompt, block_tokens=16), drowning.id)
+    router = Router(pool, None, block_tokens=16, directory=directory,
+                    queue_override=2)
+    pick, reason = router.route(prompt)
+    # warm KV never beats a drowning queue: fall through to placement
+    # (the sibling fetch moves the KV to wherever the request lands)
+    assert pick.id == idle.id and reason != "directory"
+
+
+# ---------------------------------------------------------------------------
+# migration primitives
+
+
+def test_migration_ticket_fail_and_finish():
+    t = MigrationTicket("m/r1")
+    assert not t.ready.is_set() and not t.error
+    t.fail("donor exploded")
+    assert t.ready.is_set() and t.error == "donor exploded"
+    done = {}
+
+    def waiter():
+        t.completed.wait(5.0)
+        done["outcome"] = t.outcome
+
+    th = threading.Thread(target=waiter)
+    th.start()
+    t.finish("fallback")
+    th.join(5.0)
+    assert done["outcome"] == "fallback"
+
+
+def test_continuation_request_budget_and_record():
+    req = GenRequest(prompt=[1, 2, 3], max_new_tokens=10,
+                     temperature=0.0, correlation_id="c-1")
+    cont = continuation_request(req, [1, 2, 3, 50, 51], donor_tokens=2)
+    assert cont.prompt == [1, 2, 3, 50, 51]
+    assert cont.max_new_tokens == 8
+    assert cont.correlation_id == "c-1"       # identity carries over
+    assert req.prompt == [1, 2, 3]            # original untouched
+    # budget exhausted at the boundary clamps to zero, never negative
+    spent = continuation_request(req, [1, 2, 3, 50], donor_tokens=99)
+    assert spent.max_new_tokens == 0
+
+
+# ---------------------------------------------------------------------------
+# spill → reload against real paged runners
+
+
+def _tiered_runner(kv_dtype):
+    tiny = resolve_model("debug:tiny", dtype="float32")
+    r = ModelRunner(tiny.cfg, tiny.params, num_slots=2, max_ctx=96,
+                    prefill_buckets=[16, 32], kv_dtype=kv_dtype,
+                    paged=True, kv_block_tokens=16, prefill_chunk=16,
+                    kv_num_blocks=12)
+    tier = HostTier(8 << 20)
+    r.allocator.attach_tier(tier, pack=r.pack_block, load=r.load_block)
+    return r, tier
+
+
+def _generate(r, prompt, steps=5):
+    s = r.acquire_slot()
+    out = [r.admit(s, list(prompt), temperature=0.0)]
+    out += [int(r.step()[s]) for _ in range(steps)]
+    r.release(s)
+    return out
+
+
+@pytest.mark.parametrize("kv_dtype", ["float32", "int4"])
+def test_spill_reload_preserves_greedy_output(kv_dtype):
+    """A chain evicted to the host tier and re-onboarded on the next
+    prefix hit must decode byte-identically to its first run — for the
+    f32 pool AND the nibble-packed int4 pool (blocks spill packed)."""
+    r, tier = _tiered_runner(kv_dtype)
+    prompt = list(b"spill me to host ram and bring me back intact")
+    ref = _generate(r, prompt)
+    # distinct cold chains crush the 12-block pool: the reference chain
+    # is the LRU victim and MUST spill instead of vanishing
+    filler = 0
+    while r.allocator.spills_total < 1 and filler < 12:
+        _generate(r, [60 + filler] * 33, steps=2)
+        filler += 1
+    assert r.allocator.spills_total >= 1, "pool pressure never spilled"
+    assert tier.stats()["entries"] >= 1
+    again = _generate(r, prompt)
+    assert r.allocator.reloads_total >= 1, "prefix hit never reloaded"
+    assert again == ref
+    assert not r.allocator.check_invariants()
+    ts = r.allocator.tier_stats()
+    assert ts["spills_total"] == r.allocator.spills_total
+    assert ts["reloads_total"] == r.allocator.reloads_total
+
+
+def test_tier_residency_audit_catches_violations():
+    """check_invariants: a chain resident in the HBM pool AND the tier
+    (a reload that forgot to consume its spill) and an over-budget tier
+    are both flagged."""
+    r, tier = _tiered_runner("float32")
+    prompt = list(b"audit this chain for double residency today")
+    _generate(r, prompt)
+    assert not r.allocator.check_invariants()
+    # forge the violation: park a payload under a LIVE pool chain's key
+    key = next(iter(r.allocator._prefix))
+    tier.put(key, _payload())
+    problems = r.allocator.check_invariants()
+    assert any("AND spilled" in p for p in problems)
+    tier.take(key)
+    assert not r.allocator.check_invariants()
+    # over-budget accounting (internal poke: put() itself enforces the
+    # budget, so only a bookkeeping bug can get the tier here)
+    tier._bytes = tier.budget_bytes + 1
+    assert any("over budget" in p for p in r.allocator.check_invariants())
+    tier._bytes = 0
+
+
+# ---------------------------------------------------------------------------
+# directory churn against a real 2-replica fleet
+
+
+def _fleet(name):
+    from localai_tpu.config.app_config import AppConfig
+    from localai_tpu.config.model_config import ModelConfig
+    from localai_tpu.fleet import FleetServingModel
+    from localai_tpu.fleet.replica import InProcessReplica
+    from localai_tpu.models.manager import build_serving_model
+
+    app = AppConfig()
+    mcfg = ModelConfig.model_validate({
+        "name": name, "model": "debug:tiny", "context_size": 256,
+        "parameters": {"temperature": 0.0, "max_tokens": 8},
+        "engine": {"max_slots": 2, "prefill_buckets": [16, 32, 64, 128],
+                   "dtype": "float32", "kv_dtype": "float32",
+                   "kv_block_tokens": 16},
+    })
+
+    def factory(rid, role):
+        return InProcessReplica(
+            rid, role, lambda: build_serving_model(mcfg, app))
+
+    return FleetServingModel(mcfg, app, factory, replicas=2,
+                             prefill_replicas=0, disagg_threshold=10_000)
+
+
+def _req(text, **kw):
+    kw.setdefault("temperature", 0.0)
+    kw.setdefault("max_new_tokens", 6)
+    return GenRequest(prompt=ByteTokenizer().encode(text), **kw)
+
+
+def _raise_evicted(*a, **kw):
+    raise RuntimeError("blocks evicted")
+
+
+def test_stale_directory_entry_costs_one_fetch_never_a_request():
+    """Churn invariant (ISSUE 17 satellite): a directory entry whose
+    holder no longer has the prefix costs exactly one failed sibling
+    fetch — the entry is dropped, the request re-prefills on its placed
+    replica, and NO request ever errors."""
+    fm = _fleet("kv-churn")
+    try:
+        head = "directory churn prefix family head " * 3   # > 4 blocks
+        warm = fm.scheduler.submit(_req(head + " warm"))
+        warm.result(180)
+        assert warm.finish_reason in ("stop", "length")
+        req = _req(head + " again")
+        key = affinity_key(req.prompt, block_tokens=fm.router.block_tokens,
+                           blocks=fm.router.affinity_blocks)
+        ids = [r.id for r in fm.pool.replicas]
+        holder_id = fm.scheduler.directory.holder(key, ids)
+        assert holder_id is not None, "completed request never noted"
+        holder = fm.pool.get(holder_id)
+        other = next(r for r in fm.pool.replicas if r.id != holder_id)
+        # churn: the holder evicted the family's blocks — every export
+        # surface now fails (the shape a dying/LRU-thrashed donor shows)
+        holder.export_cached = _raise_evicted
+        holder.prefill_prefix = _raise_evicted
+        # placement landed away from the warm KV → the fetch runs, fails
+        # once, and the stale entry is gone
+        assert not fm.scheduler._sibling_fetch(req, other, None)
+        assert fm.scheduler.sibling_fallbacks == 1
+        assert fm.scheduler.directory.holder(key, [holder_id]) is None
+        # the REQUEST path stays clean: same family, plain re-prefill
+        h = fm.scheduler.submit(req)
+        h.result(180)
+        assert h.finish_reason in ("stop", "length")
+        assert fm.scheduler.sibling_fallbacks == 1   # one fetch, total
+    finally:
+        fm.close()

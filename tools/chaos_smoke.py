@@ -39,6 +39,14 @@ Scenarios (≥6, see ``SCENARIOS``):
                     block pool conserves
   fleet_failover    a 2-replica fleet loses one replica pre-stream → the
                     router fails over and the request completes
+  live_migration    an in-flight request is migrated between replicas
+                    mid-generation (fleet.kveconomy) → greedy output
+                    byte-identical to an unmigrated run, zero tokens
+                    lost, blocks conserved on BOTH replicas
+  sibling_fetch_donor_death
+                    a directory-known donor dies mid-TransferPrefix →
+                    the stale entry drops, the request re-prefills
+                    locally and completes (never errors)
   respawn_backoff   respawns forced to fail → jittered exponential holds
                     grow (and cap), then clear on successful rejoin
   shed_recover      burn-rate shedding trips under a synthetic overload
@@ -501,6 +509,126 @@ def scenario_fleet_failover() -> dict:
         fm.close()
 
 
+def _fleet_blocks_conserved(fm, timeout: float = 10.0) -> list[str]:
+    """Invariant: with all traffic drained, EVERY in-process replica's
+    allocator conserves its pool. Donor-side release after a cancel or
+    migration drains asynchronously — poll until clean or timeout."""
+    deadline = time.monotonic() + timeout
+    while True:
+        problems = []
+        for r in fm.pool.replicas:
+            runner = getattr(getattr(r, "sm", None), "runner", None)
+            if runner is not None:
+                problems += [f"{r.id}: {p}"
+                             for p in _blocks_conserved(runner)]
+        if not problems or time.monotonic() > deadline:
+            return problems
+        time.sleep(0.1)
+
+
+def scenario_live_migration() -> dict:
+    """An in-flight request is migrated between replicas mid-generation
+    (fleet.kveconomy live slot migration): the donor snapshots its KV at
+    a dispatch boundary, the destination resumes from the transferred
+    prefix + full token record, and the greedy output is byte-identical
+    to an unmigrated run — zero tokens lost, usage spliced across both
+    halves, blocks conserved on BOTH replicas."""
+    prompt = "migrate this request between replicas mid-generation"
+    fm = _build_fleet("chaos-migrate")
+    try:
+        ref = fm.scheduler.submit(_req(prompt, max_new_tokens=64))
+        ref.result(180)
+        problems = _resolved([ref])
+        migrated = False
+        h = ref
+        for _ in range(4):  # racing generation: retry if it finishes first
+            h = fm.scheduler.submit(_req(prompt, max_new_tokens=64))
+            deadline = time.monotonic() + 60
+            while h.t_first_token is None and time.monotonic() < deadline:
+                time.sleep(0.005)
+            if fm.scheduler.migrate_inflight(h):
+                migrated = True
+                break
+            h.result(180)  # finished before the migration landed — retry
+        h.result(180)
+        problems += _resolved([h])
+        if not migrated:
+            problems.append("migrate_inflight never landed mid-generation")
+        if h.finish_reason not in ("stop", "length"):
+            problems.append(
+                f"migrated request finished {h.finish_reason!r}")
+        if h.text != ref.text:
+            problems.append(
+                f"migrated output diverged from the unmigrated run: "
+                f"{h.text!r} != {ref.text!r}")
+        if h.completion_tokens != ref.completion_tokens:
+            problems.append(
+                f"usage splice lost tokens: {h.completion_tokens} != "
+                f"{ref.completion_tokens}")
+        if migrated and fm.scheduler.migrations < 1:
+            problems.append("migration counter never incremented")
+        problems += _fleet_blocks_conserved(fm)
+        return {"problems": problems,
+                "migrations": fm.scheduler.migrations,
+                "migration_fallbacks": fm.scheduler.migration_fallbacks,
+                "completion_tokens": h.completion_tokens}
+    finally:
+        fm.close()
+
+
+def scenario_sibling_fetch_donor_death() -> dict:
+    """The directory routes a request at the replica whose warm KV it
+    tracks; that holder dies pre-stream (forcing a failover away from
+    the warm KV) and dies AGAIN mid-TransferPrefix when the failover
+    replica tries to pull the prefix from it as a sibling donor: the
+    stale directory entry is dropped, the request re-prefills locally
+    and completes — a dying donor never becomes a request error."""
+    from localai_tpu import faults
+    from localai_tpu.fleet.router import affinity_key
+    from localai_tpu.utils.tokenizer import ByteTokenizer
+
+    head = ("shared prefix head " * 5).strip()  # 94 tokens > 4×16 blocks
+    fm = _build_fleet("chaos-donor")
+    try:
+        warm = fm.scheduler.submit(_req(head + " warm", max_new_tokens=6))
+        warm.result(180)
+        problems = _resolved([warm])
+        tokens = ByteTokenizer().encode(head + " again")
+        key = affinity_key(tokens, block_tokens=fm.router.block_tokens,
+                           blocks=fm.router.affinity_blocks)
+        holder = fm.scheduler.directory.holder(
+            key, [r.id for r in fm.pool.replicas])
+        if holder is None:
+            problems.append("warm request never registered in directory")
+            return {"problems": problems}
+        faults.arm(faults.FaultSpec(site="worker.stream", mode="raise",
+                                    match=holder, times=1))
+        faults.arm(faults.FaultSpec(site="fleet.sibling", mode="raise",
+                                    match=holder, times=1))
+        h = fm.scheduler.submit(_req(head + " again", max_new_tokens=6))
+        h.result(180)
+        problems += _resolved([h])
+        if h.finish_reason not in ("stop", "length"):
+            problems.append(
+                f"request finished {h.finish_reason!r} — a dead donor "
+                f"must degrade to a re-prefill, never an error")
+        if fm.scheduler.sibling_fallbacks < 1:
+            problems.append("sibling fetch never fell back")
+        if fm.scheduler.directory.holder(key, [holder]) is not None:
+            problems.append("stale directory entry survived the fallback")
+        fired = {s["site"]: s["fired"] for s in faults.snapshot()}
+        if not fired.get("fleet.sibling"):
+            problems.append(f"fleet.sibling fault never fired: {fired}")
+        problems += _fleet_blocks_conserved(fm)
+        return {"problems": problems,
+                "sibling_fallbacks": fm.scheduler.sibling_fallbacks,
+                "directory": fm.scheduler.directory.stats(),
+                "routed": dict(fm.router.routed)}
+    finally:
+        faults.clear()
+        fm.close()
+
+
 def scenario_respawn_backoff() -> dict:
     """A dead replica whose respawn keeps failing: retries are spaced by
     growing jittered-exponential holds (capped), and a successful rejoin
@@ -946,6 +1074,8 @@ SCENARIOS = {
     "pool_exhaustion": scenario_pool_exhaustion,
     "spec_divergence": scenario_spec_divergence,
     "fleet_failover": scenario_fleet_failover,
+    "live_migration": scenario_live_migration,
+    "sibling_fetch_donor_death": scenario_sibling_fetch_donor_death,
     "respawn_backoff": scenario_respawn_backoff,
     "shed_recover": scenario_shed_recover,
     "network_partition": scenario_network_partition,

@@ -45,6 +45,27 @@ PROMPTS = (
     "draft a status update for the oncall",
 )
 
+# prefix-heavy profile: a few long shared "system prompt" heads with tiny
+# unique tails — every request in a family shares its first several
+# KV blocks, which is what exercises the whole fleet KV economy (router
+# affinity + prefix directory hits, block-level sharing, HBM→host spills
+# of cold families and their reloads). Each head is long enough to span
+# multiple 16-token blocks on the byte tokenizer.
+PREFIX_PROMPTS = (
+    "You are the on-call assistant for the fleet serving tier. Answer "
+    "tersely, cite runbook sections when relevant, and never invent "
+    "replica names. Operator question follows:",
+    "System: translate the user's message to French, preserving any "
+    "inline code spans and replica identifiers verbatim. Do not add "
+    "commentary or notes of any kind. User message:",
+    "Context: the paged KV allocator shares whole blocks between "
+    "requests with identical token prefixes; cold cached blocks spill "
+    "to host RAM and reload on a hit. Explain for the question:",
+    "Instructions: produce a one-line status update for the deploy "
+    "channel based on the report below, leading with the headline "
+    "metric and ending with the owning team. Report:",
+)
+
 DEFAULT_MIX = {"chat": 0.6, "embeddings": 0.2, "batch": 0.2}
 
 
@@ -73,13 +94,24 @@ class LoadGen:
     def __init__(self, *, mix: Optional[dict[str, float]] = None,
                  tenants: Optional[list[Tenant]] = None,
                  rate: float = 8.0, seed: int = 0,
-                 max_tokens: int = 8):
+                 max_tokens: int = 8, profile: str = "mixed"):
         self.mix = {k: float(v) for k, v in (mix or DEFAULT_MIX).items()
                     if float(v) > 0}
         self.tenants = list(tenants or [Tenant("default")])
         self.rate = max(0.1, rate)        # mean arrivals per second
         self.rng = random.Random(seed)
         self.max_tokens = max_tokens
+        if profile not in ("mixed", "prefix_heavy"):
+            raise ValueError(f"unknown load profile {profile!r}")
+        self.profile = profile
+
+    def _prompt(self, tenant: Tenant, i: int) -> str:
+        if self.profile == "prefix_heavy":
+            # long shared head + tiny unique tail: block-aligned prefix
+            # reuse across the family, distinct completions per request
+            return (self.rng.choice(PREFIX_PROMPTS)
+                    + f" [{tenant.name}/{i}]")
+        return self.rng.choice(PROMPTS) + f" [{tenant.name}/{i}]"
 
     def _pick(self, weighted: list[tuple[Any, float]]) -> Any:
         total = sum(w for _, w in weighted)
@@ -115,7 +147,7 @@ class LoadGen:
             tenant = self._pick(tenants)
             counts[kind] = counts.get(kind, 0) + 1
             by_tenant[tenant.name] = by_tenant.get(tenant.name, 0) + 1
-            text = self.rng.choice(PROMPTS) + f" [{tenant.name}/{i}]"
+            text = self._prompt(tenant, i)
             trace_id = f"loadgen-{tenant.name}-{i}"
             if kind == "embeddings":
                 def embed(text=text, tenant=tenant):
@@ -265,6 +297,12 @@ def main(argv=None) -> int:
     parser.add_argument("--mix", default="",
                         help='kind mix, e.g. "chat:0.5,embeddings:0.3,'
                              'batch:0.2" (default 0.6/0.2/0.2)')
+    parser.add_argument("--profile", default="mixed",
+                        choices=("mixed", "prefix_heavy"),
+                        help="prompt profile: mixed short prompts, or "
+                             "prefix_heavy (long shared heads + unique "
+                             "tails — drives prefix sharing, the fleet "
+                             "directory, and KV tier spill/reload)")
     args = parser.parse_args(argv)
 
     mix = None
@@ -287,7 +325,7 @@ def main(argv=None) -> int:
     try:
         gen = LoadGen(mix=mix, tenants=parse_tenants(args.tenants),
                       rate=args.rate, seed=args.seed,
-                      max_tokens=args.max_tokens)
+                      max_tokens=args.max_tokens, profile=args.profile)
         summary = gen.run(EngineSink(sm, max_tokens=args.max_tokens),
                           total=args.total)
     finally:

@@ -146,6 +146,19 @@ REQUIRED_FLEET = (
     'localai_fleet_prefix_transfers_total{model="fleet-smoke"} 1',
     'localai_fleet_prefix_transfer_bytes_total{model="fleet-smoke"}',
 )
+# fleet KV-economy series (round 17): the 2-replica tiered fleet must
+# render directory traffic, at least one sibling prefix transfer, and a
+# real HBM→host spill→reload round trip (values asserted in-code by
+# check_kveconomy; the exposition check pins the series names)
+REQUIRED_KVECONOMY = (
+    'localai_fleet_directory_entries{model="fleet-kv"}',
+    'localai_fleet_directory_hits_total{model="fleet-kv"}',
+    'localai_fleet_sibling_transfers_total{model="fleet-kv"}',
+    'localai_fleet_sibling_transfer_bytes_total{model="fleet-kv"}',
+    'localai_kv_tier_blocks{model="fleet-kv"}',
+    'localai_kv_tier_spills_total{model="fleet-kv"}',
+    'localai_kv_tier_reloads_total{model="fleet-kv"}',
+)
 # fleet telemetry plane series (round 15): the worker-process fleet must
 # come up healthy, the anomaly profiler must capture EXACTLY one stall-
 # triggered profile (the cooldown eats the second), and the trace-ring
@@ -361,6 +374,143 @@ def check_fleet(registry) -> list[str]:
         fm.scheduler.export_gauges()
     finally:
         fm.close()
+    return problems
+
+
+def check_kveconomy(registry) -> list[str]:
+    """Round-17 fleet KV economy: a 2-replica fleet with a deliberately
+    small block pool and the host-RAM tier armed (LOCALAI_KV_TIER_MB)
+    serves a tools.loadgen prefix-heavy workload. Asserts the three
+    planes end-to-end: the prefix directory takes routing hits, a
+    replica loss forces at least one sibling TransferPrefix warm-up on
+    the failover path, and prefix-pool pressure drives at least one
+    HBM→host spill that a later family re-request reloads. The
+    localai_fleet_directory_* / localai_fleet_sibling_* /
+    localai_kv_tier_* exposition strings are checked by
+    REQUIRED_KVECONOMY after this returns."""
+    import os
+
+    from localai_tpu import faults
+    from localai_tpu.config.app_config import AppConfig
+    from localai_tpu.config.model_config import ModelConfig
+    from localai_tpu.engine.scheduler import GenRequest
+    from localai_tpu.fleet import FleetServingModel
+    from localai_tpu.fleet.replica import InProcessReplica
+    from localai_tpu.fleet.router import affinity_key
+    from localai_tpu.models.manager import build_serving_model
+    from localai_tpu.obs.metrics import update_engine_gauges
+    from tools.loadgen import PREFIX_PROMPTS, EngineSink, LoadGen, Tenant
+
+    problems: list[str] = []
+    prev_tier = os.environ.get("LOCALAI_KV_TIER_MB")
+    os.environ["LOCALAI_KV_TIER_MB"] = "8"
+    app = AppConfig()
+    mcfg = ModelConfig.model_validate({
+        "name": "fleet-kv", "model": "debug:tiny", "context_size": 256,
+        "parameters": {"temperature": 0.0, "max_tokens": 6},
+        # 40-block prefix pool per replica: the four prefix-heavy
+        # families (~12 blocks each) plus their unique tails overflow it,
+        # so cold chains MUST spill to the tier instead of vanishing
+        "engine": {"max_slots": 2, "prefill_buckets": [16, 32, 64, 128],
+                   "dtype": "float32", "kv_dtype": "float32",
+                   "kv_block_tokens": 16, "kv_num_blocks": 40},
+    })
+
+    def factory(rid, role):
+        return InProcessReplica(
+            rid, role, lambda: build_serving_model(mcfg, app))
+
+    fm = FleetServingModel(mcfg, app, factory, replicas=2,
+                           prefill_replicas=0, disagg_threshold=10_000)
+    tok = fm.tokenizer
+
+    def submit(text):
+        return fm.scheduler.submit(GenRequest(
+            prompt=tok.encode(text), max_new_tokens=6, temperature=0.0))
+
+    try:
+        # -- directory traffic: prefix-heavy families repeat, so every
+        # repeat after the first routes on a directory hit
+        gen = LoadGen(mix={"chat": 1.0}, rate=50.0, max_tokens=6,
+                      profile="prefix_heavy",
+                      tenants=[Tenant("kv-a"), Tenant("kv-b")])
+        summary = gen.run(EngineSink(fm, max_tokens=6), total=16,
+                          timeout_s=300.0)
+        if summary.get("errors"):
+            problems.append(f"prefix-heavy load errors: {summary['errors']}")
+        # -- sibling transfer: kill the directory-known holder of one
+        # family pre-stream; the failover replica must pull the family's
+        # warm prefix from the holder over TransferPrefix before
+        # dispatching (placement away from warm KV ≠ a cold re-prefill)
+        warm = submit(PREFIX_PROMPTS[0] + " [sibling/warm]")
+        warm.result(300)
+        key = affinity_key(tok.encode(PREFIX_PROMPTS[0] + " [sibling/hit]"),
+                           block_tokens=fm.router.block_tokens,
+                           blocks=fm.router.affinity_blocks)
+        holder = fm.scheduler.directory.holder(
+            key, [r.id for r in fm.pool.replicas])
+        if holder is None:
+            problems.append("prefix family never registered in directory")
+        else:
+            faults.arm(faults.FaultSpec(site="worker.stream", mode="raise",
+                                        match=holder, times=1))
+            try:
+                h = submit(PREFIX_PROMPTS[0] + " [sibling/hit]")
+                h.result(300)
+                if h.finish_reason not in ("stop", "length"):
+                    problems.append(
+                        f"sibling-path request finished {h.finish_reason!r}")
+            finally:
+                faults.clear()
+        # -- spill→reload round trip: a dozen cold filler families crush
+        # both replicas' 40-block pools (the prefix families become LRU
+        # victims → spill to host RAM), then every family re-request
+        # re-onboards its spilled chain
+        fillers = [
+            submit(f"cold filler family {k:02d} keeps the prefix pool "
+                   f"under sustained eviction pressure " * 3)
+            for k in range(12)
+        ]
+        for h in fillers:
+            h.result(300)
+        for i, head in enumerate(PREFIX_PROMPTS):
+            submit(head + f" [reload/{i}]").result(300)
+        # -- assertions across both replicas' allocators
+        spills = reloads = 0
+        for r in fm.pool.replicas:
+            ts = r.sm.runner.allocator.tier_stats()
+            if ts is None:
+                problems.append(f"{r.id}: tier never attached "
+                                f"(LOCALAI_KV_TIER_MB ignored)")
+                continue
+            spills += ts["spills_total"]
+            reloads += ts["reloads_total"]
+        if spills < 1:
+            problems.append("no HBM→host spills under pool pressure")
+        if reloads < 1:
+            problems.append(
+                f"no spill→reload round trip ({spills} spills)")
+        st = fm.scheduler.directory.stats()
+        if st["hits"] < 1:
+            problems.append(f"directory took no routing hits: {st}")
+        if fm.scheduler.sibling_transfers < 1:
+            problems.append(
+                f"no sibling prefix transfer "
+                f"({fm.scheduler.sibling_fallbacks} fallbacks)")
+        if fm.scheduler.sibling_transfer_bytes <= 0 \
+                and fm.scheduler.sibling_transfers > 0:
+            problems.append("sibling transfer moved 0 bytes")
+        # scrape-time refresh, exactly what GET /metrics does: the tier
+        # roll-up rides the engine gauges, the directory its own pane
+        update_engine_gauges("fleet-kv", fm.scheduler.metrics())
+        fm.scheduler.export_gauges()
+    finally:
+        faults.clear()
+        fm.close()
+        if prev_tier is None:
+            os.environ.pop("LOCALAI_KV_TIER_MB", None)
+        else:
+            os.environ["LOCALAI_KV_TIER_MB"] = prev_tier
     return problems
 
 
@@ -785,6 +935,7 @@ def main(argv=None) -> int:
         problems += check_slo_overload(REGISTRY)
         problems += check_batch(sched, REGISTRY, args.batch_out)
         problems += check_fleet(REGISTRY)
+        problems += check_kveconomy(REGISTRY)
         problems += check_fleetview(REGISTRY, args.fleet_flight_out)
         problems += check_anomaly_capture(REGISTRY, args.profile_dir)
         if args.loopsan:
@@ -829,7 +980,7 @@ def main(argv=None) -> int:
     missing = [s for s in (REQUIRED_SERIES + REQUIRED_FAMILIES
                            + REQUIRED_INTROSPECTION + REQUIRED_SLO
                            + REQUIRED_BATCH + REQUIRED_FLEET
-                           + REQUIRED_FLEETVIEW)
+                           + REQUIRED_KVECONOMY + REQUIRED_FLEETVIEW)
                if s not in exposition]
     if missing or problems:
         print("FAIL: missing engine telemetry in /metrics exposition:")
