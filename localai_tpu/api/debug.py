@@ -40,6 +40,12 @@ slo_shed / step_p99_regression), triggering trace id, reason, and
 artifact path, plus the manager's rate-limit state (cooldown, per-hour
 budget, skip counts).
 
+``GET /debug/history`` / ``/debug/history/{series}`` — the persistent
+multi-resolution metrics history (obs.history): 1 s / 10 s / 5 m rings of
+every engine and usage series, queryable per resolution with ``?res=``
+and ``?since=``. The "what did occupancy look like an hour ago" view —
+survives restarts via the snapshot dir (``LOCALAI_HISTORY_DIR``).
+
 ``GET /debug/kv`` — per-model paged block-pool audit: allocator stats,
 live tables, and the result of ``BlockAllocator.check_invariants()``
 (block conservation + refcount sanity). Any violation is a leak.
@@ -217,6 +223,42 @@ async def profiles(request: web.Request) -> web.Response:
     return web.json_response(PROFILER.report())
 
 
+async def history_index(request: web.Request) -> web.Response:
+    """GET /debug/history — the multi-resolution metrics history
+    (obs.history): every recorded series name plus the ring geometry, so
+    a dashboard can enumerate before querying."""
+    from localai_tpu.obs import history as obs_history
+
+    return web.json_response({
+        "series": obs_history.HISTORY.series_names(),
+        "resolutions_s": list(obs_history.RESOLUTIONS),
+        "capacity": {str(r): c
+                     for r, c in obs_history.CAPACITY.items()},
+    })
+
+
+async def history_series(request: web.Request) -> web.Response:
+    """GET /debug/history/{series}?res=<1|10|300>&since=<unix ts> — one
+    series' ring at one resolution. Counters return the bucket max
+    (monotone totals), gauges the bucket mean. Pure in-memory ring reads
+    — no device work, no locks held across the render."""
+    from localai_tpu.obs import history as obs_history
+
+    name = request.match_info["series"]
+    try:
+        res = int(request.query.get("res", 10))
+    except ValueError:
+        raise web.HTTPBadRequest(text="res must be an integer (seconds)")
+    try:
+        since = float(request.query.get("since", 0.0))
+    except ValueError:
+        raise web.HTTPBadRequest(text="since must be a unix timestamp")
+    out = obs_history.HISTORY.query(name, res=res, since=since)
+    if out is None:
+        raise web.HTTPNotFound(text=f"unknown series {name!r}")
+    return web.json_response(out)
+
+
 async def kv(request: web.Request) -> web.Response:
     state = _state(request)
     loop = asyncio.get_running_loop()
@@ -323,6 +365,8 @@ def routes() -> list[web.RouteDef]:
         web.get("/debug/flight", flight),
         web.get("/debug/fleet/flight", fleet_flight),
         web.get("/debug/profiles", profiles),
+        web.get("/debug/history", history_index),
+        web.get("/debug/history/{series}", history_series),
         web.get("/debug/kv", kv),
         web.get("/debug/faults", faults_get),
         web.post("/debug/faults", faults_post),

@@ -20,6 +20,7 @@ from localai_tpu.api.schema import OpenAIRequest
 from localai_tpu.config.model_config import ModelConfig
 from localai_tpu.engine.scheduler import GenHandle, GenRequest
 from localai_tpu.models.manager import ServingModel
+from localai_tpu.obs import ledger as _obs_ledger
 
 log = logging.getLogger(__name__)
 
@@ -205,6 +206,12 @@ def shed_check(model: str, scheduler: Any = None) -> None:
     retry = obs_slo.SLO.shed(model)
     if scheduler is not None:
         scheduler.note_shed()
+    # waste decomposition (obs.ledger): a shed admission is one whole
+    # refused request — attributed to the caller's tenant bucket here,
+    # the only tier that ever sees it
+    _obs_ledger.LEDGER.note_waste(
+        "shed", model=model, tenant=_obs_ledger.current_tenant(),
+        requests=1)
     raise web.HTTPTooManyRequests(
         text=f"model {model!r} is shedding load (SLO burn rate over "
              f"threshold); retry after {retry}s",
@@ -246,6 +253,7 @@ def build_gen_request(
     correlation_id: str = "",
     trace_id: str = "",
     priority: int = 0,
+    tenant: str = "",
 ) -> GenRequest:
     p = cfg.parameters
     mm_flat = mm_pos = None
@@ -283,6 +291,11 @@ def build_gen_request(
         constraint=constraint,
         correlation_id=correlation_id or req.user or "",
         trace_id=trace_id or correlation_id,
+        # usage accounting: the auth middleware's contextvar reaches here
+        # even through executor threads (api.server.ContextExecutor), so
+        # every HTTP-born request carries its tenant bucket without each
+        # endpoint threading it explicitly
+        tenant=tenant or _obs_ledger.current_tenant(),
         stream=bool(req.stream),
         mm_embeds=mm_flat,
         mm_positions=mm_pos,

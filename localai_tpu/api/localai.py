@@ -71,6 +71,9 @@ async def metrics(request: web.Request) -> web.Response:
     from localai_tpu.obs.device import update_device_gauges
     from localai_tpu.obs.metrics import update_engine_gauges
 
+    from localai_tpu.obs.history import HISTORY
+    from localai_tpu.obs.ledger import LEDGER
+
     state = _state(request)
     # a fleet-served model's metrics() pulls one stats RPC per replica —
     # off the event loop, or a wedged replica freezes every endpoint for
@@ -81,6 +84,12 @@ async def metrics(request: web.Request) -> web.Response:
     for name, m in engine_metrics.items():
         if isinstance(m, dict):
             update_engine_gauges(name, m)
+            # multi-resolution history: every scrape doubles as a
+            # sampling tick (host-side dict reads — no device work)
+            HISTORY.observe_engine(name, m)
+    # usage ledger → tenant/goodput/waste families + history series
+    LEDGER.export(REGISTRY)
+    HISTORY.observe_ledger(LEDGER)
     # fleet replica-state gauges refresh at scrape time too (host-side
     # state reads only; the routed/transfer counters are event-driven)
     for sm in state.manager.loaded_snapshot().values():
@@ -119,6 +128,54 @@ async def metrics(request: web.Request) -> web.Response:
         content_type="text/plain",
         charset="utf-8",
     )
+
+
+async def usage(request: web.Request) -> web.Response:
+    """GET /v1/usage — the usage accounting plane (obs.ledger): per-tenant
+    delivered tokens / dispatch-ms / queue-wait / KV-block-seconds by
+    (model, lane), the goodput-vs-waste decomposition, and — for
+    fleet-served models — per-replica drill-down panes harvested over
+    GetTelemetry.
+
+    Query params: ``?since=<unix ts>`` or ``?window=<seconds>`` narrow
+    the per-tenant rows to the ledger's event ring (bounded — the
+    response says how far back its coverage actually reaches); without
+    them the lifetime totals answer. Tenants are hashed buckets
+    (``t-<sha256/12>``) or ``anonymous`` — a raw API key never appears
+    here. ``?replicas=1`` adds the fleet drill-down (one bounded RPC per
+    replica, off the event loop)."""
+    from localai_tpu.obs.fleetview import fleet_usage
+    from localai_tpu.obs.ledger import LEDGER
+
+    def num(name):
+        raw = request.query.get(name)
+        if raw is None or raw == "":
+            return None
+        try:
+            return float(raw)
+        except ValueError:
+            raise web.HTTPBadRequest(text=f"{name} must be a number")
+
+    since = num("since")
+    window = num("window")
+    state = _state(request)
+    want_replicas = request.query.get("replicas") not in (None, "", "0")
+
+    def build() -> dict:
+        payload = LEDGER.usage_payload(since=since, window=window)
+        if want_replicas:
+            panes = {}
+            for name, sm in state.manager.loaded_snapshot().items():
+                if getattr(sm, "pool", None) is not None:
+                    panes[name] = fleet_usage(sm)
+            payload["replicas"] = panes
+        return payload
+
+    # the fleet drill-down pulls one bounded RPC per replica — executor,
+    # never the event loop (same rule as every other harvest endpoint)
+    loop = asyncio.get_running_loop()
+    return web.json_response(await loop.run_in_executor(
+        _state(request).executor, build))
 
 
 async def slo_report(_request: web.Request) -> web.Response:
@@ -338,6 +395,7 @@ def routes() -> list[web.RouteDef]:
         web.get("/readyz", readyz),
         web.get("/version", version),
         web.get("/metrics", metrics),
+        web.get("/v1/usage", usage),
         web.get("/v1/slo", slo_report),
         web.get("/v1/fleet", fleet_status),
         web.post("/federated/register", fleet_register),

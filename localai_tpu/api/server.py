@@ -36,6 +36,9 @@ STATE_KEY = web.AppKey("state", object)
 # per-request trace id, set by trace_middleware (a plain str key: aiohttp
 # Requests are MutableMappings; handlers read it via request.get())
 TRACE_KEY = "trace_id"
+# per-request tenant bucket (obs.ledger.derive_tenant output — hashed
+# key / anonymous; NEVER the raw key), set by auth_middleware
+TENANT_KEY = "tenant"
 # observability/probe endpoints whose HTTP spans are pure scrape noise:
 # they still get a trace id, but are not recorded into the trace store
 # (a 15s Prometheus scrape would otherwise dominate the http ring)
@@ -105,6 +108,12 @@ class AppState:
 
         obs_profiler.install_from_env(
             str(self.config.backend_assets_path or "."))
+        # multi-resolution metrics history (obs.history): re-onboard the
+        # last snapshot and start the periodic writer thread when
+        # LOCALAI_HISTORY_DIR is set — the series survive restarts
+        from localai_tpu.obs import history as obs_history
+
+        obs_history.install_from_env()
         self.galleries: list[Gallery] = [
             Gallery(name=g.get("name", ""), url=g.get("url", ""))
             for g in self.config.galleries
@@ -304,18 +313,31 @@ async def trace_middleware(request: web.Request, handler):
 
 @web.middleware
 async def auth_middleware(request: web.Request, handler):
+    """Key auth + tenant derivation (obs.ledger): the ledger's tenant
+    bucket is stamped HERE — a contextvar the ContextExecutor propagates
+    into engine waits (build_gen_request resolves it), plus a request
+    key for handlers. Always derive_tenant()'s output, never the raw
+    key: auth-off/exempt traffic lands in the ``anonymous`` bucket."""
+    from localai_tpu.obs import ledger as obs_ledger
+
     state = request.app[STATE_KEY]
     keys = state.config.api_keys
+
+    def _admit(tenant: str):
+        request[TENANT_KEY] = tenant
+        obs_ledger.set_current_tenant(tenant)
+        return handler(request)
+
     if not keys or request.path in AUTH_EXEMPT:
-        return await handler(request)
+        return await _admit(obs_ledger.ANONYMOUS)
     if (request.method == "GET" and not state.config.disable_webui
             and (request.path.startswith(UI_PREFIXES)
                  or request.path in UI_EXACT)):
-        return await handler(request)
+        return await _admit(obs_ledger.ANONYMOUS)
     header = request.headers.get("Authorization", "")
     token = header.removeprefix("Bearer ").strip()
     if token and any(secrets.compare_digest(token, k) for k in keys):
-        return await handler(request)
+        return await _admit(obs_ledger.derive_tenant(token))
     return web.json_response(
         error_body("invalid or missing API key",
                    kind="authentication_error", code=401),

@@ -96,6 +96,7 @@ def _page(title: str, body: str, script: str = "") -> web.Response:
     <a href="/swarm">Swarm</a>
     <a href="/slo">SLO</a>
     <a href="/fleet">Fleet</a>
+    <a href="/usage">Usage</a>
     <a href="/batches">Batches</a>
   </nav>
   <input id="apikey" placeholder="API key (if set)"
@@ -838,6 +839,81 @@ setInterval(refresh, 2000);
 
 
 # ---------------------------------------------------------------------------
+# usage accounting
+
+
+async def usage_page(request: web.Request) -> web.Response:
+    """GET /usage — usage & goodput panel over GET /v1/usage: per-tenant
+    cost rows (delivered tokens, dispatch ms, queue wait, KV-block-
+    seconds by model/lane), the goodput ratio, and the waste
+    decomposition by reason. Tenants are hashed buckets — no key
+    material ever reaches this page. Read-side polling only."""
+    body = """
+<div class="card">
+  <div class="row"><h2 style="flex:1">Usage</h2>
+    <span id="goodput" class="badge">…</span></div>
+  <div id="tenants" class="dim">loading…</div>
+</div>
+<div class="card">
+  <h2>Waste decomposition</h2>
+  <div id="waste" class="dim">loading…</div>
+  <p class="dim">Goodput = tokens delivered on natural completions
+  (stop/length). Waste classes: speculation-rejected draft tokens,
+  failover/migration re-prefills, shed admissions, cancelled and
+  NaN-quarantined requests.</p>
+</div>"""
+    script = """
+function fmt(v, d) {
+  return (v === null || v === undefined) ? '—' : Number(v).toFixed(d ?? 1);
+}
+function table(out, headers, rows, empty) {  // textContent only: API
+  out.textContent = '';                      // data is untrusted
+  const t = document.createElement('table');
+  const hr = t.insertRow();
+  headers.forEach(h => {
+    const th = document.createElement('th');
+    th.textContent = h; hr.appendChild(th);
+  });
+  rows.forEach(r => {
+    const tr = t.insertRow();
+    r.forEach(v => tr.insertCell().textContent = v);
+  });
+  out.appendChild(t);
+  if (!rows.length) out.textContent = empty || 'no data yet';
+}
+async function refresh() {
+  try {
+    const d = await (await fetch('/v1/usage',
+                                 {headers: authHeaders()})).json();
+    const badge = document.getElementById('goodput');
+    const g = d.goodput || {};
+    badge.textContent = 'goodput ' + fmt(100 * (g.goodput_ratio ?? 1)) + '%';
+    badge.className = 'badge' +
+      ((g.goodput_ratio ?? 1) >= 0.9 ? ' loaded' : '');
+    const rows = (d.data || []).map(p =>
+      [p.tenant, p.model + '/' + p.lane, p.requests, p.delivered_tokens,
+       p.prompt_tokens, fmt(p.dispatch_ms, 0), fmt(p.queue_wait_ms, 0),
+       fmt(p.kv_block_seconds, 1), p.waste_tokens]);
+    table(document.getElementById('tenants'),
+          ['tenant', 'model/lane', 'req', 'delivered', 'prompt',
+           'dispatch ms', 'queue ms', 'kv blk·s', 'wasted'], rows,
+          'no attributed requests yet');
+    const wrows = (d.waste || []).map(c =>
+      [c.reason, c.model, c.tokens, c.requests]);
+    table(document.getElementById('waste'),
+          ['reason', 'model', 'tokens', 'requests'], wrows,
+          'no waste recorded');
+  } catch (e) {
+    document.getElementById('tenants').textContent = 'error: ' + e.message;
+  }
+}
+refresh();
+setInterval(refresh, 2000);
+"""
+    return _page("Usage", body, script)
+
+
+# ---------------------------------------------------------------------------
 # offline batch jobs
 
 
@@ -912,7 +988,7 @@ UI_PREFIXES = ("/browse", "/chat/", "/text2image/", "/tts/", "/talk/")
 # exact-match key-free pages (prefix matching would also exempt JSON
 # sub-routes like /swarm/nodes, which must stay API-key-protected — that
 # endpoint performs server-side fetches of the operator-named router)
-UI_EXACT = ("/swarm", "/slo", "/batches", "/fleet")
+UI_EXACT = ("/swarm", "/slo", "/batches", "/fleet", "/usage")
 
 
 def wants_html(request: web.Request) -> bool:
@@ -935,4 +1011,5 @@ def routes() -> list[web.RouteDef]:
         web.get("/slo", slo_page),
         web.get("/batches", batches_page),
         web.get("/fleet", fleet_page),
+        web.get("/usage", usage_page),
     ]

@@ -39,6 +39,7 @@ from localai_tpu.engine.stream import IncrementalDetokenizer, StopChecker
 from localai_tpu.faults import registry as _faults
 from localai_tpu.obs import compile as obs_compile
 from localai_tpu.obs import flight as obs_flight
+from localai_tpu.obs import ledger as obs_ledger
 from localai_tpu.obs import profiler as obs_profiler
 from localai_tpu.obs import watchdog as obs_watchdog
 from localai_tpu.obs.engine import EngineTelemetry
@@ -97,6 +98,11 @@ class GenRequest:
     # that spawned it (obs subsystem); crosses the worker RPC boundary as
     # gRPC metadata (worker.rpc.trace_metadata)
     trace_id: str = ""
+    # usage accounting (obs.ledger): the derive_tenant() bucket of the
+    # request's API key — NEVER the raw key. Non-empty means "feed the
+    # cost ledger at the terminal event"; crosses the worker RPC boundary
+    # as gRPC metadata (worker.rpc.tenant_metadata)
+    tenant: str = ""
     # an SSE client is attached: the scheduler bounds delivery lag by
     # shrinking the per-dispatch step count while this request is active
     stream: bool = False
@@ -146,6 +152,10 @@ class GenHandle:
         # snapshotted into the prompt cache at release — set by the
         # replica's migrate_out before cancel()
         self.migrate_export = False
+        # NaN-guard receipt: set by Scheduler._poisoned just before the
+        # error release, so the ledger classifies the waste as
+        # nan_quarantine instead of a generic error
+        self.nan_poisoned = False
         # global admission order (engine thread stamps it in _start):
         # lane-ordering tests and forensics read it; None until admitted
         self.admit_index: Optional[int] = None
@@ -242,6 +252,9 @@ class Scheduler:
         # request-lifecycle spans + engine histograms (obs subsystem); the
         # manager names it after the model, tests may inject their own
         self.telemetry = telemetry or EngineTelemetry()
+        # the ledger's KV-block-seconds unit follows this runner's actual
+        # paged block size (contiguous runners keep the 16-token default)
+        self.telemetry.kv_block_tokens = getattr(runner, "block_tokens", 16)
         # stall watchdog: every blocking device round-trip this engine
         # makes (drain here, syncs inside the runner) is heartbeat-guarded;
         # no progress past the deadline → engine_stalled gauge + a
@@ -658,6 +671,13 @@ class Scheduler:
             compile=fresh,
         )
         self._flight_mark = emitted
+        if spec_proposed > spec_accepted:
+            # rejected draft tokens are device work the ring never counts
+            # as emitted — the waste decomposition's spec_rejected class
+            # (a short-lock dict update; safe at drain cadence)
+            obs_ledger.LEDGER.note_waste(
+                "spec_rejected", tokens=spec_proposed - spec_accepted,
+                model=self.telemetry.model or "engine")
         if self._kv_check:
             self._check_kv_invariants()
 
@@ -867,6 +887,9 @@ class Scheduler:
             "request, quarantining the slot for %d dispatches",
             slot, ctx.handle.request.correlation_id or ctx.handle.id,
             self._nan_quarantine)
+        # the ledger's waste class for this failure is nan_quarantine,
+        # not a generic error — stamp before the release feeds telemetry
+        ctx.handle.nan_poisoned = True
         self._release(slot, ctx, "error")
         # _release returned the slot to the free list; pull it back out
         # until the quarantine window passes

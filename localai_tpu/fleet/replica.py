@@ -152,7 +152,8 @@ class BaseReplica:
     def _dial(self, timeout: float) -> bool:
         raise NotImplementedError
 
-    def predict_stream(self, opts: Any, trace_id: str = "") -> Iterator:
+    def predict_stream(self, opts: Any, trace_id: str = "",
+                       tenant: str = "") -> Iterator:
         raise NotImplementedError
 
     def prefill_prefix(self, opts: Any, trace_id: str = "") -> Iterator:
@@ -231,8 +232,10 @@ class _ClientReplica(BaseReplica):
     def _dial(self, timeout: float) -> bool:
         return self._client is not None and self._client.health(timeout)
 
-    def predict_stream(self, opts, trace_id: str = "") -> Iterator:
-        return self._client.predict_stream(opts, trace_id=trace_id)
+    def predict_stream(self, opts, trace_id: str = "",
+                       tenant: str = "") -> Iterator:
+        return self._client.predict_stream(opts, trace_id=trace_id,
+                                           tenant=tenant)
 
     def prefill_prefix(self, opts, trace_id: str = "") -> Iterator:
         return self._client.prefill_prefix(opts, trace_id=trace_id)
@@ -419,12 +422,19 @@ class InProcessReplica(BaseReplica):
         return (not self._killed and self.sm is not None
                 and self.sm.scheduler._thread.is_alive())
 
-    def predict_stream(self, opts, trace_id: str = "") -> Iterator:
+    def predict_stream(self, opts, trace_id: str = "",
+                       tenant: str = "") -> Iterator:
         from localai_tpu.worker.server import gen_request_from_options
 
         if self._killed:
             raise RuntimeError(f"replica {self.id} is dead")
         sm = self.sm
+        # ``tenant`` is accepted for surface parity and deliberately
+        # DROPPED: this engine shares the front door's process, and the
+        # fleet dispatch thread already feeds the usage ledger for the
+        # front-door request — stamping the inner resubmit too would
+        # double-count every fleet token ("whoever stamped the tenant
+        # owns the feed", obs.ledger)
         gr = gen_request_from_options(opts, sm, trace_id=trace_id)
         handle = sm.scheduler.submit(gr)
         if gr.correlation_id:

@@ -42,6 +42,7 @@ from localai_tpu.fleet.kveconomy.migration import continuation_request
 from localai_tpu.fleet.pool import ReplicaPool
 from localai_tpu.fleet.router import FleetUnavailable, Router, affinity_key
 from localai_tpu.obs import EngineTelemetry
+from localai_tpu.obs import ledger as obs_ledger
 from localai_tpu.obs import watchdog as obs_watchdog
 from localai_tpu.obs.metrics import REGISTRY
 from localai_tpu.obs.slo import SLOTracker, targets_from_config
@@ -176,6 +177,7 @@ class FleetScheduler:
                         attempt += 1
                         with self._lock:
                             self.failovers += 1
+                        self._note_failover_waste(req)
                         continue
                 elif reason not in ("affinity", "directory"):
                     # placement could not follow the warm KV (queue
@@ -215,6 +217,7 @@ class FleetScheduler:
                         attempt += 1
                         with self._lock:
                             self.failovers += 1
+                        self._note_failover_waste(req)
                         continue
                     self.telemetry.finished(tr, handle, "error")
                     handle._finish("error")
@@ -248,6 +251,16 @@ class FleetScheduler:
             with self._lock:
                 self._inflight -= 1
 
+    def _note_failover_waste(self, req: GenRequest) -> None:
+        """Waste decomposition (obs.ledger): a failover throws away the
+        failed replica's prefill work — the re-dispatch re-prefills the
+        whole prompt somewhere else. Charged in prompt tokens to the
+        request's tenant (which also stamps the front-door feed, so this
+        drill-down never double-counts delivered tokens)."""
+        obs_ledger.LEDGER.note_waste(
+            "failover_reprefill", tokens=len(req.prompt),
+            model=self._owner.name, tenant=req.tenant, requests=1)
+
     def _dispatch(self, handle: WorkerGenHandle, replica, tr,
                   req: Optional[GenRequest] = None) -> str:
         """One streaming attempt against one replica. Raises on transport
@@ -269,7 +282,8 @@ class FleetScheduler:
                 handle,
                 net.bounded_stream(
                     replica.predict_stream(
-                        opts, trace_id=req.trace_id or req.correlation_id),
+                        opts, trace_id=req.trace_id or req.correlation_id,
+                        tenant=req.tenant),
                     self.rpc_timeout_s, rid=replica.id),
                 watchdog=self.watchdog, channel=self._wd_channel, tr=tr)
             if not got_final:
@@ -620,6 +634,12 @@ class FleetScheduler:
         with self._lock:
             self.migration_fallbacks += 1
         REGISTRY.fleet_migration_fallbacks.inc(model=self._owner.name)
+        # waste decomposition (obs.ledger): the fallback throws the
+        # donor's exported KV away and re-prefills the prompt from scratch
+        obs_ledger.LEDGER.note_waste(
+            "migration_reprefill", tokens=len(handle.request.prompt),
+            model=self._owner.name, tenant=handle.request.tenant,
+            requests=1)
         log.warning("fleet %s: live migration of request %d fell back "
                     "(%s)", self._owner.name, handle.id, why)
         ticket.finish("fallback")

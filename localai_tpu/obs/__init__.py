@@ -45,6 +45,18 @@ Cooperating pieces:
     watchdog stalls, SLO shed onsets, and step-time p99 regressions fire
     a bounded, rate-limited, single-flight capture recorded in a manifest
     (``GET /debug/profiles``, ``localai_profiles_captured_total``).
+  * ``obs.ledger`` — the per-tenant cost ledger + goodput/waste
+    decomposition: every finished request attributes delivered tokens,
+    dispatch milliseconds, queue wait and KV-block-seconds to a
+    (tenant, model, lane) pane (tenant = hashed API key, LRU-bounded
+    cardinality), and every dispatch's work splits into goodput vs named
+    waste classes reconciled against the flight ring
+    (``GET /v1/usage``, ``localai_tenant_*``/``localai_goodput_*``/
+    ``localai_waste_*``).
+  * ``obs.history`` — the multi-resolution metrics history: 1s/10s/5m
+    downsampled rings for the key engine + usage series, snapshotted
+    atomically under ``LOCALAI_HISTORY_DIR`` and re-onboarded at boot
+    (``GET /debug/history/{series}``, the ``/usage`` UI pane).
 
 HTTP surface: ``GET /v1/traces``, ``GET /debug/timeline/{request_id}``
 (``api.traces``), ``GET /debug/devices``, ``GET /debug/programs``,
@@ -54,6 +66,13 @@ HTTP surface: ``GET /v1/traces``, ``GET /debug/timeline/{request_id}``
 
 from localai_tpu.obs.engine import EngineTelemetry
 from localai_tpu.obs.flight import FlightRecorder
+from localai_tpu.obs.history import HISTORY, History
+from localai_tpu.obs.ledger import (
+    LEDGER,
+    TenantLedger,
+    current_tenant,
+    derive_tenant,
+)
 from localai_tpu.obs.metrics import (
     REGISTRY,
     Counter,
@@ -75,6 +94,8 @@ from localai_tpu.obs.trace import (
 from localai_tpu.obs.watchdog import WATCHDOG, StallEvent, Watchdog
 
 __all__ = [
+    "HISTORY",
+    "LEDGER",
     "PROFILER",
     "REGISTRY",
     "SLO",
@@ -85,14 +106,18 @@ __all__ = [
     "FlightRecorder",
     "Gauge",
     "Histogram",
+    "History",
     "ProfileManager",
     "Registry",
     "RequestTrace",
     "SLOTracker",
     "Span",
     "StallEvent",
+    "TenantLedger",
     "TraceStore",
     "Watchdog",
+    "current_tenant",
+    "derive_tenant",
     "escape_label_value",
     "new_trace_id",
     "update_engine_gauges",

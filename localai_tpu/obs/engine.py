@@ -16,6 +16,7 @@ import time
 from typing import Any, Optional
 
 from localai_tpu.obs import compile as obs_compile
+from localai_tpu.obs import ledger as obs_ledger
 from localai_tpu.obs import slo as obs_slo
 from localai_tpu.obs.metrics import REGISTRY, Registry
 from localai_tpu.obs.trace import STORE, RequestTrace, TraceStore
@@ -37,6 +38,10 @@ class EngineTelemetry:
         self.registry = registry or REGISTRY
         self.store = store or STORE
         self.slo = slo or obs_slo.SLO
+        # PagedAttention block size for the ledger's KV-block-seconds
+        # cost unit; the scheduler overwrites it from its runner when a
+        # paged allocator is attached (16 is the paged default)
+        self.kv_block_tokens = 16
         # supplement the first-dispatch compile timing the runner records
         obs_compile.install(self.registry)
 
@@ -130,6 +135,34 @@ class EngineTelemetry:
             preempted = reason in PREEMPT_REASONS
         if preempted:
             self.registry.preemptions.inc(model=self.model, reason=reason)
+        # usage accounting (obs.ledger): the single feed point every
+        # scheduler tier shares. Gated on the request's tenant stamp —
+        # "whoever stamped the tenant owns the feed": InProcessReplica
+        # strips it before resubmitting to its shared-process inner
+        # engine, so fleet requests are counted exactly once (by the
+        # front door), and direct un-stamped submits stay unattributed.
+        tenant = getattr(getattr(handle, "request", None), "tenant", "")
+        if tenant:
+            t_end = handle.t_done or time.monotonic()
+            queue_wait_ms = tr.attrs.get("queue_wait_ms") or 0.0
+            service_s = max(
+                0.0, (t_end - handle.t_submit) - queue_wait_ms / 1e3)
+            ledger_reason = reason
+            if reason == "error" and getattr(handle, "nan_poisoned", False):
+                ledger_reason = "nan_quarantine"
+            obs_ledger.LEDGER.note_request(
+                tenant=tenant,
+                model=self.model or "engine",
+                lane="batch" if background else "interactive",
+                reason=ledger_reason,
+                tokens=n,
+                prompt_tokens=handle.prompt_tokens,
+                dispatch_ms=service_s * 1e3,
+                queue_wait_ms=queue_wait_ms,
+                kv_block_s=obs_ledger.kv_block_seconds(
+                    handle.prompt_tokens, n, service_s,
+                    self.kv_block_tokens),
+            )
         if reason in SLO_REASONS and not background:
             t_end = handle.t_done or time.monotonic()
             self.slo.observe(

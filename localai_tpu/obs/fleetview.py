@@ -81,6 +81,18 @@ def telemetry_payload(scheduler: Any, *, trace_id: str = "",
         payload["metrics"] = scheduler.metrics()
     except Exception as e:  # noqa: BLE001 — a stats hiccup ≠ no pane
         payload["metrics"] = {"error": str(e)}
+    # this process's usage-ledger pane (obs.ledger): per-tenant panes +
+    # waste decomposition. A worker process's ledger is fed by ITS
+    # engine, so the front door can drill into per-replica attribution —
+    # the harvest view keys these by replica and never sums them into
+    # the front-door totals (the front door's own ledger already counts
+    # every tenant-stamped request once)
+    try:
+        from localai_tpu.obs.ledger import LEDGER
+
+        payload["usage"] = LEDGER.snapshot()
+    except Exception as e:  # noqa: BLE001 — usage pane ≠ telemetry
+        payload["usage"] = {"error": str(e)}
     return payload
 
 
@@ -329,3 +341,48 @@ def fleet_flight(sm: Any, *, since: float = 0.0,
             merged.append({**rec, "replica": rid})
     merged.sort(key=lambda rec: rec.get("ts_unix") or 0.0)
     return {"replicas": panes, "records": merged, "count": len(merged)}
+
+
+# -- fleet usage harvest -----------------------------------------------------
+
+
+def fleet_usage(sm: Any) -> dict:
+    """Per-replica usage-ledger panes (obs.ledger snapshots) for one
+    fleet-served model — the drill-down half of ``GET /v1/usage``.  Keyed
+    by replica id and deliberately NOT summed: the front door's own
+    ledger already counts every tenant-stamped request exactly once
+    ("whoever stamped the tenant owns the feed"), so these panes answer
+    "which replica did tenant X's work", not "how much work was done".
+    Unhealthy/wedged replicas degrade to an error pane, never a failed
+    endpoint."""
+    pool = getattr(sm, "pool", None)
+    if pool is None:
+        return {}
+    targets: list[tuple[str, Any]] = []
+    panes: dict[str, dict] = {}
+    for r in pool.members():
+        if r.state != "healthy":
+            panes[r.id] = {"state": r.state}
+            continue
+        tele = getattr(r, "telemetry", None)
+        if tele is None:
+            panes[r.id] = {"error": "no telemetry surface"}
+            continue
+        targets.append((r.id, lambda tele=tele: tele(
+            trace_id="", limit=0, recent=0)))
+    for rid, payload in _pull_panes(targets).items():
+        if not isinstance(payload, dict) or payload.get("error"):
+            panes[rid] = {
+                "unreachable": True,
+                "error": (payload or {}).get("error", "no payload"),
+            }
+            continue
+        usage = payload.get("usage")
+        if payload.get("shared_store"):
+            # in-process replica: its "ledger" IS the front door's
+            # process-global singleton — echoing it per replica would
+            # present the same totals N times as if they were distinct
+            panes[rid] = {"shared_ledger": True}
+        else:
+            panes[rid] = usage if isinstance(usage, dict) else {}
+    return panes

@@ -537,6 +537,65 @@ class Registry:
             "localai_hbm_live_bytes",
             "Live jax array bytes by category (kv_cache/weights/other)",
         )
+        # -- usage accounting plane (obs.ledger) ---------------------------
+        # tenant labels are ALWAYS derive_tenant() outputs (hashed key /
+        # anonymous / overflow) — never a raw API key; cardinality is
+        # bounded by the ledger's tenant LRU
+        self.tenant_requests = Counter(
+            "localai_tenant_requests_total",
+            "Finished generation requests per (tenant, model, lane) "
+            "ledger pane (tenant = hashed API key bucket)",
+        )
+        self.tenant_tokens = Counter(
+            "localai_tenant_tokens_total",
+            "Delivered (goodput) completion tokens per tenant pane",
+        )
+        self.tenant_prompt_tokens = Counter(
+            "localai_tenant_prompt_tokens_total",
+            "Prompt tokens processed per tenant pane",
+        )
+        self.tenant_dispatch_ms = Counter(
+            "localai_tenant_dispatch_ms_total",
+            "Engine-resident service milliseconds (submit→done minus "
+            "queue wait) attributed per tenant pane",
+        )
+        self.tenant_queue_wait_ms = Counter(
+            "localai_tenant_queue_wait_ms_total",
+            "Milliseconds requests waited for a decode slot per tenant "
+            "pane",
+        )
+        self.tenant_kv_block_seconds = Counter(
+            "localai_tenant_kv_block_seconds_total",
+            "Paged-KV memory cost per tenant pane: context blocks × "
+            "slot-resident seconds (PagedAttention block-seconds)",
+        )
+        self.tenant_lru_evictions = Counter(
+            "localai_tenant_lru_evictions_total",
+            "Tenant panes folded into the `overflow` bucket when the "
+            "ledger's LRU exceeded LOCALAI_TENANT_MAX",
+        )
+        self.goodput_tokens = Counter(
+            "localai_goodput_tokens_total",
+            "Tokens delivered by naturally finished requests "
+            "(stop/length) per model — the goodput side of the "
+            "decomposition",
+        )
+        self.goodput_ratio = Gauge(
+            "localai_goodput_ratio",
+            "delivered / (delivered + waste) tokens per model (1.0 with "
+            "no recorded waste)",
+        )
+        self.waste_tokens = Counter(
+            "localai_waste_tokens_total",
+            "Wasted work in tokens per model by reason (spec_rejected/"
+            "failover_reprefill/migration_reprefill/cancelled/error/"
+            "nan_quarantine — reprefill classes count prompt tokens)",
+        )
+        self.waste_requests = Counter(
+            "localai_waste_requests_total",
+            "Requests whose work was (partly) wasted, per model by "
+            "reason (shed counts refused admissions)",
+        )
 
     def _all(self) -> list:
         return [v for v in self.__dict__.values()
@@ -600,6 +659,12 @@ def update_engine_gauges(name: str, m: dict,
         reg.kv_tier_reloads.set_total(
             m.get("kv_tier_reloads", 0), model=name)
     reg.decode_dispatches.set_total(m.get("dispatches", 0), model=name)
+    if m.get("shed_total"):
+        # shed admissions are whole-request waste (no tokens were ever
+        # generated); the requests_shed family stays owned by obs.slo —
+        # this is the decomposition's view of the same monotone count
+        reg.waste_requests.set_total(m["shed_total"], model=name,
+                                     reason="shed")
     if "quarantined_slots" in m:
         # point-in-time NaN-quarantine census; the nan_rows/rebuilds
         # counter families are event-time (scheduler/supervisor are their
@@ -624,6 +689,16 @@ def update_engine_gauges(name: str, m: dict,
             m.get("spec_accepted_tokens", 0), model=name)
         reg.spec_tokens_per_dispatch.set(
             m.get("spec_tokens_per_dispatch", 0.0), model=name)
+        # waste decomposition (obs.ledger): rejected draft tokens are
+        # device work the flight ring never counted. Synced here (not
+        # only via LEDGER.export) so worker/fleet tiers — whose ledgers
+        # live in other processes — still land in the roll-up; set_total
+        # max-merges, so the dual writers cannot double-count.
+        rejected = (m.get("spec_draft_tokens", 0)
+                    - m.get("spec_accepted_tokens", 0))
+        if rejected > 0:
+            reg.waste_tokens.set_total(rejected, model=name,
+                                       reason="spec_rejected")
     # windowed step-time percentiles from the flight ring (the EMA's
     # windowed counterpart; absent until a post-compile dispatch lands)
     for q in ("p50", "p99"):

@@ -112,12 +112,14 @@ class WorkerClient:
 
     def predict_stream(self, opts: pb.PredictOptions,
                        timeout: float = WORK_TIMEOUT_S,
-                       trace_id: str = "") -> Iterator[pb.Reply]:
+                       trace_id: str = "",
+                       tenant: str = "") -> Iterator[pb.Reply]:
         self._enter()
         try:
             yield from self._stub.PredictStream(
                 opts, timeout=timeout,
-                metadata=rpc.trace_metadata(trace_id) or None,
+                metadata=(rpc.trace_metadata(trace_id)
+                          + rpc.tenant_metadata(tenant)) or None,
             )
         finally:
             self._exit()

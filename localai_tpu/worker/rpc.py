@@ -43,6 +43,31 @@ def trace_id_from_context(context: Any) -> str:
         pass
     return ""
 
+
+# tenant propagation for the usage ledger (obs.ledger): the front door
+# hashes the API key into a bounded bucket id and forwards ONLY that —
+# the raw key never crosses the wire. Metadata for the same reason as
+# the trace id: third-party workers can ignore it.
+TENANT_METADATA_KEY = "x-localai-tenant"
+
+
+def tenant_metadata(tenant: str) -> tuple:
+    """Per-call gRPC metadata carrying the hashed tenant bucket."""
+    if not tenant:
+        return ()
+    return ((TENANT_METADATA_KEY, tenant),)
+
+
+def tenant_from_context(context: Any) -> str:
+    """Read the propagated tenant bucket out of a servicer context."""
+    try:
+        for key, value in context.invocation_metadata():
+            if key == TENANT_METADATA_KEY:
+                return value
+    except Exception:  # noqa: BLE001 — accounting must never fail an RPC
+        pass
+    return ""
+
 # streaming kinds: which side of the RPC is a message stream
 UNARY = "unary"
 SERVER_STREAM = "server_stream"

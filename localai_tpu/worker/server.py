@@ -34,7 +34,7 @@ log = logging.getLogger(__name__)
 
 
 def gen_request_from_options(req: pb.PredictOptions, sm,
-                             trace_id: str = ""):
+                             trace_id: str = "", tenant: str = ""):
     """PredictOptions → GenRequest against a ServingModel (the wire→engine
     converter; inverse of worker.serving.predict_options). Shared by the
     gRPC servicer and in-process fleet replicas, so both replica kinds
@@ -79,6 +79,10 @@ def gen_request_from_options(req: pb.PredictOptions, sm,
         # propagated from the API tier over gRPC metadata: the worker's
         # engine spans record under the same trace id (obs subsystem)
         trace_id=trace_id or req.correlation_id,
+        # hashed tenant bucket for the usage ledger (obs.ledger); callers
+        # that deliberately leave it empty (InProcessReplica's inner
+        # resubmit) keep their engine feed unattributed
+        tenant=tenant,
         stream=req.stream,
     )
 
@@ -197,13 +201,16 @@ class BackendServicer:
             )
         return self._sm
 
-    def _gen_request(self, req: pb.PredictOptions, sm, trace_id: str = ""):
-        return gen_request_from_options(req, sm, trace_id=trace_id)
+    def _gen_request(self, req: pb.PredictOptions, sm, trace_id: str = "",
+                     tenant: str = ""):
+        return gen_request_from_options(req, sm, trace_id=trace_id,
+                                        tenant=tenant)
 
     def Predict(self, request: pb.PredictOptions, context) -> pb.Reply:
         sm = self._require_model(context)
         handle = sm.scheduler.submit(self._gen_request(
-            request, sm, trace_id=rpc.trace_id_from_context(context)))
+            request, sm, trace_id=rpc.trace_id_from_context(context),
+            tenant=rpc.tenant_from_context(context)))
         try:
             handle.result(timeout=600.0)
         finally:
@@ -221,7 +228,8 @@ class BackendServicer:
                       context) -> Iterator[pb.Reply]:
         sm = self._require_model(context)
         handle = sm.scheduler.submit(self._gen_request(
-            request, sm, trace_id=rpc.trace_id_from_context(context)))
+            request, sm, trace_id=rpc.trace_id_from_context(context),
+            tenant=rpc.tenant_from_context(context)))
         try:
             for item in handle:
                 if _faults.ACTIVE:

@@ -189,7 +189,8 @@ class WorkerScheduler:
                 handle,
                 client.predict_stream(
                     opts, timeout=600.0,
-                    trace_id=req.trace_id or req.correlation_id),
+                    trace_id=req.trace_id or req.correlation_id,
+                    tenant=req.tenant),
                 watchdog=self.watchdog, channel=self._wd_channel, tr=tr)
             if not got_final:
                 # the stream ended without the final usage Reply: the

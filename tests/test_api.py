@@ -1108,3 +1108,133 @@ def test_embeddings_and_rerank_shed_under_overload(client):
     finally:
         SLO.configure(**saved)
         SLO.reset()
+
+
+# -- usage accounting plane (/v1/usage, /debug/history, /usage UI) -----------
+# The LEDGER/HISTORY singletons are process-global and fed by every test
+# in this run, so these assert presence and shape, never exact counts.
+
+
+def test_v1_usage_reports_anonymous_pane(client):
+    """Auth-off traffic lands in the ``anonymous`` tenant bucket with the
+    full cost pane (delivered tokens, dispatch ms, queue wait, KV-block-
+    seconds) plus the goodput/waste decomposition."""
+    r = client.post("/v1/chat/completions", json={
+        "model": "tiny",
+        "messages": [{"role": "user", "content": "bill me"}],
+        "max_tokens": 4,
+    })
+    assert r.status_code == 200
+    d = client.get("/v1/usage").json()
+    assert d["object"] == "usage"
+    for key in ("data", "waste", "goodput", "tenant_lru"):
+        assert key in d, key
+    panes = [p for p in d["data"]
+             if p["tenant"] == "anonymous" and p["model"] == "tiny"]
+    assert panes, d["data"]
+    pane = panes[0]
+    assert pane["lane"] == "interactive"
+    assert pane["requests"] >= 1
+    assert pane["delivered_tokens"] >= 1
+    for key in ("prompt_tokens", "dispatch_ms", "queue_wait_ms",
+                "kv_block_seconds", "waste_tokens", "waste_requests"):
+        assert key in pane, key
+    g = d["goodput"]
+    assert 0.0 <= g["goodput_ratio"] <= 1.0
+    assert g["delivered_tokens"] >= pane["delivered_tokens"]
+    lru = d["tenant_lru"]
+    assert lru["max_tenants"] >= lru["tenants"] >= 1
+
+
+def test_v1_usage_windowed_and_bad_params(client):
+    d = client.get("/v1/usage", params={"window": 3600}).json()
+    assert d["object"] == "usage"
+    # the windowed answer says how far back its event ring reaches
+    assert "coverage_start" in d and "events" in d
+    assert d["start_time"] is not None
+    for bad in ({"since": "soon"}, {"window": "wat"}):
+        assert client.get("/v1/usage", params=bad).status_code == 400
+
+
+def test_authenticated_tenant_is_hashed_never_raw(client, server):
+    """With API keys on, the auth middleware stamps derive_tenant(key) —
+    the raw key must never appear in /v1/usage or the exposition."""
+    from localai_tpu.obs.ledger import derive_tenant
+
+    key = "sk-usage-raw-key-material"
+    server.state.config.api_keys = [key]
+    hdr = {"Authorization": f"Bearer {key}"}
+    try:
+        r = client.post("/v1/chat/completions", json={
+            "model": "tiny",
+            "messages": [{"role": "user", "content": "tenant bill"}],
+            "max_tokens": 4,
+        }, headers=hdr)
+        assert r.status_code == 200
+        # the key gates /v1/usage too
+        assert client.get("/v1/usage").status_code == 401
+        d = client.get("/v1/usage", headers=hdr).json()
+        metrics = client.get("/metrics", headers=hdr).text
+    finally:
+        server.state.config.api_keys = []
+    bucket = derive_tenant(key)
+    assert bucket.startswith("t-") and key not in bucket
+    panes = [p for p in d["data"] if p["tenant"] == bucket]
+    assert panes and panes[0]["requests"] >= 1
+    assert key not in json.dumps(d)
+    assert key not in metrics
+    assert (f'localai_tenant_tokens_total{{lane="interactive",'
+            f'model="tiny",tenant="{bucket}"}}') in metrics
+
+
+def test_metrics_exports_tenant_and_goodput_series(client):
+    client.post("/v1/chat/completions", json={
+        "model": "tiny",
+        "messages": [{"role": "user", "content": "export me"}],
+        "max_tokens": 4,
+    })
+    body = client.get("/metrics").text
+    assert ('localai_tenant_requests_total{lane="interactive",'
+            'model="tiny",tenant="anonymous"}') in body
+    assert 'localai_goodput_tokens_total{model="tiny"}' in body
+    assert 'localai_goodput_ratio{model="tiny"}' in body
+    assert "# TYPE localai_waste_tokens_total counter" in body
+    assert "# TYPE localai_tenant_lru_evictions_total counter" in body
+
+
+def test_debug_history_index_and_series(client):
+    """Every /metrics scrape doubles as a history sampling tick — after
+    one, the ring geometry and the curated engine/ledger series must be
+    queryable at every resolution."""
+    client.post("/v1/chat/completions", json={
+        "model": "tiny",
+        "messages": [{"role": "user", "content": "history"}],
+        "max_tokens": 4,
+    })
+    client.get("/metrics")                       # the sampling tick
+    idx = client.get("/debug/history").json()
+    assert idx["resolutions_s"] == [1, 10, 300]
+    assert idx["capacity"] == {"1": 600, "10": 720, "300": 576}
+    assert "tokens_generated.tiny" in idx["series"]
+    assert "tenant_tokens.anonymous" in idx["series"]
+    q = client.get("/debug/history/tokens_generated.tiny",
+                   params={"res": 1}).json()
+    assert q["kind"] == "counter"
+    assert q["resolution_s"] == 1 and q["capacity"] == 600
+    assert q["points"] and q["points"][-1]["value"] >= 1
+    # res snaps to the nearest ring rather than erroring
+    snapped = client.get("/debug/history/tokens_generated.tiny",
+                         params={"res": 7}).json()
+    assert snapped["resolution_s"] == 10
+    assert client.get("/debug/history/no-such-series").status_code == 404
+    assert client.get("/debug/history/tokens_generated.tiny",
+                      params={"res": "x"}).status_code == 400
+    assert client.get("/debug/history/tokens_generated.tiny",
+                      params={"since": "x"}).status_code == 400
+
+
+def test_usage_ui_page_served(client):
+    r = client.get("/usage", headers={"Accept": "text/html"})
+    assert r.status_code == 200
+    assert "Usage" in r.text
+    assert "Waste decomposition" in r.text

@@ -379,7 +379,7 @@ class _ScriptedReplica(BaseReplica):
     def process_alive(self):
         return not self.dead_flag
 
-    def predict_stream(self, opts, trace_id=""):
+    def predict_stream(self, opts, trace_id="", tenant=""):
         steps = self.script.pop(0) if self.script else ["final"]
         for step in steps:
             if step == "delta":
@@ -930,7 +930,7 @@ def test_slow_link_deadline_fires_and_fails_over():
     class _SlowReplica(_ScriptedReplica):
         slow = False
 
-        def predict_stream(self, opts, trace_id=""):
+        def predict_stream(self, opts, trace_id="", tenant=""):
             if self.slow:
                 time.sleep(5.0)  # silence, not an error — like a
                 #                  partitioned peer

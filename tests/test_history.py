@@ -1,0 +1,250 @@
+"""Multi-resolution metrics history (obs.history).
+
+The unit half of the round-18 persistence surface: bucket boundary
+alignment across the 1s/10s/5m rings, counter-vs-gauge downsampling
+semantics (max-of-cumulative vs mean), ring wraparound/retention,
+out-of-order merge, and the snapshot/restore lifecycle a serving restart
+exercises (atomic write, corrupt-file tolerance, env-driven install).
+The scrape-time feeds (observe_engine/observe_ledger) and the HTTP
+surface (/debug/history) are covered in test_api.py and the smoke.
+"""
+
+import json
+import os
+import threading
+
+import pytest
+
+from localai_tpu.obs.history import (
+    CAPACITY,
+    RESOLUTIONS,
+    SNAPSHOT_FILE,
+    History,
+    install_from_env,
+)
+
+# -- bucket alignment --------------------------------------------------------
+
+
+def test_points_in_one_second_share_a_bucket():
+    h = History()
+    h.record("g", 4.0, ts=100.0)
+    h.record("g", 6.0, ts=100.9)
+    h.record("g", 10.0, ts=101.0)
+    q = h.query("g", res=1)
+    assert [(p["ts"], p["value"], p["count"]) for p in q["points"]] == [
+        (100.0, 5.0, 2),        # gauge bucket = mean of the 2 points
+        (101.0, 10.0, 1),
+    ]
+
+
+def test_buckets_align_to_resolution_boundaries():
+    h = History()
+    h.record("g", 1.0, ts=109.9)
+    h.record("g", 3.0, ts=110.0)
+    ten = h.query("g", res=10)["points"]
+    assert [p["ts"] for p in ten] == [100.0, 110.0]   # floor(ts/res)*res
+    five = h.query("g", res=300)["points"]
+    assert [p["ts"] for p in five] == [0.0]           # both inside [0,300)
+    assert five[0]["count"] == 2
+
+
+def test_query_snaps_unknown_resolution_to_nearest():
+    h = History()
+    h.record("g", 1.0, ts=50.0)
+    assert h.query("g", res=2)["resolution_s"] == 1
+    assert h.query("g", res=7)["resolution_s"] == 10
+    assert h.query("g", res=9999)["resolution_s"] == 300
+
+
+# -- counter vs gauge downsampling -------------------------------------------
+
+
+def test_counter_bucket_keeps_max_cumulative_total():
+    h = History()
+    h.record("c", 100.0, kind="counter", ts=20.0)
+    h.record("c", 120.0, kind="counter", ts=23.0)
+    h.record("c", 115.0, kind="counter", ts=27.0)   # a stale re-export
+    p = h.query("c", res=10)["points"]
+    assert p == [{"ts": 20.0, "value": 120.0, "count": 3}]
+
+
+def test_gauge_bucket_reports_mean():
+    h = History()
+    for v in (1.0, 2.0, 9.0):
+        h.record("g", v, ts=40.0)
+    p = h.query("g", res=10)["points"]
+    assert p[0]["value"] == pytest.approx(4.0)
+    assert p[0]["count"] == 3
+
+
+# -- retention / wraparound --------------------------------------------------
+
+
+def test_fine_ring_wraps_while_coarse_ring_retains():
+    h = History()
+    n = CAPACITY[1] + 50
+    for i in range(n):
+        h.record("c", float(i), kind="counter", ts=float(i))
+    fine = h.query("c", res=1)["points"]
+    assert len(fine) == CAPACITY[1]                  # capacity bound
+    assert fine[0]["ts"] == float(n - CAPACITY[1])   # oldest dropped
+    assert fine[-1]["value"] == float(n - 1)
+    coarse = h.query("c", res=10)["points"]
+    assert len(coarse) == n // 10                    # still has the past
+    assert coarse[0]["ts"] == 0.0
+
+
+def test_out_of_order_point_merges_into_resident_bucket():
+    h = History()
+    h.record("g", 1.0, ts=100.0)
+    h.record("g", 5.0, ts=200.0)
+    h.record("g", 3.0, ts=100.4)     # late arrival, bucket still resident
+    one = {p["ts"]: p for p in h.query("g", res=1)["points"]}
+    assert one[100.0]["count"] == 2
+    assert one[100.0]["value"] == pytest.approx(2.0)
+
+
+def test_out_of_order_point_past_retention_is_dropped():
+    h = History()
+    h.record("g", 1.0, ts=100.0)
+    h.record("g", 2.0, ts=200.0)
+    h.record("g", 9.0, ts=150.0)     # bucket 150 never existed: dropped
+    assert [p["ts"] for p in h.query("g", res=1)["points"]] == [100.0,
+                                                                200.0]
+
+
+def test_query_since_and_unknown_series():
+    h = History()
+    h.record("g", 1.0, ts=100.0)
+    h.record("g", 2.0, ts=200.0)
+    assert h.query("missing") is None
+    pts = h.query("g", res=1, since=150.0)["points"]
+    assert [p["ts"] for p in pts] == [200.0]
+
+
+# -- snapshot / restore ------------------------------------------------------
+
+
+def _seed(h):
+    h.record("tenant_tokens.t-abc", 40.0, kind="counter", ts=100.0)
+    h.record("tenant_tokens.t-abc", 55.0, kind="counter", ts=160.0)
+    h.record("occupancy.m", 0.5, ts=100.0)
+
+
+def test_snapshot_restores_across_restart(tmp_path):
+    h = History()
+    _seed(h)
+    path = h.save(str(tmp_path))
+    assert path and os.path.basename(path) == SNAPSHOT_FILE
+
+    restarted = History()                   # the next process boots clean
+    assert restarted.load(str(tmp_path))
+    assert restarted.series_names() == h.series_names()
+    for name in h.series_names():
+        for res in RESOLUTIONS:
+            assert (restarted.query(name, res=res)
+                    == h.query(name, res=res)), (name, res)
+    # restored rings keep accepting points with the original bounds
+    restarted.record("tenant_tokens.t-abc", 70.0, kind="counter", ts=170.0)
+    pts = restarted.query("tenant_tokens.t-abc", res=1)["points"]
+    assert pts[-1]["value"] == 70.0
+
+
+def test_save_without_directory_is_a_noop():
+    assert History().save() is None
+
+
+def test_load_missing_and_corrupt_snapshots_are_warnings(tmp_path):
+    h = History()
+    assert not h.load(str(tmp_path))                     # nothing there
+    (tmp_path / SNAPSHOT_FILE).write_text("{not json")
+    assert not h.load(str(tmp_path))                     # corrupt ≠ crash
+    malformed = {"version": 1, "series": {"g": {"kind": "gauge",
+                                                "rings": {"1": [[1, 2]]}}}}
+    (tmp_path / SNAPSHOT_FILE).write_text(json.dumps(malformed))
+    assert h.load(str(tmp_path))                         # short cells skip
+    assert h.query("g", res=1)["points"] == []
+
+
+def test_snapshot_write_is_atomic(tmp_path):
+    h = History()
+    _seed(h)
+    h.save(str(tmp_path))
+    assert not (tmp_path / (SNAPSHOT_FILE + ".tmp")).exists()
+    doc = json.loads((tmp_path / SNAPSHOT_FILE).read_text())
+    assert doc["version"] == 1 and "tenant_tokens.t-abc" in doc["series"]
+
+
+def test_configure_restores_and_starts_writer(tmp_path):
+    h = History()
+    _seed(h)
+    h.save(str(tmp_path))
+
+    h2 = History()
+    h2.configure(str(tmp_path), snapshot_s=3600.0)
+    try:
+        assert h2.series_names() == h.series_names()     # boot restore
+        writers = [t for t in threading.enumerate()
+                   if t.name == "history-writer" and t.is_alive()]
+        assert writers
+    finally:
+        h2.stop()
+
+
+def test_flush_writes_synchronously(tmp_path):
+    h = History()
+    h.configure(str(tmp_path), snapshot_s=3600.0)
+    try:
+        h.record("g", 1.0, ts=10.0)
+        assert h.flush() == str(tmp_path / SNAPSHOT_FILE)
+        assert (tmp_path / SNAPSHOT_FILE).exists()
+    finally:
+        h.stop()
+
+
+def test_install_from_env(tmp_path, monkeypatch):
+    monkeypatch.delenv("LOCALAI_HISTORY_DIR", raising=False)
+    assert not install_from_env(History())
+    monkeypatch.setenv("LOCALAI_HISTORY_DIR", str(tmp_path))
+    monkeypatch.setenv("LOCALAI_HISTORY_SNAPSHOT_S", "junk")
+    h = History()
+    try:
+        assert install_from_env(h)
+        assert h.snapshot_s == 30.0                      # junk → default
+    finally:
+        h.stop()
+
+
+# -- scrape-time feeds -------------------------------------------------------
+
+
+def test_observe_engine_records_curated_series():
+    h = History()
+    h.observe_engine("m", {"occupancy": 0.5, "queue_depth": 3,
+                           "total_generated_tokens": 120})
+    names = h.series_names()
+    assert "occupancy.m" in names and "queue_depth.m" in names
+    assert "tokens_generated.m" in names
+    assert h.query("tokens_generated.m", res=1)["kind"] == "counter"
+    h.observe_engine("w", {"error": "unreachable"})      # worker pane
+    assert "occupancy.w" not in h.series_names()
+
+
+def test_observe_ledger_records_tenant_and_waste_series():
+    from localai_tpu.obs.ledger import TenantLedger
+
+    led = TenantLedger(max_tenants=8)
+    led.note_request(tenant="t-abc", model="m", lane="interactive",
+                     reason="stop", tokens=10, prompt_tokens=4,
+                     dispatch_ms=5.0, queue_wait_ms=1.0, kv_block_s=2.0)
+    led.note_waste("spec_rejected", model="m", tokens=3)
+    h = History()
+    h.observe_ledger(led)
+    assert "tenant_tokens.t-abc" in h.series_names()
+    assert "tenant_requests.t-abc" in h.series_names()
+    assert "goodput_tokens.m" in h.series_names()
+    assert "waste_tokens.spec_rejected" in h.series_names()
+    q = h.query("tenant_tokens.t-abc", res=1)
+    assert q["kind"] == "counter"
+    assert q["points"][-1]["value"] == 10.0

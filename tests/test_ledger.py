@@ -1,0 +1,445 @@
+"""Usage/goodput accounting plane (obs.ledger).
+
+The unit half of the round-18 observability surfaces: tenant derivation
+safety (hashed buckets, never the raw key), LRU cardinality bounding,
+the goodput-vs-waste decomposition and its flight-ring reconciliation
+identity, and the two attribution paths — a real in-process engine and
+the fleet dispatch tier (whose InProcessReplica must DROP the tenant so
+shared-process fleets feed the ledger exactly once). The HTTP halves
+(GET /v1/usage, /debug/history) live in test_api.py; the worker-process
+gRPC metadata hop is covered by the telemetry smoke's check_usage.
+"""
+
+import pytest
+
+from localai_tpu.obs import Registry
+from localai_tpu.obs.ledger import (
+    ANONYMOUS,
+    FLIGHT_WASTE,
+    LEDGER,
+    OVERFLOW,
+    TenantLedger,
+    current_tenant,
+    derive_tenant,
+    kv_block_seconds,
+    set_current_tenant,
+)
+
+# -- tenant derivation (label safety) ----------------------------------------
+
+
+def test_derive_tenant_empty_key_is_anonymous():
+    assert derive_tenant("") == ANONYMOUS
+
+
+def test_derive_tenant_is_short_stable_hash():
+    a = derive_tenant("sk-secret-key-123")
+    assert a == derive_tenant("sk-secret-key-123")    # stable
+    assert a.startswith("t-") and len(a) == 14         # t- + 12 hex
+    assert a != derive_tenant("sk-secret-key-124")
+
+
+def test_derive_tenant_never_contains_raw_key():
+    key = "sk-very-secret"
+    assert key not in derive_tenant(key)
+    assert "secret" not in derive_tenant(key)
+
+
+def test_tenant_contextvar_roundtrip():
+    assert current_tenant() == ""
+    token = set_current_tenant("t-abc")
+    try:
+        assert current_tenant() == "t-abc"
+    finally:
+        token.var.reset(token)
+    assert current_tenant() == ""
+
+
+# -- KV block-seconds --------------------------------------------------------
+
+
+def test_kv_block_seconds_ceil_math():
+    # 17 tokens over 16-token blocks = 2 blocks; × 3 s resident = 6
+    assert kv_block_seconds(10, 7, 3.0, block_tokens=16) == 6.0
+    assert kv_block_seconds(16, 0, 2.0, block_tokens=16) == 2.0
+    assert kv_block_seconds(0, 0, 5.0) == 0.0
+    assert kv_block_seconds(-3, 4, 1.0, block_tokens=4) == 1.0
+    assert kv_block_seconds(4, 4, -1.0, block_tokens=4) == 0.0
+
+
+# -- classification + decomposition ------------------------------------------
+
+
+def _feed(led, *, tenant="t-a", model="m", lane="interactive",
+          reason="stop", tokens=10, prompt=4):
+    led.note_request(tenant=tenant, model=model, lane=lane, reason=reason,
+                     tokens=tokens, prompt_tokens=prompt, dispatch_ms=5.0,
+                     queue_wait_ms=1.0, kv_block_s=2.0)
+
+
+def test_note_request_classifies_goodput_vs_waste():
+    led = TenantLedger(max_tenants=8)
+    _feed(led, reason="stop", tokens=10)
+    _feed(led, reason="length", tokens=5)
+    _feed(led, reason="cancelled", tokens=3)
+    snap = led.snapshot()
+    pane = snap["tenants"]["t-a"]["m/interactive"]
+    assert pane["requests"] == 3
+    assert pane["delivered_tokens"] == 15          # stop + length only
+    assert pane["waste_tokens"] == 3
+    assert pane["waste_requests"] == 1
+    assert snap["goodput_tokens"] == {"m": 15}
+    assert snap["waste"]["cancelled/m"] == {"tokens": 3, "requests": 1}
+
+
+def test_unknown_terminal_reason_folds_into_error():
+    led = TenantLedger(max_tenants=8)
+    _feed(led, reason="exploded", tokens=2)
+    assert led.snapshot()["waste"]["error/m"]["tokens"] == 2
+
+
+def test_flight_reconciliation_identity():
+    """goodput + cancelled/error/nan tokens == the ring's total; the
+    out-of-ring classes (spec/shed/reprefill) stay outside the sum."""
+    led = TenantLedger(max_tenants=8)
+    _feed(led, reason="stop", tokens=10)
+    _feed(led, reason="cancelled", tokens=4)
+    _feed(led, reason="error", tokens=2)
+    _feed(led, reason="nan_quarantine", tokens=1)
+    led.note_waste("spec_rejected", model="m", tokens=7)
+    led.note_waste("shed", model="m", requests=2)
+    led.note_waste("failover_reprefill", model="m", tokens=9, requests=1)
+    g = led.goodput_totals("m")
+    assert g["delivered_tokens"] == 10
+    assert g["flight_tokens"] == 10 + 4 + 2 + 1    # what the ring counted
+    assert g["waste_tokens"] == 4 + 2 + 1 + 7 + 9  # every wasted token
+    assert set(FLIGHT_WASTE) == {"cancelled", "error", "nan_quarantine"}
+    assert g["goodput_ratio"] == pytest.approx(10 / (10 + 23))
+
+
+def test_goodput_totals_scopes_by_model():
+    led = TenantLedger(max_tenants=8)
+    _feed(led, model="a", tokens=10)
+    _feed(led, model="b", tokens=6)
+    led.note_waste("spec_rejected", model="b", tokens=2)
+    assert led.goodput_totals("a")["waste_tokens"] == 0
+    assert led.goodput_totals("b")["waste_tokens"] == 2
+    assert led.goodput_totals()["delivered_tokens"] == 16
+
+
+def test_note_waste_tenant_attribution_is_best_effort():
+    led = TenantLedger(max_tenants=8)
+    led.note_waste("shed", model="m", tenant="t-x", requests=1)
+    led.note_waste("shed", model="m", requests=1)   # engine-side, no tenant
+    snap = led.snapshot()
+    assert snap["waste"]["shed/m"]["requests"] == 2  # decomposition exact
+    assert snap["tenants"]["t-x"]["m/interactive"]["waste_requests"] == 1
+
+
+# -- tenant LRU (cardinality bound) ------------------------------------------
+
+
+def test_lru_eviction_folds_into_overflow_and_conserves_totals():
+    led = TenantLedger(max_tenants=3)
+    for i in range(6):
+        _feed(led, tenant=f"t-{i:02d}", tokens=10)
+    snap = led.snapshot()
+    assert len(snap["tenants"]) <= 3 + 1            # cap + overflow bucket
+    assert snap["evictions_total"] > 0
+    total = sum(p["delivered_tokens"]
+                for panes in snap["tenants"].values()
+                for p in panes.values())
+    assert total == 60                               # folded, not dropped
+    assert OVERFLOW in snap["tenants"]
+
+
+def test_anonymous_and_overflow_are_never_evicted():
+    led = TenantLedger(max_tenants=2)
+    _feed(led, tenant=ANONYMOUS, tokens=1)
+    for i in range(5):
+        _feed(led, tenant=f"t-{i:02d}", tokens=1)
+    snap = led.snapshot()
+    assert ANONYMOUS in snap["tenants"]
+    assert OVERFLOW in snap["tenants"]
+
+
+def test_tenant_max_env_knob(monkeypatch):
+    monkeypatch.setenv("LOCALAI_TENANT_MAX", "5")
+    assert TenantLedger().max_tenants == 5
+    monkeypatch.setenv("LOCALAI_TENANT_MAX", "junk")
+    assert TenantLedger().max_tenants == 64
+    monkeypatch.setenv("LOCALAI_TENANT_MAX", "0")
+    assert TenantLedger().max_tenants == 2           # floor
+
+
+# -- usage payload (GET /v1/usage body) --------------------------------------
+
+
+def test_usage_payload_lifetime_shape():
+    led = TenantLedger(max_tenants=8)
+    _feed(led, tenant="t-a", tokens=10)
+    _feed(led, tenant="t-b", reason="cancelled", tokens=2)
+    p = led.usage_payload()
+    assert p["object"] == "usage" and p["start_time"] is None
+    rows = {r["tenant"]: r for r in p["data"]}
+    assert rows["t-a"]["delivered_tokens"] == 10
+    assert rows["t-b"]["waste_tokens"] == 2
+    assert p["waste"][0]["reason"] == "cancelled"
+    assert p["goodput"]["flight_tokens"] == 12
+    assert p["tenant_lru"]["max_tenants"] == 8
+
+
+def test_usage_payload_window_filters_the_event_ring():
+    led = TenantLedger(max_tenants=8)
+    _feed(led, tokens=10)
+    everything = led.usage_payload(since=0.0)
+    assert everything["events"] == 1
+    assert everything["data"][0]["delivered_tokens"] == 10
+    assert everything["coverage_start"] <= everything["end_time"]
+    nothing = led.usage_payload(since=everything["end_time"] + 60.0)
+    assert nothing["events"] == 0 and nothing["data"] == []
+
+
+def test_event_ring_is_bounded():
+    led = TenantLedger(max_tenants=8, events=4)
+    for i in range(10):
+        _feed(led, tokens=1)
+    assert led.usage_payload(since=0.0)["events"] == 4
+
+
+# -- registry export (exposition safety) -------------------------------------
+
+
+def test_export_renders_hashed_buckets_never_raw_keys():
+    led = TenantLedger(max_tenants=8)
+    raw = "sk-super-secret-key"
+    _feed(led, tenant=derive_tenant(raw), tokens=10)
+    led.note_waste("spec_rejected", model="m", tokens=3)
+    reg = Registry()
+    led.export(reg)
+    text = reg.render()
+    assert raw not in text
+    assert f'tenant="{derive_tenant(raw)}"' in text
+    assert 'localai_goodput_tokens_total{model="m"} 10' in text
+    assert ('localai_waste_tokens_total{model="m",reason="spec_rejected"}'
+            ' 3' in text)
+    assert 'localai_goodput_ratio{model="m"}' in text
+
+
+def test_export_is_idempotent_max_merge():
+    led = TenantLedger(max_tenants=8)
+    _feed(led, tokens=10)
+    reg = Registry()
+    led.export(reg)
+    led.export(reg)  # re-export must not double the monotone counters
+    assert ('localai_tenant_tokens_total{lane="interactive",model="m",'
+            'tenant="t-a"} 10' in reg.render())
+
+
+# -- attribution through a real in-process engine ----------------------------
+
+
+@pytest.fixture(scope="module")
+def ledger_sched():
+    from localai_tpu.engine.runner import ModelRunner
+    from localai_tpu.engine.scheduler import Scheduler
+    from localai_tpu.models.registry import resolve_model
+    from localai_tpu.obs import EngineTelemetry
+    from localai_tpu.utils.tokenizer import ByteTokenizer
+
+    tiny = resolve_model("debug:tiny", dtype="float32")
+    runner = ModelRunner(
+        tiny.cfg, tiny.params, num_slots=2, max_ctx=96,
+        prefill_buckets=[16, 32], kv_dtype="float32",
+        paged=True, kv_block_tokens=16,
+    )
+    s = Scheduler(runner, ByteTokenizer(),
+                  telemetry=EngineTelemetry(model="ledger-tiny"))
+    yield s
+    s.shutdown()
+
+
+@pytest.fixture()
+def clean_ledger():
+    LEDGER.reset()
+    yield LEDGER
+    LEDGER.reset()
+
+
+def test_engine_feeds_ledger_for_stamped_requests(ledger_sched,
+                                                  clean_ledger):
+    from localai_tpu.engine.scheduler import GenRequest
+    from localai_tpu.utils.tokenizer import ByteTokenizer
+
+    tok = ByteTokenizer()
+    hs = [
+        ledger_sched.submit(GenRequest(
+            prompt=tok.encode(f"ledger smoke {i}"), max_new_tokens=6,
+            temperature=0.0, tenant=derive_tenant(f"key-{i % 2}"),
+        ))
+        for i in range(4)
+    ]
+    for h in hs:
+        h.result(timeout=300)
+    snap = clean_ledger.snapshot()
+    assert set(snap["tenants"]) == {derive_tenant("key-0"),
+                                    derive_tenant("key-1")}
+    for tenant, panes in snap["tenants"].items():
+        pane = panes["ledger-tiny/interactive"]
+        assert pane["requests"] == 2
+        assert pane["delivered_tokens"] > 0
+        assert pane["prompt_tokens"] > 0
+        assert pane["dispatch_ms"] > 0
+        assert pane["kv_block_seconds"] > 0
+
+
+def test_unstamped_requests_stay_unattributed(ledger_sched, clean_ledger):
+    from localai_tpu.engine.scheduler import GenRequest
+    from localai_tpu.utils.tokenizer import ByteTokenizer
+
+    tok = ByteTokenizer()
+    ledger_sched.submit(GenRequest(
+        prompt=tok.encode("no tenant here"), max_new_tokens=4,
+        temperature=0.0,
+    )).result(timeout=300)
+    assert clean_ledger.snapshot()["tenants"] == {}
+
+
+def test_engine_delivery_reconciles_with_flight_ring(ledger_sched,
+                                                     clean_ledger):
+    """The identity the decomposition docstring promises, on a real
+    engine: with only natural completions, the ledger's delivered tokens
+    for THIS batch equal the growth of the flight ring's token total."""
+    from localai_tpu.engine.scheduler import GenRequest
+    from localai_tpu.utils.tokenizer import ByteTokenizer
+
+    tok = ByteTokenizer()
+    before = ledger_sched.flight.total_tokens
+    hs = [
+        ledger_sched.submit(GenRequest(
+            prompt=tok.encode(f"reconcile {i}"), max_new_tokens=5,
+            temperature=0.0, tenant="t-reconcile",
+        ))
+        for i in range(3)
+    ]
+    for h in hs:
+        h.result(timeout=300)
+    g = clean_ledger.goodput_totals("ledger-tiny")
+    assert g["waste_tokens"] == 0
+    assert g["delivered_tokens"] == (
+        ledger_sched.flight.total_tokens - before)
+
+
+# -- attribution through the fleet dispatch tier -----------------------------
+
+
+def test_fleet_dispatch_feeds_front_door_exactly_once(clean_ledger):
+    """A shared-process fleet: the front-door WorkerScheduler stamps the
+    feed and InProcessReplica DROPS the tenant on the inner resubmit —
+    the pane must count every request once, not once per tier."""
+    from localai_tpu.config.app_config import AppConfig
+    from localai_tpu.config.model_config import ModelConfig
+    from localai_tpu.engine.scheduler import GenRequest
+    from localai_tpu.fleet import FleetServingModel
+    from localai_tpu.fleet.replica import InProcessReplica
+    from localai_tpu.models.manager import build_serving_model
+
+    app = AppConfig()
+    mcfg = ModelConfig.model_validate({
+        "name": "ledger-fleet", "model": "debug:tiny",
+        "context_size": 256,
+        "parameters": {"temperature": 0.0, "max_tokens": 6},
+        "engine": {"max_slots": 2, "prefill_buckets": [16, 32],
+                   "dtype": "float32", "kv_dtype": "float32",
+                   "kv_block_tokens": 16},
+    })
+
+    def factory(rid, role):
+        return InProcessReplica(
+            rid, role, lambda: build_serving_model(mcfg, app))
+
+    fm = FleetServingModel(mcfg, app, factory, replicas=2,
+                           prefill_replicas=0, disagg_threshold=1 << 30)
+    try:
+        tok = fm.tokenizer
+        hs = [
+            fm.scheduler.submit(GenRequest(
+                prompt=tok.encode(f"fleet ledger {i}"), max_new_tokens=5,
+                temperature=0.0, tenant="t-fleet",
+            ))
+            for i in range(4)
+        ]
+        delivered = 0
+        for h in hs:
+            h.result(timeout=300)
+            assert h.finish_reason in ("stop", "length")
+            delivered += h.completion_tokens
+        snap = clean_ledger.snapshot()
+        panes = snap["tenants"]["t-fleet"]
+        # ONLY the front door's pane: the inner engines saw no tenant
+        assert set(panes) == {"ledger-fleet/interactive"}
+        pane = panes["ledger-fleet/interactive"]
+        assert pane["requests"] == 4                 # once, not twice
+        assert pane["delivered_tokens"] == delivered
+        assert snap["goodput_tokens"] == {"ledger-fleet": delivered}
+    finally:
+        fm.close()
+
+
+def test_fleet_failover_charges_reprefill_waste(clean_ledger):
+    """A replica death mid-dispatch re-prefills on the survivor; the
+    decomposition must charge the prompt to failover_reprefill under the
+    request's tenant."""
+    from localai_tpu import faults
+    from localai_tpu.config.app_config import AppConfig
+    from localai_tpu.config.model_config import ModelConfig
+    from localai_tpu.engine.scheduler import GenRequest
+    from localai_tpu.fleet import FleetServingModel
+    from localai_tpu.fleet.replica import InProcessReplica
+    from localai_tpu.models.manager import build_serving_model
+
+    app = AppConfig()
+    mcfg = ModelConfig.model_validate({
+        "name": "ledger-failover", "model": "debug:tiny",
+        "context_size": 256,
+        "parameters": {"temperature": 0.0, "max_tokens": 6},
+        "engine": {"max_slots": 2, "prefill_buckets": [16, 32],
+                   "dtype": "float32", "kv_dtype": "float32",
+                   "kv_block_tokens": 16},
+    })
+
+    def factory(rid, role):
+        return InProcessReplica(
+            rid, role, lambda: build_serving_model(mcfg, app))
+
+    fm = FleetServingModel(mcfg, app, factory, replicas=2,
+                           prefill_replicas=0, disagg_threshold=1 << 30)
+    try:
+        tok = fm.tokenizer
+        # warm both replicas so the victim is known to the router
+        fm.scheduler.submit(GenRequest(
+            prompt=tok.encode("warm"), max_new_tokens=2, temperature=0.0,
+        )).result(timeout=300)
+        victim = fm.pool.replicas[0].id
+        faults.arm(faults.FaultSpec(site="worker.stream", mode="raise",
+                                    match=victim, times=1))
+        try:
+            prompt = tok.encode("failover ledger prompt")
+            h = fm.scheduler.submit(GenRequest(
+                prompt=prompt, max_new_tokens=4, temperature=0.0,
+                tenant="t-failover",
+            ))
+            h.result(timeout=300)
+            assert h.finish_reason in ("stop", "length")
+        finally:
+            faults.clear()
+        snap = clean_ledger.snapshot()
+        cell = snap["waste"].get("failover_reprefill/ledger-failover")
+        if cell is not None:  # the victim may not win the first dispatch
+            assert cell["tokens"] == len(prompt)
+            assert cell["requests"] == 1
+            pane = snap["tenants"]["t-failover"][
+                "ledger-failover/interactive"]
+            assert pane["waste_tokens"] >= len(prompt)
+    finally:
+        fm.close()

@@ -207,12 +207,17 @@ class EngineSink:
     def chat(self, text: str, *, tenant: str = "default",
              trace_id: str = "", background: bool = False):
         from localai_tpu.engine.scheduler import PRIORITY_BATCH, GenRequest
+        from localai_tpu.obs.ledger import derive_tenant
 
         return self.sm.scheduler.submit(GenRequest(
             prompt=self.sm.tokenizer.encode(text),
             max_new_tokens=self.max_tokens, temperature=0.0,
             trace_id=trace_id, correlation_id=f"{tenant}:{trace_id}",
             priority=PRIORITY_BATCH if background else 0,
+            # the tenant stamp the auth middleware would apply: hashed
+            # bucket, never the raw name — the usage smoke asserts the
+            # per-tenant shares land under these buckets
+            tenant=derive_tenant(tenant),
         ))
 
     def embedding(self, text: str, *, tenant: str = "default"):
@@ -250,10 +255,13 @@ class HttpSink:
     scheduler calls."""
 
     def __init__(self, base_url: str, model: str, *,
-                 max_tokens: int = 8, timeout: float = 120.0):
+                 max_tokens: int = 8, timeout: float = 120.0,
+                 api_key: str = ""):
         import httpx
 
-        self._client = httpx.Client(base_url=base_url, timeout=timeout)
+        headers = {"Authorization": f"Bearer {api_key}"} if api_key else None
+        self._client = httpx.Client(base_url=base_url, timeout=timeout,
+                                    headers=headers)
         self.model = model
         self.max_tokens = max_tokens
 

@@ -46,7 +46,7 @@ generations through the continuous-batching scheduler, then:
      (``--profile-dir``) with its triggering trace id — while a second
      stall inside the cooldown does NOT capture;
 
-  8. under ``--loopsan``, boots the REAL aiohttp API tier over a
+  9. under ``--loopsan``, boots the REAL aiohttp API tier over a
      2-replica in-process fleet of the tiny model and runs it under
      ``tools.loopsan``'s event-loop stall sanitizer: first a deliberate
      ``time.sleep(0.2)`` injected onto the loop must be caught (the
@@ -57,7 +57,16 @@ generations through the continuous-batching scheduler, then:
      offloads (the static loopcheck contract) actually hold under load.
      The stall report lands in ``--loopsan-out`` (a CI artifact);
 
-  9. under ``--racecheck``, runs the WHOLE lifecycle above with
+  8. asserts the round-18 usage accounting plane end-to-end: a 2-replica
+     worker-process fleet serves a 3:1 weighted tenant mix, the ledger
+     attributes every request to the right HASHED tenant bucket (raw
+     names never reach a label), each worker's delivered + flight-class
+     waste tokens reconcile against its own flight ring, the history
+     store survives a disk snapshot round trip, and the
+     ``/v1/usage``-shaped payload lands in ``--usage-out`` (a CI
+     artifact);
+
+ 10. under ``--racecheck``, runs the WHOLE lifecycle above with
      ``tools.racecheck``'s instrumented locks installed (every
      ``threading.Lock``/``RLock`` the serving stack creates records its
      acquisition ordering) and fails if the observed lock-order graph
@@ -68,6 +77,7 @@ generations through the continuous-batching scheduler, then:
 Usage:  python -m tools.telemetry_smoke [--out telemetry_summary.json]
                                         [--flight-out flight_snapshot.json]
                                         [--batch-out batch_result.jsonl]
+                                        [--usage-out usage_snapshot.json]
                                         [--racecheck]
                                         [--loopsan]
                                         [--loopsan-out loopsan_report.json]
@@ -167,6 +177,18 @@ REQUIRED_FLEETVIEW = (
     'localai_fleet_replicas{model="fleet-grpc",state="healthy"} 2',
     'localai_profiles_captured_total{trigger="stall"} 1',
     "localai_trace_ring_size",
+)
+# usage accounting plane series (round 18): after check_usage exports the
+# ledger, the tenant/goodput/waste families must render with HASHED
+# tenant buckets only (the in-code check pins the exact t-… series and
+# the absence of raw tenant names)
+REQUIRED_USAGE = (
+    "# TYPE localai_tenant_requests_total counter",
+    "# TYPE localai_tenant_tokens_total counter",
+    "# TYPE localai_tenant_kv_block_seconds_total counter",
+    "# TYPE localai_tenant_lru_evictions_total counter",
+    'localai_goodput_tokens_total{model="fleet-usage"}',
+    'localai_goodput_ratio{model="fleet-usage"}',
 )
 
 
@@ -605,6 +627,177 @@ def check_fleetview(registry, fleet_flight_out: str) -> list[str]:
     return problems
 
 
+def check_usage(registry, usage_out: str) -> list[str]:
+    """Round-18 usage accounting plane: a 2-replica WORKER-PROCESS fleet
+    serves a weighted tenant mix from tools.loadgen, then the ledger must
+    (a) attribute every request to the right HASHED tenant bucket (exact
+    against what loadgen actually sent, and within tolerance of the
+    configured mix), (b) reconcile per worker process: delivered +
+    flight-class waste tokens == that worker's flight-ring total, with
+    the front door's own ledger summing to the workers' (no double feed,
+    no dropped feed), (c) round-trip the history store through a disk
+    snapshot, and (d) export to /metrics WITHOUT any raw tenant name.
+    The ``/v1/usage``-shaped payload lands in ``usage_out`` (a CI
+    artifact)."""
+    import json as jsonlib
+    import tempfile
+
+    from localai_tpu.config.app_config import AppConfig
+    from localai_tpu.config.model_config import ModelConfig
+    from localai_tpu.fleet import FleetServingModel
+    from localai_tpu.fleet.replica import WorkerReplica
+    from localai_tpu.obs import fleetview
+    from localai_tpu.obs.history import History
+    from localai_tpu.obs.ledger import FLIGHT_WASTE, LEDGER, derive_tenant
+    from tools.loadgen import EngineSink, LoadGen, Tenant
+
+    problems: list[str] = []
+    # the ledger is process-global and earlier rounds' loadgen traffic
+    # fed it; this round asserts exact attribution, so start clean
+    LEDGER.reset()
+    app = AppConfig()
+    mcfg = ModelConfig.model_validate({
+        "name": "fleet-usage", "model": "debug:tiny", "context_size": 256,
+        "parameters": {"temperature": 0.0, "max_tokens": 6},
+        "engine": {"max_slots": 2, "prefill_buckets": [16, 32, 64, 128],
+                   "dtype": "float32", "kv_dtype": "float32",
+                   "kv_block_tokens": 16},
+    })
+
+    def factory(rid, role):
+        return WorkerReplica(rid, role, mcfg, app,
+                             env={"JAX_PLATFORMS": "cpu"})
+
+    fm = FleetServingModel(mcfg, app, factory, replicas=2,
+                           prefill_replicas=0, disagg_threshold=1 << 30)
+    mix = {"usage-free": 3, "usage-pro": 1}
+    try:
+        gen = LoadGen(mix={"chat": 1.0},
+                      tenants=[Tenant(n, w) for n, w in mix.items()],
+                      rate=20.0, seed=7, max_tokens=6)
+        summary = gen.run(EngineSink(fm, max_tokens=6), total=24,
+                          timeout_s=300.0)
+        bad = {r: n for r, n in summary["outcomes"].items()
+               if r not in ("stop", "length")}
+        if bad or summary["errors"]:
+            problems.append(
+                f"usage traffic failed: {bad} {summary['errors']}")
+        payload = LEDGER.usage_payload()
+        by_tenant: dict[str, int] = {}
+        for row in payload["data"]:
+            by_tenant[row["tenant"]] = (by_tenant.get(row["tenant"], 0)
+                                        + row["requests"])
+        # exact attribution: the ledger's per-tenant request counts must
+        # equal what loadgen actually sent under each name's hash
+        for name, sent in summary["tenants"].items():
+            got = by_tenant.get(derive_tenant(name), 0)
+            if got != sent:
+                problems.append(
+                    f"tenant {name}: ledger counted {got} of {sent} "
+                    f"requests")
+        # …and the realized shares must sit near the configured 3:1 mix
+        total = sum(summary["tenants"].values())
+        weight = sum(mix.values())
+        for name, w in mix.items():
+            share = by_tenant.get(derive_tenant(name), 0) / max(1, total)
+            want = w / weight
+            if abs(share - want) > 0.25:
+                problems.append(
+                    f"tenant {name} share {share:.2f} vs configured "
+                    f"{want:.2f} (tolerance 0.25)")
+        leaked = [t for t in by_tenant if t.startswith("usage-")]
+        if leaked:
+            problems.append(
+                f"raw tenant names leaked into the ledger: {leaked}")
+        # windowed view: every finished request is inside the last hour,
+        # so the ring-backed aggregation must see all of them
+        windowed = LEDGER.usage_payload(window=3600.0)
+        if windowed["events"] != total:
+            problems.append(
+                f"windowed usage saw {windowed['events']} of {total} "
+                f"events")
+        # per-engine-process reconciliation: each worker's ledger
+        # (harvested over GetTelemetry) must balance its own flight ring
+        usage_panes = fleetview.fleet_usage(fm)
+        flight = fleetview.fleet_flight(fm)
+        reconciled = 0
+        for rid, pane in usage_panes.items():
+            if "goodput_tokens" not in pane:
+                problems.append(
+                    f"{rid}: no worker usage pane harvested: {pane}")
+                continue
+            delivered = sum(pane["goodput_tokens"].values())
+            waste = sum(
+                cell["tokens"] for key, cell in pane["waste"].items()
+                if key.partition("/")[0] in FLIGHT_WASTE)
+            ftotal = (flight["replicas"].get(rid) or {}).get("tokens_total")
+            if ftotal is None:
+                problems.append(f"{rid}: no flight pane to reconcile "
+                                f"against")
+            elif delivered + waste != ftotal:
+                problems.append(
+                    f"{rid}: ledger {delivered} delivered + {waste} "
+                    f"flight-waste != flight ring {ftotal} tokens")
+            else:
+                reconciled += 1
+        if reconciled < 2:
+            problems.append(
+                f"reconciled {reconciled} worker ledger(s), need 2")
+        # the front door counted every delivered token exactly once —
+        # its total equals the workers' (one feed per tier, no overlap)
+        front = LEDGER.goodput_totals("fleet-usage")
+        worker_delivered = sum(
+            sum(p.get("goodput_tokens", {}).values())
+            for p in usage_panes.values())
+        if front["delivered_tokens"] != worker_delivered:
+            problems.append(
+                f"front-door delivered {front['delivered_tokens']} != "
+                f"workers' {worker_delivered}")
+        # history round-trip: ledger series → disk snapshot → fresh store
+        h = History()
+        h.observe_ledger(LEDGER)
+        with tempfile.TemporaryDirectory() as td:
+            h.save(td)
+            h2 = History()
+            if not h2.load(td):
+                problems.append("history snapshot did not restore")
+            elif h2.series_names() != h.series_names():
+                problems.append(
+                    f"restored history lost series: "
+                    f"{set(h.series_names()) - set(h2.series_names())}")
+            else:
+                name = f"tenant_tokens.{derive_tenant('usage-free')}"
+                q = h2.query(name, res=1)
+                if not q or not q["points"]:
+                    problems.append(
+                        f"restored history has no points for {name}")
+        # export + exposition safety: hashed buckets render, raw names
+        # never do (REQUIRED_USAGE pins the family lines)
+        LEDGER.export(registry)
+        expo = registry.render()
+        tser = (f'localai_tenant_tokens_total{{lane="interactive",'
+                f'model="fleet-usage",'
+                f'tenant="{derive_tenant("usage-free")}"}}')
+        if tser not in expo:
+            problems.append(f"tenant series missing from /metrics: {tser}")
+        for raw in mix:
+            if raw in expo:
+                problems.append(
+                    f"raw tenant name {raw!r} leaked into /metrics")
+        with open(usage_out, "w") as f:
+            jsonlib.dump({
+                "payload": payload,
+                "windowed": windowed,
+                "replicas": usage_panes,
+                "loadgen": {k: v for k, v in summary.items()
+                            if k != "trace_ids"},
+            }, f, indent=2, sort_keys=True)
+        fm.scheduler.export_gauges()
+    finally:
+        fm.close()
+    return problems
+
+
 def check_anomaly_capture(registry, profile_dir: str) -> list[str]:
     """Round-15 anomaly profiler: an injected ``engine.drain`` stall
     trips the watchdog and auto-captures a (real) jax.profiler trace
@@ -865,6 +1058,7 @@ def main(argv=None) -> int:
     parser.add_argument("--flight-out", default="flight_snapshot.json")
     parser.add_argument("--batch-out", default="batch_result.jsonl")
     parser.add_argument("--fleet-flight-out", default="fleet_flight.json")
+    parser.add_argument("--usage-out", default="usage_snapshot.json")
     parser.add_argument("--profile-dir", default="profile_manifest")
     parser.add_argument("--requests", type=int, default=4)
     # two dispatch-rounds past the compile-bearing first one, so the
@@ -937,6 +1131,7 @@ def main(argv=None) -> int:
         problems += check_fleet(REGISTRY)
         problems += check_kveconomy(REGISTRY)
         problems += check_fleetview(REGISTRY, args.fleet_flight_out)
+        problems += check_usage(REGISTRY, args.usage_out)
         problems += check_anomaly_capture(REGISTRY, args.profile_dir)
         if args.loopsan:
             problems += check_loopsan(args.loopsan_out)
@@ -980,7 +1175,8 @@ def main(argv=None) -> int:
     missing = [s for s in (REQUIRED_SERIES + REQUIRED_FAMILIES
                            + REQUIRED_INTROSPECTION + REQUIRED_SLO
                            + REQUIRED_BATCH + REQUIRED_FLEET
-                           + REQUIRED_KVECONOMY + REQUIRED_FLEETVIEW)
+                           + REQUIRED_KVECONOMY + REQUIRED_FLEETVIEW
+                           + REQUIRED_USAGE)
                if s not in exposition]
     if missing or problems:
         print("FAIL: missing engine telemetry in /metrics exposition:")
@@ -1033,6 +1229,7 @@ def main(argv=None) -> int:
           f"flight ring → {args.flight_out}, "
           f"batch result → {args.batch_out}, "
           f"fleet flight → {args.fleet_flight_out}, "
+          f"usage → {args.usage_out}, "
           f"profiles → {args.profile_dir}/manifest.json"
           + (f", loopsan → {args.loopsan_out}" if args.loopsan else ""))
     print(f"    ttft mean {summary['ttft']['mean_ms']}ms  "
