@@ -277,17 +277,26 @@ def run_decode_bench(preset: str, quant: str, steps: int, multi: int,
     jax.block_until_ready(runner.state.tokens)
     pulse()
 
-    def note_drain(last_t: float) -> float:
+    def note_drain(last_t: float, launch_ms: float,
+                   sync_ms: float) -> float:
         """One drained dispatch: heartbeat + flight record (the ring is
-        what survives an abandoned phase — see module docstring)."""
+        what survives an abandoned phase — see module docstring). Phase
+        attribution mirrors the scheduler's interval tiling (obs.anatomy):
+        measured launch (async enqueue span) + sync (the asarray block),
+        gap by exclusion; the bench loop has no admit work, so sched=0."""
         now = time.monotonic()
         if flight is not None:
+            wall_ms = (now - last_t) * 1e3
+            sync_ms = min(max(0.0, sync_ms), wall_ms)
+            launch_ms = min(max(0.0, launch_ms), wall_ms - sync_ms)
             flight.record(
                 program="decode_n", steps=multi,
-                dispatch_ms=(now - last_t) * 1e3,
+                dispatch_ms=wall_ms,
                 occupancy=1.0, queue_depth=0,
                 kv_utilization=min(1.0, (100 + steps) / max_ctx),
                 tokens=multi * num_slots,
+                gap_ms=max(0.0, wall_ms - launch_ms - sync_ms),
+                launch_ms=launch_ms, sync_ms=sync_ms,
             )
         pulse()
         return now
@@ -296,19 +305,28 @@ def run_decode_bench(preset: str, quant: str, steps: int, multi: int,
     t0 = time.perf_counter()
     last_t = time.monotonic()
     q: deque = deque()
+    launch_acc = 0.0  # enqueue ms since the last drain (obs.anatomy)
     for _ in range(dispatches):
+        tl = time.perf_counter()
         toks = runner.step_n_async(multi)
         try:
             toks.copy_to_host_async()
         except AttributeError:
             pass
+        launch_acc += (time.perf_counter() - tl) * 1e3
         q.append(toks)
         if len(q) >= depth:
+            ts = time.perf_counter()
             np.asarray(q.popleft())
-            last_t = note_drain(last_t)
+            sync_ms = (time.perf_counter() - ts) * 1e3
+            last_t = note_drain(last_t, launch_acc, sync_ms)
+            launch_acc = 0.0
     while q:
+        ts = time.perf_counter()
         np.asarray(q.popleft())
-        last_t = note_drain(last_t)
+        sync_ms = (time.perf_counter() - ts) * 1e3
+        last_t = note_drain(last_t, launch_acc, sync_ms)
+        launch_acc = 0.0
     dt = time.perf_counter() - t0
     # phase provenance for the output line (ISSUE 14 satellite): which
     # attention kernel actually served the measurement, the KV dtype, and
@@ -402,25 +420,39 @@ def run_spec_bench(preset: str, quant: str, steps: int,
     last_t = time.monotonic()
     while emitted < target_tokens and dispatches < steps * 2:
         dispatches += 1
+        tl = time.perf_counter()
         rows = eng.step_spec_async()
+        launch_ms = (time.perf_counter() - tl) * 1e3
         if rows is None:  # lookup miss everywhere — plain fallback
             toks = np.asarray(runner.step())
+            # the runner split its own wall (obs.anatomy scratch); the
+            # declined proposal's host span above stays in gap
+            launch_ms = runner.last_launch_ms
+            sync_ms = runner.last_sync_ms
             for s in slots:
                 eng.drafter.observe(s, [int(toks[s])])
             emitted += num_slots
             w = None
         else:
-            w = eng.observe_window(np.asarray(rows))
+            ts = time.perf_counter()
+            rows = np.asarray(rows)
+            sync_ms = (time.perf_counter() - ts) * 1e3
+            w = eng.observe_window(rows)
             emitted += w["emitted"]
         now = time.monotonic()
         if flight is not None:
+            wall_ms = (now - last_t) * 1e3
+            sync_ms = min(max(0.0, sync_ms), wall_ms)
+            launch_ms = min(max(0.0, launch_ms), wall_ms - sync_ms)
             flight.record(
                 program="spec" if w else "decode", steps=1,
-                dispatch_ms=(now - last_t) * 1e3, occupancy=1.0,
+                dispatch_ms=wall_ms, occupancy=1.0,
                 queue_depth=0, kv_utilization=0.0,
                 tokens=w["emitted"] if w else num_slots,
                 spec_proposed=w["proposed"] if w else 0,
                 spec_accepted=w["accepted"] if w else 0,
+                gap_ms=max(0.0, wall_ms - launch_ms - sync_ms),
+                launch_ms=launch_ms, sync_ms=sync_ms,
             )
         last_t = now
         pulse()
@@ -467,6 +499,7 @@ def _measure_spec(board, preset: str, quant: str, steps: int,
             if pct["step_ms_p50"] is not None:
                 line["step_ms_p50"] = pct["step_ms_p50"]
                 line["step_ms_p99"] = pct["step_ms_p99"]
+            line.update(_anatomy_fields(flight))
         board.annotate("spec", line)
     except Exception as e:  # noqa: BLE001 — keep a diagnosable line
         board.annotate("spec", {
@@ -539,6 +572,21 @@ class _Board:
             sys.stdout.flush()
 
 
+def _anatomy_fields(flight) -> dict:
+    """Dispatch-anatomy attribution for a bench phase line (obs.anatomy):
+    windowless ring summary → host/sync p50 + the bubble estimate, so the
+    line names its bottleneck instead of reporting another blind tok/s."""
+    ph = flight.phases()
+    if not ph.get("samples") or ph.get("host_ms_p50") is None:
+        return {}
+    return {
+        "host_ms_p50": ph["host_ms_p50"],
+        "sync_ms_p50": ph["sync_ms_p50"],
+        "bubble": ph["device_bubble_fraction"],
+        "host_overhead_fraction": ph["host_overhead_fraction"],
+    }
+
+
 def _measure(board: _Board, preset: str, quant: str, steps: int, multi: int,
              depth: int, primary: bool, watchdog=None,
              channel: str = "bench", flight=None, meshed: bool = False) -> None:
@@ -593,6 +641,7 @@ def _measure(board: _Board, preset: str, quant: str, steps: int, multi: int,
             if pct["step_ms_p50"] is not None:
                 line["step_ms_p50"] = pct["step_ms_p50"]
                 line["step_ms_p99"] = pct["step_ms_p99"]
+            line.update(_anatomy_fields(flight))
         if meshed:
             # the meshed line rides the output as its own key — offer()
             # only keeps primaries/promotions, and the meshed phase must

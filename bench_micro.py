@@ -223,6 +223,77 @@ def decode_smoke(paged: bool, preset: str = "tiny", num_slots: int = 4,
     return best
 
 
+def anatomy_smoke(preset: str = "tiny", num_slots: int = 4,
+                  max_ctx: int = 512, multi: int = 16,
+                  dispatches: int = 24, depth: int = 2,
+                  kv_dtype: str = "float32"):
+    """Dispatch-anatomy summary of the pipelined paged decode smoke.
+
+    The same loop shape as bench.py's pipelined decode (async dispatch +
+    copy_to_host_async + deferred drain), with measured launch/sync and
+    gap-by-exclusion phase attribution into a private FlightRecorder
+    (obs.anatomy interval tiling; the smoke loop has no admit work, so
+    sched=0). Returns ``FlightRecorder.phases()`` — tools/perf_smoke.py
+    records and gates ``host_overhead_fraction`` from it, the ratchet the
+    fused-dispatch work must drive down. Warmup compiles outside the
+    measured window, so no compile row ever lands in the ring."""
+    from collections import deque
+
+    import jax
+
+    from localai_tpu.engine.runner import ModelRunner
+    from localai_tpu.models.registry import resolve_model
+    from localai_tpu.obs.flight import FlightRecorder
+
+    model = resolve_model(f"debug:{preset}", dtype="float32")
+    runner = ModelRunner(model.cfg, model.params, num_slots=num_slots,
+                         max_ctx=max_ctx, prefill_buckets=[128],
+                         kv_dtype=kv_dtype, paged=True)
+    prompt = list(range(1, 65))
+    for _ in range(num_slots):
+        runner.admit(runner.acquire_slot(), prompt, temperature=0.0)
+    runner.step_n(multi)  # compile outside the measurement
+    jax.block_until_ready(runner.state.tokens)
+    flight = FlightRecorder(capacity=max(dispatches + 2, 8))
+    q: deque = deque()
+    launch_acc = 0.0
+    last_t = time.monotonic()
+
+    def drain() -> None:
+        nonlocal last_t, launch_acc
+        ts = time.perf_counter()
+        np.asarray(q.popleft())
+        sync_ms = (time.perf_counter() - ts) * 1e3
+        now = time.monotonic()
+        wall_ms = (now - last_t) * 1e3
+        sync_ms = min(sync_ms, wall_ms)
+        launch_ms = min(launch_acc, wall_ms - sync_ms)
+        flight.record(
+            program="decode_n", steps=multi, dispatch_ms=wall_ms,
+            occupancy=1.0, queue_depth=0, kv_utilization=0.0,
+            tokens=multi * num_slots,
+            gap_ms=max(0.0, wall_ms - launch_ms - sync_ms),
+            launch_ms=launch_ms, sync_ms=sync_ms,
+        )
+        launch_acc = 0.0
+        last_t = now
+
+    for _ in range(dispatches):
+        tl = time.perf_counter()
+        toks = runner.step_n_async(multi)
+        try:
+            toks.copy_to_host_async()
+        except AttributeError:
+            pass
+        launch_acc += (time.perf_counter() - tl) * 1e3
+        q.append(toks)
+        if len(q) >= depth:
+            drain()
+    while q:
+        drain()
+    return flight.phases()
+
+
 def main():
     import jax
 

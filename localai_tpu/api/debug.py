@@ -28,6 +28,15 @@ saw); ``?limit=N`` bounds the newest records returned. The "what was the
 engine doing for the last N seconds" view — reading it never touches a
 device.
 
+``GET /debug/anatomy`` — the dispatch-anatomy breakdown (obs.anatomy):
+per-model windowed gap/sched/launch/sync phase percentiles and totals
+from the flight ring's phase columns, the derived
+``host_overhead_fraction`` / ``device_bubble_fraction``, per-phase wall
+shares (stacked-bar ready), and the unattributed remainder.
+``?window=S`` sets the window (default 60 s; ``window=0`` reads the
+whole ring). The "where did the dispatch time go" view — host-side
+reads only, zero device syncs.
+
 ``GET /debug/fleet/flight`` — the fleet-wide flight view: every replica's
 ring harvested over GetTelemetry (off the event loop, fleet RPC deadline)
 and merged into one table with a ``replica`` column plus per-replica
@@ -179,6 +188,29 @@ async def flight(request: web.Request) -> web.Response:
     return web.json_response({
         # the clock records are stamped with, so pollers can window
         "now_monotonic": round(time.monotonic(), 6),
+        "models": models,
+    })
+
+
+async def anatomy(request: web.Request) -> web.Response:
+    from localai_tpu.obs import anatomy as obs_anatomy
+
+    state = _state(request)
+    try:
+        window = float(request.query.get(
+            "window", obs_anatomy.DEFAULT_WINDOW_S))
+    except ValueError:
+        raise web.HTTPBadRequest(text="window must be a number (seconds)")
+    window_s = window if window > 0 else None  # 0 = whole ring
+    models = {}
+    for name, sm in state.manager.loaded_snapshot().items():
+        rec = getattr(getattr(sm, "scheduler", None), "flight", None)
+        if rec is None:
+            continue  # worker-backed / non-LLM serving models have no ring
+        models[name] = obs_anatomy.breakdown(rec, window_s=window_s)
+    return web.json_response({
+        "now_monotonic": round(time.monotonic(), 6),
+        "phases": list(obs_anatomy.PHASES),
         "models": models,
     })
 
@@ -363,6 +395,7 @@ def routes() -> list[web.RouteDef]:
         web.get("/debug/programs", programs),
         web.get("/debug/stacks", stacks),
         web.get("/debug/flight", flight),
+        web.get("/debug/anatomy", anatomy),
         web.get("/debug/fleet/flight", fleet_flight),
         web.get("/debug/profiles", profiles),
         web.get("/debug/history", history_index),

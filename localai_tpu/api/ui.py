@@ -682,6 +682,13 @@ async def slo_page(request: web.Request) -> web.Response:
 <div class="card">
   <h2>Flight recorder</h2>
   <div id="flight" class="dim">loading…</div>
+</div>
+<div class="card">
+  <h2>Dispatch anatomy</h2>
+  <div class="dim" style="margin-bottom:6px">
+    windowed wall-time shares per model: gap / sched / launch / sync /
+    unattributed (obs.anatomy — bubble is an estimator)</div>
+  <div id="anatomy" class="dim">loading…</div>
 </div>"""
     script = """
 function fmt(v, d) {
@@ -748,6 +755,53 @@ async function refresh() {
            'occupancy', 'queue', 'kv util', 'spec accept'], rows);
   } catch (e) {
     document.getElementById('flight').textContent = 'error: ' + e.message;
+  }
+  try {
+    const a = await (await fetch('/debug/anatomy',
+                                 {headers: authHeaders()})).json();
+    const out = document.getElementById('anatomy');
+    out.textContent = '';
+    const colors = {gap: '#888', sched: '#d90', launch: '#38c',
+                    sync: '#2a6', unattributed: '#444'};
+    let any = false;
+    for (const [name, m] of Object.entries(a.models || {})) {
+      if (!m.samples) continue;
+      any = true;
+      const row = document.createElement('div');
+      row.style.margin = '6px 0';
+      const label = document.createElement('div');
+      label.textContent = name + ' — host overhead ' +
+        fmt(m.host_overhead_fraction, 3) + ' · bubble ' +
+        fmt(m.device_bubble_fraction, 3) + ' · ' + m.samples +
+        ' dispatches / ' + fmt(m.dispatch_ms_total, 0) + ' ms';
+      row.appendChild(label);
+      const bar = document.createElement('div');
+      bar.style.cssText =
+        'display:flex;height:14px;border-radius:3px;overflow:hidden;' +
+        'background:#222;margin-top:2px';
+      const shares = Object.assign({}, m.phase_share || {});
+      shares.unattributed = m.unattributed_share;
+      for (const [ph, share] of Object.entries(shares)) {
+        if (!share) continue;
+        const seg = document.createElement('div');
+        seg.style.width = (share * 100).toFixed(1) + '%';
+        seg.style.background = colors[ph] || '#666';
+        seg.title = ph + ' ' + (share * 100).toFixed(1) + '%';
+        bar.appendChild(seg);
+      }
+      row.appendChild(bar);
+      const legend = document.createElement('div');
+      legend.className = 'dim';
+      legend.textContent = Object.entries(shares)
+        .filter(([, v]) => v != null)
+        .map(([ph, v]) => ph + ' ' + (v * 100).toFixed(1) + '%')
+        .join(' · ');
+      row.appendChild(legend);
+      out.appendChild(row);
+    }
+    if (!any) out.textContent = 'no dispatches in window yet';
+  } catch (e) {
+    document.getElementById('anatomy').textContent = 'error: ' + e.message;
   }
 }
 refresh();

@@ -21,6 +21,7 @@ from __future__ import annotations
 import dataclasses
 import logging
 import os
+import time
 from functools import partial
 from typing import Any, Optional
 
@@ -123,6 +124,14 @@ class ModelRunner:
         # (the scheduler rebinds it to its own instance when injected); the
         # process-wide default is unstarted until a Scheduler starts it
         self.watchdog = obs_watchdog.WATCHDOG
+        # dispatch-anatomy scratch (obs.anatomy): the sync-by-contract
+        # entry points (step / step_n / step_frozen_n) split their wall
+        # time into call-return (async enqueue) vs result-fetch (device
+        # block) and leave it here for the caller to harvest. Engine-
+        # thread-only, overwritten every call — an attribution side
+        # channel, not state.
+        self.last_launch_ms = 0.0
+        self.last_sync_ms = 0.0
         # self-extend / group attention (parity: llama.cpp ga_n/ga_w slot
         # options — see engine.selfextend). ga_n>1 serves past the trained
         # context by merging neighbor + grouped attention scores; the KV
@@ -1623,9 +1632,14 @@ class ModelRunner:
         Synchronous by contract — the blocking host read IS the API
         (constraint gating needs the token before the next dispatch);
         pipelined callers use step_async()."""
+        t0 = time.perf_counter()
         tokens = self.step_async()
+        t1 = time.perf_counter()
         with self.watchdog.guard("device"):
-            return np.asarray(tokens)  # jaxlint: disable=host-sync-in-hot-path
+            out = np.asarray(tokens)  # jaxlint: disable=host-sync-in-hot-path
+        self.last_launch_ms = (t1 - t0) * 1e3
+        self.last_sync_ms = (time.perf_counter() - t1) * 1e3
+        return out
 
     def step_async(self) -> jax.Array:
         """Like step() but returns the device array without synchronizing —
@@ -1664,9 +1678,14 @@ class ModelRunner:
         """n decode iterations in one dispatch; returns tokens [n, S].
         Synchronous by contract — see step(); hot callers use
         step_n_async()."""
+        t0 = time.perf_counter()
         tokens = self.step_n_async(n)
+        t1 = time.perf_counter()
         with self.watchdog.guard("device"):
-            return np.asarray(tokens)  # jaxlint: disable=host-sync-in-hot-path
+            out = np.asarray(tokens)  # jaxlint: disable=host-sync-in-hot-path
+        self.last_launch_ms = (t1 - t0) * 1e3
+        self.last_sync_ms = (time.perf_counter() - t1) * 1e3
+        return out
 
     def step_n_async(self, n: int) -> jax.Array:
         """Like step_n() but returns the [n, S] device array without
@@ -1684,6 +1703,7 @@ class ModelRunner:
     def step_frozen_n(self, freeze: np.ndarray, n: int) -> np.ndarray:
         """n decode iterations where ``freeze``-masked slots advance only on
         the first; returns tokens [n, S] (rows 1+ stale for frozen slots)."""
+        t0 = time.perf_counter()
         if self.paged:
             self.kv, self.state, tokens = self._decode_paged_frozen_n(
                 self.params, self.kv, self.state, self.block_tables,
@@ -1696,8 +1716,12 @@ class ModelRunner:
             )
         # synchronous by contract: the frozen slots' constraint masks need
         # the sampled token on the host before the next dispatch
+        t1 = time.perf_counter()
         with self.watchdog.guard("device"):
-            return np.asarray(tokens)  # jaxlint: disable=host-sync-in-hot-path
+            out = np.asarray(tokens)  # jaxlint: disable=host-sync-in-hot-path
+        self.last_launch_ms = (t1 - t0) * 1e3
+        self.last_sync_ms = (time.perf_counter() - t1) * 1e3
+        return out
 
     def embed(self, prompt: list[int]) -> np.ndarray:
         """[D] float32 embedding of a token sequence (bucketed like prefill)."""
@@ -1990,6 +2014,10 @@ class PagedAdmission:
         self.sp = sp                         # ring-attention one-shot path
         self.first_token: Optional[int] = None
         self.done = False
+        # dispatch-anatomy scratch for the last step_chunk() call: enqueue
+        # span vs the final chunk's first-token fetch (obs.anatomy)
+        self.last_launch_ms = 0.0
+        self.last_sync_ms = 0.0
 
     @property
     def chunks_remaining(self) -> int:
@@ -2010,6 +2038,7 @@ class PagedAdmission:
         r = self.runner
         slot = self.slot
         n = len(self.prompt)
+        t0 = time.perf_counter()
         table_row = jnp.asarray(r.allocator.table_row(slot))
         if self.sp:
             # ring attention over the 'seq' mesh axis, scattered straight
@@ -2054,12 +2083,18 @@ class PagedAdmission:
             )
             self.pos += take
         if not last:
+            # pure async enqueue — no sync on intermediate chunks
+            self.last_launch_ms = (time.perf_counter() - t0) * 1e3
+            self.last_sync_ms = 0.0
             return None
         self.done = True
         r._finish_paged_admit(slot, self.prompt, mm=self.mm)
         # the admit-time prefill/decode handoff sync, same as admit()
+        t1 = time.perf_counter()
         with r.watchdog.guard("device"):
             self.first_token = int(tok)  # jaxlint: disable=host-sync-in-hot-path
+        self.last_launch_ms = (t1 - t0) * 1e3
+        self.last_sync_ms = (time.perf_counter() - t1) * 1e3
         return self.first_token
 
     def abort(self) -> None:

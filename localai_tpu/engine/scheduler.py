@@ -37,6 +37,7 @@ import numpy as np
 from localai_tpu.engine.runner import NAN_TOKEN, ModelRunner
 from localai_tpu.engine.stream import IncrementalDetokenizer, StopChecker
 from localai_tpu.faults import registry as _faults
+from localai_tpu.obs import anatomy as obs_anatomy
 from localai_tpu.obs import compile as obs_compile
 from localai_tpu.obs import flight as obs_flight
 from localai_tpu.obs import ledger as obs_ledger
@@ -330,6 +331,12 @@ class Scheduler:
         self.stream_latency_target = stream_latency_target
         self._step_ema: Optional[float] = None   # seconds per decoded token
         self._last_drain_t: Optional[float] = None
+        # dispatch-anatomy accumulators (obs.anatomy): measured host-phase
+        # seconds since the LAST flight record, taken-and-reset by
+        # _take_anat() at each record. Engine-thread-only scratch.
+        self._anat_sched_s = 0.0    # admit/select/host-mirror spans
+        self._anat_launch_s = 0.0   # async jit call-return spans
+        self._anat_overlap_s = 0.0  # wall other records already account
         self.last_dispatch_steps = 0             # observability + tests
         # program shapes already dispatched once: the FIRST dispatch of a
         # new step count includes XLA trace+compile time, which must not be
@@ -494,6 +501,7 @@ class Scheduler:
         None until a post-compile dispatch lands."""
         num_slots = self.runner.num_slots
         pct = self.flight.percentiles()
+        anat = obs_anatomy.summarize(self.flight)
         with self._lock:
             active = [
                 {
@@ -585,6 +593,11 @@ class Scheduler:
             "step_time_ema": self._step_ema,  # seconds per decoded token
             "step_ms_p50": pct["step_ms_p50"],
             "step_ms_p99": pct["step_ms_p99"],
+            # dispatch anatomy (obs.anatomy): windowed host/device
+            # attribution over the same ring the step percentiles read
+            "host_overhead_fraction": anat["host_overhead_fraction"],
+            "device_bubble_fraction": anat["device_bubble_fraction"],
+            "dispatch_phase_ms": obs_anatomy.phase_quantiles(anat),
             **(
                 {"prompt_cache": self.prompt_cache.stats()}
                 if self.prompt_cache is not None else {}
@@ -637,9 +650,35 @@ class Scheduler:
             except Exception as e:  # noqa: BLE001 — cache ≠ serving
                 log.warning("prompt-cache store failed: %s", e)
 
+    def _take_anat(self, dt: float, sync_s: float,
+                   ) -> dict:  # jaxlint: disable=lock-guarded-attr
+        """Take-and-reset the anatomy accumulators into phase ms for a
+        record accounting the wall interval ``dt`` (seconds).
+
+        Clamp order is by trust: the measured ``sync`` block first, then
+        the measured ``launch`` spans, then accumulated ``sched`` (which
+        may predate a non-pipelined record's issue→drain interval and is
+        crowded out rather than stealing from measured phases), then the
+        wall other records already account (``overlap`` — prefill-chunk
+        records inside this interval must not double count as gap).
+        ``gap`` is the remainder, so gap+sched+launch+sync <= dispatch_ms
+        holds structurally for every record. Engine thread only."""
+        wall = max(0.0, dt)
+        sync = min(max(0.0, sync_s), wall)
+        launch = min(self._anat_launch_s, wall - sync)
+        sched = min(self._anat_sched_s, wall - sync - launch)
+        overlap = min(self._anat_overlap_s, wall - sync - launch - sched)
+        gap = max(0.0, wall - sync - launch - sched - overlap)
+        self._anat_sched_s = 0.0
+        self._anat_launch_s = 0.0
+        self._anat_overlap_s = 0.0
+        return {"gap_ms": gap * 1e3, "sched_ms": sched * 1e3,
+                "launch_ms": launch * 1e3, "sync_ms": sync * 1e3}
+
     def _flight_record(self, program: str, steps: int, dt: float,
                        fresh: bool, spec_proposed: int = 0,
-                       spec_accepted: int = 0,
+                       spec_accepted: int = 0, sync_s: float = 0.0,
+                       phases: Optional[dict] = None,
                        ) -> None:  # jaxlint: disable=lock-guarded-attr
         """One flight-ring record at a drain point. Everything here is a
         host mirror this (engine) thread already owns — ``_slots`` is only
@@ -647,13 +686,19 @@ class Scheduler:
         the cost is a handful of scalar reads plus one in-place ring row
         write. Called AFTER ``_process_rows`` so occupancy/tokens reflect
         end-of-dispatch state. ``spec_proposed``/``spec_accepted`` are
-        THIS dispatch's draft counts (speculative windows only)."""
+        THIS dispatch's draft counts (speculative windows only).
+        ``sync_s`` is the measured result-fetch block for this drain;
+        phase attribution comes from _take_anat unless the caller passes
+        a pre-built ``phases`` dict (prefill chunks, whose span must not
+        consume the accumulators owed to the next decode record)."""
         emitted = self._tokens_emitted
         num_slots = self.runner.num_slots
         batch_slots = sum(
             1 for c in self._slots.values()
             if c.handle.request.priority >= PRIORITY_BATCH
         )
+        if phases is None:
+            phases = self._take_anat(dt, sync_s)
         self.flight.record(
             program=program,
             steps=steps,
@@ -668,6 +713,10 @@ class Scheduler:
                          if self.spec is not None else None),
             spec_proposed=spec_proposed,
             spec_accepted=spec_accepted,
+            gap_ms=phases["gap_ms"],
+            sched_ms=phases["sched_ms"],
+            launch_ms=phases["launch_ms"],
+            sync_ms=phases["sync_ms"],
             compile=fresh,
         )
         self._flight_mark = emitted
@@ -942,6 +991,7 @@ class Scheduler:
             # the next dispatch already running on device. Watchdog-guarded:
             # a dead tunnel parks this exact line forever, and the stall
             # forensics must say so.
+            t_sync = time.monotonic()  # anatomy: the result-fetch block
             with self.watchdog.guard(self._wd_channel):
                 if _faults.ACTIVE:  # chaos: wedge/raise inside the guard
                     _faults.apply("engine.drain", key=self._wd_channel)
@@ -951,6 +1001,7 @@ class Scheduler:
                 # the round-trip above — the state is no longer ours
                 raise _EngineAbandoned
             now = time.monotonic()
+            sync_s = now - t_sync
             window = None
             if k == 0 and self.spec is not None:  # speculative window
                 window = self.spec.observe_window(rows)
@@ -995,6 +1046,7 @@ class Scheduler:
                 k_eff, dt, fresh,
                 spec_proposed=window["proposed"] if window else 0,
                 spec_accepted=window["accepted"] if window else 0,
+                sync_s=sync_s,
             )
 
         while not self._stopping and self._epoch == epoch:
@@ -1003,7 +1055,16 @@ class Scheduler:
                 # row so its next logits go non-finite — exercising the
                 # real device-side guard end to end
                 self._inject_slot_faults()
+            t_adm = time.monotonic()
             admitted = self._admit_pending()
+            adm_s = time.monotonic() - t_adm
+            if admitted and not self._chunked:
+                # one-shot admissions dispatch AND sync a full prefill
+                # inside _admit_pending — device compute, not host
+                # scheduling; overlap keeps it out of the next record's gap
+                self._anat_overlap_s += adm_s
+            else:
+                self._anat_sched_s += adm_s
             # chunked prefill: ONE chunk per loop iteration, so pending
             # chunks and decode dispatches alternate — a long prompt
             # spreads its prefill across the batch's decode cadence
@@ -1017,6 +1078,11 @@ class Scheduler:
                 if self._prefills:
                     continue  # no decode work yet — keep chunking
                 if not admitted and not chunked:
+                    # true idle: the poll spans accumulated above belong
+                    # to no future record — drop them
+                    self._anat_sched_s = 0.0
+                    self._anat_launch_s = 0.0
+                    self._anat_overlap_s = 0.0
                     self._wake.wait(timeout=0.05)
                     self._wake.clear()
                 continue
@@ -1049,12 +1115,18 @@ class Scheduler:
                         t0 = time.monotonic()
                         rows = self.runner.step()[None]
                         dt = time.monotonic() - t0
+                        # anatomy: the runner split its own wall into
+                        # enqueue vs result-fetch — harvest the scratch
+                        self._anat_launch_s += (
+                            self.runner.last_launch_ms * 1e-3)
                         if not fresh:
                             self._observe_step_time(dt)
                             obs_compile.note_latency("decode", dt, steps=1)
                         self.last_dispatch_steps = 1
                         self._process_rows(rows, self._dispatch_seq)
-                        self._flight_record("decode", 1, dt, fresh)
+                        self._flight_record(
+                            "decode", 1, dt, fresh,
+                            sync_s=self.runner.last_sync_ms * 1e-3)
                     else:
                         freeze = np.zeros(self.runner.num_slots, bool)
                         freeze[list(constrained)] = True
@@ -1062,6 +1134,8 @@ class Scheduler:
                         t0 = time.monotonic()
                         rows = self.runner.step_frozen_n(freeze, steps)
                         dt = time.monotonic() - t0
+                        self._anat_launch_s += (
+                            self.runner.last_launch_ms * 1e-3)
                         if not fresh:
                             self._observe_step_time(dt / steps)
                             obs_compile.note_latency(
@@ -1071,7 +1145,8 @@ class Scheduler:
                             rows, self._dispatch_seq, frozen=constrained
                         )
                         self._flight_record(
-                            "decode_frozen_n", steps, dt, fresh)
+                            "decode_frozen_n", steps, dt, fresh,
+                            sync_s=self.runner.last_sync_ms * 1e-3)
                     self._last_drain_t = None  # sync path: drain clock stale
                 else:
                     # cheap speculation pre-gate, BEFORE any drain or
@@ -1102,6 +1177,16 @@ class Scheduler:
                         # None = the drafter declined (no lookup hit
                         # anywhere) — fall through to plain decode
                         spec_rows = self.spec.step_spec_async()
+                        # anatomy: proposal + verify enqueue span (host
+                        # drafter work rides in launch — documented
+                        # caveat); a declined proposal dispatched nothing,
+                        # so its host work is scheduling, not launch
+                        if spec_rows is not None:
+                            self._anat_launch_s += (
+                                time.monotonic() - t_issue)
+                        else:
+                            self._anat_sched_s += (
+                                time.monotonic() - t_issue)
                     if spec_rows is not None:
                         self._dispatch_seq += 1
                         fresh = self._fresh_shape("spec")
@@ -1133,6 +1218,8 @@ class Scheduler:
                         tokens.copy_to_host_async()
                     except AttributeError:
                         pass
+                    # anatomy: async enqueue span (jit call + D2H start)
+                    self._anat_launch_s += time.monotonic() - t_issue
                     inflight.append((tokens, self._dispatch_seq, steps,
                                      bool(inflight), t_issue, fresh))
                     if len(inflight) >= self.pipeline_depth:
@@ -1517,7 +1604,21 @@ class Scheduler:
         first = pf.adm.step_chunk()
         dt = time.monotonic() - t0
         self.total_prefill_chunks += 1
-        self._flight_record("prefill_chunk", 0, dt, False)
+        # anatomy: the admission object split its own wall into enqueue
+        # vs the final chunk's first-token fetch; the remainder of THIS
+        # span is chunk staging (sched). Pre-built phases so the chunk
+        # does not consume accumulators owed to the next decode record —
+        # and its whole span becomes overlap there (no double count).
+        wall_ms = max(0.0, dt) * 1e3
+        sync_ms = min(getattr(pf.adm, "last_sync_ms", 0.0), wall_ms)
+        launch_ms = min(getattr(pf.adm, "last_launch_ms", 0.0),
+                        wall_ms - sync_ms)
+        self._flight_record(
+            "prefill_chunk", 0, dt, False,
+            phases={"gap_ms": 0.0,
+                    "sched_ms": wall_ms - sync_ms - launch_ms,
+                    "launch_ms": launch_ms, "sync_ms": sync_ms})
+        self._anat_overlap_s += dt
         if first is None:
             return True
         self._prefills.popleft()

@@ -73,6 +73,11 @@ def telemetry_payload(scheduler: Any, *, trace_id: str = "",
             "records": (flight.snapshot(since=since, limit=limit)
                         if limit > 0 else []),
             "percentiles": flight.percentiles(),
+            # dispatch anatomy (obs.anatomy): windowed phase breakdown +
+            # host/bubble fractions, so the fleet view gets per-replica
+            # bubble columns without a second RPC
+            "anatomy": flight.phases(
+                window_s=60.0) if hasattr(flight, "phases") else None,
             "dispatches": flight.count,
             "tokens_total": flight.total_tokens,
             "capacity": flight.capacity,
@@ -330,15 +335,25 @@ def fleet_flight(sm: Any, *, since: float = 0.0,
             continue
         flight = payload.get("flight") or {}
         records = flight.get("records") or []
+        # anatomy pane is .get()-guarded throughout: a mixed-version
+        # fleet where some replicas predate the phase columns degrades
+        # to None fractions / blank columns, never a KeyError
+        anatomy = flight.get("anatomy") or {}
         panes[rid] = {
             "state": state,
             "records": len(records),
             "percentiles": flight.get("percentiles"),
+            "anatomy": flight.get("anatomy"),
+            "host_overhead_fraction": anatomy.get("host_overhead_fraction"),
+            "device_bubble_fraction": anatomy.get("device_bubble_fraction"),
             "dispatches": flight.get("dispatches"),
             "tokens_total": flight.get("tokens_total"),
         }
         for rec in records:
-            merged.append({**rec, "replica": rid})
+            row = {**rec, "replica": rid}
+            for ph in ("gap_ms", "sched_ms", "launch_ms", "sync_ms"):
+                row.setdefault(ph, None)  # old-version replica → blank
+            merged.append(row)
     merged.sort(key=lambda rec: rec.get("ts_unix") or 0.0)
     return {"replicas": panes, "records": merged, "count": len(merged)}
 

@@ -69,6 +69,10 @@ class FlightRecorder:
         self._spec_accept = np.full(n, np.nan)
         self._spec_proposed = np.zeros(n, np.int64)
         self._spec_accepted = np.zeros(n, np.int64)
+        self._gap_ms = np.zeros(n)
+        self._sched_ms = np.zeros(n)
+        self._launch_ms = np.zeros(n)
+        self._sync_ms = np.zeros(n)
         self._compile = np.zeros(n, bool)
         self._program: list[str] = [""] * n
         self._n = 0                # records ever written (ring head = n % cap)
@@ -82,7 +86,9 @@ class FlightRecorder:
                spec_accept: Optional[float] = None,
                spec_proposed: int = 0, spec_accepted: int = 0,
                compile: bool = False, ts: Optional[float] = None,
-               batch_slots: int = 0) -> None:
+               batch_slots: int = 0, gap_ms: float = 0.0,
+               sched_ms: float = 0.0, launch_ms: float = 0.0,
+               sync_ms: float = 0.0) -> None:
         """Append one dispatch record (host scalars only).
 
         ``batch_slots`` tags the record with the lane mix: how many of the
@@ -90,7 +96,14 @@ class FlightRecorder:
         (0 = pure interactive dispatch). ``spec_proposed``/
         ``spec_accepted`` are THIS dispatch's draft-token counts (0 for
         non-speculative dispatches) — the per-window accept trace the
-        cumulative ``spec_accept`` ratio can't show."""
+        cumulative ``spec_accept`` ratio can't show.
+
+        ``gap_ms``/``sched_ms``/``launch_ms``/``sync_ms`` decompose the
+        wall interval ``dispatch_ms`` accounts for (see
+        :mod:`obs.anatomy` for phase semantics). The scheduler guarantees
+        their sum never exceeds ``dispatch_ms``; callers that cannot
+        attribute phases pass the zero defaults and the record degrades
+        to the undifferentiated pre-anatomy shape."""
         now = time.monotonic() if ts is None else ts
         with self._lock:
             i = self._n % self.capacity
@@ -107,6 +120,10 @@ class FlightRecorder:
                                     else spec_accept)
             self._spec_proposed[i] = spec_proposed
             self._spec_accepted[i] = spec_accepted
+            self._gap_ms[i] = gap_ms
+            self._sched_ms[i] = sched_ms
+            self._launch_ms[i] = launch_ms
+            self._sync_ms[i] = sync_ms
             self._compile[i] = compile
             self._program[i] = program
             self._n += 1
@@ -159,6 +176,10 @@ class FlightRecorder:
                 "acc": self._spec_accept[order].tolist(),
                 "proposed": self._spec_proposed[order].tolist(),
                 "accepted": self._spec_accepted[order].tolist(),
+                "gap": self._gap_ms[order].tolist(),
+                "sched": self._sched_ms[order].tolist(),
+                "launch": self._launch_ms[order].tolist(),
+                "sync": self._sync_ms[order].tolist(),
                 "compile": self._compile[order].tolist(),
                 "program": [self._program[i] for i in order],
             }
@@ -185,6 +206,10 @@ class FlightRecorder:
                 "spec_accept": (None if np.isnan(acc) else round(acc, 4)),
                 "spec_proposed": cols["proposed"][j],
                 "spec_accepted": cols["accepted"][j],
+                "gap_ms": round(cols["gap"][j], 3),
+                "sched_ms": round(cols["sched"][j], 3),
+                "launch_ms": round(cols["launch"][j], 3),
+                "sync_ms": round(cols["sync"][j], 3),
                 "compile": cols["compile"][j],
             })
         return out
@@ -220,3 +245,78 @@ class FlightRecorder:
             "step_ms_p99": round(float(p99), 4),
             "samples": int(len(per_step)),
         }
+
+    def phases(self, window_s: Optional[float] = None,
+               now: Optional[float] = None) -> dict:
+        """Per-phase dispatch-anatomy percentiles + windowed fractions.
+
+        Same window semantics as :meth:`percentiles` (ring by default,
+        ``window_s`` to restrict; compile-bearing rows excluded — a
+        compile's minutes of tracing would drown every phase). For each
+        phase in gap/sched/launch/sync: ``{phase}_ms_p50/p90/p99`` and
+        ``{phase}_ms_total`` over the window, plus ``dispatch_ms_total``,
+        ``host_ms_total`` (gap+sched+launch) and the two derived gauges:
+
+        * ``host_overhead_fraction`` = host_ms_total / dispatch_ms_total —
+          the share of accounted wall time the host spent NOT blocked on
+          the device.
+        * ``device_bubble_fraction`` — estimator of device idle share:
+          per record ``max(0, (gap+sched+launch) - sync_ms)`` summed over
+          the window, / dispatch_ms_total. A record whose host phases
+          were fully covered by a later sync wait means the device queue
+          hid the host time (no bubble); host time the device did NOT
+          make the host wait for is (estimated) device idleness. An
+          estimator, not a measurement — see :mod:`obs.anatomy`.
+        """
+        with self._lock:
+            order = self._order()
+            mask = ~self._compile[order]
+            if window_s is not None:
+                cutoff = (time.monotonic() if now is None else now) - window_s
+                mask &= self._ts[order] >= cutoff
+            rows = order[mask]
+            ph_cols = {
+                "gap": self._gap_ms[rows].copy(),
+                "sched": self._sched_ms[rows].copy(),
+                "launch": self._launch_ms[rows].copy(),
+                "sync": self._sync_ms[rows].copy(),
+            }
+            dispatch = self._dispatch_ms[rows].copy()
+        out: dict = {"samples": int(len(dispatch))}
+        if len(dispatch) == 0:
+            for ph in (*ph_cols, "host"):
+                out[f"{ph}_ms_p50"] = None
+                out[f"{ph}_ms_p90"] = None
+                out[f"{ph}_ms_p99"] = None
+            for ph in ph_cols:
+                out[f"{ph}_ms_total"] = 0.0
+            out["dispatch_ms_total"] = 0.0
+            out["host_ms_total"] = 0.0
+            out["host_overhead_fraction"] = None
+            out["device_bubble_fraction"] = None
+            return out
+        for ph, arr in ph_cols.items():
+            p50, p90, p99 = np.percentile(arr, (50, 90, 99))
+            out[f"{ph}_ms_p50"] = round(float(p50), 4)
+            out[f"{ph}_ms_p90"] = round(float(p90), 4)
+            out[f"{ph}_ms_p99"] = round(float(p99), 4)
+            out[f"{ph}_ms_total"] = round(float(arr.sum()), 3)
+        host = ph_cols["gap"] + ph_cols["sched"] + ph_cols["launch"]
+        # host percentiles are computed on the per-record SUM, not a sum
+        # of per-phase percentiles (those don't compose)
+        p50, p90, p99 = np.percentile(host, (50, 90, 99))
+        out["host_ms_p50"] = round(float(p50), 4)
+        out["host_ms_p90"] = round(float(p90), 4)
+        out["host_ms_p99"] = round(float(p99), 4)
+        bubble = np.maximum(0.0, host - ph_cols["sync"])
+        total = float(dispatch.sum())
+        out["dispatch_ms_total"] = round(total, 3)
+        out["host_ms_total"] = round(float(host.sum()), 3)
+        if total > 0:
+            out["host_overhead_fraction"] = round(float(host.sum()) / total, 4)
+            out["device_bubble_fraction"] = round(
+                float(bubble.sum()) / total, 4)
+        else:
+            out["host_overhead_fraction"] = None
+            out["device_bubble_fraction"] = None
+        return out

@@ -141,9 +141,13 @@ class History:
             return
         gauges = (("occupancy", "occupancy"),
                   ("queue_depth", "queue_depth"),
-                  ("kv_utilization", "kv_utilization"))
+                  ("kv_utilization", "kv_utilization"),
+                  # dispatch anatomy (obs.anatomy): None until the ring's
+                  # window holds a non-compile dispatch — skip, don't zero
+                  ("host_overhead_fraction", "host_overhead_fraction"),
+                  ("device_bubble_fraction", "device_bubble_fraction"))
         for key, series in gauges:
-            if key in m:
+            if m.get(key) is not None:
                 self.record(f"{series}.{model}", m[key])
         counters = (("total_generated_tokens", "tokens_generated"),
                     ("total_prompt_tokens", "tokens_prompt"),

@@ -272,6 +272,25 @@ class Registry:
             "engine reports its most recent activity (quantile label: "
             "p50/p99)",
         )
+        self.dispatch_phase_ms = Gauge(
+            "localai_dispatch_phase_ms",
+            "Dispatch-anatomy phase time over the flight ring's recent "
+            "window, compile rows excluded (phase label: gap/sched/"
+            "launch/sync, quantile label: p50/p90/p99 — see obs.anatomy "
+            "for phase semantics)",
+        )
+        self.host_overhead_fraction = Gauge(
+            "localai_host_overhead_fraction",
+            "Share of windowed dispatch wall time the host spent NOT "
+            "blocked on the device (gap+sched+launch over dispatch "
+            "wall) — the number fused multi-step dispatch must drive down",
+        )
+        self.device_bubble_fraction = Gauge(
+            "localai_device_bubble_fraction",
+            "Estimated share of windowed dispatch wall time the device "
+            "sat idle: host phases not covered by a later result-fetch "
+            "block (estimator — see obs.anatomy caveats)",
+        )
         self.slo_burn_rate = Gauge(
             "localai_slo_burn_rate",
             "Error-budget burn rate per model and window "
@@ -705,6 +724,20 @@ def update_engine_gauges(name: str, m: dict,
         v = m.get(f"step_ms_{q}")
         if v is not None:
             reg.step_time_ms.set(v, model=name, quantile=q)
+    # dispatch anatomy (obs.anatomy): windowed phase percentiles + the
+    # derived host/bubble fractions; absent keys (old-version payloads,
+    # empty windows) simply leave the gauges untouched
+    for ph, qs in (m.get("dispatch_phase_ms") or {}).items():
+        for q, v in qs.items():
+            if v is not None:
+                reg.dispatch_phase_ms.set(v, model=name, phase=ph,
+                                          quantile=q)
+    v = m.get("host_overhead_fraction")
+    if v is not None:
+        reg.host_overhead_fraction.set(v, model=name)
+    v = m.get("device_bubble_fraction")
+    if v is not None:
+        reg.device_bubble_fraction.set(v, model=name)
 
 
 REGISTRY = Registry()

@@ -329,6 +329,49 @@ def test_fleet_flight_merges_replicas(fleet):
     # percentile panes ride along
     assert all("percentiles" in p for p in
                (out["replicas"][rid] for rid in with_records))
+    # dispatch-anatomy columns on every merged row, fraction gauges per
+    # replica pane (the per-replica bubble columns on /debug/fleet/flight)
+    for rec in out["records"]:
+        for ph in ("gap_ms", "sched_ms", "launch_ms", "sync_ms"):
+            assert ph in rec
+    assert all("host_overhead_fraction" in out["replicas"][rid]
+               and "device_bubble_fraction" in out["replicas"][rid]
+               for rid in with_records)
+
+
+def test_fleet_flight_tolerates_replicas_without_phase_columns():
+    """A mixed-version fleet: a replica whose payload predates the
+    anatomy columns merges with BLANK phase cells and None fractions —
+    never a KeyError (round-19 satellite)."""
+
+    class LegacyReplica:
+        id = "legacy/r0"
+        state = "healthy"
+
+        def telemetry(self, trace_id="", since=0.0, limit=64, recent=0):
+            return {"flight": {
+                "records": [{"ts": 1.0, "ts_unix": 100.0,
+                             "program": "decode_n", "dispatch_ms": 5.0}],
+                "percentiles": None, "dispatches": 1, "tokens_total": 8,
+            }}
+
+    class Pool:
+        def members(self):
+            return [LegacyReplica()]
+
+    class SM:
+        pool = Pool()
+
+    out = fleetview.fleet_flight(SM())
+    assert out["count"] == 1
+    row = out["records"][0]
+    assert row["replica"] == "legacy/r0"
+    for ph in ("gap_ms", "sched_ms", "launch_ms", "sync_ms"):
+        assert row[ph] is None
+    pane = out["replicas"]["legacy/r0"]
+    assert pane["host_overhead_fraction"] is None
+    assert pane["device_bubble_fraction"] is None
+    assert pane["anatomy"] is None
 
 
 def test_replica_telemetry_never_raises(fleet):

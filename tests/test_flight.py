@@ -17,10 +17,11 @@ from localai_tpu.obs import slo as obs_slo
 
 
 def _rec(fl, i, *, steps=8, ms=8.0, compile=False, tokens=32, ts=None,
-         program="decode_n"):
+         program="decode_n", gap=0.0, sched=0.0, launch=0.0, sync=0.0):
     fl.record(program=program, steps=steps, dispatch_ms=ms,
               occupancy=0.5, queue_depth=i, kv_utilization=0.25,
-              tokens=tokens, preemptions=0, compile=compile, ts=ts)
+              tokens=tokens, preemptions=0, compile=compile, ts=ts,
+              gap_ms=gap, sched_ms=sched, launch_ms=launch, sync_ms=sync)
 
 
 def test_ring_wraparound_keeps_newest():
@@ -100,6 +101,113 @@ def test_snapshot_since_and_limit():
     assert len(fl.snapshot(limit=2)) == 2
     assert fl.snapshot(limit=2)[-1]["queue_depth"] == 5
     assert fl.snapshot(since=106.0) == []
+
+
+# -- dispatch anatomy (phase columns + obs.anatomy) --------------------------
+
+
+def test_phase_columns_default_zero_and_survive_since_filter():
+    fl = FlightRecorder(8)
+    _rec(fl, 0, ts=100.0)                       # no phase kwargs
+    _rec(fl, 1, ts=101.0, gap=1.0, sched=2.0, launch=3.0, sync=4.0)
+    snap = fl.snapshot()
+    for key in ("gap_ms", "sched_ms", "launch_ms", "sync_ms"):
+        assert snap[0][key] == 0.0              # pre-anatomy degrade shape
+    assert snap[1]["gap_ms"] == 1.0
+    assert snap[1]["sync_ms"] == 4.0
+    # the since-filtered view carries the same phase keys (satellite:
+    # merged fleet rows must never KeyError on them)
+    newer = fl.snapshot(since=100.5)
+    assert len(newer) == 1
+    assert newer[0]["sched_ms"] == 2.0 and newer[0]["launch_ms"] == 3.0
+
+
+def test_phase_columns_survive_wraparound():
+    fl = FlightRecorder(4)
+    for i in range(10):
+        _rec(fl, i, sync=float(i))
+    snap = fl.snapshot()
+    assert [r["sync_ms"] for r in snap] == [6.0, 7.0, 8.0, 9.0]
+    ph = fl.phases()
+    assert ph["samples"] == 4                   # resident rows only
+    assert ph["sync_ms_total"] == pytest.approx(30.0)
+
+
+def test_phases_percentile_math_matches_numpy():
+    fl = FlightRecorder(64)
+    gaps = [1.0, 2.0, 3.0, 4.0, 5.0]
+    syncs = [0.5, 1.0, 1.5, 2.0, 2.5]
+    for i, (g, s) in enumerate(zip(gaps, syncs)):
+        _rec(fl, i, ms=20.0, gap=g, sched=0.5, launch=2.0, sync=s)
+    ph = fl.phases()
+    assert ph["samples"] == 5
+    assert ph["gap_ms_p50"] == pytest.approx(
+        np.percentile(gaps, 50), abs=1e-3)
+    assert ph["gap_ms_p90"] == pytest.approx(
+        np.percentile(gaps, 90), abs=1e-3)
+    assert ph["sync_ms_p99"] == pytest.approx(
+        np.percentile(syncs, 99), abs=1e-3)
+    # host percentiles are over the per-record SUM (percentiles of
+    # independent phases do not compose)
+    host = np.array(gaps) + 0.5 + 2.0
+    assert ph["host_ms_p50"] == pytest.approx(
+        np.percentile(host, 50), abs=1e-3)
+    # windowed totals + fractions
+    assert ph["dispatch_ms_total"] == pytest.approx(100.0)
+    assert ph["host_ms_total"] == pytest.approx(host.sum(), abs=1e-3)
+    assert ph["host_overhead_fraction"] == pytest.approx(
+        host.sum() / 100.0, abs=1e-3)
+    bubble = np.maximum(0.0, host - np.array(syncs))
+    assert ph["device_bubble_fraction"] == pytest.approx(
+        bubble.sum() / 100.0, abs=1e-3)
+
+
+def test_phases_exclude_compile_rows_and_window():
+    fl = FlightRecorder(16)
+    # a compile row's minutes of tracing must not drown the phases
+    _rec(fl, 0, ms=5000.0, compile=True, gap=4000.0, sync=900.0, ts=100.0)
+    _rec(fl, 1, ms=10.0, gap=6.0, sync=4.0, ts=100.0)
+    _rec(fl, 2, ms=10.0, gap=2.0, sync=8.0, ts=200.0)
+    ph = fl.phases()
+    assert ph["samples"] == 2
+    assert ph["dispatch_ms_total"] == pytest.approx(20.0)
+    assert ph["gap_ms_total"] == pytest.approx(8.0)
+    # window keeps only the recent row
+    ph = fl.phases(window_s=50.0, now=210.0)
+    assert ph["samples"] == 1
+    assert ph["sync_ms_total"] == pytest.approx(8.0)
+    assert ph["host_overhead_fraction"] == pytest.approx(0.2)
+
+
+def test_phases_empty_returns_none_percentiles():
+    fl = FlightRecorder(8)
+    ph = fl.phases()
+    assert ph["samples"] == 0
+    for name in ("gap", "sched", "launch", "sync", "host"):
+        assert ph[f"{name}_ms_p50"] is None
+    assert ph["host_overhead_fraction"] is None
+    assert ph["device_bubble_fraction"] is None
+    assert ph["dispatch_ms_total"] == 0.0
+
+
+def test_anatomy_breakdown_shares_and_quantiles():
+    from localai_tpu.obs import anatomy
+
+    fl = FlightRecorder(16)
+    _rec(fl, 0, ms=10.0, gap=1.0, sched=2.0, launch=3.0, sync=4.0)
+    _rec(fl, 1, ms=10.0)                        # fully unattributed
+    b = anatomy.breakdown(fl, window_s=None)
+    assert b["samples"] == 2
+    assert b["phase_share"]["gap"] == pytest.approx(0.05)
+    assert b["phase_share"]["sync"] == pytest.approx(0.2)
+    # the all-zero record's wall lands in unattributed, not in a phase
+    assert b["unattributed_ms_total"] == pytest.approx(10.0)
+    assert b["unattributed_share"] == pytest.approx(0.5)
+    q = anatomy.phase_quantiles(anatomy.summarize(fl, window_s=None))
+    assert set(q) == set(anatomy.PHASES)
+    assert set(q["gap"]) == {"p50", "p90", "p99"}
+    assert q["launch"]["p99"] == pytest.approx(
+        np.percentile([3.0, 0.0], 99), abs=1e-3)
 
 
 # -- SLO observatory ---------------------------------------------------------

@@ -69,6 +69,21 @@ PREFIX_PROMPTS = (
 DEFAULT_MIX = {"chat": 0.6, "embeddings": 0.2, "batch": 0.2}
 
 
+def _latency_summary(vals: list[float]) -> Optional[dict]:
+    """p50/p95/count over client-observed latencies (ms). None when no
+    request yielded a usable timestamp pair — the summary key stays
+    present so consumers need no existence check, only a None check."""
+    if not vals:
+        return None
+    xs = sorted(vals)
+
+    def pct(p: float) -> float:
+        return xs[min(len(xs) - 1, int(p * len(xs)))]
+
+    return {"p50": round(pct(0.50), 3), "p95": round(pct(0.95), 3),
+            "count": len(xs)}
+
+
 @dataclasses.dataclass
 class Tenant:
     """One traffic source: requests carry its name (the correlation /
@@ -170,10 +185,23 @@ class LoadGen:
                     errors.append(f"{kind}: {e}")
             time.sleep(self.rng.expovariate(self.rate))
         deadline = time.monotonic() + timeout_s
+        client_ttft: list[float] = []
+        client_e2e: list[float] = []
         for h, kind in handles:
             try:
                 h.result(timeout=max(1.0, deadline - time.monotonic()))
                 reason = h.finish_reason or "none"
+                # client-observed latency: the handle's own submit/first-
+                # token/done stamps (GenHandle and _HttpChatHandle both
+                # carry them) — what the CALLER waited, queueing included,
+                # which the server-side histogram cannot see on its own
+                ts = getattr(h, "t_submit", None)
+                tf = getattr(h, "t_first_token", None)
+                td = getattr(h, "t_done", None)
+                if ts is not None and td is not None and td >= ts:
+                    client_e2e.append((td - ts) * 1e3)
+                if ts is not None and tf is not None and tf >= ts:
+                    client_ttft.append((tf - ts) * 1e3)
             except Exception as e:  # noqa: BLE001 — failures are COUNTED,
                 # never raised: the chaos harness injects them on purpose
                 errors.append(f"{kind}: {e}")
@@ -189,6 +217,8 @@ class LoadGen:
             "outcomes": outcomes,
             "errors": errors,
             "trace_ids": trace_ids,
+            "client_ttft_ms": _latency_summary(client_ttft),
+            "client_e2e_ms": _latency_summary(client_e2e),
         }
 
 
@@ -231,6 +261,13 @@ class _HttpChatHandle:
 
     def __init__(self):
         self.finish_reason: Optional[str] = None
+        # client-observed stamps matching the GenHandle surface. The chat
+        # endpoint is non-streaming, so the first byte the client sees IS
+        # the full body: t_first_token == t_done by construction (an honest
+        # upper bound on TTFT, noted in the README anatomy runbook).
+        self.t_submit: Optional[float] = None
+        self.t_first_token: Optional[float] = None
+        self.t_done: Optional[float] = None
         self._text = ""
         self._error: Optional[str] = None
         self._done = threading.Event()
@@ -268,6 +305,7 @@ class HttpSink:
     def chat(self, text: str, *, tenant: str = "default",
              trace_id: str = "", background: bool = False):
         h = _HttpChatHandle()
+        h.t_submit = time.monotonic()
 
         def post():
             try:
@@ -284,6 +322,10 @@ class HttpSink:
                 h._error = f"{tenant}/{trace_id}: {e}"
                 h.finish_reason = "exception"
             finally:
+                h.t_done = time.monotonic()
+                if h._error is None:
+                    h.t_first_token = h.t_done  # non-streaming: first
+                    # byte == full body
                 h._done.set()
 
         threading.Thread(target=post, daemon=True,

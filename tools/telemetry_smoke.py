@@ -66,6 +66,16 @@ generations through the continuous-batching scheduler, then:
      ``/v1/usage``-shaped payload lands in ``--usage-out`` (a CI
      artifact);
 
+ 11. asserts the round-19 dispatch anatomy: extra ``tools.loadgen``
+     traffic through the smoke engine leaves every flight-ring record
+     with gap/sched/launch/sync phases summing within its
+     ``dispatch_ms`` (the interval-tiling invariant), the derived
+     host-overhead fraction in (0, 1), the
+     ``localai_dispatch_phase_ms`` / ``localai_host_overhead_fraction``
+     / ``localai_device_bubble_fraction`` series rendering, and the
+     client-observed TTFT p95 agreeing with the server-side histogram;
+     the breakdown lands in ``--anatomy-out`` (a CI artifact);
+
  10. under ``--racecheck``, runs the WHOLE lifecycle above with
      ``tools.racecheck``'s instrumented locks installed (every
      ``threading.Lock``/``RLock`` the serving stack creates records its
@@ -189,6 +199,18 @@ REQUIRED_USAGE = (
     "# TYPE localai_tenant_lru_evictions_total counter",
     'localai_goodput_tokens_total{model="fleet-usage"}',
     'localai_goodput_ratio{model="fleet-usage"}',
+)
+# dispatch-anatomy series (round 19): after real traffic through the
+# smoke engine, every phase column must render a windowed percentile and
+# both derived fractions must be present (values asserted in-code by
+# check_anatomy; the exposition check pins the series names)
+REQUIRED_ANATOMY = (
+    'localai_dispatch_phase_ms{model="smoke",phase="gap",quantile="p50"}',
+    'localai_dispatch_phase_ms{model="smoke",phase="sched",quantile="p50"}',
+    'localai_dispatch_phase_ms{model="smoke",phase="launch",quantile="p50"}',
+    'localai_dispatch_phase_ms{model="smoke",phase="sync",quantile="p99"}',
+    'localai_host_overhead_fraction{model="smoke"}',
+    'localai_device_bubble_fraction{model="smoke"}',
 )
 
 
@@ -798,6 +820,129 @@ def check_usage(registry, usage_out: str) -> list[str]:
     return problems
 
 
+def check_anatomy(sched, tok, registry, anatomy_out: str) -> list[str]:
+    """Round-19 dispatch anatomy: drive extra client traffic through the
+    REAL smoke engine, then assert the phase decomposition holds record
+    by record (gap+sched+launch+sync ≤ dispatch_ms — the interval-tiling
+    invariant ``Scheduler._take_anat`` guarantees by clamp order), the
+    derived ``host_overhead_fraction`` is a genuine fraction in (0, 1),
+    and the client-observed TTFT p95 from ``tools.loadgen`` agrees with
+    the server-side ``localai_ttft_seconds`` histogram (same submit /
+    first-token stamps, so gross disagreement means one side is lying —
+    the tolerance only absorbs bucket granularity and the earlier smoke
+    requests sharing the histogram). Writes the breakdown + cross-check
+    receipt to ``anatomy_out`` (a CI artifact)."""
+    import json as jsonlib
+    import re
+    import types
+
+    from localai_tpu.obs import anatomy as obs_anatomy
+    from localai_tpu.obs.metrics import update_engine_gauges
+    from tools.loadgen import EngineSink, LoadGen
+
+    problems = []
+
+    def ttft_buckets():
+        # cumulative (upper_bound_s, count) pairs for model="smoke" out
+        # of the rendered exposition — the same text a scrape would see
+        pat = re.compile(r'localai_ttft_seconds_bucket\{model="smoke",'
+                         r'le="([^"]+)"\} (\d+)')
+        return [(float("inf") if le == "+Inf" else float(le), int(c))
+                for le, c in pat.findall(registry.ttft.render())]
+
+    # chat-only mix: the batch lane is excluded from the TTFT histogram
+    # by design, so every client latency sample must have a server twin.
+    # Snapshot the histogram FIRST: the earlier smoke requests paid the
+    # compile, and diffing bucket counts is what isolates the server-side
+    # view of exactly this traffic.
+    before = dict(ttft_buckets())
+    sm = types.SimpleNamespace(scheduler=sched, tokenizer=tok, runner=None)
+    gen = LoadGen(mix={"chat": 1.0}, rate=64.0, seed=19, max_tokens=8)
+    summary = gen.run(EngineSink(sm, max_tokens=8), total=8)
+    if summary["errors"]:
+        problems.append(f"anatomy loadgen traffic errored: "
+                        f"{summary['errors'][:3]}")
+
+    # (a) per-record tiling invariant over the live ring
+    rows = sched.flight.snapshot()
+    decode_rows = [r for r in rows if not r["compile"]]
+    if not decode_rows:
+        problems.append("anatomy: flight ring has no post-compile rows")
+    for r in decode_rows:
+        phase_sum = (r["gap_ms"] + r["sched_ms"] + r["launch_ms"]
+                     + r["sync_ms"])
+        # 5e-3 slack: snapshot rounds each column to 3 decimals, so four
+        # rounded-up phases can nominally exceed a rounded-down dispatch
+        if phase_sum > r["dispatch_ms"] + 5e-3:
+            problems.append(
+                f"anatomy: phase sum {phase_sum:.3f}ms exceeds "
+                f"dispatch_ms {r['dispatch_ms']:.3f} "
+                f"(program={r['program']})")
+            break
+
+    # (b) derived fractions: genuine open-interval fractions
+    anat = obs_anatomy.summarize(sched.flight, window_s=None)
+    hof = anat["host_overhead_fraction"]
+    bubble = anat["device_bubble_fraction"]
+    if not anat["samples"]:
+        problems.append("anatomy: summarize() saw zero samples")
+    elif hof is None or not (0.0 < hof < 1.0):
+        problems.append(
+            f"anatomy: host_overhead_fraction {hof} outside (0, 1)")
+    if bubble is not None and not (0.0 <= bubble <= 1.0):
+        problems.append(
+            f"anatomy: device_bubble_fraction {bubble} outside [0, 1]")
+
+    # (c) client-vs-server latency cross-check: diff the histogram around
+    # the loadgen run (isolating exactly this traffic's server view),
+    # then the client p95 must land inside the delta-histogram's p95
+    # bucket — both sides derive from the same handle stamps, so the
+    # slack only absorbs bucket granularity
+    client = summary.get("client_ttft_ms")
+    cross = {"client_ttft_ms": client}
+    if not client:
+        problems.append("anatomy: loadgen produced no client TTFT samples")
+    else:
+        delta = [(ub, cum - before.get(ub, 0))
+                 for ub, cum in ttft_buckets()]
+        total = delta[-1][1] if delta else 0
+        if total < client["count"]:
+            problems.append(
+                f"anatomy: server ttft histogram gained {total} samples "
+                f"but the client observed {client['count']}")
+        else:
+            lo, hi = 0.0, float("inf")
+            for ub, cum in delta:
+                if cum >= 0.95 * total:
+                    hi = ub
+                    break
+                lo = ub
+            client_p95_s = client["p95"] / 1e3
+            if (client_p95_s < lo / 2 - 0.05
+                    or client_p95_s > hi * 2 + 0.05):
+                problems.append(
+                    f"anatomy: client ttft p95 {client_p95_s:.3f}s "
+                    f"disagrees with server histogram p95 bucket "
+                    f"({lo}, {hi}]s")
+            cross.update(server_p95_bucket_lo_s=lo,
+                         server_p95_bucket_hi_s=(
+                             None if hi == float("inf") else hi),
+                         server_samples=total)
+
+    # re-export so the phase gauges reflect the anatomy traffic, exactly
+    # what a scrape after this load would show
+    update_engine_gauges("smoke", sched.metrics())
+    with open(anatomy_out, "w") as f:
+        jsonlib.dump({
+            "breakdown": obs_anatomy.breakdown(sched.flight,
+                                               window_s=None),
+            "client_cross_check": cross,
+            "loadgen": {k: v for k, v in summary.items()
+                        if k != "trace_ids"},
+        }, f, indent=2, sort_keys=True)
+    return problems
+
+
 def check_anomaly_capture(registry, profile_dir: str) -> list[str]:
     """Round-15 anomaly profiler: an injected ``engine.drain`` stall
     trips the watchdog and auto-captures a (real) jax.profiler trace
@@ -1059,6 +1204,7 @@ def main(argv=None) -> int:
     parser.add_argument("--batch-out", default="batch_result.jsonl")
     parser.add_argument("--fleet-flight-out", default="fleet_flight.json")
     parser.add_argument("--usage-out", default="usage_snapshot.json")
+    parser.add_argument("--anatomy-out", default="anatomy_report.json")
     parser.add_argument("--profile-dir", default="profile_manifest")
     parser.add_argument("--requests", type=int, default=4)
     # two dispatch-rounds past the compile-bearing first one, so the
@@ -1132,6 +1278,7 @@ def main(argv=None) -> int:
         problems += check_kveconomy(REGISTRY)
         problems += check_fleetview(REGISTRY, args.fleet_flight_out)
         problems += check_usage(REGISTRY, args.usage_out)
+        problems += check_anatomy(sched, tok, REGISTRY, args.anatomy_out)
         problems += check_anomaly_capture(REGISTRY, args.profile_dir)
         if args.loopsan:
             problems += check_loopsan(args.loopsan_out)
@@ -1176,7 +1323,7 @@ def main(argv=None) -> int:
                            + REQUIRED_INTROSPECTION + REQUIRED_SLO
                            + REQUIRED_BATCH + REQUIRED_FLEET
                            + REQUIRED_KVECONOMY + REQUIRED_FLEETVIEW
-                           + REQUIRED_USAGE)
+                           + REQUIRED_USAGE + REQUIRED_ANATOMY)
                if s not in exposition]
     if missing or problems:
         print("FAIL: missing engine telemetry in /metrics exposition:")
@@ -1230,6 +1377,7 @@ def main(argv=None) -> int:
           f"batch result → {args.batch_out}, "
           f"fleet flight → {args.fleet_flight_out}, "
           f"usage → {args.usage_out}, "
+          f"anatomy → {args.anatomy_out}, "
           f"profiles → {args.profile_dir}/manifest.json"
           + (f", loopsan → {args.loopsan_out}" if args.loopsan else ""))
     print(f"    ttft mean {summary['ttft']['mean_ms']}ms  "
