@@ -279,6 +279,51 @@ async def fleet_register(request: web.Request) -> web.Response:
     return web.json_response({"address": address, "adopted": adopted})
 
 
+async def fleet_swap(request: web.Request) -> web.Response:
+    """POST /v1/fleet/{model}/swap: hot weight swap as the deploy
+    primitive — boot fresh replicas (``{"checkpoint": "ref"}`` switches
+    weights; an empty body recycles the current ones), shift router
+    traffic, drain and retire the old generation. Same ``peer_token``
+    guard as fleet registration: this mutates serving capacity."""
+    import hmac
+
+    state = _state(request)
+    if state.config.peer_token:
+        header = request.headers.get("Authorization", "")
+        token = header.removeprefix("Bearer ").strip()
+        if not hmac.compare_digest(token, state.config.peer_token):
+            return web.json_response({"error": "invalid peer token"},
+                                     status=401)
+    checkpoint = None
+    if request.can_read_body:
+        try:
+            body = await request.json()
+        except Exception:
+            return web.json_response({"error": "invalid JSON body"},
+                                     status=400)
+        if not isinstance(body, dict):
+            return web.json_response({"error": "body must be a JSON "
+                                               "object"}, status=400)
+        checkpoint = body.get("checkpoint")
+        if checkpoint is not None and not isinstance(checkpoint, str):
+            return web.json_response({"error": "checkpoint must be a "
+                                               "string"}, status=400)
+    name = request.match_info["model"]
+    sm = state.manager.loaded_snapshot().get(name)
+    if sm is None:
+        return web.json_response({"error": f"model {name!r} is not "
+                                           "loaded"}, status=404)
+    swap_fn = getattr(sm, "swap", None)
+    if swap_fn is None:
+        return web.json_response({"error": f"model {name!r} is not "
+                                           "fleet-served"}, status=409)
+    loop = asyncio.get_running_loop()
+    # the swap boots replicas and drains the old generation — off the loop
+    result = await loop.run_in_executor(None, swap_fn, checkpoint)
+    return web.json_response({"model": name, **result},
+                             status=200 if result.get("ok") else 409)
+
+
 async def system(request: web.Request) -> web.Response:
     """GET /system (parity: SystemInformations, routes/localai.go:64 —
     CPU/GPU info becomes the JAX device inventory)."""
@@ -398,6 +443,7 @@ def routes() -> list[web.RouteDef]:
         web.get("/v1/usage", usage),
         web.get("/v1/slo", slo_report),
         web.get("/v1/fleet", fleet_status),
+        web.post("/v1/fleet/{model}/swap", fleet_swap),
         web.post("/federated/register", fleet_register),
         web.get("/system", system),
         web.post("/v1/tokenize", tokenize),

@@ -127,6 +127,33 @@ def build_parser() -> argparse.ArgumentParser:
                           "replica streams and control RPCs (default "
                           "120; 0 disables; size above worst-case "
                           "queue wait + TTFT)")
+    # elastic capacity (localai_tpu.fleet.autoscale)
+    run.add_argument("--autoscale", action="store_true",
+                     default=_env_bool("autoscale"),
+                     help="telemetry-driven fleet autoscaling: scale "
+                          "decode replicas between --autoscale-min/max "
+                          "off queue depth, SLO burn, and KV pressure; "
+                          "drain-based scale-in loses zero requests")
+    run.add_argument("--autoscale-min", type=int, default=None,
+                     help="decode replica floor the autoscaler holds "
+                          "(default 1)")
+    run.add_argument("--autoscale-max", type=int, default=None,
+                     help="decode replica ceiling for scale-out "
+                          "(default 4)")
+    run.add_argument("--autoscale-interval-s", type=float, default=None,
+                     help="seconds between autoscale control-loop ticks "
+                          "(default 5)")
+    run.add_argument("--autoscale-in-idle-s", type=float, default=None,
+                     help="a replica idle this long (fleet above the "
+                          "floor) is drained and retired (default 120)")
+    run.add_argument("--autoscale-zero-idle-s", type=float, default=None,
+                     help="ALL replicas idle this long → scale the model "
+                          "to zero; the next request cold-respawns one "
+                          "and waits for it (0 = off, the default)")
+    run.add_argument("--autoscale-standby-hosts", default=None,
+                     help="comma-separated host:port standby workers "
+                          "adopted (instant capacity) before spawning "
+                          "when scaling out")
 
     models = sub.add_parser("models", help="model management")
     models_sub = models.add_subparsers(dest="models_command")
@@ -406,6 +433,15 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             fleet_hosts=([h for h in args.fleet_hosts.split(",") if h]
                          if args.fleet_hosts is not None else None),
             fleet_rpc_timeout_s=args.fleet_rpc_timeout_s,
+            autoscale=args.autoscale or None,
+            autoscale_min=args.autoscale_min,
+            autoscale_max=args.autoscale_max,
+            autoscale_interval_s=args.autoscale_interval_s,
+            autoscale_in_idle_s=args.autoscale_in_idle_s,
+            autoscale_zero_idle_s=args.autoscale_zero_idle_s,
+            autoscale_standby_hosts=(
+                [h for h in args.autoscale_standby_hosts.split(",") if h]
+                if args.autoscale_standby_hosts is not None else None),
         )
         serve(cfg)
         return 0

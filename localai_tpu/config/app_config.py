@@ -132,6 +132,23 @@ class AppConfig:
     # knobs LOCALAI_FLEET_REDIAL_{BASE,CAP}_S.
     fleet_rpc_timeout_s: float = 120.0
 
+    # elastic capacity (fleet.autoscale): the closed-loop controller that
+    # scales each fleet between autoscale_min and autoscale_max decode
+    # replicas off queue depth / SLO burn / KV pressure, retires a replica
+    # idle past autoscale_in_idle_s, and — when autoscale_zero_idle_s > 0
+    # — scales a wholly idle model to ZERO replicas, cold-respawning on
+    # the next request (the held request waits, never errors). Overload
+    # thresholds and cooldowns are env-only (LOCALAI_AUTOSCALE_OUT_*,
+    # LOCALAI_AUTOSCALE_{IN,OUT}_COOLDOWN_S, ...); standby hosts are
+    # adopted before spawning when scaling out.
+    autoscale: bool = False
+    autoscale_min: int = 1
+    autoscale_max: int = 4
+    autoscale_interval_s: float = 5.0
+    autoscale_in_idle_s: float = 120.0
+    autoscale_zero_idle_s: float = 0.0
+    autoscale_standby_hosts: list[str] = field(default_factory=list)
+
     # TPU-specific
     mesh_shape: Optional[dict[str, int]] = None   # None = auto from devices
                                                   # (LOCALAI_MESH / --mesh

@@ -1,0 +1,23 @@
+"""Elastic capacity (ROADMAP item 4): a closed-loop capacity controller
+over the existing ReplicaPool/FleetScheduler — telemetry-driven scale
+out/in, scale-to-zero with cold re-onboard, hot weight swap, and
+multi-model density under HBM pressure."""
+
+from localai_tpu.fleet.autoscale.controller import AutoscaleController
+from localai_tpu.fleet.autoscale.density import (evict_lru_model,
+                                                 hbm_fraction, hot_swap)
+from localai_tpu.fleet.autoscale.policy import (ACTIONS, AutoscaleConfig,
+                                                AutoscalePolicy, Decision,
+                                                ReplicaSignals)
+
+__all__ = [
+    "ACTIONS",
+    "AutoscaleConfig",
+    "AutoscaleController",
+    "AutoscalePolicy",
+    "Decision",
+    "ReplicaSignals",
+    "evict_lru_model",
+    "hbm_fraction",
+    "hot_swap",
+]

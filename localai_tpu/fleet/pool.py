@@ -60,6 +60,11 @@ class ReplicaPool:
             self.replicas.append(factory(f"{model}/r{i}", "decode"))
         for i in range(prefill_replicas):
             self.replicas.append(factory(f"{model}/p{i}", "prefill"))
+        # runtime-spawn id minting (autoscale scale-out, hot swap): ids
+        # only ever advance — a retired r0's name is never reused, so
+        # directory entries, SLO windows, and backoff books keyed on the
+        # old id can never be mistaken for the newcomer's
+        self._next_index = {"decode": replicas, "prefill": prefill_replicas}
         self._lock = threading.Lock()
         self._respawning: set[str] = set()
         # death listeners: called with the replica id once per
@@ -205,6 +210,49 @@ class ReplicaPool:
                              name=f"fleet-adopt-{replica.id}").start()
         return True
 
+    def spawn(self, role: str = "decode", *,
+              wait: bool = True) -> Optional[str]:
+        """Mint a brand-new locally owned replica through the pool's
+        factory and adopt it (autoscale scale-out / hot swap / cold
+        re-onboard). Returns the new replica id, or None when the boot
+        failed — the failed newcomer stays in the pool's respawn loop,
+        so capacity still arrives once whatever blocked the spawn clears."""
+        with self._lock:
+            prefix = "r" if role == "decode" else "p"
+            idx = self._next_index.get(role, 0)
+            self._next_index[role] = idx + 1
+        rid = f"{self.model}/{prefix}{idx}"
+        replica = self.factory(rid, role)
+        self.adopt(replica, wait=wait)
+        if wait and replica.state != HEALTHY:
+            return None
+        return rid
+
+    def remove(self, rid: str, *, stop: bool = True) -> bool:
+        """Retire ``rid`` out of the pool (autoscale scale-in, hot swap).
+        The replica leaves the member list (routing loses it on the next
+        ring rebuild), its respawn/backoff books are cleared, and its
+        ``retired`` flag parks any in-flight respawn thread. The caller
+        owns the drain — this only removes and stops."""
+        with self._lock:
+            replica = next((r for r in self.replicas if r.id == rid), None)
+            if replica is None:
+                return False
+            replica.retired = True
+            self.replicas = [r for r in self.replicas if r.id != rid]
+            self._respawn_failures.pop(rid, None)
+            self._respawn_after.pop(rid, None)
+            self.respawn_backoff_s.pop(rid, None)
+            self.redial_backoff_s.pop(rid, None)
+        if stop:
+            try:
+                replica.stop()
+            except Exception:  # noqa: BLE001 — removal must finish
+                log.exception("stopping retired replica %s failed", rid)
+        log.info("fleet %s: replica %s retired from the pool",
+                 self.model, rid)
+        return True
+
     def shutdown(self) -> None:
         self._stop.set()
         if self._monitor is not None:
@@ -331,7 +379,7 @@ class ReplicaPool:
 
         def respawn() -> None:
             try:
-                if self._stop.is_set():
+                if self._stop.is_set() or r.retired:
                     r.state = down_state
                     return
                 try:
@@ -343,9 +391,10 @@ class ReplicaPool:
                     # exercise fleet.dial on the post-start dial instead)
                     _faults.apply("fleet.respawn", key=r.id)
                 r.start()
-                if self._stop.is_set():
-                    # shutdown raced the spawn: its stop() sweep already
-                    # ran, so reap the worker we just brought up
+                if self._stop.is_set() or r.retired:
+                    # shutdown (or a scale-in removal) raced the spawn:
+                    # its stop() sweep already ran, so reap the worker we
+                    # just brought up
                     try:
                         r.stop()
                     except Exception:  # noqa: BLE001

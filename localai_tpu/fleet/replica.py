@@ -77,6 +77,17 @@ class BaseReplica:
         self.dial_seconds: Optional[float] = None
         self.checked_mono: Optional[float] = None
         self.started_at = time.monotonic()
+        # deliberately removed from the pool (autoscale scale-in, hot
+        # swap): an in-flight respawn thread must park the corpse instead
+        # of resurrecting a replica the operator just drained away
+        self.retired = False
+        # last-dispatch clock pair: monotonic drives the idle_s policy
+        # signal (never jumps), wall time is the human-readable export in
+        # GET /v1/fleet. A replica that never served reads idle since
+        # boot — an unused fleet is exactly as scale-in-eligible as a
+        # quiesced one.
+        self.last_dispatch_mono = self.started_at
+        self.last_dispatch_wall: Optional[float] = None
         # last reported decode queue depth (monitor-refreshed when the
         # pool tracks it — router.py's queue-override admission hint
         # reads this as a plain field, never an RPC)
@@ -88,6 +99,8 @@ class BaseReplica:
         with self._lock:
             self.inflight += 1
             self.dispatched += 1
+            self.last_dispatch_mono = time.monotonic()
+            self.last_dispatch_wall = time.time()
 
     def done(self, *, error: bool = False) -> None:
         with self._lock:
@@ -125,10 +138,23 @@ class BaseReplica:
             self.failures += 1
         return ok
 
+    def idle_s(self) -> float:
+        """Seconds since the last request was dispatched here (or since
+        boot, for a replica that never served) — the autoscale policy's
+        scale-in/scale-to-zero signal. 0 while anything is in flight: a
+        slow generation is work, not idleness."""
+        with self._lock:
+            if self.inflight > 0:
+                return 0.0
+            return max(0.0, time.monotonic() - self.last_dispatch_mono)
+
     def snapshot(self) -> dict:
         with self._lock:
             inflight, dispatched = self.inflight, self.dispatched
             errors = self.errors
+            last_wall = self.last_dispatch_wall
+            idle = (0.0 if inflight > 0
+                    else max(0.0, time.monotonic() - self.last_dispatch_mono))
         return {
             "id": self.id,
             "role": self.role,
@@ -136,6 +162,8 @@ class BaseReplica:
             "inflight": inflight,
             "dispatched": dispatched,
             "errors": errors,
+            "idle_s": round(idle, 1),
+            "last_dispatch": last_wall,
             "dial_failures": self.failures,
             "dial_seconds": self.dial_seconds,
             "checked_age_s": (

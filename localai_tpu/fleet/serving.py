@@ -30,7 +30,7 @@ import logging
 import os
 import threading
 import time
-from typing import Optional
+from typing import Callable, Optional
 
 from localai_tpu.config.app_config import AppConfig
 from localai_tpu.config.model_config import ModelConfig
@@ -76,6 +76,11 @@ class FleetScheduler:
         self._ids = itertools.count()
         self._inflight = 0
         self._lock = threading.Lock()
+        # autoscale cold-start hook (AutoscaleController.request_capacity):
+        # when routing finds no healthy replica, the dispatch thread calls
+        # this and, on True, re-routes — a scaled-to-zero model serves the
+        # held request off its cold re-onboard instead of erroring
+        self.on_cold: Optional[Callable[[], bool]] = None
         # fleet prefix directory (shared with the router, which probes it
         # for placement; the scheduler writes it and fetches against it)
         self.directory: Optional[PrefixDirectory] = router.directory
@@ -142,6 +147,7 @@ class FleetScheduler:
                 tr.end("queued")
             exclude: set = set()
             attempt = 0
+            cold_started = False
             while True:
                 try:
                     if tr is not None:
@@ -157,7 +163,16 @@ class FleetScheduler:
                         # holds the other half of the waterfall
                         tr.annotate(replica=replica.id)
                 except FleetUnavailable as e:
-                    if tr is not None:
+                    # scale-to-zero wakeup: the autoscaler parks a hook
+                    # here; the held request waits out the cold boot (one
+                    # attempt) and re-routes instead of erroring
+                    if not cold_started and self.on_cold is not None:
+                        cold_started = True
+                        if tr is not None:
+                            tr.end("route", error=str(e), cold_start=True)
+                        if self.on_cold():
+                            continue
+                    elif tr is not None:
                         tr.end("route", error=str(e))
                     log.error("fleet %s: %s", self._owner.name, e)
                     self.telemetry.finished(tr, handle, "error")
@@ -729,6 +744,10 @@ class FleetScheduler:
                       "evicted"):
             REGISTRY.fleet_replicas.set(
                 states.get(state, 0), model=self._owner.name, state=state)
+        auto = getattr(self._owner, "autoscaler", None)
+        if auto is not None:
+            REGISTRY.fleet_target_replicas.set(
+                auto.target, model=self._owner.name)
         if self.directory is not None:
             st = self.directory.stats()
             REGISTRY.fleet_directory_entries.set(
@@ -823,6 +842,12 @@ class FleetServingModel:
             rpc_timeout_s=(rpc_timeout_s if rpc_timeout_s is not None
                            else getattr(app, "fleet_rpc_timeout_s", None)),
         )
+        # hot-swap surface: the pool factory reads its model config
+        # through this mutable holder (manager rebinds it here), so a
+        # runtime spawn after a checkpoint swap boots the NEW weights;
+        # the autoscaler is attached by the manager when enabled
+        self.cfg_ref = {"mcfg": mcfg}
+        self.autoscaler = None
         self.loaded_at = time.monotonic()
         self.last_used = time.monotonic()
 
@@ -881,7 +906,21 @@ class FleetServingModel:
             "shedding": {
                 r.id: self.slo.shedding(r.id) for r in self.pool.members()
             },
+            "autoscale": (self.autoscaler.snapshot()
+                          if self.autoscaler is not None
+                          else {"enabled": False}),
         }
 
+    def swap(self, checkpoint: Optional[str] = None,
+             *, timeout: float = 30.0) -> dict:
+        """Hot weight swap (POST /v1/fleet/{model}/swap): boot fresh
+        replicas — on ``checkpoint`` when given — shift traffic, drain
+        and retire the old generation."""
+        from localai_tpu.fleet.autoscale import hot_swap
+
+        return hot_swap(self, checkpoint, timeout=timeout)
+
     def close(self) -> None:
+        if self.autoscaler is not None:
+            self.autoscaler.stop()
         self.pool.shutdown()

@@ -880,6 +880,11 @@ class ModelManager:
         from localai_tpu.fleet.replica import InProcessReplica, WorkerReplica
 
         app = self.app
+        # hot-swap indirection: the factory reads its model config from
+        # this holder at SPAWN time, so rebinding it (fleet.autoscale
+        # density.hot_swap) makes every later runtime spawn boot the new
+        # checkpoint while the running generation keeps its own
+        cfg_ref = {"mcfg": mcfg}
         if app.fleet_backend == "inprocess":
             def factory(rid: str, role: str):
                 # each replica engine gets its own identity: under the
@@ -887,8 +892,9 @@ class ModelManager:
                 # every request the fleet tier already records (worker
                 # replicas are naturally separate — their own process,
                 # their own registry)
-                rcfg = mcfg.model_copy(update={
-                    "name": rid, "model": mcfg.model or mcfg.name})
+                live = cfg_ref["mcfg"]
+                rcfg = live.model_copy(update={
+                    "name": rid, "model": live.model or live.name})
                 return InProcessReplica(
                     rid, role, lambda: build_serving_model(rcfg, app))
         else:
@@ -906,13 +912,25 @@ class ModelManager:
                     kind, num = rid.rsplit("/", 1)[-1][0], rid.rsplit("/", 1)[-1][1:]
                     idx = int(num) + (app.fleet_replicas
                                       if kind == "p" else 0)
-                    env = pinned_worker_env(app.worker_env, idx, total)
-                return WorkerReplica(rid, role, mcfg, app, env=env or None)
-        return FleetServingModel(
+                    # runtime spawns (autoscale/hot swap) mint ever-higher
+                    # indexes; fold them back into the boot partition —
+                    # the replica they replace has retired its slice
+                    env = pinned_worker_env(app.worker_env, idx % total,
+                                            total)
+                return WorkerReplica(rid, role, cfg_ref["mcfg"], app,
+                                     env=env or None)
+        fm = FleetServingModel(
             mcfg, app, factory,
             replicas=app.fleet_replicas,
             prefill_replicas=app.fleet_prefill_replicas,
         )
+        fm.cfg_ref = cfg_ref
+        if app.autoscale:
+            from localai_tpu.fleet.autoscale import AutoscaleController
+
+            fm.autoscaler = AutoscaleController(fm, manager=self)
+            fm.autoscaler.start()
+        return fm
 
     def _load_image(self, mcfg: ModelConfig) -> ImageServingModel:
         from localai_tpu.image import resolve_image_model

@@ -450,6 +450,22 @@ class Registry:
             "or control-plane calls) that blew "
             "LOCALAI_FLEET_RPC_TIMEOUT_S",
         )
+        # -- elastic capacity (fleet.autoscale) ----------------------------
+        self.autoscale_decisions = Counter(
+            "localai_autoscale_decisions_total",
+            "Autoscale policy decisions applied per model by action "
+            "(scale_out/scale_in/scale_to_zero/cold_start/swap/none)",
+        )
+        self.fleet_target_replicas = Gauge(
+            "localai_fleet_target_replicas",
+            "Decode replica count the autoscale controller is steering "
+            "the fleet toward (0 while scaled to zero)",
+        )
+        self.model_swaps = Counter(
+            "localai_model_swaps_total",
+            "Hot weight swaps completed (fresh replicas booted on the "
+            "new checkpoint, traffic shifted, old replicas drained)",
+        )
         # -- fault injection + self-healing (localai_tpu.faults) -----------
         self.faults_injected = Counter(
             "localai_faults_injected_total",

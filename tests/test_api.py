@@ -1074,6 +1074,39 @@ def test_fleet_register_endpoint_guards(server, client):
         server.state.config.peer_token = ""
 
 
+def test_fleet_swap_endpoint_guards(server, client):
+    """POST /v1/fleet/{model}/swap guard matrix: peer_token answers 401,
+    malformed bodies are 400, an unknown model is 404, and a loaded but
+    single-engine (non-fleet) model is a clean 409 — the deploy
+    primitive never silently no-ops."""
+    # malformed bodies are rejected before any model is consulted
+    r = client.post("/v1/fleet/tiny/swap", content=b"{not json",
+                    headers={"Content-Type": "application/json"})
+    assert r.status_code == 400
+    assert client.post("/v1/fleet/tiny/swap",
+                       json=["checkpoint"]).status_code == 400
+    assert client.post("/v1/fleet/tiny/swap",
+                       json={"checkpoint": 7}).status_code == 400
+    # unknown model
+    assert client.post("/v1/fleet/nope/swap",
+                       json={}).status_code == 404
+    # loaded single-engine model has no fleet to swap
+    server.state.manager.get("tiny")
+    r = client.post("/v1/fleet/tiny/swap", json={})
+    assert r.status_code == 409
+    assert "not fleet-served" in r.json()["error"]
+    # the shared peer_token guards the swap like every capacity mutation
+    server.state.config.peer_token = "sekrit"
+    try:
+        assert client.post("/v1/fleet/tiny/swap",
+                           json={}).status_code == 401
+        r = client.post("/v1/fleet/tiny/swap", json={},
+                        headers={"Authorization": "Bearer sekrit"})
+        assert r.status_code == 409  # authorized, still not fleet-served
+    finally:
+        server.state.config.peer_token = ""
+
+
 def test_embeddings_and_rerank_shed_under_overload(client):
     """Satellite: the SLO admission hook covers embeddings and rerank too,
     with the same preserved Retry-After header."""

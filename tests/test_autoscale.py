@@ -1,0 +1,370 @@
+"""Elastic capacity: the autoscale policy decision table (pure, no
+fleet) and the controller's full lifecycle against a real in-process
+fleet — spike scale-out, idle scale-in with zero lost requests,
+scale-to-zero, and the cold re-onboard that serves the held request.
+
+The policy tests pin the hysteresis contract: scale-out and scale-in
+read different thresholds with separate cooldowns, overload always
+overrides idleness, and the last replica only ever leaves through
+scale_to_zero."""
+
+import threading
+import time
+
+import pytest
+
+from localai_tpu.config.app_config import AppConfig
+from localai_tpu.config.model_config import ModelConfig
+from localai_tpu.engine.scheduler import GenRequest
+from localai_tpu.fleet.autoscale import (ACTIONS, AutoscaleConfig,
+                                         AutoscaleController,
+                                         AutoscalePolicy, ReplicaSignals,
+                                         evict_lru_model, hbm_fraction)
+
+# ---------------------------------------------------------------------------
+# policy decision table (no fleet, no clock, no threads)
+
+
+def _cfg(**kw):
+    base = dict(min_replicas=1, max_replicas=4, in_idle_s=60.0,
+                zero_idle_s=0.0, out_queue_depth=4.0, out_kv_util=0.85,
+                out_step_p99_ms=0.0, out_burn=2.0, out_cooldown_s=30.0,
+                in_cooldown_s=60.0)
+    base.update(kw)
+    return AutoscaleConfig(**base)
+
+
+def _sig(rid="r0", **kw):
+    return ReplicaSignals(rid=rid, **kw)
+
+
+def test_below_min_self_heals_regardless_of_cooldown():
+    pol = AutoscalePolicy(_cfg(min_replicas=2))
+    pol.last_out_at = 100.0  # cooldown would normally suppress
+    d = pol.decide([_sig()], now=101.0)
+    assert (d.action, d.reason, d.target) == ("scale_out", "below_min", 2)
+    # a booting replica counts toward the floor — no double-spawn
+    d = pol.decide([_sig(), _sig("r1", state="starting")], now=101.0)
+    assert d.action == "none"
+
+
+def test_each_overload_signal_scales_out_with_its_reason():
+    cases = [
+        (dict(queue_depth=5.0), "queue_depth"),
+        (dict(burn_1m=3.0), "slo_burn"),
+        (dict(kv_util=0.9), "kv_pressure"),
+    ]
+    for kw, why in cases:
+        d = AutoscalePolicy(_cfg()).decide([_sig(**kw)], now=0.0)
+        assert (d.action, d.reason) == ("scale_out", why), kw
+    # step p99 is opt-in: disabled (0) never fires, enabled does
+    slow = [_sig(step_p99_ms=900.0)]
+    assert AutoscalePolicy(_cfg()).decide(slow, now=0.0).action == "none"
+    d = AutoscalePolicy(_cfg(out_step_p99_ms=500.0)).decide(slow, now=0.0)
+    assert (d.action, d.reason) == ("scale_out", "step_p99")
+
+
+def test_overload_holds_at_max_cooldown_and_boot_pending():
+    hot = _sig(queue_depth=9.0)
+    pol = AutoscalePolicy(_cfg(max_replicas=1))
+    assert pol.decide([hot], now=0.0).reason == "at_max:queue_depth"
+
+    pol = AutoscalePolicy(_cfg())
+    pol.note("scale_out", 100.0)
+    d = pol.decide([hot], now=110.0)  # inside the 30 s out-cooldown
+    assert (d.action, d.reason) == ("none", "out_cooldown:queue_depth")
+    d = pol.decide([hot], now=200.0)  # cooldown expired
+    assert d.action == "scale_out"
+
+    # a replica already booting absorbs the overload — don't stack spawns
+    d = AutoscalePolicy(_cfg()).decide(
+        [hot, _sig("r1", state="respawning")], now=0.0)
+    assert d.reason == "boot_pending:queue_depth"
+
+
+def test_scale_in_picks_idlest_and_never_takes_the_last_replica():
+    fleet = [_sig("r0", idle_s=200.0), _sig("r1", idle_s=50.0),
+             _sig("r2", idle_s=400.0)]
+    d = AutoscalePolicy(_cfg()).decide(fleet, now=0.0)
+    assert (d.action, d.rid, d.target) == ("scale_in", "r2", 2)
+
+    # the floor is max(min_replicas, 1): even with min_replicas=0 the
+    # last replica only leaves through scale_to_zero
+    d = AutoscalePolicy(_cfg(min_replicas=0)).decide(
+        [_sig(idle_s=9999.0)], now=0.0)
+    assert (d.action, d.reason) == ("none", "steady")
+
+    # in-cooldown suppresses; note() only arms it for the in-direction
+    pol = AutoscalePolicy(_cfg())
+    pol.note("scale_in", 100.0)
+    assert pol.decide(fleet, now=110.0).reason == "in_cooldown"
+    assert pol.decide(fleet, now=300.0).action == "scale_in"
+    assert pol.last_out_at == float("-inf")  # untouched by scale_in
+
+
+def test_overload_overrides_idleness():
+    # long-idle replica but the other one is burning SLO budget: the
+    # fleet adds capacity, it does not shed it
+    fleet = [_sig("r0", idle_s=500.0), _sig("r1", burn_1m=5.0)]
+    d = AutoscalePolicy(_cfg()).decide(fleet, now=0.0)
+    assert (d.action, d.reason) == ("scale_out", "slo_burn")
+
+
+def test_scale_to_zero_requires_every_replica_quiet_and_idle():
+    cfg = _cfg(min_replicas=0, zero_idle_s=10.0, in_cooldown_s=5.0)
+    idle = [_sig("r0", idle_s=20.0), _sig("r1", idle_s=15.0)]
+    d = AutoscalePolicy(cfg).decide(idle, now=100.0)
+    assert (d.action, d.target) == ("scale_to_zero", 0)
+
+    # one replica with anything in flight (or queued) vetoes it
+    busy = [_sig("r0", idle_s=20.0), _sig("r1", inflight=1)]
+    assert AutoscalePolicy(cfg).decide(busy, now=100.0).action != \
+        "scale_to_zero"
+    queued = [_sig("r0", idle_s=20.0),
+              _sig("r1", idle_s=20.0, queue_depth=1.0)]
+    assert AutoscalePolicy(cfg).decide(queued, now=100.0).action != \
+        "scale_to_zero"
+
+    pol = AutoscalePolicy(cfg)
+    pol.note("scale_to_zero", 99.0)
+    assert pol.decide(idle, now=100.0).reason == "in_cooldown"
+
+    # zero_idle_s=0 disables the path entirely
+    d = AutoscalePolicy(_cfg(min_replicas=0)).decide(idle, now=100.0)
+    assert d.action != "scale_to_zero"
+
+
+def test_from_app_and_env_knobs(monkeypatch):
+    app = AppConfig(autoscale_min=2, autoscale_max=6,
+                    autoscale_interval_s=1.5, autoscale_in_idle_s=30.0,
+                    autoscale_zero_idle_s=300.0,
+                    autoscale_standby_hosts=["h1:50051"])
+    monkeypatch.setenv("LOCALAI_AUTOSCALE_OUT_QUEUE", "2.5")
+    monkeypatch.setenv("LOCALAI_AUTOSCALE_OUT_BURN", "nonsense")
+    cfg = AutoscaleConfig.from_app(app)
+    assert (cfg.min_replicas, cfg.max_replicas) == (2, 6)
+    assert cfg.standby_hosts == ["h1:50051"]
+    assert cfg.out_queue_depth == 2.5
+    assert cfg.out_burn == 2.0  # unparseable env falls back to default
+    assert set(ACTIONS) >= {"scale_out", "scale_in", "scale_to_zero",
+                            "cold_start", "swap", "none"}
+
+
+# ---------------------------------------------------------------------------
+# density reaper (stub manager — no engines)
+
+
+class _StubModel:
+    def __init__(self, last_used, busy=False):
+        self.last_used = last_used
+        self._busy = busy
+
+    @property
+    def busy(self):
+        return self._busy
+
+
+class _StubManager:
+    def __init__(self, models):
+        self._models = dict(models)
+        self._lock = threading.RLock()
+        self.shut = []
+
+    def shutdown_model(self, name, *, force=False, wait=5.0):
+        self.shut.append(name)
+        self._models.pop(name, None)
+        return True
+
+
+def test_evict_lru_model_spares_keep_and_busy():
+    mgr = _StubManager({"old": _StubModel(10.0), "mid": _StubModel(20.0),
+                        "hot": _StubModel(30.0)})
+    # below threshold: no eviction
+    assert evict_lru_model(mgr, threshold=0.9, fraction=0.5) is None
+    # LRU goes first; the keep-set and busy models are untouchable
+    assert evict_lru_model(mgr, keep=("old",), threshold=0.9,
+                           fraction=0.95) == "mid"
+    mgr._models["busy"] = _StubModel(1.0, busy=True)
+    assert evict_lru_model(mgr, keep=("old",), threshold=0.9,
+                           fraction=0.95) == "hot"
+    assert evict_lru_model(mgr, keep=("old",), threshold=0.9,
+                           fraction=0.95) is None  # only keep/busy left
+    assert mgr.shut == ["mid", "hot"]
+
+
+def test_hbm_fraction_env_override(monkeypatch):
+    monkeypatch.setenv("LOCALAI_AUTOSCALE_HBM_FRACTION", "0.77")
+    assert hbm_fraction() == pytest.approx(0.77)
+
+
+def test_usage_report_ingests_autoscale_artifact(tmp_path):
+    """tools/usage_report --ingest-autoscale replays the CI artifact's
+    capacity trajectory at its recorded timestamps and folds decision
+    counts into autoscale.* series; bad files are skipped, not fatal."""
+    import json
+
+    from localai_tpu.obs.history import History
+    from tools.usage_report import build_report, ingest_autoscale
+
+    doc = {
+        "decisions": {"scale_out": 2, "none": 50},
+        "peak_healthy": 3, "cold_start_ms": 2895.1,
+        "target_series": {
+            "series": "fleet_target_replicas.fleet-auto",
+            "points": [{"ts": 100.0, "value": 1.0},
+                       {"ts": 103.0, "value": 3.0}],
+        },
+    }
+    (tmp_path / "autoscale_report.json").write_text(json.dumps(doc))
+    (tmp_path / "autoscale_report_bad.json").write_text("{nope")
+
+    h = History()
+    n = ingest_autoscale(h, [str(tmp_path)])
+    assert n == 6  # 2 trajectory points + 2 decisions + peak + cold
+    rep = build_report(h, res=1)
+    assert rep["fleet_target_replicas"]["fleet-auto"]["latest"] == 3.0
+    assert rep["autoscale"]["decisions_scale_out"]["latest"] == 2.0
+    assert rep["autoscale"]["peak_healthy"]["latest"] == 3.0
+
+
+# ---------------------------------------------------------------------------
+# controller lifecycle against a real in-process fleet
+
+TINY = {
+    "name": "astiny", "model": "debug:tiny", "context_size": 256,
+    "parameters": {"temperature": 0.0, "max_tokens": 8},
+    "engine": {"max_slots": 2, "prefill_buckets": [16, 32, 64, 128],
+               "dtype": "float32", "kv_dtype": "float32",
+               "kv_block_tokens": 16},
+}
+
+
+def _build_fleet(replicas=1):
+    from localai_tpu.fleet import FleetServingModel
+    from localai_tpu.fleet.replica import InProcessReplica
+    from localai_tpu.models.manager import build_serving_model
+
+    app = AppConfig()
+    mcfg = ModelConfig.model_validate(TINY)
+
+    def factory(rid, role):
+        return InProcessReplica(
+            rid, role, lambda: build_serving_model(mcfg, app))
+
+    return FleetServingModel(mcfg, app, factory, replicas=replicas)
+
+
+def _submit(fm, text, max_new=8):
+    return fm.scheduler.submit(GenRequest(
+        prompt=fm.tokenizer.encode(text), max_new_tokens=max_new,
+        temperature=0.0))
+
+
+def _tick_until(auto, pred, timeout=30.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        auto.tick()
+        if pred():
+            return True
+        time.sleep(0.05)
+    return False
+
+
+def test_controller_scales_out_under_burst_then_back_in():
+    """Manual-tick e2e (no daemon thread — the test owns the clockwork):
+    a queue burst scales a 1-replica fleet out, every request completes,
+    and the idle fleet scales back in to exactly one replica — never
+    zero, because scale-to-zero is disabled here and single scale-in
+    refuses to take the last replica."""
+    fm = _build_fleet(replicas=1)
+    auto = AutoscaleController(fm, config=AutoscaleConfig(
+        min_replicas=0, max_replicas=3, interval_s=0.1,
+        in_idle_s=0.4, zero_idle_s=0.0, out_queue_depth=0.5,
+        out_cooldown_s=0.2, in_cooldown_s=0.2))
+    fm.autoscaler = auto
+    pool = fm.pool
+    try:
+        # -- burst: queue depth over threshold forces a scale-out
+        handles = [_submit(fm, f"elastic burst prompt {i}")
+                   for i in range(8)]
+        grew = _tick_until(
+            auto, lambda: len(pool.healthy("decode")) >= 2)
+        assert grew, "spike never scaled out"
+        assert auto.decisions["scale_out"] >= 1
+        for h in handles:
+            h.result(timeout=120)
+            assert h.finish_reason in ("stop", "length")
+
+        # -- quiesce: surplus capacity drains away, every request above
+        # already accounted for (nothing lost), and the shrink floors at 1
+        shrank = _tick_until(
+            auto, lambda: len(pool.healthy("decode")) == 1, timeout=60.0)
+        assert shrank, "idle fleet never scaled in"
+        assert auto.decisions["scale_in"] >= 1
+        for _ in range(10):  # well past in_idle_s + in_cooldown_s
+            auto.tick()
+            time.sleep(0.1)
+        assert len(pool.healthy("decode")) == 1
+        assert auto.decisions["scale_to_zero"] == 0
+
+        snap = auto.snapshot()
+        assert snap["enabled"] and snap["max"] == 3
+        assert snap["decisions"]["scale_out"] >= 1
+    finally:
+        auto.stop()
+        fm.close()
+
+
+def test_controller_scale_to_zero_then_cold_start_serves():
+    """An all-idle fleet (scale-in disabled, zero enabled) collapses to
+    zero replicas via scale_to_zero only, and the next request triggers
+    the scheduler's on_cold hook: it waits for the cold re-onboard and
+    completes — the caller never sees an error."""
+    fm = _build_fleet(replicas=1)
+    auto = AutoscaleController(fm, config=AutoscaleConfig(
+        min_replicas=0, max_replicas=3, interval_s=0.1,
+        in_idle_s=0.0, zero_idle_s=0.5, out_queue_depth=50.0,
+        in_cooldown_s=0.2, cold_timeout_s=120.0))
+    fm.autoscaler = auto
+    pool = fm.pool
+    try:
+        h = _submit(fm, "one request so idle_s measures from real work")
+        h.result(timeout=120)
+        assert h.finish_reason in ("stop", "length")
+
+        zeroed = _tick_until(
+            auto, lambda: not pool.healthy("decode"), timeout=60.0)
+        assert zeroed, "idle fleet never reached zero"
+        assert auto.decisions["scale_to_zero"] >= 1
+        assert auto.decisions["scale_in"] == 0  # only path to zero
+        assert auto.target == 0
+
+        h = _submit(fm, "the request that wakes the fleet back up")
+        h.result(timeout=120)
+        assert h.finish_reason in ("stop", "length")
+        assert auto.decisions["cold_start"] >= 1
+        assert len(pool.healthy("decode")) == 1 and auto.target >= 1
+    finally:
+        auto.stop()
+        fm.close()
+
+
+def test_hot_swap_replaces_generation_and_keeps_capacity():
+    """fm.swap() (the POST /v1/fleet/{model}/swap backend) boots a new
+    replica generation, drains the old one, and leaves capacity and
+    serving intact — the deploy primitive in miniature."""
+    fm = _build_fleet(replicas=2)
+    try:
+        for h in [_submit(fm, f"warm the pool {i}") for i in range(2)]:
+            h.result(timeout=120)
+        old = {r.id for r in fm.pool.healthy("decode")}
+        res = fm.swap(timeout=30.0)
+        assert res["ok"], res
+        now = {r.id for r in fm.pool.healthy("decode")}
+        assert now and not (now & old)
+        assert len(now) == len(old)
+        h = _submit(fm, "post-swap traffic still serves")
+        h.result(timeout=120)
+        assert h.finish_reason in ("stop", "length")
+    finally:
+        fm.close()

@@ -404,10 +404,24 @@ def test_anatomy_phases_attribute_injected_delays(tiny):
         return [r for r in sched.flight.snapshot()
                 if not r["compile"] and r["ts"] > base_ts]
 
+    keeper = None
     try:
         # warm-up: compile-bearing dispatches are flagged (and excluded
         # from phases()); the injected runs below measure steady state
         run_one("warm me up")
+
+        # keep a long request in flight across both injections: if the
+        # engine loop goes idle between requests it drops its last-drain
+        # anchor, and a pre-issue delay on the NEXT dispatch lands
+        # nowhere (dt falls back to issue→drain) — steady decode keeps
+        # every drain pipelined, so attribution is deterministic
+        keeper = sched.submit(GenRequest(
+            prompt=tokzr.encode("keeper"), max_new_tokens=224,
+            temperature=0.0, ignore_eos=True))
+        deadline = time.monotonic() + 30.0
+        while keeper.t_first_token is None and time.monotonic() < deadline:
+            time.sleep(0.005)
+        assert keeper.t_first_token is not None
 
         # host-side: 120 ms sleep before a decode dispatch
         base = sched.flight.snapshot()[-1]["ts"]
@@ -427,6 +441,9 @@ def test_anatomy_phases_attribute_injected_delays(tiny):
         run_one("device-side delay")
         hit = max(rows_after(base), key=lambda r: r["sync_ms"])
         assert hit["sync_ms"] >= 100.0
+        keeper.cancel()
+        keeper.result(timeout=30.0)
+        keeper = None
 
         # the tiling invariant holds ring-wide (5e-3 slack: snapshot
         # rounds each phase column to 3 decimals)
@@ -435,4 +452,6 @@ def test_anatomy_phases_attribute_injected_delays(tiny):
                      + r["sync_ms"])
             assert total <= r["dispatch_ms"] + 5e-3, r
     finally:
+        if keeper is not None:
+            keeper.cancel()
         sched.shutdown()

@@ -1066,6 +1066,161 @@ def scenario_shed_recover() -> dict:
     return {"problems": problems}
 
 
+def scenario_scale_out_under_spike() -> dict:
+    """A 1-replica autoscaled fleet rides a seeded arrival spike: the
+    controller's queue-depth signal adds capacity, every request resolves
+    cleanly, and every replica's block pool conserves after the drain."""
+    from localai_tpu.fleet.autoscale import (AutoscaleConfig,
+                                             AutoscaleController)
+    from tools.loadgen import EngineSink, LoadGen
+
+    fm = _build_fleet("chaos-spike", replicas=1)
+    auto = AutoscaleController(fm, config=AutoscaleConfig(
+        min_replicas=1, max_replicas=3, interval_s=0.1,
+        in_idle_s=0.0, zero_idle_s=0.0,   # scale-out only: no retirement
+        out_queue_depth=1.5, out_cooldown_s=0.5))
+    fm.autoscaler = auto
+    try:
+        auto.start()
+        gen = LoadGen(mix={"chat": 1.0}, rate=6.0, seed=23, max_tokens=6,
+                      profile="spike", spike_start_s=0.3, spike_len_s=3.0,
+                      spike_mult=8.0)
+        summary = gen.run(EngineSink(fm, max_tokens=6), total=24,
+                          timeout_s=300.0)
+        problems = []
+        bad = {r: n for r, n in summary["outcomes"].items()
+               if r not in ("stop", "length")}
+        if bad or summary["errors"]:
+            problems.append(f"spike traffic failed: {bad} "
+                            f"{summary['errors'][:3]}")
+        if auto.decisions["scale_out"] < 1:
+            problems.append(
+                f"no scale-out under the spike ({auto.decisions})")
+        healthy = len(fm.pool.healthy("decode"))
+        if healthy < 2:
+            problems.append(f"fleet still at {healthy} replica(s) after "
+                            f"the spike")
+        problems += _fleet_blocks_conserved(fm)
+        return {"problems": problems, "decisions": dict(auto.decisions),
+                "healthy": healthy, "outcomes": summary["outcomes"]}
+    finally:
+        auto.stop()
+        fm.close()
+
+
+def scenario_scale_in_zero_lost() -> dict:
+    """Drain-based scale-in mid-traffic: a replica is retired while it
+    serves an in-flight request — the drain live-migrates the slot to
+    the survivor, the request completes (nothing lost, nothing errored),
+    and BOTH replicas' block pools conserve."""
+    fm = _build_fleet("chaos-scalein")
+    try:
+        warm = fm.scheduler.submit(_req("scale-in warmup",
+                                        max_new_tokens=6))
+        warm.result(180)
+        problems = []
+        victim = None
+        victim_h = None
+        res = {}
+        # the drain migrates mid-GENERATION (KV exports at a dispatch
+        # boundary): wait for the first token, and retry if the racing
+        # generation finishes before the drain lands
+        for _ in range(4):
+            victim_h = fm.scheduler.submit(
+                _req("drain me to the survivor", max_new_tokens=64))
+            deadline = time.monotonic() + 60.0
+            while (victim_h.t_first_token is None
+                   and time.monotonic() < deadline):
+                time.sleep(0.005)
+            entry = fm.scheduler._active.get(victim_h.id)
+            if entry is None or victim_h.finish_reason is not None:
+                victim_h.result(180)
+                continue
+            victim = entry[1]
+            res = fm.scheduler.drain(victim.id)
+            if res.get("moved"):
+                break
+            victim_h.result(180)  # finished first — retry
+            victim = None
+        if victim is None:
+            problems.append(
+                "drain never moved a mid-generation request")
+            victim_h.result(180)
+            return {"problems": problems + _resolved([warm, victim_h])}
+        deadline = time.monotonic() + 15.0
+        while victim.inflight > 0 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        if victim.inflight > 0:
+            problems.append(f"{victim.id} still busy after drain {res}")
+        if res.get("failed"):
+            problems.append(f"drain failed to move requests: {res}")
+        # the victim must be clean BEFORE retirement (its engine closes
+        # on remove, taking its allocator with it)
+        runner = getattr(getattr(victim, "sm", None), "runner", None)
+        if runner is not None:
+            conserve_deadline = time.monotonic() + 10.0
+            vp = _blocks_conserved(runner)
+            while vp and time.monotonic() < conserve_deadline:
+                time.sleep(0.1)
+                vp = _blocks_conserved(runner)
+            problems += [f"victim {victim.id}: {p}" for p in vp]
+        if not fm.pool.remove(victim.id):
+            problems.append(f"pool.remove({victim.id}) found nothing")
+        victim_h.result(180)
+        problems += _resolved([warm, victim_h])
+        if victim_h.finish_reason not in ("stop", "length"):
+            problems.append(
+                f"drained request finished {victim_h.finish_reason!r} — "
+                f"a scale-in lost a request")
+        healthy = [r.id for r in fm.pool.healthy("decode")]
+        if victim.id in healthy or len(healthy) != 1:
+            problems.append(f"pool after scale-in: {healthy}")
+        problems += _fleet_blocks_conserved(fm)
+        return {"problems": problems, "drain": res,
+                "victim": victim.id, "survivors": healthy,
+                "migrations": fm.scheduler.migrations}
+    finally:
+        fm.close()
+
+
+def scenario_hot_swap_mid_traffic() -> dict:
+    """Hot weight swap under live load: fresh replicas boot, the router
+    shifts, the old generation drains — every in-flight request
+    completes (no errors = the HTTP tier would have sent no 5xx) and the
+    new generation conserves its blocks."""
+    fm = _build_fleet("chaos-swap")
+    try:
+        warm = fm.scheduler.submit(_req("swap warmup", max_new_tokens=6))
+        warm.result(180)
+        old_ids = {r.id for r in fm.pool.healthy("decode")}
+        handles = [fm.scheduler.submit(
+            _req(f"ride out the swap {i}", max_new_tokens=32))
+            for i in range(4)]
+        swap = fm.swap(timeout=30.0)
+        for h in handles:
+            h.result(300)
+        problems = _resolved([warm] + handles)
+        errored = [h.id for h in handles
+                   if h.finish_reason not in ("stop", "length")]
+        if errored:
+            problems.append(
+                f"requests {errored} errored across the swap")
+        if not swap.get("ok"):
+            problems.append(f"hot swap failed: {swap}")
+        healthy = {r.id for r in fm.pool.healthy("decode")}
+        if healthy & old_ids:
+            problems.append(f"old replicas {healthy & old_ids} survived "
+                            f"the swap")
+        if len(healthy) != len(old_ids):
+            problems.append(
+                f"swap changed capacity: {old_ids} → {healthy}")
+        problems += _fleet_blocks_conserved(fm)
+        return {"problems": problems, "swap": swap,
+                "old": sorted(old_ids), "new": sorted(healthy)}
+    finally:
+        fm.close()
+
+
 SCENARIOS = {
     "nan_poison": scenario_nan_poison,
     "engine_rebuild": scenario_engine_rebuild,
@@ -1082,6 +1237,9 @@ SCENARIOS = {
     "slow_link": scenario_slow_link,
     "flapping_peer": scenario_flapping_peer,
     "registry_join": scenario_registry_join,
+    "scale_out_under_spike": scenario_scale_out_under_spike,
+    "scale_in_zero_lost": scenario_scale_in_zero_lost,
+    "hot_swap_mid_traffic": scenario_hot_swap_mid_traffic,
 }
 
 

@@ -109,16 +109,25 @@ class LoadGen:
     def __init__(self, *, mix: Optional[dict[str, float]] = None,
                  tenants: Optional[list[Tenant]] = None,
                  rate: float = 8.0, seed: int = 0,
-                 max_tokens: int = 8, profile: str = "mixed"):
+                 max_tokens: int = 8, profile: str = "mixed",
+                 spike_start_s: float = 2.0, spike_len_s: float = 4.0,
+                 spike_mult: float = 8.0):
         self.mix = {k: float(v) for k, v in (mix or DEFAULT_MIX).items()
                     if float(v) > 0}
         self.tenants = list(tenants or [Tenant("default")])
         self.rate = max(0.1, rate)        # mean arrivals per second
         self.rng = random.Random(seed)
         self.max_tokens = max_tokens
-        if profile not in ("mixed", "prefix_heavy"):
+        if profile not in ("mixed", "prefix_heavy", "spike"):
             raise ValueError(f"unknown load profile {profile!r}")
         self.profile = profile
+        # spike profile: Poisson baseline at ``rate``, multiplied by
+        # ``spike_mult`` inside the [start, start+len) wall-clock window —
+        # the deterministic burst the autoscale smoke/chaos scenarios
+        # drive scale-out with (seeded, so CI sees the same arrivals)
+        self.spike_start_s = max(0.0, spike_start_s)
+        self.spike_len_s = max(0.0, spike_len_s)
+        self.spike_mult = max(1.0, spike_mult)
 
     def _prompt(self, tenant: Tenant, i: int) -> str:
         if self.profile == "prefix_heavy":
@@ -183,7 +192,13 @@ class LoadGen:
                     trace_ids.append(trace_id)
                 except Exception as e:  # noqa: BLE001 — counted below
                     errors.append(f"{kind}: {e}")
-            time.sleep(self.rng.expovariate(self.rate))
+            rate = self.rate
+            if self.profile == "spike":
+                elapsed = time.monotonic() - t0
+                if (self.spike_start_s <= elapsed
+                        < self.spike_start_s + self.spike_len_s):
+                    rate *= self.spike_mult
+            time.sleep(self.rng.expovariate(rate))
         deadline = time.monotonic() + timeout_s
         client_ttft: list[float] = []
         client_e2e: list[float] = []
@@ -348,11 +363,20 @@ def main(argv=None) -> int:
                         help='kind mix, e.g. "chat:0.5,embeddings:0.3,'
                              'batch:0.2" (default 0.6/0.2/0.2)')
     parser.add_argument("--profile", default="mixed",
-                        choices=("mixed", "prefix_heavy"),
-                        help="prompt profile: mixed short prompts, or "
-                             "prefix_heavy (long shared heads + unique "
-                             "tails — drives prefix sharing, the fleet "
-                             "directory, and KV tier spill/reload)")
+                        choices=("mixed", "prefix_heavy", "spike"),
+                        help="prompt/arrival profile: mixed short "
+                             "prompts; prefix_heavy (long shared heads + "
+                             "unique tails — drives prefix sharing, the "
+                             "fleet directory, and KV tier spill/reload); "
+                             "spike (mixed prompts, Poisson baseline with "
+                             "a burst window — drives the autoscaler)")
+    parser.add_argument("--spike-start-s", type=float, default=2.0,
+                        help="spike profile: burst window start (s)")
+    parser.add_argument("--spike-len-s", type=float, default=4.0,
+                        help="spike profile: burst window length (s)")
+    parser.add_argument("--spike-mult", type=float, default=8.0,
+                        help="spike profile: arrival-rate multiplier "
+                             "inside the burst window")
     args = parser.parse_args(argv)
 
     mix = None
@@ -375,7 +399,10 @@ def main(argv=None) -> int:
     try:
         gen = LoadGen(mix=mix, tenants=parse_tenants(args.tenants),
                       rate=args.rate, seed=args.seed,
-                      max_tokens=args.max_tokens, profile=args.profile)
+                      max_tokens=args.max_tokens, profile=args.profile,
+                      spike_start_s=args.spike_start_s,
+                      spike_len_s=args.spike_len_s,
+                      spike_mult=args.spike_mult)
         summary = gen.run(EngineSink(sm, max_tokens=args.max_tokens),
                           total=args.total)
     finally:

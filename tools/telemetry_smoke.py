@@ -76,6 +76,13 @@ generations through the continuous-batching scheduler, then:
      client-observed TTFT p95 agreeing with the server-side histogram;
      the breakdown lands in ``--anatomy-out`` (a CI artifact);
 
+ 12. asserts the round-20 elastic capacity loop: a 1-replica autoscaled
+     fleet scales OUT under a seeded loadgen spike (queue-depth signal),
+     hot-swaps its replicas mid-life with zero failed requests, scales
+     to ZERO after the traffic quiesces, and cold-re-onboards a replica
+     for the next request — which waits for the boot and completes; the
+     capacity trajectory lands in ``--autoscale-out`` (a CI artifact);
+
  10. under ``--racecheck``, runs the WHOLE lifecycle above with
      ``tools.racecheck``'s instrumented locks installed (every
      ``threading.Lock``/``RLock`` the serving stack creates records its
@@ -211,6 +218,22 @@ REQUIRED_ANATOMY = (
     'localai_dispatch_phase_ms{model="smoke",phase="sync",quantile="p99"}',
     'localai_host_overhead_fraction{model="smoke"}',
     'localai_device_bubble_fraction{model="smoke"}',
+)
+# elastic-capacity series (round 20): the autoscaled fleet must record a
+# spike-driven scale-out, the quiesce-driven scale-to-zero, the cold
+# re-onboard that served the held request, and one hot weight swap
+# (values asserted in-code by check_autoscale; the exposition check pins
+# the series names — labels render alphabetically)
+REQUIRED_AUTOSCALE = (
+    'localai_autoscale_decisions_total{action="scale_out",'
+    'model="fleet-auto"}',
+    'localai_autoscale_decisions_total{action="scale_to_zero",'
+    'model="fleet-auto"}',
+    'localai_autoscale_decisions_total{action="cold_start",'
+    'model="fleet-auto"}',
+    'localai_autoscale_decisions_total{action="swap",model="fleet-auto"}',
+    'localai_fleet_target_replicas{model="fleet-auto"}',
+    'localai_model_swaps_total{model="fleet-auto"} 1',
 )
 
 
@@ -943,6 +966,144 @@ def check_anatomy(sched, tok, registry, anatomy_out: str) -> list[str]:
     return problems
 
 
+def check_autoscale(registry, autoscale_out: str) -> list[str]:
+    """Round 20 — elastic capacity end-to-end: a 1-replica autoscaled
+    in-process fleet rides a seeded spike (tools.loadgen profile=spike)
+    into a telemetry-driven scale-out, hot-swaps its replicas mid-life,
+    quiesces into scale-to-zero, and cold-re-onboards a replica for the
+    next request (which waits and completes — never errors). The
+    capacity trajectory lands in ``autoscale_out`` (a CI artifact,
+    ingestible by ``tools/usage_report.py --ingest-autoscale``)."""
+    import json as jsonlib
+    import threading
+
+    from localai_tpu.config.app_config import AppConfig
+    from localai_tpu.config.model_config import ModelConfig
+    from localai_tpu.engine.scheduler import GenRequest
+    from localai_tpu.fleet import FleetServingModel
+    from localai_tpu.fleet.autoscale import (AutoscaleConfig,
+                                             AutoscaleController)
+    from localai_tpu.fleet.replica import InProcessReplica
+    from localai_tpu.models.manager import build_serving_model
+    from localai_tpu.obs.history import HISTORY
+    from tools.loadgen import EngineSink, LoadGen
+
+    problems: list[str] = []
+    app = AppConfig()
+    mcfg = ModelConfig.model_validate({
+        "name": "fleet-auto", "model": "debug:tiny", "context_size": 256,
+        "parameters": {"temperature": 0.0, "max_tokens": 6},
+        "engine": {"max_slots": 2, "prefill_buckets": [16, 32, 64, 128],
+                   "dtype": "float32", "kv_dtype": "float32",
+                   "kv_block_tokens": 16},
+    })
+
+    def factory(rid, role):
+        return InProcessReplica(
+            rid, role, lambda: build_serving_model(mcfg, app))
+
+    fm = FleetServingModel(mcfg, app, factory, replicas=1)
+    auto = AutoscaleController(fm, config=AutoscaleConfig(
+        min_replicas=0, max_replicas=3, interval_s=0.1,
+        in_idle_s=1.0, zero_idle_s=1.5, out_queue_depth=1.5,
+        out_cooldown_s=0.5, in_cooldown_s=0.3, cold_timeout_s=120.0))
+    fm.autoscaler = auto
+    peak = {"healthy": 0}
+    sampling = threading.Event()
+
+    def sample():
+        while not sampling.wait(0.05):
+            peak["healthy"] = max(peak["healthy"],
+                                  len(fm.pool.healthy("decode")))
+
+    sampler = threading.Thread(target=sample, daemon=True)
+    report: dict = {}
+    try:
+        auto.start()
+        sampler.start()
+        # phase 1 — spike: seeded Poisson baseline, 6× burst window; the
+        # burst queues behind the single replica and the controller adds
+        # capacity (queue-depth signal)
+        gen = LoadGen(mix={"chat": 1.0}, rate=6.0, seed=11, max_tokens=6,
+                      profile="spike", spike_start_s=0.5, spike_len_s=4.0,
+                      spike_mult=8.0)
+        summary = gen.run(EngineSink(fm, max_tokens=6), total=36,
+                          timeout_s=300.0)
+        bad = {r: n for r, n in summary["outcomes"].items()
+               if r not in ("stop", "length")}
+        if bad or summary["errors"]:
+            problems.append(
+                f"autoscale: spike traffic failed: {bad} "
+                f"{summary['errors'][:3]}")
+        deadline = time.monotonic() + 30.0
+        while (auto.decisions["scale_out"] == 0
+               and time.monotonic() < deadline):
+            time.sleep(0.05)
+        if auto.decisions["scale_out"] < 1:
+            problems.append(
+                f"autoscale: no scale-out under the spike "
+                f"(decisions {auto.decisions})")
+        if peak["healthy"] < 2:
+            problems.append(
+                f"autoscale: fleet never exceeded 1 healthy replica "
+                f"(peak {peak['healthy']})")
+        # phase 2 — hot weight swap while capacity is up: every local
+        # replica is replaced by a freshly booted one, traffic shifts,
+        # the old generation drains clean
+        swap = fm.swap()
+        report["swap"] = swap
+        if not swap.get("ok"):
+            problems.append(f"autoscale: hot swap failed: {swap}")
+        # phase 3 — quiesce: all replicas idle past zero_idle_s → the
+        # model scales to ZERO
+        deadline = time.monotonic() + 60.0
+        while (fm.pool.healthy("decode")
+               and time.monotonic() < deadline):
+            time.sleep(0.1)
+        if fm.pool.healthy("decode"):
+            problems.append(
+                f"autoscale: fleet did not scale to zero after quiesce "
+                f"(decisions {auto.decisions})")
+        if auto.decisions["scale_to_zero"] < 1:
+            problems.append(
+                f"autoscale: no scale_to_zero decision recorded "
+                f"({auto.decisions})")
+        # phase 4 — cold re-onboard: the next request finds ZERO
+        # replicas, waits out the cold boot, and completes
+        t0 = time.monotonic()
+        h = fm.scheduler.submit(GenRequest(
+            prompt=fm.tokenizer.encode("wake the scaled-to-zero fleet"),
+            max_new_tokens=6, temperature=0.0))
+        h.result(timeout=300)
+        cold_ms = (time.monotonic() - t0) * 1e3
+        if h.finish_reason not in ("stop", "length"):
+            problems.append(
+                f"autoscale: held request finished "
+                f"{h.finish_reason!r} instead of being served by the "
+                f"cold re-onboard")
+        if auto.decisions["cold_start"] < 1:
+            problems.append(
+                f"autoscale: no cold_start recorded ({auto.decisions})")
+        fm.scheduler.export_gauges()
+        report.update({
+            "loadgen": summary,
+            "decisions": dict(auto.decisions),
+            "peak_healthy": peak["healthy"],
+            "cold_start_ms": round(cold_ms, 1),
+            "last_decision": auto.last_decision,
+            "target_series": HISTORY.query(
+                "fleet_target_replicas.fleet-auto", res=1),
+        })
+    finally:
+        sampling.set()
+        sampler.join(2)
+        auto.stop()
+        fm.close()
+    with open(autoscale_out, "w") as f:
+        jsonlib.dump(report, f, indent=2, sort_keys=True)
+    return problems
+
+
 def check_anomaly_capture(registry, profile_dir: str) -> list[str]:
     """Round-15 anomaly profiler: an injected ``engine.drain`` stall
     trips the watchdog and auto-captures a (real) jax.profiler trace
@@ -1205,6 +1366,7 @@ def main(argv=None) -> int:
     parser.add_argument("--fleet-flight-out", default="fleet_flight.json")
     parser.add_argument("--usage-out", default="usage_snapshot.json")
     parser.add_argument("--anatomy-out", default="anatomy_report.json")
+    parser.add_argument("--autoscale-out", default="autoscale_report.json")
     parser.add_argument("--profile-dir", default="profile_manifest")
     parser.add_argument("--requests", type=int, default=4)
     # two dispatch-rounds past the compile-bearing first one, so the
@@ -1279,6 +1441,7 @@ def main(argv=None) -> int:
         problems += check_fleetview(REGISTRY, args.fleet_flight_out)
         problems += check_usage(REGISTRY, args.usage_out)
         problems += check_anatomy(sched, tok, REGISTRY, args.anatomy_out)
+        problems += check_autoscale(REGISTRY, args.autoscale_out)
         problems += check_anomaly_capture(REGISTRY, args.profile_dir)
         if args.loopsan:
             problems += check_loopsan(args.loopsan_out)
@@ -1323,7 +1486,8 @@ def main(argv=None) -> int:
                            + REQUIRED_INTROSPECTION + REQUIRED_SLO
                            + REQUIRED_BATCH + REQUIRED_FLEET
                            + REQUIRED_KVECONOMY + REQUIRED_FLEETVIEW
-                           + REQUIRED_USAGE + REQUIRED_ANATOMY)
+                           + REQUIRED_USAGE + REQUIRED_ANATOMY
+                           + REQUIRED_AUTOSCALE)
                if s not in exposition]
     if missing or problems:
         print("FAIL: missing engine telemetry in /metrics exposition:")
@@ -1378,6 +1542,7 @@ def main(argv=None) -> int:
           f"fleet flight → {args.fleet_flight_out}, "
           f"usage → {args.usage_out}, "
           f"anatomy → {args.anatomy_out}, "
+          f"autoscale → {args.autoscale_out}, "
           f"profiles → {args.profile_dir}/manifest.json"
           + (f", loopsan → {args.loopsan_out}" if args.loopsan else ""))
     print(f"    ttft mean {summary['ttft']['mean_ms']}ms  "

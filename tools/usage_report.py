@@ -14,8 +14,12 @@ afternoon" is answerable from a dead snapshot.
 "unit", ...}`` with an optional nested ``"secondary"``) into the same
 store as ``bench.<metric>`` gauge series, timestamped at each file's
 mtime — the hardware-round trajectory lands in the one place that
-already knows how to downsample and persist it. ``--save`` writes the
-merged snapshot back (tmp + ``os.replace``, same as the live writer).
+already knows how to downsample and persist it. ``--ingest-autoscale``
+does the same for the ``autoscale_report.json`` artifact telemetry_smoke
+round 20 leaves behind: the fleet's capacity trajectory replays at its
+recorded timestamps and the decision counts / cold-start latency land as
+``autoscale.*`` series. ``--save`` writes the merged snapshot back
+(tmp + ``os.replace``, same as the live writer).
 
 Raw API keys never appear here for the same reason they never appear in
 /metrics: the ledger only ever stored hashed ``t-…`` buckets, so the
@@ -96,6 +100,9 @@ def build_report(h: History, *, res: int = 10) -> dict:
             "requests_shed": _collect(h, "requests_shed", res),
         },
         "bench": _collect(h, "bench", res),
+        "autoscale": _collect(h, "autoscale", res),
+        "fleet_target_replicas": _collect(h, "fleet_target_replicas",
+                                          res),
         "series_total": len(h.series_names()),
     }
     # tenants only present in the requests series (all-waste tenants
@@ -143,6 +150,19 @@ def render_text(report: dict, out=None) -> None:
         _table("bench trajectory", ["metric", "last", "points", "as of"],
                rows, out)
 
+    if report["autoscale"] or report["fleet_target_replicas"]:
+        rows = [[f"target_replicas.{m}", s["latest"], s["points"],
+                 time.strftime("%Y-%m-%d %H:%M",
+                               time.localtime(s["to_ts"]))]
+                for m, s in sorted(
+                    report["fleet_target_replicas"].items())]
+        rows += [[m, s["latest"], s["points"],
+                  time.strftime("%Y-%m-%d %H:%M",
+                                time.localtime(s["to_ts"]))]
+                 for m, s in sorted(report["autoscale"].items())]
+        _table("elastic capacity",
+               ["metric", "last", "points", "as of"], rows, out)
+
 
 def _bench_files(paths: list[str]) -> list[str]:
     files: list[str] = []
@@ -182,6 +202,58 @@ def ingest_bench(h: History, paths: list[str]) -> int:
     return ingested
 
 
+def _autoscale_files(paths: list[str]) -> list[str]:
+    files: list[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            files.extend(sorted(glob.glob(
+                os.path.join(p, "autoscale_report*.json"))))
+        else:
+            files.append(p)
+    return files
+
+
+def ingest_autoscale(h: History, paths: list[str]) -> int:
+    """Fold ``autoscale_report.json`` artifacts (telemetry_smoke round
+    20) into the store: the capacity trajectory replays point-by-point
+    at its recorded timestamps (``fleet_target_replicas.<model>``), and
+    the run's decision counts / peak / cold-start latency land as
+    ``autoscale.*`` gauges at the file's mtime. Returns points ingested;
+    bad files are skipped with a stderr note."""
+    ingested = 0
+    for path in _autoscale_files(paths):
+        try:
+            with open(path, encoding="utf-8") as f:
+                doc = json.load(f)
+            ts = os.path.getmtime(path)
+        except (OSError, ValueError) as e:
+            sys.stderr.write(f"usage_report: skipping {path}: {e}\n")
+            continue
+        if not isinstance(doc, dict):
+            sys.stderr.write(f"usage_report: skipping {path}: not a "
+                             f"JSON object\n")
+            continue
+        series = doc.get("target_series") or {}
+        name = series.get("series") or "fleet_target_replicas.unknown"
+        for pt in series.get("points") or []:
+            if isinstance(pt, dict) and isinstance(
+                    pt.get("value"), (int, float)):
+                h.record(name, float(pt["value"]),
+                         ts=float(pt.get("ts") or ts))
+                ingested += 1
+        for action, count in (doc.get("decisions") or {}).items():
+            if isinstance(count, (int, float)):
+                h.record(f"autoscale.decisions_{action}", float(count),
+                         ts=ts)
+                ingested += 1
+        for key in ("peak_healthy", "cold_start_ms"):
+            val = doc.get(key)
+            if isinstance(val, (int, float)):
+                h.record(f"autoscale.{key}", float(val), ts=ts)
+                ingested += 1
+    return ingested
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("snapshot_dir", nargs="?", default="",
@@ -194,6 +266,11 @@ def main(argv=None) -> int:
                         metavar="PATH",
                         help="BENCH_*.json files or directories to fold "
                              "into the store as bench.<metric> series")
+    parser.add_argument("--ingest-autoscale", nargs="+", default=[],
+                        metavar="PATH",
+                        help="autoscale_report*.json files or "
+                             "directories (telemetry_smoke round 20) to "
+                             "fold into the store as capacity series")
     parser.add_argument("--save", action="store_true",
                         help="write the (merged) snapshot back to "
                              "snapshot_dir")
@@ -202,8 +279,10 @@ def main(argv=None) -> int:
                              "of tables")
     args = parser.parse_args(argv)
 
-    if not args.snapshot_dir and not args.ingest_bench:
-        parser.error("need a snapshot dir and/or --ingest-bench")
+    if not args.snapshot_dir and not args.ingest_bench \
+            and not args.ingest_autoscale:
+        parser.error("need a snapshot dir, --ingest-bench and/or "
+                     "--ingest-autoscale")
 
     h = History()
     if args.snapshot_dir and not h.load(args.snapshot_dir):
@@ -212,6 +291,10 @@ def main(argv=None) -> int:
     if args.ingest_bench:
         n = ingest_bench(h, args.ingest_bench)
         sys.stderr.write(f"usage_report: ingested {n} bench point(s)\n")
+    if args.ingest_autoscale:
+        n = ingest_autoscale(h, args.ingest_autoscale)
+        sys.stderr.write(f"usage_report: ingested {n} autoscale "
+                         f"point(s)\n")
     if args.save:
         if not args.snapshot_dir:
             parser.error("--save needs a snapshot_dir to write to")
